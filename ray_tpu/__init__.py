@@ -1,0 +1,57 @@
+"""ray_tpu — a TPU-native distributed RL training framework.
+
+A from-scratch reimplementation of the capabilities of the Ray + RLlib reference
+(charlesjsun/ray, surveyed in SURVEY.md), designed TPU-first: CPU actor fleets run
+environment rollout while the policy-gradient learner loop runs as jit-compiled JAX
+sharded across a TPU mesh.
+
+Public surface (mirrors the reference's ``ray`` top-level API,
+``python/ray/_private/worker.py:984,2086``):
+
+    import ray_tpu as ray
+    ray.init()
+    @ray.remote
+    def f(x): ...
+    ref = f.remote(1)
+    ray.get(ref)
+"""
+
+from ray_tpu.version import __version__
+from ray_tpu.core.api import (
+    init,
+    shutdown,
+    is_initialized,
+    remote,
+    get,
+    put,
+    wait,
+    method,
+    get_runtime_context,
+    available_resources,
+    cluster_resources,
+    nodes,
+    timeline,
+    kill,
+    cancel,
+)
+from ray_tpu.core.object_store import ObjectRef
+
+__all__ = [
+    "__version__",
+    "init",
+    "shutdown",
+    "is_initialized",
+    "remote",
+    "get",
+    "put",
+    "wait",
+    "method",
+    "kill",
+    "cancel",
+    "get_runtime_context",
+    "available_resources",
+    "cluster_resources",
+    "nodes",
+    "timeline",
+    "ObjectRef",
+]

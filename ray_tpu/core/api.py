@@ -1,0 +1,759 @@
+"""Ray-like task/actor API over a process-based local backend.
+
+Counterpart of the reference's Python core API
+(``python/ray/_private/worker.py:984`` init, ``:2086`` get, remote_function /
+actor decorator machinery ``remote_function.py:34`` / ``actor.py:377``) and,
+underneath, the roles of raylet scheduling + CoreWorker submission
+(``src/ray/core_worker/core_worker.h:462``), scoped to one host.
+
+TPU-first disposition (SURVEY §2.1 table note): the heavy C++ process fabric
+(GCS, raylet, gRPC transports) is replaced by a driver-resident scheduler +
+spawned CPU worker processes + a shared-memory object plane. On a TPU pod
+the accelerator-side "scheduling" is static SPMD placement via jax meshes;
+this API exists for the CPU rollout fleet around the learner. Multi-host
+fan-out rides jax.distributed (DCN) rather than a bespoke RPC stack.
+"""
+
+from __future__ import annotations
+
+import atexit
+import functools
+import os
+import queue
+import threading
+import time
+import traceback
+import uuid
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+
+import multiprocessing as mp
+
+from ray_tpu.core import serialization as ser
+from ray_tpu.core.object_store import (
+    ObjectRef,
+    ObjectStore,
+    RayActorError,
+    RayTaskError,
+    WorkerCrashedError,
+)
+from ray_tpu.core.worker_proc import worker_main, _ObjArg
+
+_INLINE_ARG_MAX = 256 * 1024
+
+
+class _WorkerHandle:
+    def __init__(self, proc, conn, worker_id: str, dedicated: bool):
+        self.proc = proc
+        self.conn = conn
+        self.worker_id = worker_id
+        self.dedicated = dedicated  # actor-owned process
+        self.idle = True
+        self.dead = False
+        self.registered_funcs = set()
+        self.inflight: Dict[str, "_TaskRecord"] = {}
+        self.send_lock = threading.Lock()
+        self.recv_thread: Optional[threading.Thread] = None
+
+
+class _TaskRecord:
+    def __init__(self, task_id, msg, retries_left, name):
+        self.task_id = task_id
+        self.msg = msg
+        self.retries_left = retries_left
+        self.name = name
+        self.submit_time = time.time()
+
+
+class _ActorRecord:
+    def __init__(self, actor_id, worker, cls_blob, init_msg, max_restarts):
+        self.actor_id = actor_id
+        self.worker = worker
+        self.cls_blob = cls_blob
+        self.init_msg = init_msg
+        self.max_restarts = max_restarts
+        self.restarts = 0
+        self.name: Optional[str] = None
+        self.dead = False
+
+
+class _Runtime:
+    """Global driver state (reference: the global ``Worker`` in
+    ``_private/worker.py:397``)."""
+
+    def __init__(self, num_cpus: int, object_store_memory=None):
+        self.num_cpus = num_cpus
+        self.store = ObjectStore()
+        self.ctx = mp.get_context("spawn")
+        self.lock = threading.RLock()
+        self.pool: List[_WorkerHandle] = []
+        self.actors: Dict[str, _ActorRecord] = {}
+        self.named_actors: Dict[str, str] = {}
+        self.pending: "queue.deque" = None
+        import collections
+
+        self.pending = collections.deque()
+        self.timeline_events: List[Dict] = []
+        self.shutting_down = False
+        self._worker_env = {}
+
+    # -- worker lifecycle ------------------------------------------------
+
+    def _spawn_worker(self, dedicated: bool = False) -> _WorkerHandle:
+        worker_id = uuid.uuid4().hex[:12]
+        parent_conn, child_conn = self.ctx.Pipe(duplex=True)
+        proc = self.ctx.Process(
+            target=worker_main,
+            args=(child_conn, worker_id, dict(self._worker_env)),
+            daemon=True,
+            name=f"ray_tpu_worker_{worker_id}",
+        )
+        proc.start()
+        child_conn.close()
+        w = _WorkerHandle(proc, parent_conn, worker_id, dedicated)
+        t = threading.Thread(
+            target=self._recv_loop, args=(w,), daemon=True,
+            name=f"recv_{worker_id}",
+        )
+        w.recv_thread = t
+        t.start()
+        return w
+
+    def _recv_loop(self, w: _WorkerHandle):
+        while True:
+            try:
+                msg = w.conn.recv()
+            except (EOFError, OSError):
+                self._on_worker_death(w)
+                return
+            self._on_result(w, msg)
+
+    def _on_result(self, w: _WorkerHandle, msg: Dict):
+        task_id = msg.get("task_id")
+        with self.lock:
+            rec = w.inflight.pop(task_id, None)
+        status = msg["status"]
+        if status == "ok":
+            self.store.put(task_id, msg["value"], use_shm=False)
+        elif status == "ok_shm":
+            self.store.attach_shm(task_id, msg["shm_name"])
+        else:
+            name = rec.name if rec else "unknown"
+            err: BaseException = RayTaskError(name, msg["traceback"])
+            self.store.put_error(task_id, err)
+        if rec:
+            self._record_event(rec, w)
+        with self.lock:
+            if not w.dedicated:
+                w.idle = True
+        self._dispatch_pending()
+
+    def _on_worker_death(self, w: _WorkerHandle):
+        with self.lock:
+            if w.dead:
+                return
+            w.dead = True
+            inflight = list(w.inflight.values())
+            w.inflight.clear()
+            if not w.dedicated:
+                if w in self.pool:
+                    self.pool.remove(w)
+            actor_rec = None
+            for rec in self.actors.values():
+                if rec.worker is w:
+                    actor_rec = rec
+                    break
+        if self.shutting_down:
+            return
+        for trec in inflight:
+            if trec.retries_left > 0 and trec.msg["type"] == "task":
+                trec.retries_left -= 1
+                self._enqueue(trec)
+            else:
+                err: BaseException
+                if actor_rec is not None:
+                    err = RayActorError(
+                        f"Actor {actor_rec.actor_id} died executing "
+                        f"{trec.name}"
+                    )
+                else:
+                    err = WorkerCrashedError(
+                        f"Worker died executing {trec.name}"
+                    )
+                self.store.put_error(trec.task_id, err)
+        if actor_rec is not None:
+            self._maybe_restart_actor(actor_rec)
+        self._dispatch_pending()
+
+    def _maybe_restart_actor(self, rec: _ActorRecord):
+        with self.lock:
+            if rec.restarts >= rec.max_restarts or self.shutting_down:
+                rec.dead = True
+                return
+            rec.restarts += 1
+            w = self._spawn_worker(dedicated=True)
+            rec.worker = w
+        with w.send_lock:
+            w.conn.send(rec.init_msg)
+
+    # -- scheduling ------------------------------------------------------
+
+    def _enqueue(self, trec: _TaskRecord):
+        with self.lock:
+            self.pending.append(trec)
+        self._dispatch_pending()
+
+    def _dispatch_pending(self):
+        while True:
+            with self.lock:
+                if not self.pending:
+                    return
+                w = None
+                for cand in self.pool:
+                    if cand.idle and not cand.dead:
+                        w = cand
+                        break
+                if w is None and len(self.pool) < self.num_cpus:
+                    w = self._spawn_worker()
+                    self.pool.append(w)
+                if w is None:
+                    return
+                trec = self.pending.popleft()
+                w.idle = False
+                w.inflight[trec.task_id] = trec
+            self._send_task(w, trec)
+
+    def _send_task(self, w: _WorkerHandle, trec: _TaskRecord):
+        msg = trec.msg
+        try:
+            with w.send_lock:
+                if (
+                    msg["type"] == "task"
+                    and msg["func_id"] not in w.registered_funcs
+                ):
+                    w.conn.send(
+                        {
+                            "type": "register_func",
+                            "func_id": msg["func_id"],
+                            "func": msg["func_blob"],
+                        }
+                    )
+                    w.registered_funcs.add(msg["func_id"])
+                wire = {k: v for k, v in msg.items() if k != "func_blob"}
+                w.conn.send(wire)
+        except (BrokenPipeError, OSError):
+            self._on_worker_death(w)
+
+    def _record_event(self, trec: _TaskRecord, w: _WorkerHandle):
+        now = time.time()
+        self.timeline_events.append(
+            {
+                "name": trec.name,
+                "cat": "task",
+                "ph": "X",
+                "ts": trec.submit_time * 1e6,
+                "dur": (now - trec.submit_time) * 1e6,
+                "pid": 1,
+                "tid": hash(w.worker_id) % 10000,
+            }
+        )
+
+    # -- argument marshalling --------------------------------------------
+
+    def _marshal_arg(self, v):
+        if isinstance(v, ObjectRef):
+            if not self.store.is_ready(v.id):
+                raise _UnreadyDep(v.id)
+            shm = self.store.shm_name(v.id)
+            if shm:
+                return _ObjArg(v.id, shm_name=shm)
+            return _ObjArg(
+                v.id, inline=self.store.get(v.id), has_inline=True
+            )
+        return v
+
+    def submit_task(
+        self, func, func_id, func_blob, args, kwargs, options
+    ) -> List[ObjectRef]:
+        num_returns = options.get("num_returns", 1)
+        task_id = uuid.uuid4().hex
+        name = options.get("name") or getattr(func, "__name__", "task")
+        refs = [ObjectRef(task_id, self.store)]
+        if num_returns > 1:
+            refs = [
+                ObjectRef(f"{task_id}_{i}", self.store)
+                for i in range(num_returns)
+            ]
+            self._register_split(task_id, refs)
+
+        trec = _TaskRecord(
+            task_id,
+            {
+                "type": "task",
+                "task_id": task_id,
+                "func_id": func_id,
+                "func_blob": func_blob,
+                "args": args,
+                "kwargs": kwargs,
+            },
+            retries_left=options.get("max_retries", 3),
+            name=name,
+        )
+        self._submit_when_ready(trec, args, kwargs)
+        return refs
+
+    def _register_split(self, task_id: str, refs: List[ObjectRef]):
+        def split():
+            try:
+                values = self.store.get(task_id)
+            except BaseException as e:  # propagate error to all returns
+                for r in refs:
+                    self.store.put_error(r.id, e)
+                return
+            for r, v in zip(refs, values):
+                self.store.put(r.id, v, use_shm=False)
+
+        self.store.on_ready(task_id, split)
+
+    def _submit_when_ready(self, trec: _TaskRecord, args, kwargs):
+        """Marshal args; if some ObjectRef deps are unready, wait for them."""
+        deps = [
+            a.id
+            for a in list(args) + list(kwargs.values())
+            if isinstance(a, ObjectRef) and not self.store.is_ready(a.id)
+        ]
+        if not deps:
+            trec.msg["args"] = [self._marshal_arg(a) for a in trec.msg["args"]]
+            trec.msg["kwargs"] = {
+                k: self._marshal_arg(v) for k, v in trec.msg["kwargs"].items()
+            }
+            self._enqueue(trec)
+            return
+        remaining = {"n": len(deps)}
+        lk = threading.Lock()
+
+        def on_dep():
+            with lk:
+                remaining["n"] -= 1
+                done = remaining["n"] == 0
+            if done:
+                self._submit_when_ready(trec, trec.msg["args"], trec.msg["kwargs"])
+
+        for d in deps:
+            self.store.on_ready(d, on_dep)
+
+    # -- actors ----------------------------------------------------------
+
+    def create_actor(self, cls, args, kwargs, options) -> "ActorHandle":
+        actor_id = uuid.uuid4().hex
+        cls_blob = ser.dumps(cls)
+        w = self._spawn_worker(dedicated=True)
+        init_msg = {
+            "type": "actor_init",
+            "actor_id": actor_id,
+            "task_id": None,
+            "cls": cls_blob,
+            "args": [self._marshal_arg(a) for a in args],
+            "kwargs": {k: self._marshal_arg(v) for k, v in kwargs.items()},
+        }
+        rec = _ActorRecord(
+            actor_id, w, cls_blob, init_msg,
+            options.get("max_restarts", 0),
+        )
+        name = options.get("name")
+        with self.lock:
+            self.actors[actor_id] = rec
+            if name:
+                if name in self.named_actors:
+                    raise ValueError(f"Actor name {name} already taken")
+                self.named_actors[name] = actor_id
+                rec.name = name
+        with w.send_lock:
+            w.conn.send(init_msg)
+        return ActorHandle(actor_id, cls.__name__)
+
+    def call_actor(self, actor_id, method, args, kwargs, num_returns=1):
+        with self.lock:
+            rec = self.actors.get(actor_id)
+        if rec is None or rec.dead:
+            ref = ObjectRef(uuid.uuid4().hex, self.store)
+            self.store.put_error(
+                ref.id, RayActorError(f"Actor {actor_id} is dead")
+            )
+            return [ref]
+        task_id = uuid.uuid4().hex
+        trec = _TaskRecord(
+            task_id,
+            {
+                "type": "actor_call",
+                "task_id": task_id,
+                "actor_id": actor_id,
+                "method": method,
+                "args": [self._marshal_arg(a) for a in args],
+                "kwargs": {
+                    k: self._marshal_arg(v) for k, v in kwargs.items()
+                },
+            },
+            retries_left=0,
+            name=f"{method}",
+        )
+        w = rec.worker
+        with self.lock:
+            w.inflight[task_id] = trec
+        self._send_task(w, trec)
+        refs = [ObjectRef(task_id, self.store)]
+        if num_returns > 1:
+            refs = [
+                ObjectRef(f"{task_id}_{i}", self.store)
+                for i in range(num_returns)
+            ]
+            self._register_split(task_id, refs)
+        return refs
+
+    def kill_actor(self, actor_id: str, no_restart: bool = True):
+        with self.lock:
+            rec = self.actors.get(actor_id)
+            if rec is None:
+                return
+            rec.dead = True
+            if no_restart:
+                rec.max_restarts = 0
+            w = rec.worker
+        try:
+            w.proc.terminate()
+        except Exception:
+            pass
+
+    # -- shutdown --------------------------------------------------------
+
+    def shutdown(self):
+        self.shutting_down = True
+        with self.lock:
+            workers = list(self.pool) + [
+                rec.worker for rec in self.actors.values()
+            ]
+        for w in workers:
+            try:
+                with w.send_lock:
+                    w.conn.send({"type": "shutdown"})
+            except Exception:
+                pass
+        deadline = time.time() + 2.0
+        for w in workers:
+            w.proc.join(max(0.0, deadline - time.time()))
+            if w.proc.is_alive():
+                w.proc.terminate()
+        self.store.clear()
+
+
+class _UnreadyDep(Exception):
+    def __init__(self, obj_id):
+        self.obj_id = obj_id
+
+
+_runtime: Optional[_Runtime] = None
+
+
+def init(
+    num_cpus: Optional[int] = None,
+    num_gpus: Optional[int] = None,
+    object_store_memory: Optional[int] = None,
+    ignore_reinit_error: bool = False,
+    local_mode: bool = False,
+    worker_env: Optional[Dict[str, str]] = None,
+    **kwargs,
+) -> Dict:
+    """Start the local runtime (reference ray.init,
+    ``_private/worker.py:984``)."""
+    global _runtime
+    if _runtime is not None:
+        if ignore_reinit_error:
+            return {"address": "local"}
+        raise RuntimeError(
+            "ray_tpu.init() called twice; pass ignore_reinit_error=True"
+        )
+    n = num_cpus if num_cpus is not None else max(4, os.cpu_count() or 1)
+    _runtime = _Runtime(n, object_store_memory)
+    if worker_env:
+        _runtime._worker_env.update(worker_env)
+    return {"address": "local", "num_cpus": n}
+
+
+def is_initialized() -> bool:
+    return _runtime is not None
+
+
+def shutdown():
+    global _runtime
+    if _runtime is not None:
+        _runtime.shutdown()
+        _runtime = None
+
+
+atexit.register(shutdown)
+
+
+def _require_runtime() -> _Runtime:
+    if _runtime is None:
+        init()
+    return _runtime
+
+
+def put(value: Any) -> ObjectRef:
+    rt = _require_runtime()
+    ref = ObjectRef(uuid.uuid4().hex, rt.store)
+    rt.store.put(ref.id, value)
+    return ref
+
+
+def get(
+    refs: Union[ObjectRef, Sequence[ObjectRef]],
+    *,
+    timeout: Optional[float] = None,
+):
+    rt = _require_runtime()
+    if isinstance(refs, ObjectRef):
+        return rt.store.get(refs.id, timeout)
+    return [rt.store.get(r.id, timeout) for r in refs]
+
+
+def wait(
+    refs: Sequence[ObjectRef],
+    *,
+    num_returns: int = 1,
+    timeout: Optional[float] = None,
+    fetch_local: bool = True,
+) -> Tuple[List[ObjectRef], List[ObjectRef]]:
+    """reference ray.wait (worker.py)."""
+    rt = _require_runtime()
+    refs = list(refs)
+    deadline = None if timeout is None else time.time() + timeout
+    ready: List[ObjectRef] = []
+    evt = threading.Event()
+
+    def notify():
+        evt.set()
+
+    while True:
+        ready = [r for r in refs if rt.store.is_ready(r.id)]
+        if len(ready) >= num_returns:
+            break
+        if deadline is not None and time.time() >= deadline:
+            break
+        evt.clear()
+        for r in refs:
+            if not rt.store.is_ready(r.id):
+                rt.store.on_ready(r.id, notify)
+        remaining_t = (
+            None if deadline is None else max(0.0, deadline - time.time())
+        )
+        evt.wait(remaining_t)
+    ready, not_ready = [], []
+    for r in refs:
+        if rt.store.is_ready(r.id) and len(ready) < num_returns:
+            ready.append(r)
+        else:
+            not_ready.append(r)
+    return ready, not_ready
+
+
+class RemoteFunction:
+    """reference ``remote_function.py:34``."""
+
+    def __init__(self, func, options: Dict):
+        self._func = func
+        self._options = dict(options)
+        self._func_id = uuid.uuid4().hex[:16]
+        self._func_blob = None
+        functools.update_wrapper(self, func)
+
+    def options(self, **kwargs) -> "RemoteFunction":
+        rf = RemoteFunction(self._func, {**self._options, **kwargs})
+        rf._func_id = self._func_id
+        rf._func_blob = self._func_blob
+        return rf
+
+    def remote(self, *args, **kwargs) -> Union[ObjectRef, List[ObjectRef]]:
+        rt = _require_runtime()
+        if self._func_blob is None:
+            self._func_blob = ser.dumps(self._func)
+        refs = rt.submit_task(
+            self._func,
+            self._func_id,
+            self._func_blob,
+            list(args),
+            dict(kwargs),
+            self._options,
+        )
+        if self._options.get("num_returns", 1) == 1:
+            return refs[0]
+        return refs
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            "Remote functions cannot be called directly; use .remote()"
+        )
+
+
+class ActorMethod:
+    def __init__(self, handle: "ActorHandle", name: str, num_returns: int = 1):
+        self._handle = handle
+        self._name = name
+        self._num_returns = num_returns
+
+    def options(self, num_returns: int = 1, **kwargs) -> "ActorMethod":
+        return ActorMethod(self._handle, self._name, num_returns)
+
+    def remote(self, *args, **kwargs):
+        rt = _require_runtime()
+        refs = rt.call_actor(
+            self._handle._actor_id, self._name, list(args), dict(kwargs),
+            self._num_returns,
+        )
+        if self._num_returns == 1:
+            return refs[0]
+        return refs
+
+
+class ActorHandle:
+    """reference ``actor.py:950``."""
+
+    def __init__(self, actor_id: str, class_name: str = "Actor"):
+        self._actor_id = actor_id
+        self._class_name = class_name
+
+    def __getattr__(self, name):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return ActorMethod(self, name)
+
+    def __repr__(self):
+        return f"ActorHandle({self._class_name}, {self._actor_id[:8]})"
+
+    def __reduce__(self):
+        return (ActorHandle, (self._actor_id, self._class_name))
+
+
+class ActorClass:
+    """reference ``actor.py:377``."""
+
+    def __init__(self, cls, options: Dict):
+        self._cls = cls
+        self._options = dict(options)
+
+    def options(self, **kwargs) -> "ActorClass":
+        return ActorClass(self._cls, {**self._options, **kwargs})
+
+    def remote(self, *args, **kwargs) -> ActorHandle:
+        rt = _require_runtime()
+        return rt.create_actor(self._cls, list(args), dict(kwargs),
+                               self._options)
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            "Actor classes cannot be instantiated directly; use .remote()"
+        )
+
+
+def remote(*args, **options):
+    """``@ray.remote`` decorator (reference ``worker.py`` remote)."""
+
+    def decorate(obj):
+        if isinstance(obj, type):
+            return ActorClass(obj, options)
+        return RemoteFunction(obj, options)
+
+    if len(args) == 1 and callable(args[0]) and not options:
+        return decorate(args[0])
+    return decorate
+
+
+def method(num_returns: int = 1, **kwargs):
+    """``@ray.method`` decorator — annotates num_returns on actor methods."""
+
+    def decorate(m):
+        m.__ray_num_returns__ = num_returns
+        return m
+
+    return decorate
+
+
+def kill(actor: ActorHandle, *, no_restart: bool = True):
+    rt = _require_runtime()
+    rt.kill_actor(actor._actor_id, no_restart)
+
+
+def cancel(ref: ObjectRef, *, force: bool = False, recursive: bool = True):
+    # Best-effort: mark as errored if not yet done.
+    rt = _require_runtime()
+    if not rt.store.is_ready(ref.id):
+        rt.store.put_error(ref.id, TaskCancelledError("cancelled"))
+
+
+class TaskCancelledError(RuntimeError):
+    pass
+
+
+def get_actor(name: str) -> ActorHandle:
+    rt = _require_runtime()
+    with rt.lock:
+        actor_id = rt.named_actors.get(name)
+    if actor_id is None:
+        raise ValueError(f"No actor named {name!r}")
+    return ActorHandle(actor_id)
+
+
+class RuntimeContext:
+    def __init__(self):
+        self.node_id = "local"
+        self.job_id = "job_local"
+
+    def get(self):
+        return {"node_id": self.node_id, "job_id": self.job_id}
+
+
+def get_runtime_context() -> RuntimeContext:
+    return RuntimeContext()
+
+
+def available_resources() -> Dict[str, float]:
+    rt = _require_runtime()
+    with rt.lock:
+        used = sum(1 for w in rt.pool if not w.idle)
+    return {"CPU": float(rt.num_cpus - used)}
+
+
+def cluster_resources() -> Dict[str, float]:
+    rt = _require_runtime()
+    res = {"CPU": float(rt.num_cpus)}
+    try:
+        import jax
+
+        tpus = len(
+            [d for d in jax.devices() if d.platform not in ("cpu",)]
+        )
+        if tpus:
+            res["TPU"] = float(tpus)
+    except Exception:
+        pass
+    return res
+
+
+def nodes() -> List[Dict]:
+    return [
+        {
+            "NodeID": "local",
+            "Alive": True,
+            "Resources": cluster_resources(),
+        }
+    ]
+
+
+def timeline() -> List[Dict]:
+    """Chrome-trace events (reference ``_private/state.py:435``)."""
+    rt = _require_runtime()
+    return list(rt.timeline_events)
+
+
+def free(refs: Sequence[ObjectRef]):
+    rt = _require_runtime()
+    rt.store.free([r.id for r in refs])

@@ -1,0 +1,195 @@
+"""Host object plane: ObjectRef + shared-memory store.
+
+Plays the roles of the reference's in-process memory store
+(``src/ray/core_worker/store_provider/memory_store/memory_store.h:43``) for
+small objects and the plasma store (``plasma/store.h:55``) for large ones,
+scoped to one host. Objects above ``SHM_THRESHOLD`` are serialized into a
+POSIX shared-memory segment so any worker process on the node can map them
+zero-copy; small objects travel inline over the control pipes.
+
+Disposition vs the reference (SURVEY §2.1): distributed refcounting /
+spilling / lineage reconstruction are host-scoped here — a put object lives
+until ``free()`` or driver shutdown; cross-host transfer belongs to the
+(future) DCN object transport, not this file.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import uuid
+from multiprocessing import shared_memory
+from typing import Any, Dict, Optional
+
+from ray_tpu.core import serialization as ser
+
+SHM_THRESHOLD = 256 * 1024  # bytes
+
+
+class ObjectRef:
+    """Future handle to a task result or put object
+    (reference ``python/ray/_raylet.pyx ObjectRef``)."""
+
+    __slots__ = ("id", "_store")
+
+    def __init__(self, id: Optional[str] = None, store=None):
+        self.id = id or uuid.uuid4().hex
+        self._store = store
+
+    def __hash__(self):
+        return hash(self.id)
+
+    def __eq__(self, other):
+        return isinstance(other, ObjectRef) and self.id == other.id
+
+    def hex(self) -> str:
+        return self.id
+
+    def __repr__(self):
+        return f"ObjectRef({self.id[:16]})"
+
+    def __reduce__(self):
+        # Refs pickle as bare ids; the receiving side re-binds its store.
+        return (ObjectRef, (self.id,))
+
+
+class _Entry:
+    __slots__ = ("value", "shm", "event", "error", "callbacks")
+
+    def __init__(self):
+        self.value = None
+        self.shm: Optional[shared_memory.SharedMemory] = None
+        self.event = threading.Event()
+        self.error: Optional[BaseException] = None
+        self.callbacks = []
+
+    def fire(self):
+        self.event.set()
+        cbs, self.callbacks = self.callbacks, []
+        for cb in cbs:
+            cb()
+
+
+class ObjectStore:
+    """Driver-side object table. Thread-safe."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._entries: Dict[str, _Entry] = {}
+
+    def _entry(self, obj_id: str) -> _Entry:
+        with self._lock:
+            e = self._entries.get(obj_id)
+            if e is None:
+                e = _Entry()
+                self._entries[obj_id] = e
+            return e
+
+    def put(self, obj_id: str, value: Any, use_shm: bool = True) -> Optional[str]:
+        """Store a value; returns shm segment name if spilled to shm."""
+        e = self._entry(obj_id)
+        shm_name = None
+        if use_shm:
+            meta, buffers = ser.serialize(value)
+            size = ser.serialized_size(meta, buffers)
+            if size >= SHM_THRESHOLD:
+                shm = shared_memory.SharedMemory(
+                    create=True, size=size, name=f"rt_{obj_id[:24]}"
+                )
+                ser.write_to_buffer(shm.buf, meta, buffers)
+                e.shm = shm
+                shm_name = shm.name
+        e.value = value
+        e.fire()
+        return shm_name
+
+    def put_error(self, obj_id: str, err: BaseException) -> None:
+        e = self._entry(obj_id)
+        e.error = err
+        e.fire()
+
+    def attach_shm(self, obj_id: str, shm_name: str) -> None:
+        """Register a worker-created shm segment as this object's value."""
+        e = self._entry(obj_id)
+        shm = shared_memory.SharedMemory(name=shm_name)
+        e.shm = shm
+        e.value = ser.read_from_buffer(shm.buf)
+        e.fire()
+
+    def is_ready(self, obj_id: str) -> bool:
+        return self._entry(obj_id).event.is_set()
+
+    def wait(self, obj_id: str, timeout: Optional[float] = None) -> bool:
+        return self._entry(obj_id).event.wait(timeout)
+
+    def get(self, obj_id: str, timeout: Optional[float] = None) -> Any:
+        e = self._entry(obj_id)
+        if not e.event.wait(timeout):
+            raise GetTimeoutError(f"Timed out getting object {obj_id}")
+        if e.error is not None:
+            raise e.error
+        return e.value
+
+    def on_ready(self, obj_id: str, callback) -> None:
+        """Run callback when the object becomes available (or immediately)."""
+        e = self._entry(obj_id)
+        with self._lock:
+            if e.event.is_set():
+                run_now = True
+            else:
+                e.callbacks.append(callback)
+                run_now = False
+        if run_now:
+            callback()
+
+    def shm_name(self, obj_id: str) -> Optional[str]:
+        e = self._entries.get(obj_id)
+        return e.shm.name if e and e.shm else None
+
+    def free(self, obj_ids) -> None:
+        with self._lock:
+            for oid in obj_ids:
+                e = self._entries.pop(oid, None)
+                if e and e.shm:
+                    e.value = None  # drop zero-copy views first
+                    try:
+                        e.shm.unlink()
+                    except FileNotFoundError:
+                        pass
+                    try:
+                        e.shm.close()
+                    except BufferError:
+                        # Deserialized arrays still view the buffer; the
+                        # mapping is released when they are GC'd.
+                        pass
+
+    def clear(self) -> None:
+        with self._lock:
+            ids = list(self._entries)
+        self.free(ids)
+
+
+class GetTimeoutError(TimeoutError):
+    """reference: ray.exceptions.GetTimeoutError"""
+
+
+class RayTaskError(RuntimeError):
+    """A task raised; carries the remote traceback
+    (reference ray.exceptions.RayTaskError)."""
+
+    def __init__(self, function_name: str, traceback_str: str,
+                 cause: Optional[BaseException] = None):
+        self.function_name = function_name
+        self.traceback_str = traceback_str
+        self.cause = cause
+        super().__init__(
+            f"Task {function_name} failed:\n{traceback_str}"
+        )
+
+
+class RayActorError(RuntimeError):
+    """Actor died or method failed (reference ray.exceptions.RayActorError)."""
+
+
+class WorkerCrashedError(RuntimeError):
+    """The worker process died unexpectedly."""

@@ -1,0 +1,90 @@
+"""Zero-copy-oriented serialization for the object plane.
+
+Counterpart of the reference's ``python/ray/_private/serialization.py`` +
+plasma protocol. Uses pickle protocol 5 with out-of-band buffers: numpy
+arrays (SampleBatch columns, weight pytrees) serialize as a small metadata
+pickle plus raw buffers that are written contiguously into a shared-memory
+segment and reconstructed as views on attach — the shm segment plays the
+plasma role (``src/ray/object_manager/plasma/store.h:55``) scoped to one
+host.
+"""
+
+from __future__ import annotations
+
+import pickle
+import struct
+from typing import Any, List, Tuple
+
+import cloudpickle
+
+# Segment layout: [u64 meta_len][meta][u64 nbuf][u64 len_i ...][buf_0 pad8]...
+_HDR = struct.Struct("<Q")
+
+
+def serialize(obj: Any) -> Tuple[bytes, List[pickle.PickleBuffer]]:
+    """→ (meta, out-of-band buffers). Functions/classes go through
+    cloudpickle (reference: ray/cloudpickle fork)."""
+    buffers: List[pickle.PickleBuffer] = []
+    meta = cloudpickle.dumps(obj, protocol=5, buffer_callback=buffers.append)
+    return meta, buffers
+
+
+def deserialize(meta: bytes, buffers: List[Any]) -> Any:
+    return pickle.loads(meta, buffers=buffers)
+
+
+def serialized_size(meta: bytes, buffers: List[pickle.PickleBuffer]) -> int:
+    total = _HDR.size * 2 + len(meta)
+    for b in buffers:
+        n = b.raw().nbytes
+        total += _HDR.size + ((n + 7) & ~7)
+    return total
+
+
+def write_to_buffer(
+    view: memoryview, meta: bytes, buffers: List[pickle.PickleBuffer]
+) -> int:
+    """Write the segment layout into ``view``; returns bytes written."""
+    off = 0
+    view[off : off + _HDR.size] = _HDR.pack(len(meta))
+    off += _HDR.size
+    view[off : off + len(meta)] = meta
+    off += len(meta)
+    view[off : off + _HDR.size] = _HDR.pack(len(buffers))
+    off += _HDR.size
+    for b in buffers:
+        raw = b.raw()
+        n = raw.nbytes
+        view[off : off + _HDR.size] = _HDR.pack(n)
+        off += _HDR.size
+        view[off : off + n] = raw.cast("B")
+        off += (n + 7) & ~7
+    return off
+
+
+def read_from_buffer(view: memoryview) -> Any:
+    """Reconstruct an object from a segment; array buffers are zero-copy
+    views into ``view`` (caller keeps the segment alive)."""
+    off = 0
+    (meta_len,) = _HDR.unpack_from(view, off)
+    off += _HDR.size
+    meta = bytes(view[off : off + meta_len])
+    off += meta_len
+    (nbuf,) = _HDR.unpack_from(view, off)
+    off += _HDR.size
+    buffers = []
+    for _ in range(nbuf):
+        (n,) = _HDR.unpack_from(view, off)
+        off += _HDR.size
+        buffers.append(view[off : off + n])
+        off += (n + 7) & ~7
+    return deserialize(meta, buffers)
+
+
+def dumps(obj: Any) -> bytes:
+    """Single-buffer form (for pipe transport of small objects)."""
+    return cloudpickle.dumps(obj, protocol=5)
+
+
+def loads(data: bytes) -> Any:
+    return pickle.loads(data)

@@ -1,0 +1,163 @@
+"""Worker process main loop.
+
+The ray_tpu counterpart of the reference worker executable
+(``python/ray/_private/workers/default_worker.py`` +
+``_raylet.pyx execute_task :487``): a spawned process that executes stateless
+tasks and hosts actor instances, exchanging commands/results with the driver
+over a duplex pipe and large payloads through shared memory.
+
+Workers pin JAX to the CPU platform — the single TPU chip belongs to the
+driver/learner; rollout actors do inference with CPU XLA.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import traceback
+from multiprocessing import shared_memory
+from typing import Any, Dict
+
+
+def _resolve_args(args, kwargs, shm_cache):
+    """Replace _ObjArg markers with actual values (attaching shm)."""
+
+    def resolve(v):
+        if isinstance(v, _ObjArg):
+            return v.load(shm_cache)
+        return v
+
+    return [resolve(a) for a in args], {k: resolve(v) for k, v in kwargs.items()}
+
+
+class _ObjArg:
+    """Marker for an object-store argument passed to a worker."""
+
+    __slots__ = ("obj_id", "shm_name", "inline", "has_inline")
+
+    def __init__(self, obj_id, shm_name=None, inline=None, has_inline=False):
+        self.obj_id = obj_id
+        self.shm_name = shm_name
+        self.inline = inline
+        self.has_inline = has_inline
+
+    def load(self, shm_cache: Dict[str, Any]):
+        from ray_tpu.core import serialization as ser
+
+        if self.obj_id in shm_cache:
+            return shm_cache[self.obj_id][1]
+        if self.has_inline:
+            shm_cache[self.obj_id] = (None, self.inline)
+            return self.inline
+        shm = shared_memory.SharedMemory(name=self.shm_name)
+        value = ser.read_from_buffer(shm.buf)
+        # Keep the segment mapped as long as the value is cached: the
+        # deserialized arrays are zero-copy views into it.
+        shm_cache[self.obj_id] = (shm, value)
+        return value
+
+
+def worker_main(conn, worker_id: str, env_overrides: Dict[str, str]):
+    """Entry point for spawned worker processes."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ.update(env_overrides or {})
+
+    from ray_tpu.core import serialization as ser
+
+    func_cache: Dict[str, Any] = {}
+    shm_cache: Dict[str, Any] = {}
+    actors: Dict[str, Any] = {}
+    result_shms = []  # keep created segments alive until driver owns them
+
+    while True:
+        try:
+            msg = conn.recv()
+        except (EOFError, KeyboardInterrupt):
+            break
+        mtype = msg["type"]
+        if mtype == "shutdown":
+            break
+        try:
+            if mtype == "register_func":
+                func_cache[msg["func_id"]] = ser.loads(msg["func"])
+                continue
+            elif mtype == "task":
+                fn = func_cache[msg["func_id"]]
+                args, kwargs = _resolve_args(
+                    msg["args"], msg["kwargs"], shm_cache
+                )
+                value = fn(*args, **kwargs)
+            elif mtype == "actor_init":
+                cls = ser.loads(msg["cls"])
+                args, kwargs = _resolve_args(
+                    msg["args"], msg["kwargs"], shm_cache
+                )
+                actors[msg["actor_id"]] = cls(*args, **kwargs)
+                value = None
+            elif mtype == "actor_call":
+                actor = actors[msg["actor_id"]]
+                args, kwargs = _resolve_args(
+                    msg["args"], msg["kwargs"], shm_cache
+                )
+                value = getattr(actor, msg["method"])(*args, **kwargs)
+            elif mtype == "free":
+                for oid in msg["obj_ids"]:
+                    ent = shm_cache.pop(oid, None)
+                    if ent and ent[0] is not None:
+                        ent[0].close()
+                continue
+            else:
+                raise ValueError(f"unknown message type {mtype}")
+        except BaseException as e:  # noqa: BLE001 — report, don't die
+            tb = traceback.format_exc()
+            try:
+                conn.send(
+                    {
+                        "task_id": msg.get("task_id"),
+                        "status": "err",
+                        "error": str(e),
+                        "error_cls": type(e).__name__,
+                        "traceback": tb,
+                    }
+                )
+            except Exception:
+                break
+            continue
+
+        if msg.get("task_id") is None:
+            continue
+        # Serialize result; large payloads go out via a fresh shm segment.
+        meta, buffers = ser.serialize(value)
+        size = ser.serialized_size(meta, buffers)
+        if size >= 256 * 1024:
+            shm = shared_memory.SharedMemory(
+                create=True, size=size, name=f"rt_{msg['task_id'][:24]}"
+            )
+            ser.write_to_buffer(shm.buf, meta, buffers)
+            conn.send(
+                {
+                    "task_id": msg["task_id"],
+                    "status": "ok_shm",
+                    "shm_name": shm.name,
+                }
+            )
+            shm.close()  # driver now owns the segment (it will unlink)
+        else:
+            conn.send(
+                {
+                    "task_id": msg["task_id"],
+                    "status": "ok",
+                    "value": value,
+                }
+            )
+
+    for shm, _ in (v for v in shm_cache.values() if v[0] is not None):
+        try:
+            shm.close()
+        except Exception:
+            pass
+    try:
+        conn.close()
+    except Exception:
+        pass
+    sys.exit(0)

@@ -1,0 +1,3 @@
+from ray_tpu.data.sample_batch import SampleBatch, MultiAgentBatch, concat_samples
+
+__all__ = ["SampleBatch", "MultiAgentBatch", "concat_samples"]

@@ -1,0 +1,16 @@
+from ray_tpu.models.base import RTModel
+from ray_tpu.models.catalog import ModelCatalog, MODEL_DEFAULTS
+from ray_tpu.models.fcnet import FCNet
+from ray_tpu.models.cnn import VisionNet
+from ray_tpu.models.rnn import LSTMWrapper
+from ray_tpu.models.attention import GTrXLNet
+
+__all__ = [
+    "RTModel",
+    "ModelCatalog",
+    "MODEL_DEFAULTS",
+    "FCNet",
+    "VisionNet",
+    "LSTMWrapper",
+    "GTrXLNet",
+]

@@ -1,0 +1,136 @@
+"""GTrXL attention network (stabilized transformer for RL).
+
+Counterpart of the reference's ``rllib/models/torch/attention_net.py:37``
+(GTrXLNet, from "Stabilizing Transformers for RL", Parisotto et al. 2019).
+TPU-first: attention over the (memory + fragment) window is a single fused
+(B, H, T, S) dot-product batch that maps straight onto the MXU; recurrent
+"memory" per layer is carried as state arrays of static shape
+(B, memory_len, dim), so inference and training use one compiled graph.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from ray_tpu.models.base import RTModel
+
+
+class _GRUGate(nn.Module):
+    dim: int
+    init_bias: float = 2.0
+
+    @nn.compact
+    def __call__(self, x, y):
+        # x = residual input, y = transformed branch
+        wr = nn.Dense(self.dim, use_bias=False, name="wr")
+        ur = nn.Dense(self.dim, use_bias=False, name="ur")
+        wz = nn.Dense(self.dim, use_bias=False, name="wz")
+        uz = nn.Dense(self.dim, use_bias=False, name="uz")
+        wg = nn.Dense(self.dim, use_bias=False, name="wg")
+        ug = nn.Dense(self.dim, use_bias=False, name="ug")
+        bz = self.param(
+            "bz", nn.initializers.constant(self.init_bias), (self.dim,)
+        )
+        r = nn.sigmoid(wr(y) + ur(x))
+        z = nn.sigmoid(wz(y) + uz(x) - bz)
+        h = nn.tanh(wg(y) + ug(r * x))
+        return (1.0 - z) * x + z * h
+
+
+def _rel_positional_embedding(seq_len: int, dim: int) -> jnp.ndarray:
+    pos = jnp.arange(seq_len - 1, -1, -1.0)
+    inv_freq = 1.0 / (10000 ** (jnp.arange(0, dim, 2.0) / dim))
+    inp = pos[:, None] * inv_freq[None, :]
+    return jnp.concatenate([jnp.sin(inp), jnp.cos(inp)], axis=-1)
+
+
+class GTrXLNet(RTModel):
+    num_outputs: int
+    attention_dim: int = 64
+    num_transformer_units: int = 1
+    num_heads: int = 2
+    head_dim: int = 32
+    memory_len: int = 50
+    position_wise_mlp_dim: int = 32
+    init_gru_gate_bias: float = 2.0
+
+    @property
+    def is_recurrent(self) -> bool:
+        return True
+
+    def initial_state(self, batch_size: int = 1):
+        return tuple(
+            jnp.zeros(
+                (batch_size, self.memory_len, self.attention_dim), jnp.float32
+            )
+            for _ in range(self.num_transformer_units)
+        )
+
+    @nn.compact
+    def __call__(self, obs, state, seq_lens=None, resets=None):
+        B, T = obs.shape[0], obs.shape[1]
+        x = obs.reshape(B, T, -1).astype(jnp.float32)
+        x = nn.Dense(self.attention_dim, name="embed")(x)
+
+        new_state = []
+        M = self.memory_len
+        S = M + T
+        # causal mask over the concatenated [memory | fragment] window:
+        # query t may attend to all memory plus fragment steps <= t.
+        q_pos = jnp.arange(T)[:, None]
+        k_pos = jnp.arange(S)[None, :] - M
+        mask = k_pos <= q_pos  # (T, S)
+
+        pos_emb = _rel_positional_embedding(S, self.attention_dim)
+
+        for layer in range(self.num_transformer_units):
+            mem = state[layer]  # (B, M, D)
+            new_state.append(
+                jnp.concatenate([mem, x], axis=1)[:, -M:].astype(jnp.float32)
+            )
+            kv_in = jnp.concatenate([mem, x], axis=1)  # (B, S, D)
+            ln_x = nn.LayerNorm(name=f"ln_q_{layer}")(x)
+            ln_kv = nn.LayerNorm(name=f"ln_kv_{layer}")(kv_in)
+
+            H, Dh = self.num_heads, self.head_dim
+            q = nn.Dense(H * Dh, name=f"q_{layer}")(ln_x)
+            k = nn.Dense(H * Dh, name=f"k_{layer}")(ln_kv + pos_emb[None])
+            v = nn.Dense(H * Dh, name=f"v_{layer}")(ln_kv)
+            q = q.reshape(B, T, H, Dh).transpose(0, 2, 1, 3)
+            k = k.reshape(B, S, H, Dh).transpose(0, 2, 1, 3)
+            v = v.reshape(B, S, H, Dh).transpose(0, 2, 1, 3)
+            scores = jnp.einsum("bhtd,bhsd->bhts", q, k) / jnp.sqrt(
+                jnp.float32(Dh)
+            )
+            scores = jnp.where(mask[None, None], scores, -1e9)
+            attn = nn.softmax(scores, axis=-1)
+            out = jnp.einsum("bhts,bhsd->bhtd", attn, v)
+            out = out.transpose(0, 2, 1, 3).reshape(B, T, H * Dh)
+            out = nn.Dense(self.attention_dim, name=f"proj_{layer}")(out)
+            x = _GRUGate(
+                self.attention_dim, self.init_gru_gate_bias,
+                name=f"gate_attn_{layer}",
+            )(x, nn.relu(out))
+
+            ln2 = nn.LayerNorm(name=f"ln_mlp_{layer}")(x)
+            mlp = nn.Dense(
+                self.position_wise_mlp_dim, name=f"mlp0_{layer}"
+            )(ln2)
+            mlp = nn.relu(mlp)
+            mlp = nn.Dense(self.attention_dim, name=f"mlp1_{layer}")(mlp)
+            x = _GRUGate(
+                self.attention_dim, self.init_gru_gate_bias,
+                name=f"gate_mlp_{layer}",
+            )(x, nn.relu(mlp))
+
+        y = x.reshape(B * T, self.attention_dim)
+        logits = nn.Dense(
+            self.num_outputs, name="logits",
+            kernel_init=nn.initializers.variance_scaling(
+                0.01, "fan_in", "truncated_normal"),
+        )(y)
+        value = nn.Dense(1, name="value")(y).squeeze(-1)
+        return logits, value, tuple(new_state)

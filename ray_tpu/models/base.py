@@ -1,0 +1,45 @@
+"""Model interface for ray_tpu policies.
+
+Counterpart of the reference's ``rllib/models/modelv2.py`` (ModelV2), with one
+deliberate TPU-first change: instead of ``forward()`` + a separately-called,
+feature-caching ``value_function()`` (reference modelv2.py), every model's
+``__call__`` returns ``(logits, value, state_out)`` in a single forward pass,
+so policy and value share one fused XLA computation and no host-side caching
+protocol is needed.
+
+All models are ``flax.linen`` modules with signature::
+
+    __call__(obs, state: Sequence[jnp.ndarray], seq_lens) ->
+        (logits, value, state_out)
+
+Non-recurrent models take/return an empty state tuple and ignore seq_lens.
+Recurrent models receive ``obs`` shaped (B, T, ...) and states shaped
+(B, ...).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+ModelOutput = Tuple[jnp.ndarray, jnp.ndarray, Sequence[jnp.ndarray]]
+
+
+class RTModel(nn.Module):
+    """Marker base class; see module docstring for the contract."""
+
+    def initial_state(self, batch_size: int = 1) -> Sequence[jnp.ndarray]:
+        """Initial recurrent state arrays, leading dim = batch_size."""
+        return ()
+
+    @property
+    def is_recurrent(self) -> bool:
+        return False
+
+
+def get_activation(name: str):
+    if name in (None, "linear"):
+        return lambda x: x
+    return getattr(nn, name if name != "swish" else "silu")

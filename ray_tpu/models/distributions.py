@@ -1,0 +1,289 @@
+"""Action distributions as pure-JAX classes usable inside jit.
+
+Counterpart of the reference's ``rllib/models/torch/torch_action_dist.py`` and
+``rllib/models/jax/jax_action_dist.py`` (the 298-LoC stub the reference never
+finished — this module supplies the real thing). Every method is traceable:
+distributions are lightweight wrappers over their ``dist_inputs`` array, so a
+whole (sample, logp, entropy, kl) bundle fuses into the surrounding jitted
+policy function.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+SMALL_NUMBER = 1e-6
+MIN_LOG_NN_OUTPUT = -20.0
+MAX_LOG_NN_OUTPUT = 2.0
+
+
+class ActionDistribution:
+    """Base class (reference rllib/models/action_dist.py:14)."""
+
+    def __init__(self, inputs: jnp.ndarray):
+        self.inputs = inputs
+
+    def sample(self, rng: jax.Array) -> jnp.ndarray:
+        raise NotImplementedError
+
+    def deterministic_sample(self) -> jnp.ndarray:
+        raise NotImplementedError
+
+    def logp(self, x: jnp.ndarray) -> jnp.ndarray:
+        raise NotImplementedError
+
+    def entropy(self) -> jnp.ndarray:
+        raise NotImplementedError
+
+    def kl(self, other: "ActionDistribution") -> jnp.ndarray:
+        raise NotImplementedError
+
+    def sampled_action_logp(self, rng: jax.Array):
+        a = self.sample(rng)
+        return a, self.logp(a)
+
+    @staticmethod
+    def required_model_output_shape(action_space) -> int:
+        raise NotImplementedError
+
+
+class Categorical(ActionDistribution):
+    """Discrete actions from logits."""
+
+    def sample(self, rng):
+        return jax.random.categorical(rng, self.inputs, axis=-1)
+
+    def deterministic_sample(self):
+        return jnp.argmax(self.inputs, axis=-1)
+
+    def logp(self, x):
+        logits = jax.nn.log_softmax(self.inputs, axis=-1)
+        return jnp.take_along_axis(
+            logits, x[..., None].astype(jnp.int32), axis=-1
+        ).squeeze(-1)
+
+    def entropy(self):
+        logp = jax.nn.log_softmax(self.inputs, axis=-1)
+        p = jnp.exp(logp)
+        return -jnp.sum(p * logp, axis=-1)
+
+    def kl(self, other):
+        logp = jax.nn.log_softmax(self.inputs, axis=-1)
+        other_logp = jax.nn.log_softmax(other.inputs, axis=-1)
+        p = jnp.exp(logp)
+        return jnp.sum(p * (logp - other_logp), axis=-1)
+
+    @staticmethod
+    def required_model_output_shape(action_space):
+        return int(action_space.n)
+
+
+class MultiCategorical(ActionDistribution):
+    """Vector of discrete actions (reference MultiCategorical)."""
+
+    def __init__(self, inputs, input_lens: Tuple[int, ...]):
+        super().__init__(inputs)
+        self.input_lens = tuple(int(x) for x in input_lens)
+        splits = jnp.cumsum(jnp.array(self.input_lens))[:-1]
+        self.cats = [
+            Categorical(x) for x in jnp.split(inputs, splits, axis=-1)
+        ]
+
+    def sample(self, rng):
+        rngs = jax.random.split(rng, len(self.cats))
+        return jnp.stack(
+            [c.sample(r) for c, r in zip(self.cats, rngs)], axis=-1
+        )
+
+    def deterministic_sample(self):
+        return jnp.stack([c.deterministic_sample() for c in self.cats], -1)
+
+    def logp(self, x):
+        return sum(
+            c.logp(x[..., i]) for i, c in enumerate(self.cats)
+        )
+
+    def entropy(self):
+        return sum(c.entropy() for c in self.cats)
+
+    def kl(self, other):
+        return sum(c.kl(o) for c, o in zip(self.cats, other.cats))
+
+
+class DiagGaussian(ActionDistribution):
+    """Independent normal per dim; inputs = concat(mean, log_std)."""
+
+    def __init__(self, inputs):
+        super().__init__(inputs)
+        self.mean, self.log_std = jnp.split(inputs, 2, axis=-1)
+        self.std = jnp.exp(self.log_std)
+
+    def sample(self, rng):
+        return self.mean + self.std * jax.random.normal(
+            rng, self.mean.shape, dtype=self.mean.dtype
+        )
+
+    def deterministic_sample(self):
+        return self.mean
+
+    def logp(self, x):
+        return (
+            -0.5
+            * jnp.sum(jnp.square((x - self.mean) / (self.std + SMALL_NUMBER)), -1)
+            - 0.5 * jnp.log(2.0 * jnp.pi) * x.shape[-1]
+            - jnp.sum(self.log_std, -1)
+        )
+
+    def entropy(self):
+        return jnp.sum(
+            self.log_std + 0.5 * jnp.log(2.0 * jnp.pi * jnp.e), -1
+        )
+
+    def kl(self, other):
+        return jnp.sum(
+            other.log_std
+            - self.log_std
+            + (jnp.square(self.std) + jnp.square(self.mean - other.mean))
+            / (2.0 * jnp.square(other.std) + SMALL_NUMBER)
+            - 0.5,
+            -1,
+        )
+
+    @staticmethod
+    def required_model_output_shape(action_space):
+        return int(jnp.prod(jnp.array(action_space.shape))) * 2
+
+
+class SquashedGaussian(ActionDistribution):
+    """tanh-squashed gaussian, bounded to [low, high] (SAC;
+    reference rllib/models/torch/torch_action_dist.py SquashedGaussian)."""
+
+    def __init__(self, inputs, low: float = -1.0, high: float = 1.0):
+        super().__init__(inputs)
+        self.mean, log_std = jnp.split(inputs, 2, axis=-1)
+        self.log_std = jnp.clip(
+            log_std, MIN_LOG_NN_OUTPUT, MAX_LOG_NN_OUTPUT
+        )
+        self.std = jnp.exp(self.log_std)
+        self.low = low
+        self.high = high
+
+    def _squash(self, raw):
+        return (
+            (jnp.tanh(raw) + 1.0) / 2.0 * (self.high - self.low) + self.low
+        )
+
+    def _unsquash(self, a):
+        a01 = (a - self.low) / (self.high - self.low) * 2.0 - 1.0
+        a01 = jnp.clip(a01, -1.0 + SMALL_NUMBER, 1.0 - SMALL_NUMBER)
+        return jnp.arctanh(a01)
+
+    def sample(self, rng):
+        raw = self.mean + self.std * jax.random.normal(
+            rng, self.mean.shape, dtype=self.mean.dtype
+        )
+        return self._squash(raw)
+
+    def deterministic_sample(self):
+        return self._squash(self.mean)
+
+    def logp(self, x):
+        raw = self._unsquash(x)
+        base_logp = (
+            -0.5 * jnp.sum(jnp.square((raw - self.mean) / (self.std + SMALL_NUMBER)), -1)
+            - 0.5 * jnp.log(2.0 * jnp.pi) * raw.shape[-1]
+            - jnp.sum(self.log_std, -1)
+        )
+        # log det of tanh + affine jacobian
+        correction = jnp.sum(
+            jnp.log(1.0 - jnp.square(jnp.tanh(raw)) + SMALL_NUMBER)
+            + jnp.log((self.high - self.low) / 2.0),
+            axis=-1,
+        )
+        return base_logp - correction
+
+    def sampled_action_logp(self, rng):
+        raw = self.mean + self.std * jax.random.normal(
+            rng, self.mean.shape, dtype=self.mean.dtype
+        )
+        a = self._squash(raw)
+        base_logp = (
+            -0.5 * jnp.sum(jnp.square((raw - self.mean) / (self.std + SMALL_NUMBER)), -1)
+            - 0.5 * jnp.log(2.0 * jnp.pi) * raw.shape[-1]
+            - jnp.sum(self.log_std, -1)
+        )
+        correction = jnp.sum(
+            jnp.log(1.0 - jnp.square(jnp.tanh(raw)) + SMALL_NUMBER)
+            + jnp.log((self.high - self.low) / 2.0),
+            axis=-1,
+        )
+        return a, base_logp - correction
+
+    def entropy(self):
+        # No closed form post-squash; return base gaussian entropy
+        # (same convention as the reference torch SquashedGaussian).
+        return jnp.sum(
+            self.log_std + 0.5 * jnp.log(2.0 * jnp.pi * jnp.e), -1
+        )
+
+    @staticmethod
+    def required_model_output_shape(action_space):
+        return int(jnp.prod(jnp.array(action_space.shape))) * 2
+
+
+class Deterministic(ActionDistribution):
+    """Pass-through for DDPG/TD3-style deterministic policies."""
+
+    def sample(self, rng):
+        return self.inputs
+
+    def deterministic_sample(self):
+        return self.inputs
+
+    def logp(self, x):
+        return jnp.zeros(self.inputs.shape[:-1], self.inputs.dtype)
+
+    def entropy(self):
+        return jnp.zeros(self.inputs.shape[:-1], self.inputs.dtype)
+
+    def kl(self, other):
+        return jnp.zeros(self.inputs.shape[:-1], self.inputs.dtype)
+
+
+class Bernoulli(ActionDistribution):
+    """Independent bernoulli per dim from logits (MultiBinary spaces)."""
+
+    def sample(self, rng):
+        p = jax.nn.sigmoid(self.inputs)
+        return (
+            jax.random.uniform(rng, p.shape, dtype=p.dtype) < p
+        ).astype(jnp.int32)
+
+    def deterministic_sample(self):
+        return (self.inputs > 0).astype(jnp.int32)
+
+    def logp(self, x):
+        x = x.astype(self.inputs.dtype)
+        return -jnp.sum(
+            jnp.maximum(self.inputs, 0)
+            - self.inputs * x
+            + jnp.log1p(jnp.exp(-jnp.abs(self.inputs))),
+            axis=-1,
+        )
+
+    def entropy(self):
+        p = jax.nn.sigmoid(self.inputs)
+        logp = jax.nn.log_sigmoid(self.inputs)
+        log1mp = jax.nn.log_sigmoid(-self.inputs)
+        return -jnp.sum(p * logp + (1 - p) * log1mp, axis=-1)
+
+    def kl(self, other):
+        p = jax.nn.sigmoid(self.inputs)
+        return jnp.sum(
+            p * (jax.nn.log_sigmoid(self.inputs) - jax.nn.log_sigmoid(other.inputs))
+            + (1 - p) * (jax.nn.log_sigmoid(-self.inputs) - jax.nn.log_sigmoid(-other.inputs)),
+            axis=-1,
+        )

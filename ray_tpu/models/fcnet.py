@@ -1,0 +1,75 @@
+"""Fully-connected policy/value network.
+
+Counterpart of the reference's ``rllib/models/torch/fcnet.py`` (and the jax
+stub ``rllib/models/jax/fcnet.py``). Supports the same knobs: ``hiddens``,
+``activation``, ``vf_share_layers``, ``free_log_std`` (a state-independent
+log-std appended to the mean output for DiagGaussian policies).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from ray_tpu.models.base import RTModel, get_activation
+
+
+class FCNet(RTModel):
+    num_outputs: int
+    hiddens: Sequence[int] = (256, 256)
+    activation: str = "tanh"
+    vf_share_layers: bool = False
+    free_log_std: bool = False
+    dtype_: str = "float32"
+
+    @nn.compact
+    def __call__(self, obs, state=(), seq_lens=None):
+        dtype = jnp.dtype(self.dtype_)
+        x = obs.astype(dtype)
+        x = x.reshape(x.shape[0], -1)
+        act = get_activation(self.activation)
+
+        num_outputs = self.num_outputs
+        if self.free_log_std:
+            num_outputs = num_outputs // 2
+
+        h = x
+        for i, size in enumerate(self.hiddens):
+            h = act(nn.Dense(size, name=f"fc_{i}", dtype=dtype)(h))
+        logits = nn.Dense(
+            num_outputs,
+            name="logits",
+            dtype=dtype,
+            kernel_init=nn.initializers.variance_scaling(
+                0.01, "fan_in", "truncated_normal"
+            ),
+        )(h)
+
+        if self.free_log_std:
+            log_std = self.param(
+                "free_log_std",
+                nn.initializers.zeros,
+                (num_outputs,),
+                jnp.float32,
+            )
+            logits = jnp.concatenate(
+                [logits, jnp.broadcast_to(log_std, logits.shape)], axis=-1
+            )
+
+        if self.vf_share_layers:
+            vf_h = h
+        else:
+            vf_h = x
+            for i, size in enumerate(self.hiddens):
+                vf_h = act(nn.Dense(size, name=f"vf_fc_{i}", dtype=dtype)(vf_h))
+        value = nn.Dense(
+            1,
+            name="value",
+            dtype=dtype,
+            kernel_init=nn.initializers.variance_scaling(
+                1.0, "fan_in", "truncated_normal"
+            ),
+        )(vf_h)
+        return logits.astype(jnp.float32), value.squeeze(-1).astype(jnp.float32), ()

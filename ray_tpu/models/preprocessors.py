@@ -1,0 +1,130 @@
+"""Observation preprocessors (host-side, numpy).
+
+Counterpart of the reference's ``rllib/models/preprocessors.py:24``. Runs on
+CPU rollout actors before observations enter SampleBatch columns, so the
+learner only ever sees flat fixed-shape float/uint8 arrays.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+try:
+    import gymnasium as gym
+    from gymnasium import spaces
+except ImportError:  # pragma: no cover
+    gym = None
+    spaces = None
+
+
+class Preprocessor:
+    def __init__(self, obs_space):
+        self._obs_space = obs_space
+        self.shape = self._init_shape(obs_space)
+        self._size = int(np.prod(self.shape))
+
+    def _init_shape(self, obs_space):
+        raise NotImplementedError
+
+    def transform(self, observation) -> np.ndarray:
+        raise NotImplementedError
+
+    @property
+    def size(self) -> int:
+        return self._size
+
+    @property
+    def observation_space(self):
+        space = spaces.Box(-1.0, 1.0, self.shape, dtype=np.float32)
+        space.original_space = self._obs_space
+        return space
+
+
+class NoPreprocessor(Preprocessor):
+    def _init_shape(self, obs_space):
+        return obs_space.shape
+
+    def transform(self, observation):
+        return np.asarray(observation)
+
+    @property
+    def observation_space(self):
+        return self._obs_space
+
+
+class OneHotPreprocessor(Preprocessor):
+    """Discrete → one-hot (reference preprocessors.py OneHotPreprocessor)."""
+
+    def _init_shape(self, obs_space):
+        if isinstance(obs_space, spaces.Discrete):
+            return (int(obs_space.n),)
+        # MultiDiscrete
+        return (int(np.sum(obs_space.nvec)),)
+
+    def transform(self, observation):
+        out = np.zeros(self.shape, dtype=np.float32)
+        if isinstance(self._obs_space, spaces.Discrete):
+            out[int(observation)] = 1.0
+        else:
+            offset = 0
+            for i, n in enumerate(self._obs_space.nvec):
+                out[offset + int(observation[i])] = 1.0
+                offset += int(n)
+        return out
+
+
+class FlattenPreprocessor(Preprocessor):
+    def _init_shape(self, obs_space):
+        return (int(np.prod(obs_space.shape)),)
+
+    def transform(self, observation):
+        return np.asarray(observation, dtype=np.float32).reshape(-1)
+
+
+class DictFlatteningPreprocessor(Preprocessor):
+    """Dict/Tuple spaces → single flat vector (reference
+    DictFlatteningPreprocessor / TupleFlatteningPreprocessor)."""
+
+    def _init_shape(self, obs_space):
+        self._children = []
+        if isinstance(obs_space, spaces.Dict):
+            items = [obs_space.spaces[k] for k in sorted(obs_space.spaces)]
+            self._keys = sorted(obs_space.spaces)
+        else:
+            items = list(obs_space.spaces)
+            self._keys = None
+        size = 0
+        for sp in items:
+            child = get_preprocessor_for_space(sp)
+            self._children.append(child)
+            size += child.size
+        return (size,)
+
+    def transform(self, observation):
+        if self._keys is not None:
+            parts = [
+                self._children[i].transform(observation[k]).reshape(-1)
+                for i, k in enumerate(self._keys)
+            ]
+        else:
+            parts = [
+                c.transform(o).reshape(-1)
+                for c, o in zip(self._children, observation)
+            ]
+        return np.concatenate(
+            [p.astype(np.float32) for p in parts]
+        )
+
+
+def get_preprocessor_for_space(obs_space) -> Preprocessor:
+    """Reference ModelCatalog.get_preprocessor (catalog.py:768)."""
+    if isinstance(obs_space, (spaces.Discrete, spaces.MultiDiscrete)):
+        return OneHotPreprocessor(obs_space)
+    if isinstance(obs_space, (spaces.Dict, spaces.Tuple)):
+        return DictFlatteningPreprocessor(obs_space)
+    if isinstance(obs_space, spaces.Box):
+        # Images (3D uint8) pass through unchanged for the CNN path.
+        if len(obs_space.shape) == 3:
+            return NoPreprocessor(obs_space)
+        return NoPreprocessor(obs_space)
+    return NoPreprocessor(obs_space)

@@ -1,0 +1,97 @@
+"""Recurrent (LSTM) model wrapper.
+
+Counterpart of the reference's ``rllib/models/torch/recurrent_net.py``
+(LSTMWrapper). TPU-first differences:
+  - time is unrolled with ``nn.scan`` (compiles to an XLA while loop with
+    static (B, T) shapes) instead of cuDNN packed sequences;
+  - episode boundaries inside a fragment are handled by a per-step ``resets``
+    mask that zeroes the carried state, so fragments never need re-chopping
+    to episode boundaries (the reference chops + zero-pads via
+    ``rllib/policy/rnn_sequencing.py:216``).
+
+Call contract: obs is (B, T, ...); state is a (h, c) pair each (B, cell);
+returns logits (B*T, num_outputs), value (B*T,), new state.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.models.base import RTModel, get_activation
+
+
+class LSTMWrapper(RTModel):
+    num_outputs: int
+    cell_size: int = 256
+    hiddens: Sequence[int] = (256,)
+    activation: str = "tanh"
+    use_prev_action: bool = False
+    use_prev_reward: bool = False
+    dtype_: str = "float32"
+
+    @property
+    def is_recurrent(self) -> bool:
+        return True
+
+    def initial_state(self, batch_size: int = 1):
+        return (
+            jnp.zeros((batch_size, self.cell_size), jnp.float32),
+            jnp.zeros((batch_size, self.cell_size), jnp.float32),
+        )
+
+    @nn.compact
+    def __call__(self, obs, state, seq_lens=None, resets=None,
+                 prev_actions=None, prev_rewards=None):
+        dtype = jnp.dtype(self.dtype_)
+        act = get_activation(self.activation)
+        B, T = obs.shape[0], obs.shape[1]
+        x = obs.astype(dtype).reshape(B, T, -1)
+        extras = []
+        if self.use_prev_action and prev_actions is not None:
+            extras.append(prev_actions.astype(dtype).reshape(B, T, -1))
+        if self.use_prev_reward and prev_rewards is not None:
+            extras.append(prev_rewards.astype(dtype).reshape(B, T, 1))
+        if extras:
+            x = jnp.concatenate([x] + extras, axis=-1)
+        for i, size in enumerate(self.hiddens):
+            x = act(nn.Dense(size, name=f"fc_{i}", dtype=dtype)(x))
+
+        cell = nn.OptimizedLSTMCell(self.cell_size, dtype=dtype)
+        if resets is None:
+            resets = jnp.zeros((B, T), jnp.float32)
+        resets = resets.astype(jnp.float32)
+
+        def step(cell, carry, inputs):
+            xt, reset_t = inputs
+            keep = (1.0 - reset_t)[:, None]
+            carry = (carry[0] * keep, carry[1] * keep)
+            carry, y = cell(carry, xt)
+            return carry, y
+
+        scan = nn.scan(
+            step,
+            variable_broadcast="params",
+            split_rngs={"params": False},
+            in_axes=1,
+            out_axes=1,
+        )
+        carry0 = (state[1].astype(dtype), state[0].astype(dtype))  # (c, h)
+        carry, y = scan(cell, carry0, (x, resets))
+        new_state = (
+            carry[1].astype(jnp.float32),  # h
+            carry[0].astype(jnp.float32),  # c
+        )
+        y = y.reshape(B * T, -1)
+        logits = nn.Dense(
+            self.num_outputs, name="logits", dtype=jnp.float32,
+            kernel_init=nn.initializers.variance_scaling(
+                0.01, "fan_in", "truncated_normal"),
+        )(y.astype(jnp.float32))
+        value = nn.Dense(1, name="value", dtype=jnp.float32)(
+            y.astype(jnp.float32)
+        ).squeeze(-1)
+        return logits, value, new_state

@@ -1,0 +1,16 @@
+from ray_tpu.ops.gae import (
+    discount_cumsum,
+    discount_cumsum_np,
+    compute_gae,
+    compute_gae_np,
+)
+from ray_tpu.ops.vtrace import vtrace_from_importance_weights, vtrace_from_logits
+
+__all__ = [
+    "discount_cumsum",
+    "discount_cumsum_np",
+    "compute_gae",
+    "compute_gae_np",
+    "vtrace_from_importance_weights",
+    "vtrace_from_logits",
+]

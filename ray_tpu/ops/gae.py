@@ -1,0 +1,125 @@
+"""Generalized Advantage Estimation as XLA-friendly scans.
+
+TPU-native counterpart of the reference's numpy GAE
+(``rllib/evaluation/postprocessing.py:76`` compute_advantages and the
+``discount_cumsum`` helper). The reference runs this per-episode in numpy on
+rollout workers; here the fast path is a jit-compiled ``lax.scan`` over fixed
+(B, T) fragments inside the learner step, with episode boundaries handled by
+``dones`` masks so no dynamic shapes are ever needed.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def discount_cumsum_np(x: np.ndarray, gamma: float) -> np.ndarray:
+    """y[t] = sum_{k>=t} gamma^(k-t) x[k] (host/numpy golden version)."""
+    out = np.zeros_like(x, dtype=np.float32)
+    run = 0.0
+    for t in range(len(x) - 1, -1, -1):
+        run = x[t] + gamma * run
+        out[t] = run
+    return out
+
+
+def discount_cumsum(x: jnp.ndarray, gamma: float) -> jnp.ndarray:
+    """Reverse discounted cumsum along the last axis via associative scan.
+
+    Uses a first-order linear recurrence composed associatively, so XLA can
+    parallelize it (log-depth) instead of a sequential loop.
+    """
+
+    def combine(a, b):
+        # Each element is (coeff, value): y = coeff * y_next + value
+        ca, va = a
+        cb, vb = b
+        return ca * cb, va * cb + vb
+
+    coeffs = jnp.full_like(x, gamma)
+    _, y = jax.lax.associative_scan(
+        combine, (coeffs, x), reverse=True, axis=x.ndim - 1
+    )
+    return y
+
+
+def compute_gae_np(
+    rewards: np.ndarray,
+    values: np.ndarray,
+    dones: np.ndarray,
+    bootstrap_value: float,
+    gamma: float = 0.99,
+    lambda_: float = 1.0,
+):
+    """Host/numpy GAE over a single trajectory (golden version).
+
+    Matches the semantics of reference ``postprocessing.py:76``: if the
+    trajectory was terminated, ``bootstrap_value`` should be 0; if truncated,
+    it is V(s_T).
+    """
+    T = len(rewards)
+    values_tp1 = np.append(values[1:], bootstrap_value)
+    not_done = 1.0 - dones.astype(np.float32)
+    deltas = rewards + gamma * values_tp1 * not_done - values
+    adv = np.zeros(T, dtype=np.float32)
+    run = 0.0
+    for t in range(T - 1, -1, -1):
+        run = deltas[t] + gamma * lambda_ * not_done[t] * run
+        adv[t] = run
+    value_targets = adv + values
+    return adv.astype(np.float32), value_targets.astype(np.float32)
+
+
+def compute_gae(
+    rewards: jnp.ndarray,
+    values: jnp.ndarray,
+    dones: jnp.ndarray,
+    bootstrap_value: jnp.ndarray,
+    gamma: float = 0.99,
+    lambda_: float = 1.0,
+):
+    """GAE over fixed-shape (B, T) fragments; jit/TPU fast path.
+
+    Args:
+        rewards/values/dones: float/bool arrays of shape (B, T). ``dones``
+            marks environment termination at step t (no bootstrap across it).
+        bootstrap_value: (B,) value estimate of the observation *after* the
+            fragment's last step (0 where the last step terminated).
+
+    Returns:
+        (advantages, value_targets), both (B, T) float32.
+
+    Episode boundaries inside a fragment are handled by the ``dones`` mask:
+    the recurrence resets because (1 - done) zeroes both the bootstrapped
+    next-value and the accumulated advantage.
+    """
+    rewards = rewards.astype(jnp.float32)
+    values = values.astype(jnp.float32)
+    not_done = 1.0 - dones.astype(jnp.float32)
+
+    values_tp1 = jnp.concatenate(
+        [values[:, 1:], bootstrap_value[:, None]], axis=1
+    )
+    deltas = rewards + gamma * values_tp1 * not_done - values
+
+    # adv[t] = delta[t] + (gamma*lambda*not_done[t]) * adv[t+1]
+    coeffs = gamma * lambda_ * not_done
+
+    def combine(a, b):
+        ca, va = a
+        cb, vb = b
+        return ca * cb, va * cb + vb
+
+    _, adv = jax.lax.associative_scan(
+        combine, (coeffs, deltas), reverse=True, axis=deltas.ndim - 1
+    )
+    value_targets = adv + values
+    return adv, value_targets
+
+
+def standardize(x: jnp.ndarray, eps: float = 1e-4) -> jnp.ndarray:
+    """Zero-mean unit-variance normalization (reference ppo.py:415
+    standardize_fields)."""
+    return (x - x.mean()) / jnp.maximum(x.std(), eps)

@@ -1,0 +1,91 @@
+"""Vectorized segment trees for prioritized replay.
+
+Counterpart of the reference's ``rllib/execution/segment_tree.py:172``
+(SumSegmentTree/MinSegmentTree). The reference uses per-element python
+recursion; here the tree is a flat numpy array with vectorized batch
+operations (``set_items``, ``sample_idx`` for a whole batch at once) since
+replay sampling happens on the host at batch granularity.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class SegmentTree:
+    def __init__(self, capacity: int, operation, neutral_element: float):
+        assert capacity > 0 and capacity & (capacity - 1) == 0, (
+            "capacity must be a positive power of 2"
+        )
+        self.capacity = capacity
+        self.operation = operation
+        self.neutral_element = neutral_element
+        self.value = np.full(2 * capacity, neutral_element, dtype=np.float64)
+
+    def set_items(self, idx: np.ndarray, val: np.ndarray) -> None:
+        idx = np.asarray(idx, dtype=np.int64) + self.capacity
+        self.value[idx] = val
+        idx //= 2
+        while np.any(idx >= 1):
+            live = idx[idx >= 1]
+            self.value[live] = self.operation(
+                self.value[2 * live], self.value[2 * live + 1]
+            )
+            idx //= 2
+            idx = idx[idx >= 1]
+            if len(idx) == 0:
+                break
+
+    def __setitem__(self, idx, val):
+        self.set_items(np.atleast_1d(idx), np.atleast_1d(val))
+
+    def __getitem__(self, idx):
+        return self.value[self.capacity + idx]
+
+    def reduce(self, start: int = 0, end: int | None = None) -> float:
+        if end is None:
+            end = self.capacity
+        if end < 0:
+            end += self.capacity
+        result = self.neutral_element
+        start += self.capacity
+        end += self.capacity
+        while start < end:
+            if start & 1:
+                result = self.operation(result, self.value[start])
+                start += 1
+            if end & 1:
+                end -= 1
+                result = self.operation(result, self.value[end])
+            start //= 2
+            end //= 2
+        return result
+
+
+class SumSegmentTree(SegmentTree):
+    def __init__(self, capacity: int):
+        super().__init__(capacity, np.add, 0.0)
+
+    def sum(self, start: int = 0, end: int | None = None) -> float:
+        return self.reduce(start, end)
+
+    def find_prefixsum_idx(self, prefixsum: np.ndarray) -> np.ndarray:
+        """Vectorized: for each p in prefixsum, find the highest leaf i such
+        that sum(leaves[0..i-1]) <= p. Descends all queries in lockstep."""
+        p = np.asarray(prefixsum, dtype=np.float64).copy()
+        idx = np.ones(len(p), dtype=np.int64)
+        while idx[0] < self.capacity:
+            left = 2 * idx
+            left_vals = self.value[left]
+            go_right = p > left_vals
+            p = np.where(go_right, p - left_vals, p)
+            idx = np.where(go_right, left + 1, left)
+        return idx - self.capacity
+
+
+class MinSegmentTree(SegmentTree):
+    def __init__(self, capacity: int):
+        super().__init__(capacity, np.minimum, float("inf"))
+
+    def min(self, start: int = 0, end: int | None = None) -> float:
+        return self.reduce(start, end)

@@ -1,0 +1,111 @@
+"""V-trace off-policy correction (IMPALA) as an XLA associative scan.
+
+TPU-native counterpart of the reference's
+``rllib/algorithms/impala/vtrace_torch.py:127`` (multi_from_logits) and
+``:251`` (from_importance_weights). The sequential backward recurrence
+
+    acc[t] = delta[t] + discount[t] * c[t] * acc[t+1]
+
+is a first-order linear recurrence, so it is computed with
+``lax.associative_scan`` (log-depth) rather than a python/time loop.
+
+All arrays are batch-major (B, T); the reference is time-major (T, B) —
+batch-major keeps the layout identical to the rest of the learner pipeline
+and lets XLA tile the (B,) dim onto the VPU lanes.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+class VTraceReturns(NamedTuple):
+    vs: jnp.ndarray  # (B, T) v-trace corrected value targets
+    pg_advantages: jnp.ndarray  # (B, T) policy-gradient advantages
+
+
+def _linear_recurrence_reverse(coeffs: jnp.ndarray, deltas: jnp.ndarray):
+    """y[t] = deltas[t] + coeffs[t] * y[t+1], scanned along axis -1."""
+
+    def combine(a, b):
+        ca, va = a
+        cb, vb = b
+        return ca * cb, va * cb + vb
+
+    _, y = jax.lax.associative_scan(
+        combine, (coeffs, deltas), reverse=True, axis=deltas.ndim - 1
+    )
+    return y
+
+
+def vtrace_from_importance_weights(
+    log_rhos: jnp.ndarray,
+    discounts: jnp.ndarray,
+    rewards: jnp.ndarray,
+    values: jnp.ndarray,
+    bootstrap_value: jnp.ndarray,
+    clip_rho_threshold: Optional[float] = 1.0,
+    clip_pg_rho_threshold: Optional[float] = 1.0,
+) -> VTraceReturns:
+    """V-trace from log importance weights (reference vtrace_torch.py:251).
+
+    Args:
+        log_rhos: (B, T) log(target_prob / behaviour_prob) per step.
+        discounts: (B, T) gamma * (1 - done) per step.
+        rewards/values: (B, T).
+        bootstrap_value: (B,) value estimate after the last step.
+    """
+    rhos = jnp.exp(log_rhos)
+    if clip_rho_threshold is not None:
+        clipped_rhos = jnp.minimum(clip_rho_threshold, rhos)
+    else:
+        clipped_rhos = rhos
+    cs = jnp.minimum(1.0, rhos)
+
+    values_tp1 = jnp.concatenate(
+        [values[:, 1:], bootstrap_value[:, None]], axis=1
+    )
+    deltas = clipped_rhos * (rewards + discounts * values_tp1 - values)
+
+    vs_minus_v_xs = _linear_recurrence_reverse(discounts * cs, deltas)
+    vs = vs_minus_v_xs + values
+
+    vs_tp1 = jnp.concatenate([vs[:, 1:], bootstrap_value[:, None]], axis=1)
+    if clip_pg_rho_threshold is not None:
+        clipped_pg_rhos = jnp.minimum(clip_pg_rho_threshold, rhos)
+    else:
+        clipped_pg_rhos = rhos
+    pg_advantages = clipped_pg_rhos * (
+        rewards + discounts * vs_tp1 - values
+    )
+    return VTraceReturns(
+        vs=jax.lax.stop_gradient(vs),
+        pg_advantages=jax.lax.stop_gradient(pg_advantages),
+    )
+
+
+def vtrace_from_logits(
+    behaviour_action_log_probs: jnp.ndarray,
+    target_action_log_probs: jnp.ndarray,
+    discounts: jnp.ndarray,
+    rewards: jnp.ndarray,
+    values: jnp.ndarray,
+    bootstrap_value: jnp.ndarray,
+    clip_rho_threshold: Optional[float] = 1.0,
+    clip_pg_rho_threshold: Optional[float] = 1.0,
+) -> VTraceReturns:
+    """V-trace from behaviour/target action log-probs
+    (reference vtrace_torch.py:127 multi_from_logits)."""
+    log_rhos = target_action_log_probs - behaviour_action_log_probs
+    return vtrace_from_importance_weights(
+        log_rhos=jax.lax.stop_gradient(log_rhos),
+        discounts=discounts,
+        rewards=rewards,
+        values=values,
+        bootstrap_value=bootstrap_value,
+        clip_rho_threshold=clip_rho_threshold,
+        clip_pg_rho_threshold=clip_pg_rho_threshold,
+    )

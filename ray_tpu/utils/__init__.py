@@ -1,0 +1,22 @@
+from ray_tpu.utils.filter import Filter, NoFilter, MeanStdFilter, RunningStat
+from ray_tpu.utils.schedules import (
+    Schedule,
+    ConstantSchedule,
+    LinearSchedule,
+    PiecewiseSchedule,
+    ExponentialSchedule,
+    make_schedule,
+)
+
+__all__ = [
+    "Filter",
+    "NoFilter",
+    "MeanStdFilter",
+    "RunningStat",
+    "Schedule",
+    "ConstantSchedule",
+    "LinearSchedule",
+    "PiecewiseSchedule",
+    "ExponentialSchedule",
+    "make_schedule",
+]

@@ -1,0 +1,33 @@
+"""Test configuration: force an 8-device virtual CPU platform.
+
+Mirrors the reference's ``_fake_gpus`` testing strategy
+(``rllib/policy/torch_policy.py:192-196``): multi-device semantics are tested
+without hardware by asking XLA for 8 host devices. Must run before jax is
+imported anywhere.
+"""
+
+import os
+
+# Hard override: the session sitecustomize pins jax to the real TPU
+# ("axon"); tests always run on the virtual 8-device CPU platform.
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("RAY_TPU_TEST_MODE", "1")
+
+import jax
+
+# sitecustomize sets jax_platforms="axon,cpu" directly on jax.config,
+# bypassing the env var — override it before any backend initializes.
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)

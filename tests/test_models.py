@@ -1,0 +1,248 @@
+"""Model + distribution tests (reference rllib/models/tests/)."""
+
+import gymnasium as gym
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ray_tpu.models import (
+    FCNet,
+    VisionNet,
+    LSTMWrapper,
+    GTrXLNet,
+    ModelCatalog,
+)
+from ray_tpu.models import distributions as dists
+
+
+def test_fcnet_shapes():
+    model = FCNet(num_outputs=6, hiddens=(32, 32))
+    obs = jnp.zeros((4, 8))
+    params = model.init(jax.random.PRNGKey(0), obs)
+    logits, value, state = model.apply(params, obs)
+    assert logits.shape == (4, 6)
+    assert value.shape == (4,)
+    assert state == ()
+
+
+def test_fcnet_free_log_std():
+    model = FCNet(num_outputs=8, hiddens=(16,), free_log_std=True)
+    obs = jnp.zeros((2, 3))
+    params = model.init(jax.random.PRNGKey(0), obs)
+    logits, _, _ = model.apply(params, obs)
+    assert logits.shape == (2, 8)
+    # log-std half must be identical across batch (state-independent).
+    np.testing.assert_array_equal(
+        np.asarray(logits[0, 4:]), np.asarray(logits[1, 4:])
+    )
+
+
+def test_visionnet_shapes():
+    model = VisionNet(num_outputs=4)
+    obs = jnp.zeros((2, 84, 84, 4), jnp.uint8)
+    params = model.init(jax.random.PRNGKey(0), obs)
+    logits, value, _ = model.apply(params, obs)
+    assert logits.shape == (2, 4)
+    assert logits.dtype == jnp.float32
+    assert value.shape == (2,)
+
+
+def test_lstm_wrapper_step_vs_unroll():
+    """Stepping T=1 twice must equal unrolling T=2 once."""
+    model = LSTMWrapper(num_outputs=3, cell_size=16, hiddens=(8,))
+    B, T, D = 2, 2, 5
+    obs = jax.random.normal(jax.random.PRNGKey(1), (B, T, D))
+    state0 = model.initial_state(B)
+    params = model.init(jax.random.PRNGKey(0), obs, state0)
+
+    logits_full, _, _ = model.apply(params, obs, state0)
+
+    l0, _, s1 = model.apply(params, obs[:, :1], state0)
+    l1, _, _ = model.apply(params, obs[:, 1:], s1)
+    step_logits = jnp.concatenate(
+        [l0.reshape(B, 1, -1), l1.reshape(B, 1, -1)], axis=1
+    ).reshape(B * T, -1)
+    np.testing.assert_allclose(
+        np.asarray(logits_full), np.asarray(step_logits), rtol=1e-5, atol=1e-5
+    )
+
+
+def test_lstm_reset_mask_zeroes_state():
+    """A reset at t must make output independent of pre-reset history."""
+    model = LSTMWrapper(num_outputs=3, cell_size=16, hiddens=(8,))
+    B, T, D = 1, 4, 5
+    obs = jax.random.normal(jax.random.PRNGKey(1), (B, T, D))
+    state0 = model.initial_state(B)
+    params = model.init(jax.random.PRNGKey(0), obs, state0)
+
+    resets = jnp.array([[0.0, 0.0, 1.0, 0.0]])
+    logits_a, _, _ = model.apply(params, obs, state0, resets=resets)
+
+    # Different history before the reset point
+    obs_b = obs.at[:, :2].set(obs[:, :2] + 10.0)
+    logits_b, _, _ = model.apply(params, obs_b, state0, resets=resets)
+    la = np.asarray(logits_a).reshape(T, -1)
+    lb = np.asarray(logits_b).reshape(T, -1)
+    # post-reset outputs identical, pre-reset different
+    assert not np.allclose(la[1], lb[1])
+    np.testing.assert_allclose(la[2], lb[2], rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(la[3], lb[3], rtol=1e-5, atol=1e-5)
+
+
+def test_gtrxl_shapes_and_memory():
+    model = GTrXLNet(
+        num_outputs=5, attention_dim=32, num_transformer_units=2,
+        num_heads=2, head_dim=16, memory_len=8,
+    )
+    B, T, D = 3, 4, 6
+    obs = jnp.zeros((B, T, D))
+    state0 = model.initial_state(B)
+    assert len(state0) == 2
+    params = model.init(jax.random.PRNGKey(0), obs, state0)
+    logits, value, state1 = model.apply(params, obs, state0)
+    assert logits.shape == (B * T, 5)
+    assert value.shape == (B * T,)
+    assert state1[0].shape == (B, 8, 32)
+
+
+def test_gtrxl_causality():
+    """Output at t must not depend on inputs at t' > t."""
+    model = GTrXLNet(
+        num_outputs=2, attention_dim=16, num_transformer_units=1,
+        num_heads=1, head_dim=16, memory_len=4,
+    )
+    B, T, D = 1, 5, 3
+    obs = jax.random.normal(jax.random.PRNGKey(2), (B, T, D))
+    state0 = model.initial_state(B)
+    params = model.init(jax.random.PRNGKey(0), obs, state0)
+    logits_a, _, _ = model.apply(params, obs, state0)
+    obs_b = obs.at[:, -1].set(obs[:, -1] + 5.0)
+    logits_b, _, _ = model.apply(params, obs_b, state0)
+    la = np.asarray(logits_a).reshape(T, -1)
+    lb = np.asarray(logits_b).reshape(T, -1)
+    np.testing.assert_allclose(la[:-1], lb[:-1], rtol=1e-5, atol=1e-5)
+    assert not np.allclose(la[-1], lb[-1])
+
+
+# ---------------- catalog ----------------
+
+
+def test_catalog_discrete():
+    obs_space = gym.spaces.Box(-1, 1, (4,), np.float32)
+    act_space = gym.spaces.Discrete(2)
+    dist_cls, n = ModelCatalog.get_action_dist(act_space)
+    assert dist_cls is dists.Categorical and n == 2
+    model = ModelCatalog.get_model(obs_space, act_space, n, {})
+    assert isinstance(model, FCNet)
+
+
+def test_catalog_box_action():
+    act_space = gym.spaces.Box(-2, 2, (3,), np.float32)
+    dist_cls, n = ModelCatalog.get_action_dist(act_space)
+    assert n == 6
+    d = dist_cls(jnp.zeros((1, 6)))
+    assert isinstance(d, dists.DiagGaussian)
+
+
+def test_catalog_image_obs():
+    obs_space = gym.spaces.Box(0, 255, (84, 84, 4), np.uint8)
+    act_space = gym.spaces.Discrete(6)
+    model = ModelCatalog.get_model(obs_space, act_space, 6, {})
+    assert isinstance(model, VisionNet)
+
+
+def test_catalog_lstm():
+    obs_space = gym.spaces.Box(-1, 1, (4,), np.float32)
+    act_space = gym.spaces.Discrete(2)
+    model = ModelCatalog.get_model(
+        obs_space, act_space, 2, {"use_lstm": True, "lstm_cell_size": 32}
+    )
+    assert isinstance(model, LSTMWrapper)
+    assert model.cell_size == 32
+
+
+def test_catalog_multidiscrete():
+    act_space = gym.spaces.MultiDiscrete([3, 4])
+    dist_cls, n = ModelCatalog.get_action_dist(act_space)
+    assert n == 7
+    d = dist_cls(jnp.zeros((2, 7)))
+    a = d.sample(jax.random.PRNGKey(0))
+    assert a.shape == (2, 2)
+
+
+def test_custom_model_registration():
+    class MyModel(FCNet):
+        pass
+
+    ModelCatalog.register_custom_model("my_model", MyModel)
+    obs_space = gym.spaces.Box(-1, 1, (4,), np.float32)
+    model = ModelCatalog.get_model(
+        obs_space, gym.spaces.Discrete(2), 2,
+        {"custom_model": "my_model",
+         "custom_model_config": {"hiddens": (8,)}},
+    )
+    assert isinstance(model, MyModel)
+
+
+# ---------------- distributions ----------------
+
+
+def test_categorical_logp_entropy():
+    logits = jnp.asarray([[2.0, 0.0, -1.0]])
+    d = dists.Categorical(logits)
+    p = jax.nn.softmax(logits)[0]
+    want_entropy = -float(jnp.sum(p * jnp.log(p)))
+    assert abs(float(d.entropy()[0]) - want_entropy) < 1e-5
+    logp = d.logp(jnp.asarray([0]))
+    assert abs(float(logp[0]) - float(jnp.log(p[0]))) < 1e-5
+
+
+def test_categorical_kl_self_zero():
+    logits = jax.random.normal(jax.random.PRNGKey(0), (4, 5))
+    d = dists.Categorical(logits)
+    np.testing.assert_allclose(
+        np.asarray(d.kl(dists.Categorical(logits))), 0.0, atol=1e-6
+    )
+
+
+def test_diag_gaussian_logp_matches_scipy():
+    from scipy import stats
+
+    mean = np.array([[0.5, -0.3]], np.float32)
+    log_std = np.array([[0.1, -0.2]], np.float32)
+    inputs = jnp.asarray(np.concatenate([mean, log_std], -1))
+    d = dists.DiagGaussian(inputs)
+    x = np.array([[0.7, 0.1]], np.float32)
+    want = stats.norm.logpdf(x, mean, np.exp(log_std)).sum(-1)
+    np.testing.assert_allclose(
+        np.asarray(d.logp(jnp.asarray(x))), want, rtol=1e-4
+    )
+
+
+def test_squashed_gaussian_bounds_and_logp_consistency():
+    rng = jax.random.PRNGKey(0)
+    inputs = jax.random.normal(rng, (100, 4))
+    d = dists.SquashedGaussian(inputs, low=-2.0, high=2.0)
+    a, logp = d.sampled_action_logp(jax.random.PRNGKey(1))
+    a_np = np.asarray(a)
+    assert a_np.min() >= -2.0 and a_np.max() <= 2.0
+    # logp(sample) should be close to recomputing via d.logp
+    logp2 = d.logp(a)
+    np.testing.assert_allclose(
+        np.asarray(logp), np.asarray(logp2), rtol=1e-2, atol=1e-2
+    )
+
+
+def test_bernoulli():
+    logits = jnp.asarray([[0.0, 3.0, -3.0]])
+    d = dists.Bernoulli(logits)
+    det = np.asarray(d.deterministic_sample())
+    np.testing.assert_array_equal(det, [[0, 1, 0]])
+    x = jnp.asarray([[1, 1, 0]])
+    want = float(
+        jnp.log(jax.nn.sigmoid(0.0))
+        + jnp.log(jax.nn.sigmoid(3.0))
+        + jnp.log(1 - jax.nn.sigmoid(-3.0))
+    )
+    assert abs(float(d.logp(x)[0]) - want) < 1e-4

@@ -1,0 +1,123 @@
+"""SampleBatch/MultiAgentBatch tests.
+
+Mirrors the coverage of the reference's
+``rllib/policy/tests/test_sample_batch.py``.
+"""
+
+import numpy as np
+import pytest
+
+from ray_tpu.data.sample_batch import (
+    SampleBatch,
+    MultiAgentBatch,
+    concat_samples,
+)
+
+
+def make_batch(n=10):
+    return SampleBatch(
+        {
+            SampleBatch.OBS: np.arange(n * 4, dtype=np.float32).reshape(n, 4),
+            SampleBatch.ACTIONS: np.arange(n, dtype=np.int64),
+            SampleBatch.REWARDS: np.ones(n, dtype=np.float32),
+            SampleBatch.EPS_ID: np.array(
+                [0] * (n // 2) + [1] * (n - n // 2)
+            ),
+        }
+    )
+
+
+def test_count():
+    b = make_batch(10)
+    assert len(b) == 10
+    assert b.env_steps() == 10
+
+
+def test_mismatched_lengths_raise():
+    with pytest.raises(ValueError):
+        SampleBatch({"a": np.zeros(3), "b": np.zeros(4)})
+
+
+def test_concat():
+    b = concat_samples([make_batch(4), make_batch(6)])
+    assert b.count == 10
+    assert b[SampleBatch.OBS].shape == (10, 4)
+
+
+def test_slice_and_getitem_slice():
+    b = make_batch(10)
+    s = b.slice(2, 5)
+    assert s.count == 3
+    np.testing.assert_array_equal(
+        s[SampleBatch.ACTIONS], np.array([2, 3, 4])
+    )
+    s2 = b[2:5]
+    np.testing.assert_array_equal(
+        s2[SampleBatch.ACTIONS], s[SampleBatch.ACTIONS]
+    )
+
+
+def test_timeslices_static_shapes():
+    b = make_batch(10)
+    slices = b.timeslices(3)
+    assert len(slices) == 3
+    assert all(s.count == 3 for s in slices)
+
+
+def test_shuffle_preserves_rows(rng):
+    b = make_batch(10)
+    orig = {k: v.copy() for k, v in b.items()}
+    b.shuffle(rng)
+    # Row integrity: obs row i must still match action value.
+    for i in range(10):
+        a = b[SampleBatch.ACTIONS][i]
+        np.testing.assert_array_equal(
+            b[SampleBatch.OBS][i], orig[SampleBatch.OBS][a]
+        )
+
+
+def test_right_zero_pad():
+    b = make_batch(7)
+    p = b.right_zero_pad(10)
+    assert p.count == 10
+    assert p[SampleBatch.SEQ_LENS][0] == 7
+    np.testing.assert_array_equal(
+        p[SampleBatch.REWARDS][7:], np.zeros(3, dtype=np.float32)
+    )
+
+
+def test_split_by_episode():
+    b = make_batch(10)
+    eps = b.split_by_episode()
+    assert len(eps) == 2
+    assert eps[0].count == 5 and eps[1].count == 5
+
+
+def test_minibatches():
+    b = make_batch(10)
+    mbs = list(b.minibatches(5, num_epochs=2))
+    assert len(mbs) == 4
+    assert all(m.count == 5 for m in mbs)
+
+
+def test_multi_agent_concat():
+    ma1 = MultiAgentBatch({"p0": make_batch(4), "p1": make_batch(2)}, 4)
+    ma2 = MultiAgentBatch({"p0": make_batch(6)}, 6)
+    out = MultiAgentBatch.concat_samples([ma1, ma2])
+    assert out.env_steps() == 10
+    assert out.policy_batches["p0"].count == 10
+    assert out.policy_batches["p1"].count == 2
+
+
+def test_as_multi_agent_roundtrip():
+    b = make_batch(5)
+    ma = b.as_multi_agent()
+    assert ma.env_steps() == 5
+    out = MultiAgentBatch.wrap_as_needed(ma.policy_batches, 5)
+    assert isinstance(out, SampleBatch)
+
+
+def test_to_device():
+    b = make_batch(5)
+    tree = b.to_device()
+    assert tree[SampleBatch.OBS].shape == (5, 4)
