@@ -1,0 +1,10 @@
+from ray_tpu.algorithms.algorithm import Algorithm
+from ray_tpu.algorithms.algorithm_config import AlgorithmConfig
+from ray_tpu.algorithms.registry import get_algorithm_class, register_algorithm
+
+__all__ = [
+    "Algorithm",
+    "AlgorithmConfig",
+    "get_algorithm_class",
+    "register_algorithm",
+]

@@ -1,0 +1,302 @@
+"""Algorithm: Trainable subclass owning WorkerSet(s) and the training loop.
+
+Counterpart of the reference's ``rllib/algorithms/algorithm.py:134``
+(``setup :312``, ``step :547``, ``evaluate :650``, ``training_step :841``,
+``save_checkpoint :1438``, ``__getstate__ :2186``).
+"""
+
+from __future__ import annotations
+
+import collections
+import os
+import pickle
+import time
+from typing import Any, Callable, Dict, List, Optional, Type
+
+import numpy as np
+
+import ray_tpu as ray
+from ray_tpu.algorithms.algorithm_config import AlgorithmConfig
+from ray_tpu.data.sample_batch import DEFAULT_POLICY_ID
+from ray_tpu.env.registry import get_env_creator
+from ray_tpu.evaluation.metrics import summarize_episodes
+from ray_tpu.evaluation.worker_set import WorkerSet
+from ray_tpu.parallel import mesh as mesh_lib
+from ray_tpu.tune.trainable import Trainable
+
+NUM_ENV_STEPS_SAMPLED = "num_env_steps_sampled"
+NUM_AGENT_STEPS_SAMPLED = "num_agent_steps_sampled"
+
+
+class Algorithm(Trainable):
+    _default_policy_class = None
+
+    @classmethod
+    def get_default_config(cls) -> AlgorithmConfig:
+        return AlgorithmConfig(cls)
+
+    def __init__(self, config=None, env=None, logger_creator=None, **kwargs):
+        if isinstance(config, AlgorithmConfig):
+            config = config.to_dict()
+        config = dict(config or {})
+        if env is not None:
+            config.setdefault("env", env)
+        defaults = self.get_default_config().to_dict()
+        merged = {**defaults, **config}
+        super().__init__(merged, logger_creator)
+
+    def get_default_policy_class(self, config: Dict):
+        return self._default_policy_class
+
+    # -- setup -----------------------------------------------------------
+
+    def setup(self, config: Dict) -> None:
+        """reference algorithm.py:312."""
+        self.callbacks = None
+        cb_cls = config.get("callbacks_class")
+        if cb_cls:
+            self.callbacks = cb_cls()
+        self._counters: Dict[str, int] = collections.defaultdict(int)
+        self._timers: Dict[str, float] = collections.defaultdict(float)
+        self._episode_history: List = []
+
+        env_spec = config.get("env")
+        env_creator = get_env_creator(env_spec) if env_spec else None
+        policy_cls = self.get_default_policy_class(config)
+
+        # learner mesh (driver-side policies)
+        n_learner = config.get("learner_devices")
+        import jax
+
+        devices = jax.devices()
+        if n_learner:
+            devices = devices[:n_learner]
+        config["_mesh"] = mesh_lib.make_mesh(devices=devices)
+
+        policy_specs = None
+        policy_mapping_fn = config.get("policy_mapping_fn")
+        if config.get("policies"):
+            policy_specs = {}
+            for pid, spec in config["policies"].items():
+                if isinstance(spec, (tuple, list)):
+                    cls, obs_sp, act_sp, overrides = spec
+                    policy_specs[pid] = (
+                        cls or policy_cls,
+                        obs_sp,
+                        act_sp,
+                        overrides or {},
+                    )
+                else:
+                    probe = env_creator(
+                        config.get("env_config") or {}
+                    )
+                    policy_specs[pid] = (
+                        policy_cls,
+                        probe.observation_space,
+                        probe.action_space,
+                        {},
+                    )
+
+        self.workers = WorkerSet(
+            env_creator=env_creator,
+            policy_cls=policy_cls,
+            policy_specs=policy_specs,
+            policy_mapping_fn=policy_mapping_fn,
+            config=config,
+            num_workers=int(config.get("num_workers", 0)),
+        )
+        self.evaluation_workers: Optional[WorkerSet] = None
+        if config.get("evaluation_interval"):
+            eval_config = {
+                **config,
+                **(config.get("evaluation_config") or {}),
+                "num_workers": 0,
+            }
+            self.evaluation_workers = WorkerSet(
+                env_creator=env_creator,
+                policy_cls=policy_cls,
+                policy_specs=policy_specs,
+                policy_mapping_fn=policy_mapping_fn,
+                config=eval_config,
+                num_workers=int(
+                    config.get("evaluation_num_workers", 0)
+                ),
+            )
+
+    # -- training iteration ---------------------------------------------
+
+    def training_step(self) -> Dict:
+        """Override point (reference algorithm.py:841)."""
+        raise NotImplementedError
+
+    def step(self) -> Dict:
+        """reference algorithm.py:547 (incl. worker-failure handling)."""
+        config = self.config
+        t0 = time.time()
+        results: Dict[str, Any] = {}
+        train_info: Dict[str, Any] = {}
+        min_t = config.get("min_time_s_per_iteration")
+        min_ts = config.get("min_sample_timesteps_per_iteration") or 0
+        ts_before = self._counters[NUM_ENV_STEPS_SAMPLED]
+        while True:
+            try:
+                info = self.training_step()
+                if info:
+                    train_info = info
+            except (
+                ray.core.object_store.RayActorError,
+                ray.core.object_store.WorkerCrashedError,
+            ):
+                if config.get("recreate_failed_workers"):
+                    self.workers.recreate_failed_workers()
+                    continue
+                elif config.get("ignore_worker_failures"):
+                    continue
+                raise
+            done_t = (
+                min_t is None or (time.time() - t0) >= min_t
+            )
+            done_ts = (
+                self._counters[NUM_ENV_STEPS_SAMPLED] - ts_before
+                >= min_ts
+            )
+            if done_t and done_ts:
+                break
+
+        results["info"] = {
+            "learner": train_info,
+            **{k: v for k, v in self._counters.items()},
+        }
+        results.update(self._collect_rollout_metrics())
+        results["num_env_steps_sampled"] = self._counters[
+            NUM_ENV_STEPS_SAMPLED
+        ]
+        results["timesteps_total"] = self._counters[NUM_ENV_STEPS_SAMPLED]
+        self._timesteps_total = self._counters[NUM_ENV_STEPS_SAMPLED]
+
+        if (
+            self.evaluation_workers is not None
+            and self.config.get("evaluation_interval")
+            and (self._iteration + 1)
+            % self.config["evaluation_interval"]
+            == 0
+        ):
+            results["evaluation"] = self.evaluate()
+        return results
+
+    def _collect_rollout_metrics(self) -> Dict:
+        episodes = []
+        if self.workers.num_remote_workers() > 0:
+            for eps in ray.get(
+                [
+                    w.get_metrics.remote()
+                    for w in self.workers.remote_workers()
+                ]
+            ):
+                episodes.extend(eps)
+        lw = self.workers.local_worker()
+        if lw is not None and lw.sampler is not None:
+            episodes.extend(lw.get_metrics())
+        # smooth over a sliding window (reference metrics smoothing)
+        self._episode_history.extend(episodes)
+        window = self.config.get(
+            "metrics_num_episodes_for_smoothing", 100
+        )
+        self._episode_history = self._episode_history[-window:]
+        summary = summarize_episodes(
+            self._episode_history if self._episode_history else []
+        )
+        summary["episodes_this_iter"] = len(episodes)
+        self._episodes_total += len(episodes)
+        summary["episodes_total"] = self._episodes_total
+        return summary
+
+    # -- evaluation ------------------------------------------------------
+
+    def evaluate(self) -> Dict:
+        """reference algorithm.py:650."""
+        assert self.evaluation_workers is not None
+        # sync current weights into eval workers
+        weights = self.workers.local_worker().get_weights()
+        self.evaluation_workers.local_worker().set_weights(weights)
+        duration = self.config.get("evaluation_duration", 10)
+        episodes = []
+        lw = self.evaluation_workers.local_worker()
+        while len(episodes) < duration:
+            lw.sample()
+            episodes.extend(lw.get_metrics())
+        return summarize_episodes(episodes)
+
+    def compute_single_action(
+        self, observation, state=None, policy_id=DEFAULT_POLICY_ID,
+        explore: Optional[bool] = None, **kwargs,
+    ):
+        """reference algorithm.py compute_single_action."""
+        policy = self.get_policy(policy_id)
+        worker = self.workers.local_worker()
+        if worker.preprocessor is not None:
+            observation = worker.preprocessor.transform(observation)
+        filt = worker.filters.get(policy_id)
+        if filt is not None:
+            observation = filt(observation, update=False)
+        explore = (
+            self.config.get("explore", True)
+            if explore is None
+            else explore
+        )
+        action, state_out, _ = policy.compute_single_action(
+            observation, state, explore=explore
+        )
+        if state:
+            return action, state_out, {}
+        return action
+
+    def get_policy(self, policy_id: str = DEFAULT_POLICY_ID):
+        return self.workers.local_worker().policy_map[policy_id]
+
+    # -- checkpointing ---------------------------------------------------
+
+    def __getstate__(self) -> Dict:
+        """reference algorithm.py:2186."""
+        state = {
+            "worker": self.workers.local_worker().save(),
+            "counters": dict(self._counters),
+            "episodes_total": self._episodes_total,
+        }
+        return state
+
+    def __setstate__(self, state: Dict) -> None:
+        self.workers.local_worker().restore(state["worker"])
+        self._counters = collections.defaultdict(
+            int, state.get("counters", {})
+        )
+        self._episodes_total = state.get("episodes_total", 0)
+        # push restored weights to rollout workers
+        self.workers.sync_weights()
+
+    def save_checkpoint(self, checkpoint_dir: str) -> str:
+        """reference algorithm.py:1438."""
+        path = os.path.join(checkpoint_dir, "algorithm_state.pkl")
+        with open(path, "wb") as f:
+            pickle.dump(self.__getstate__(), f)
+        return checkpoint_dir
+
+    def load_checkpoint(self, checkpoint_path: str) -> None:
+        if os.path.isdir(checkpoint_path):
+            checkpoint_path = os.path.join(
+                checkpoint_path, "algorithm_state.pkl"
+            )
+        with open(checkpoint_path, "rb") as f:
+            state = pickle.load(f)
+        self.__setstate__(state)
+
+    def export_policy_model(
+        self, export_dir: str, policy_id: str = DEFAULT_POLICY_ID
+    ) -> None:
+        self.get_policy(policy_id).export_checkpoint(export_dir)
+
+    def cleanup(self) -> None:
+        if hasattr(self, "workers"):
+            self.workers.stop()
+        if getattr(self, "evaluation_workers", None) is not None:
+            self.evaluation_workers.stop()

@@ -1,0 +1,309 @@
+"""AlgorithmConfig: typed fluent builder → plain dict.
+
+Counterpart of the reference's ``rllib/algorithms/algorithm_config.py:33``
+(``resources :339``, ``framework :408``, ``environment :453``,
+``rollouts :533``, ``training :717``, ``evaluation :800``,
+``multi_agent :1027``, ``to_dict :241``). The framework is always "jax"
+here; the knob kept for API parity.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Any, Callable, Dict, Optional, Type
+
+
+class AlgorithmConfig:
+    def __init__(self, algo_class: Optional[type] = None):
+        self.algo_class = algo_class
+
+        # environment (reference :453)
+        self.env = None
+        self.env_config: Dict = {}
+        self.observation_space = None
+        self.action_space = None
+        self.clip_actions = False
+        self.normalize_actions = True
+        self.horizon = None
+
+        # framework (reference :408)
+        self.framework_str = "jax"
+
+        # rollouts (reference :533)
+        self.num_workers = 0
+        self.num_envs_per_worker = 1
+        self.rollout_fragment_length = 200
+        self.batch_mode = "truncate_episodes"
+        self.observation_filter = "NoFilter"
+        self.compress_observations = False
+        self.ignore_worker_failures = False
+        self.recreate_failed_workers = False
+
+        # training (reference :717)
+        self.gamma = 0.99
+        self.lr = 0.001
+        self.lr_schedule = None
+        self.train_batch_size = 4000
+        self.model: Dict = {}
+        self.optimizer: Dict = {}
+        self.grad_clip = None
+        self.seed = None
+
+        # learner placement (TPU-specific)
+        self.learner_devices = None  # None → all visible devices
+
+        # exploration
+        self.explore = True
+        self.exploration_config: Dict = {}
+
+        # evaluation (reference :800)
+        self.evaluation_interval = None
+        self.evaluation_duration = 10
+        self.evaluation_duration_unit = "episodes"
+        self.evaluation_num_workers = 0
+        self.evaluation_config: Dict = {}
+
+        # multi-agent (reference :1027)
+        self.policies: Dict = {}
+        self.policy_mapping_fn = None
+        self.policies_to_train = None
+
+        # reporting
+        self.min_time_s_per_iteration = None
+        self.min_sample_timesteps_per_iteration = 0
+        self.metrics_num_episodes_for_smoothing = 100
+
+        # debugging / resources
+        self.log_level = "WARN"
+        self.num_gpus = 0
+        self.num_cpus_per_worker = 1
+
+        # callbacks
+        self.callbacks_class = None
+
+    # -- fluent sections -------------------------------------------------
+
+    def environment(
+        self,
+        env=None,
+        *,
+        env_config: Optional[Dict] = None,
+        observation_space=None,
+        action_space=None,
+        clip_actions: Optional[bool] = None,
+        normalize_actions: Optional[bool] = None,
+        horizon: Optional[int] = None,
+        **kwargs,
+    ) -> "AlgorithmConfig":
+        if env is not None:
+            self.env = env
+        if env_config is not None:
+            self.env_config = env_config
+        if observation_space is not None:
+            self.observation_space = observation_space
+        if action_space is not None:
+            self.action_space = action_space
+        if clip_actions is not None:
+            self.clip_actions = clip_actions
+        if normalize_actions is not None:
+            self.normalize_actions = normalize_actions
+        if horizon is not None:
+            self.horizon = horizon
+        return self
+
+    def framework(self, framework: str = "jax", **kwargs) -> "AlgorithmConfig":
+        self.framework_str = framework
+        return self
+
+    def rollouts(
+        self,
+        *,
+        num_rollout_workers: Optional[int] = None,
+        num_envs_per_worker: Optional[int] = None,
+        rollout_fragment_length: Optional[int] = None,
+        batch_mode: Optional[str] = None,
+        observation_filter: Optional[str] = None,
+        ignore_worker_failures: Optional[bool] = None,
+        recreate_failed_workers: Optional[bool] = None,
+        **kwargs,
+    ) -> "AlgorithmConfig":
+        if num_rollout_workers is not None:
+            self.num_workers = num_rollout_workers
+        if num_envs_per_worker is not None:
+            self.num_envs_per_worker = num_envs_per_worker
+        if rollout_fragment_length is not None:
+            self.rollout_fragment_length = rollout_fragment_length
+        if batch_mode is not None:
+            self.batch_mode = batch_mode
+        if observation_filter is not None:
+            self.observation_filter = observation_filter
+        if ignore_worker_failures is not None:
+            self.ignore_worker_failures = ignore_worker_failures
+        if recreate_failed_workers is not None:
+            self.recreate_failed_workers = recreate_failed_workers
+        return self
+
+    def training(
+        self,
+        *,
+        gamma: Optional[float] = None,
+        lr: Optional[float] = None,
+        lr_schedule=None,
+        train_batch_size: Optional[int] = None,
+        model: Optional[Dict] = None,
+        optimizer: Optional[Dict] = None,
+        grad_clip: Optional[float] = None,
+        **kwargs,
+    ) -> "AlgorithmConfig":
+        if gamma is not None:
+            self.gamma = gamma
+        if lr is not None:
+            self.lr = lr
+        if lr_schedule is not None:
+            self.lr_schedule = lr_schedule
+        if train_batch_size is not None:
+            self.train_batch_size = train_batch_size
+        if model is not None:
+            self.model = model
+        if optimizer is not None:
+            self.optimizer = optimizer
+        if grad_clip is not None:
+            self.grad_clip = grad_clip
+        for k, v in kwargs.items():
+            setattr(self, k, v)
+        return self
+
+    def resources(
+        self,
+        *,
+        num_gpus: Optional[int] = None,
+        num_cpus_per_worker: Optional[int] = None,
+        learner_devices: Optional[int] = None,
+        **kwargs,
+    ) -> "AlgorithmConfig":
+        if num_gpus is not None:
+            self.num_gpus = num_gpus
+        if num_cpus_per_worker is not None:
+            self.num_cpus_per_worker = num_cpus_per_worker
+        if learner_devices is not None:
+            self.learner_devices = learner_devices
+        return self
+
+    def exploration(
+        self, *, explore: Optional[bool] = None,
+        exploration_config: Optional[Dict] = None, **kwargs,
+    ) -> "AlgorithmConfig":
+        if explore is not None:
+            self.explore = explore
+        if exploration_config is not None:
+            self.exploration_config = exploration_config
+        return self
+
+    def evaluation(
+        self,
+        *,
+        evaluation_interval: Optional[int] = None,
+        evaluation_duration: Optional[int] = None,
+        evaluation_duration_unit: Optional[str] = None,
+        evaluation_num_workers: Optional[int] = None,
+        evaluation_config: Optional[Dict] = None,
+        **kwargs,
+    ) -> "AlgorithmConfig":
+        if evaluation_interval is not None:
+            self.evaluation_interval = evaluation_interval
+        if evaluation_duration is not None:
+            self.evaluation_duration = evaluation_duration
+        if evaluation_duration_unit is not None:
+            self.evaluation_duration_unit = evaluation_duration_unit
+        if evaluation_num_workers is not None:
+            self.evaluation_num_workers = evaluation_num_workers
+        if evaluation_config is not None:
+            self.evaluation_config = evaluation_config
+        return self
+
+    def multi_agent(
+        self,
+        *,
+        policies: Optional[Dict] = None,
+        policy_mapping_fn: Optional[Callable] = None,
+        policies_to_train=None,
+        **kwargs,
+    ) -> "AlgorithmConfig":
+        if policies is not None:
+            self.policies = policies
+        if policy_mapping_fn is not None:
+            self.policy_mapping_fn = policy_mapping_fn
+        if policies_to_train is not None:
+            self.policies_to_train = policies_to_train
+        return self
+
+    def reporting(
+        self,
+        *,
+        min_time_s_per_iteration: Optional[float] = None,
+        min_sample_timesteps_per_iteration: Optional[int] = None,
+        **kwargs,
+    ) -> "AlgorithmConfig":
+        if min_time_s_per_iteration is not None:
+            self.min_time_s_per_iteration = min_time_s_per_iteration
+        if min_sample_timesteps_per_iteration is not None:
+            self.min_sample_timesteps_per_iteration = (
+                min_sample_timesteps_per_iteration
+            )
+        return self
+
+    def debugging(
+        self, *, log_level: Optional[str] = None,
+        seed: Optional[int] = None, **kwargs,
+    ) -> "AlgorithmConfig":
+        if log_level is not None:
+            self.log_level = log_level
+        if seed is not None:
+            self.seed = seed
+        return self
+
+    def callbacks(self, callbacks_class) -> "AlgorithmConfig":
+        self.callbacks_class = callbacks_class
+        return self
+
+    # -- conversion ------------------------------------------------------
+
+    def to_dict(self) -> Dict[str, Any]:
+        """reference algorithm_config.py:241."""
+        out = {}
+        for k, v in vars(self).items():
+            if k == "algo_class":
+                continue
+            if k == "framework_str":
+                out["framework"] = v
+                continue
+            out[k] = v
+        return copy.deepcopy(
+            {k: v for k, v in out.items()}
+        ) if False else dict(out)
+
+    def update_from_dict(self, d: Dict) -> "AlgorithmConfig":
+        for k, v in d.items():
+            if k == "framework":
+                self.framework_str = v
+            elif k == "num_rollout_workers":
+                self.num_workers = v
+            else:
+                setattr(self, k, v)
+        return self
+
+    def copy(self) -> "AlgorithmConfig":
+        new = self.__class__()
+        new.__dict__.update(copy.deepcopy(self.__dict__))
+        return new
+
+    def build(self, env=None, logger_creator=None):
+        if env is not None:
+            self.env = env
+        cls = self.algo_class
+        if cls is None:
+            raise ValueError("No algo_class bound to this config")
+        return cls(config=self.to_dict(), env=self.env)
+
+    def validate(self) -> None:
+        pass

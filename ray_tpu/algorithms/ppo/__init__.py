@@ -1,0 +1,3 @@
+from ray_tpu.algorithms.ppo.ppo import PPO, PPOConfig, PPOJaxPolicy
+
+__all__ = ["PPO", "PPOConfig", "PPOJaxPolicy"]

@@ -1,0 +1,231 @@
+"""PPO: config, JAX policy (loss), and algorithm.
+
+Counterpart of the reference's ``rllib/algorithms/ppo/ppo.py`` (PPOConfig
+``:47``, ``training_step :400``, adaptive-KL update ``:433-447``) and the
+torch loss ``rllib/algorithms/ppo/ppo_torch_policy.py:69``. The learner side
+— advantage standardization, the clipped surrogate/vf/entropy loss, and the
+``num_sgd_iter × minibatches`` SGD nest — runs as one jitted shard_map
+program on the TPU mesh (see JaxPolicy).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.algorithms.algorithm import (
+    Algorithm,
+    NUM_AGENT_STEPS_SAMPLED,
+    NUM_ENV_STEPS_SAMPLED,
+)
+from ray_tpu.algorithms.algorithm_config import AlgorithmConfig
+from ray_tpu.data.sample_batch import SampleBatch
+from ray_tpu.evaluation.postprocessing import compute_gae_for_sample_batch
+from ray_tpu.execution.rollout_ops import synchronous_parallel_sample
+from ray_tpu.execution.train_ops import train_one_step
+from ray_tpu.policy.jax_policy import JaxPolicy
+
+
+class PPOConfig(AlgorithmConfig):
+    """reference ppo.py:47."""
+
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class or PPO)
+        self.lr = 5e-5
+        self.train_batch_size = 4000
+        self.sgd_minibatch_size = 128
+        self.num_sgd_iter = 30
+        self.lambda_ = 1.0
+        self.use_gae = True
+        self.use_critic = True
+        self.kl_coeff = 0.2
+        self.kl_target = 0.01
+        self.vf_loss_coeff = 1.0
+        self.entropy_coeff = 0.0
+        self.entropy_coeff_schedule = None
+        self.clip_param = 0.3
+        self.vf_clip_param = 10.0
+        self.shuffle_sequences = True
+
+    def training(
+        self,
+        *,
+        lambda_: Optional[float] = None,
+        use_gae: Optional[bool] = None,
+        use_critic: Optional[bool] = None,
+        kl_coeff: Optional[float] = None,
+        kl_target: Optional[float] = None,
+        sgd_minibatch_size: Optional[int] = None,
+        num_sgd_iter: Optional[int] = None,
+        vf_loss_coeff: Optional[float] = None,
+        entropy_coeff: Optional[float] = None,
+        entropy_coeff_schedule=None,
+        clip_param: Optional[float] = None,
+        vf_clip_param: Optional[float] = None,
+        **kwargs,
+    ) -> "PPOConfig":
+        super().training(**kwargs)
+        if lambda_ is not None:
+            self.lambda_ = lambda_
+        if use_gae is not None:
+            self.use_gae = use_gae
+        if use_critic is not None:
+            self.use_critic = use_critic
+        if kl_coeff is not None:
+            self.kl_coeff = kl_coeff
+        if kl_target is not None:
+            self.kl_target = kl_target
+        if sgd_minibatch_size is not None:
+            self.sgd_minibatch_size = sgd_minibatch_size
+        if num_sgd_iter is not None:
+            self.num_sgd_iter = num_sgd_iter
+        if vf_loss_coeff is not None:
+            self.vf_loss_coeff = vf_loss_coeff
+        if entropy_coeff is not None:
+            self.entropy_coeff = entropy_coeff
+        if entropy_coeff_schedule is not None:
+            self.entropy_coeff_schedule = entropy_coeff_schedule
+        if clip_param is not None:
+            self.clip_param = clip_param
+        if vf_clip_param is not None:
+            self.vf_clip_param = vf_clip_param
+        return self
+
+    def to_dict(self) -> Dict:
+        d = super().to_dict()
+        d["lambda"] = d.pop("lambda_", 1.0)
+        return d
+
+
+class PPOJaxPolicy(JaxPolicy):
+    """Clipped-surrogate PPO loss (reference ppo_torch_policy.py:69),
+    with KL penalty adapted on host between train calls."""
+
+    def _init_coeffs(self):
+        self.coeff_values["kl_coeff"] = float(
+            self.config.get("kl_coeff", 0.2)
+        )
+
+    def loss(self, params, batch, rng, coeffs):
+        cfg = self.config
+        clip_param = cfg.get("clip_param", 0.3)
+        vf_clip = cfg.get("vf_clip_param", 10.0)
+        vf_coeff = cfg.get("vf_loss_coeff", 1.0)
+
+        dist_inputs, value, _ = self.model_forward(
+            params, batch[SampleBatch.OBS]
+        )
+        dist = self.dist_class(dist_inputs)
+        prev_dist = self.dist_class(
+            batch[SampleBatch.ACTION_DIST_INPUTS]
+        )
+
+        logp = dist.logp(batch[SampleBatch.ACTIONS])
+        logp_ratio = jnp.exp(logp - batch[SampleBatch.ACTION_LOGP])
+        advantages = batch[SampleBatch.ADVANTAGES]
+
+        surrogate = jnp.minimum(
+            advantages * logp_ratio,
+            advantages
+            * jnp.clip(logp_ratio, 1.0 - clip_param, 1.0 + clip_param),
+        )
+        action_kl = prev_dist.kl(dist)
+        entropy = dist.entropy()
+
+        value_targets = batch[SampleBatch.VALUE_TARGETS]
+        vf_loss = jnp.square(value - value_targets)
+        vf_loss_clipped = jnp.clip(vf_loss, 0.0, vf_clip)
+
+        total = jnp.mean(
+            -surrogate
+            + coeffs["kl_coeff"] * action_kl
+            + vf_coeff * vf_loss_clipped
+            - coeffs["entropy_coeff"] * entropy
+        )
+        stats = {
+            "policy_loss": jnp.mean(-surrogate),
+            "vf_loss": jnp.mean(vf_loss_clipped),
+            "kl": jnp.mean(action_kl),
+            "entropy": jnp.mean(entropy),
+            "vf_explained_var": _explained_variance(
+                value_targets, value
+            ),
+        }
+        return total, stats
+
+    def after_learn_on_batch(self, stats: Dict[str, float]) -> Dict:
+        """Adaptive KL coefficient (reference ppo.py:433-447 /
+        ppo_torch_policy KLCoeffMixin.update_kl)."""
+        kl = stats.get("kl", 0.0)
+        target = self.config.get("kl_target", 0.01)
+        if self.coeff_values["kl_coeff"] > 0.0:
+            if kl > 2.0 * target:
+                self.coeff_values["kl_coeff"] *= 1.5
+            elif kl < 0.5 * target:
+                self.coeff_values["kl_coeff"] *= 0.5
+        return {"cur_kl_coeff": self.coeff_values["kl_coeff"]}
+
+    def postprocess_trajectory(
+        self, sample_batch, other_agent_batches=None, episode=None
+    ):
+        return compute_gae_for_sample_batch(
+            self, sample_batch, other_agent_batches, episode
+        )
+
+
+def _explained_variance(y, pred):
+    y_var = jnp.var(y)
+    diff_var = jnp.var(y - pred)
+    return jnp.maximum(-1.0, 1.0 - diff_var / (y_var + 1e-8))
+
+
+class PPO(Algorithm):
+    _default_policy_class = PPOJaxPolicy
+
+    @classmethod
+    def get_default_config(cls) -> PPOConfig:
+        return PPOConfig(cls)
+
+    def training_step(self) -> Dict:
+        """reference ppo.py:400."""
+        train_batch = synchronous_parallel_sample(
+            worker_set=self.workers,
+            max_env_steps=self.config["train_batch_size"],
+        )
+        self._counters[NUM_ENV_STEPS_SAMPLED] += train_batch.env_steps()
+        self._counters[NUM_AGENT_STEPS_SAMPLED] += (
+            train_batch.env_steps()
+        )
+
+        # standardize advantages across the full train batch
+        # (reference ppo.py:415 standardize_fields)
+        from ray_tpu.data.sample_batch import MultiAgentBatch
+
+        def _standardize(b):
+            adv = np.asarray(b[SampleBatch.ADVANTAGES], np.float32)
+            b[SampleBatch.ADVANTAGES] = (
+                (adv - adv.mean()) / max(1e-4, adv.std())
+            ).astype(np.float32)
+
+        if isinstance(train_batch, MultiAgentBatch):
+            for b in train_batch.policy_batches.values():
+                _standardize(b)
+        else:
+            _standardize(train_batch)
+
+        train_info = train_one_step(self, train_batch)
+
+        # broadcast new weights + timestep to rollout workers
+        self.workers.sync_weights(
+            global_vars={
+                "timestep": self._counters[NUM_ENV_STEPS_SAMPLED]
+            }
+        )
+        if self.config.get("observation_filter") not in (
+            None,
+            "NoFilter",
+        ):
+            self.workers.sync_filters()
+        return train_info

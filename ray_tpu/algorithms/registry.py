@@ -1,0 +1,66 @@
+"""Algorithm registry (reference ``rllib/algorithms/registry.py``)."""
+
+from __future__ import annotations
+
+from typing import Callable, Dict
+
+_ALGORITHMS: Dict[str, Callable] = {}
+
+
+def register_algorithm(name: str, loader: Callable) -> None:
+    _ALGORITHMS[name] = loader
+
+
+def get_algorithm_class(name: str):
+    if name not in _ALGORITHMS:
+        _register_builtins()
+    if name not in _ALGORITHMS:
+        raise ValueError(
+            f"Unknown algorithm {name!r}; known: {sorted(_ALGORITHMS)}"
+        )
+    return _ALGORITHMS[name]()
+
+
+def _register_builtins() -> None:
+    def _ppo():
+        from ray_tpu.algorithms.ppo.ppo import PPO
+
+        return PPO
+
+    _ALGORITHMS.setdefault("PPO", _ppo)
+    try:
+        def _impala():
+            from ray_tpu.algorithms.impala.impala import IMPALA
+
+            return IMPALA
+
+        _ALGORITHMS.setdefault("IMPALA", _impala)
+    except ImportError:
+        pass
+    try:
+        def _sac():
+            from ray_tpu.algorithms.sac.sac import SAC
+
+            return SAC
+
+        _ALGORITHMS.setdefault("SAC", _sac)
+    except ImportError:
+        pass
+    try:
+        def _dqn():
+            from ray_tpu.algorithms.dqn.dqn import DQN
+
+            return DQN
+
+        _ALGORITHMS.setdefault("DQN", _dqn)
+    except ImportError:
+        pass
+    try:
+        def _a2c():
+            from ray_tpu.algorithms.a2c.a2c import A2C
+
+            return A2C
+
+        _ALGORITHMS.setdefault("A2C", _a2c)
+    except ImportError:
+        pass

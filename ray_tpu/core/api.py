@@ -133,7 +133,9 @@ class _Runtime:
             rec = w.inflight.pop(task_id, None)
         status = msg["status"]
         if status == "ok":
-            self.store.put(task_id, msg["value"], use_shm=False)
+            self.store.put(
+                task_id, ser.loads(msg["value_blob"]), use_shm=False
+            )
         elif status == "ok_shm":
             self.store.attach_shm(task_id, msg["shm_name"])
         else:
@@ -322,10 +324,12 @@ class _Runtime:
             if isinstance(a, ObjectRef) and not self.store.is_ready(a.id)
         ]
         if not deps:
-            trec.msg["args"] = [self._marshal_arg(a) for a in trec.msg["args"]]
-            trec.msg["kwargs"] = {
+            m_args = [self._marshal_arg(a) for a in trec.msg["args"]]
+            m_kwargs = {
                 k: self._marshal_arg(v) for k, v in trec.msg["kwargs"].items()
             }
+            trec.msg["payload"] = ser.dumps((m_args, m_kwargs))
+            del trec.msg["args"], trec.msg["kwargs"]
             self._enqueue(trec)
             return
         remaining = {"n": len(deps)}
@@ -352,8 +356,12 @@ class _Runtime:
             "actor_id": actor_id,
             "task_id": None,
             "cls": cls_blob,
-            "args": [self._marshal_arg(a) for a in args],
-            "kwargs": {k: self._marshal_arg(v) for k, v in kwargs.items()},
+            "payload": ser.dumps(
+                (
+                    [self._marshal_arg(a) for a in args],
+                    {k: self._marshal_arg(v) for k, v in kwargs.items()},
+                )
+            ),
         }
         rec = _ActorRecord(
             actor_id, w, cls_blob, init_msg,
@@ -388,10 +396,15 @@ class _Runtime:
                 "task_id": task_id,
                 "actor_id": actor_id,
                 "method": method,
-                "args": [self._marshal_arg(a) for a in args],
-                "kwargs": {
-                    k: self._marshal_arg(v) for k, v in kwargs.items()
-                },
+                "payload": ser.dumps(
+                    (
+                        [self._marshal_arg(a) for a in args],
+                        {
+                            k: self._marshal_arg(v)
+                            for k, v in kwargs.items()
+                        },
+                    )
+                ),
             },
             retries_left=0,
             name=f"{method}",

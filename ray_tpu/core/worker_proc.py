@@ -84,20 +84,20 @@ def worker_main(conn, worker_id: str, env_overrides: Dict[str, str]):
             elif mtype == "task":
                 fn = func_cache[msg["func_id"]]
                 args, kwargs = _resolve_args(
-                    msg["args"], msg["kwargs"], shm_cache
+                    *ser.loads(msg["payload"]), shm_cache
                 )
                 value = fn(*args, **kwargs)
             elif mtype == "actor_init":
                 cls = ser.loads(msg["cls"])
                 args, kwargs = _resolve_args(
-                    msg["args"], msg["kwargs"], shm_cache
+                    *ser.loads(msg["payload"]), shm_cache
                 )
                 actors[msg["actor_id"]] = cls(*args, **kwargs)
                 value = None
             elif mtype == "actor_call":
                 actor = actors[msg["actor_id"]]
                 args, kwargs = _resolve_args(
-                    msg["args"], msg["kwargs"], shm_cache
+                    *ser.loads(msg["payload"]), shm_cache
                 )
                 value = getattr(actor, msg["method"])(*args, **kwargs)
             elif mtype == "free":
@@ -147,7 +147,7 @@ def worker_main(conn, worker_id: str, env_overrides: Dict[str, str]):
                 {
                     "task_id": msg["task_id"],
                     "status": "ok",
-                    "value": value,
+                    "value_blob": ser.dumps(value),
                 }
             )
 

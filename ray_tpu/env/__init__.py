@@ -1,0 +1,13 @@
+from ray_tpu.env.env_context import EnvContext
+from ray_tpu.env.vector_env import VectorEnv
+from ray_tpu.env.multi_agent_env import MultiAgentEnv, make_multi_agent
+from ray_tpu.env.registry import register_env, get_env_creator
+
+__all__ = [
+    "EnvContext",
+    "VectorEnv",
+    "MultiAgentEnv",
+    "make_multi_agent",
+    "register_env",
+    "get_env_creator",
+]

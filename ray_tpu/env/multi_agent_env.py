@@ -1,0 +1,88 @@
+"""Multi-agent environment interface.
+
+Counterpart of the reference's ``rllib/env/multi_agent_env.py:29``: dict-in /
+dict-out stepping keyed by agent id, with the special ``__all__`` key in the
+terminated/truncated dicts.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Set, Tuple
+
+
+class MultiAgentEnv:
+    def __init__(self):
+        self._agent_ids: Set = set()
+        if not hasattr(self, "observation_space"):
+            self.observation_space = None
+        if not hasattr(self, "action_space"):
+            self.action_space = None
+
+    def reset(
+        self, *, seed: Optional[int] = None, options: Optional[dict] = None
+    ) -> Tuple[Dict, Dict]:
+        """→ (obs_dict, info_dict) for the agents acting first."""
+        raise NotImplementedError
+
+    def step(self, action_dict: Dict):
+        """→ (obs, rewards, terminateds, truncateds, infos) dicts. The
+        terminateds/truncateds dicts carry '__all__'."""
+        raise NotImplementedError
+
+    def get_agent_ids(self) -> Set:
+        return self._agent_ids
+
+    def observation_space_sample(self):
+        return {
+            aid: self.observation_space.sample() for aid in self._agent_ids
+        }
+
+    def action_space_sample(self):
+        return {aid: self.action_space.sample() for aid in self._agent_ids}
+
+
+def make_multi_agent(env_name_or_creator):
+    """Turn a single-agent env into N independent-agent copies
+    (reference multi_agent_env.py make_multi_agent)."""
+    import gymnasium as gym
+
+    class IndependentMultiEnv(MultiAgentEnv):
+        def __init__(self, config=None):
+            super().__init__()
+            config = config or {}
+            num = config.get("num_agents", 2)
+            if callable(env_name_or_creator):
+                self.envs = [env_name_or_creator(config) for _ in range(num)]
+            else:
+                self.envs = [gym.make(env_name_or_creator) for _ in range(num)]
+            self._agent_ids = set(range(num))
+            self.observation_space = self.envs[0].observation_space
+            self.action_space = self.envs[0].action_space
+            self.terminateds = set()
+            self.truncateds = set()
+
+        def reset(self, *, seed=None, options=None):
+            self.terminateds = set()
+            self.truncateds = set()
+            obs, infos = {}, {}
+            for i, e in enumerate(self.envs):
+                obs[i], infos[i] = e.reset(
+                    seed=None if seed is None else seed + i
+                )
+            return obs, infos
+
+        def step(self, action_dict):
+            obs, rew, term, trunc, info = {}, {}, {}, {}, {}
+            for i, action in action_dict.items():
+                obs[i], rew[i], term[i], trunc[i], info[i] = self.envs[
+                    i
+                ].step(action)
+                if term[i]:
+                    self.terminateds.add(i)
+                if trunc[i]:
+                    self.truncateds.add(i)
+            term["__all__"] = len(self.terminateds) == len(self.envs)
+            trunc["__all__"] = len(self.truncateds) == len(self.envs)
+            return obs, rew, term, trunc, info
+
+    return IndependentMultiEnv

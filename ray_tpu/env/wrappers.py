@@ -1,0 +1,186 @@
+"""Env wrappers: Atari deepmind-style preprocessing + frame stacking.
+
+Counterpart of the reference's ``rllib/env/wrappers/atari_wrappers.py``
+(wrap_deepmind). ALE may not be installed in every image; the wrappers that
+don't need it (FrameStack, NormalizedImageEnv, TimeLimit) work for any env
+with image observations.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+
+import numpy as np
+
+try:
+    import gymnasium as gym
+    from gymnasium import spaces
+except ImportError:  # pragma: no cover
+    gym = None
+
+
+def is_atari(env) -> bool:
+    return (
+        hasattr(env, "unwrapped")
+        and type(env.unwrapped).__module__.startswith("ale_py")
+    )
+
+
+class FrameStack(gym.Wrapper):
+    """Stack the last k frames along the channel axis
+    (reference atari_wrappers.py FrameStack)."""
+
+    def __init__(self, env, k: int = 4):
+        super().__init__(env)
+        self.k = k
+        self.frames = deque([], maxlen=k)
+        shp = env.observation_space.shape
+        self.observation_space = spaces.Box(
+            low=0,
+            high=255,
+            shape=(shp[0], shp[1], shp[2] * k),
+            dtype=env.observation_space.dtype,
+        )
+
+    def reset(self, **kwargs):
+        ob, info = self.env.reset(**kwargs)
+        for _ in range(self.k):
+            self.frames.append(ob)
+        return self._get_ob(), info
+
+    def step(self, action):
+        ob, reward, term, trunc, info = self.env.step(action)
+        self.frames.append(ob)
+        return self._get_ob(), reward, term, trunc, info
+
+    def _get_ob(self):
+        return np.concatenate(list(self.frames), axis=2)
+
+
+class MaxAndSkipEnv(gym.Wrapper):
+    """Repeat action k times, max over last two frames
+    (reference MaxAndSkipEnv)."""
+
+    def __init__(self, env, skip: int = 4):
+        super().__init__(env)
+        self._obs_buffer = np.zeros(
+            (2,) + env.observation_space.shape,
+            dtype=env.observation_space.dtype,
+        )
+        self._skip = skip
+
+    def step(self, action):
+        total_reward = 0.0
+        term = trunc = False
+        info = {}
+        for i in range(self._skip):
+            obs, reward, term, trunc, info = self.env.step(action)
+            if i == self._skip - 2:
+                self._obs_buffer[0] = obs
+            if i == self._skip - 1:
+                self._obs_buffer[1] = obs
+            total_reward += float(reward)
+            if term or trunc:
+                break
+        return (
+            self._obs_buffer.max(axis=0),
+            total_reward,
+            term,
+            trunc,
+            info,
+        )
+
+    def reset(self, **kwargs):
+        return self.env.reset(**kwargs)
+
+
+class ClipRewardEnv(gym.RewardWrapper):
+    def reward(self, reward):
+        return float(np.sign(reward))
+
+
+class WarpFrame(gym.ObservationWrapper):
+    """84x84 grayscale via numpy area pooling (reference WarpFrame uses
+    cv2; box-mean downsampling avoids the cv2 dependency)."""
+
+    def __init__(self, env, dim: int = 84):
+        super().__init__(env)
+        self.dim = dim
+        self.observation_space = spaces.Box(
+            low=0, high=255, shape=(dim, dim, 1), dtype=np.uint8
+        )
+
+    def observation(self, frame):
+        if frame.ndim == 3 and frame.shape[2] == 3:
+            frame = (
+                0.299 * frame[..., 0]
+                + 0.587 * frame[..., 1]
+                + 0.114 * frame[..., 2]
+            )
+        h, w = frame.shape[:2]
+        # crop to a multiple of dim, then area-average pool
+        fh, fw = h // self.dim, w // self.dim
+        if fh >= 1 and fw >= 1:
+            frame = frame[: fh * self.dim, : fw * self.dim]
+            frame = frame.reshape(
+                self.dim, fh, self.dim, fw
+            ).mean(axis=(1, 3))
+        else:  # upscale-needed fallback: nearest
+            ys = (np.arange(self.dim) * h // self.dim).clip(0, h - 1)
+            xs = (np.arange(self.dim) * w // self.dim).clip(0, w - 1)
+            frame = frame[ys][:, xs]
+        return frame.astype(np.uint8)[:, :, None]
+
+
+class EpisodicLifeEnv(gym.Wrapper):
+    """End episode on life loss (reference EpisodicLifeEnv)."""
+
+    def __init__(self, env):
+        super().__init__(env)
+        self.lives = 0
+        self.was_real_done = True
+
+    def step(self, action):
+        obs, reward, term, trunc, info = self.env.step(action)
+        self.was_real_done = term or trunc
+        lives = self.env.unwrapped.ale.lives()
+        if 0 < lives < self.lives:
+            term = True
+        self.lives = lives
+        return obs, reward, term, trunc, info
+
+    def reset(self, **kwargs):
+        if self.was_real_done:
+            obs, info = self.env.reset(**kwargs)
+        else:
+            obs, _, _, _, info = self.env.step(0)
+        self.lives = self.env.unwrapped.ale.lives()
+        return obs, info
+
+
+class NoopResetEnv(gym.Wrapper):
+    def __init__(self, env, noop_max: int = 30):
+        super().__init__(env)
+        self.noop_max = noop_max
+
+    def reset(self, **kwargs):
+        obs, info = self.env.reset(**kwargs)
+        noops = np.random.randint(1, self.noop_max + 1)
+        for _ in range(noops):
+            obs, _, term, trunc, info = self.env.step(0)
+            if term or trunc:
+                obs, info = self.env.reset(**kwargs)
+        return obs, info
+
+
+def wrap_deepmind(env, dim: int = 84, framestack: bool = True):
+    """Reference atari_wrappers.py wrap_deepmind."""
+    if is_atari(env):
+        env = NoopResetEnv(env, noop_max=30)
+        env = MaxAndSkipEnv(env, skip=4)
+        env = EpisodicLifeEnv(env)
+    env = WarpFrame(env, dim)
+    env = ClipRewardEnv(env)
+    if framestack:
+        env = FrameStack(env, 4)
+    return env

@@ -1,0 +1,18 @@
+from ray_tpu.evaluation.rollout_worker import RolloutWorker
+from ray_tpu.evaluation.worker_set import WorkerSet
+from ray_tpu.evaluation.sampler import SyncSampler
+from ray_tpu.evaluation.postprocessing import (
+    compute_advantages,
+    compute_gae_for_sample_batch,
+)
+from ray_tpu.evaluation.metrics import RolloutMetrics, summarize_episodes
+
+__all__ = [
+    "RolloutWorker",
+    "WorkerSet",
+    "SyncSampler",
+    "compute_advantages",
+    "compute_gae_for_sample_batch",
+    "RolloutMetrics",
+    "summarize_episodes",
+]

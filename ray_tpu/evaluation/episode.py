@@ -1,0 +1,24 @@
+"""Episode bookkeeping for metrics (reference
+``rllib/evaluation/episode.py`` Episode, trimmed to the metric-bearing
+fields)."""
+
+from __future__ import annotations
+
+import random
+from typing import Dict
+
+
+class EpisodeRecord:
+    def __init__(self):
+        self.episode_id = random.getrandbits(62)
+        self.total_reward = 0.0
+        self.length = 0
+        self.agent_rewards: Dict = {}
+
+    def add(self, reward: float, agent_id=None):
+        self.total_reward += reward
+        self.length += 1
+        if agent_id is not None:
+            self.agent_rewards[agent_id] = (
+                self.agent_rewards.get(agent_id, 0.0) + reward
+            )

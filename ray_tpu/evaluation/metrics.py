@@ -1,0 +1,40 @@
+"""Rollout metrics aggregation.
+
+Counterpart of the reference's ``rllib/evaluation/metrics.py``
+(collect_episodes / summarize_episodes).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+import numpy as np
+
+
+class RolloutMetrics:
+    def __init__(self, episode_length: int, episode_reward: float,
+                 agent_rewards: Dict | None = None):
+        self.episode_length = episode_length
+        self.episode_reward = episode_reward
+        self.agent_rewards = agent_rewards or {}
+
+
+def summarize_episodes(episodes: List[RolloutMetrics]) -> Dict:
+    """reference metrics.py summarize_episodes."""
+    rewards = [e.episode_reward for e in episodes]
+    lengths = [e.episode_length for e in episodes]
+    policy_rewards: Dict[str, List[float]] = {}
+    for e in episodes:
+        for (aid, pid), r in e.agent_rewards.items():
+            policy_rewards.setdefault(pid, []).append(r)
+    out = {
+        "episode_reward_max": float(np.max(rewards)) if rewards else np.nan,
+        "episode_reward_min": float(np.min(rewards)) if rewards else np.nan,
+        "episode_reward_mean": float(np.mean(rewards)) if rewards else np.nan,
+        "episode_len_mean": float(np.mean(lengths)) if lengths else np.nan,
+        "episodes_this_iter": len(episodes),
+        "policy_reward_mean": {
+            pid: float(np.mean(rs)) for pid, rs in policy_rewards.items()
+        },
+    }
+    return out

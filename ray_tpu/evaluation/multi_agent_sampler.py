@@ -1,0 +1,195 @@
+"""Multi-agent synchronous sampler.
+
+Counterpart of the reference's multi-agent path through
+``rllib/evaluation/sampler.py _env_runner :531`` +
+``SimpleListCollector`` (``collectors/simple_list_collector.py:523``): one
+MultiAgentEnv, per-agent trajectories routed to policies via
+``policy_mapping_fn``, emitted as a MultiAgentBatch keyed by policy id.
+
+Per step, observations are grouped by policy so each policy does ONE batched
+``compute_actions`` call across its agents (the reference batches the same
+way through the collector's forward-pass buffers).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List
+
+import numpy as np
+
+from ray_tpu.data.sample_batch import (
+    MultiAgentBatch,
+    SampleBatch,
+    concat_samples,
+)
+from ray_tpu.evaluation.episode import EpisodeRecord
+from ray_tpu.evaluation.metrics import RolloutMetrics
+from ray_tpu.evaluation.sampler import _EnvSlotCollector, unsquash_action
+
+
+class MultiAgentSyncSampler:
+    def __init__(
+        self,
+        *,
+        env,
+        policy_map: Dict,
+        policy_mapping_fn: Callable,
+        preprocessors: Dict,
+        obs_filters: Dict,
+        rollout_fragment_length: int = 200,
+        batch_mode: str = "truncate_episodes",
+        normalize_actions: bool = True,
+    ):
+        self.env = env
+        self.policy_map = policy_map
+        self.policy_mapping_fn = policy_mapping_fn
+        self.preprocessors = preprocessors
+        self.obs_filters = obs_filters
+        self.frag_len = rollout_fragment_length
+        self.batch_mode = batch_mode
+        self.normalize_actions = normalize_actions
+
+        self.collectors: Dict = {}  # agent_id -> _EnvSlotCollector
+        self.agent_policy: Dict = {}
+        self.metrics_queue: List[RolloutMetrics] = []
+        self.episode = EpisodeRecord()
+        self._reset_env()
+
+    def _transform(self, pid, obs):
+        prep = self.preprocessors.get(pid)
+        if prep is not None:
+            obs = prep.transform(obs)
+        filt = self.obs_filters.get(pid)
+        if filt is not None:
+            obs = filt(obs)
+        return np.asarray(obs)
+
+    def _reset_env(self):
+        raw_obs, _ = self.env.reset()
+        self.cur_obs = {}
+        for aid, o in raw_obs.items():
+            pid = self._pid(aid)
+            self.cur_obs[aid] = self._transform(pid, o)
+        self.episode = EpisodeRecord()
+
+    def _pid(self, aid):
+        if aid not in self.agent_policy:
+            self.agent_policy[aid] = self.policy_mapping_fn(aid)
+        return self.agent_policy[aid]
+
+    def sample(self) -> MultiAgentBatch:
+        out: Dict[str, List[SampleBatch]] = {}
+        env_steps = 0
+        while env_steps < self.frag_len:
+            env_steps += 1
+            self._step_once(out)
+        # flush remaining trajectories at fragment boundary
+        for aid in list(self.collectors):
+            self._flush_agent(aid, out, done=False)
+        policy_batches = {
+            pid: concat_samples(bs) for pid, bs in out.items() if bs
+        }
+        return MultiAgentBatch(policy_batches, env_steps)
+
+    def _step_once(self, out):
+        # group agents by policy → one batched forward per policy
+        by_policy: Dict[str, List] = {}
+        for aid, obs in self.cur_obs.items():
+            by_policy.setdefault(self._pid(aid), []).append(aid)
+
+        actions: Dict = {}
+        extras_by_agent: Dict = {}
+        for pid, aids in by_policy.items():
+            policy = self.policy_map[pid]
+            obs_batch = np.stack([self.cur_obs[a] for a in aids])
+            acts, _, extras = policy.compute_actions(obs_batch)
+            for j, aid in enumerate(aids):
+                actions[aid] = acts[j]
+                extras_by_agent[aid] = {
+                    k: np.asarray(v[j]) for k, v in extras.items()
+                }
+
+        env_actions = {
+            aid: (
+                unsquash_action(
+                    a, self.policy_map[self._pid(aid)].action_space
+                )
+                if self.normalize_actions
+                else a
+            )
+            for aid, a in actions.items()
+        }
+        next_obs, rewards, terms, truncs, infos = self.env.step(env_actions)
+        all_done = terms.get("__all__", False) or truncs.get(
+            "__all__", False
+        )
+
+        for aid in actions:
+            pid = self._pid(aid)
+            term = bool(terms.get(aid, False))
+            trunc = bool(truncs.get(aid, False))
+            has_next = aid in next_obs
+            t_obs = (
+                self._transform(pid, next_obs[aid])
+                if has_next
+                else self.cur_obs[aid]
+            )
+            coll = self.collectors.setdefault(aid, _EnvSlotCollector())
+            coll.add(
+                {
+                    SampleBatch.OBS: self.cur_obs[aid],
+                    SampleBatch.NEXT_OBS: t_obs,
+                    SampleBatch.ACTIONS: np.asarray(actions[aid]),
+                    SampleBatch.REWARDS: np.float32(
+                        rewards.get(aid, 0.0)
+                    ),
+                    SampleBatch.TERMINATEDS: np.bool_(term or all_done),
+                    SampleBatch.TRUNCATEDS: np.bool_(trunc),
+                    SampleBatch.EPS_ID: np.int64(self.episode.episode_id),
+                    SampleBatch.AGENT_INDEX: np.int64(
+                        hash(aid) % (2**31)
+                    ),
+                    **extras_by_agent[aid],
+                }
+            )
+            self.episode.add(float(rewards.get(aid, 0.0)), aid)
+            if term or trunc or all_done:
+                self._flush_agent(aid, out, done=True)
+                self.cur_obs.pop(aid, None)
+            elif has_next:
+                self.cur_obs[aid] = t_obs
+
+        for aid, o in next_obs.items():
+            if aid not in self.cur_obs and not (
+                terms.get(aid, False) or truncs.get(aid, False)
+            ) and not all_done:
+                self.cur_obs[aid] = self._transform(self._pid(aid), o)
+
+        if all_done or not self.cur_obs:
+            self.metrics_queue.append(
+                RolloutMetrics(
+                    self.episode.length // max(1, len(self.agent_policy)),
+                    self.episode.total_reward,
+                    {
+                        (aid, self._pid(aid)): r
+                        for aid, r in self.episode.agent_rewards.items()
+                    },
+                )
+            )
+            self._reset_env()
+
+    def _flush_agent(self, aid, out, done: bool):
+        coll = self.collectors.get(aid)
+        if coll is None or coll.count == 0:
+            return
+        pid = self._pid(aid)
+        batch = coll.flush()
+        batch = self.policy_map[pid].postprocess_trajectory(batch)
+        out.setdefault(pid, []).append(batch)
+        if done:
+            self.collectors.pop(aid, None)
+
+    def get_metrics(self) -> List[RolloutMetrics]:
+        m = self.metrics_queue
+        self.metrics_queue = []
+        return m

@@ -1,0 +1,97 @@
+"""Host-side trajectory postprocessing (GAE).
+
+Counterpart of the reference's ``rllib/evaluation/postprocessing.py``
+(``compute_advantages :76``, ``compute_gae_for_sample_batch :140``). Runs in
+numpy on CPU rollout actors. The learner-side jit GAE fast path lives in
+``ray_tpu/ops/gae.py``; this module is the parity path used when workers
+postprocess (needed for replay-based algorithms and multi-agent callbacks).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import scipy.signal
+
+from ray_tpu.data.sample_batch import SampleBatch
+
+
+def discount_cumsum(x: np.ndarray, gamma: float) -> np.ndarray:
+    """y[t] = sum_k gamma^k x[t+k] via an IIR filter (vectorized)."""
+    return scipy.signal.lfilter(
+        [1], [1, float(-gamma)], x[::-1], axis=0
+    )[::-1].astype(np.float32)
+
+
+def compute_advantages(
+    rollout: SampleBatch,
+    last_r: float,
+    gamma: float = 0.9,
+    lambda_: float = 1.0,
+    use_gae: bool = True,
+    use_critic: bool = True,
+) -> SampleBatch:
+    """Reference postprocessing.py:76, same semantics and column names."""
+    rewards = np.asarray(rollout[SampleBatch.REWARDS], np.float32)
+    if use_gae:
+        vpred = np.asarray(rollout[SampleBatch.VF_PREDS], np.float32)
+        vpred_t = np.concatenate([vpred, np.array([last_r], np.float32)])
+        delta_t = rewards + gamma * vpred_t[1:] - vpred_t[:-1]
+        advantages = discount_cumsum(delta_t, gamma * lambda_)
+        rollout[SampleBatch.ADVANTAGES] = advantages
+        rollout[SampleBatch.VALUE_TARGETS] = (
+            advantages + vpred
+        ).astype(np.float32)
+    else:
+        rewards_plus_v = np.concatenate(
+            [rewards, np.array([last_r], np.float32)]
+        )
+        discounted_returns = discount_cumsum(rewards_plus_v, gamma)[:-1]
+        if use_critic:
+            vpred = np.asarray(rollout[SampleBatch.VF_PREDS], np.float32)
+            rollout[SampleBatch.ADVANTAGES] = discounted_returns - vpred
+            rollout[SampleBatch.VALUE_TARGETS] = discounted_returns
+        else:
+            rollout[SampleBatch.ADVANTAGES] = discounted_returns
+            rollout[SampleBatch.VALUE_TARGETS] = np.zeros_like(
+                discounted_returns
+            )
+    rollout[SampleBatch.ADVANTAGES] = rollout[
+        SampleBatch.ADVANTAGES
+    ].astype(np.float32)
+    return rollout
+
+
+def compute_gae_for_sample_batch(
+    policy,
+    sample_batch: SampleBatch,
+    other_agent_batches=None,
+    episode=None,
+) -> SampleBatch:
+    """Reference postprocessing.py:140: bootstrap the fragment tail with
+    V(s_T) when truncated, 0 when terminated."""
+    terminated = bool(sample_batch[SampleBatch.TERMINATEDS][-1])
+    truncated = bool(
+        sample_batch.get(
+            SampleBatch.TRUNCATEDS,
+            np.zeros(len(sample_batch), bool),
+        )[-1]
+    )
+    if terminated and not truncated:
+        last_r = 0.0
+    else:
+        last_obs = sample_batch[SampleBatch.NEXT_OBS][-1]
+        state = None
+        if policy.is_recurrent:
+            state = [
+                sample_batch[f"state_out_{i}"][-1][None]
+                for i in range(len(policy.get_initial_state()))
+            ]
+        last_r = float(policy.value_batch(last_obs[None], state)[0])
+    return compute_advantages(
+        sample_batch,
+        last_r,
+        policy.config.get("gamma", 0.99),
+        policy.config.get("lambda", 1.0),
+        use_gae=policy.config.get("use_gae", True),
+        use_critic=policy.config.get("use_critic", True),
+    )

@@ -1,0 +1,284 @@
+"""RolloutWorker: env + policy + sampler, runnable locally or as an actor.
+
+Counterpart of the reference's ``rllib/evaluation/rollout_worker.py:130``
+(``sample :824``, ``learn_on_batch :929``, ``get_weights :1578``,
+``set_weights :1616``). The same class is the driver-local learner worker
+(policy on the TPU mesh) and the remote CPU rollout actor (policy jitted on
+host CPU) — platform selection happens naturally because actor processes pin
+``JAX_PLATFORMS=cpu``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from ray_tpu.data.sample_batch import (
+    DEFAULT_POLICY_ID,
+    MultiAgentBatch,
+    SampleBatch,
+)
+from ray_tpu.env.env_context import EnvContext
+from ray_tpu.env.multi_agent_env import MultiAgentEnv
+from ray_tpu.env.registry import get_env_creator
+from ray_tpu.env.vector_env import VectorEnv
+from ray_tpu.evaluation.sampler import SyncSampler
+from ray_tpu.models.catalog import ModelCatalog
+from ray_tpu.utils.filter import get_filter
+
+
+class RolloutWorker:
+    def __init__(
+        self,
+        *,
+        env_creator: Optional[Callable] = None,
+        policy_cls=None,
+        policy_specs: Optional[Dict] = None,
+        policy_mapping_fn: Optional[Callable] = None,
+        config: Optional[Dict] = None,
+        worker_index: int = 0,
+        num_workers: int = 0,
+        seed: Optional[int] = None,
+    ):
+        self.config = dict(config or {})
+        self.worker_index = worker_index
+        self.num_workers = num_workers
+        self.global_vars: Dict[str, Any] = {"timestep": 0}
+
+        env_config = EnvContext(
+            self.config.get("env_config") or {},
+            worker_index=worker_index,
+            num_workers=num_workers,
+        )
+        seed = (
+            seed
+            if seed is not None
+            else self.config.get("seed")
+        )
+        if seed is not None:
+            seed = seed + worker_index * 1000
+            np.random.seed(seed)
+
+        # ---- build env ----
+        self.env = None
+        self.vector_env = None
+        self.preprocessor = None
+        if env_creator is not None:
+            num_envs = int(self.config.get("num_envs_per_worker", 1))
+
+            def make_sub_env(vector_index):
+                ctx = env_config.copy_with_overrides(
+                    vector_index=vector_index
+                )
+                return env_creator(ctx)
+
+            probe = make_sub_env(0)
+            if isinstance(probe, MultiAgentEnv):
+                self.env = probe
+                self._multiagent_env = True
+            else:
+                self._multiagent_env = False
+                self.env = probe
+                envs = [probe] + [
+                    make_sub_env(i) for i in range(1, num_envs)
+                ]
+                self.vector_env = VectorEnv.vectorize_gym_envs(
+                    lambda i: envs[i], num_envs, seed=seed
+                )
+
+        # ---- policies ----
+        self.policy_map: Dict[str, Any] = {}
+        self.policy_mapping_fn = policy_mapping_fn or (
+            lambda agent_id, **kw: DEFAULT_POLICY_ID
+        )
+        self.filters: Dict[str, Any] = {}
+
+        if policy_specs is None and policy_cls is not None:
+            obs_space = self.config.get("observation_space") or (
+                self.env.observation_space
+            )
+            act_space = self.config.get("action_space") or (
+                self.env.action_space
+            )
+            policy_specs = {
+                DEFAULT_POLICY_ID: (policy_cls, obs_space, act_space, {})
+            }
+
+        for pid, (cls, obs_space, act_space, overrides) in (
+            policy_specs or {}
+        ).items():
+            pol_config = {**self.config, **(overrides or {})}
+            prep = ModelCatalog.get_preprocessor_for_space(obs_space)
+            eff_obs_space = prep.observation_space
+            if pid == DEFAULT_POLICY_ID or self.preprocessor is None:
+                self.preprocessor = prep
+            # Rollout workers (worker_index > 0) keep single-device CPU
+            # meshes; the local worker builds its learner mesh from config.
+            if worker_index > 0:
+                pol_config.pop("_mesh", None)
+            self.policy_map[pid] = cls(eff_obs_space, act_space, pol_config)
+            self.filters[pid] = get_filter(
+                self.config.get("observation_filter", "NoFilter"),
+                eff_obs_space.shape,
+            )
+
+        # ---- sampler ----
+        self.sampler = None
+        if self.vector_env is not None and self.policy_map:
+            pid = DEFAULT_POLICY_ID
+            self.sampler = SyncSampler(
+                vector_env=self.vector_env,
+                policy=self.policy_map[pid],
+                preprocessor=self.preprocessor,
+                obs_filter=self.filters.get(pid),
+                rollout_fragment_length=int(
+                    self.config.get("rollout_fragment_length", 200)
+                ),
+                batch_mode=self.config.get(
+                    "batch_mode", "truncate_episodes"
+                ),
+                episode_horizon=self.config.get("horizon"),
+                clip_actions=self.config.get("clip_actions", False),
+                normalize_actions=self.config.get(
+                    "normalize_actions", True
+                ),
+            )
+        elif env_creator is not None and self._multiagent_env:
+            from ray_tpu.evaluation.multi_agent_sampler import (
+                MultiAgentSyncSampler,
+            )
+
+            self.sampler = MultiAgentSyncSampler(
+                env=self.env,
+                policy_map=self.policy_map,
+                policy_mapping_fn=self.policy_mapping_fn,
+                preprocessors={
+                    pid: ModelCatalog.get_preprocessor_for_space(
+                        p.observation_space
+                    )
+                    for pid, p in self.policy_map.items()
+                },
+                obs_filters=self.filters,
+                rollout_fragment_length=int(
+                    self.config.get("rollout_fragment_length", 200)
+                ),
+                batch_mode=self.config.get(
+                    "batch_mode", "truncate_episodes"
+                ),
+            )
+
+    # -- sampling --------------------------------------------------------
+
+    def sample(self):
+        """reference rollout_worker.py:824."""
+        assert self.sampler is not None, "worker has no env"
+        return self.sampler.sample()
+
+    def sample_with_count(self):
+        batch = self.sample()
+        return batch, batch.env_steps()
+
+    def get_metrics(self) -> List:
+        return self.sampler.get_metrics() if self.sampler else []
+
+    # -- learning --------------------------------------------------------
+
+    def policy(self, pid: str = DEFAULT_POLICY_ID):
+        return self.policy_map[pid]
+
+    def learn_on_batch(self, samples) -> Dict:
+        """reference rollout_worker.py:929."""
+        if isinstance(samples, MultiAgentBatch):
+            info = {}
+            for pid, batch in samples.policy_batches.items():
+                if pid in self.policy_map:
+                    info[pid] = self.policy_map[pid].learn_on_batch(batch)
+            return info
+        return {
+            DEFAULT_POLICY_ID: self.policy_map[
+                DEFAULT_POLICY_ID
+            ].learn_on_batch(samples)
+        }
+
+    def compute_gradients(self, samples):
+        if isinstance(samples, MultiAgentBatch):
+            samples = samples.policy_batches[DEFAULT_POLICY_ID]
+        return self.policy_map[DEFAULT_POLICY_ID].compute_gradients(samples)
+
+    def apply_gradients(self, grads) -> None:
+        self.policy_map[DEFAULT_POLICY_ID].apply_gradients(grads)
+
+    # -- weights & filters ----------------------------------------------
+
+    def get_weights(self, policies: Optional[List[str]] = None) -> Dict:
+        return {
+            pid: p.get_weights()
+            for pid, p in self.policy_map.items()
+            if policies is None or pid in policies
+        }
+
+    def set_weights(self, weights: Dict, global_vars: Optional[Dict] = None):
+        for pid, w in weights.items():
+            if pid in self.policy_map:
+                self.policy_map[pid].set_weights(w)
+        if global_vars:
+            self.set_global_vars(global_vars)
+
+    def get_filters(self, flush_after: bool = False) -> Dict:
+        out = {
+            pid: f.as_serializable() for pid, f in self.filters.items()
+        }
+        if flush_after:
+            for f in self.filters.values():
+                f.clear_buffer()
+        return out
+
+    def sync_filters(self, new_filters: Dict) -> None:
+        for pid, f in new_filters.items():
+            if pid in self.filters:
+                self.filters[pid].sync(f)
+
+    def set_global_vars(self, global_vars: Dict) -> None:
+        self.global_vars.update(global_vars)
+        for p in self.policy_map.values():
+            p.on_global_var_update(global_vars)
+
+    # -- state / misc ----------------------------------------------------
+
+    def save(self) -> Dict:
+        return {
+            "policy_states": {
+                pid: p.get_state() for pid, p in self.policy_map.items()
+            },
+            "filters": self.get_filters(),
+        }
+
+    def restore(self, state: Dict) -> None:
+        for pid, s in state.get("policy_states", {}).items():
+            if pid in self.policy_map:
+                self.policy_map[pid].set_state(s)
+        self.sync_filters(state.get("filters", {}))
+
+    def apply(self, fn: Callable, *args, **kwargs):
+        """reference rollout_worker.py apply (used by foreach_worker)."""
+        return fn(self, *args, **kwargs)
+
+    def foreach_env(self, fn: Callable) -> List:
+        if self.vector_env is None:
+            return [fn(self.env)] if self.env else []
+        return [fn(e) for e in self.vector_env.get_sub_environments()]
+
+    def foreach_policy(self, fn: Callable) -> List:
+        return [fn(p, pid) for pid, p in self.policy_map.items()]
+
+    def stop(self) -> None:
+        if self.vector_env is not None:
+            for e in self.vector_env.get_sub_environments():
+                try:
+                    e.close()
+                except Exception:
+                    pass
+
+    def ping(self) -> str:
+        return "pong"

@@ -1,0 +1,233 @@
+"""Synchronous vectorized sampler — the rollout hot loop.
+
+Counterpart of the reference's ``rllib/evaluation/sampler.py``
+(``SyncSampler :168``, the ``_env_runner`` generator ``:531``) fused with the
+trajectory collector (``collectors/simple_list_collector.py:523``). The loop
+is batched across a VectorEnv: one ``policy.compute_actions`` call per step
+covers every sub-env (a single jitted CPU forward), actions fan back out to
+the envs, and per-env collectors assemble fixed-length fragments
+("truncate_episodes" mode) or whole episodes ("complete_episodes").
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ray_tpu.data.sample_batch import SampleBatch, concat_samples
+from ray_tpu.evaluation.episode import EpisodeRecord
+from ray_tpu.evaluation.metrics import RolloutMetrics
+
+try:
+    from gymnasium import spaces
+except ImportError:  # pragma: no cover
+    spaces = None
+
+
+def unsquash_action(action, space):
+    """Map a [-1,1]-normalized action to the space bounds (reference
+    ``rllib/utils/spaces/space_utils.py`` unsquash_action)."""
+    if isinstance(space, spaces.Box) and np.all(np.isfinite(space.low)):
+        a = np.clip(action, -1.0, 1.0)
+        return space.low + (a + 1.0) * (space.high - space.low) / 2.0
+    return action
+
+
+def clip_action(action, space):
+    if isinstance(space, spaces.Box):
+        return np.clip(action, space.low, space.high)
+    return action
+
+
+class _EnvSlotCollector:
+    """Per-sub-env trajectory accumulator."""
+
+    def __init__(self):
+        self.columns: Dict[str, List] = {}
+        self.count = 0
+
+    def add(self, row: Dict):
+        for k, v in row.items():
+            self.columns.setdefault(k, []).append(v)
+        self.count += 1
+
+    def flush(self) -> SampleBatch:
+        batch = SampleBatch(
+            {
+                k: (np.stack(v) if not isinstance(v[0], dict) else v)
+                for k, v in self.columns.items()
+            }
+        )
+        self.columns = {}
+        self.count = 0
+        return batch
+
+
+class SyncSampler:
+    def __init__(
+        self,
+        *,
+        vector_env,
+        policy,
+        preprocessor=None,
+        obs_filter=None,
+        rollout_fragment_length: int = 200,
+        batch_mode: str = "truncate_episodes",
+        episode_horizon: Optional[int] = None,
+        clip_actions: bool = False,
+        normalize_actions: bool = True,
+        callbacks=None,
+    ):
+        self.env = vector_env
+        self.policy = policy
+        self.preprocessor = preprocessor
+        self.obs_filter = obs_filter
+        self.frag_len = rollout_fragment_length
+        self.batch_mode = batch_mode
+        self.horizon = episode_horizon
+        self.clip_actions = clip_actions
+        self.normalize_actions = normalize_actions
+        self.callbacks = callbacks
+
+        n = self.env.num_envs
+        self.collectors = [_EnvSlotCollector() for _ in range(n)]
+        self.episodes = [EpisodeRecord() for _ in range(n)]
+        self.metrics_queue: List[RolloutMetrics] = []
+        self.unroll_id = 0
+
+        raw_obs, _ = self.env.vector_reset()
+        self.cur_obs = [self._transform(o) for o in raw_obs]
+        init_state = self.policy.get_initial_state()
+        self.states = [
+            [s.copy() for s in init_state] for _ in range(n)
+        ]
+        self._has_state = bool(init_state)
+
+    def _transform(self, obs):
+        if self.preprocessor is not None:
+            obs = self.preprocessor.transform(obs)
+        if self.obs_filter is not None:
+            obs = self.obs_filter(obs)
+        return np.asarray(obs)
+
+    # -- main loop -------------------------------------------------------
+
+    def sample(self) -> SampleBatch:
+        n = self.env.num_envs
+        out: List[SampleBatch] = []
+        if self.batch_mode == "truncate_episodes":
+            for _ in range(self.frag_len):
+                self._step_once(out)
+            for i in range(n):
+                self._flush_slot(i, out)
+        else:  # complete_episodes
+            target = self.frag_len * n
+            steps = 0
+            while steps < target or any(
+                c.count > 0 for c in self.collectors
+            ):
+                done_any = self._step_once(out)
+                steps += n
+                if steps >= target and not any(
+                    c.count > 0 for c in self.collectors
+                ):
+                    break
+        batches = [b for b in out if b.count > 0]
+        if not batches:
+            return SampleBatch()
+        return concat_samples(batches)
+
+    def _step_once(self, out: List[SampleBatch]) -> bool:
+        n = self.env.num_envs
+        obs_batch = np.stack(self.cur_obs)
+        state_batches = None
+        if self._has_state:
+            state_batches = [
+                np.stack([self.states[i][k] for i in range(n)])
+                for k in range(len(self.states[0]))
+            ]
+        actions, state_out, extras = self.policy.compute_actions(
+            obs_batch, state_batches, explore=True
+        )
+
+        env_actions = []
+        for i in range(n):
+            a = actions[i]
+            if self.normalize_actions:
+                a = unsquash_action(a, self.env.action_space)
+            elif self.clip_actions:
+                a = clip_action(a, self.env.action_space)
+            env_actions.append(a)
+
+        next_obs, rewards, terms, truncs, infos = self.env.vector_step(
+            env_actions
+        )
+        done_any = False
+        for i in range(n):
+            t_obs = self._transform(next_obs[i])
+            row = {
+                SampleBatch.OBS: self.cur_obs[i],
+                SampleBatch.NEXT_OBS: t_obs,
+                SampleBatch.ACTIONS: np.asarray(actions[i]),
+                SampleBatch.REWARDS: np.float32(rewards[i]),
+                SampleBatch.TERMINATEDS: np.bool_(terms[i]),
+                SampleBatch.TRUNCATEDS: np.bool_(truncs[i]),
+                SampleBatch.EPS_ID: np.int64(self.episodes[i].episode_id),
+                SampleBatch.AGENT_INDEX: np.int64(i),
+                SampleBatch.T: np.int64(self.episodes[i].length),
+            }
+            for k, v in extras.items():
+                row[k] = np.asarray(v[i])
+            if self._has_state:
+                for k in range(len(self.states[i])):
+                    row[f"state_in_{k}"] = self.states[i][k]
+            self.collectors[i].add(row)
+            self.episodes[i].add(float(rewards[i]))
+
+            if self._has_state:
+                self.states[i] = [np.asarray(s[i]) for s in state_out]
+
+            ep_done = terms[i] or truncs[i]
+            if (
+                self.horizon
+                and self.episodes[i].length >= self.horizon
+            ):
+                ep_done = True
+                truncs[i] = True
+            if ep_done:
+                done_any = True
+                self._flush_slot(i, out)
+                self.metrics_queue.append(
+                    RolloutMetrics(
+                        self.episodes[i].length,
+                        self.episodes[i].total_reward,
+                    )
+                )
+                self.episodes[i] = EpisodeRecord()
+                raw, _ = self.env.reset_at(i)
+                self.cur_obs[i] = self._transform(raw)
+                if self._has_state:
+                    self.states[i] = [
+                        s.copy()
+                        for s in self.policy.get_initial_state()
+                    ]
+            else:
+                self.cur_obs[i] = t_obs
+        return done_any
+
+    def _flush_slot(self, i: int, out: List[SampleBatch]) -> None:
+        if self.collectors[i].count == 0:
+            return
+        batch = self.collectors[i].flush()
+        batch[SampleBatch.UNROLL_ID] = np.full(
+            batch.count, self.unroll_id, np.int64
+        )
+        self.unroll_id += 1
+        batch = self.policy.postprocess_trajectory(batch)
+        out.append(batch)
+
+    def get_metrics(self) -> List[RolloutMetrics]:
+        out = self.metrics_queue
+        self.metrics_queue = []
+        return out

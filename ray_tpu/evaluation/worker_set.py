@@ -1,0 +1,199 @@
+"""WorkerSet: one local (learner) worker + N remote rollout actors.
+
+Counterpart of the reference's ``rllib/evaluation/worker_set.py:50``
+(``sync_weights :192``, ``foreach_worker :367``). Weight broadcast is a
+single ``ray.put`` of the host pytree into the shared-memory object plane;
+every actor maps the same segment (reference's object-store broadcast,
+``worker_set.py:209-224``).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional
+
+import ray_tpu as ray
+from ray_tpu.evaluation.rollout_worker import RolloutWorker
+from ray_tpu.utils.filter import MeanStdFilter
+
+
+class WorkerSet:
+    def __init__(
+        self,
+        *,
+        env_creator,
+        policy_cls=None,
+        policy_specs=None,
+        policy_mapping_fn=None,
+        config: Dict,
+        num_workers: int = 0,
+        local_worker: bool = True,
+    ):
+        self._env_creator = env_creator
+        self._policy_cls = policy_cls
+        self._policy_specs = policy_specs
+        self._policy_mapping_fn = policy_mapping_fn
+        self._config = config
+        self._remote_workers: List = []
+
+        self._local_worker = None
+        if local_worker:
+            self._local_worker = RolloutWorker(
+                env_creator=env_creator,
+                policy_cls=policy_cls,
+                policy_specs=policy_specs,
+                policy_mapping_fn=policy_mapping_fn,
+                config=config,
+                worker_index=0,
+                num_workers=num_workers,
+            )
+        if num_workers > 0:
+            self.add_workers(num_workers)
+
+    def add_workers(self, num_workers: int) -> None:
+        """reference worker_set.py:234."""
+        if not ray.is_initialized():
+            ray.init()
+        RemoteWorker = ray.remote(RolloutWorker)
+        start = len(self._remote_workers)
+        for i in range(num_workers):
+            self._remote_workers.append(
+                RemoteWorker.options(
+                    max_restarts=int(
+                        self._config.get("recreate_failed_workers", False)
+                    )
+                    and 3
+                ).remote(
+                    env_creator=self._env_creator,
+                    policy_cls=self._policy_cls,
+                    policy_specs=self._policy_specs,
+                    policy_mapping_fn=self._policy_mapping_fn,
+                    config={**self._config, "_mesh": None},
+                    worker_index=start + i + 1,
+                    num_workers=num_workers,
+                )
+            )
+
+    def local_worker(self) -> Optional[RolloutWorker]:
+        return self._local_worker
+
+    def remote_workers(self) -> List:
+        return self._remote_workers
+
+    def num_remote_workers(self) -> int:
+        return len(self._remote_workers)
+
+    # -- sync ------------------------------------------------------------
+
+    def sync_weights(
+        self,
+        policies: Optional[List[str]] = None,
+        global_vars: Optional[Dict] = None,
+        to_worker_indices: Optional[List[int]] = None,
+    ) -> None:
+        """reference worker_set.py:192."""
+        if self._local_worker is None:
+            return
+        weights = self._local_worker.get_weights(policies)
+        if self._remote_workers:
+            ref = ray.put(weights)
+            targets = self._remote_workers
+            if to_worker_indices is not None:
+                targets = [
+                    w
+                    for i, w in enumerate(self._remote_workers)
+                    if i + 1 in to_worker_indices
+                ]
+            for w in targets:
+                w.set_weights.remote(ref, global_vars)
+        if global_vars:
+            self._local_worker.set_global_vars(global_vars)
+
+    def sync_filters(self) -> None:
+        """Aggregate rollout filter deltas into the local worker's filters
+        and broadcast the merged stats back (reference
+        ``rllib/utils/filter_manager.py`` FilterManager.synchronize)."""
+        if self._local_worker is None or not self._remote_workers:
+            return
+        remote_filters = ray.get(
+            [w.get_filters.remote(True) for w in self._remote_workers]
+        )
+        local = self._local_worker.filters
+        for rf in remote_filters:
+            for pid, f in rf.items():
+                if pid in local and isinstance(f, MeanStdFilter):
+                    local[pid].apply_changes(f, with_buffer=False)
+        merged = {
+            pid: f.as_serializable() for pid, f in local.items()
+        }
+        ref = ray.put(merged)
+        for w in self._remote_workers:
+            w.sync_filters.remote(ref)
+
+    # -- mapping ---------------------------------------------------------
+
+    def foreach_worker(self, fn: Callable) -> List:
+        """reference worker_set.py:367."""
+        out = []
+        if self._local_worker is not None:
+            out.append(fn(self._local_worker))
+        out.extend(
+            ray.get([w.apply.remote(fn) for w in self._remote_workers])
+        )
+        return out
+
+    def foreach_worker_with_index(self, fn: Callable) -> List:
+        out = []
+        if self._local_worker is not None:
+            out.append(fn(self._local_worker, 0))
+        refs = [
+            w.apply.remote(fn, i + 1)
+            for i, w in enumerate(self._remote_workers)
+        ]
+        out.extend(ray.get(refs))
+        return out
+
+    def foreach_policy(self, fn: Callable) -> List:
+        out = []
+        for res in self.foreach_worker(
+            lambda w: w.foreach_policy(fn)
+        ):
+            out.extend(res)
+        return out
+
+    def probe_unhealthy_workers(self) -> List[int]:
+        """→ indices of workers that fail a ping (reference fault
+        tolerance in worker_set / algorithm.try_recover)."""
+        bad = []
+        refs = [
+            (i, w.ping.remote())
+            for i, w in enumerate(self._remote_workers)
+        ]
+        for i, ref in refs:
+            try:
+                ray.get(ref, timeout=30)
+            except Exception:
+                bad.append(i + 1)
+        return bad
+
+    def recreate_failed_workers(self) -> None:
+        bad = self.probe_unhealthy_workers()
+        if not bad:
+            return
+        num = len(self._remote_workers)
+        keep = [
+            w
+            for i, w in enumerate(self._remote_workers)
+            if i + 1 not in bad
+        ]
+        self._remote_workers = keep
+        self.add_workers(len(bad))
+        self.sync_weights()
+
+    def stop(self) -> None:
+        if self._local_worker is not None:
+            self._local_worker.stop()
+        for w in self._remote_workers:
+            try:
+                w.stop.remote()
+            except Exception:
+                pass

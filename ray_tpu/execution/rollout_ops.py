@@ -1,0 +1,53 @@
+"""Rollout collection primitives.
+
+Counterpart of the reference's ``rllib/execution/rollout_ops.py:35``
+(synchronous_parallel_sample).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Union
+
+import ray_tpu as ray
+from ray_tpu.data.sample_batch import (
+    MultiAgentBatch,
+    SampleBatch,
+    concat_samples,
+)
+
+
+def synchronous_parallel_sample(
+    *,
+    worker_set,
+    max_agent_steps: Optional[int] = None,
+    max_env_steps: Optional[int] = None,
+    concat: bool = True,
+) -> Union[SampleBatch, MultiAgentBatch, List]:
+    """Sample from all workers in parallel until the step target is met
+    (reference rollout_ops.py:35)."""
+    agent_or_env_steps = 0
+    max_steps = max_agent_steps or max_env_steps
+    all_batches = []
+    while True:
+        if worker_set.num_remote_workers() <= 0:
+            batches = [worker_set.local_worker().sample()]
+        else:
+            refs = [
+                w.sample.remote() for w in worker_set.remote_workers()
+            ]
+            batches = ray.get(refs)
+        for b in batches:
+            if max_agent_steps:
+                agent_or_env_steps += (
+                    b.agent_steps()
+                    if isinstance(b, MultiAgentBatch)
+                    else b.count
+                )
+            else:
+                agent_or_env_steps += b.env_steps()
+        all_batches.extend(batches)
+        if max_steps is None or agent_or_env_steps >= max_steps:
+            break
+    if concat:
+        return concat_samples(all_batches)
+    return all_batches

@@ -1,0 +1,17 @@
+from ray_tpu.parallel.mesh import (
+    make_mesh,
+    data_sharding,
+    replicated,
+    num_data_shards,
+    DATA_AXIS,
+    MODEL_AXIS,
+)
+
+__all__ = [
+    "make_mesh",
+    "data_sharding",
+    "replicated",
+    "num_data_shards",
+    "DATA_AXIS",
+    "MODEL_AXIS",
+]

@@ -1,0 +1,61 @@
+"""Device mesh helpers for the learner plane.
+
+This is where the reference's multi-GPU tower machinery
+(``rllib/policy/torch_policy.py:498-624``: per-device replicas, loader
+threads, CPU grad averaging) collapses into JAX sharding: one mesh, one
+jitted update, XLA collectives over ICI.
+
+Axis conventions used across ray_tpu:
+  - "data": batch data parallelism (the parity axis with the reference)
+  - "model": tensor parallelism for large learner models (TPU extension)
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+
+
+def get_devices(platform: Optional[str] = None):
+    devs = jax.devices()
+    if platform:
+        devs = [d for d in devs if d.platform == platform]
+    return devs
+
+
+def make_mesh(
+    axis_shapes: Optional[Sequence[Tuple[str, int]]] = None,
+    devices=None,
+) -> Mesh:
+    """Build a mesh; default is a 1-D data mesh over all devices."""
+    devices = devices if devices is not None else jax.devices()
+    if axis_shapes is None:
+        axis_shapes = [(DATA_AXIS, len(devices))]
+    names = tuple(n for n, _ in axis_shapes)
+    shape = tuple(s for _, s in axis_shapes)
+    n = int(np.prod(shape))
+    if n > len(devices):
+        raise ValueError(
+            f"mesh shape {shape} needs {n} devices, have {len(devices)}"
+        )
+    arr = np.asarray(devices[:n]).reshape(shape)
+    return Mesh(arr, names)
+
+
+def data_sharding(mesh: Mesh) -> NamedSharding:
+    """Leading-dim batch sharding."""
+    return NamedSharding(mesh, P(DATA_AXIS))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def num_data_shards(mesh: Mesh) -> int:
+    return mesh.shape[DATA_AXIS]

@@ -1,0 +1,4 @@
+from ray_tpu.policy.policy import Policy, ViewRequirement
+from ray_tpu.policy.jax_policy import JaxPolicy, build_jax_policy
+
+__all__ = ["Policy", "ViewRequirement", "JaxPolicy", "build_jax_policy"]

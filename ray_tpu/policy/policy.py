@@ -1,0 +1,150 @@
+"""Policy abstract base class + view requirements.
+
+Counterpart of the reference's ``rllib/policy/policy.py:99`` (Policy ABC:
+``compute_actions :356``, ``postprocess_trajectory :434``,
+``learn_on_batch :487``, ``compute_gradients :598``) and
+``rllib/policy/view_requirement.py:15``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ray_tpu.data.sample_batch import SampleBatch
+
+
+class ViewRequirement:
+    """Declares a column the policy needs at compute/train time
+    (reference view_requirement.py:15)."""
+
+    def __init__(
+        self,
+        data_col: Optional[str] = None,
+        shift: int = 0,
+        used_for_compute_actions: bool = True,
+        used_for_training: bool = True,
+        space=None,
+    ):
+        self.data_col = data_col
+        self.shift = shift
+        self.used_for_compute_actions = used_for_compute_actions
+        self.used_for_training = used_for_training
+        self.space = space
+
+
+class Policy:
+    """Per-policy inference/learning contract (reference policy.py:99)."""
+
+    def __init__(self, observation_space, action_space, config: Dict):
+        self.observation_space = observation_space
+        self.action_space = action_space
+        self.config = config or {}
+        self.global_timestep = 0
+        self.view_requirements: Dict[str, ViewRequirement] = {
+            SampleBatch.OBS: ViewRequirement(space=observation_space),
+            SampleBatch.ACTIONS: ViewRequirement(
+                space=action_space, used_for_compute_actions=False
+            ),
+            SampleBatch.REWARDS: ViewRequirement(
+                used_for_compute_actions=False
+            ),
+            SampleBatch.TERMINATEDS: ViewRequirement(
+                used_for_compute_actions=False
+            ),
+            SampleBatch.TRUNCATEDS: ViewRequirement(
+                used_for_compute_actions=False
+            ),
+            SampleBatch.EPS_ID: ViewRequirement(
+                used_for_compute_actions=False
+            ),
+        }
+
+    # -- inference -------------------------------------------------------
+
+    def compute_actions(
+        self,
+        obs_batch: np.ndarray,
+        state_batches: Optional[List[np.ndarray]] = None,
+        prev_action_batch: Optional[np.ndarray] = None,
+        prev_reward_batch: Optional[np.ndarray] = None,
+        explore: bool = True,
+        timestep: Optional[int] = None,
+        **kwargs,
+    ) -> Tuple[np.ndarray, List[np.ndarray], Dict[str, np.ndarray]]:
+        """→ (actions, state_outs, extra_fetches). Reference policy.py:356."""
+        raise NotImplementedError
+
+    def compute_single_action(
+        self, obs, state=None, explore: bool = True, **kwargs
+    ):
+        obs_batch = np.asarray(obs)[None]
+        state_batches = (
+            [np.asarray(s)[None] for s in state] if state else None
+        )
+        actions, state_out, extra = self.compute_actions(
+            obs_batch, state_batches, explore=explore, **kwargs
+        )
+        return (
+            actions[0],
+            [s[0] for s in state_out] if state_out else [],
+            {k: v[0] for k, v in extra.items()},
+        )
+
+    def get_initial_state(self) -> List[np.ndarray]:
+        return []
+
+    @property
+    def is_recurrent(self) -> bool:
+        return bool(self.get_initial_state())
+
+    # -- training --------------------------------------------------------
+
+    def postprocess_trajectory(
+        self,
+        sample_batch: SampleBatch,
+        other_agent_batches: Optional[Dict] = None,
+        episode=None,
+    ) -> SampleBatch:
+        return sample_batch
+
+    def learn_on_batch(self, samples: SampleBatch) -> Dict[str, Any]:
+        raise NotImplementedError
+
+    def compute_gradients(self, batch: SampleBatch):
+        raise NotImplementedError
+
+    def apply_gradients(self, gradients) -> None:
+        raise NotImplementedError
+
+    # -- state -----------------------------------------------------------
+
+    def get_weights(self) -> Dict[str, np.ndarray]:
+        raise NotImplementedError
+
+    def set_weights(self, weights) -> None:
+        raise NotImplementedError
+
+    def get_state(self) -> Dict[str, Any]:
+        return {
+            "weights": self.get_weights(),
+            "global_timestep": self.global_timestep,
+        }
+
+    def set_state(self, state: Dict[str, Any]) -> None:
+        self.set_weights(state["weights"])
+        self.global_timestep = state.get("global_timestep", 0)
+
+    def on_global_var_update(self, global_vars: Dict[str, Any]) -> None:
+        self.global_timestep = global_vars.get(
+            "timestep", self.global_timestep
+        )
+
+    def export_checkpoint(self, export_dir: str) -> None:
+        import os
+        import pickle
+
+        os.makedirs(export_dir, exist_ok=True)
+        with open(os.path.join(export_dir, "policy_state.pkl"), "wb") as f:
+            pickle.dump(self.get_state(), f)

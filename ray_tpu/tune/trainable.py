@@ -1,0 +1,112 @@
+"""Trainable: the training-iteration protocol.
+
+Counterpart of the reference's ``ray/tune/trainable/trainable.py:63``
+(``train :303``, ``save :418``, ``restore :514``; subclass hooks ``setup``,
+``step :895``, ``save_checkpoint :912``, ``load_checkpoint :952``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pickle
+import tempfile
+import time
+from typing import Any, Dict, Optional
+
+
+class Trainable:
+    def __init__(self, config: Optional[Dict] = None,
+                 logger_creator=None):
+        self.config = config or {}
+        self._iteration = 0
+        self._timesteps_total = 0
+        self._episodes_total = 0
+        self._time_total = 0.0
+        self._start_time = time.time()
+        self._logdir = None
+        self._last_result: Dict = {}
+        self.setup(self.config)
+
+    # -- subclass API ----------------------------------------------------
+
+    def setup(self, config: Dict) -> None:
+        pass
+
+    def step(self) -> Dict[str, Any]:
+        raise NotImplementedError
+
+    def save_checkpoint(self, checkpoint_dir: str) -> str:
+        raise NotImplementedError
+
+    def load_checkpoint(self, checkpoint_path: str) -> None:
+        raise NotImplementedError
+
+    def cleanup(self) -> None:
+        pass
+
+    # -- driver API ------------------------------------------------------
+
+    @property
+    def iteration(self) -> int:
+        return self._iteration
+
+    @property
+    def logdir(self) -> str:
+        if self._logdir is None:
+            self._logdir = tempfile.mkdtemp(prefix="ray_tpu_trainable_")
+        return self._logdir
+
+    def train(self) -> Dict[str, Any]:
+        """One training iteration (reference trainable.py:303)."""
+        start = time.time()
+        result = self.step() or {}
+        self._iteration += 1
+        dur = time.time() - start
+        self._time_total += dur
+        result.setdefault("training_iteration", self._iteration)
+        result.setdefault("time_this_iter_s", dur)
+        result.setdefault("time_total_s", self._time_total)
+        result.setdefault(
+            "timesteps_total", result.get("timesteps_total",
+                                          self._timesteps_total)
+        )
+        result.setdefault("date", time.strftime("%Y-%m-%d_%H-%M-%S"))
+        self._last_result = result
+        return result
+
+    def save(self, checkpoint_dir: Optional[str] = None) -> str:
+        """reference trainable.py:418."""
+        checkpoint_dir = checkpoint_dir or os.path.join(
+            self.logdir, f"checkpoint_{self._iteration:06d}"
+        )
+        os.makedirs(checkpoint_dir, exist_ok=True)
+        path = self.save_checkpoint(checkpoint_dir)
+        meta = {
+            "iteration": self._iteration,
+            "timesteps_total": self._timesteps_total,
+            "time_total": self._time_total,
+        }
+        with open(
+            os.path.join(checkpoint_dir, ".tune_metadata"), "wb"
+        ) as f:
+            pickle.dump(meta, f)
+        return path or checkpoint_dir
+
+    def restore(self, checkpoint_path: str) -> None:
+        """reference trainable.py:514."""
+        if os.path.isfile(checkpoint_path):
+            checkpoint_dir = os.path.dirname(checkpoint_path)
+        else:
+            checkpoint_dir = checkpoint_path
+        meta_path = os.path.join(checkpoint_dir, ".tune_metadata")
+        if os.path.exists(meta_path):
+            with open(meta_path, "rb") as f:
+                meta = pickle.load(f)
+            self._iteration = meta["iteration"]
+            self._timesteps_total = meta["timesteps_total"]
+            self._time_total = meta["time_total"]
+        self.load_checkpoint(checkpoint_path)
+
+    def stop(self) -> None:
+        self.cleanup()
