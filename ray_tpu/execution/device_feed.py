@@ -1,0 +1,64 @@
+"""Asynchronous host→device batch feeding (double buffering).
+
+Counterpart of the reference's ``_MultiGPULoaderThread``
+(``rllib/execution/multi_gpu_learner_thread.py:184``), which moved batches
+into idle GPU tower buffers while the learner consumed others. Here a feeder
+thread runs ``jax.device_put`` onto the learner mesh so the (often
+bandwidth-bound) host→device transfer of batch k+1 overlaps the jitted SGD
+compute of batch k.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Optional
+
+import jax
+
+
+class DeviceFeeder:
+    def __init__(self, sharding=None, capacity: int = 2):
+        self._sharding = sharding
+        self._in: "queue.Queue" = queue.Queue(maxsize=capacity)
+        self._out: "queue.Queue" = queue.Queue(maxsize=capacity)
+        self._stopped = False
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="device_feeder"
+        )
+        self._thread.start()
+
+    def _run(self):
+        while True:
+            item = self._in.get()
+            if item is None:
+                return
+            try:
+                if self._sharding is not None:
+                    dev = jax.device_put(item, self._sharding)
+                else:
+                    dev = jax.device_put(item)
+                jax.block_until_ready(dev)
+                self._out.put(dev)
+            except Exception as e:  # surface to consumer
+                self._out.put(e)
+
+    def put(self, host_batch: Any) -> None:
+        """Enqueue a host batch for transfer."""
+        if self._stopped:
+            raise RuntimeError("feeder stopped")
+        self._in.put(host_batch)
+
+    def get(self, timeout: Optional[float] = None) -> Any:
+        """Dequeue the next device-resident batch (blocking)."""
+        out = self._out.get(timeout=timeout)
+        if isinstance(out, Exception):
+            raise out
+        return out
+
+    def qsize(self) -> int:
+        return self._out.qsize()
+
+    def stop(self) -> None:
+        self._stopped = True
+        self._in.put(None)

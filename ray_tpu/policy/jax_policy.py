@@ -236,10 +236,19 @@ class JaxPolicy(Policy):
     # -- learning --------------------------------------------------------
 
     def _coeff_array(self) -> Dict[str, jnp.ndarray]:
-        return {
-            k: jnp.asarray(v, jnp.float32)
-            for k, v in self.coeff_values.items()
-        }
+        # Cache device scalars; re-transfer only the coefficients whose
+        # host values changed (each put is a host→device round trip).
+        cache = getattr(self, "_coeff_cache", None)
+        if cache is None:
+            cache = self._coeff_cache = {}
+        out = {}
+        for k, v in self.coeff_values.items():
+            ent = cache.get(k)
+            if ent is None or ent[0] != v:
+                ent = (v, jnp.asarray(v, jnp.float32))
+                cache[k] = ent
+            out[k] = ent[1]
+        return out
 
     def _update_scheduled_coeffs(self):
         t = self.global_timestep
@@ -336,6 +345,9 @@ class JaxPolicy(Policy):
         self.num_grad_updates += self.num_sgd_iter * max(
             1, bsize // max(1, self.minibatch_size)
         )
+        # One device→host transfer for all stats (individual float()
+        # conversions each pay a full device round trip).
+        stats = jax.device_get(stats)
         out = {k: float(v) for k, v in stats.items()}
         out.update(self.after_learn_on_batch(out))
         out["cur_lr"] = self.coeff_values["lr"]
