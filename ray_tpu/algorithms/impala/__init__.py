@@ -1,0 +1,7 @@
+from ray_tpu.algorithms.impala.impala import (
+    IMPALA,
+    IMPALAConfig,
+    ImpalaJaxPolicy,
+)
+
+__all__ = ["IMPALA", "IMPALAConfig", "ImpalaJaxPolicy"]

@@ -143,6 +143,9 @@ class RolloutWorker:
                 normalize_actions=self.config.get(
                     "normalize_actions", True
                 ),
+                flush_on_episode_end=not self.config.get(
+                    "_fixed_unrolls", False
+                ),
             )
         elif env_creator is not None and self._multiagent_env:
             from ray_tpu.evaluation.multi_agent_sampler import (

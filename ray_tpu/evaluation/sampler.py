@@ -78,6 +78,7 @@ class SyncSampler:
         clip_actions: bool = False,
         normalize_actions: bool = True,
         callbacks=None,
+        flush_on_episode_end: bool = True,
     ):
         self.env = vector_env
         self.policy = policy
@@ -89,6 +90,10 @@ class SyncSampler:
         self.clip_actions = clip_actions
         self.normalize_actions = normalize_actions
         self.callbacks = callbacks
+        # False → fixed-size unrolls that may span episode boundaries
+        # (IMPALA/V-trace mode: dones inside the fragment carry the reset
+        # information; no padding or re-chopping needed on TPU).
+        self.flush_on_episode_end = flush_on_episode_end
 
         n = self.env.num_envs
         self.collectors = [_EnvSlotCollector() for _ in range(n)]
@@ -197,7 +202,8 @@ class SyncSampler:
                 truncs[i] = True
             if ep_done:
                 done_any = True
-                self._flush_slot(i, out)
+                if self.flush_on_episode_end:
+                    self._flush_slot(i, out)
                 self.metrics_queue.append(
                     RolloutMetrics(
                         self.episodes[i].length,

@@ -1,0 +1,204 @@
+"""Replay buffers (uniform + prioritized), host-RAM resident.
+
+Counterpart of the reference's
+``rllib/utils/replay_buffers/{replay_buffer,prioritized_replay_buffer}.py``
+(PrioritizedReplayBuffer ``:19``) and the segment trees
+(``rllib/execution/segment_tree.py``). TPU-first: storage is columnar
+(pre-allocated numpy ring arrays per column) instead of a deque of
+per-timestep dicts, so sampling a training batch is a single fancy-index
+gather producing learner-ready arrays with zero python-loop work.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ray_tpu.data.sample_batch import SampleBatch
+from ray_tpu.ops.segment_tree import MinSegmentTree, SumSegmentTree
+
+
+class ReplayBuffer:
+    """Uniform ring buffer (reference replay_buffer.py ReplayBuffer)."""
+
+    def __init__(self, capacity: int = 10000, seed: Optional[int] = None):
+        self.capacity = capacity
+        self._cols: Dict[str, np.ndarray] = {}
+        self._idx = 0
+        self._size = 0
+        self._rng = np.random.default_rng(seed)
+        self._num_added = 0
+
+    def __len__(self) -> int:
+        return self._size
+
+    @property
+    def num_added(self) -> int:
+        return self._num_added
+
+    def _ensure_cols(self, batch: SampleBatch):
+        for k, v in batch.items():
+            if not isinstance(v, np.ndarray) or v.dtype == object:
+                continue
+            if k not in self._cols:
+                self._cols[k] = np.zeros(
+                    (self.capacity,) + v.shape[1:], v.dtype
+                )
+
+    def add(self, batch: SampleBatch) -> None:
+        n = batch.count
+        if n == 0:
+            return
+        self._ensure_cols(batch)
+        idx = (self._idx + np.arange(n)) % self.capacity
+        for k, col in self._cols.items():
+            if k in batch:
+                col[idx] = batch[k]
+        self._idx = int((self._idx + n) % self.capacity)
+        self._size = int(min(self._size + n, self.capacity))
+        self._num_added += n
+
+    def sample(self, num_items: int) -> SampleBatch:
+        idx = self._rng.integers(0, self._size, num_items)
+        return self._make_batch(idx)
+
+    def _make_batch(self, idx: np.ndarray) -> SampleBatch:
+        return SampleBatch(
+            {k: col[idx] for k, col in self._cols.items()}
+        )
+
+    def stats(self) -> Dict:
+        return {"size": self._size, "num_added": self._num_added}
+
+    def get_state(self) -> Dict:
+        return {
+            "cols": {k: v[: self._size].copy() for k, v in self._cols.items()},
+            "idx": self._idx,
+            "size": self._size,
+            "num_added": self._num_added,
+        }
+
+    def set_state(self, state: Dict) -> None:
+        self._size = state["size"]
+        self._idx = state["idx"]
+        self._num_added = state["num_added"]
+        for k, v in state["cols"].items():
+            self._cols[k] = np.zeros(
+                (self.capacity,) + v.shape[1:], v.dtype
+            )
+            self._cols[k][: self._size] = v
+
+
+class PrioritizedReplayBuffer(ReplayBuffer):
+    """Proportional prioritized replay (reference
+    prioritized_replay_buffer.py:19), vectorized over the whole sample
+    batch via the numpy segment trees."""
+
+    def __init__(
+        self,
+        capacity: int = 10000,
+        alpha: float = 0.6,
+        seed: Optional[int] = None,
+    ):
+        super().__init__(capacity, seed)
+        assert alpha >= 0
+        self._alpha = alpha
+        cap2 = 1
+        while cap2 < capacity:
+            cap2 *= 2
+        self._sum_tree = SumSegmentTree(cap2)
+        self._min_tree = MinSegmentTree(cap2)
+        self._max_priority = 1.0
+
+    def add(self, batch: SampleBatch) -> None:
+        n = batch.count
+        if n == 0:
+            return
+        idx = (self._idx + np.arange(n)) % self.capacity
+        super().add(batch)
+        pri = self._max_priority**self._alpha
+        self._sum_tree.set_items(idx, np.full(n, pri))
+        self._min_tree.set_items(idx, np.full(n, pri))
+
+    def sample(self, num_items: int, beta: float = 0.4) -> SampleBatch:
+        total = self._sum_tree.sum(0, self._size)
+        mass = (
+            self._rng.random(num_items) + np.arange(num_items)
+        ) / num_items * total
+        idx = self._sum_tree.find_prefixsum_idx(mass)
+        idx = np.clip(idx, 0, self._size - 1)
+
+        p_min = self._min_tree.min(0, self._size) / total
+        max_weight = (p_min * self._size) ** (-beta)
+        p_sample = self._sum_tree[idx] / total
+        weights = (p_sample * self._size) ** (-beta) / max_weight
+
+        batch = self._make_batch(idx)
+        batch["weights"] = weights.astype(np.float32)
+        batch["batch_indexes"] = idx.astype(np.int64)
+        return batch
+
+    def update_priorities(
+        self, idx: np.ndarray, priorities: np.ndarray
+    ) -> None:
+        priorities = np.maximum(np.asarray(priorities, np.float64), 1e-6)
+        self._sum_tree.set_items(idx, priorities**self._alpha)
+        self._min_tree.set_items(idx, priorities**self._alpha)
+        self._max_priority = max(
+            self._max_priority, float(priorities.max())
+        )
+
+
+class MultiAgentReplayBuffer:
+    """Per-policy buffers (reference multi_agent_replay_buffer.py)."""
+
+    def __init__(
+        self,
+        capacity: int = 10000,
+        prioritized: bool = False,
+        alpha: float = 0.6,
+        seed: Optional[int] = None,
+    ):
+        self.capacity = capacity
+        self.prioritized = prioritized
+        self.alpha = alpha
+        self.seed = seed
+        self.buffers: Dict[str, ReplayBuffer] = {}
+
+    def _buffer(self, pid: str) -> ReplayBuffer:
+        if pid not in self.buffers:
+            if self.prioritized:
+                self.buffers[pid] = PrioritizedReplayBuffer(
+                    self.capacity, self.alpha, self.seed
+                )
+            else:
+                self.buffers[pid] = ReplayBuffer(self.capacity, self.seed)
+        return self.buffers[pid]
+
+    def add(self, batch) -> None:
+        from ray_tpu.data.sample_batch import (
+            DEFAULT_POLICY_ID,
+            MultiAgentBatch,
+        )
+
+        if isinstance(batch, SampleBatch):
+            batch = batch.as_multi_agent()
+        for pid, sb in batch.policy_batches.items():
+            self._buffer(pid).add(sb)
+
+    def sample(self, num_items: int, **kwargs):
+        from ray_tpu.data.sample_batch import MultiAgentBatch
+
+        out = {}
+        for pid, buf in self.buffers.items():
+            if len(buf) >= num_items:
+                out[pid] = (
+                    buf.sample(num_items, **kwargs)
+                    if isinstance(buf, PrioritizedReplayBuffer)
+                    else buf.sample(num_items)
+                )
+        return MultiAgentBatch(out, num_items)
+
+    def __len__(self) -> int:
+        return max((len(b) for b in self.buffers.values()), default=0)

@@ -263,7 +263,7 @@ class JaxPolicy(Policy):
                 f"batch size {batch_size} not divisible by "
                 f"{n_shards} data shards"
             )
-        b_loc = batch_size // n_shards
+        b_loc = max(1, batch_size // n_shards)
         mb_loc = min(b_loc, max(1, self.minibatch_size // n_shards))
         num_mb = max(1, b_loc // mb_loc)
         num_iters = self.num_sgd_iter
@@ -319,19 +319,32 @@ class JaxPolicy(Policy):
             in_specs=(P(), P(), P("data"), P(), P()),
             out_specs=(P(), P(), P()),
         )
-        return jax.jit(sharded, donate_argnums=(0, 1))
+        # Donate only opt_state: params buffers must stay valid because an
+        # async sampler thread may be running compute_actions with them
+        # concurrently (IMPALA sync mode shares the policy object).
+        return jax.jit(sharded, donate_argnums=(1,))
 
     def learn_on_batch(self, samples: SampleBatch) -> Dict[str, Any]:
         """One full multi-epoch SGD update (reference
         TorchPolicy.learn_on_batch :467 + the whole train_ops stack)."""
         batch = self._batch_to_train_tree(samples)
         bsize = int(next(iter(batch.values())).shape[0])
-        # Static-shape discipline: trim to a multiple of the data shards so
-        # one compiled program serves every iteration.
-        trim = (bsize // self.n_shards) * self.n_shards
-        if trim != bsize:
-            batch = {k: v[:trim] for k, v in batch.items()}
-            bsize = trim
+        # Static-shape discipline: the leading dim must be a multiple of
+        # the data shards. Trim when possible; tile tiny batches up.
+        if bsize < self.n_shards:
+            reps = -(-self.n_shards // bsize)
+            batch = {
+                k: np.tile(v, (reps,) + (1,) * (v.ndim - 1))[
+                    : self.n_shards
+                ]
+                for k, v in batch.items()
+            }
+            bsize = self.n_shards
+        else:
+            trim = (bsize // self.n_shards) * self.n_shards
+            if trim != bsize:
+                batch = {k: v[:trim] for k, v in batch.items()}
+                bsize = trim
         fn = self._learn_fns.get(bsize)
         if fn is None:
             fn = self._build_learn_fn(bsize)

@@ -1,0 +1,89 @@
+"""IMPALA tests (reference rllib/algorithms/impala/tests/test_impala.py)."""
+
+import time
+
+import numpy as np
+import pytest
+
+from ray_tpu.algorithms.impala import IMPALA, IMPALAConfig
+
+
+def test_impala_sync_mode_trains():
+    algo = (
+        IMPALAConfig()
+        .environment("CartPole-v1")
+        .rollouts(num_rollout_workers=0, rollout_fragment_length=32)
+        .training(train_batch_size=128, lr=5e-4)
+        .reporting(min_time_s_per_iteration=0)
+        .debugging(seed=0)
+        .build()
+    )
+    result = algo.train()
+    # learner thread is async; wait for at least one learn step
+    deadline = time.time() + 30
+    while (
+        algo._learner_thread.num_steps == 0 and time.time() < deadline
+    ):
+        algo.train()
+    assert algo._learner_thread.num_steps > 0
+    info = algo._learner_thread.learner_info
+    assert np.isfinite(info["total_loss"])
+    algo.cleanup()
+
+
+def test_impala_async_with_workers():
+    algo = (
+        IMPALAConfig()
+        .environment("CartPole-v1")
+        .rollouts(num_rollout_workers=2, rollout_fragment_length=32)
+        .training(train_batch_size=128)
+        .reporting(min_time_s_per_iteration=0)
+        .debugging(seed=0)
+        .build()
+    )
+    deadline = time.time() + 300
+    steps_trained = 0
+    while time.time() < deadline:
+        result = algo.train()
+        steps_trained = algo._counters.get("num_env_steps_trained", 0)
+        if steps_trained > 0:
+            break
+    assert steps_trained > 0, "async learner never trained a batch"
+    assert algo._counters["num_env_steps_sampled"] > 0
+    algo.cleanup()
+
+
+@pytest.mark.slow
+def test_impala_cartpole_learns():
+    """Learning regression in sync mode (the async path is identical
+    learner-side; multi-process rollout is too contended on 1-CPU CI)."""
+    algo = (
+        IMPALAConfig()
+        .environment("CartPole-v1")
+        .rollouts(
+            num_rollout_workers=0,
+            rollout_fragment_length=64,
+            num_envs_per_worker=4,
+        )
+        .training(
+            train_batch_size=512,
+            lr=5e-4,
+            entropy_coeff=0.01,
+            vf_loss_coeff=0.5,
+            grad_clip=40.0,
+        )
+        .reporting(min_time_s_per_iteration=1)
+        .debugging(seed=11)
+        .build()
+    )
+    best = -np.inf
+    deadline = time.time() + 240
+    while time.time() < deadline:
+        result = algo.train()
+        r = result.get("episode_reward_mean", np.nan)
+        if np.isfinite(r):
+            best = max(best, r)
+        if best >= 100.0:
+            break
+    algo.cleanup()
+    assert best >= 100.0, f"IMPALA failed to learn: best={best}"
