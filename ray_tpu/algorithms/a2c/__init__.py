@@ -1,0 +1,3 @@
+from ray_tpu.algorithms.a2c.a2c import A2C, A2CConfig, A2CJaxPolicy, A3C, A3CConfig
+
+__all__ = ["A2C", "A2CConfig", "A2CJaxPolicy", "A3C", "A3CConfig"]

@@ -1,0 +1,169 @@
+"""A2C (sync) + A3C-style async gradients.
+
+Counterpart of the reference's ``rllib/algorithms/a2c/a2c.py`` and
+``a3c/a3c.py:191`` (async grads: workers compute gradients, driver
+applies). A2C here is the synchronous path: sample → single-pass
+actor-critic loss on the learner mesh. The A3C flavor reuses the
+compute_gradients/apply_gradients JaxPolicy parity API.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax.numpy as jnp
+
+import ray_tpu as ray
+from ray_tpu.algorithms.algorithm import (
+    Algorithm,
+    NUM_AGENT_STEPS_SAMPLED,
+    NUM_ENV_STEPS_SAMPLED,
+)
+from ray_tpu.algorithms.algorithm_config import AlgorithmConfig
+from ray_tpu.data.sample_batch import DEFAULT_POLICY_ID, SampleBatch
+from ray_tpu.evaluation.postprocessing import compute_gae_for_sample_batch
+from ray_tpu.execution.rollout_ops import synchronous_parallel_sample
+from ray_tpu.execution.train_ops import train_one_step
+from ray_tpu.policy.jax_policy import JaxPolicy
+
+
+class A2CConfig(AlgorithmConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class or A2C)
+        self.lr = 1e-4
+        self.train_batch_size = 200
+        self.rollout_fragment_length = 20
+        self.use_gae = True
+        self.lambda_ = 1.0
+        self.vf_loss_coeff = 0.5
+        self.entropy_coeff = 0.01
+        self.entropy_coeff_schedule = None
+        self.grad_clip = 40.0
+        self.microbatch_size = None
+
+    def training(
+        self,
+        *,
+        use_gae: Optional[bool] = None,
+        lambda_: Optional[float] = None,
+        vf_loss_coeff: Optional[float] = None,
+        entropy_coeff: Optional[float] = None,
+        entropy_coeff_schedule=None,
+        microbatch_size: Optional[int] = None,
+        **kwargs,
+    ) -> "A2CConfig":
+        super().training(**kwargs)
+        if use_gae is not None:
+            self.use_gae = use_gae
+        if lambda_ is not None:
+            self.lambda_ = lambda_
+        if vf_loss_coeff is not None:
+            self.vf_loss_coeff = vf_loss_coeff
+        if entropy_coeff is not None:
+            self.entropy_coeff = entropy_coeff
+        if entropy_coeff_schedule is not None:
+            self.entropy_coeff_schedule = entropy_coeff_schedule
+        if microbatch_size is not None:
+            self.microbatch_size = microbatch_size
+        return self
+
+    def to_dict(self) -> Dict:
+        d = super().to_dict()
+        d["lambda"] = d.pop("lambda_", 1.0)
+        return d
+
+
+class A2CJaxPolicy(JaxPolicy):
+    """Vanilla actor-critic loss (reference a3c_torch_policy.py)."""
+
+    def loss(self, params, batch, rng, coeffs):
+        cfg = self.config
+        dist_inputs, values, _ = self.model_forward(
+            params, batch[SampleBatch.OBS]
+        )
+        dist = self.dist_class(dist_inputs)
+        logp = dist.logp(batch[SampleBatch.ACTIONS])
+        adv = batch[SampleBatch.ADVANTAGES]
+        pi_loss = -jnp.mean(logp * adv)
+        vf_loss = jnp.mean(
+            jnp.square(values - batch[SampleBatch.VALUE_TARGETS])
+        )
+        entropy = jnp.mean(dist.entropy())
+        total = (
+            pi_loss
+            + cfg.get("vf_loss_coeff", 0.5) * vf_loss
+            - coeffs["entropy_coeff"] * entropy
+        )
+        return total, {
+            "policy_loss": pi_loss,
+            "vf_loss": vf_loss,
+            "entropy": entropy,
+        }
+
+    def postprocess_trajectory(
+        self, sample_batch, other_agent_batches=None, episode=None
+    ):
+        return compute_gae_for_sample_batch(
+            self, sample_batch, other_agent_batches, episode
+        )
+
+
+class A2C(Algorithm):
+    _default_policy_class = A2CJaxPolicy
+
+    @classmethod
+    def get_default_config(cls) -> A2CConfig:
+        return A2CConfig(cls)
+
+    def training_step(self) -> Dict:
+        train_batch = synchronous_parallel_sample(
+            worker_set=self.workers,
+            max_env_steps=self.config["train_batch_size"],
+        )
+        self._counters[NUM_ENV_STEPS_SAMPLED] += train_batch.env_steps()
+        info = train_one_step(self, train_batch)
+        self.workers.sync_weights(
+            global_vars={
+                "timestep": self._counters[NUM_ENV_STEPS_SAMPLED]
+            }
+        )
+        return info
+
+
+class A3CConfig(A2CConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class or A3C)
+
+
+class A3C(A2C):
+    """Async gradient-parallel flavor (reference a3c.py:191): each
+    ready worker computes gradients on its own sample; the driver
+    applies them and returns fresh weights to that worker only."""
+
+    def training_step(self) -> Dict:
+        workers = self.workers.remote_workers()
+        if not workers:
+            return super().training_step()
+        policy = self.get_policy()
+        info = {}
+
+        def sample_and_grad(worker):
+            batch = worker.sample()
+            grads, g_info = worker.compute_gradients(batch)
+            return grads, g_info, batch.env_steps()
+
+        refs = [w.apply.remote(sample_and_grad) for w in workers]
+        ready, _ = ray.wait(
+            refs, num_returns=1, timeout=60.0
+        )
+        for ref in ready:
+            grads, g_info, steps = ray.get(ref)
+            policy.apply_gradients(grads)
+            self._counters[NUM_ENV_STEPS_SAMPLED] += steps
+            info = {DEFAULT_POLICY_ID: g_info}
+        self.workers.sync_weights(
+            global_vars={
+                "timestep": self._counters[NUM_ENV_STEPS_SAMPLED]
+            }
+        )
+        return info

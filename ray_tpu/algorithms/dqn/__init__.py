@@ -1,0 +1,9 @@
+from ray_tpu.algorithms.dqn.dqn import (
+    DQN,
+    DQNConfig,
+    DQNJaxPolicy,
+    SimpleQ,
+    SimpleQConfig,
+)
+
+__all__ = ["DQN", "DQNConfig", "DQNJaxPolicy", "SimpleQ", "SimpleQConfig"]

@@ -1,0 +1,376 @@
+"""DQN family: double/dueling DQN with (prioritized) replay.
+
+Counterpart of the reference's ``rllib/algorithms/dqn/dqn.py`` (config,
+``training_step :336`` — shared by all off-policy algos) and
+``rllib/algorithms/simple_q/simple_q.py:256``. The TD-loss/optimizer runs as
+one jitted program; the target network lives in the policy's replicated
+``aux_state`` (the reference keeps a second torch module) and is refreshed
+by a host-side copy every ``target_network_update_freq`` trained steps.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.algorithms.algorithm import (
+    Algorithm,
+    NUM_AGENT_STEPS_SAMPLED,
+    NUM_ENV_STEPS_SAMPLED,
+)
+from ray_tpu.algorithms.algorithm_config import AlgorithmConfig
+from ray_tpu.data.sample_batch import DEFAULT_POLICY_ID, SampleBatch
+from ray_tpu.execution.replay_buffer import (
+    MultiAgentReplayBuffer,
+    PrioritizedReplayBuffer,
+)
+from ray_tpu.execution.rollout_ops import synchronous_parallel_sample
+from ray_tpu.execution.train_ops import (
+    NUM_AGENT_STEPS_TRAINED,
+    NUM_ENV_STEPS_TRAINED,
+)
+from ray_tpu.policy.jax_policy import JaxPolicy
+from ray_tpu.utils.schedules import PiecewiseSchedule
+
+
+class DQNConfig(AlgorithmConfig):
+    """reference dqn.py DQNConfig."""
+
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class or DQN)
+        self.lr = 5e-4
+        self.train_batch_size = 32
+        self.rollout_fragment_length = 4
+        self.gamma = 0.99
+        self.num_steps_sampled_before_learning_starts = 1000
+        self.target_network_update_freq = 500
+        self.double_q = True
+        self.dueling = True
+        self.n_step = 1
+        self.replay_buffer_config = {
+            "capacity": 50000,
+            "prioritized_replay": False,
+            "prioritized_replay_alpha": 0.6,
+            "prioritized_replay_beta": 0.4,
+        }
+        self.epsilon_timesteps = 10000
+        self.final_epsilon = 0.02
+        self.initial_epsilon = 1.0
+        self.training_intensity = None
+        self.grad_clip = 40.0
+
+    def training(
+        self,
+        *,
+        target_network_update_freq: Optional[int] = None,
+        double_q: Optional[bool] = None,
+        dueling: Optional[bool] = None,
+        n_step: Optional[int] = None,
+        replay_buffer_config: Optional[Dict] = None,
+        num_steps_sampled_before_learning_starts: Optional[int] = None,
+        epsilon_timesteps: Optional[int] = None,
+        final_epsilon: Optional[float] = None,
+        **kwargs,
+    ) -> "DQNConfig":
+        super().training(**kwargs)
+        if target_network_update_freq is not None:
+            self.target_network_update_freq = target_network_update_freq
+        if double_q is not None:
+            self.double_q = double_q
+        if dueling is not None:
+            self.dueling = dueling
+        if n_step is not None:
+            self.n_step = n_step
+        if replay_buffer_config is not None:
+            self.replay_buffer_config.update(replay_buffer_config)
+        if num_steps_sampled_before_learning_starts is not None:
+            self.num_steps_sampled_before_learning_starts = (
+                num_steps_sampled_before_learning_starts
+            )
+        if epsilon_timesteps is not None:
+            self.epsilon_timesteps = epsilon_timesteps
+        if final_epsilon is not None:
+            self.final_epsilon = final_epsilon
+        return self
+
+
+def adjust_nstep(n_step: int, gamma: float, batch: SampleBatch) -> None:
+    """In-place n-step reward folding (reference
+    ``rllib/utils/replay_buffers/utils.py`` / dqn postprocessing):
+    rewards[t] ← sum_{k<n} gamma^k r[t+k], new_obs[t] ← obs[t+n] with
+    termination-aware truncation."""
+    n = batch.count
+    rewards = np.asarray(batch[SampleBatch.REWARDS], np.float32)
+    dones = np.asarray(batch[SampleBatch.TERMINATEDS], bool)
+    next_obs = np.asarray(batch[SampleBatch.NEXT_OBS])
+    new_rewards = rewards.copy()
+    new_next = next_obs.copy()
+    new_dones = dones.copy()
+    for t in range(n):
+        acc = rewards[t]
+        last = t
+        for k in range(1, n_step):
+            if t + k >= n or dones[last]:
+                break
+            acc += (gamma**k) * rewards[t + k]
+            last = t + k
+        new_rewards[t] = acc
+        new_next[t] = next_obs[last]
+        new_dones[t] = dones[last]
+    batch[SampleBatch.REWARDS] = new_rewards
+    batch[SampleBatch.NEXT_OBS] = new_next
+    batch[SampleBatch.TERMINATEDS] = new_dones
+
+
+class DQNJaxPolicy(JaxPolicy):
+    """Double/dueling TD loss (reference dqn_torch_policy.py)."""
+
+    def __init__(self, observation_space, action_space, config):
+        config = dict(config)
+        # model's "logits" head = per-action Q values (+ optional dueling
+        # value stream handled by vf head reuse)
+        super().__init__(observation_space, action_space, config)
+        self._epsilon_schedule = PiecewiseSchedule(
+            [
+                (0, config.get("initial_epsilon", 1.0)),
+                (
+                    config.get("epsilon_timesteps", 10000),
+                    config.get("final_epsilon", 0.02),
+                ),
+            ]
+        )
+        self.coeff_values["epsilon"] = float(self._epsilon_schedule(0))
+        self._steps_since_target_update = 0
+
+    def _init_aux_state(self):
+        return {"target_params": self.params}
+
+    def update_target(self) -> None:
+        """Copy online → target (reference update_target in
+        dqn_torch_policy)."""
+        self.aux_state = {"target_params": self.params}
+
+    def _update_scheduled_coeffs(self):
+        super()._update_scheduled_coeffs()
+        self.coeff_values["epsilon"] = float(
+            self._epsilon_schedule(self.global_timestep)
+        )
+
+    # -- inference: epsilon-greedy over Q --------------------------------
+
+    def _build_action_fn(self):
+        model = self.model
+
+        def fn(params, obs, states, rng, explore, epsilon):
+            q, value, state_out = model.apply(params, obs)
+            greedy = jnp.argmax(q, axis=-1)
+            if explore:
+                rng_e, rng_a = jax.random.split(rng)
+                random_actions = jax.random.randint(
+                    rng_a, greedy.shape, 0, q.shape[-1]
+                )
+                use_random = (
+                    jax.random.uniform(rng_e, greedy.shape) < epsilon
+                )
+                actions = jnp.where(use_random, random_actions, greedy)
+            else:
+                actions = greedy
+            extra = {"q_values": q}
+            return actions, state_out, extra
+
+        return jax.jit(fn, static_argnames=("explore",))
+
+    def compute_actions(
+        self,
+        obs_batch,
+        state_batches=None,
+        prev_action_batch=None,
+        prev_reward_batch=None,
+        explore: bool = True,
+        timestep: Optional[int] = None,
+        **kwargs,
+    ):
+        if self._action_fn is None:
+            self._action_fn = self._build_action_fn()
+        self.coeff_values["epsilon"] = float(
+            self._epsilon_schedule(self.global_timestep)
+        )
+        self._rng, rng = jax.random.split(self._rng)
+        actions, state_out, extra = self._action_fn(
+            self.params,
+            jnp.asarray(obs_batch),
+            tuple(state_batches or ()),
+            rng,
+            bool(explore),
+            jnp.asarray(self.coeff_values["epsilon"], jnp.float32),
+        )
+        return (
+            np.asarray(actions),
+            [np.asarray(s) for s in state_out],
+            {k: np.asarray(v) for k, v in extra.items()},
+        )
+
+    # -- loss ------------------------------------------------------------
+
+    def loss_with_aux(self, params, aux, batch, rng, coeffs):
+        cfg = self.config
+        gamma = cfg.get("gamma", 0.99)
+        n_step = cfg.get("n_step", 1)
+        target_params = aux["target_params"]
+
+        q_all, _, _ = self.model_forward(params, batch[SampleBatch.OBS])
+        q_next_target, _, _ = self.model_forward(
+            target_params, batch[SampleBatch.NEXT_OBS]
+        )
+        actions = batch[SampleBatch.ACTIONS].astype(jnp.int32)
+        q_sel = jnp.take_along_axis(
+            q_all, actions[:, None], axis=-1
+        ).squeeze(-1)
+
+        if cfg.get("double_q", True):
+            q_next_online, _, _ = self.model_forward(
+                params, batch[SampleBatch.NEXT_OBS]
+            )
+            next_actions = jnp.argmax(q_next_online, axis=-1)
+        else:
+            next_actions = jnp.argmax(q_next_target, axis=-1)
+        q_next = jnp.take_along_axis(
+            q_next_target, next_actions[:, None], axis=-1
+        ).squeeze(-1)
+
+        not_done = 1.0 - batch[SampleBatch.TERMINATEDS].astype(
+            jnp.float32
+        )
+        td_target = (
+            batch[SampleBatch.REWARDS]
+            + (gamma**n_step) * not_done * jax.lax.stop_gradient(q_next)
+        )
+        td_error = q_sel - jax.lax.stop_gradient(td_target)
+        # Huber loss (reference huber_loss, delta=1)
+        abs_err = jnp.abs(td_error)
+        huber = jnp.where(
+            abs_err < 1.0, 0.5 * jnp.square(td_error), abs_err - 0.5
+        )
+        weights = batch.get(
+            "weights", jnp.ones_like(huber)
+        )
+        loss = jnp.mean(weights * huber)
+        stats = {
+            "mean_q": jnp.mean(q_sel),
+            "mean_td_error": jnp.mean(td_error),
+            "max_q": jnp.max(q_all),
+        }
+        return loss, stats
+
+    def after_learn_on_batch(self, stats):
+        self._steps_since_target_update += 1
+        return {}
+
+
+class DQN(Algorithm):
+    _default_policy_class = DQNJaxPolicy
+
+    @classmethod
+    def get_default_config(cls) -> DQNConfig:
+        return DQNConfig(cls)
+
+    def setup(self, config: Dict) -> None:
+        super().setup(config)
+        rb_cfg = config.get("replay_buffer_config") or {}
+        self.local_replay_buffer = MultiAgentReplayBuffer(
+            capacity=rb_cfg.get("capacity", 50000),
+            prioritized=rb_cfg.get("prioritized_replay", False),
+            alpha=rb_cfg.get("prioritized_replay_alpha", 0.6),
+            seed=config.get("seed"),
+        )
+        self._last_target_update = 0
+
+    def training_step(self) -> Dict:
+        """reference dqn.py:336 (shared off-policy training_step)."""
+        config = self.config
+        batch = synchronous_parallel_sample(
+            worker_set=self.workers,
+            max_env_steps=config.get("rollout_fragment_length", 4)
+            * max(1, config.get("num_envs_per_worker", 1)),
+        )
+        n_step = config.get("n_step", 1)
+        if n_step > 1:
+            from ray_tpu.data.sample_batch import MultiAgentBatch
+
+            if isinstance(batch, MultiAgentBatch):
+                for b in batch.policy_batches.values():
+                    adjust_nstep(n_step, config["gamma"], b)
+            else:
+                adjust_nstep(n_step, config["gamma"], batch)
+        self._counters[NUM_ENV_STEPS_SAMPLED] += batch.env_steps()
+        self.local_replay_buffer.add(batch)
+
+        train_info = {}
+        if (
+            self._counters[NUM_ENV_STEPS_SAMPLED]
+            >= config.get("num_steps_sampled_before_learning_starts", 0)
+            and len(self.local_replay_buffer) > 0
+        ):
+            rb_cfg = config.get("replay_buffer_config") or {}
+            prioritized = rb_cfg.get("prioritized_replay", False)
+            kwargs = (
+                {"beta": rb_cfg.get("prioritized_replay_beta", 0.4)}
+                if prioritized
+                else {}
+            )
+            train_batch = self.local_replay_buffer.sample(
+                config["train_batch_size"], **kwargs
+            )
+            for pid, b in train_batch.policy_batches.items():
+                policy = self.get_policy(pid)
+                info = policy.learn_on_batch(b)
+                train_info[pid] = info
+                if prioritized:
+                    buf = self.local_replay_buffer.buffers[pid]
+                    if isinstance(buf, PrioritizedReplayBuffer):
+                        td = abs(info.get("mean_td_error", 0.0))
+                        buf.update_priorities(
+                            b["batch_indexes"],
+                            np.full(
+                                len(b["batch_indexes"]), td + 1e-6
+                            ),
+                        )
+                self._counters[NUM_ENV_STEPS_TRAINED] += b.count
+            # target network sync
+            if (
+                self._counters[NUM_ENV_STEPS_TRAINED]
+                - self._last_target_update
+                >= config.get("target_network_update_freq", 500)
+            ):
+                for pid in self.workers.local_worker().policy_map:
+                    self.get_policy(pid).update_target()
+                self._last_target_update = self._counters[
+                    NUM_ENV_STEPS_TRAINED
+                ]
+                self._counters["num_target_updates"] += 1
+
+        self.workers.sync_weights(
+            global_vars={
+                "timestep": self._counters[NUM_ENV_STEPS_SAMPLED]
+            }
+        )
+        return train_info
+
+
+class SimpleQConfig(DQNConfig):
+    """reference simple_q.py:256 — DQN without double/dueling/n-step."""
+
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class or SimpleQ)
+        self.double_q = False
+        self.dueling = False
+        self.n_step = 1
+
+
+class SimpleQ(DQN):
+    @classmethod
+    def get_default_config(cls) -> SimpleQConfig:
+        return SimpleQConfig(cls)

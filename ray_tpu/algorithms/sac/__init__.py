@@ -1,0 +1,3 @@
+from ray_tpu.algorithms.sac.sac import SAC, SACConfig, SACJaxPolicy
+
+__all__ = ["SAC", "SACConfig", "SACJaxPolicy"]

@@ -1,0 +1,435 @@
+"""SAC: soft actor-critic with twin Q, auto-tuned entropy temperature.
+
+Counterpart of the reference's ``rllib/algorithms/sac/sac.py:274`` (config;
+SAC extends DQN's off-policy training_step) and
+``sac_torch_policy.py`` (actor/critic/alpha losses with three optimizers).
+TPU-first: the whole update — critic step, actor step, alpha step, polyak
+target blend — is ONE jitted shard_map program; the three optimizers are
+three optax states advanced inside it.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import PartitionSpec as P
+
+from ray_tpu.algorithms.algorithm_config import AlgorithmConfig
+from ray_tpu.algorithms.dqn.dqn import DQN, DQNConfig
+from ray_tpu.data.sample_batch import SampleBatch
+from ray_tpu.models.base import get_activation
+from ray_tpu.models.distributions import SquashedGaussian
+from ray_tpu.policy.jax_policy import JaxPolicy, _tree_to_device
+
+
+class _ActorNet(nn.Module):
+    action_dim: int
+    hiddens: Sequence[int] = (256, 256)
+    activation: str = "relu"
+
+    @nn.compact
+    def __call__(self, obs):
+        act = get_activation(self.activation)
+        x = obs.astype(jnp.float32).reshape(obs.shape[0], -1)
+        for i, h in enumerate(self.hiddens):
+            x = act(nn.Dense(h, name=f"fc_{i}")(x))
+        return nn.Dense(2 * self.action_dim, name="out")(x)
+
+
+class _TwinQNet(nn.Module):
+    hiddens: Sequence[int] = (256, 256)
+    activation: str = "relu"
+
+    @nn.compact
+    def __call__(self, obs, actions):
+        act = get_activation(self.activation)
+        x0 = jnp.concatenate(
+            [
+                obs.astype(jnp.float32).reshape(obs.shape[0], -1),
+                actions.astype(jnp.float32).reshape(
+                    actions.shape[0], -1
+                ),
+            ],
+            axis=-1,
+        )
+        qs = []
+        for name in ("q1", "q2"):
+            x = x0
+            for i, h in enumerate(self.hiddens):
+                x = act(nn.Dense(h, name=f"{name}_fc_{i}")(x))
+            qs.append(nn.Dense(1, name=f"{name}_out")(x).squeeze(-1))
+        return qs[0], qs[1]
+
+
+class SACConfig(DQNConfig):
+    """reference sac.py:274."""
+
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class or SAC)
+        self.twin_q = True
+        self.tau = 5e-3
+        self.initial_alpha = 1.0
+        self.target_entropy = "auto"
+        self.optimization = {
+            "actor_learning_rate": 3e-4,
+            "critic_learning_rate": 3e-4,
+            "entropy_learning_rate": 3e-4,
+        }
+        self.train_batch_size = 256
+        self.rollout_fragment_length = 1
+        self.num_steps_sampled_before_learning_starts = 1500
+        self.target_network_update_freq = 0
+        self.q_model_config = {"fcnet_hiddens": [256, 256]}
+        self.policy_model_config = {"fcnet_hiddens": [256, 256]}
+        self.n_step = 1
+        self.grad_clip = None
+        self.replay_buffer_config = {
+            "capacity": 100000,
+            "prioritized_replay": False,
+            "prioritized_replay_alpha": 0.6,
+            "prioritized_replay_beta": 0.4,
+        }
+
+    def training(
+        self,
+        *,
+        twin_q: Optional[bool] = None,
+        tau: Optional[float] = None,
+        initial_alpha: Optional[float] = None,
+        target_entropy=None,
+        optimization: Optional[Dict] = None,
+        q_model_config: Optional[Dict] = None,
+        policy_model_config: Optional[Dict] = None,
+        **kwargs,
+    ) -> "SACConfig":
+        super().training(**kwargs)
+        if twin_q is not None:
+            self.twin_q = twin_q
+        if tau is not None:
+            self.tau = tau
+        if initial_alpha is not None:
+            self.initial_alpha = initial_alpha
+        if target_entropy is not None:
+            self.target_entropy = target_entropy
+        if optimization is not None:
+            self.optimization.update(optimization)
+        if q_model_config is not None:
+            self.q_model_config = q_model_config
+        if policy_model_config is not None:
+            self.policy_model_config = policy_model_config
+        return self
+
+
+class SACJaxPolicy(JaxPolicy):
+    """Actor/critic/alpha losses fused into one jitted update
+    (reference sac_torch_policy.py actor_critic_loss + three optimizers)."""
+
+    def __init__(self, observation_space, action_space, config):
+        # Bypass JaxPolicy model construction: SAC has its own nets.
+        from ray_tpu.policy.policy import Policy
+        from ray_tpu.parallel import mesh as mesh_lib
+
+        Policy.__init__(self, observation_space, action_space, config)
+        self.action_dim = int(np.prod(action_space.shape))
+        self.low = float(np.min(action_space.low))
+        self.high = float(np.max(action_space.high))
+
+        self.mesh = config.get("_mesh") or mesh_lib.make_mesh()
+        self.n_shards = mesh_lib.num_data_shards(self.mesh)
+        self._param_sharding = mesh_lib.replicated(self.mesh)
+        self._data_sharding = mesh_lib.data_sharding(self.mesh)
+
+        pm_cfg = config.get("policy_model_config") or {}
+        qm_cfg = config.get("q_model_config") or {}
+        self.actor = _ActorNet(
+            self.action_dim,
+            tuple(pm_cfg.get("fcnet_hiddens", (256, 256))),
+            pm_cfg.get("fcnet_activation", "relu"),
+        )
+        self.critic = _TwinQNet(
+            tuple(qm_cfg.get("fcnet_hiddens", (256, 256))),
+            qm_cfg.get("fcnet_activation", "relu"),
+        )
+
+        seed = int(config.get("seed") or 0)
+        self._rng = jax.random.PRNGKey(seed)
+        self._rng, r1, r2 = jax.random.split(self._rng, 3)
+        obs_shape = observation_space.shape
+        dummy_obs = jnp.zeros((2,) + tuple(obs_shape), jnp.float32)
+        dummy_act = jnp.zeros((2, self.action_dim), jnp.float32)
+        actor_params = self.actor.init(r1, dummy_obs)
+        critic_params = self.critic.init(r2, dummy_obs, dummy_act)
+        log_alpha = jnp.asarray(
+            np.log(config.get("initial_alpha", 1.0)), jnp.float32
+        )
+        self.params = _tree_to_device(
+            {
+                "actor": actor_params,
+                "critic": critic_params,
+                "log_alpha": log_alpha,
+            },
+            self._param_sharding,
+        )
+        self.aux_state = _tree_to_device(
+            {"target_critic": critic_params}, self._param_sharding
+        )
+
+        opt = config.get("optimization") or {}
+        self._tx_actor = optax.adam(opt.get("actor_learning_rate", 3e-4))
+        self._tx_critic = optax.adam(
+            opt.get("critic_learning_rate", 3e-4)
+        )
+        self._tx_alpha = optax.adam(
+            opt.get("entropy_learning_rate", 3e-4)
+        )
+        self.opt_state = _tree_to_device(
+            {
+                "actor": self._tx_actor.init(self.params["actor"]),
+                "critic": self._tx_critic.init(self.params["critic"]),
+                "log_alpha": self._tx_alpha.init(
+                    self.params["log_alpha"]
+                ),
+            },
+            self._param_sharding,
+        )
+
+        te = config.get("target_entropy", "auto")
+        self.target_entropy = (
+            -float(self.action_dim) if te in (None, "auto") else float(te)
+        )
+        self.tau = float(config.get("tau", 5e-3))
+        self.gamma = float(config.get("gamma", 0.99))
+        self.n_step = int(config.get("n_step", 1))
+
+        self.coeff_values = {}
+        self._learn_fns = {}
+        self._action_fn = None
+        self.num_grad_updates = 0
+
+    def get_initial_state(self):
+        return []
+
+    # -- inference -------------------------------------------------------
+
+    def _build_action_fn(self):
+        actor = self.actor
+        low, high = self.low, self.high
+
+        def fn(params, obs, states, rng, explore):
+            dist_inputs = actor.apply(params["actor"], obs)
+            dist = SquashedGaussian(dist_inputs, low=low, high=high)
+            if explore:
+                actions, logp = dist.sampled_action_logp(rng)
+            else:
+                actions = dist.deterministic_sample()
+                logp = dist.logp(actions)
+            return actions, (), {SampleBatch.ACTION_LOGP: logp}
+
+        return jax.jit(fn, static_argnames=("explore",))
+
+    def compute_actions(
+        self, obs_batch, state_batches=None, explore=True, **kwargs
+    ):
+        if self._action_fn is None:
+            self._action_fn = self._build_action_fn()
+        self._rng, rng = jax.random.split(self._rng)
+        actions, state_out, extra = self._action_fn(
+            self.params, jnp.asarray(obs_batch), (), rng, bool(explore)
+        )
+        return (
+            np.asarray(actions),
+            [],
+            {k: np.asarray(v) for k, v in extra.items()},
+        )
+
+    # -- learning --------------------------------------------------------
+
+    def _build_learn_fn(self, batch_size: int):
+        actor, critic = self.actor, self.critic
+        tx_a, tx_c, tx_al = (
+            self._tx_actor,
+            self._tx_critic,
+            self._tx_alpha,
+        )
+        gamma, tau = self.gamma**self.n_step, self.tau
+        target_entropy = self.target_entropy
+        low, high = self.low, self.high
+        mesh = self.mesh
+
+        def device_fn(params, opt_state, aux, batch, rng, coeffs):
+            obs = batch[SampleBatch.OBS].astype(jnp.float32)
+            next_obs = batch[SampleBatch.NEXT_OBS].astype(jnp.float32)
+            rewards = batch[SampleBatch.REWARDS].astype(jnp.float32)
+            not_done = 1.0 - batch[SampleBatch.TERMINATEDS].astype(
+                jnp.float32
+            )
+            actions = batch[SampleBatch.ACTIONS].astype(jnp.float32)
+            rng = jax.random.fold_in(
+                rng, jax.lax.axis_index("data")
+            )
+            rng_t, rng_a = jax.random.split(rng)
+            alpha = jnp.exp(params["log_alpha"])
+
+            # ---- critic update ----
+            next_dist = SquashedGaussian(
+                actor.apply(params["actor"], next_obs), low=low, high=high
+            )
+            next_a, next_logp = next_dist.sampled_action_logp(rng_t)
+            tq1, tq2 = critic.apply(
+                aux["target_critic"], next_obs, next_a
+            )
+            target_q = jnp.minimum(tq1, tq2) - alpha * next_logp
+            td_target = jax.lax.stop_gradient(
+                rewards + gamma * not_done * target_q
+            )
+
+            def critic_loss(cp):
+                q1, q2 = critic.apply(cp, obs, actions)
+                return (
+                    jnp.mean(jnp.square(q1 - td_target))
+                    + jnp.mean(jnp.square(q2 - td_target))
+                ), (q1, q2)
+
+            (c_loss, (q1, q2)), c_grads = jax.value_and_grad(
+                critic_loss, has_aux=True
+            )(params["critic"])
+            c_grads = jax.lax.pmean(c_grads, "data")
+            c_upd, c_opt = tx_c.update(
+                c_grads, opt_state["critic"], params["critic"]
+            )
+            new_critic = optax.apply_updates(params["critic"], c_upd)
+
+            # ---- actor update (uses the fresh critic) ----
+            def actor_loss(ap):
+                dist = SquashedGaussian(
+                    actor.apply(ap, obs), low=low, high=high
+                )
+                a, logp = dist.sampled_action_logp(rng_a)
+                aq1, aq2 = critic.apply(new_critic, obs, a)
+                return jnp.mean(
+                    alpha * logp - jnp.minimum(aq1, aq2)
+                ), logp
+
+            (a_loss, logp_pi), a_grads = jax.value_and_grad(
+                actor_loss, has_aux=True
+            )(params["actor"])
+            a_grads = jax.lax.pmean(a_grads, "data")
+            a_upd, a_opt = tx_a.update(
+                a_grads, opt_state["actor"], params["actor"]
+            )
+            new_actor = optax.apply_updates(params["actor"], a_upd)
+
+            # ---- alpha update ----
+            def alpha_loss(log_alpha):
+                return -jnp.mean(
+                    log_alpha
+                    * jax.lax.stop_gradient(logp_pi + target_entropy)
+                )
+
+            al_loss, al_grad = jax.value_and_grad(alpha_loss)(
+                params["log_alpha"]
+            )
+            al_grad = jax.lax.pmean(al_grad, "data")
+            al_upd, al_opt = tx_al.update(
+                al_grad, opt_state["log_alpha"], params["log_alpha"]
+            )
+            new_log_alpha = optax.apply_updates(
+                params["log_alpha"], al_upd
+            )
+
+            # ---- polyak target blend (reference tau soft update) ----
+            new_target = jax.tree_util.tree_map(
+                lambda t, o: (1.0 - tau) * t + tau * o,
+                aux["target_critic"],
+                new_critic,
+            )
+
+            new_params = {
+                "actor": new_actor,
+                "critic": new_critic,
+                "log_alpha": new_log_alpha,
+            }
+            new_opt = {
+                "actor": a_opt,
+                "critic": c_opt,
+                "log_alpha": al_opt,
+            }
+            new_aux = {"target_critic": new_target}
+            stats = {
+                "actor_loss": a_loss,
+                "critic_loss": c_loss,
+                "alpha_loss": al_loss,
+                "alpha_value": alpha,
+                "mean_q": jnp.mean(jnp.minimum(q1, q2)),
+                "total_loss": a_loss + c_loss + al_loss,
+            }
+            stats = jax.tree_util.tree_map(
+                lambda x: jax.lax.pmean(x, "data"), stats
+            )
+            return new_params, new_opt, new_aux, stats
+
+        sharded = jax.shard_map(
+            device_fn,
+            mesh=mesh,
+            in_specs=(P(), P(), P(), P("data"), P(), P()),
+            out_specs=(P(), P(), P(), P()),
+        )
+        return jax.jit(sharded, donate_argnums=(1,))
+
+    def learn_on_batch(self, samples: SampleBatch) -> Dict:
+        batch = self._batch_to_train_tree(samples)
+        bsize = int(next(iter(batch.values())).shape[0])
+        if bsize < self.n_shards:
+            reps = -(-self.n_shards // bsize)
+            batch = {
+                k: np.tile(v, (reps,) + (1,) * (v.ndim - 1))[
+                    : self.n_shards
+                ]
+                for k, v in batch.items()
+            }
+            bsize = self.n_shards
+        else:
+            trim = (bsize // self.n_shards) * self.n_shards
+            batch = {k: v[:trim] for k, v in batch.items()}
+            bsize = trim
+        fn = self._learn_fns.get(bsize)
+        if fn is None:
+            fn = self._build_learn_fn(bsize)
+            self._learn_fns[bsize] = fn
+        self._rng, rng = jax.random.split(self._rng)
+        batch_dev = jax.device_put(batch, self._data_sharding)
+        self.params, self.opt_state, self.aux_state, stats = fn(
+            self.params, self.opt_state, self.aux_state, batch_dev,
+            rng, {},
+        )
+        self.num_grad_updates += 1
+        stats = jax.device_get(stats)
+        return {k: float(v) for k, v in stats.items()}
+
+    def update_target(self) -> None:
+        """No-op: polyak blending happens inside the learn program."""
+
+    def _batch_to_train_tree(self, samples: SampleBatch):
+        keys = [
+            SampleBatch.OBS,
+            SampleBatch.NEXT_OBS,
+            SampleBatch.ACTIONS,
+            SampleBatch.REWARDS,
+            SampleBatch.TERMINATEDS,
+        ]
+        return {
+            k: np.asarray(samples[k]) for k in keys if k in samples
+        }
+
+
+class SAC(DQN):
+    _default_policy_class = SACJaxPolicy
+
+    @classmethod
+    def get_default_config(cls) -> SACConfig:
+        return SACConfig(cls)

@@ -119,11 +119,18 @@ class JaxPolicy(Policy):
         self._action_fn = None
         self._value_fn = None
         self.num_grad_updates = 0
+        # Replicated non-gradient state (target networks etc).
+        self.aux_state: Dict[str, Any] = self._init_aux_state()
 
     # -- subclass hooks --------------------------------------------------
 
     def _init_coeffs(self) -> None:
         """Subclasses add extra coefficients to self.coeff_values."""
+
+    def _init_aux_state(self) -> Dict[str, Any]:
+        """Subclasses return initial aux (non-gradient) state, e.g.
+        target-network params."""
+        return {}
 
     def loss(
         self,
@@ -133,6 +140,13 @@ class JaxPolicy(Policy):
         coeffs: Dict[str, jnp.ndarray],
     ) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
         raise NotImplementedError
+
+    def loss_with_aux(self, params, aux, batch, rng, coeffs):
+        """Loss entry point inside the learn program. ``aux`` is the
+        replicated non-gradient state (e.g. target-network params for
+        DQN/SAC — the reference keeps these as separate torch modules);
+        base policies ignore it."""
+        return self.loss(params, batch, rng, coeffs)
 
     def extra_action_out(
         self, dist_inputs, value, dist, rng
@@ -269,9 +283,9 @@ class JaxPolicy(Policy):
         num_iters = self.num_sgd_iter
         tx = self._tx
         mesh = self.mesh
-        loss_fn = self.loss
+        loss_fn = self.loss_with_aux
 
-        def device_fn(params, opt_state, batch, rng, coeffs):
+        def device_fn(params, opt_state, aux, batch, rng, coeffs):
             # Different shuffle stream per data shard.
             rng = jax.random.fold_in(rng, jax.lax.axis_index("data"))
 
@@ -281,7 +295,7 @@ class JaxPolicy(Policy):
                 mb = jax.tree_util.tree_map(lambda x: x[idx], batch)
                 (loss, stats), grads = jax.value_and_grad(
                     loss_fn, has_aux=True
-                )(params, mb, mb_rng, coeffs)
+                )(params, aux, mb, mb_rng, coeffs)
                 grads = jax.lax.pmean(grads, "data")
                 updates, opt_state = tx.update(grads, opt_state, params)
                 lr = coeffs["lr"]
@@ -316,7 +330,7 @@ class JaxPolicy(Policy):
         sharded = jax.shard_map(
             device_fn,
             mesh=mesh,
-            in_specs=(P(), P(), P("data"), P(), P()),
+            in_specs=(P(), P(), P(), P("data"), P(), P()),
             out_specs=(P(), P(), P()),
         )
         # Donate only opt_state: params buffers must stay valid because an
@@ -353,7 +367,12 @@ class JaxPolicy(Policy):
         self._rng, rng = jax.random.split(self._rng)
         batch = _tree_to_device(batch, self._data_sharding)
         self.params, self.opt_state, stats = fn(
-            self.params, self.opt_state, batch, rng, self._coeff_array()
+            self.params,
+            self.opt_state,
+            self.aux_state,
+            batch,
+            rng,
+            self._coeff_array(),
         )
         self.num_grad_updates += self.num_sgd_iter * max(
             1, bsize // max(1, self.minibatch_size)
@@ -384,19 +403,19 @@ class JaxPolicy(Policy):
 
     def compute_gradients(self, samples: SampleBatch):
         if not hasattr(self, "_grad_fn"):
-            loss_fn = self.loss
+            loss_fn = self.loss_with_aux
 
-            def gfn(params, batch, rng, coeffs):
+            def gfn(params, aux, batch, rng, coeffs):
                 (loss, stats), grads = jax.value_and_grad(
                     loss_fn, has_aux=True
-                )(params, batch, rng, coeffs)
+                )(params, aux, batch, rng, coeffs)
                 return grads, dict(stats, total_loss=loss)
 
             self._grad_fn = jax.jit(gfn)
         batch = self._batch_to_train_tree(samples)
         self._rng, rng = jax.random.split(self._rng)
         grads, stats = self._grad_fn(
-            self.params, batch, rng, self._coeff_array()
+            self.params, self.aux_state, batch, rng, self._coeff_array()
         )
         return jax.device_get(grads), {k: float(v) for k, v in stats.items()}
 
