@@ -59,8 +59,23 @@ class _ObjArg:
 
 def worker_main(conn, worker_id: str, env_overrides: Dict[str, str]):
     """Entry point for spawned worker processes."""
-    os.environ.setdefault("JAX_PLATFORMS", "cpu")
     os.environ.update(env_overrides or {})
+    # Rollout workers must never claim the accelerator — it belongs to
+    # the driver/learner. The inherited env (and the image's
+    # sitecustomize, which registers the TPU PJRT plugin in every
+    # python process) may pin jax to the TPU, so force the platform at
+    # the config level. Override via worker_env={"RAY_TPU_WORKER_PLATFORM":
+    # ...} in ray.init for workers that legitimately need a device.
+    platform = (env_overrides or {}).get(
+        "RAY_TPU_WORKER_PLATFORM", "cpu"
+    )
+    os.environ["JAX_PLATFORMS"] = platform
+    try:
+        import jax
+
+        jax.config.update("jax_platforms", platform)
+    except Exception:
+        pass
 
     from ray_tpu.core import serialization as ser
 

@@ -1,0 +1,34 @@
+from ray_tpu.tune.trainable import Trainable
+from ray_tpu.tune.trial import Trial
+from ray_tpu.tune.trial_runner import TrialRunner
+from ray_tpu.tune.tune import run, ExperimentAnalysis
+from ray_tpu.tune.schedulers import (
+    FIFOScheduler,
+    AsyncHyperBandScheduler,
+    PopulationBasedTraining,
+)
+from ray_tpu.tune.search import (
+    grid_search,
+    uniform,
+    loguniform,
+    choice,
+    randint,
+    sample_from,
+)
+
+__all__ = [
+    "Trainable",
+    "Trial",
+    "TrialRunner",
+    "run",
+    "ExperimentAnalysis",
+    "FIFOScheduler",
+    "AsyncHyperBandScheduler",
+    "PopulationBasedTraining",
+    "grid_search",
+    "uniform",
+    "loguniform",
+    "choice",
+    "randint",
+    "sample_from",
+]

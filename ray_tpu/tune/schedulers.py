@@ -1,0 +1,189 @@
+"""Trial schedulers: FIFO, ASHA, PBT.
+
+Counterpart of the reference's ``ray/tune/schedulers/``
+(``async_hyperband.py`` AsyncHyperBandScheduler, ``pbt.py``
+PopulationBasedTraining).
+"""
+
+from __future__ import annotations
+
+import copy
+import random
+from typing import Dict, List, Optional
+
+CONTINUE = "CONTINUE"
+STOP = "STOP"
+PAUSE = "PAUSE"
+
+
+class TrialScheduler:
+    def on_trial_result(self, runner, trial, result: Dict) -> str:
+        return CONTINUE
+
+    def on_trial_complete(self, runner, trial, result: Dict) -> None:
+        pass
+
+
+class FIFOScheduler(TrialScheduler):
+    pass
+
+
+class _Bracket:
+    """One ASHA bracket: rungs at min_t * reduction^k."""
+
+    def __init__(self, min_t: int, max_t: int, reduction_factor: float):
+        self.rf = reduction_factor
+        self.rungs: List[Dict] = []
+        t = min_t
+        while t < max_t:
+            self.rungs.append({"milestone": t, "recorded": {}})
+            t = int(t * reduction_factor)
+        self.rungs = self.rungs[::-1]  # highest milestone first
+
+    def on_result(self, trial_id: str, cur_iter: int, metric: float) -> str:
+        action = CONTINUE
+        for rung in self.rungs:
+            if (
+                cur_iter >= rung["milestone"]
+                and trial_id not in rung["recorded"]
+            ):
+                rung["recorded"][trial_id] = metric
+                vals = list(rung["recorded"].values())
+                if len(vals) >= 2:
+                    import numpy as np
+
+                    cutoff = np.percentile(
+                        vals, (1 - 1 / self.rf) * 100
+                    )
+                    if metric < cutoff:
+                        action = STOP
+                break
+        return action
+
+
+class AsyncHyperBandScheduler(TrialScheduler):
+    """ASHA (reference schedulers/async_hyperband.py)."""
+
+    def __init__(
+        self,
+        metric: str = "episode_reward_mean",
+        mode: str = "max",
+        time_attr: str = "training_iteration",
+        max_t: int = 100,
+        grace_period: int = 1,
+        reduction_factor: float = 4,
+    ):
+        self.metric = metric
+        self.mode = mode
+        self.time_attr = time_attr
+        self.max_t = max_t
+        self._bracket = _Bracket(grace_period, max_t, reduction_factor)
+
+    def on_trial_result(self, runner, trial, result: Dict) -> str:
+        cur = result.get(self.time_attr, 0)
+        metric = result.get(self.metric)
+        if metric is None:
+            return CONTINUE
+        if self.mode == "min":
+            metric = -metric
+        if cur >= self.max_t:
+            return STOP
+        return self._bracket.on_result(trial.trial_id, cur, metric)
+
+
+class PopulationBasedTraining(TrialScheduler):
+    """PBT (reference schedulers/pbt.py): at each perturbation interval,
+    bottom-quantile trials clone the weights + hyperparams of a
+    top-quantile trial, with hyperparams resampled/perturbed."""
+
+    def __init__(
+        self,
+        metric: str = "episode_reward_mean",
+        mode: str = "max",
+        time_attr: str = "training_iteration",
+        perturbation_interval: int = 4,
+        hyperparam_mutations: Optional[Dict] = None,
+        quantile_fraction: float = 0.25,
+        resample_probability: float = 0.25,
+        seed: int = 0,
+    ):
+        self.metric = metric
+        self.mode = mode
+        self.time_attr = time_attr
+        self.interval = perturbation_interval
+        self.mutations = hyperparam_mutations or {}
+        self.quantile = quantile_fraction
+        self.resample_probability = resample_probability
+        self._rng = random.Random(seed)
+        self._last_perturb: Dict[str, int] = {}
+        self.num_perturbations = 0
+
+    def _score(self, trial) -> float:
+        v = trial.last_result.get(self.metric, float("-inf"))
+        return -v if self.mode == "min" else v
+
+    def on_trial_result(self, runner, trial, result: Dict) -> str:
+        t = result.get(self.time_attr, 0)
+        last = self._last_perturb.get(trial.trial_id, 0)
+        if t - last < self.interval:
+            return CONTINUE
+        self._last_perturb[trial.trial_id] = t
+
+        trials = [
+            tr
+            for tr in runner.trials
+            if tr.last_result and tr.status != "ERROR"
+        ]
+        if len(trials) < 2:
+            return CONTINUE
+        ranked = sorted(trials, key=self._score, reverse=True)
+        n_q = max(1, int(len(ranked) * self.quantile))
+        top, bottom = ranked[:n_q], ranked[-n_q:]
+        if trial in bottom and trial not in top:
+            donor = self._rng.choice(top)
+            self._exploit_and_explore(trial, donor)
+        return CONTINUE
+
+    def _exploit_and_explore(self, trial, donor) -> None:
+        # exploit: copy weights through a checkpoint
+        if donor.runner is not None and trial.runner is not None:
+            state = donor.runner.__getstate__() if hasattr(
+                donor.runner, "__getstate__"
+            ) else None
+            if state is not None:
+                try:
+                    trial.runner.__setstate__(copy.deepcopy(state))
+                except Exception:
+                    pass
+        # explore: perturb mutated hyperparams
+        new_config = copy.deepcopy(donor.config)
+        for key, spec in self.mutations.items():
+            if self._rng.random() < self.resample_probability:
+                if callable(spec):
+                    new_config[key] = spec()
+                elif isinstance(spec, list):
+                    new_config[key] = self._rng.choice(spec)
+            else:
+                factor = self._rng.choice([0.8, 1.2])
+                base = donor.config.get(key)
+                if isinstance(base, (int, float)):
+                    new_config[key] = type(base)(base * factor)
+        trial.config = new_config
+        # push mutated scalars into the live policy where possible
+        if trial.runner is not None and hasattr(
+            trial.runner, "get_policy"
+        ):
+            try:
+                pol = trial.runner.get_policy()
+                if "lr" in new_config:
+                    pol.coeff_values["lr"] = float(new_config["lr"])
+                pol.config.update(
+                    {
+                        k: v
+                        for k, v in new_config.items()
+                        if not isinstance(v, dict)
+                    }
+                )
+            except Exception:
+                pass
+        self.num_perturbations += 1

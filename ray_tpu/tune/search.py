@@ -1,0 +1,153 @@
+"""Search-space DSL + variant generation.
+
+Counterpart of the reference's ``ray/tune/sample.py`` (grid_search,
+uniform/choice/... distributions) and ``ray/tune/suggest/variant_generator.py``
+(resolving a config dict into concrete trial variants).
+"""
+
+from __future__ import annotations
+
+import itertools
+import random
+from typing import Any, Callable, Dict, List
+
+
+class Domain:
+    def sample(self, rng: random.Random) -> Any:
+        raise NotImplementedError
+
+
+class Uniform(Domain):
+    def __init__(self, low, high):
+        self.low, self.high = low, high
+
+    def sample(self, rng):
+        return rng.uniform(self.low, self.high)
+
+
+class LogUniform(Domain):
+    def __init__(self, low, high):
+        import math
+
+        self.log_low, self.log_high = math.log(low), math.log(high)
+
+    def sample(self, rng):
+        import math
+
+        return math.exp(rng.uniform(self.log_low, self.log_high))
+
+
+class Randint(Domain):
+    def __init__(self, low, high):
+        self.low, self.high = low, high
+
+    def sample(self, rng):
+        return rng.randrange(self.low, self.high)
+
+
+class Choice(Domain):
+    def __init__(self, categories):
+        self.categories = list(categories)
+
+    def sample(self, rng):
+        return rng.choice(self.categories)
+
+
+class Function(Domain):
+    def __init__(self, fn: Callable):
+        self.fn = fn
+
+    def sample(self, rng):
+        return self.fn(None)
+
+
+def uniform(low, high) -> Uniform:
+    return Uniform(low, high)
+
+
+def loguniform(low, high) -> LogUniform:
+    return LogUniform(low, high)
+
+
+def randint(low, high) -> Randint:
+    return Randint(low, high)
+
+
+def choice(categories) -> Choice:
+    return Choice(categories)
+
+
+def sample_from(fn) -> Function:
+    return Function(fn)
+
+
+def grid_search(values: List) -> Dict:
+    """reference tune/sample.py grid_search marker."""
+    return {"grid_search": list(values)}
+
+
+def _find_grid_axes(config: Dict, prefix=()) -> List:
+    axes = []
+    for k, v in config.items():
+        if isinstance(v, dict) and "grid_search" in v:
+            axes.append((prefix + (k,), v["grid_search"]))
+        elif isinstance(v, dict):
+            axes.extend(_find_grid_axes(v, prefix + (k,)))
+    return axes
+
+
+def _set_path(d: Dict, path, value):
+    for k in path[:-1]:
+        d = d[k]
+    d[path[-1]] = value
+
+
+def _resolve_domains(config: Dict, rng: random.Random):
+    for k, v in config.items():
+        if isinstance(v, Domain):
+            config[k] = v.sample(rng)
+        elif isinstance(v, dict) and "grid_search" not in v:
+            _resolve_domains(v, rng)
+
+
+def generate_variants(
+    config: Dict, num_samples: int = 1, seed: int = 0
+) -> List[Dict]:
+    """Expand grid_search axes × num_samples random resolutions
+    (reference variant_generator.generate_variants)."""
+    import copy
+
+    rng = random.Random(seed)
+    axes = _find_grid_axes(config)
+    grid_values = (
+        itertools.product(*[vals for _, vals in axes])
+        if axes
+        else [()]
+    )
+    variants = []
+    for combo in grid_values:
+        for _ in range(num_samples):
+            c = copy.deepcopy(config)
+            for (path, _), val in zip(axes, combo):
+                _set_path(c, path, val)
+            _resolve_domains(c, rng)
+            variants.append(c)
+    return variants
+
+
+class BasicVariantGenerator:
+    """reference tune/suggest/basic_variant.py."""
+
+    def __init__(self, config: Dict, num_samples: int = 1, seed: int = 0):
+        self._variants = generate_variants(config, num_samples, seed)
+        self._i = 0
+
+    def next_variant(self):
+        if self._i >= len(self._variants):
+            return None
+        v = self._variants[self._i]
+        self._i += 1
+        return v
+
+    def __len__(self):
+        return len(self._variants)

@@ -1,0 +1,41 @@
+"""Trial: one configuration's lifecycle record
+(reference ``ray/tune/experiment/trial.py``)."""
+
+from __future__ import annotations
+
+import uuid
+from typing import Any, Dict, Optional
+
+PENDING = "PENDING"
+RUNNING = "RUNNING"
+PAUSED = "PAUSED"
+TERMINATED = "TERMINATED"
+ERROR = "ERROR"
+
+
+class Trial:
+    def __init__(self, trainable_name: str, config: Dict,
+                 stopping_criterion: Optional[Dict] = None,
+                 trial_id: Optional[str] = None):
+        self.trainable_name = trainable_name
+        self.config = config
+        self.stopping_criterion = stopping_criterion or {}
+        self.trial_id = trial_id or uuid.uuid4().hex[:8]
+        self.status = PENDING
+        self.runner = None  # the Trainable instance
+        self.last_result: Dict[str, Any] = {}
+        self.results: list = []
+        self.checkpoint_path: Optional[str] = None
+        self.error: Optional[str] = None
+
+    def should_stop(self, result: Dict) -> bool:
+        for k, v in self.stopping_criterion.items():
+            if result.get(k, float("-inf")) >= v:
+                return True
+        return False
+
+    def __repr__(self):
+        return (
+            f"Trial({self.trainable_name}_{self.trial_id}, "
+            f"{self.status})"
+        )

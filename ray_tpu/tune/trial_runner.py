@@ -1,0 +1,5 @@
+"""Re-export (the runner lives in tune.py beside run())."""
+
+from ray_tpu.tune.tune import TrialRunner
+
+__all__ = ["TrialRunner"]

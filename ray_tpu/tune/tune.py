@@ -1,0 +1,213 @@
+"""tune.run: experiment runner.
+
+Counterpart of the reference's ``ray/tune/tune.py:118`` (tune.run) +
+``tune/execution/trial_runner.py:226`` (TrialRunner.step :793). Trials run
+time-sliced in-process (one TPU learner per host; the reference's
+placement-group-per-trial model maps to sequential mesh occupancy here),
+which preserves ASHA/PBT semantics: every trial advances one
+``train()`` per scheduling round.
+"""
+
+from __future__ import annotations
+
+import os
+import traceback
+from typing import Any, Dict, List, Optional, Type, Union
+
+from ray_tpu.tune.schedulers import (
+    CONTINUE,
+    STOP,
+    FIFOScheduler,
+    TrialScheduler,
+)
+from ray_tpu.tune.search import BasicVariantGenerator
+from ray_tpu.tune.trial import (
+    ERROR,
+    PENDING,
+    RUNNING,
+    TERMINATED,
+    Trial,
+)
+
+
+class ExperimentAnalysis:
+    """reference ray/tune/analysis/experiment_analysis.py."""
+
+    def __init__(self, trials: List[Trial],
+                 metric: str = "episode_reward_mean",
+                 mode: str = "max"):
+        self.trials = trials
+        self.default_metric = metric
+        self.default_mode = mode
+
+    def get_best_trial(
+        self, metric: Optional[str] = None, mode: Optional[str] = None
+    ) -> Optional[Trial]:
+        metric = metric or self.default_metric
+        mode = mode or self.default_mode
+        best, best_v = None, None
+        for t in self.trials:
+            v = t.last_result.get(metric)
+            if v is None:
+                continue
+            if (
+                best_v is None
+                or (mode == "max" and v > best_v)
+                or (mode == "min" and v < best_v)
+            ):
+                best, best_v = t, v
+        return best
+
+    @property
+    def best_config(self) -> Optional[Dict]:
+        t = self.get_best_trial()
+        return t.config if t else None
+
+    @property
+    def results(self) -> Dict[str, Dict]:
+        return {t.trial_id: t.last_result for t in self.trials}
+
+    def dataframe(self) -> List[Dict]:
+        return [
+            {"trial_id": t.trial_id, **t.last_result}
+            for t in self.trials
+        ]
+
+
+class TrialRunner:
+    """reference tune/execution/trial_runner.py:226."""
+
+    def __init__(
+        self,
+        trainable_cls,
+        trials: List[Trial],
+        scheduler: Optional[TrialScheduler] = None,
+        max_iterations: int = 100,
+        checkpoint_freq: int = 0,
+        local_dir: Optional[str] = None,
+        callbacks: Optional[List] = None,
+    ):
+        self.trainable_cls = trainable_cls
+        self.trials = trials
+        self.scheduler = scheduler or FIFOScheduler()
+        self.max_iterations = max_iterations
+        self.checkpoint_freq = checkpoint_freq
+        self.local_dir = local_dir
+        self.callbacks = callbacks or []
+
+    def is_finished(self) -> bool:
+        return all(
+            t.status in (TERMINATED, ERROR) for t in self.trials
+        )
+
+    def step(self) -> None:
+        """Advance every live trial by one training iteration
+        (reference trial_runner.py:793)."""
+        for trial in self.trials:
+            if trial.status in (TERMINATED, ERROR):
+                continue
+            if trial.runner is None:
+                try:
+                    trial.runner = self.trainable_cls(
+                        config=trial.config
+                    )
+                    trial.status = RUNNING
+                except Exception:
+                    trial.status = ERROR
+                    trial.error = traceback.format_exc()
+                    continue
+            try:
+                result = trial.runner.train()
+            except Exception:
+                trial.status = ERROR
+                trial.error = traceback.format_exc()
+                self._cleanup_trial(trial)
+                continue
+            trial.last_result = result
+            trial.results.append(result)
+            for cb in self.callbacks:
+                cb(trial, result)
+            if self.checkpoint_freq and (
+                result["training_iteration"] % self.checkpoint_freq
+                == 0
+            ):
+                trial.checkpoint_path = trial.runner.save()
+            decision = self.scheduler.on_trial_result(
+                self, trial, result
+            )
+            if (
+                decision == STOP
+                or trial.should_stop(result)
+                or result["training_iteration"] >= self.max_iterations
+            ):
+                trial.status = TERMINATED
+                self.scheduler.on_trial_complete(self, trial, result)
+                if self.checkpoint_freq:
+                    trial.checkpoint_path = trial.runner.save()
+                self._cleanup_trial(trial)
+
+    def _cleanup_trial(self, trial: Trial) -> None:
+        if trial.runner is not None:
+            try:
+                trial.runner.stop()
+            except Exception:
+                pass
+            trial.runner = None
+
+
+def run(
+    run_or_experiment: Union[str, Type],
+    *,
+    config: Optional[Dict] = None,
+    stop: Optional[Dict] = None,
+    num_samples: int = 1,
+    scheduler: Optional[TrialScheduler] = None,
+    checkpoint_freq: int = 0,
+    local_dir: Optional[str] = None,
+    metric: str = "episode_reward_mean",
+    mode: str = "max",
+    max_iterations: int = 100,
+    callbacks: Optional[List] = None,
+    verbose: int = 1,
+    seed: int = 0,
+) -> ExperimentAnalysis:
+    """reference tune/tune.py:118."""
+    if isinstance(run_or_experiment, str):
+        from ray_tpu.algorithms.registry import get_algorithm_class
+
+        trainable_cls = get_algorithm_class(run_or_experiment)
+        name = run_or_experiment
+    else:
+        trainable_cls = run_or_experiment
+        name = trainable_cls.__name__
+
+    stop = dict(stop or {})
+    max_iters = int(stop.pop("training_iteration", max_iterations))
+    gen = BasicVariantGenerator(config or {}, num_samples, seed)
+    trials = [
+        Trial(name, v, stopping_criterion=stop)
+        for v in iter(gen.next_variant, None)
+    ]
+    runner = TrialRunner(
+        trainable_cls,
+        trials,
+        scheduler=scheduler,
+        max_iterations=max_iters,
+        checkpoint_freq=checkpoint_freq,
+        local_dir=local_dir,
+        callbacks=callbacks,
+    )
+    while not runner.is_finished():
+        runner.step()
+        if verbose:
+            live = sum(1 for t in trials if t.status == RUNNING)
+            best = ExperimentAnalysis(
+                trials, metric, mode
+            ).get_best_trial()
+            if best is not None:
+                print(
+                    f"[tune] live={live} "
+                    f"best[{metric}]="
+                    f"{best.last_result.get(metric)}"
+                )
+    return ExperimentAnalysis(trials, metric, mode)

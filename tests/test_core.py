@@ -96,12 +96,12 @@ def test_wait(ray_start):
 
     @ray.remote
     def slow():
-        time.sleep(1.0)
+        time.sleep(20.0)
         return "slow"
 
     rs = slow.remote()
     rf = fast.remote()
-    ready, not_ready = ray.wait([rs, rf], num_returns=1, timeout=5.0)
+    ready, not_ready = ray.wait([rs, rf], num_returns=1, timeout=15.0)
     assert len(ready) == 1
     assert ray.get(ready[0]) == "fast"
     assert len(not_ready) == 1

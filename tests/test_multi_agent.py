@@ -1,0 +1,88 @@
+"""Multi-agent training tests (reference rllib multi-agent suite /
+``make_multi_agent`` pattern)."""
+
+import numpy as np
+import pytest
+
+from ray_tpu.algorithms.ppo import PPO, PPOConfig
+from ray_tpu.data.sample_batch import MultiAgentBatch
+from ray_tpu.env.multi_agent_env import make_multi_agent
+from ray_tpu.env.registry import register_env
+
+
+def _register():
+    register_env(
+        "multi_cartpole",
+        lambda cfg: make_multi_agent("CartPole-v1")(
+            {"num_agents": 2}
+        ),
+    )
+
+
+def _base_cfg():
+    import gymnasium as gym
+
+    obs_sp = gym.spaces.Box(-np.inf, np.inf, (4,), np.float64)
+    act_sp = gym.spaces.Discrete(2)
+    return (
+        PPOConfig()
+        .environment("multi_cartpole")
+        .rollouts(num_rollout_workers=0, rollout_fragment_length=64)
+        .training(
+            train_batch_size=256,
+            sgd_minibatch_size=64,
+            num_sgd_iter=2,
+            lr=3e-4,
+        )
+        .debugging(seed=0)
+    ), obs_sp, act_sp
+
+
+def test_shared_policy_multi_agent():
+    _register()
+    cfg, obs_sp, act_sp = _base_cfg()
+    cfg = cfg.multi_agent(
+        policies={"shared": (None, obs_sp, act_sp, {})},
+        policy_mapping_fn=lambda aid, **kw: "shared",
+    )
+    algo = cfg.build()
+    result = algo.train()
+    learner = result["info"]["learner"]
+    assert "shared" in learner
+    assert np.isfinite(learner["shared"]["total_loss"])
+    algo.cleanup()
+
+
+def test_independent_policies_multi_agent():
+    _register()
+    cfg, obs_sp, act_sp = _base_cfg()
+    cfg = cfg.multi_agent(
+        policies={
+            "p0": (None, obs_sp, act_sp, {}),
+            "p1": (None, obs_sp, act_sp, {"lr": 1e-4}),
+        },
+        policy_mapping_fn=lambda aid, **kw: f"p{aid % 2}",
+    )
+    algo = cfg.build()
+    result = algo.train()
+    learner = result["info"]["learner"]
+    assert "p0" in learner and "p1" in learner
+    algo.cleanup()
+
+
+def test_multi_agent_batch_structure():
+    _register()
+    cfg, obs_sp, act_sp = _base_cfg()
+    cfg = cfg.multi_agent(
+        policies={"shared": (None, obs_sp, act_sp, {})},
+        policy_mapping_fn=lambda aid, **kw: "shared",
+    )
+    algo = cfg.build()
+    batch = algo.workers.local_worker().sample()
+    assert isinstance(batch, MultiAgentBatch)
+    sb = batch.policy_batches["shared"]
+    # both agents' steps routed to the shared policy (some agents drop
+    # out early when their sub-episode terminates first)
+    assert sb.count > 64
+    assert "advantages" in sb
+    algo.cleanup()

@@ -1,0 +1,138 @@
+"""Tune layer tests (reference ray/tune/tests/test_trial_runner*.py,
+test_trial_scheduler.py)."""
+
+import numpy as np
+import pytest
+
+from ray_tpu.tune import (
+    AsyncHyperBandScheduler,
+    PopulationBasedTraining,
+    Trainable,
+    grid_search,
+    run,
+    uniform,
+)
+from ray_tpu.tune.search import generate_variants
+
+
+class _Quadratic(Trainable):
+    """Toy trainable: reward approaches -(x-3)^2 + noise-free."""
+
+    def setup(self, config):
+        self.x = config.get("x", 0.0)
+        self.lr = config.get("lr", 0.1)
+
+    def step(self):
+        self.x = self.x + self.lr * 2 * (3.0 - self.x)
+        return {"episode_reward_mean": -((self.x - 3.0) ** 2)}
+
+    def save_checkpoint(self, d):
+        import json, os
+
+        with open(os.path.join(d, "state.json"), "w") as f:
+            json.dump({"x": self.x}, f)
+        return d
+
+    def load_checkpoint(self, path):
+        import json, os
+
+        with open(os.path.join(path, "state.json")) as f:
+            self.x = json.load(f)["x"]
+
+
+def test_generate_variants_grid():
+    variants = generate_variants(
+        {"a": grid_search([1, 2, 3]), "b": {"c": grid_search([4, 5])}}
+    )
+    assert len(variants) == 6
+    assert {v["a"] for v in variants} == {1, 2, 3}
+
+
+def test_generate_variants_distributions():
+    variants = generate_variants(
+        {"lr": uniform(0.0, 1.0)}, num_samples=5
+    )
+    assert len(variants) == 5
+    assert all(0.0 <= v["lr"] <= 1.0 for v in variants)
+
+
+def test_tune_run_fifo():
+    analysis = run(
+        _Quadratic,
+        config={"x": grid_search([0.0, 10.0]), "lr": 0.3},
+        stop={"training_iteration": 10},
+        verbose=0,
+    )
+    assert len(analysis.trials) == 2
+    best = analysis.get_best_trial()
+    assert best.last_result["episode_reward_mean"] > -1.0
+
+
+def test_tune_run_stop_on_reward():
+    analysis = run(
+        _Quadratic,
+        config={"x": 0.0, "lr": 0.5},
+        stop={
+            "episode_reward_mean": -0.01,
+            "training_iteration": 50,
+        },
+        verbose=0,
+    )
+    t = analysis.trials[0]
+    assert t.last_result["episode_reward_mean"] >= -0.01
+    assert t.last_result["training_iteration"] < 50
+
+
+def test_asha_stops_bad_trials():
+    scheduler = AsyncHyperBandScheduler(
+        max_t=20, grace_period=2, reduction_factor=2
+    )
+    analysis = run(
+        _Quadratic,
+        config={"x": grid_search([0.0, 1.0, 9.0, 30.0]), "lr": 0.05},
+        stop={"training_iteration": 20},
+        scheduler=scheduler,
+        verbose=0,
+    )
+    iters = [
+        t.last_result["training_iteration"] for t in analysis.trials
+    ]
+    # at least one trial early-stopped before max_t
+    assert min(iters) < 20
+    assert max(iters) == 20
+
+
+def test_pbt_perturbs():
+    scheduler = PopulationBasedTraining(
+        perturbation_interval=2,
+        hyperparam_mutations={"lr": [0.05, 0.1, 0.3]},
+    )
+    analysis = run(
+        _Quadratic,
+        config={"x": grid_search([0.0, 20.0, -10.0, 40.0]), "lr": 0.1},
+        stop={"training_iteration": 12},
+        scheduler=scheduler,
+        verbose=0,
+    )
+    assert scheduler.num_perturbations > 0
+
+
+def test_tune_with_ppo():
+    analysis = run(
+        "PPO",
+        config={
+            "env": "CartPole-v1",
+            "num_workers": 0,
+            "rollout_fragment_length": 64,
+            "train_batch_size": 128,
+            "sgd_minibatch_size": 64,
+            "num_sgd_iter": 2,
+            "lr": grid_search([1e-4, 3e-4]),
+        },
+        stop={"training_iteration": 2},
+        verbose=0,
+    )
+    assert len(analysis.trials) == 2
+    for t in analysis.trials:
+        assert t.status == "TERMINATED", t.error
+        assert "episode_reward_mean" in t.last_result
