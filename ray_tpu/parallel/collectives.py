@@ -1,0 +1,143 @@
+"""Collective communication API.
+
+Counterpart of the reference's ``ray.util.collective``
+(``util/collective/collective.py:120-615``: init_collective_group,
+allreduce :258, broadcast :373, allgather :423, reducescatter :472,
+send/recv :531,594 over NCCL/Gloo groups).
+
+TPU-first disposition (SURVEY §5.8): on-device collectives are XLA
+primitives over mesh axes — there is no group bootstrap, no NCCL
+communicator, no rendezvous KV; a Mesh IS the group. This module provides:
+
+  1. The device-plane API: named wrappers usable inside ``shard_map``
+     bodies, one per reference verb (allreduce→psum, allgather,
+     reducescatter→psum_scatter, broadcast, send/recv→ppermute shift).
+  2. A host-plane ``Group`` for CPU actor fleets (the Gloo role):
+     driver-mediated reduction across actor handles, used by
+     DDPPO-style decentralized training.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# ---------------------------------------------------------------------------
+# Device plane (inside shard_map over a mesh axis)
+# ---------------------------------------------------------------------------
+
+
+def allreduce(x, axis_name: str, op: str = "sum"):
+    """reference collective.py:258 (NCCL allreduce) → XLA psum/pmax/..."""
+    if op == "sum":
+        return jax.lax.psum(x, axis_name)
+    if op == "mean":
+        return jax.lax.pmean(x, axis_name)
+    if op == "max":
+        return jax.lax.pmax(x, axis_name)
+    if op == "min":
+        return jax.lax.pmin(x, axis_name)
+    raise ValueError(f"unknown op {op}")
+
+
+def allgather(x, axis_name: str, axis: int = 0):
+    """reference collective.py:423 → lax.all_gather (concatenated)."""
+    return jax.lax.all_gather(x, axis_name, axis=axis, tiled=True)
+
+
+def reducescatter(x, axis_name: str, scatter_axis: int = 0):
+    """reference collective.py:472 → lax.psum_scatter."""
+    return jax.lax.psum_scatter(
+        x, axis_name, scatter_dimension=scatter_axis, tiled=True
+    )
+
+
+def broadcast(x, axis_name: str, src: int = 0):
+    """reference collective.py:373: every shard gets shard ``src``'s
+    value. Implemented as a masked psum (zero elsewhere)."""
+    idx = jax.lax.axis_index(axis_name)
+    masked = jnp.where(idx == src, x, jnp.zeros_like(x))
+    return jax.lax.psum(masked, axis_name)
+
+
+def send_recv_shift(x, axis_name: str, shift: int = 1):
+    """reference send/recv :531,594 — on an ICI ring the idiom is a
+    permute shift: every shard sends to (rank+shift) and receives from
+    (rank-shift)."""
+    n = jax.lax.axis_size(axis_name)
+    perm = [(i, (i + shift) % n) for i in range(n)]
+    return jax.lax.ppermute(x, axis_name, perm)
+
+
+def barrier(axis_name: str):
+    """reference collective.py barrier — a psum of a scalar."""
+    return jax.lax.psum(jnp.ones(()), axis_name)
+
+
+# ---------------------------------------------------------------------------
+# Host plane (CPU actor fleets; the Gloo-group role)
+# ---------------------------------------------------------------------------
+
+_OPS = {
+    "sum": lambda arrs: np.sum(arrs, axis=0),
+    "mean": lambda arrs: np.mean(arrs, axis=0),
+    "max": lambda arrs: np.max(arrs, axis=0),
+    "min": lambda arrs: np.min(arrs, axis=0),
+}
+
+
+class HostGroup:
+    """Driver-mediated collective over actor handles (reference
+    GLOOGroup ``gloo_collective_group.py:184``, scoped to the
+    driver-as-root topology). Each verb fans out actor calls, reduces on
+    the driver, and fans the result back — one shm broadcast each way."""
+
+    def __init__(self, actors: Sequence):
+        self.actors = list(actors)
+
+    @property
+    def world_size(self) -> int:
+        return len(self.actors)
+
+    def allreduce(
+        self, get_method: str, set_method: str, op: str = "mean"
+    ) -> np.ndarray:
+        """Gather `a.<get_method>()` from every actor, reduce, push the
+        result back via `a.<set_method>(reduced)`."""
+        import ray_tpu as ray
+
+        vals = ray.get(
+            [getattr(a, get_method).remote() for a in self.actors]
+        )
+        leaves_list = [jax.tree_util.tree_leaves(v) for v in vals]
+        treedef = jax.tree_util.tree_structure(vals[0])
+        reduced_leaves = [
+            _OPS[op]([np.asarray(l[i]) for l in leaves_list])
+            for i in range(len(leaves_list[0]))
+        ]
+        reduced = jax.tree_util.tree_unflatten(
+            treedef, reduced_leaves
+        )
+        ref = ray.put(reduced)
+        ray.get(
+            [getattr(a, set_method).remote(ref) for a in self.actors]
+        )
+        return reduced
+
+    def gather(self, get_method: str) -> List:
+        import ray_tpu as ray
+
+        return ray.get(
+            [getattr(a, get_method).remote() for a in self.actors]
+        )
+
+    def broadcast_value(self, set_method: str, value) -> None:
+        import ray_tpu as ray
+
+        ref = ray.put(value)
+        ray.get(
+            [getattr(a, set_method).remote(ref) for a in self.actors]
+        )

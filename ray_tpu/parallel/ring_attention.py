@@ -1,0 +1,146 @@
+"""Ring attention: sequence-parallel exact attention over a mesh axis.
+
+The reference has NO sequence/context parallelism (SURVEY §5.7 — its
+sequence handling stops at padded chopping, ``rnn_sequencing.py:34``); this
+module is the deliberate TPU-first extension: long sequences are sharded
+along time over a ("sp",) mesh axis, each device holds a Q/K/V block, and
+K/V blocks rotate around the ICI ring via ``lax.ppermute`` while a
+flash-attention-style online softmax accumulates exact results
+(Liu et al., "Ring Attention with Blockwise Transformers", 2023 —
+reimplemented from the paper's math, not ported code).
+
+Communication pattern: n-1 ppermute hops of the local K/V block — each hop
+overlaps with the local block matmul, so the MXU stays busy while ICI moves
+the next block.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+NEG_INF = -1e30
+
+
+def _block_attn(q, k, v, mask):
+    """One (Tq, Tk) block: returns (unnormalized out, row max, row sum).
+
+    q: (B, Tq, H, D), k/v: (B, Tk, H, D), mask: (Tq, Tk) bool or None.
+    """
+    scale = 1.0 / jnp.sqrt(jnp.asarray(q.shape[-1], jnp.float32))
+    scores = (
+        jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    )
+    if mask is not None:
+        scores = jnp.where(mask[None, None], scores, NEG_INF)
+    m = jnp.max(scores, axis=-1)  # (B, H, Tq)
+    p = jnp.exp(scores - m[..., None])
+    l = jnp.sum(p, axis=-1)  # (B, H, Tq)
+    o = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v)
+    return o, m, l
+
+
+def _merge(o1, m1, l1, o2, m2, l2):
+    """Combine two partial softmax accumulators (flash-attention merge)."""
+    m = jnp.maximum(m1, m2)
+    a1 = jnp.exp(m1 - m)
+    a2 = jnp.exp(m2 - m)
+    l = l1 * a1 + l2 * a2
+    # broadcast (B,H,Tq) -> (B,Tq,H,1)
+    s1 = jnp.transpose(a1, (0, 2, 1))[..., None]
+    s2 = jnp.transpose(a2, (0, 2, 1))[..., None]
+    o = o1 * s1 + o2 * s2
+    return o, m, l
+
+
+def ring_attention_local(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    *,
+    axis_name: str = "sp",
+    causal: bool = False,
+) -> jnp.ndarray:
+    """Per-shard body; call inside shard_map over the ``axis_name`` axis.
+
+    q/k/v: (B, T_local, H, D) — this shard's sequence block. Returns the
+    attention output for the local Q block, exact w.r.t. the full
+    sequence.
+    """
+    n = jax.lax.axis_size(axis_name)
+    my = jax.lax.axis_index(axis_name)
+    B, Tq, H, D = q.shape
+    Tk = k.shape[1]
+
+    q_pos = my * Tq + jnp.arange(Tq)  # global positions of local Q rows
+
+    def hop(carry, step):
+        o_acc, m_acc, l_acc, k_cur, v_cur = carry
+        src_shard = (my - step) % n  # whose K/V block we now hold
+        if causal:
+            k_pos = src_shard * Tk + jnp.arange(Tk)
+            mask = k_pos[None, :] <= q_pos[:, None]
+        else:
+            mask = None
+        o, m, l = _block_attn(q, k_cur, v_cur, mask)
+        o_acc, m_acc, l_acc = _merge(o_acc, m_acc, l_acc, o, m, l)
+        # rotate K/V to the next device (skip the final, unused hop
+        # is harmless — keeps the scan body uniform)
+        perm = [(i, (i + 1) % n) for i in range(n)]
+        k_cur = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_cur = jax.lax.ppermute(v_cur, axis_name, perm)
+        return (o_acc, m_acc, l_acc, k_cur, v_cur), None
+
+    o0 = jnp.zeros((B, Tq, H, D), jnp.float32)
+    m0 = jnp.full((B, H, Tq), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((B, H, Tq), jnp.float32)
+    (o, m, l, _, _), _ = jax.lax.scan(
+        hop, (o0, m0, l0, k, v), jnp.arange(n)
+    )
+    denom = jnp.transpose(l, (0, 2, 1))[..., None]
+    return (o / jnp.maximum(denom, 1e-30)).astype(q.dtype)
+
+
+def ring_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    mesh: Mesh,
+    *,
+    axis_name: str = "sp",
+    causal: bool = False,
+) -> jnp.ndarray:
+    """Full-array entry point: shards (B, T, H, D) inputs along T over
+    ``axis_name`` and runs the ring. T must divide by the axis size."""
+    body = functools.partial(
+        ring_attention_local, axis_name=axis_name, causal=causal
+    )
+    spec = P(None, axis_name)
+    fn = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        # fresh accumulators in the scan carry start axis-unvarying and
+        # become varying after the first merge; skip the static check
+        check_vma=False,
+    )
+    return fn(q, k, v)
+
+
+def full_attention_reference(q, k, v, causal: bool = False):
+    """Single-device exact attention (golden for tests)."""
+    scale = 1.0 / jnp.sqrt(jnp.asarray(q.shape[-1], jnp.float32))
+    scores = (
+        jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    )
+    if causal:
+        T = q.shape[1]
+        mask = jnp.tril(jnp.ones((T, T), bool))
+        scores = jnp.where(mask[None, None], scores, NEG_INF)
+    p = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v)
