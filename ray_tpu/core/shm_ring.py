@@ -1,0 +1,185 @@
+"""ctypes binding for the native shared-memory ring buffer.
+
+The streaming data plane between rollout actors and the learner: the
+python side serializes objects (pickle-5 with out-of-band numpy buffers
+written contiguously) and moves bytes through the C++ SPSC ring
+(``ray_tpu/native/shm_ring.cpp``), bypassing the pipe+re-pickle control
+path entirely for bulk SampleBatch traffic.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import pickle
+from typing import Any, Optional
+
+from ray_tpu.core import serialization as ser
+from ray_tpu.native.build import ensure_built
+
+_lib = None
+
+
+def _load():
+    global _lib
+    if _lib is None:
+        lib = ctypes.CDLL(ensure_built())
+        lib.shmring_create.restype = ctypes.c_void_p
+        lib.shmring_create.argtypes = [ctypes.c_char_p, ctypes.c_uint64]
+        lib.shmring_attach.restype = ctypes.c_void_p
+        lib.shmring_attach.argtypes = [ctypes.c_char_p]
+        lib.shmring_push.restype = ctypes.c_int
+        lib.shmring_push.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_char_p,
+            ctypes.c_uint64,
+        ]
+        lib.shmring_push_wait.restype = ctypes.c_int
+        lib.shmring_push_wait.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_char_p,
+            ctypes.c_uint64,
+            ctypes.c_int64,
+        ]
+        lib.shmring_peek_len.restype = ctypes.c_int64
+        lib.shmring_peek_len.argtypes = [ctypes.c_void_p]
+        lib.shmring_pop.restype = ctypes.c_int64
+        lib.shmring_pop.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_char_p,
+            ctypes.c_uint64,
+        ]
+        lib.shmring_pop_wait.restype = ctypes.c_int64
+        lib.shmring_pop_wait.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_char_p,
+            ctypes.c_uint64,
+            ctypes.c_int64,
+        ]
+        lib.shmring_size.restype = ctypes.c_uint64
+        lib.shmring_size.argtypes = [ctypes.c_void_p]
+        lib.shmring_num_pushed.restype = ctypes.c_uint64
+        lib.shmring_num_pushed.argtypes = [ctypes.c_void_p]
+        lib.shmring_num_popped.restype = ctypes.c_uint64
+        lib.shmring_num_popped.argtypes = [ctypes.c_void_p]
+        lib.shmring_mark_closed.argtypes = [ctypes.c_void_p]
+        lib.shmring_is_closed.restype = ctypes.c_int
+        lib.shmring_is_closed.argtypes = [ctypes.c_void_p]
+        lib.shmring_close.argtypes = [ctypes.c_void_p]
+        _lib = lib
+    return _lib
+
+
+class ShmRing:
+    """One SPSC byte ring. Create on one side, attach on the other."""
+
+    def __init__(self, name: str, handle, owner: bool):
+        self.name = name
+        self._h = handle
+        self._owner = owner
+        self._closed = False
+
+    @classmethod
+    def create(cls, name: str, capacity: int = 64 * 1024 * 1024) -> "ShmRing":
+        lib = _load()
+        h = lib.shmring_create(name.encode(), capacity)
+        if not h:
+            raise OSError(f"shmring_create({name}) failed")
+        return cls(name, h, owner=True)
+
+    @classmethod
+    def attach(cls, name: str) -> "ShmRing":
+        lib = _load()
+        h = lib.shmring_attach(name.encode())
+        if not h:
+            raise OSError(f"shmring_attach({name}) failed")
+        return cls(name, h, owner=False)
+
+    # -- raw bytes -------------------------------------------------------
+
+    def push_bytes(self, data: bytes, timeout: Optional[float] = 10.0) -> bool:
+        lib = _load()
+        t_ms = -1 if timeout is None else int(timeout * 1000)
+        rc = lib.shmring_push_wait(self._h, data, len(data), t_ms)
+        if rc == -2:
+            raise ValueError(
+                f"record of {len(data)} bytes exceeds ring capacity"
+            )
+        if rc == -3:
+            raise BrokenPipeError("ring closed")
+        return rc == 0
+
+    def pop_bytes(self, timeout: Optional[float] = 10.0) -> Optional[bytes]:
+        lib = _load()
+        n = lib.shmring_peek_len(self._h)
+        t_ms = -1 if timeout is None else int(timeout * 1000)
+        if n < 0:
+            # wait for a record
+            buf = ctypes.create_string_buffer(1)
+            n = lib.shmring_pop_wait(self._h, buf, 0, 0)
+        # allocate exactly and pop
+        while True:
+            n = lib.shmring_peek_len(self._h)
+            if n >= 0:
+                buf = ctypes.create_string_buffer(int(n))
+                got = lib.shmring_pop(self._h, buf, n)
+                if got >= 0:
+                    return buf.raw[:got]
+            else:
+                buf = ctypes.create_string_buffer(8)
+                got = lib.shmring_pop_wait(self._h, buf, 8, t_ms)
+                if got == -1:
+                    return None  # timeout
+                if got == -3:
+                    raise BrokenPipeError("ring closed")
+                if got == -2:
+                    continue  # record bigger than probe buf; re-peek
+                return buf.raw[:got]
+
+    # -- objects ---------------------------------------------------------
+
+    def push(self, obj: Any, timeout: Optional[float] = 10.0) -> bool:
+        """Serialize (out-of-band numpy buffers inline) and push."""
+        meta, buffers = ser.serialize(obj)
+        size = ser.serialized_size(meta, buffers)
+        payload = bytearray(size)
+        ser.write_to_buffer(memoryview(payload), meta, buffers)
+        return self.push_bytes(bytes(payload), timeout)
+
+    def pop(self, timeout: Optional[float] = 10.0) -> Any:
+        data = self.pop_bytes(timeout)
+        if data is None:
+            return None
+        return ser.read_from_buffer(memoryview(data))
+
+    # -- stats / lifecycle ----------------------------------------------
+
+    def size_bytes(self) -> int:
+        return _load().shmring_size(self._h)
+
+    def num_pushed(self) -> int:
+        return _load().shmring_num_pushed(self._h)
+
+    def num_popped(self) -> int:
+        return _load().shmring_num_popped(self._h)
+
+    def mark_closed(self) -> None:
+        _load().shmring_mark_closed(self._h)
+
+    def is_closed(self) -> bool:
+        return bool(_load().shmring_is_closed(self._h))
+
+    def close(self) -> None:
+        if not self._closed and self._h:
+            _load().shmring_close(self._h)
+            self._closed = True
+            self._h = None
+
+    def __reduce__(self):
+        # Rings pickle as attach-by-name (for shipping to actors).
+        return (ShmRing.attach, (self.name,))
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -1,0 +1,3 @@
+from ray_tpu.native.build import available, ensure_built
+
+__all__ = ["available", "ensure_built"]

@@ -1,0 +1,41 @@
+"""Build the native library (g++, no external deps). Idempotent: rebuilds
+only when the source is newer than the .so."""
+
+from __future__ import annotations
+
+import os
+import subprocess
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+SRC = os.path.join(_DIR, "shm_ring.cpp")
+LIB = os.path.join(_DIR, "libshm_ring.so")
+
+
+def ensure_built() -> str:
+    """→ path to libshm_ring.so, building if needed. Raises on failure."""
+    if os.path.exists(LIB) and os.path.getmtime(LIB) >= os.path.getmtime(
+        SRC
+    ):
+        return LIB
+    cmd = [
+        "g++",
+        "-O2",
+        "-std=c++17",
+        "-shared",
+        "-fPIC",
+        "-o",
+        LIB,
+        SRC,
+        "-lrt",
+        "-pthread",
+    ]
+    subprocess.run(cmd, check=True, capture_output=True)
+    return LIB
+
+
+def available() -> bool:
+    try:
+        ensure_built()
+        return True
+    except Exception:
+        return False

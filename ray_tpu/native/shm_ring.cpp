@@ -1,0 +1,266 @@
+// Shared-memory SPSC ring buffer — the native data plane for
+// rollout→learner batch transfer.
+//
+// Plays the plasma-store role of the reference
+// (src/ray/object_manager/plasma/store.h:55 + the create/get protocol)
+// scoped to the streaming single-producer/single-consumer pattern RL
+// training actually uses: a rollout actor pushes serialized SampleBatch
+// records; the learner's feeder thread pops them. Lock-free: one atomic
+// head (consumer) and tail (producer) cursor in the mapped header, with
+// length-prefixed records and wrap-around markers.
+//
+// Layout:
+//   [Header | data bytes ...]
+//   Header: magic, capacity, head, tail (64-byte aligned atomics)
+//   Record: u64 len | len bytes (8-byte aligned). len == WRAP_MARKER
+//   means "skip to buffer start".
+//
+// Build: g++ -O2 -shared -fPIC -o libshm_ring.so shm_ring.cpp -lrt
+
+#include <atomic>
+#include <cerrno>
+#include <cstdint>
+#include <cstring>
+
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <time.h>
+#include <unistd.h>
+
+namespace {
+
+constexpr uint64_t kMagic = 0x52494e475450ULL;  // "RINGTP"
+constexpr uint64_t kWrapMarker = ~0ULL;
+
+struct alignas(64) Header {
+  uint64_t magic;
+  uint64_t capacity;  // data area size in bytes
+  alignas(64) std::atomic<uint64_t> head;  // consumer cursor (abs offset)
+  alignas(64) std::atomic<uint64_t> tail;  // producer cursor (abs offset)
+  alignas(64) std::atomic<uint64_t> n_pushed;
+  std::atomic<uint64_t> n_popped;
+  std::atomic<uint64_t> closed;
+};
+
+struct Ring {
+  Header* hdr;
+  uint8_t* data;
+  size_t map_size;
+  int owner;
+  char name[256];
+};
+
+inline uint64_t align8(uint64_t x) { return (x + 7) & ~7ULL; }
+
+}  // namespace
+
+extern "C" {
+
+// Create a new ring with `capacity` data bytes. Returns NULL on error.
+void* shmring_create(const char* name, uint64_t capacity) {
+  capacity = align8(capacity);
+  size_t total = sizeof(Header) + capacity;
+  shm_unlink(name);  // stale segment from a crashed run
+  int fd = shm_open(name, O_CREAT | O_EXCL | O_RDWR, 0600);
+  if (fd < 0) return nullptr;
+  if (ftruncate(fd, (off_t)total) != 0) {
+    close(fd);
+    shm_unlink(name);
+    return nullptr;
+  }
+  void* mem =
+      mmap(nullptr, total, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  close(fd);
+  if (mem == MAP_FAILED) {
+    shm_unlink(name);
+    return nullptr;
+  }
+  Ring* r = new Ring();
+  r->hdr = (Header*)mem;
+  r->data = (uint8_t*)mem + sizeof(Header);
+  r->map_size = total;
+  r->owner = 1;
+  strncpy(r->name, name, sizeof(r->name) - 1);
+  r->hdr->capacity = capacity;
+  r->hdr->head.store(0);
+  r->hdr->tail.store(0);
+  r->hdr->n_pushed.store(0);
+  r->hdr->n_popped.store(0);
+  r->hdr->closed.store(0);
+  std::atomic_thread_fence(std::memory_order_release);
+  r->hdr->magic = kMagic;
+  return r;
+}
+
+// Attach to an existing ring. Returns NULL on error.
+void* shmring_attach(const char* name) {
+  int fd = shm_open(name, O_RDWR, 0600);
+  if (fd < 0) return nullptr;
+  struct stat st;
+  if (fstat(fd, &st) != 0) {
+    close(fd);
+    return nullptr;
+  }
+  void* mem = mmap(nullptr, (size_t)st.st_size, PROT_READ | PROT_WRITE,
+                   MAP_SHARED, fd, 0);
+  close(fd);
+  if (mem == MAP_FAILED) return nullptr;
+  Header* hdr = (Header*)mem;
+  if (hdr->magic != kMagic) {
+    munmap(mem, (size_t)st.st_size);
+    return nullptr;
+  }
+  Ring* r = new Ring();
+  r->hdr = hdr;
+  r->data = (uint8_t*)mem + sizeof(Header);
+  r->map_size = (size_t)st.st_size;
+  r->owner = 0;
+  strncpy(r->name, name, sizeof(r->name) - 1);
+  return r;
+}
+
+// Push one record. Returns 0 on success, -1 if not enough space, -2 if
+// the record can never fit, -3 if the ring is closed.
+int shmring_push(void* ring, const uint8_t* buf, uint64_t len) {
+  Ring* r = (Ring*)ring;
+  Header* h = r->hdr;
+  if (h->closed.load(std::memory_order_acquire)) return -3;
+  const uint64_t cap = h->capacity;
+  const uint64_t need = align8(8 + len);
+  if (need + 8 > cap) return -2;
+  uint64_t head = h->head.load(std::memory_order_acquire);
+  uint64_t tail = h->tail.load(std::memory_order_relaxed);
+  uint64_t used = tail - head;
+  uint64_t tpos = tail % cap;
+  uint64_t contiguous = cap - tpos;
+  uint64_t total_need = need;
+  bool wrap = false;
+  if (contiguous < need) {
+    // need a wrap marker (8 bytes) + the record at buffer start
+    total_need = contiguous + need;
+    wrap = true;
+  }
+  if (used + total_need > cap) return -1;  // full
+  if (wrap) {
+    *(uint64_t*)(r->data + tpos) = kWrapMarker;
+    tail += contiguous;
+    tpos = 0;
+  }
+  *(uint64_t*)(r->data + tpos) = len;
+  memcpy(r->data + tpos + 8, buf, len);
+  h->tail.store(tail + need, std::memory_order_release);
+  h->n_pushed.fetch_add(1, std::memory_order_relaxed);
+  return 0;
+}
+
+// Peek the next record's length. Returns length, -1 if empty.
+int64_t shmring_peek_len(void* ring) {
+  Ring* r = (Ring*)ring;
+  Header* h = r->hdr;
+  const uint64_t cap = h->capacity;
+  uint64_t head = h->head.load(std::memory_order_relaxed);
+  uint64_t tail = h->tail.load(std::memory_order_acquire);
+  while (true) {
+    if (head == tail) return -1;
+    uint64_t hpos = head % cap;
+    uint64_t len = *(uint64_t*)(r->data + hpos);
+    if (len == kWrapMarker) {
+      head += cap - hpos;
+      h->head.store(head, std::memory_order_release);
+      continue;
+    }
+    return (int64_t)len;
+  }
+}
+
+// Pop one record into buf (size maxlen). Returns record length,
+// -1 if empty, -2 if buf too small (record left in place).
+int64_t shmring_pop(void* ring, uint8_t* buf, uint64_t maxlen) {
+  Ring* r = (Ring*)ring;
+  Header* h = r->hdr;
+  int64_t len = shmring_peek_len(ring);
+  if (len < 0) return len;
+  if ((uint64_t)len > maxlen) return -2;
+  const uint64_t cap = h->capacity;
+  uint64_t head = h->head.load(std::memory_order_relaxed);
+  uint64_t hpos = head % cap;
+  memcpy(buf, r->data + hpos + 8, (size_t)len);
+  h->head.store(head + align8(8 + (uint64_t)len),
+                std::memory_order_release);
+  h->n_popped.fetch_add(1, std::memory_order_relaxed);
+  return len;
+}
+
+// Blocking pop with timeout (ms). Spin with exponential backoff sleep.
+int64_t shmring_pop_wait(void* ring, uint8_t* buf, uint64_t maxlen,
+                         int64_t timeout_ms) {
+  struct timespec start, now;
+  clock_gettime(CLOCK_MONOTONIC, &start);
+  long sleep_us = 50;
+  while (true) {
+    int64_t n = shmring_pop(ring, buf, maxlen);
+    if (n != -1) return n;
+    Ring* r = (Ring*)ring;
+    if (r->hdr->closed.load(std::memory_order_acquire)) return -3;
+    clock_gettime(CLOCK_MONOTONIC, &now);
+    int64_t elapsed_ms = (now.tv_sec - start.tv_sec) * 1000 +
+                         (now.tv_nsec - start.tv_nsec) / 1000000;
+    if (timeout_ms >= 0 && elapsed_ms >= timeout_ms) return -1;
+    usleep((useconds_t)sleep_us);
+    if (sleep_us < 2000) sleep_us *= 2;
+  }
+}
+
+// Blocking push with timeout (ms): waits for space.
+int shmring_push_wait(void* ring, const uint8_t* buf, uint64_t len,
+                      int64_t timeout_ms) {
+  struct timespec start, now;
+  clock_gettime(CLOCK_MONOTONIC, &start);
+  long sleep_us = 50;
+  while (true) {
+    int rc = shmring_push(ring, buf, len);
+    if (rc != -1) return rc;
+    clock_gettime(CLOCK_MONOTONIC, &now);
+    int64_t elapsed_ms = (now.tv_sec - start.tv_sec) * 1000 +
+                         (now.tv_nsec - start.tv_nsec) / 1000000;
+    if (timeout_ms >= 0 && elapsed_ms >= timeout_ms) return -1;
+    usleep((useconds_t)sleep_us);
+    if (sleep_us < 2000) sleep_us *= 2;
+  }
+}
+
+uint64_t shmring_size(void* ring) {
+  Ring* r = (Ring*)ring;
+  return r->hdr->tail.load(std::memory_order_acquire) -
+         r->hdr->head.load(std::memory_order_acquire);
+}
+
+uint64_t shmring_num_pushed(void* ring) {
+  return ((Ring*)ring)->hdr->n_pushed.load(std::memory_order_relaxed);
+}
+
+uint64_t shmring_num_popped(void* ring) {
+  return ((Ring*)ring)->hdr->n_popped.load(std::memory_order_relaxed);
+}
+
+void shmring_mark_closed(void* ring) {
+  ((Ring*)ring)->hdr->closed.store(1, std::memory_order_release);
+}
+
+int shmring_is_closed(void* ring) {
+  return (int)((Ring*)ring)->hdr->closed.load(std::memory_order_acquire);
+}
+
+// Unmap; owner also unlinks the segment.
+void shmring_close(void* ring) {
+  Ring* r = (Ring*)ring;
+  int owner = r->owner;
+  char name[256];
+  strncpy(name, r->name, sizeof(name));
+  munmap((void*)r->hdr, r->map_size);
+  if (owner) shm_unlink(name);
+  delete r;
+}
+
+}  // extern "C"

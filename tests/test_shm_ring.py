@@ -1,0 +1,114 @@
+"""Native shm ring buffer tests (the C++ data plane)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu as ray
+from ray_tpu.core.shm_ring import ShmRing
+from ray_tpu.native.build import available
+
+pytestmark = pytest.mark.skipif(
+    not available(), reason="native toolchain unavailable"
+)
+
+
+def test_push_pop_bytes():
+    ring = ShmRing.create("test_ring_a", 1 << 20)
+    assert ring.push_bytes(b"hello")
+    assert ring.push_bytes(b"world" * 100)
+    assert ring.pop_bytes() == b"hello"
+    assert ring.pop_bytes() == b"world" * 100
+    ring.close()
+
+
+def test_pop_empty_times_out():
+    ring = ShmRing.create("test_ring_b", 1 << 16)
+    assert ring.pop_bytes(timeout=0.1) is None
+    ring.close()
+
+
+def test_wraparound():
+    ring = ShmRing.create("test_ring_c", 4096)
+    payload = bytes(1000)
+    for round_ in range(20):  # forces many wraps
+        assert ring.push_bytes(payload)
+        assert ring.push_bytes(b"x" * (round_ + 1))
+        assert ring.pop_bytes() == payload
+        assert ring.pop_bytes() == b"x" * (round_ + 1)
+    assert ring.num_pushed() == 40
+    ring.close()
+
+
+def test_backpressure_full_then_drain():
+    ring = ShmRing.create("test_ring_d", 4096)
+    big = bytes(1500)
+    assert ring.push_bytes(big, timeout=0.2)
+    assert ring.push_bytes(big, timeout=0.2)
+    # third won't fit until we drain
+    assert not ring.push_bytes(big, timeout=0.2)
+    assert ring.pop_bytes() == big
+    assert ring.push_bytes(big, timeout=0.2)
+    ring.close()
+
+
+def test_oversized_record_raises():
+    ring = ShmRing.create("test_ring_e", 4096)
+    with pytest.raises(ValueError):
+        ring.push_bytes(bytes(8192))
+    ring.close()
+
+
+def test_object_roundtrip_numpy():
+    from ray_tpu.data.sample_batch import SampleBatch
+
+    ring = ShmRing.create("test_ring_f", 8 << 20)
+    batch = SampleBatch(
+        {
+            "obs": np.random.default_rng(0)
+            .standard_normal((64, 17))
+            .astype(np.float32),
+            "rewards": np.ones(64, np.float32),
+        }
+    )
+    ring.push(batch)
+    out = ring.pop()
+    np.testing.assert_array_equal(out["obs"], batch["obs"])
+    assert out.count == 64
+    ring.close()
+
+
+def test_cross_process_stream():
+    """Producer actor pushes batches through the ring; driver pops."""
+    ray.init(ignore_reinit_error=True)
+    ring = ShmRing.create("test_ring_g", 16 << 20)
+
+    @ray.remote
+    class Producer:
+        def produce(self, ring, n):
+            import numpy as np
+
+            for i in range(n):
+                ring.push(
+                    {"i": i, "data": np.full(10000, i, np.float32)}
+                )
+            return "done"
+
+    p = Producer.remote()
+    done_ref = p.produce.remote(ring, 20)
+    seen = []
+    for _ in range(20):
+        item = ring.pop(timeout=60.0)
+        assert item is not None
+        assert item["data"][0] == item["i"]
+        seen.append(item["i"])
+    assert seen == list(range(20))
+    assert ray.get(done_ref) == "done"
+    ring.close()
+
+
+def test_closed_ring_raises():
+    ring = ShmRing.create("test_ring_h", 1 << 16)
+    ring.mark_closed()
+    with pytest.raises(BrokenPipeError):
+        ring.push_bytes(b"x")
+    ring.close()
