@@ -5,11 +5,14 @@ Measures the north-star metric from BASELINE.md: PPO learner throughput
 batches with the Nature-CNN policy, at the reference's pong-ppo.yaml
 geometry (train batch ~4096, minibatch 512, 10 SGD epochs). Compares:
 
-  - ray_tpu JAX/TPU learner: ONE jitted shard_map SGD nest per train
-    batch, host→device transfer overlapped with compute via DeviceFeeder
-    (the reference's _MultiGPULoaderThread role).
+  - ray_tpu JAX/TPU learner, through the PUBLIC two-phase policy API
+    (``prepare_batch`` → DeviceFeeder → ``learn_on_device_batch``): ONE
+    jitted shard_map SGD nest per train batch, host→device transfer of
+    batch k+1 overlapped with the compute of batch k (the reference's
+    _MultiGPULoaderThread role).
   - torch-CPU learner: a faithful implementation of the reference's
-    minibatch SGD loop (``rllib/policy/torch_policy.py:498-624``).
+    minibatch SGD loop (``rllib/policy/torch_policy.py:498-624``), run in
+    full (no extrapolation).
 
 Observations are structured (block-textured) frames, matching real Atari
 content rather than incompressible noise. Prints ONE JSON line.
@@ -25,77 +28,74 @@ H, W, C, NUM_ACTIONS = 84, 84, 4, 6
 TIMED_ROUNDS = 4
 
 
-def make_frames(rng, n):
+def make_frames(rng, n, h=H, w=W, c=C):
     """Blocky 84x84 frames approximating Atari content."""
-    base = rng.integers(0, 255, (n, H // 4, W // 4, C), dtype=np.uint8)
+    base = rng.integers(0, 255, (n, h // 4, w // 4, c), dtype=np.uint8)
     return np.kron(base, np.ones((1, 4, 4, 1), np.uint8))
 
 
-def make_batch(rng):
+def make_batch(rng, b=B, h=H, w=W, c=C, num_actions=NUM_ACTIONS):
     return {
-        "obs": make_frames(rng, B),
-        "actions": rng.integers(0, NUM_ACTIONS, B).astype(np.int64),
-        "action_logp": np.full(B, -1.79, np.float32),
+        "obs": make_frames(rng, b, h, w, c),
+        "actions": rng.integers(0, num_actions, b).astype(np.int64),
+        "action_logp": np.full(b, -1.79, np.float32),
         "action_dist_inputs": rng.standard_normal(
-            (B, NUM_ACTIONS)
+            (b, num_actions)
         ).astype(np.float32),
-        "advantages": rng.standard_normal(B).astype(np.float32),
-        "value_targets": rng.standard_normal(B).astype(np.float32),
+        "advantages": rng.standard_normal(b).astype(np.float32),
+        "value_targets": rng.standard_normal(b).astype(np.float32),
     }
 
 
-def bench_jax() -> float:
-    import jax
-
+def bench_jax(
+    b=B, mb=MB, iters=ITERS, timed_rounds=TIMED_ROUNDS, h=H, w=W, c=C
+) -> float:
     import gymnasium as gym
 
     from ray_tpu.algorithms.ppo.ppo import PPOJaxPolicy
     from ray_tpu.execution.device_feed import DeviceFeeder
 
-    obs_space = gym.spaces.Box(0, 255, (H, W, C), np.uint8)
+    obs_space = gym.spaces.Box(0, 255, (h, w, c), np.uint8)
     act_space = gym.spaces.Discrete(NUM_ACTIONS)
     policy = PPOJaxPolicy(
         obs_space,
         act_space,
         {
-            "train_batch_size": B,
-            "sgd_minibatch_size": MB,
-            "num_sgd_iter": ITERS,
+            "train_batch_size": b,
+            "sgd_minibatch_size": mb,
+            "num_sgd_iter": iters,
             "lr": 5e-5,
         },
     )
     rng = np.random.default_rng(0)
-    host_batches = [make_batch(rng) for _ in range(3)]
+    host_batches = [
+        policy.prepare_batch(make_batch(rng, b, h, w, c))
+        for _ in range(3)
+    ]
 
-    fn = policy._build_learn_fn(B)
-    policy._learn_fns[B] = fn
-    coeffs = policy._coeff_array()
-    r = jax.random.PRNGKey(0)
-
-    feeder = DeviceFeeder(policy._data_sharding)
-    feeder.put(host_batches[0])
-    dev = feeder.get()
-    # compile + warm
-    params, opt_state, stats = fn(
-        policy.params, policy.opt_state, dev, r, coeffs
-    )
-    float(stats["total_loss"])
+    feeder = DeviceFeeder(policy.data_sharding)
+    feeder.put(*host_batches[0])
+    dev, bsize = feeder.get()
+    # compile + warm (learn_fn is the supported program accessor)
+    policy.learn_fn(bsize)
+    policy.learn_on_device_batch(dev, bsize)
 
     # steady state: feeder transfers batch k+1 while learner runs batch k
-    feeder.put(host_batches[1 % 3])
+    feeder.put(*host_batches[1 % 3])
     t0 = time.perf_counter()
-    for k in range(TIMED_ROUNDS):
-        dev = feeder.get()
-        feeder.put(host_batches[(k + 2) % 3])
-        params, opt_state, stats = fn(params, opt_state, dev, r, coeffs)
-        loss = float(stats["total_loss"])  # sync
-    dt = (time.perf_counter() - t0) / TIMED_ROUNDS
+    for k in range(timed_rounds):
+        dev, bsize = feeder.get()
+        feeder.put(*host_batches[(k + 2) % 3])
+        stats = policy.learn_on_device_batch(dev, bsize)
+        stats["total_loss"]  # host sync already done by device_get
+    dt = (time.perf_counter() - t0) / timed_rounds
     feeder.stop()
-    return B / dt
+    return b / dt
 
 
-def bench_torch() -> float:
-    """Reference-semantics torch CPU learner: same net, same SGD nest."""
+def bench_torch(b=B, mb=MB, iters=ITERS) -> float:
+    """Reference-semantics torch CPU learner: same net, same SGD nest,
+    run in full (``rllib/policy/torch_policy.py:498-624``)."""
     import torch
     import torch.nn as nn
 
@@ -120,19 +120,19 @@ def bench_torch() -> float:
     net = Net()
     opt = torch.optim.Adam(net.parameters(), lr=5e-5)
     rng = np.random.default_rng(0)
-    b = make_batch(rng)
-    obs_u8 = torch.from_numpy(b["obs"].transpose(0, 3, 1, 2).copy())
-    actions = torch.from_numpy(b["actions"])
-    old_logp = torch.from_numpy(b["action_logp"])
-    adv = torch.from_numpy(b["advantages"])
-    vt = torch.from_numpy(b["value_targets"])
+    batch = make_batch(rng, b)
+    obs_u8 = torch.from_numpy(batch["obs"].transpose(0, 3, 1, 2).copy())
+    actions = torch.from_numpy(batch["actions"])
+    old_logp = torch.from_numpy(batch["action_logp"])
+    adv = torch.from_numpy(batch["advantages"])
+    vt = torch.from_numpy(batch["value_targets"])
 
-    def one_round(iters):
-        n_mb = B // MB
+    def one_nest():
+        n_mb = b // mb
         for _ in range(iters):
-            perm = torch.randperm(B)
+            perm = torch.randperm(b)
             for i in range(n_mb):
-                idx = perm[i * MB : (i + 1) * MB]
+                idx = perm[i * mb : (i + 1) * mb]
                 x = obs_u8[idx].float() / 255.0
                 logits, value = net(x)
                 logp = torch.log_softmax(logits, -1).gather(
@@ -149,11 +149,17 @@ def bench_torch() -> float:
                 loss.backward()
                 opt.step()
 
-    one_round(1)  # warmup
+    # warmup: one epoch to settle allocators/threads
+    n_mb = b // mb
+    for i in range(n_mb):
+        idx = torch.arange(i * mb, (i + 1) * mb)
+        logits, value = net(obs_u8[idx].float() / 255.0)
+        (logits.sum() + value.sum()).backward()
+        opt.zero_grad()
     t0 = time.perf_counter()
-    one_round(1)
-    dt = (time.perf_counter() - t0) * ITERS  # extrapolate to full nest
-    return B / dt
+    one_nest()
+    dt = time.perf_counter() - t0
+    return b / dt
 
 
 def main():

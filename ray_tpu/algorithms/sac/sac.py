@@ -381,30 +381,14 @@ class SACJaxPolicy(JaxPolicy):
         )
         return jax.jit(sharded, donate_argnums=(1,))
 
-    def learn_on_batch(self, samples: SampleBatch) -> Dict:
-        batch = self._batch_to_train_tree(samples)
-        bsize = int(next(iter(batch.values())).shape[0])
-        if bsize < self.n_shards:
-            reps = -(-self.n_shards // bsize)
-            batch = {
-                k: np.tile(v, (reps,) + (1,) * (v.ndim - 1))[
-                    : self.n_shards
-                ]
-                for k, v in batch.items()
-            }
-            bsize = self.n_shards
-        else:
-            trim = (bsize // self.n_shards) * self.n_shards
-            batch = {k: v[:trim] for k, v in batch.items()}
-            bsize = trim
-        fn = self._learn_fns.get(bsize)
-        if fn is None:
-            fn = self._build_learn_fn(bsize)
-            self._learn_fns[bsize] = fn
+    def learn_on_device_batch(self, dev_batch, batch_size: int) -> Dict:
+        """SAC's compiled fn threads aux_state (target critic) through the
+        update, so phase 2 is overridden; phase 1 (prepare_batch) and
+        learn_on_batch's composition are inherited from JaxPolicy."""
+        fn = self.learn_fn(batch_size)
         self._rng, rng = jax.random.split(self._rng)
-        batch_dev = jax.device_put(batch, self._data_sharding)
         self.params, self.opt_state, self.aux_state, stats = fn(
-            self.params, self.opt_state, self.aux_state, batch_dev,
+            self.params, self.opt_state, self.aux_state, dev_batch,
             rng, {},
         )
         self.num_grad_updates += 1

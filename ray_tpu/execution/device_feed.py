@@ -33,27 +33,30 @@ class DeviceFeeder:
             item = self._in.get()
             if item is None:
                 return
+            host_batch, meta = item
             try:
                 if self._sharding is not None:
-                    dev = jax.device_put(item, self._sharding)
+                    dev = jax.device_put(host_batch, self._sharding)
                 else:
-                    dev = jax.device_put(item)
+                    dev = jax.device_put(host_batch)
                 jax.block_until_ready(dev)
-                self._out.put(dev)
-            except Exception as e:  # surface to consumer
-                self._out.put(e)
+                self._out.put((dev, meta))
+            except Exception as e:  # surface to consumer, meta intact
+                self._out.put((e, meta))
 
-    def put(self, host_batch: Any) -> None:
-        """Enqueue a host batch for transfer."""
+    def put(self, host_batch: Any, meta: Any = None) -> None:
+        """Enqueue a host batch for transfer; ``meta`` rides along
+        untransferred (batch size, env-step count, ...)."""
         if self._stopped:
             raise RuntimeError("feeder stopped")
-        self._in.put(host_batch)
+        self._in.put((host_batch, meta))
 
-    def get(self, timeout: Optional[float] = None) -> Any:
-        """Dequeue the next device-resident batch (blocking)."""
+    def get(self, timeout: Optional[float] = None):
+        """Dequeue the next ``(device_batch, meta)`` pair (blocking).
+        Raises the transfer error if that batch's device_put failed."""
         out = self._out.get(timeout=timeout)
-        if isinstance(out, Exception):
-            raise out
+        if isinstance(out[0], Exception):
+            raise out[0]
         return out
 
     def qsize(self) -> int:

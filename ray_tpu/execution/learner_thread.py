@@ -2,9 +2,11 @@
 
 Counterpart of the reference's ``rllib/execution/learner_thread.py:17`` and
 ``multi_gpu_learner_thread.py:20`` (``step :140``). Rollout batches queue in
-from async worker polls; a DeviceFeeder pipeline overlaps host→device
-transfer with the jitted learner step so the TPU never idles on feed
-(replacing the reference's _MultiGPULoaderThread + tower-buffer protocol).
+from async worker polls; a DeviceFeeder pipelines host→device transfer so
+the copy of batch k+1 overlaps the jitted SGD step of batch k (the
+reference's _MultiGPULoaderThread + tower-buffer protocol, collapsed to a
+double-buffered ``jax.device_put`` thread). Policies without the two-phase
+JaxPolicy learn API fall back to synchronous ``learn_on_batch``.
 """
 
 from __future__ import annotations
@@ -15,6 +17,10 @@ import time
 from typing import Dict, Optional
 
 from ray_tpu.data.sample_batch import SampleBatch
+
+# Transfers in flight ahead of the compute step. 2 = classic double
+# buffering: one batch on device waiting, one being copied.
+PIPELINE_DEPTH = 2
 
 
 class LearnerThread(threading.Thread):
@@ -34,15 +40,84 @@ class LearnerThread(threading.Thread):
         self.learner_info: Dict = {}
         self.queue_timer = 0.0
         self.grad_timer = 0.0
+        # Pipeline only policies using the JaxPolicy two-phase learn API
+        # through the standard composition: a subclass that overrides
+        # learn_on_batch itself has semantics the split would bypass.
+        from ray_tpu.policy.jax_policy import JaxPolicy
+
+        self._pipelined = isinstance(policy, JaxPolicy) and (
+            type(policy).learn_on_batch is JaxPolicy.learn_on_batch
+        )
+        self._feeder = None
+        self._in_flight = 0
+
+    def _get_feeder(self):
+        # Lazy: build on the learner thread so jax initializes there.
+        if self._feeder is None:
+            from ray_tpu.execution.device_feed import DeviceFeeder
+
+            self._feeder = DeviceFeeder(self.policy.data_sharding)
+        return self._feeder
 
     def run(self) -> None:
-        while not self.stopped:
-            try:
-                self.step()
-            except queue.Empty:
-                continue
+        try:
+            while not self.stopped:
+                try:
+                    self.step()
+                except queue.Empty:
+                    continue
+        finally:
+            # The learner thread owns the feeder: stopping it here (not in
+            # stop(), which runs on another thread) avoids racing an
+            # in-progress _pump against the feeder's stopped flag.
+            if self._feeder is not None:
+                self._feeder.stop()
+
+    def _pump(self, block: bool) -> bool:
+        """Move one host batch inqueue → feeder. Returns True if moved."""
+        batch = self.inqueue.get(timeout=0.5) if block else (
+            self.inqueue.get_nowait()
+        )
+        if batch is None:
+            self.stopped = True
+            return False
+        tree, bsize = self.policy.prepare_batch(batch)
+        self._get_feeder().put(tree, (bsize, batch.env_steps()))
+        self._in_flight += 1
+        return True
 
     def step(self) -> None:
+        if not self._pipelined:
+            return self._step_sync()
+        t0 = time.perf_counter()
+        # Top up the transfer pipeline; block only when nothing is in
+        # flight (otherwise learn on what we have).
+        if self._in_flight == 0:
+            if not self._pump(block=True):
+                return
+        while self._in_flight < PIPELINE_DEPTH:
+            try:
+                if not self._pump(block=False):
+                    break
+            except queue.Empty:
+                break
+        try:
+            dev, (bsize, env_steps) = self._feeder.get()
+        finally:
+            # A failed transfer still consumed an in-flight slot.
+            self._in_flight -= 1
+        self.queue_timer += time.perf_counter() - t0
+        t0 = time.perf_counter()
+        info = self.policy.learn_on_device_batch(dev, bsize)
+        self.grad_timer += time.perf_counter() - t0
+        self.num_steps += 1
+        self.learner_info = info
+        try:
+            self.outqueue.put_nowait((env_steps, info))
+        except queue.Full:
+            pass
+
+    def _step_sync(self) -> None:
         t0 = time.perf_counter()
         batch = self.inqueue.get(timeout=0.5)
         self.queue_timer += time.perf_counter() - t0

@@ -338,13 +338,26 @@ class JaxPolicy(Policy):
         # concurrently (IMPALA sync mode shares the policy object).
         return jax.jit(sharded, donate_argnums=(1,))
 
-    def learn_on_batch(self, samples: SampleBatch) -> Dict[str, Any]:
-        """One full multi-epoch SGD update (reference
-        TorchPolicy.learn_on_batch :467 + the whole train_ops stack)."""
-        batch = self._batch_to_train_tree(samples)
+    def prepare_batch(self, samples) -> Tuple[Dict[str, np.ndarray], int]:
+        """Public phase 1 of learning: turn a SampleBatch (or plain dict of
+        arrays) into the host tree the compiled learn program consumes.
+
+        Enforces static-shape discipline — the leading dim must be a
+        multiple of the data shards; trims when possible, tiles tiny
+        batches up. Returns ``(host_tree, batch_size)``; the tree is ready
+        for ``jax.device_put`` onto ``self.data_sharding`` (directly or via
+        a :class:`~ray_tpu.execution.device_feed.DeviceFeeder`)."""
+        if isinstance(samples, SampleBatch) or not isinstance(
+            samples, dict
+        ):
+            batch = self._batch_to_train_tree(samples)
+        else:  # plain dict of arrays (benchmarks, tests)
+            batch = {
+                k: np.asarray(v)
+                for k, v in samples.items()
+                if isinstance(v, np.ndarray) and v.dtype != object
+            }
         bsize = int(next(iter(batch.values())).shape[0])
-        # Static-shape discipline: the leading dim must be a multiple of
-        # the data shards. Trim when possible; tile tiny batches up.
         if bsize < self.n_shards:
             reps = -(-self.n_shards // bsize)
             batch = {
@@ -359,23 +372,50 @@ class JaxPolicy(Policy):
             if trim != bsize:
                 batch = {k: v[:trim] for k, v in batch.items()}
                 bsize = trim
-        fn = self._learn_fns.get(bsize)
+        return batch, bsize
+
+    @property
+    def data_sharding(self):
+        """Sharding for train-batch leading-dim placement (public, for
+        DeviceFeeder wiring)."""
+        return self._data_sharding
+
+    def learn_fn(self, batch_size: int):
+        """Public accessor for the compiled SGD-nest program at a given
+        (post-``prepare_batch``) batch size. Signature of the returned
+        function is stable:
+
+            ``fn(params, opt_state, aux_state, batch, rng, coeffs)
+            -> (params, opt_state, stats)``
+
+        Benchmarks and learner threads must obtain the program here (or
+        use :meth:`learn_on_device_batch`) rather than via private
+        attributes, so internal refactors can't silently break them."""
+        fn = self._learn_fns.get(batch_size)
         if fn is None:
-            fn = self._build_learn_fn(bsize)
-            self._learn_fns[bsize] = fn
+            fn = self._build_learn_fn(batch_size)
+            self._learn_fns[batch_size] = fn
+        return fn
+
+    def learn_on_device_batch(
+        self, dev_batch: Dict[str, Any], batch_size: int
+    ) -> Dict[str, float]:
+        """Public phase 2 of learning: run the compiled SGD nest on an
+        already-device-resident batch (e.g. transferred ahead of time by a
+        DeviceFeeder so host→device copy overlapped the previous step)."""
+        fn = self.learn_fn(batch_size)
         self._update_scheduled_coeffs()
         self._rng, rng = jax.random.split(self._rng)
-        batch = _tree_to_device(batch, self._data_sharding)
         self.params, self.opt_state, stats = fn(
             self.params,
             self.opt_state,
             self.aux_state,
-            batch,
+            dev_batch,
             rng,
             self._coeff_array(),
         )
         self.num_grad_updates += self.num_sgd_iter * max(
-            1, bsize // max(1, self.minibatch_size)
+            1, batch_size // max(1, self.minibatch_size)
         )
         # One device→host transfer for all stats (individual float()
         # conversions each pay a full device round trip).
@@ -384,6 +424,15 @@ class JaxPolicy(Policy):
         out.update(self.after_learn_on_batch(out))
         out["cur_lr"] = self.coeff_values["lr"]
         return out
+
+    def learn_on_batch(self, samples: SampleBatch) -> Dict[str, Any]:
+        """One full multi-epoch SGD update (reference
+        TorchPolicy.learn_on_batch :467 + the whole train_ops stack).
+        ``jax.device_put`` dispatch is asynchronous, so the transfer
+        overlaps this host code until the program consumes the buffers."""
+        batch, bsize = self.prepare_batch(samples)
+        dev = _tree_to_device(batch, self._data_sharding)
+        return self.learn_on_device_batch(dev, bsize)
 
     def after_learn_on_batch(self, stats: Dict[str, float]) -> Dict[str, float]:
         """Hook for host-side coefficient updates (e.g. PPO kl coeff)."""

