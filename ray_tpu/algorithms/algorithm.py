@@ -214,17 +214,38 @@ class Algorithm(Trainable):
     # -- evaluation ------------------------------------------------------
 
     def evaluate(self) -> Dict:
-        """reference algorithm.py:650."""
+        """reference algorithm.py:650 — fans out across the evaluation
+        workers when ``evaluation_num_workers > 0``; weights AND
+        observation-filter statistics sync to every eval worker first
+        (stale MeanStd stats under-report the policy)."""
         assert self.evaluation_workers is not None
-        # sync current weights into eval workers
         weights = self.workers.local_worker().get_weights()
-        self.evaluation_workers.local_worker().set_weights(weights)
+        filters = self.workers.local_worker().get_filters()
+        lw = self.evaluation_workers.local_worker()
+        lw.set_weights(weights)
+        lw.sync_filters(filters)
+        remote = self.evaluation_workers.remote_workers()
+        if remote:
+            weights_ref = ray.put(weights)
+            ray.get(
+                [w.set_weights.remote(weights_ref) for w in remote]
+                + [w.sync_filters.remote(filters) for w in remote]
+            )
         duration = self.config.get("evaluation_duration", 10)
         episodes = []
-        lw = self.evaluation_workers.local_worker()
-        while len(episodes) < duration:
-            lw.sample()
-            episodes.extend(lw.get_metrics())
+        if remote:
+            # Round-robin sample rounds across the eval fleet until we
+            # have the requested number of episodes.
+            while len(episodes) < duration:
+                ray.get([w.sample.remote() for w in remote])
+                for eps in ray.get(
+                    [w.get_metrics.remote() for w in remote]
+                ):
+                    episodes.extend(eps)
+        else:
+            while len(episodes) < duration:
+                lw.sample()
+                episodes.extend(lw.get_metrics())
         return summarize_episodes(episodes)
 
     def compute_single_action(

@@ -101,7 +101,13 @@ def adjust_nstep(n_step: int, gamma: float, batch: SampleBatch) -> None:
     """In-place n-step reward folding (reference
     ``rllib/utils/replay_buffers/utils.py`` / dqn postprocessing):
     rewards[t] ← sum_{k<n} gamma^k r[t+k], new_obs[t] ← obs[t+n] with
-    termination-aware truncation."""
+    termination-aware truncation.
+
+    Records the actual number of folded steps per row in an ``n_steps``
+    column so the TD target can discount the bootstrap by gamma**k rather
+    than a uniform gamma**n_step — fragment tails fold fewer than n_step
+    rewards (the reference sidesteps this by only applying n-step to
+    episode-sliced trajectories)."""
     n = batch.count
     rewards = np.asarray(batch[SampleBatch.REWARDS], np.float32)
     dones = np.asarray(batch[SampleBatch.TERMINATEDS], bool)
@@ -109,6 +115,7 @@ def adjust_nstep(n_step: int, gamma: float, batch: SampleBatch) -> None:
     new_rewards = rewards.copy()
     new_next = next_obs.copy()
     new_dones = dones.copy()
+    n_steps = np.ones(n, np.float32)
     for t in range(n):
         acc = rewards[t]
         last = t
@@ -120,9 +127,11 @@ def adjust_nstep(n_step: int, gamma: float, batch: SampleBatch) -> None:
         new_rewards[t] = acc
         new_next[t] = next_obs[last]
         new_dones[t] = dones[last]
+        n_steps[t] = last - t + 1
     batch[SampleBatch.REWARDS] = new_rewards
     batch[SampleBatch.NEXT_OBS] = new_next
     batch[SampleBatch.TERMINATEDS] = new_dones
+    batch["n_steps"] = n_steps
 
 
 class DQNJaxPolicy(JaxPolicy):
@@ -147,6 +156,20 @@ class DQNJaxPolicy(JaxPolicy):
 
     def _init_aux_state(self):
         return {"target_params": self.params}
+
+    def update_config(self, new_config: Dict) -> None:
+        super().update_config(new_config)
+        self._epsilon_schedule = PiecewiseSchedule(
+            [
+                (0, self.config.get("initial_epsilon", 1.0)),
+                (
+                    self.config.get("epsilon_timesteps", 10000),
+                    self.config.get("final_epsilon", 0.02),
+                ),
+            ]
+        )
+        if hasattr(self, "_td_error_fn"):
+            del self._td_error_fn
 
     def update_target(self) -> None:
         """Copy online → target (reference update_target in
@@ -215,7 +238,10 @@ class DQNJaxPolicy(JaxPolicy):
 
     # -- loss ------------------------------------------------------------
 
-    def loss_with_aux(self, params, aux, batch, rng, coeffs):
+    def _td_error(self, params, aux, batch):
+        """Per-sample TD error (shared by the loss and the PER priority
+        refresh; reference dqn_torch_policy computes it inside QLoss and
+        exposes policy.compute_td_error)."""
         cfg = self.config
         gamma = cfg.get("gamma", 0.99)
         n_step = cfg.get("n_step", 1)
@@ -244,11 +270,23 @@ class DQNJaxPolicy(JaxPolicy):
         not_done = 1.0 - batch[SampleBatch.TERMINATEDS].astype(
             jnp.float32
         )
+        # Per-row bootstrap exponent: fragment tails fold fewer than
+        # n_step rewards (recorded by adjust_nstep in "n_steps").
+        steps = batch.get("n_steps")
+        bootstrap_discount = (
+            gamma ** steps if steps is not None else gamma**n_step
+        )
         td_target = (
             batch[SampleBatch.REWARDS]
-            + (gamma**n_step) * not_done * jax.lax.stop_gradient(q_next)
+            + bootstrap_discount
+            * not_done
+            * jax.lax.stop_gradient(q_next)
         )
         td_error = q_sel - jax.lax.stop_gradient(td_target)
+        return td_error, q_sel, q_all
+
+    def loss_with_aux(self, params, aux, batch, rng, coeffs):
+        td_error, q_sel, q_all = self._td_error(params, aux, batch)
         # Huber loss (reference huber_loss, delta=1)
         abs_err = jnp.abs(td_error)
         huber = jnp.where(
@@ -264,6 +302,20 @@ class DQNJaxPolicy(JaxPolicy):
             "max_q": jnp.max(q_all),
         }
         return loss, stats
+
+    def compute_td_error(self, samples) -> np.ndarray:
+        """Per-sample |TD error| for prioritized-replay updates, aligned
+        with the rows of ``samples`` (pre-tiling/trim: uses a plain jit
+        forward, not the sharded nest)."""
+        if not hasattr(self, "_td_error_fn"):
+            def fn(params, aux, batch):
+                td, _, _ = self._td_error(params, aux, batch)
+                return td
+
+            self._td_error_fn = jax.jit(fn)
+        batch = self._batch_to_train_tree(samples)
+        td = self._td_error_fn(self.params, self.aux_state, batch)
+        return np.abs(np.asarray(td))
 
     def after_learn_on_batch(self, stats):
         self._steps_since_target_update += 1
@@ -331,12 +383,22 @@ class DQN(Algorithm):
                 if prioritized:
                     buf = self.local_replay_buffer.buffers[pid]
                     if isinstance(buf, PrioritizedReplayBuffer):
-                        td = abs(info.get("mean_td_error", 0.0))
+                        # Per-sample |TD error| refresh (reference
+                        # dqn.py training_step → update_priorities):
+                        # a batch-mean scalar would cancel +/- errors
+                        # and collapse PER to uniform sampling.
+                        # Policies without per-sample errors (e.g.
+                        # continuous-action subclasses) fall back to
+                        # the batch-mean scalar.
+                        if hasattr(policy, "compute_td_error"):
+                            td = policy.compute_td_error(b)
+                        else:
+                            td = np.full(
+                                len(b["batch_indexes"]),
+                                abs(info.get("mean_td_error", 0.0)),
+                            )
                         buf.update_priorities(
-                            b["batch_indexes"],
-                            np.full(
-                                len(b["batch_indexes"]), td + 1e-6
-                            ),
+                            b["batch_indexes"], td + 1e-6
                         )
                 self._counters[NUM_ENV_STEPS_TRAINED] += b.count
             # target network sync

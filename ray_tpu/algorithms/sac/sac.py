@@ -381,6 +381,46 @@ class SACJaxPolicy(JaxPolicy):
         )
         return jax.jit(sharded, donate_argnums=(1,))
 
+    def compute_td_error(self, samples) -> np.ndarray:
+        """Per-sample |TD error| of the min-twin critic vs the soft TD
+        target, for prioritized-replay priority refresh (reference
+        sac_torch_policy keeps ``policy.td_error`` from the loss)."""
+        if not hasattr(self, "_td_error_fn"):
+            actor, critic = self.actor, self.critic
+            gamma = self.gamma**self.n_step
+            low, high = self.low, self.high
+
+            def fn(params, aux, batch, rng):
+                obs = batch[SampleBatch.OBS].astype(jnp.float32)
+                next_obs = batch[SampleBatch.NEXT_OBS].astype(
+                    jnp.float32
+                )
+                rewards = batch[SampleBatch.REWARDS].astype(jnp.float32)
+                not_done = 1.0 - batch[
+                    SampleBatch.TERMINATEDS
+                ].astype(jnp.float32)
+                actions = batch[SampleBatch.ACTIONS].astype(jnp.float32)
+                alpha = jnp.exp(params["log_alpha"])
+                next_dist = SquashedGaussian(
+                    actor.apply(params["actor"], next_obs),
+                    low=low,
+                    high=high,
+                )
+                next_a, next_logp = next_dist.sampled_action_logp(rng)
+                tq1, tq2 = critic.apply(
+                    aux["target_critic"], next_obs, next_a
+                )
+                target_q = jnp.minimum(tq1, tq2) - alpha * next_logp
+                td_target = rewards + gamma * not_done * target_q
+                q1, q2 = critic.apply(params["critic"], obs, actions)
+                return jnp.minimum(q1, q2) - td_target
+
+            self._td_error_fn = jax.jit(fn)
+        batch = self._batch_to_train_tree(samples)
+        self._rng, rng = jax.random.split(self._rng)
+        td = self._td_error_fn(self.params, self.aux_state, batch, rng)
+        return np.abs(np.asarray(td))
+
     def learn_on_device_batch(self, dev_batch, batch_size: int) -> Dict:
         """SAC's compiled fn threads aux_state (target critic) through the
         update, so phase 2 is overridden; phase 1 (prepare_batch) and

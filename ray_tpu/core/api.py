@@ -546,20 +546,35 @@ def wait(
     def notify():
         evt.set()
 
-    while True:
-        ready = [r for r in refs if rt.store.is_ready(r.id)]
-        if len(ready) >= num_returns:
-            break
-        if deadline is not None and time.time() >= deadline:
-            break
-        evt.clear()
-        for r in refs:
-            if not rt.store.is_ready(r.id):
-                rt.store.on_ready(r.id, notify)
-        remaining_t = (
-            None if deadline is None else max(0.0, deadline - time.time())
-        )
-        evt.wait(remaining_t)
+    registered: set = set()
+    try:
+        while True:
+            # Clear BEFORE scanning: a ref completing after the scan
+            # sets the event, so the wakeup cannot be lost between the
+            # scan and the wait.
+            evt.clear()
+            ready = [r for r in refs if rt.store.is_ready(r.id)]
+            if len(ready) >= num_returns:
+                break
+            if deadline is not None and time.time() >= deadline:
+                break
+            for r in refs:
+                if r.id not in registered and not rt.store.is_ready(
+                    r.id
+                ):
+                    rt.store.on_ready(r.id, notify)
+                    registered.add(r.id)
+            remaining_t = (
+                None
+                if deadline is None
+                else max(0.0, deadline - time.time())
+            )
+            evt.wait(remaining_t)
+    finally:
+        # Deregister: repeated wait() polls on long-pending refs must
+        # not accumulate callbacks on the store entries.
+        for rid in registered:
+            rt.store.discard_callback(rid, notify)
     ready, not_ready = [], []
     for r in refs:
         if rt.store.is_ready(r.id) and len(ready) < num_returns:

@@ -142,6 +142,18 @@ class ObjectStore:
         if run_now:
             callback()
 
+    def discard_callback(self, obj_id: str, callback) -> None:
+        """Deregister a pending on_ready callback (no-op if absent/fired).
+        Lets wait() clean up after itself instead of accumulating dead
+        callbacks on never-ready entries."""
+        with self._lock:
+            e = self._entries.get(obj_id)
+            if e is not None:
+                try:
+                    e.callbacks.remove(callback)
+                except ValueError:
+                    pass
+
     def shm_name(self, obj_id: str) -> Optional[str]:
         e = self._entries.get(obj_id)
         return e.shm.name if e and e.shm else None

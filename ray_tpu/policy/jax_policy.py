@@ -489,6 +489,52 @@ class JaxPolicy(Policy):
 
     # -- weights ---------------------------------------------------------
 
+    def update_config(self, new_config: Dict) -> None:
+        """Apply mutated hyperparameters at runtime (PBT explore,
+        reference tune/schedulers/pbt.py does this via checkpoint+restart
+        of the whole trial). Loss constants (clip_param, vf_loss_coeff,
+        ...) are baked into the compiled learn programs, so those are
+        dropped for re-trace; lr/entropy schedules are rebuilt from the
+        new config; subclass coefficients re-derived."""
+        self.config.update(new_config)
+        from ray_tpu.utils.schedules import make_schedule
+
+        self._lr_schedule = make_schedule(
+            self.config.get("lr_schedule"), self.config.get("lr", 5e-5)
+        )
+        self._entropy_schedule = make_schedule(
+            self.config.get("entropy_coeff_schedule"),
+            self.config.get("entropy_coeff", 0.0),
+        )
+        # Re-derive loss coefficients from the mutated config, but keep
+        # adaptive state (e.g. PPO's kl_coeff) for keys NOT explicitly
+        # mutated — exploit just restored the donor's adapted values.
+        adapted = {
+            k: v
+            for k, v in self.coeff_values.items()
+            if k not in new_config
+        }
+        self._init_coeffs()
+        self.coeff_values.update(
+            {k: v for k, v in adapted.items() if k in self.coeff_values}
+        )
+        self._update_scheduled_coeffs()
+        # SGD geometry is cached at init and baked into the compiled
+        # nest; refresh it so mutations of these knobs take effect.
+        self.train_batch_size = int(
+            self.config.get("train_batch_size", self.train_batch_size)
+        )
+        self.minibatch_size = int(
+            self.config.get("sgd_minibatch_size")
+            or self.config.get("train_batch_size", self.minibatch_size)
+        )
+        self.num_sgd_iter = int(
+            self.config.get("num_sgd_iter", self.num_sgd_iter)
+        )
+        self._learn_fns.clear()
+        if hasattr(self, "_grad_fn"):
+            del self._grad_fn
+
     def get_weights(self):
         return jax.device_get(self.params)
 

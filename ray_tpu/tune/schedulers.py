@@ -169,21 +169,25 @@ class PopulationBasedTraining(TrialScheduler):
                 if isinstance(base, (int, float)):
                     new_config[key] = type(base)(base * factor)
         trial.config = new_config
-        # push mutated scalars into the live policy where possible
+        # Push mutated scalars into the live policy. update_config
+        # rebuilds lr/entropy schedules and drops the compiled learn
+        # programs (loss constants are baked into the XLA programs, and
+        # scheduled coeffs are overwritten each learn call — plain
+        # coeff_values/config writes would silently have no effect).
         if trial.runner is not None and hasattr(
             trial.runner, "get_policy"
         ):
             try:
                 pol = trial.runner.get_policy()
-                if "lr" in new_config:
-                    pol.coeff_values["lr"] = float(new_config["lr"])
-                pol.config.update(
-                    {
-                        k: v
-                        for k, v in new_config.items()
-                        if not isinstance(v, dict)
-                    }
-                )
+                scalars = {
+                    k: v
+                    for k, v in new_config.items()
+                    if not isinstance(v, dict)
+                }
+                if hasattr(pol, "update_config"):
+                    pol.update_config(scalars)
+                else:
+                    pol.config.update(scalars)
             except Exception:
                 pass
         self.num_perturbations += 1

@@ -124,3 +124,42 @@ def test_ppo_with_remote_workers():
     result = algo.train()
     assert result["num_env_steps_sampled"] >= 256
     algo.cleanup()
+
+
+def test_evaluate_syncs_filters_and_uses_remote_eval_workers():
+    """ADVICE r1: evaluation must sync MeanStd filter stats (not just
+    weights) and actually use the remote eval workers it creates."""
+    from ray_tpu.algorithms.ppo import PPO, PPOConfig
+
+    algo = (
+        PPOConfig()
+        .environment("CartPole-v1")
+        .rollouts(
+            num_rollout_workers=0,
+            rollout_fragment_length=64,
+            observation_filter="MeanStdFilter",
+        )
+        .training(
+            train_batch_size=128, sgd_minibatch_size=64, num_sgd_iter=2
+        )
+        .evaluation(
+            evaluation_interval=1,
+            evaluation_duration=2,
+            evaluation_num_workers=1,
+        )
+        .debugging(seed=0)
+        .build()
+    )
+    algo.train()
+    ev = algo.evaluate()
+    assert "episode_reward_mean" in ev
+    assert np.isfinite(ev["episode_reward_mean"])
+    # local eval worker's filter received the training statistics
+    train_filt = algo.workers.local_worker().get_filters()
+    eval_filt = algo.evaluation_workers.local_worker().get_filters()
+    assert train_filt, "MeanStdFilter expected on the training worker"
+    for pid, f in train_filt.items():
+        # eval filter received the training statistics (>= because eval
+        # sampling may have pushed more into its own copy since)
+        assert eval_filt[pid].rs.num >= f.rs.num > 0
+    algo.cleanup()

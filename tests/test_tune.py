@@ -136,3 +136,54 @@ def test_tune_with_ppo():
     for t in analysis.trials:
         assert t.status == "TERMINATED", t.error
         assert "episode_reward_mean" in t.last_result
+
+
+def test_pbt_mutation_reaches_live_policy():
+    """ADVICE r1: PBT explore must actually change training — rebuild
+    schedules and drop compiled learn programs — not just write into
+    dicts that the next learn call overwrites."""
+    import gymnasium as gym
+    import numpy as np
+
+    from ray_tpu.algorithms.ppo.ppo import PPOJaxPolicy
+    from ray_tpu.data.sample_batch import SampleBatch
+
+    pol = PPOJaxPolicy(
+        gym.spaces.Box(-1, 1, (4,), np.float32),
+        gym.spaces.Discrete(2),
+        {"train_batch_size": 64, "sgd_minibatch_size": 32,
+         "num_sgd_iter": 1, "lr": 1e-3, "clip_param": 0.3},
+    )
+    rng = np.random.default_rng(0)
+
+    def batch():
+        return SampleBatch({
+            SampleBatch.OBS: rng.standard_normal((64, 4)).astype(
+                np.float32
+            ),
+            SampleBatch.ACTIONS: rng.integers(0, 2, 64).astype(
+                np.int64
+            ),
+            SampleBatch.ACTION_LOGP: np.full(64, -0.69, np.float32),
+            SampleBatch.ACTION_DIST_INPUTS: rng.standard_normal(
+                (64, 2)
+            ).astype(np.float32),
+            SampleBatch.ADVANTAGES: rng.standard_normal(64).astype(
+                np.float32
+            ),
+            SampleBatch.VALUE_TARGETS: rng.standard_normal(64).astype(
+                np.float32
+            ),
+        })
+
+    info = pol.learn_on_batch(batch())
+    assert np.isclose(info["cur_lr"], 1e-3)
+    assert len(pol._learn_fns) == 1
+
+    pol.update_config({"lr": 5e-4, "clip_param": 0.1})
+    # compiled programs dropped (clip_param is baked into them)
+    assert len(pol._learn_fns) == 0
+    info = pol.learn_on_batch(batch())
+    # the new lr survives _update_scheduled_coeffs on the next learn
+    assert np.isclose(info["cur_lr"], 5e-4)
+    assert pol.config["clip_param"] == 0.1
