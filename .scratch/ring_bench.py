@@ -1,0 +1,23 @@
+import numpy as np, time, ray_tpu as ray
+
+def bench(env, shape):
+    ray.init(num_cpus=1, ignore_reinit_error=True, worker_env=env)
+    try:
+        payload = np.ones(shape, np.float32)
+        @ray.remote
+        def produce():
+            return payload
+        ray.get(produce.remote())
+        t0 = time.perf_counter()
+        for _ in range(30):
+            ray.get(produce.remote())
+        return (time.perf_counter() - t0) / 30
+    finally:
+        ray.shutdown()
+
+if __name__ == "__main__":
+    for kb in (16, 48, 96, 192, 512):
+        shape = (kb * 256,)
+        tr = bench({}, shape)
+        tp = bench({"RAY_TPU_DISABLE_RING": "1"}, shape)
+        print(f"{kb:4d}KB  ring={tr*1e3:7.3f}ms  no-ring={tp*1e3:7.3f}ms  ratio={tp/tr:5.2f}x", flush=True)

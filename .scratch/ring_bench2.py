@@ -1,0 +1,22 @@
+import numpy as np, time, ray_tpu as ray
+
+def bench(env, kb, n=64):
+    ray.init(num_cpus=2, ignore_reinit_error=True, worker_env=env)
+    try:
+        payload = np.ones((kb * 256,), np.float32)
+        @ray.remote
+        def produce():
+            return payload
+        ray.get([produce.remote() for _ in range(4)])
+        t0 = time.perf_counter()
+        refs = [produce.remote() for _ in range(n)]
+        ray.get(refs)
+        return (time.perf_counter() - t0) / n
+    finally:
+        ray.shutdown()
+
+if __name__ == "__main__":
+    for kb in (48, 96, 192):
+        tr = bench({}, kb)
+        tp = bench({"RAY_TPU_DISABLE_RING": "1"}, kb)
+        print(f"pipelined {kb:4d}KB  ring={tr*1e3:7.3f}ms  no-ring={tp*1e3:7.3f}ms  ratio={tp/tr:5.2f}x", flush=True)

@@ -53,6 +53,8 @@ class _WorkerHandle:
         self.inflight: Dict[str, "_TaskRecord"] = {}
         self.send_lock = threading.Lock()
         self.recv_thread: Optional[threading.Thread] = None
+        self.ring = None  # bulk-result ShmRing (attached lazily)
+        self.ring_results = 0
 
 
 class _TaskRecord:
@@ -128,14 +130,41 @@ class _Runtime:
             self._on_result(w, msg)
 
     def _on_result(self, w: _WorkerHandle, msg: Dict):
+        status = msg["status"]
+        if status == "ring":
+            # Worker announced its bulk-result ring: attach as consumer.
+            try:
+                from ray_tpu.core.shm_ring import ShmRing
+
+                w.ring = ShmRing.attach(msg["ring_name"])
+            except Exception:
+                w.ring = None
+            return
         task_id = msg.get("task_id")
         with self.lock:
             rec = w.inflight.pop(task_id, None)
-        status = msg["status"]
         if status == "ok":
             self.store.put(
                 task_id, ser.loads(msg["value_blob"]), use_shm=False
             )
+        elif status == "ok_ring":
+            # The record was pushed before the control message was sent,
+            # so the next ring record is this task's payload (SPSC FIFO).
+            data = w.ring.pop_bytes(timeout=30.0) if w.ring else None
+            if data is None:
+                self.store.put_error(
+                    task_id,
+                    WorkerCrashedError(
+                        "bulk result missing from worker ring"
+                    ),
+                )
+            else:
+                w.ring_results += 1
+                self.store.put(
+                    task_id,
+                    ser.read_from_buffer(memoryview(data)),
+                    use_shm=False,
+                )
         elif status == "ok_shm":
             self.store.attach_shm(task_id, msg["shm_name"])
         else:
@@ -154,6 +183,12 @@ class _Runtime:
             if w.dead:
                 return
             w.dead = True
+            if w.ring is not None:
+                try:
+                    w.ring.close()
+                except Exception:
+                    pass
+                w.ring = None
             inflight = list(w.inflight.values())
             w.inflight.clear()
             if not w.dedicated:

@@ -84,6 +84,26 @@ def worker_main(conn, worker_id: str, env_overrides: Dict[str, str]):
     actors: Dict[str, Any] = {}
     result_shms = []  # keep created segments alive until driver owns them
 
+    # Bulk-result data plane: a persistent native SPSC ring to the driver
+    # (the plasma role for produced-once/consumed-once payloads, e.g.
+    # rollout SampleBatches — reference src/ray/object_manager/plasma/
+    # store.h:55). Results in [ring_min, capacity/2] ride the ring; larger
+    # ones fall back to a dedicated shm segment; small ones stay on the
+    # pipe. Gate via worker_env RAY_TPU_DISABLE_RING=1.
+    ring = None
+    ring_cap = int(
+        os.environ.get("RAY_TPU_RING_CAPACITY", 64 * 1024 * 1024)
+    )
+    ring_min = int(os.environ.get("RAY_TPU_RING_MIN_BYTES", 32 * 1024))
+    if os.environ.get("RAY_TPU_DISABLE_RING") != "1":
+        try:
+            from ray_tpu.core.shm_ring import ShmRing
+
+            ring = ShmRing.create(f"rtring_{worker_id}", ring_cap)
+            conn.send({"status": "ring", "ring_name": ring.name})
+        except Exception:
+            ring = None
+
     while True:
         try:
             msg = conn.recv()
@@ -141,9 +161,27 @@ def worker_main(conn, worker_id: str, env_overrides: Dict[str, str]):
 
         if msg.get("task_id") is None:
             continue
-        # Serialize result; large payloads go out via a fresh shm segment.
+        # Serialize result; bulk payloads ride the ring, very large ones
+        # a fresh shm segment, small ones the pipe.
         meta, buffers = ser.serialize(value)
         size = ser.serialized_size(meta, buffers)
+        if ring is not None and ring_min <= size <= ring_cap // 2:
+            payload = bytearray(size)
+            ser.write_to_buffer(memoryview(payload), meta, buffers)
+            try:
+                pushed = ring.push_bytes(bytes(payload), timeout=5.0)
+            except (BrokenPipeError, ValueError):
+                pushed = False
+            if pushed:
+                conn.send(
+                    {
+                        "task_id": msg["task_id"],
+                        "status": "ok_ring",
+                        "nbytes": size,
+                    }
+                )
+                continue
+            # ring congested/unusable: fall through to segment/pipe
         if size >= 256 * 1024:
             shm = shared_memory.SharedMemory(
                 create=True, size=size, name=f"rt_{msg['task_id'][:24]}"
@@ -166,6 +204,12 @@ def worker_main(conn, worker_id: str, env_overrides: Dict[str, str]):
                 }
             )
 
+    if ring is not None:
+        try:
+            ring.mark_closed()
+            ring.close()
+        except Exception:
+            pass
     for shm, _ in (v for v in shm_cache.values() if v[0] is not None):
         try:
             shm.close()
