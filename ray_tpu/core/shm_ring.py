@@ -19,6 +19,23 @@ from ray_tpu.native.build import ensure_built
 _lib = None
 
 
+def _poll(timeout: Optional[float]):
+    """Attempt-pacing generator: yields immediately, then sleeps with
+    50µs→2ms exponential backoff between attempts until the deadline.
+    The shared wait scaffold for producer (ring full) and consumer
+    (ring empty) sides."""
+    import time as _time
+
+    deadline = None if timeout is None else _time.monotonic() + timeout
+    sleep_s = 50e-6
+    while True:
+        yield
+        if deadline is not None and _time.monotonic() >= deadline:
+            return
+        _time.sleep(sleep_s)
+        sleep_s = min(sleep_s * 2, 2e-3)
+
+
 def _load():
     global _lib
     if _lib is None:
@@ -27,19 +44,6 @@ def _load():
         lib.shmring_create.argtypes = [ctypes.c_char_p, ctypes.c_uint64]
         lib.shmring_attach.restype = ctypes.c_void_p
         lib.shmring_attach.argtypes = [ctypes.c_char_p]
-        lib.shmring_push.restype = ctypes.c_int
-        lib.shmring_push.argtypes = [
-            ctypes.c_void_p,
-            ctypes.c_char_p,
-            ctypes.c_uint64,
-        ]
-        lib.shmring_push_wait.restype = ctypes.c_int
-        lib.shmring_push_wait.argtypes = [
-            ctypes.c_void_p,
-            ctypes.c_char_p,
-            ctypes.c_uint64,
-            ctypes.c_int64,
-        ]
         lib.shmring_peek_len.restype = ctypes.c_int64
         lib.shmring_peek_len.argtypes = [ctypes.c_void_p]
         lib.shmring_pop.restype = ctypes.c_int64
@@ -48,13 +52,13 @@ def _load():
             ctypes.c_char_p,
             ctypes.c_uint64,
         ]
-        lib.shmring_pop_wait.restype = ctypes.c_int64
-        lib.shmring_pop_wait.argtypes = [
-            ctypes.c_void_p,
-            ctypes.c_char_p,
-            ctypes.c_uint64,
-            ctypes.c_int64,
-        ]
+        lib.shmring_reserve.restype = ctypes.c_int64
+        lib.shmring_reserve.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+        lib.shmring_commit.argtypes = [ctypes.c_void_p]
+        lib.shmring_data.restype = ctypes.c_void_p
+        lib.shmring_data.argtypes = [ctypes.c_void_p]
+        lib.shmring_capacity.restype = ctypes.c_uint64
+        lib.shmring_capacity.argtypes = [ctypes.c_void_p]
         lib.shmring_size.restype = ctypes.c_uint64
         lib.shmring_size.argtypes = [ctypes.c_void_p]
         lib.shmring_num_pushed.restype = ctypes.c_uint64
@@ -77,6 +81,15 @@ class ShmRing:
         self._h = handle
         self._owner = owner
         self._closed = False
+        # Writable view over the mapped data area for zero-copy pushes:
+        # the serializer writes record payloads straight into shared
+        # memory between reserve and commit.
+        lib = _load()
+        cap = lib.shmring_capacity(handle)
+        addr = lib.shmring_data(handle)
+        self._data = memoryview(
+            (ctypes.c_char * cap).from_address(addr)
+        ).cast("B")
 
     @classmethod
     def create(cls, name: str, capacity: int = 64 * 1024 * 1024) -> "ShmRing":
@@ -96,54 +109,72 @@ class ShmRing:
 
     # -- raw bytes -------------------------------------------------------
 
-    def push_bytes(self, data: bytes, timeout: Optional[float] = 10.0) -> bool:
+    def _reserve_wait(self, size: int, timeout: Optional[float]) -> int:
+        """Reserve `size` bytes, waiting for the consumer to drain if the
+        ring is full. Returns the payload offset or -1 on timeout."""
         lib = _load()
-        t_ms = -1 if timeout is None else int(timeout * 1000)
-        rc = lib.shmring_push_wait(self._h, data, len(data), t_ms)
-        if rc == -2:
-            raise ValueError(
-                f"record of {len(data)} bytes exceeds ring capacity"
-            )
-        if rc == -3:
-            raise BrokenPipeError("ring closed")
-        return rc == 0
+        for _ in _poll(timeout):
+            off = lib.shmring_reserve(self._h, size)
+            if off >= 0:
+                return off
+            if off == -2:
+                raise ValueError(
+                    f"record of {size} bytes cannot fit in the ring"
+                )
+            if off == -3:
+                raise BrokenPipeError("ring closed")
+        return -1
 
-    def pop_bytes(self, timeout: Optional[float] = 10.0) -> Optional[bytes]:
+    def push_bytes(self, data, timeout: Optional[float] = 10.0) -> bool:
+        off = self._reserve_wait(len(data), timeout)
+        if off < 0:
+            return False
+        self._data[off : off + len(data)] = data
+        _load().shmring_commit(self._h)
+        return True
+
+    def pop_bytes(self, timeout: Optional[float] = 10.0):
+        """Pop one record (single memcpy out of shm). Returns a writable
+        buffer (numpy uint8 array — uninitialized alloc, no memset) or
+        None on timeout."""
+        import numpy as _np
+
         lib = _load()
-        n = lib.shmring_peek_len(self._h)
-        t_ms = -1 if timeout is None else int(timeout * 1000)
-        if n < 0:
-            # wait for a record
-            buf = ctypes.create_string_buffer(1)
-            n = lib.shmring_pop_wait(self._h, buf, 0, 0)
-        # allocate exactly and pop
-        while True:
+        for _ in _poll(timeout):
             n = lib.shmring_peek_len(self._h)
             if n >= 0:
-                buf = ctypes.create_string_buffer(int(n))
-                got = lib.shmring_pop(self._h, buf, n)
+                n = int(n)
+                buf = _np.empty(n, _np.uint8)
+                got = lib.shmring_pop(
+                    self._h,
+                    (ctypes.c_char * n).from_buffer(buf.data),
+                    n,
+                )
                 if got >= 0:
-                    return buf.raw[:got]
-            else:
-                buf = ctypes.create_string_buffer(8)
-                got = lib.shmring_pop_wait(self._h, buf, 8, t_ms)
-                if got == -1:
-                    return None  # timeout
-                if got == -3:
-                    raise BrokenPipeError("ring closed")
-                if got == -2:
-                    continue  # record bigger than probe buf; re-peek
-                return buf.raw[:got]
+                    return buf.data  # memoryview-compatible buffer
+            elif lib.shmring_is_closed(self._h):
+                raise BrokenPipeError("ring closed")
+        return None
 
     # -- objects ---------------------------------------------------------
+
+    def push_serialized(
+        self, meta, buffers, size: int, timeout: Optional[float] = 10.0
+    ) -> bool:
+        """Write a pre-serialized record straight into shared memory
+        (zero intermediate copies: reserve → write_to_buffer → commit)."""
+        off = self._reserve_wait(size, timeout)
+        if off < 0:
+            return False
+        ser.write_to_buffer(self._data[off : off + size], meta, buffers)
+        _load().shmring_commit(self._h)
+        return True
 
     def push(self, obj: Any, timeout: Optional[float] = 10.0) -> bool:
         """Serialize (out-of-band numpy buffers inline) and push."""
         meta, buffers = ser.serialize(obj)
         size = ser.serialized_size(meta, buffers)
-        payload = bytearray(size)
-        ser.write_to_buffer(memoryview(payload), meta, buffers)
-        return self.push_bytes(bytes(payload), timeout)
+        return self.push_serialized(meta, buffers, size, timeout)
 
     def pop(self, timeout: Optional[float] = 10.0) -> Any:
         data = self.pop_bytes(timeout)

@@ -25,7 +25,6 @@
 #include <fcntl.h>
 #include <sys/mman.h>
 #include <sys/stat.h>
-#include <time.h>
 #include <unistd.h>
 
 namespace {
@@ -48,6 +47,7 @@ struct Ring {
   uint8_t* data;
   size_t map_size;
   int owner;
+  uint64_t pending_tail;  // tail to publish at next commit (producer only)
   char name[256];
 };
 
@@ -69,8 +69,10 @@ void* shmring_create(const char* name, uint64_t capacity) {
     shm_unlink(name);
     return nullptr;
   }
-  void* mem =
-      mmap(nullptr, total, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  // MAP_POPULATE pre-faults the whole mapping once so steady-state
+  // pushes never pay per-page soft faults as the cursor sweeps the ring.
+  void* mem = mmap(nullptr, total, PROT_READ | PROT_WRITE,
+                   MAP_SHARED | MAP_POPULATE, fd, 0);
   close(fd);
   if (mem == MAP_FAILED) {
     shm_unlink(name);
@@ -102,6 +104,9 @@ void* shmring_attach(const char* name) {
     close(fd);
     return nullptr;
   }
+  // No MAP_POPULATE here: attach runs on the driver's recv thread and
+  // prefaulting 64MB there would block result handling; the consumer
+  // faults pages in lazily on first sweep only.
   void* mem = mmap(nullptr, (size_t)st.st_size, PROT_READ | PROT_WRITE,
                    MAP_SHARED, fd, 0);
   close(fd);
@@ -120,9 +125,13 @@ void* shmring_attach(const char* name) {
   return r;
 }
 
-// Push one record. Returns 0 on success, -1 if not enough space, -2 if
-// the record can never fit, -3 if the ring is closed.
-int shmring_push(void* ring, const uint8_t* buf, uint64_t len) {
+// Zero-copy producer API: reserve space for a `len`-byte record and
+// return the offset into the data area where the payload may be written
+// directly (e.g. by the Python serializer writing into the mapped
+// buffer). The record becomes visible to the consumer only at
+// shmring_commit. Returns the payload offset, -1 if the ring is
+// currently too full, -2 if the record can never fit, -3 if closed.
+int64_t shmring_reserve(void* ring, uint64_t len) {
   Ring* r = (Ring*)ring;
   Header* h = r->hdr;
   if (h->closed.load(std::memory_order_acquire)) return -3;
@@ -137,10 +146,14 @@ int shmring_push(void* ring, const uint8_t* buf, uint64_t len) {
   uint64_t total_need = need;
   bool wrap = false;
   if (contiguous < need) {
-    // need a wrap marker (8 bytes) + the record at buffer start
     total_need = contiguous + need;
     wrap = true;
   }
+  // At this cursor position the record needs total_need bytes of free
+  // space; if that exceeds the capacity it can NEVER fit here no matter
+  // how far the consumer drains — report -2 (permanent) rather than -1
+  // (retry), or the producer would spin until timeout.
+  if (total_need > cap) return -2;
   if (used + total_need > cap) return -1;  // full
   if (wrap) {
     *(uint64_t*)(r->data + tpos) = kWrapMarker;
@@ -148,10 +161,22 @@ int shmring_push(void* ring, const uint8_t* buf, uint64_t len) {
     tpos = 0;
   }
   *(uint64_t*)(r->data + tpos) = len;
-  memcpy(r->data + tpos + 8, buf, len);
-  h->tail.store(tail + need, std::memory_order_release);
-  h->n_pushed.fetch_add(1, std::memory_order_relaxed);
-  return 0;
+  r->pending_tail = tail + need;
+  return (int64_t)(tpos + 8);
+}
+
+// Publish the record written after shmring_reserve.
+void shmring_commit(void* ring) {
+  Ring* r = (Ring*)ring;
+  r->hdr->tail.store(r->pending_tail, std::memory_order_release);
+  r->hdr->n_pushed.fetch_add(1, std::memory_order_relaxed);
+}
+
+// Base address of the data area (for mapping a memoryview in Python).
+void* shmring_data(void* ring) { return ((Ring*)ring)->data; }
+
+uint64_t shmring_capacity(void* ring) {
+  return ((Ring*)ring)->hdr->capacity;
 }
 
 // Peek the next record's length. Returns length, -1 if empty.
@@ -190,44 +215,6 @@ int64_t shmring_pop(void* ring, uint8_t* buf, uint64_t maxlen) {
                 std::memory_order_release);
   h->n_popped.fetch_add(1, std::memory_order_relaxed);
   return len;
-}
-
-// Blocking pop with timeout (ms). Spin with exponential backoff sleep.
-int64_t shmring_pop_wait(void* ring, uint8_t* buf, uint64_t maxlen,
-                         int64_t timeout_ms) {
-  struct timespec start, now;
-  clock_gettime(CLOCK_MONOTONIC, &start);
-  long sleep_us = 50;
-  while (true) {
-    int64_t n = shmring_pop(ring, buf, maxlen);
-    if (n != -1) return n;
-    Ring* r = (Ring*)ring;
-    if (r->hdr->closed.load(std::memory_order_acquire)) return -3;
-    clock_gettime(CLOCK_MONOTONIC, &now);
-    int64_t elapsed_ms = (now.tv_sec - start.tv_sec) * 1000 +
-                         (now.tv_nsec - start.tv_nsec) / 1000000;
-    if (timeout_ms >= 0 && elapsed_ms >= timeout_ms) return -1;
-    usleep((useconds_t)sleep_us);
-    if (sleep_us < 2000) sleep_us *= 2;
-  }
-}
-
-// Blocking push with timeout (ms): waits for space.
-int shmring_push_wait(void* ring, const uint8_t* buf, uint64_t len,
-                      int64_t timeout_ms) {
-  struct timespec start, now;
-  clock_gettime(CLOCK_MONOTONIC, &start);
-  long sleep_us = 50;
-  while (true) {
-    int rc = shmring_push(ring, buf, len);
-    if (rc != -1) return rc;
-    clock_gettime(CLOCK_MONOTONIC, &now);
-    int64_t elapsed_ms = (now.tv_sec - start.tv_sec) * 1000 +
-                         (now.tv_nsec - start.tv_nsec) / 1000000;
-    if (timeout_ms >= 0 && elapsed_ms >= timeout_ms) return -1;
-    usleep((useconds_t)sleep_us);
-    if (sleep_us < 2000) sleep_us *= 2;
-  }
 }
 
 uint64_t shmring_size(void* ring) {

@@ -58,6 +58,20 @@ def test_oversized_record_raises():
     ring.close()
 
 
+def test_never_fits_at_cursor_raises_not_spins():
+    """A record that cannot fit at the current cursor position (wrap
+    marker + record > capacity) must fail fast with ValueError so the
+    producer falls back to the segment path — not retry until timeout."""
+    ring = ShmRing.create("test_ring_e2", 4096)
+    assert ring.push_bytes(bytes(2040))
+    assert bytes(ring.pop_bytes()) == bytes(2040)
+    # Ring is empty but the cursor sits mid-buffer: 2048-byte record
+    # needs wrap-skip (2048) + record (2056) > capacity (4096).
+    with pytest.raises(ValueError):
+        ring.push_bytes(bytes(2048), timeout=0.5)
+    ring.close()
+
+
 def test_object_roundtrip_numpy():
     from ray_tpu.data.sample_batch import SampleBatch
 
@@ -121,14 +135,15 @@ def test_bulk_task_results_traverse_ring():
     try:
         @ray.remote
         def big():
-            return np.ones((300, 1024), np.float32)  # ~1.2 MB
+            # ~600 KB: inside the ring's routing band [32KB, 768KB]
+            return np.ones((150, 1024), np.float32)
 
         @ray.remote
         def small():
             return 1
 
         out = ray.get(big.remote())
-        assert out.shape == (300, 1024)
+        assert out.shape == (150, 1024)
         assert ray.get(small.remote()) == 1
         rt = ray.core.api._require_runtime()
         ring_counts = [w.ring_results for w in rt.pool]
@@ -163,12 +178,21 @@ def test_actor_bulk_results_traverse_ring():
 
 
 def test_ring_throughput_beats_pipe():
-    """The ring must earn its keep vs the pipe for bulk payloads."""
+    """The ring must earn its keep in its routing band.
+
+    Results are size-routed (worker_proc.py): pipe < 32KB <= ring <=
+    768KB < dedicated shm segment. In the ring band the per-record
+    segment/pipe overhead (shm_open/ftruncate/mmap/unlink + resource
+    tracker, or pipe chunking) dominates a single extra memcpy —
+    measured 1.3-1.7x in the ring's favor at 64KB-512KB. Above ~1MB the
+    segment path's lazy zero-copy views win, which is exactly why bulk
+    records are routed there instead.
+    """
     import time as _t
 
     payload = np.random.default_rng(0).standard_normal(
-        (512, 1024)
-    ).astype(np.float32)  # 2 MB
+        (128, 1024)
+    ).astype(np.float32)  # 512 KB — inside the ring band
 
     def run_round_trips(env):
         ray.init(num_cpus=1, ignore_reinit_error=True, worker_env=env)
@@ -179,15 +203,21 @@ def test_ring_throughput_beats_pipe():
 
             ray.get(produce.remote())  # warm the worker
             t0 = _t.perf_counter()
-            for _ in range(8):
-                ray.get(produce.remote())
+            for _ in range(16):
+                # Consume the payload: the segment fallback hands back
+                # lazy zero-copy views, so without a real read it would
+                # never touch the data at all and the comparison would
+                # measure deferral, not transfer.
+                float(ray.get(produce.remote()).sum())
             return _t.perf_counter() - t0
         finally:
             ray.shutdown()
 
     t_ring = run_round_trips({})
-    t_pipe = run_round_trips({"RAY_TPU_DISABLE_RING": "1"})
-    # Not a strict perf assertion (CI noise); require the ring path to
-    # be at least not pathologically slower, and report the ratio.
-    print(f"ring={t_ring:.3f}s pipe={t_pipe:.3f}s ratio={t_pipe/t_ring:.2f}x")
-    assert t_ring < t_pipe * 1.5
+    t_fallback = run_round_trips({"RAY_TPU_DISABLE_RING": "1"})
+    print(
+        f"ring={t_ring:.3f}s fallback={t_fallback:.3f}s "
+        f"ratio={t_fallback/t_ring:.2f}x"
+    )
+    # Slack for CI noise; measured advantage is ~1.6x.
+    assert t_ring < t_fallback * 1.2
