@@ -33,7 +33,6 @@ from ray_tpu.execution.train_ops import (
     NUM_ENV_STEPS_TRAINED,
 )
 from ray_tpu.policy.jax_policy import JaxPolicy
-from ray_tpu.utils.schedules import PiecewiseSchedule
 
 
 class DQNConfig(AlgorithmConfig):
@@ -134,24 +133,32 @@ def adjust_nstep(n_step: int, gamma: float, batch: SampleBatch) -> None:
     batch["n_steps"] = n_steps
 
 
+def _epsilon_exploration_config(config: Dict) -> Dict:
+    """Fold DQN's flat epsilon knobs into exploration_config so the
+    pluggable EpsilonGreedy strategy picks them up. The flat keys are
+    authoritative (they are DQNConfig's documented surface and the ones
+    PBT mutates), so they overwrite any stale copies from an earlier
+    fold."""
+    ec = dict(config.get("exploration_config") or {})
+    for key in ("initial_epsilon", "final_epsilon", "epsilon_timesteps"):
+        if key in config:
+            ec[key] = config[key]
+    return ec
+
+
 class DQNJaxPolicy(JaxPolicy):
-    """Double/dueling TD loss (reference dqn_torch_policy.py)."""
+    """Double/dueling TD loss (reference dqn_torch_policy.py). Action
+    selection is epsilon-greedy via the pluggable exploration framework
+    (reference rllib/utils/exploration/epsilon_greedy.py)."""
+
+    default_exploration = "EpsilonGreedy"
 
     def __init__(self, observation_space, action_space, config):
         config = dict(config)
+        config["exploration_config"] = _epsilon_exploration_config(config)
         # model's "logits" head = per-action Q values (+ optional dueling
         # value stream handled by vf head reuse)
         super().__init__(observation_space, action_space, config)
-        self._epsilon_schedule = PiecewiseSchedule(
-            [
-                (0, config.get("initial_epsilon", 1.0)),
-                (
-                    config.get("epsilon_timesteps", 10000),
-                    config.get("final_epsilon", 0.02),
-                ),
-            ]
-        )
-        self.coeff_values["epsilon"] = float(self._epsilon_schedule(0))
         self._steps_since_target_update = 0
 
     def _init_aux_state(self):
@@ -159,15 +166,18 @@ class DQNJaxPolicy(JaxPolicy):
 
     def update_config(self, new_config: Dict) -> None:
         super().update_config(new_config)
-        self._epsilon_schedule = PiecewiseSchedule(
-            [
-                (0, self.config.get("initial_epsilon", 1.0)),
-                (
-                    self.config.get("epsilon_timesteps", 10000),
-                    self.config.get("final_epsilon", 0.02),
-                ),
-            ]
+        from ray_tpu.utils.exploration import exploration_from_config
+
+        self.config["exploration_config"] = _epsilon_exploration_config(
+            self.config
         )
+        self.exploration = exploration_from_config(
+            self.config,
+            self.action_space,
+            self.model_config,
+            default=self.default_exploration,
+        )
+        self.coeff_values.update(self.exploration.init_coeffs())
         if hasattr(self, "_td_error_fn"):
             del self._td_error_fn
 
@@ -176,65 +186,11 @@ class DQNJaxPolicy(JaxPolicy):
         dqn_torch_policy)."""
         self.aux_state = {"target_params": self.params}
 
-    def _update_scheduled_coeffs(self):
-        super()._update_scheduled_coeffs()
-        self.coeff_values["epsilon"] = float(
-            self._epsilon_schedule(self.global_timestep)
-        )
-
-    # -- inference: epsilon-greedy over Q --------------------------------
-
-    def _build_action_fn(self):
-        model = self.model
-
-        def fn(params, obs, states, rng, explore, epsilon):
-            q, value, state_out = model.apply(params, obs)
-            greedy = jnp.argmax(q, axis=-1)
-            if explore:
-                rng_e, rng_a = jax.random.split(rng)
-                random_actions = jax.random.randint(
-                    rng_a, greedy.shape, 0, q.shape[-1]
-                )
-                use_random = (
-                    jax.random.uniform(rng_e, greedy.shape) < epsilon
-                )
-                actions = jnp.where(use_random, random_actions, greedy)
-            else:
-                actions = greedy
-            extra = {"q_values": q}
-            return actions, state_out, extra
-
-        return jax.jit(fn, static_argnames=("explore",))
-
-    def compute_actions(
-        self,
-        obs_batch,
-        state_batches=None,
-        prev_action_batch=None,
-        prev_reward_batch=None,
-        explore: bool = True,
-        timestep: Optional[int] = None,
-        **kwargs,
-    ):
-        if self._action_fn is None:
-            self._action_fn = self._build_action_fn()
-        self.coeff_values["epsilon"] = float(
-            self._epsilon_schedule(self.global_timestep)
-        )
-        self._rng, rng = jax.random.split(self._rng)
-        actions, state_out, extra = self._action_fn(
-            self.params,
-            jnp.asarray(obs_batch),
-            tuple(state_batches or ()),
-            rng,
-            bool(explore),
-            jnp.asarray(self.coeff_values["epsilon"], jnp.float32),
-        )
-        return (
-            np.asarray(actions),
-            [np.asarray(s) for s in state_out],
-            {k: np.asarray(v) for k, v in extra.items()},
-        )
+    def extra_action_out(self, dist_inputs, value, dist, rng):
+        # The per-action Q values already ride ACTION_DIST_INPUTS (the
+        # model head IS the Q head); don't duplicate them as a second
+        # replay-buffer column.
+        return {}
 
     # -- loss ------------------------------------------------------------
 

@@ -211,6 +211,19 @@ class SACJaxPolicy(JaxPolicy):
         self._action_fn = None
         self.num_grad_updates = 0
 
+        # SAC's squashed-Gaussian sampling IS its exploration (the
+        # reference uses StochasticSampling for SAC too); the strategy
+        # object exists for the uniform hook surface (state, weights).
+        from ray_tpu.utils.exploration import exploration_from_config
+
+        self.exploration = exploration_from_config(
+            config, action_space, config.get("model") or {}
+        )
+        self.coeff_values.update(self.exploration.init_coeffs())
+        self._expl_state = ()
+        self._expl_state_batch = -1
+        self._last_obs = None
+
     def get_initial_state(self):
         return []
 
@@ -219,16 +232,19 @@ class SACJaxPolicy(JaxPolicy):
     def _build_action_fn(self):
         actor = self.actor
         low, high = self.low, self.high
+        exploration = self.exploration
 
-        def fn(params, obs, states, rng, explore):
+        def fn(params, obs, rng, explore, coeffs, expl_state):
             dist_inputs = actor.apply(params["actor"], obs)
             dist = SquashedGaussian(dist_inputs, low=low, high=high)
-            if explore:
-                actions, logp = dist.sampled_action_logp(rng)
-            else:
-                actions = dist.deterministic_sample()
-                logp = dist.logp(actions)
-            return actions, (), {SampleBatch.ACTION_LOGP: logp}
+            actions, logp, expl_state = exploration.sample_fn(
+                dist, rng, explore, coeffs, expl_state
+            )
+            return (
+                actions,
+                {SampleBatch.ACTION_LOGP: logp},
+                expl_state,
+            )
 
         return jax.jit(fn, static_argnames=("explore",))
 
@@ -237,9 +253,21 @@ class SACJaxPolicy(JaxPolicy):
     ):
         if self._action_fn is None:
             self._action_fn = self._build_action_fn()
+        self.exploration.update_coeffs(
+            self.coeff_values, self.global_timestep
+        )
+        params = self.exploration.params_for_inference(self, explore)
         self._rng, rng = jax.random.split(self._rng)
-        actions, state_out, extra = self._action_fn(
-            self.params, jnp.asarray(obs_batch), (), rng, bool(explore)
+        obs = jnp.asarray(obs_batch)
+        if self.exploration.needs_last_obs:
+            self._last_obs = obs
+        bsize = int(obs.shape[0])
+        if self._expl_state_batch != bsize:
+            self._expl_state = self.exploration.initial_state(bsize)
+            self._expl_state_batch = bsize
+        actions, extra, self._expl_state = self._action_fn(
+            params, obs, rng, bool(explore),
+            self._coeff_array(), self._expl_state,
         )
         return (
             np.asarray(actions),

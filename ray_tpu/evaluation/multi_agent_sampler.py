@@ -184,7 +184,11 @@ class MultiAgentSyncSampler:
             return
         pid = self._pid(aid)
         batch = coll.flush()
-        batch = self.policy_map[pid].postprocess_trajectory(batch)
+        policy = self.policy_map[pid]
+        expl = getattr(policy, "exploration", None)
+        if expl is not None:
+            batch = expl.postprocess_trajectory(policy, batch)
+        batch = policy.postprocess_trajectory(batch)
         out.setdefault(pid, []).append(batch)
         if done:
             self.collectors.pop(aid, None)

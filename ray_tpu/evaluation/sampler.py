@@ -230,6 +230,11 @@ class SyncSampler:
             batch.count, self.unroll_id, np.int64
         )
         self.unroll_id += 1
+        # Exploration first (intrinsic rewards land before GAE sees
+        # them), then the policy's own postprocessing.
+        expl = getattr(self.policy, "exploration", None)
+        if expl is not None:
+            batch = expl.postprocess_trajectory(self.policy, batch)
         batch = self.policy.postprocess_trajectory(batch)
         out.append(batch)
 

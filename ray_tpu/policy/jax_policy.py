@@ -49,6 +49,10 @@ class JaxPolicy(Policy):
     # (e.g. PPO's adaptive kl coeff). Values live in self.coeff_values.
     coeff_names: Tuple[str, ...] = ("lr", "entropy_coeff")
 
+    # Exploration strategy used when exploration_config gives no "type"
+    # (reference Policy._create_exploration default per algorithm).
+    default_exploration: str = "StochasticSampling"
+
     def __init__(self, observation_space, action_space, config: Dict):
         super().__init__(observation_space, action_space, config)
         self.model_config = dict(config.get("model") or {})
@@ -122,6 +126,20 @@ class JaxPolicy(Policy):
         # Replicated non-gradient state (target networks etc).
         self.aux_state: Dict[str, Any] = self._init_aux_state()
 
+        # ---- exploration ----
+        from ray_tpu.utils.exploration import exploration_from_config
+
+        self.exploration = exploration_from_config(
+            config,
+            action_space,
+            self.model_config,
+            default=self.default_exploration,
+        )
+        self.coeff_values.update(self.exploration.init_coeffs())
+        self._expl_state: Tuple = ()
+        self._expl_state_batch = -1
+        self._last_obs = None  # for ParameterNoise sigma adaptation
+
     # -- subclass hooks --------------------------------------------------
 
     def _init_coeffs(self) -> None:
@@ -181,8 +199,9 @@ class JaxPolicy(Policy):
         model = self.model
         dist_class = self.dist_class
         recurrent = model.is_recurrent
+        exploration = self.exploration
 
-        def fn(params, obs, states, rng, explore):
+        def fn(params, obs, states, rng, explore, coeffs, expl_state):
             if recurrent:
                 dist_inputs, value, state_out = model.apply(
                     params, obs[:, None], states
@@ -190,18 +209,16 @@ class JaxPolicy(Policy):
             else:
                 dist_inputs, value, state_out = model.apply(params, obs)
             dist = dist_class(dist_inputs)
-            if explore:
-                rng, sub = jax.random.split(rng)
-                actions, logp = dist.sampled_action_logp(sub)
-            else:
-                actions = dist.deterministic_sample()
-                logp = dist.logp(actions)
+            rng_x, rng = jax.random.split(rng)
+            actions, logp, expl_state = exploration.sample_fn(
+                dist, rng_x, explore, coeffs, expl_state
+            )
             extra = {
                 SampleBatch.ACTION_DIST_INPUTS: dist_inputs,
                 SampleBatch.ACTION_LOGP: logp,
             }
             extra.update(self.extra_action_out(dist_inputs, value, dist, rng))
-            return actions, state_out, extra
+            return actions, state_out, extra, expl_state
 
         return jax.jit(fn, static_argnames=("explore",))
 
@@ -217,11 +234,22 @@ class JaxPolicy(Policy):
     ):
         if self._action_fn is None:
             self._action_fn = self._build_action_fn()
+        self.exploration.update_coeffs(
+            self.coeff_values, self.global_timestep
+        )
+        params = self.exploration.params_for_inference(self, explore)
         self._rng, rng = jax.random.split(self._rng)
         obs = jnp.asarray(obs_batch)
+        if self.exploration.needs_last_obs:
+            self._last_obs = obs
         states = tuple(jnp.asarray(s) for s in (state_batches or ()))
-        actions, state_out, extra = self._action_fn(
-            self.params, obs, states, rng, bool(explore)
+        bsize = int(obs.shape[0])
+        if self._expl_state_batch != bsize:
+            self._expl_state = self.exploration.initial_state(bsize)
+            self._expl_state_batch = bsize
+        actions, state_out, extra, self._expl_state = self._action_fn(
+            params, obs, states, rng, bool(explore),
+            self._coeff_array(), self._expl_state,
         )
         return (
             np.asarray(actions),
@@ -540,6 +568,7 @@ class JaxPolicy(Policy):
 
     def set_weights(self, weights) -> None:
         self.params = _tree_to_device(weights, self._param_sharding)
+        self.exploration.on_weights_updated(self)
 
     def get_state(self) -> Dict[str, Any]:
         return {
@@ -548,6 +577,7 @@ class JaxPolicy(Policy):
             "coeff_values": dict(self.coeff_values),
             "global_timestep": self.global_timestep,
             "num_grad_updates": self.num_grad_updates,
+            "exploration_state": self.exploration.get_state(),
         }
 
     def set_state(self, state: Dict[str, Any]) -> None:
@@ -559,6 +589,7 @@ class JaxPolicy(Policy):
         self.coeff_values.update(state.get("coeff_values", {}))
         self.global_timestep = state.get("global_timestep", 0)
         self.num_grad_updates = state.get("num_grad_updates", 0)
+        self.exploration.set_state(state.get("exploration_state", {}))
 
 
 def build_jax_policy(

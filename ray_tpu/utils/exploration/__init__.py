@@ -1,0 +1,36 @@
+"""Pluggable exploration strategies (reference
+``rllib/utils/exploration/exploration.py:23`` and siblings).
+
+TPU-first: the action-selection part of every strategy is a pure traced
+function composed into the policy's jitted action program — schedules
+enter as traced scalars (no recompiles), stochastic state (OU noise)
+flows through the program like RNN state, and intrinsic-reward learners
+(Curiosity/RND) train their own nets with jitted updates in
+``postprocess_trajectory``.
+"""
+
+from ray_tpu.utils.exploration.exploration import (
+    Exploration,
+    StochasticSampling,
+    Random,
+    EpsilonGreedy,
+    GaussianNoise,
+    OrnsteinUhlenbeckNoise,
+    ParameterNoise,
+    exploration_from_config,
+)
+from ray_tpu.utils.exploration.curiosity import Curiosity
+from ray_tpu.utils.exploration.rnd import RND
+
+__all__ = [
+    "Exploration",
+    "StochasticSampling",
+    "Random",
+    "EpsilonGreedy",
+    "GaussianNoise",
+    "OrnsteinUhlenbeckNoise",
+    "ParameterNoise",
+    "Curiosity",
+    "RND",
+    "exploration_from_config",
+]

@@ -1,0 +1,166 @@
+"""Curiosity (ICM) exploration — intrinsic rewards from an Intrinsic
+Curiosity Module (reference ``rllib/utils/exploration/curiosity.py``,
+after Pathak et al. 2017).
+
+Three small nets over flattened observations: a feature encoder phi, an
+inverse model (phi(s), phi(s')) -> action logits, and a forward model
+(phi(s), a) -> phi(s'). Intrinsic reward = eta * ||phi_hat(s') -
+phi(s')||^2. The whole ICM update — loss, grads, adam — is ONE jitted
+program run per trajectory in ``postprocess_trajectory`` (the reference
+runs a torch optimizer step there too)."""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.data.sample_batch import SampleBatch
+from ray_tpu.models.base import get_activation
+from ray_tpu.utils.exploration.exploration import (
+    StochasticSampling,
+    register_exploration,
+)
+
+
+class _MLP(nn.Module):
+    out: int
+    hiddens: Tuple[int, ...] = (256,)
+    activation: str = "relu"
+
+    @nn.compact
+    def __call__(self, x):
+        act = get_activation(self.activation)
+        h = x.reshape(x.shape[0], -1).astype(jnp.float32)
+        for i, size in enumerate(self.hiddens):
+            h = act(nn.Dense(size, name=f"h_{i}")(h))
+        return nn.Dense(self.out, name="out")(h)
+
+
+class Curiosity(StochasticSampling):
+    """Underlying action selection is stochastic sampling; the module's
+    contribution is the intrinsic reward + ICM learner."""
+
+    def __init__(self, action_space, config, model_config=None):
+        super().__init__(action_space, config, model_config)
+        cfg = self.config
+        self.feature_dim = int(cfg.get("feature_dim", 288))
+        self.eta = float(cfg.get("eta", 1.0))
+        self.beta = float(cfg.get("beta", 0.2))
+        self.lr = float(cfg.get("lr", 1e-3))
+        hid = tuple(cfg.get("feature_net_hiddens", (256,)))
+        import gymnasium as gym
+
+        if not isinstance(action_space, gym.spaces.Discrete):
+            raise ValueError(
+                "Curiosity currently supports Discrete action spaces "
+                "(reference curiosity.py has the same restriction)"
+            )
+        self.num_actions = int(action_space.n)
+        self.phi = _MLP(self.feature_dim, hid)
+        self.inverse = _MLP(
+            self.num_actions, tuple(cfg.get("inverse_net_hiddens", (256,)))
+        )
+        self.forward_m = _MLP(
+            self.feature_dim,
+            tuple(cfg.get("forward_net_hiddens", (256,))),
+        )
+        self._tx = optax.adam(self.lr)
+        self.params = None
+        self.opt_state = None
+        self._update_fn = None
+        self._rng = jax.random.PRNGKey(int(cfg.get("seed", 0)))
+
+    def _init_params(self, obs: np.ndarray) -> None:
+        r1, r2, r3, self._rng = jax.random.split(self._rng, 4)
+        dummy = jnp.zeros((2,) + obs.shape[1:], jnp.float32)
+        phi_p = self.phi.init(r1, dummy)
+        feat = jnp.zeros((2, 2 * self.feature_dim), jnp.float32)
+        inv_p = self.inverse.init(r2, feat)
+        fwd_in = jnp.zeros(
+            (2, self.feature_dim + self.num_actions), jnp.float32
+        )
+        fwd_p = self.forward_m.init(r3, fwd_in)
+        self.params = {"phi": phi_p, "inverse": inv_p, "forward": fwd_p}
+        self.opt_state = self._tx.init(self.params)
+
+    def _build_update_fn(self):
+        phi, inverse, forward_m = self.phi, self.inverse, self.forward_m
+        num_actions, beta, eta = self.num_actions, self.beta, self.eta
+        tx = self._tx
+
+        def icm_loss(params, obs, next_obs, actions):
+            f = phi.apply(params["phi"], obs)
+            f_next = phi.apply(params["phi"], next_obs)
+            # inverse: predict a from (phi, phi')
+            inv_logits = inverse.apply(
+                params["inverse"],
+                jnp.concatenate([f, f_next], axis=-1),
+            )
+            onehot = jax.nn.one_hot(actions, num_actions)
+            inv_loss = optax.softmax_cross_entropy(
+                inv_logits, onehot
+            ).mean()
+            # forward: predict phi' from (phi, a)
+            f_pred = forward_m.apply(
+                params["forward"],
+                jnp.concatenate([f, onehot], axis=-1),
+            )
+            fwd_err = jnp.sum(
+                jnp.square(f_pred - jax.lax.stop_gradient(f_next)),
+                axis=-1,
+            )
+            fwd_loss = 0.5 * fwd_err.mean()
+            loss = (1.0 - beta) * inv_loss + beta * fwd_loss
+            return loss, eta * 0.5 * fwd_err
+
+        def update(params, opt_state, obs, next_obs, actions):
+            (loss, intrinsic), grads = jax.value_and_grad(
+                icm_loss, has_aux=True
+            )(params, obs, next_obs, actions)
+            updates, opt_state = tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, loss, intrinsic
+
+        return jax.jit(update)
+
+    def postprocess_trajectory(self, policy, sample_batch):
+        obs = np.asarray(sample_batch[SampleBatch.OBS], np.float32)
+        if SampleBatch.NEXT_OBS in sample_batch:
+            next_obs = np.asarray(
+                sample_batch[SampleBatch.NEXT_OBS], np.float32
+            )
+        else:
+            next_obs = np.concatenate([obs[1:], obs[-1:]], axis=0)
+        actions = np.asarray(sample_batch[SampleBatch.ACTIONS])
+        if self.params is None:
+            self._init_params(obs)
+        if self._update_fn is None:
+            self._update_fn = self._build_update_fn()
+        self.params, self.opt_state, loss, intrinsic = self._update_fn(
+            self.params, self.opt_state, obs, next_obs, actions
+        )
+        sample_batch[SampleBatch.REWARDS] = sample_batch[
+            SampleBatch.REWARDS
+        ] + np.asarray(intrinsic, np.float32)
+        return sample_batch
+
+    def get_state(self):
+        if self.params is None:
+            return {}
+        return {
+            "params": jax.device_get(self.params),
+            "opt_state": jax.device_get(self.opt_state),
+        }
+
+    def set_state(self, state):
+        if "params" in state:
+            self.params = jax.device_put(state["params"])
+            self.opt_state = jax.device_put(state["opt_state"])
+
+
+register_exploration("Curiosity", Curiosity)

@@ -1,0 +1,384 @@
+"""Exploration strategy API + the distribution/noise-based strategies.
+
+Reference: ``rllib/utils/exploration/exploration.py:23`` (API),
+``stochastic_sampling.py``, ``epsilon_greedy.py``, ``random.py``,
+``gaussian_noise.py``, ``ornstein_uhlenbeck_noise.py``,
+``parameter_noise.py``. The reference dispatches per-framework inside
+``get_exploration_action``; here the strategy contributes a pure
+``sample_fn`` that the policy traces INTO its jitted action program, so
+exploration costs nothing extra at runtime:
+
+- scheduled knobs (epsilon, noise scale) enter as traced f32 scalars via
+  the policy's ``coeff_values`` — annealing never recompiles;
+- stochastic carried state (the OU process) flows through the program
+  as explicit state, like RNN state;
+- strategies with their own learners (Curiosity/RND, see siblings) hook
+  ``postprocess_trajectory`` instead.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.utils.schedules import PiecewiseSchedule, make_schedule
+
+
+class Exploration:
+    """Base strategy. All hooks are optional; the default is pure
+    exploitation of the action distribution."""
+
+    # Set by strategies that read policy._last_obs (ParameterNoise), so
+    # the policy doesn't pin an obs device buffer for everyone else.
+    needs_last_obs: bool = False
+
+    def __init__(self, action_space, config: Dict, model_config=None):
+        self.action_space = action_space
+        self.config = dict(config or {})
+        self.model_config = dict(model_config or {})
+
+    # -- traced hooks ---------------------------------------------------
+
+    def sample_fn(
+        self,
+        dist,
+        rng: jax.Array,
+        explore: bool,
+        coeffs: Dict[str, jnp.ndarray],
+        state: Tuple,
+    ) -> Tuple[jnp.ndarray, jnp.ndarray, Tuple]:
+        """Pure function traced inside the policy's jitted action
+        program. ``explore`` is a static bool; ``coeffs`` are traced
+        scalars; ``state`` is the carried exploration state (a tuple of
+        arrays, possibly empty). Returns (actions, logp, new_state)."""
+        if explore:
+            actions, logp = dist.sampled_action_logp(rng)
+        else:
+            actions = dist.deterministic_sample()
+            logp = dist.logp(actions)
+        return actions, logp, state
+
+    def initial_state(self, batch_size: int) -> Tuple:
+        """Carried exploration state for a rollout batch (OU noise)."""
+        return ()
+
+    # -- host-side hooks ------------------------------------------------
+
+    def init_coeffs(self) -> Dict[str, float]:
+        """Scheduled scalars to merge into policy.coeff_values."""
+        return {}
+
+    def update_coeffs(self, coeff_values: Dict, timestep: int) -> None:
+        """Advance schedules (host side, called per compute_actions)."""
+
+    def params_for_inference(self, policy, explore: bool):
+        """Which params the action program should run with (overridden
+        by ParameterNoise to substitute perturbed params)."""
+        return policy.params
+
+    def on_weights_updated(self, policy) -> None:
+        """Called after policy.set_weights (ParameterNoise re-perturbs)."""
+
+    def postprocess_trajectory(self, policy, sample_batch):
+        """Intrinsic-reward strategies rewrite the batch here."""
+        return sample_batch
+
+    def get_state(self) -> Dict[str, Any]:
+        return {}
+
+    def set_state(self, state: Dict[str, Any]) -> None:
+        pass
+
+
+class StochasticSampling(Exploration):
+    """Sample from the action distribution when exploring, deterministic
+    (mode) otherwise (reference stochastic_sampling.py). This is the
+    base-class behavior, named for config symmetry."""
+
+
+class Random(Exploration):
+    """Uniform-random actions while exploring (reference random.py).
+    Supports Discrete and Box action spaces."""
+
+    def sample_fn(self, dist, rng, explore, coeffs, state):
+        if not explore:
+            actions = dist.deterministic_sample()
+            return actions, dist.logp(actions), state
+        det = dist.deterministic_sample()
+        import gymnasium as gym
+
+        if isinstance(self.action_space, gym.spaces.Discrete):
+            n = int(self.action_space.n)
+            actions = jax.random.randint(rng, det.shape, 0, n)
+            logp = jnp.full(det.shape[:1], -jnp.log(float(n)))
+        else:
+            low = jnp.asarray(self.action_space.low, jnp.float32)
+            high = jnp.asarray(self.action_space.high, jnp.float32)
+            u = jax.random.uniform(rng, det.shape)
+            actions = low + u * (high - low)
+            logp = jnp.zeros(det.shape[:1])
+        return actions, logp, state
+
+
+class EpsilonGreedy(Exploration):
+    """Epsilon-greedy over a discrete distribution's greedy action with
+    an annealed epsilon (reference epsilon_greedy.py). The epsilon knob
+    rides ``coeffs["epsilon"]`` so annealing never recompiles."""
+
+    def __init__(self, action_space, config, model_config=None):
+        super().__init__(action_space, config, model_config)
+        cfg = self.config
+        self.schedule = PiecewiseSchedule(
+            [
+                (0, float(cfg.get("initial_epsilon", 1.0))),
+                (
+                    int(cfg.get("epsilon_timesteps", 10000)),
+                    float(cfg.get("final_epsilon", 0.02)),
+                ),
+            ]
+        )
+
+    def init_coeffs(self):
+        return {"epsilon": float(self.schedule(0))}
+
+    def update_coeffs(self, coeff_values, timestep):
+        coeff_values["epsilon"] = float(self.schedule(timestep))
+
+    def sample_fn(self, dist, rng, explore, coeffs, state):
+        greedy = dist.deterministic_sample()
+        if not explore:
+            return greedy, dist.logp(greedy), state
+        num_actions = dist.inputs.shape[-1]
+        rng_u, rng_a = jax.random.split(rng)
+        random_actions = jax.random.randint(
+            rng_a, greedy.shape, 0, num_actions
+        )
+        use_random = (
+            jax.random.uniform(rng_u, greedy.shape) < coeffs["epsilon"]
+        )
+        actions = jnp.where(use_random, random_actions, greedy)
+        return actions, dist.logp(actions), state
+
+
+class GaussianNoise(Exploration):
+    """Deterministic action + annealed additive Gaussian noise, clipped
+    to the action-space bounds (reference gaussian_noise.py; the DDPG/
+    TD3 exploration). ``random_timesteps`` of pure-random warmup are
+    approximated by the scale schedule's initial value."""
+
+    def __init__(self, action_space, config, model_config=None):
+        super().__init__(action_space, config, model_config)
+        cfg = self.config
+        self.stddev = float(cfg.get("stddev", 0.1))
+        self.scale_schedule = make_schedule(
+            cfg.get("scale_schedule"),
+            float(cfg.get("initial_scale", 1.0)),
+        )
+        if cfg.get("scale_schedule") is None and cfg.get(
+            "scale_timesteps"
+        ):
+            self.scale_schedule = PiecewiseSchedule(
+                [
+                    (0, float(cfg.get("initial_scale", 1.0))),
+                    (
+                        int(cfg["scale_timesteps"]),
+                        float(cfg.get("final_scale", 1.0)),
+                    ),
+                ]
+            )
+        self.low = np.asarray(action_space.low, np.float32)
+        self.high = np.asarray(action_space.high, np.float32)
+
+    def init_coeffs(self):
+        return {"noise_scale": float(self.scale_schedule(0))}
+
+    def update_coeffs(self, coeff_values, timestep):
+        coeff_values["noise_scale"] = float(self.scale_schedule(timestep))
+
+    def _noise(self, rng, det, state):
+        return self.stddev * jax.random.normal(rng, det.shape), state
+
+    def sample_fn(self, dist, rng, explore, coeffs, state):
+        det = dist.deterministic_sample()
+        logp = jnp.zeros(det.shape[:1])
+        if not explore:
+            return det, logp, state
+        noise, state = self._noise(rng, det, state)
+        actions = jnp.clip(
+            det + coeffs["noise_scale"] * noise,
+            jnp.asarray(self.low),
+            jnp.asarray(self.high),
+        )
+        return actions, logp, state
+
+
+class OrnsteinUhlenbeckNoise(GaussianNoise):
+    """Temporally-correlated OU noise (reference
+    ornstein_uhlenbeck_noise.py): ``x += theta*(0-x) + sigma*N(0,1)``
+    carried across steps as traced exploration state, matching the
+    vector-env batch. State resets to zero whenever the rollout batch
+    size changes (approximation of per-episode reset; the OU process
+    mean-reverts quickly regardless)."""
+
+    def __init__(self, action_space, config, model_config=None):
+        super().__init__(action_space, config, model_config)
+        cfg = self.config
+        self.theta = float(cfg.get("ou_theta", 0.15))
+        self.sigma = float(cfg.get("ou_sigma", 0.2))
+        self.base_scale = float(cfg.get("ou_base_scale", 0.1))
+
+    def initial_state(self, batch_size: int) -> Tuple:
+        dim = int(np.prod(self.action_space.shape))
+        return (jnp.zeros((batch_size, dim), jnp.float32),)
+
+    def _noise(self, rng, det, state):
+        (x,) = state
+        x = x + self.theta * (0.0 - x) + self.sigma * jax.random.normal(
+            rng, x.shape
+        )
+        return self.base_scale * x.reshape(det.shape), (x,)
+
+
+class ParameterNoise(Exploration):
+    """Adaptive parameter-space noise (reference parameter_noise.py,
+    after Plappert et al. 2018): perturb the policy weights with
+    N(0, sigma) and act greedily under the perturbed weights; sigma
+    adapts so the induced action-space divergence tracks a target.
+
+    Host-side by design: perturbation happens at weight-sync / interval
+    boundaries (not per step), so the traced action program just runs
+    with substituted params."""
+
+    needs_last_obs = True
+
+    def __init__(self, action_space, config, model_config=None):
+        super().__init__(action_space, config, model_config)
+        cfg = self.config
+        self.initial_stddev = float(cfg.get("initial_stddev", 1.0))
+        self.target_stddev = float(cfg.get("target_stddev", 0.01))
+        self.adapt_coeff = float(cfg.get("adapt_coeff", 1.01))
+        self.perturb_interval = int(cfg.get("perturb_interval", 50))
+        self.stddev = self.initial_stddev
+        self._perturbed = None
+        self._calls = 0
+        self._perturb_fn = None
+
+    def _perturb(self, policy):
+        policy._rng, rng = jax.random.split(policy._rng)
+        if self._perturb_fn is None:
+
+            def fn(params, rng, stddev):
+                leaves, treedef = jax.tree_util.tree_flatten(params)
+                rngs = jax.random.split(rng, len(leaves))
+                out = [
+                    p + stddev * jax.random.normal(r, p.shape, p.dtype)
+                    if jnp.issubdtype(p.dtype, jnp.floating)
+                    else p
+                    for p, r in zip(leaves, rngs)
+                ]
+                return jax.tree_util.tree_unflatten(treedef, out)
+
+            self._perturb_fn = jax.jit(fn)
+        self._perturbed = self._perturb_fn(
+            policy.params, rng, jnp.asarray(self.stddev, jnp.float32)
+        )
+
+    def _adapt(self, policy) -> None:
+        """Grow/shrink sigma toward the target divergence, measured as
+        the RMS distance between clean and perturbed model outputs on
+        the last observed batch (the reference uses action-space KL;
+        output-space RMS is the framework-generic analog)."""
+        obs = getattr(policy, "_last_obs", None)
+        if obs is None or self._perturbed is None:
+            return
+        try:
+            clean, _, _ = policy.model_forward(policy.params, obs)
+            pert, _, _ = policy.model_forward(self._perturbed, obs)
+            dist = float(
+                np.sqrt(
+                    np.mean(
+                        np.square(
+                            np.asarray(clean, np.float32)
+                            - np.asarray(pert, np.float32)
+                        )
+                    )
+                )
+            )
+        except Exception as e:
+            if not getattr(self, "_adapt_warned", False):
+                self._adapt_warned = True
+                import warnings
+
+                warnings.warn(
+                    "ParameterNoise sigma adaptation disabled: model "
+                    f"forward on the last obs batch failed ({e!r}); "
+                    "stddev stays at its current value."
+                )
+            return
+        if dist > self.target_stddev:
+            self.stddev /= self.adapt_coeff
+        else:
+            self.stddev *= self.adapt_coeff
+
+    def params_for_inference(self, policy, explore: bool):
+        if not explore:
+            return policy.params
+        self._calls += 1
+        if (
+            self._perturbed is None
+            or self._calls % self.perturb_interval == 0
+        ):
+            self._adapt(policy)
+            self._perturb(policy)
+        return self._perturbed
+
+    def on_weights_updated(self, policy) -> None:
+        self._perturbed = None  # re-perturb from the fresh weights
+
+    def get_state(self):
+        return {"stddev": self.stddev}
+
+    def set_state(self, state):
+        self.stddev = float(state.get("stddev", self.stddev))
+
+
+_REGISTRY = {
+    "StochasticSampling": StochasticSampling,
+    "Random": Random,
+    "EpsilonGreedy": EpsilonGreedy,
+    "GaussianNoise": GaussianNoise,
+    "OrnsteinUhlenbeckNoise": OrnsteinUhlenbeckNoise,
+    "ParameterNoise": ParameterNoise,
+}
+
+
+def register_exploration(name: str, cls) -> None:
+    _REGISTRY[name] = cls
+
+
+def exploration_from_config(
+    config: Dict,
+    action_space,
+    model_config=None,
+    default: str = "StochasticSampling",
+) -> Exploration:
+    """Build the strategy from ``config["exploration_config"]``
+    (reference ``from_config`` on exploration_config dicts)."""
+    ec = dict(config.get("exploration_config") or {})
+    typ = ec.pop("type", default)
+    if isinstance(typ, type):
+        return typ(action_space, ec, model_config)
+    cls = _REGISTRY.get(typ)
+    if cls is None:
+        # late registration (Curiosity/RND import cycle)
+        from ray_tpu.utils.exploration import curiosity, rnd  # noqa: F401
+
+        cls = _REGISTRY.get(typ)
+    if cls is None:
+        raise ValueError(
+            f"Unknown exploration type {typ!r}; known: {sorted(_REGISTRY)}"
+        )
+    return cls(action_space, ec, model_config)

@@ -1,0 +1,124 @@
+"""Random Network Distillation exploration (reference
+``rllib/utils/exploration/random_encoder.py``, after Burda et al. 2018).
+
+A frozen randomly-initialized target encoder f(s) and a trained
+predictor f_hat(s); intrinsic reward is the (running-normalized)
+prediction error. Predictor update is one jitted adam step per
+trajectory in ``postprocess_trajectory``."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.data.sample_batch import SampleBatch
+from ray_tpu.utils.exploration.curiosity import _MLP
+from ray_tpu.utils.exploration.exploration import (
+    StochasticSampling,
+    register_exploration,
+)
+
+
+class RND(StochasticSampling):
+    def __init__(self, action_space, config, model_config=None):
+        super().__init__(action_space, config, model_config)
+        cfg = self.config
+        self.embed_dim = int(cfg.get("embed_dim", 128))
+        self.eta = float(cfg.get("intrinsic_reward_coeff", 0.5))
+        self.lr = float(cfg.get("lr", 1e-4))
+        hid = tuple(cfg.get("hiddens", (256,)))
+        self.target_net = _MLP(self.embed_dim, hid)
+        self.predictor_net = _MLP(self.embed_dim, hid)
+        self._tx = optax.adam(self.lr)
+        self.target_params = None
+        self.predictor_params = None
+        self.opt_state = None
+        self._update_fn = None
+        self._rng = jax.random.PRNGKey(int(cfg.get("seed", 0)))
+        # Welford running stats for intrinsic-reward normalization.
+        self._count = 1e-4
+        self._mean = 0.0
+        self._m2 = 1.0
+
+    def _init_params(self, obs: np.ndarray) -> None:
+        r1, r2, self._rng = jax.random.split(self._rng, 3)
+        dummy = jnp.zeros((2,) + obs.shape[1:], jnp.float32)
+        self.target_params = self.target_net.init(r1, dummy)
+        self.predictor_params = self.predictor_net.init(r2, dummy)
+        self.opt_state = self._tx.init(self.predictor_params)
+
+    def _build_update_fn(self):
+        target_net, predictor_net = self.target_net, self.predictor_net
+        tx = self._tx
+
+        def loss_fn(pred_params, target_params, obs):
+            t = jax.lax.stop_gradient(
+                target_net.apply(target_params, obs)
+            )
+            p = predictor_net.apply(pred_params, obs)
+            err = jnp.sum(jnp.square(p - t), axis=-1)
+            return err.mean(), err
+
+        def update(pred_params, opt_state, target_params, obs):
+            (loss, err), grads = jax.value_and_grad(
+                loss_fn, has_aux=True
+            )(pred_params, target_params, obs)
+            updates, opt_state = tx.update(grads, opt_state, pred_params)
+            pred_params = optax.apply_updates(pred_params, updates)
+            return pred_params, opt_state, err
+
+        return jax.jit(update)
+
+    def postprocess_trajectory(self, policy, sample_batch):
+        obs = np.asarray(sample_batch[SampleBatch.OBS], np.float32)
+        if self.target_params is None:
+            self._init_params(obs)
+        if self._update_fn is None:
+            self._update_fn = self._build_update_fn()
+        self.predictor_params, self.opt_state, err = self._update_fn(
+            self.predictor_params,
+            self.opt_state,
+            self.target_params,
+            obs,
+        )
+        err = np.asarray(err, np.float64)
+        # batched Welford merge
+        n, mean, var = err.size, err.mean(), err.var()
+        delta = mean - self._mean
+        tot = self._count + n
+        self._mean += delta * n / tot
+        self._m2 += var * n + delta**2 * self._count * n / tot
+        self._count = tot
+        std = max(np.sqrt(self._m2 / self._count), 1e-8)
+        # Scale by running std only (Burda et al. 2018): mean-centering
+        # would hand below-average-novelty states a NEGATIVE bonus and
+        # zero out the aggregate signal from the very first batch.
+        intrinsic = self.eta * err / std
+        sample_batch[SampleBatch.REWARDS] = sample_batch[
+            SampleBatch.REWARDS
+        ] + intrinsic.astype(np.float32)
+        return sample_batch
+
+    def get_state(self):
+        if self.target_params is None:
+            return {}
+        return {
+            "target_params": jax.device_get(self.target_params),
+            "predictor_params": jax.device_get(self.predictor_params),
+            "opt_state": jax.device_get(self.opt_state),
+            "norm": (self._count, self._mean, self._m2),
+        }
+
+    def set_state(self, state):
+        if "target_params" in state:
+            self.target_params = jax.device_put(state["target_params"])
+            self.predictor_params = jax.device_put(
+                state["predictor_params"]
+            )
+            self.opt_state = jax.device_put(state["opt_state"])
+            self._count, self._mean, self._m2 = state["norm"]
+
+
+register_exploration("RND", RND)

@@ -1,0 +1,265 @@
+"""Exploration framework tests (reference
+rllib/utils/exploration/tests/test_explorations.py)."""
+
+import gymnasium as gym
+import numpy as np
+import pytest
+
+from ray_tpu.algorithms.ppo import PPOConfig
+from ray_tpu.algorithms.dqn import DQNConfig
+from ray_tpu.utils.exploration import (
+    Curiosity,
+    EpsilonGreedy,
+    GaussianNoise,
+    OrnsteinUhlenbeckNoise,
+    ParameterNoise,
+    RND,
+    Random,
+    StochasticSampling,
+    exploration_from_config,
+)
+
+
+def _ppo_policy(env="CartPole-v1", **expl):
+    config = (
+        PPOConfig()
+        .environment(env)
+        .rollouts(num_rollout_workers=0, rollout_fragment_length=32)
+        .training(train_batch_size=64, sgd_minibatch_size=32)
+    )
+    if expl:
+        config.exploration(exploration_config=expl)
+    algo = config.build()
+    return algo
+
+
+def test_from_config_registry():
+    space = gym.spaces.Discrete(4)
+    for typ, cls in [
+        ("StochasticSampling", StochasticSampling),
+        ("Random", Random),
+        ("EpsilonGreedy", EpsilonGreedy),
+        ("Curiosity", Curiosity),
+        ("RND", RND),
+    ]:
+        e = exploration_from_config(
+            {"exploration_config": {"type": typ}}, space
+        )
+        assert isinstance(e, cls)
+    box = gym.spaces.Box(-1.0, 1.0, (3,), np.float32)
+    for typ, cls in [
+        ("GaussianNoise", GaussianNoise),
+        ("OrnsteinUhlenbeckNoise", OrnsteinUhlenbeckNoise),
+        ("ParameterNoise", ParameterNoise),
+    ]:
+        e = exploration_from_config(
+            {"exploration_config": {"type": typ}}, box
+        )
+        assert isinstance(e, cls)
+    with pytest.raises(ValueError):
+        exploration_from_config(
+            {"exploration_config": {"type": "Nope"}}, space
+        )
+
+
+def test_epsilon_greedy_anneals_and_randomizes():
+    algo = (
+        DQNConfig()
+        .environment("CartPole-v1")
+        .rollouts(num_rollout_workers=0, rollout_fragment_length=16)
+        .training(
+            epsilon_timesteps=50,
+            final_epsilon=0.05,
+            num_steps_sampled_before_learning_starts=10,
+            train_batch_size=16,
+        )
+        .build()
+    )
+    pol = algo.get_policy()
+    assert isinstance(pol.exploration, EpsilonGreedy)
+    assert pol.coeff_values["epsilon"] == 1.0
+    obs = np.zeros((8, 4), np.float32)
+    # with epsilon=1 actions are uniform-random
+    acts, _, _ = pol.compute_actions(obs, explore=True)
+    assert acts.shape == (8,)
+    # anneal: past the horizon the schedule bottoms out
+    pol.global_timestep = 10_000
+    pol.compute_actions(obs, explore=True)
+    assert pol.coeff_values["epsilon"] == pytest.approx(0.05)
+    # explore=False is greedy & deterministic
+    a1, _, _ = pol.compute_actions(obs, explore=False)
+    a2, _, _ = pol.compute_actions(obs, explore=False)
+    np.testing.assert_array_equal(a1, a2)
+    algo.stop()
+
+
+def test_epsilon_mutation_rebuilds_schedule():
+    """PBT-style update_config of the flat epsilon knobs must reach the
+    rebuilt EpsilonGreedy schedule (the flat keys are authoritative over
+    stale fold-ins)."""
+    algo = (
+        DQNConfig()
+        .environment("CartPole-v1")
+        .rollouts(num_rollout_workers=0, rollout_fragment_length=16)
+        .training(epsilon_timesteps=100, final_epsilon=0.02)
+        .build()
+    )
+    pol = algo.get_policy()
+    pol.update_config({"final_epsilon": 0.5})
+    assert pol.exploration.schedule(10**9) == pytest.approx(0.5)
+    algo.stop()
+
+
+def test_random_exploration_uniform():
+    algo = _ppo_policy(type="Random")
+    pol = algo.get_policy()
+    obs = np.zeros((64, 4), np.float32)
+    acts, _, _ = pol.compute_actions(obs, explore=True)
+    # both actions present with overwhelming probability
+    assert set(np.unique(acts)) == {0, 1}
+    algo.stop()
+
+
+def test_gaussian_noise_bounds_and_determinism():
+    env = gym.make("Pendulum-v1")
+    space = env.action_space
+    e = GaussianNoise(space, {"stddev": 0.5})
+    from ray_tpu.models.distributions import DiagGaussian
+    import jax
+
+    inputs = np.zeros((16, 2), np.float32)
+    dist = DiagGaussian(inputs)
+    rng = jax.random.PRNGKey(0)
+    coeffs = {"noise_scale": 1.0}
+    a, logp, st = e.sample_fn(dist, rng, True, coeffs, ())
+    a = np.asarray(a)
+    assert (a >= space.low - 1e-6).all() and (a <= space.high + 1e-6).all()
+    assert not np.allclose(a, 0.0)  # noise applied
+    a2, _, _ = e.sample_fn(dist, rng, False, coeffs, ())
+    np.testing.assert_allclose(np.asarray(a2), 0.0, atol=1e-6)
+
+
+def test_ou_noise_is_temporally_correlated():
+    space = gym.spaces.Box(-2.0, 2.0, (1,), np.float32)
+    e = OrnsteinUhlenbeckNoise(
+        space, {"ou_theta": 0.15, "ou_sigma": 0.2, "ou_base_scale": 1.0}
+    )
+    from ray_tpu.models.distributions import DiagGaussian
+    import jax
+
+    dist = DiagGaussian(np.zeros((4, 2), np.float32))
+    state = e.initial_state(4)
+    rng = jax.random.PRNGKey(0)
+    xs = []
+    for i in range(200):
+        rng, sub = jax.random.split(rng)
+        a, _, state = e.sample_fn(
+            dist, sub, True, {"noise_scale": 1.0}, state
+        )
+        xs.append(np.asarray(a)[:, 0])
+    xs = np.stack(xs)  # (T, B)
+    # lag-1 autocorrelation of an OU process with theta=0.15 is ~0.85;
+    # white noise would be ~0.
+    x = xs[:, 0]
+    ac = np.corrcoef(x[:-1], x[1:])[0, 1]
+    assert ac > 0.5
+
+
+def test_parameter_noise_perturbs_and_adapts():
+    algo = _ppo_policy(
+        type="ParameterNoise", initial_stddev=0.5, perturb_interval=3
+    )
+    pol = algo.get_policy()
+    assert isinstance(pol.exploration, ParameterNoise)
+    obs = np.random.default_rng(0).standard_normal((32, 4)).astype(
+        np.float32
+    )
+    # exploring uses perturbed params; eval uses clean ones
+    pol.compute_actions(obs, explore=True)
+    assert pol.exploration._perturbed is not None
+    logits_clean, _, _ = pol.model_forward(
+        pol.params, obs
+    )
+    logits_pert, _, _ = pol.model_forward(
+        pol.exploration._perturbed, obs
+    )
+    assert not np.allclose(
+        np.asarray(logits_clean), np.asarray(logits_pert)
+    )
+    # weight sync invalidates the perturbation
+    pol.set_weights(pol.get_weights())
+    assert pol.exploration._perturbed is None
+    algo.stop()
+
+
+def test_curiosity_adds_intrinsic_reward_and_learns():
+    algo = _ppo_policy(type="Curiosity", feature_dim=16, eta=0.1)
+    pol = algo.get_policy()
+    assert isinstance(pol.exploration, Curiosity)
+    from ray_tpu.data.sample_batch import SampleBatch
+
+    rng = np.random.default_rng(0)
+    batch = SampleBatch(
+        {
+            SampleBatch.OBS: rng.standard_normal((32, 4)).astype(
+                np.float32
+            ),
+            SampleBatch.NEXT_OBS: rng.standard_normal((32, 4)).astype(
+                np.float32
+            ),
+            SampleBatch.ACTIONS: rng.integers(0, 2, 32),
+            SampleBatch.REWARDS: np.zeros(32, np.float32),
+        }
+    )
+    out = pol.exploration.postprocess_trajectory(pol, batch)
+    r1 = out[SampleBatch.REWARDS].copy()
+    assert (r1 > 0).any()  # intrinsic reward added
+    # repeated updates on the same transitions shrink the surprise
+    for _ in range(60):
+        batch[SampleBatch.REWARDS] = np.zeros(32, np.float32)
+        out = pol.exploration.postprocess_trajectory(pol, batch)
+    r_late = out[SampleBatch.REWARDS]
+    assert r_late.mean() < r1.mean()
+    algo.stop()
+
+
+def test_rnd_intrinsic_reward_normalized():
+    algo = _ppo_policy(type="RND", embed_dim=16)
+    pol = algo.get_policy()
+    from ray_tpu.data.sample_batch import SampleBatch
+
+    rng = np.random.default_rng(0)
+    batch = SampleBatch(
+        {
+            SampleBatch.OBS: rng.standard_normal((64, 4)).astype(
+                np.float32
+            ),
+            SampleBatch.REWARDS: np.zeros(64, np.float32),
+        }
+    )
+    out = pol.exploration.postprocess_trajectory(pol, batch)
+    r = out[SampleBatch.REWARDS]
+    assert r.std() > 0
+    algo.stop()
+
+
+def test_exploration_state_checkpoints():
+    algo = _ppo_policy(type="RND", embed_dim=8)
+    pol = algo.get_policy()
+    from ray_tpu.data.sample_batch import SampleBatch
+
+    batch = SampleBatch(
+        {
+            SampleBatch.OBS: np.ones((8, 4), np.float32),
+            SampleBatch.REWARDS: np.zeros(8, np.float32),
+        }
+    )
+    pol.exploration.postprocess_trajectory(pol, batch)
+    state = pol.get_state()
+    assert "exploration_state" in state
+    algo2 = _ppo_policy(type="RND", embed_dim=8)
+    pol2 = algo2.get_policy()
+    pol2.set_state(state)
+    assert pol2.exploration.target_params is not None
+    algo.stop()
+    algo2.stop()
