@@ -1,0 +1,3 @@
+from ray_tpu.algorithms.appo.appo import APPO, APPOConfig, APPOJaxPolicy
+
+__all__ = ["APPO", "APPOConfig", "APPOJaxPolicy"]

@@ -1,0 +1,203 @@
+"""APPO: asynchronous PPO on the IMPALA actor-learner machinery.
+
+Counterpart of the reference's ``rllib/algorithms/appo/appo.py``
+(APPOConfig extends ImpalaConfig; ``after_train_step`` updates the
+target net + adapts the KL coeff) and ``appo_torch_policy.py`` (V-trace
+weighted PPO-clip surrogate against a periodically-frozen "old policy"
+target network).
+
+Loss semantics (appo_torch_policy.py:160-270): V-trace advantages are
+computed against the TARGET policy's logits; the surrogate ratio is
+``clamp(exp(behaviour_logp - old_logp), 0, 2) * exp(cur_logp -
+behaviour_logp)`` — a doubly-corrected IS ratio that keeps the clipping
+anchor at the slow-moving old policy while samples come from slightly
+stale behaviour policies. The target params live in the policy's
+replicated aux_state like DQN's target net."""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.algorithms.impala.impala import (
+    IMPALA,
+    IMPALAConfig,
+    ImpalaJaxPolicy,
+)
+from ray_tpu.data.sample_batch import DEFAULT_POLICY_ID, SampleBatch
+from ray_tpu.execution.train_ops import NUM_ENV_STEPS_TRAINED
+from ray_tpu.ops.vtrace import vtrace_from_logits
+
+
+class APPOConfig(IMPALAConfig):
+    """reference appo.py APPOConfig."""
+
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class or APPO)
+        self.vtrace = True
+        self.use_critic = True
+        self.use_gae = True
+        self.lambda_ = 1.0
+        self.clip_param = 0.4
+        self.use_kl_loss = False
+        self.kl_coeff = 1.0
+        self.kl_target = 0.01
+        # learner steps between old-policy refreshes (the reference
+        # derives this from num_sgd_iter * minibatch_buffer_size, i.e.
+        # effectively every train step).
+        self.target_update_frequency = 1
+
+    def training(
+        self,
+        *,
+        clip_param: Optional[float] = None,
+        use_kl_loss: Optional[bool] = None,
+        kl_coeff: Optional[float] = None,
+        kl_target: Optional[float] = None,
+        lambda_: Optional[float] = None,
+        target_update_frequency: Optional[int] = None,
+        **kwargs,
+    ) -> "APPOConfig":
+        super().training(**kwargs)
+        if clip_param is not None:
+            self.clip_param = clip_param
+        if use_kl_loss is not None:
+            self.use_kl_loss = use_kl_loss
+        if kl_coeff is not None:
+            self.kl_coeff = kl_coeff
+        if kl_target is not None:
+            self.kl_target = kl_target
+        if lambda_ is not None:
+            self.lambda_ = lambda_
+        if target_update_frequency is not None:
+            self.target_update_frequency = target_update_frequency
+        return self
+
+
+class APPOJaxPolicy(ImpalaJaxPolicy):
+    """V-trace weighted PPO-clip surrogate vs a frozen old policy
+    (reference appo_torch_policy.py)."""
+
+    def _init_coeffs(self):
+        self.coeff_values["kl_coeff"] = float(
+            self.config.get("kl_coeff", 1.0)
+        )
+
+    def _init_aux_state(self):
+        return {"target_params": self.params}
+
+    def update_target(self) -> None:
+        """Refresh the frozen old policy (reference
+        appo.py after_train_step → p.update_target())."""
+        self.aux_state = {"target_params": self.params}
+
+    def loss_with_aux(self, params, aux, batch, rng, coeffs):
+        cfg = self.config
+        gamma = cfg.get("gamma", 0.99)
+        clip_param = cfg.get("clip_param", 0.4)
+        use_kl = cfg.get("use_kl_loss", False)
+        obs = batch[SampleBatch.OBS]
+        B, T = obs.shape[0], obs.shape[1]
+        flat_obs = obs.reshape((B * T,) + obs.shape[2:])
+
+        dist_inputs, values, _ = self.model_forward(params, flat_obs)
+        old_inputs, _, _ = self.model_forward(
+            aux["target_params"], flat_obs
+        )
+        old_inputs = jax.lax.stop_gradient(old_inputs)
+        _, bootstrap_value, _ = self.model_forward(
+            params, batch["bootstrap_obs"]
+        )
+        dist = self.dist_class(dist_inputs)
+        old_dist = self.dist_class(old_inputs)
+
+        actions = batch[SampleBatch.ACTIONS]
+        flat_actions = actions.reshape((B * T,) + actions.shape[2:])
+        cur_logp = dist.logp(flat_actions)
+        old_logp = old_dist.logp(flat_actions)
+        behaviour_logp = batch[SampleBatch.ACTION_LOGP].reshape(B * T)
+
+        # V-trace against the OLD policy (its logp as target).
+        vtr = vtrace_from_logits(
+            behaviour_action_log_probs=batch[SampleBatch.ACTION_LOGP],
+            target_action_log_probs=old_logp.reshape(B, T),
+            discounts=gamma * (1.0 - batch[SampleBatch.TERMINATEDS]),
+            rewards=batch[SampleBatch.REWARDS],
+            values=values.reshape(B, T),
+            bootstrap_value=bootstrap_value,
+            clip_rho_threshold=cfg.get("vtrace_clip_rho_threshold", 1.0),
+            clip_pg_rho_threshold=cfg.get(
+                "vtrace_clip_pg_rho_threshold", 1.0
+            ),
+        )
+        advantages = vtr.pg_advantages.reshape(B * T)
+
+        # Doubly-corrected IS ratio (appo_torch_policy.py:236-239).
+        is_ratio = jnp.clip(
+            jnp.exp(behaviour_logp - old_logp), 0.0, 2.0
+        )
+        logp_ratio = is_ratio * jnp.exp(cur_logp - behaviour_logp)
+
+        surrogate = jnp.minimum(
+            advantages * logp_ratio,
+            advantages
+            * jnp.clip(logp_ratio, 1.0 - clip_param, 1.0 + clip_param),
+        )
+        pi_loss = -jnp.mean(surrogate)
+        vf_loss = 0.5 * jnp.mean(
+            jnp.square(vtr.vs - values.reshape(B, T))
+        )
+        entropy_mean = jnp.mean(dist.entropy())
+        action_kl = jnp.mean(old_dist.kl(dist))
+
+        total = (
+            pi_loss
+            + cfg.get("vf_loss_coeff", 0.5) * vf_loss
+            - coeffs["entropy_coeff"] * entropy_mean
+        )
+        if use_kl:
+            total = total + coeffs["kl_coeff"] * action_kl
+        stats = {
+            "policy_loss": pi_loss,
+            "vf_loss": vf_loss,
+            "entropy": entropy_mean,
+            "kl": action_kl,
+            "mean_is_ratio": jnp.mean(is_ratio),
+        }
+        return total, stats
+
+
+class APPO(IMPALA):
+    _default_policy_class = APPOJaxPolicy
+
+    @classmethod
+    def get_default_config(cls) -> APPOConfig:
+        return APPOConfig(cls)
+
+    def setup(self, config: Dict) -> None:
+        super().setup(config)
+        self._last_target_refresh = 0
+
+    def training_step(self) -> Dict:
+        results = super().training_step()
+        # Target refresh + KL adaptation (reference appo.py
+        # after_train_step).
+        trained = self._counters[NUM_ENV_STEPS_TRAINED]
+        freq = self.config.get("target_update_frequency", 1)
+        batch_size = max(1, self.config.get("train_batch_size", 500))
+        if trained - self._last_target_refresh >= freq * batch_size:
+            self._last_target_refresh = trained
+            self._counters["num_target_updates"] += 1
+            policy = self.get_policy()
+            policy.update_target()
+            if self.config.get("use_kl_loss"):
+                kl = results.get(DEFAULT_POLICY_ID, {}).get("kl")
+                target = self.config.get("kl_target", 0.01)
+                if kl is not None:
+                    if kl > 2.0 * target:
+                        policy.coeff_values["kl_coeff"] *= 1.5
+                    elif kl < 0.5 * target:
+                        policy.coeff_values["kl_coeff"] *= 0.5
+        return results

@@ -1,0 +1,9 @@
+from ray_tpu.algorithms.ddpg.ddpg import (
+    DDPG,
+    DDPGConfig,
+    DDPGJaxPolicy,
+    TD3,
+    TD3Config,
+)
+
+__all__ = ["DDPG", "DDPGConfig", "DDPGJaxPolicy", "TD3", "TD3Config"]

@@ -133,15 +133,19 @@ def adjust_nstep(n_step: int, gamma: float, batch: SampleBatch) -> None:
     batch["n_steps"] = n_steps
 
 
-def _epsilon_exploration_config(config: Dict) -> Dict:
+_EPSILON_KEYS = ("initial_epsilon", "final_epsilon", "epsilon_timesteps")
+
+
+def _epsilon_exploration_config(config: Dict, force_keys=()) -> Dict:
     """Fold DQN's flat epsilon knobs into exploration_config so the
-    pluggable EpsilonGreedy strategy picks them up. The flat keys are
-    authoritative (they are DQNConfig's documented surface and the ones
-    PBT mutates), so they overwrite any stale copies from an earlier
-    fold."""
+    pluggable EpsilonGreedy strategy picks them up. A user-supplied
+    exploration_config wins over the flat DQNConfig defaults (which
+    always exist), EXCEPT for keys in ``force_keys`` — the explicitly
+    mutated knobs of an update_config/PBT call, which must override
+    stale fold-ins from init time."""
     ec = dict(config.get("exploration_config") or {})
-    for key in ("initial_epsilon", "final_epsilon", "epsilon_timesteps"):
-        if key in config:
+    for key in _EPSILON_KEYS:
+        if key in config and (key not in ec or key in force_keys):
             ec[key] = config[key]
     return ec
 
@@ -164,20 +168,13 @@ class DQNJaxPolicy(JaxPolicy):
     def _init_aux_state(self):
         return {"target_params": self.params}
 
+    def _refold_exploration_config(self, new_config: Dict) -> None:
+        self.config["exploration_config"] = _epsilon_exploration_config(
+            self.config, force_keys=new_config
+        )
+
     def update_config(self, new_config: Dict) -> None:
         super().update_config(new_config)
-        from ray_tpu.utils.exploration import exploration_from_config
-
-        self.config["exploration_config"] = _epsilon_exploration_config(
-            self.config
-        )
-        self.exploration = exploration_from_config(
-            self.config,
-            self.action_space,
-            self.model_config,
-            default=self.default_exploration,
-        )
-        self.coeff_values.update(self.exploration.init_coeffs())
         if hasattr(self, "_td_error_fn"):
             del self._td_error_fn
 

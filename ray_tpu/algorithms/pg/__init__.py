@@ -1,0 +1,3 @@
+from ray_tpu.algorithms.pg.pg import PG, PGConfig, PGJaxPolicy
+
+__all__ = ["PG", "PGConfig", "PGJaxPolicy"]

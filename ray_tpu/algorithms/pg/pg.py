@@ -1,0 +1,88 @@
+"""Vanilla Policy Gradient (REINFORCE).
+
+Counterpart of the reference's ``rllib/algorithms/pg/pg.py`` (PGConfig)
+and ``pg_torch_policy.py`` (loss = -mean(logp * discounted returns),
+advantages from ``post_process_advantages`` with use_gae=use_critic=
+False). The whole update is the base JaxPolicy jitted SGD nest with a
+one-line loss."""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax.numpy as jnp
+
+from ray_tpu.algorithms.algorithm import (
+    Algorithm,
+    NUM_AGENT_STEPS_SAMPLED,
+    NUM_ENV_STEPS_SAMPLED,
+)
+from ray_tpu.algorithms.algorithm_config import AlgorithmConfig
+from ray_tpu.data.sample_batch import SampleBatch
+from ray_tpu.evaluation.postprocessing import compute_gae_for_sample_batch
+from ray_tpu.execution.rollout_ops import synchronous_parallel_sample
+from ray_tpu.execution.train_ops import train_one_step
+from ray_tpu.policy.jax_policy import JaxPolicy
+
+
+class PGConfig(AlgorithmConfig):
+    """reference pg.py PGConfig (lr=4e-4, train_batch_size=200)."""
+
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class or PG)
+        self.lr = 0.0004
+        self.train_batch_size = 200
+        self.num_sgd_iter = 1
+        # REINFORCE uses raw discounted returns, no baseline
+        self.use_gae = False
+        self.use_critic = False
+
+
+class PGJaxPolicy(JaxPolicy):
+    """reference pg_torch_policy.py pg_torch_loss."""
+
+    def loss(self, params, batch, rng, coeffs):
+        dist_inputs, _, _ = self.model_forward(
+            params, batch[SampleBatch.OBS]
+        )
+        dist = self.dist_class(dist_inputs)
+        logp = dist.logp(batch[SampleBatch.ACTIONS])
+        advantages = batch[SampleBatch.ADVANTAGES]
+        policy_loss = -jnp.mean(logp * advantages)
+        total = policy_loss - coeffs["entropy_coeff"] * jnp.mean(
+            dist.entropy()
+        )
+        return total, {
+            "policy_loss": policy_loss,
+            "entropy": jnp.mean(dist.entropy()),
+        }
+
+    def postprocess_trajectory(
+        self, sample_batch, other_agent_batches=None, episode=None
+    ):
+        return compute_gae_for_sample_batch(
+            self, sample_batch, other_agent_batches, episode
+        )
+
+
+class PG(Algorithm):
+    _default_policy_class = PGJaxPolicy
+
+    @classmethod
+    def get_default_config(cls) -> PGConfig:
+        return PGConfig(cls)
+
+    def training_step(self) -> Dict:
+        train_batch = synchronous_parallel_sample(
+            worker_set=self.workers,
+            max_env_steps=self.config["train_batch_size"],
+        )
+        self._counters[NUM_ENV_STEPS_SAMPLED] += train_batch.env_steps()
+        self._counters[NUM_AGENT_STEPS_SAMPLED] += train_batch.env_steps()
+        train_info = train_one_step(self, train_batch)
+        self.workers.sync_weights(
+            global_vars={
+                "timestep": self._counters[NUM_ENV_STEPS_SAMPLED]
+            }
+        )
+        return train_info

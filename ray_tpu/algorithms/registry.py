@@ -2,9 +2,25 @@
 
 from __future__ import annotations
 
-from typing import Callable, Dict
+import importlib
+from typing import Callable, Dict, Tuple
 
 _ALGORITHMS: Dict[str, Callable] = {}
+
+# name -> (module, class attr); imports resolve lazily on first lookup.
+_BUILTINS: Dict[str, Tuple[str, str]] = {
+    "PPO": ("ray_tpu.algorithms.ppo.ppo", "PPO"),
+    "APPO": ("ray_tpu.algorithms.appo.appo", "APPO"),
+    "IMPALA": ("ray_tpu.algorithms.impala.impala", "IMPALA"),
+    "SAC": ("ray_tpu.algorithms.sac.sac", "SAC"),
+    "DQN": ("ray_tpu.algorithms.dqn.dqn", "DQN"),
+    "SimpleQ": ("ray_tpu.algorithms.dqn.dqn", "SimpleQ"),
+    "A2C": ("ray_tpu.algorithms.a2c.a2c", "A2C"),
+    "A3C": ("ray_tpu.algorithms.a2c.a2c", "A3C"),
+    "PG": ("ray_tpu.algorithms.pg.pg", "PG"),
+    "DDPG": ("ray_tpu.algorithms.ddpg.ddpg", "DDPG"),
+    "TD3": ("ray_tpu.algorithms.ddpg.ddpg", "TD3"),
+}
 
 
 def register_algorithm(name: str, loader: Callable) -> None:
@@ -12,55 +28,12 @@ def register_algorithm(name: str, loader: Callable) -> None:
 
 
 def get_algorithm_class(name: str):
-    if name not in _ALGORITHMS:
-        _register_builtins()
-    if name not in _ALGORITHMS:
-        raise ValueError(
-            f"Unknown algorithm {name!r}; known: {sorted(_ALGORITHMS)}"
-        )
-    return _ALGORITHMS[name]()
-
-
-def _register_builtins() -> None:
-    def _ppo():
-        from ray_tpu.algorithms.ppo.ppo import PPO
-
-        return PPO
-
-    _ALGORITHMS.setdefault("PPO", _ppo)
-    try:
-        def _impala():
-            from ray_tpu.algorithms.impala.impala import IMPALA
-
-            return IMPALA
-
-        _ALGORITHMS.setdefault("IMPALA", _impala)
-    except ImportError:
-        pass
-    try:
-        def _sac():
-            from ray_tpu.algorithms.sac.sac import SAC
-
-            return SAC
-
-        _ALGORITHMS.setdefault("SAC", _sac)
-    except ImportError:
-        pass
-    try:
-        def _dqn():
-            from ray_tpu.algorithms.dqn.dqn import DQN
-
-            return DQN
-
-        _ALGORITHMS.setdefault("DQN", _dqn)
-    except ImportError:
-        pass
-    try:
-        def _a2c():
-            from ray_tpu.algorithms.a2c.a2c import A2C
-
-            return A2C
-
-        _ALGORITHMS.setdefault("A2C", _a2c)
-    except ImportError:
-        pass
+    if name in _ALGORITHMS:
+        return _ALGORITHMS[name]()
+    if name in _BUILTINS:
+        module, attr = _BUILTINS[name]
+        return getattr(importlib.import_module(module), attr)
+    raise ValueError(
+        f"Unknown algorithm {name!r}; known: "
+        f"{sorted(set(_ALGORITHMS) | set(_BUILTINS))}"
+    )

@@ -214,15 +214,7 @@ class SACJaxPolicy(JaxPolicy):
         # SAC's squashed-Gaussian sampling IS its exploration (the
         # reference uses StochasticSampling for SAC too); the strategy
         # object exists for the uniform hook surface (state, weights).
-        from ray_tpu.utils.exploration import exploration_from_config
-
-        self.exploration = exploration_from_config(
-            config, action_space, config.get("model") or {}
-        )
-        self.coeff_values.update(self.exploration.init_coeffs())
-        self._expl_state = ()
-        self._expl_state_batch = -1
-        self._last_obs = None
+        self._init_exploration()
 
     def get_initial_state(self):
         return []

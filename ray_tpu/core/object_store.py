@@ -26,6 +26,24 @@ from ray_tpu.core import serialization as ser
 SHM_THRESHOLD = 256 * 1024  # bytes
 
 
+class Segment(shared_memory.SharedMemory):
+    """SharedMemory whose finalizer tolerates still-exported views.
+
+    Task results are deserialized as zero-copy numpy views into the
+    segment; if user code still references them when the segment object
+    is garbage-collected (e.g. at interpreter exit without free()),
+    stock SharedMemory.__del__ sprays "BufferError: cannot close
+    exported pointers exist". The OS reclaims the mapping at process
+    exit regardless, so the finalizer — and only the finalizer —
+    swallows that error; explicit close() still raises."""
+
+    def __del__(self):
+        try:
+            super().__del__()
+        except BufferError:
+            pass
+
+
 class ObjectRef:
     """Future handle to a task result or put object
     (reference ``python/ray/_raylet.pyx ObjectRef``)."""
@@ -93,7 +111,7 @@ class ObjectStore:
             meta, buffers = ser.serialize(value)
             size = ser.serialized_size(meta, buffers)
             if size >= SHM_THRESHOLD:
-                shm = shared_memory.SharedMemory(
+                shm = Segment(
                     create=True, size=size, name=f"rt_{obj_id[:24]}"
                 )
                 ser.write_to_buffer(shm.buf, meta, buffers)
@@ -111,7 +129,7 @@ class ObjectStore:
     def attach_shm(self, obj_id: str, shm_name: str) -> None:
         """Register a worker-created shm segment as this object's value."""
         e = self._entry(obj_id)
-        shm = shared_memory.SharedMemory(name=shm_name)
+        shm = Segment(name=shm_name)
         e.shm = shm
         e.value = ser.read_from_buffer(shm.buf)
         e.fire()

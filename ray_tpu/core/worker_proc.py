@@ -15,7 +15,6 @@ from __future__ import annotations
 import os
 import sys
 import traceback
-from multiprocessing import shared_memory
 from typing import Any, Dict
 
 
@@ -49,7 +48,9 @@ class _ObjArg:
         if self.has_inline:
             shm_cache[self.obj_id] = (None, self.inline)
             return self.inline
-        shm = shared_memory.SharedMemory(name=self.shm_name)
+        from ray_tpu.core.object_store import Segment
+
+        shm = Segment(name=self.shm_name)
         value = ser.read_from_buffer(shm.buf)
         # Keep the segment mapped as long as the value is cached: the
         # deserialized arrays are zero-copy views into it.
@@ -195,7 +196,9 @@ def worker_main(conn, worker_id: str, env_overrides: Dict[str, str]):
                 continue
             # ring congested/unusable: fall through to segment/pipe
         if size >= 256 * 1024:
-            shm = shared_memory.SharedMemory(
+            from ray_tpu.core.object_store import Segment
+
+            shm = Segment(
                 create=True, size=size, name=f"rt_{msg['task_id'][:24]}"
             )
             ser.write_to_buffer(shm.buf, meta, buffers)

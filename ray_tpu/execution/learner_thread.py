@@ -142,12 +142,17 @@ class LearnerThread(threading.Thread):
         except queue.Full:
             return False
 
-    def stop(self) -> None:
+    def stop(self, join_timeout: float = 30.0) -> None:
         self.stopped = True
         try:
             self.inqueue.put_nowait(None)
         except queue.Full:
             pass
+        # Join before interpreter teardown: a daemon thread killed while
+        # inside a jitted XLA call aborts the process ("FATAL: exception
+        # not rethrown") instead of exiting cleanly.
+        if self.is_alive() and threading.current_thread() is not self:
+            self.join(timeout=join_timeout)
 
     def stats(self) -> Dict:
         return {

@@ -127,12 +127,23 @@ class JaxPolicy(Policy):
         self.aux_state: Dict[str, Any] = self._init_aux_state()
 
         # ---- exploration ----
+        self._init_exploration()
+
+    # -- subclass hooks --------------------------------------------------
+
+    def _init_exploration(self) -> None:
+        """(Re)build the exploration strategy, merge its scheduled
+        coefficients, and reset its carried state. Shared by __init__
+        and update_config here and in the actor-critic policies (SAC,
+        DDPG) that bypass the base constructor."""
         from ray_tpu.utils.exploration import exploration_from_config
 
         self.exploration = exploration_from_config(
-            config,
-            action_space,
-            self.model_config,
+            self.config,
+            self.action_space,
+            getattr(self, "model_config", None)
+            or self.config.get("model")
+            or {},
             default=self.default_exploration,
         )
         self.coeff_values.update(self.exploration.init_coeffs())
@@ -140,7 +151,9 @@ class JaxPolicy(Policy):
         self._expl_state_batch = -1
         self._last_obs = None  # for ParameterNoise sigma adaptation
 
-    # -- subclass hooks --------------------------------------------------
+    def _refold_exploration_config(self, new_config: Dict) -> None:
+        """Hook for subclasses that mirror flat config knobs into
+        exploration_config (DQN's epsilon surface)."""
 
     def _init_coeffs(self) -> None:
         """Subclasses add extra coefficients to self.coeff_values."""
@@ -562,6 +575,12 @@ class JaxPolicy(Policy):
         self._learn_fns.clear()
         if hasattr(self, "_grad_fn"):
             del self._grad_fn
+        # Rebuild exploration (type/knobs may have mutated) and drop the
+        # compiled action program — its closure captured the old
+        # strategy object.
+        self._refold_exploration_config(new_config)
+        self._init_exploration()
+        self._action_fn = None
 
     def get_weights(self):
         return jax.device_get(self.params)

@@ -110,6 +110,45 @@ def test_epsilon_mutation_rebuilds_schedule():
     algo.stop()
 
 
+def test_user_exploration_config_wins_over_flat_defaults():
+    """exploration_config epsilon knobs must not be clobbered by the
+    always-present flat DQNConfig defaults."""
+    algo = (
+        DQNConfig()
+        .environment("CartPole-v1")
+        .rollouts(num_rollout_workers=0, rollout_fragment_length=16)
+        .exploration(
+            exploration_config={
+                "type": "EpsilonGreedy",
+                "epsilon_timesteps": 200000,
+            }
+        )
+        .build()
+    )
+    pol = algo.get_policy()
+    assert pol.exploration.schedule(100000) > 0.4  # not the 10k default
+    algo.stop()
+
+
+def test_update_config_swaps_exploration_and_drops_stale_action_fn():
+    algo = (
+        DQNConfig()
+        .environment("CartPole-v1")
+        .rollouts(num_rollout_workers=0, rollout_fragment_length=16)
+        .build()
+    )
+    pol = algo.get_policy()
+    pol.compute_actions(np.zeros((4, 4), np.float32))
+    assert pol._action_fn is not None
+    pol.update_config({"exploration_config": {"type": "Random"}})
+    assert isinstance(pol.exploration, Random)
+    # compiled action program captured the old strategy; must recompile
+    assert pol._action_fn is None
+    acts, _, _ = pol.compute_actions(np.zeros((4, 4), np.float32))
+    assert acts.shape == (4,)
+    algo.stop()
+
+
 def test_random_exploration_uniform():
     algo = _ppo_policy(type="Random")
     pol = algo.get_policy()
