@@ -1,0 +1,3 @@
+from ray_tpu.algorithms.es.es import ARS, ARSConfig, ES, ESConfig
+
+__all__ = ["ES", "ESConfig", "ARS", "ARSConfig"]

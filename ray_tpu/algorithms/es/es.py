@@ -1,0 +1,515 @@
+"""Evolution Strategies (ES) and Augmented Random Search (ARS).
+
+Counterpart of the reference's ``rllib/algorithms/es/es.py`` (Salimans
+et al. 2017: antithetic Gaussian perturbations, centered-rank weighting,
+shared noise table, Adam on the flat parameter vector) and
+``rllib/algorithms/ars/ars.py`` (Mania et al. 2018: top-k direction
+selection, reward-std scaling, plain SGD).
+
+These are the showcase for the task/actor API: perturbation rollouts are
+embarrassingly parallel `@ray.remote` actors, each holding an env + the
+policy network + a deterministically re-derived slice view of the shared
+noise table (the reference ships a 250M-float table through the object
+store — re-seeding locally is free and exact). The learner-side update
+(gather noise rows, centered-rank weighted sum, Adam) is host numpy: the
+parameter vectors are tiny MLPs, far below MXU-worthwhile sizes."""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+import ray_tpu as ray
+from ray_tpu.algorithms.algorithm import (
+    Algorithm,
+    NUM_AGENT_STEPS_SAMPLED,
+    NUM_ENV_STEPS_SAMPLED,
+)
+from ray_tpu.algorithms.algorithm_config import AlgorithmConfig
+from ray_tpu.policy.jax_policy import JaxPolicy
+from ray_tpu.utils.filter import get_filter
+
+
+class SharedNoiseTable:
+    """Deterministic Gaussian noise table (reference es.py
+    SharedNoiseTable / create_shared_noise). Every process re-derives
+    the identical table from the seed instead of shipping ~1GB."""
+
+    def __init__(self, count: int = 25_000_000, seed: int = 42):
+        self.noise = np.random.RandomState(seed).randn(count).astype(
+            np.float32
+        )
+
+    def get(self, i: int, dim: int) -> np.ndarray:
+        return self.noise[i : i + dim]
+
+    def sample_index(self, rng: np.random.RandomState, dim: int) -> int:
+        return int(rng.randint(0, len(self.noise) - dim + 1))
+
+
+def compute_centered_ranks(x: np.ndarray) -> np.ndarray:
+    """reference es_utils.py compute_centered_ranks: ranks scaled to
+    [-0.5, 0.5]."""
+    flat = x.ravel()
+    ranks = np.empty(flat.size, dtype=np.float32)
+    ranks[flat.argsort()] = np.arange(flat.size, dtype=np.float32)
+    ranks = ranks.reshape(x.shape)
+    return ranks / (x.size - 1) - 0.5
+
+
+class ESConfig(AlgorithmConfig):
+    """reference es.py ESConfig."""
+
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class or ES)
+        self.num_workers = 2
+        self.episodes_per_batch = 40
+        self.train_batch_size = 2000  # min timesteps per iteration
+        self.noise_stdev = 0.02
+        self.stepsize = 0.01
+        self.l2_coeff = 0.005
+        self.eval_prob = 0.03
+        self.noise_size = 25_000_000
+        self.report_length = 10
+        self.observation_filter = "MeanStdFilter"
+        self.model = {"fcnet_hiddens": [64, 64], "fcnet_activation": "tanh"}
+
+    def training(
+        self,
+        *,
+        episodes_per_batch: Optional[int] = None,
+        noise_stdev: Optional[float] = None,
+        stepsize: Optional[float] = None,
+        l2_coeff: Optional[float] = None,
+        eval_prob: Optional[float] = None,
+        noise_size: Optional[int] = None,
+        **kwargs,
+    ) -> "ESConfig":
+        super().training(**kwargs)
+        if episodes_per_batch is not None:
+            self.episodes_per_batch = episodes_per_batch
+        if noise_stdev is not None:
+            self.noise_stdev = noise_stdev
+        if stepsize is not None:
+            self.stepsize = stepsize
+        if l2_coeff is not None:
+            self.l2_coeff = l2_coeff
+        if eval_prob is not None:
+            self.eval_prob = eval_prob
+        if noise_size is not None:
+            self.noise_size = noise_size
+        return self
+
+
+class ARSConfig(ESConfig):
+    """reference ars.py ARSConfig."""
+
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class or ARS)
+        self.num_rollouts = 32  # directions per iteration
+        self.rollouts_used = 32  # top-k directions kept
+        self.sgd_stepsize = 0.01
+        self.noise_stdev = 0.02
+        self.eval_prob = 0.0
+
+    def training(
+        self,
+        *,
+        num_rollouts: Optional[int] = None,
+        rollouts_used: Optional[int] = None,
+        sgd_stepsize: Optional[float] = None,
+        **kwargs,
+    ) -> "ARSConfig":
+        super().training(**kwargs)
+        if num_rollouts is not None:
+            self.num_rollouts = num_rollouts
+        if rollouts_used is not None:
+            self.rollouts_used = rollouts_used
+        if sgd_stepsize is not None:
+            self.sgd_stepsize = sgd_stepsize
+        return self
+
+
+class ESJaxPolicy(JaxPolicy):
+    """The evaluated policy: deterministic forward of the catalog model.
+    ES never calls loss/learn — weights move via flat-vector updates."""
+
+    def loss(self, params, batch, rng, coeffs):
+        raise NotImplementedError("ES updates parameters via evolution")
+
+    def get_flat_weights(self) -> np.ndarray:
+        from jax.flatten_util import ravel_pytree
+
+        flat, unravel = ravel_pytree(jax.device_get(self.params))
+        self._unravel = unravel
+        return np.asarray(flat, np.float32)
+
+    def set_flat_weights(self, flat: np.ndarray) -> None:
+        if not hasattr(self, "_unravel"):
+            self.get_flat_weights()
+        self.set_weights(self._unravel(np.asarray(flat, np.float32)))
+
+
+class _RolloutEngine:
+    """Env + model + filter, shared by perturbation workers and the
+    driver-side evaluation path."""
+
+    def __init__(self, config: Dict, env_spec):
+        import gymnasium as gym
+
+        from ray_tpu.env.registry import get_env_creator
+        from ray_tpu.models.catalog import ModelCatalog
+
+        creator = get_env_creator(env_spec)
+        self.env = creator(config.get("env_config") or {})
+        model_config = dict(config.get("model") or {})
+        self.dist_class, num_outputs = ModelCatalog.get_action_dist(
+            self.env.action_space, model_config, config.get("dist_type")
+        )
+        self.model = ModelCatalog.get_model(
+            self.env.observation_space,
+            self.env.action_space,
+            num_outputs,
+            model_config,
+        )
+        rng = jax.random.PRNGKey(int(config.get("seed") or 0))
+        dummy = np.zeros(
+            (2,) + self.env.observation_space.shape, np.float32
+        )
+        params = self.model.init(rng, dummy)
+        from jax.flatten_util import ravel_pytree
+
+        flat, self._unravel = ravel_pytree(params)
+        self.num_params = int(flat.size)
+        self.filter = get_filter(
+            config.get("observation_filter", "MeanStdFilter"),
+            self.env.observation_space.shape,
+        )
+
+        def act(params, obs):
+            dist_inputs, _, _ = self.model.apply(params, obs[None])
+            return self.dist_class(dist_inputs).deterministic_sample()[0]
+
+        self._act = jax.jit(act)
+
+    def rollout(
+        self, flat_params: np.ndarray, update_filter: bool = True
+    ) -> Tuple[float, int]:
+        params = self._unravel(np.asarray(flat_params, np.float32))
+        obs, _ = self.env.reset()
+        total, steps = 0.0, 0
+        done = False
+        while not done:
+            fobs = self.filter(
+                np.asarray(obs, np.float32), update=update_filter
+            )
+            action = np.asarray(self._act(params, fobs))
+            obs, reward, terminated, truncated, _ = self.env.step(action)
+            total += float(reward)
+            steps += 1
+            done = terminated or truncated
+        return total, steps
+
+
+@ray.remote
+class _ESWorker:
+    """Perturbation-rollout actor (reference es.py Worker)."""
+
+    def __init__(self, config: Dict, env_spec, worker_seed: int):
+        self.config = dict(config)
+        self.engine = _RolloutEngine(self.config, env_spec)
+        self.noise = SharedNoiseTable(
+            int(config.get("noise_size", 25_000_000))
+        )
+        self.rng = np.random.RandomState(worker_seed)
+        self.stdev = float(config.get("noise_stdev", 0.02))
+        self.eval_prob = float(config.get("eval_prob", 0.0))
+
+    def do_rollouts(
+        self, flat_params: np.ndarray, filter_state, num_pairs: int
+    ) -> Dict:
+        if filter_state is not None:
+            self.engine.filter.sync(filter_state)
+        self.engine.filter.clear_buffer()
+        flat_params = np.asarray(flat_params, np.float32)
+        dim = flat_params.size
+        indices: List[int] = []
+        pos_returns: List[float] = []
+        neg_returns: List[float] = []
+        eval_returns: List[float] = []
+        steps = 0
+        lengths: List[int] = []
+        for _ in range(num_pairs):
+            if self.eval_prob and self.rng.rand() < self.eval_prob:
+                ret, n = self.engine.rollout(
+                    flat_params, update_filter=False
+                )
+                eval_returns.append(ret)
+                steps += n
+                continue
+            idx = self.noise.sample_index(self.rng, dim)
+            pert = self.stdev * self.noise.get(idx, dim)
+            r_pos, n_pos = self.engine.rollout(flat_params + pert)
+            r_neg, n_neg = self.engine.rollout(flat_params - pert)
+            indices.append(idx)
+            pos_returns.append(r_pos)
+            neg_returns.append(r_neg)
+            lengths += [n_pos, n_neg]
+            steps += n_pos + n_neg
+        return {
+            "indices": indices,
+            "pos_returns": pos_returns,
+            "neg_returns": neg_returns,
+            "lengths": lengths,
+            "eval_returns": eval_returns,
+            "steps": steps,
+            "filter_buffer": self.engine.filter.as_serializable(),
+        }
+
+
+class _FlatAdam:
+    """Adam on the flat parameter vector (reference
+    es/optimizers.py Adam)."""
+
+    def __init__(self, dim: int, stepsize: float):
+        self.m = np.zeros(dim, np.float32)
+        self.v = np.zeros(dim, np.float32)
+        self.t = 0
+        self.stepsize = stepsize
+        self.beta1, self.beta2, self.eps = 0.9, 0.999, 1e-8
+
+    def update(self, theta: np.ndarray, grad: np.ndarray) -> np.ndarray:
+        self.t += 1
+        self.m = self.beta1 * self.m + (1 - self.beta1) * grad
+        self.v = self.beta2 * self.v + (1 - self.beta2) * grad * grad
+        a = (
+            self.stepsize
+            * np.sqrt(1 - self.beta2**self.t)
+            / (1 - self.beta1**self.t)
+        )
+        return theta - a * self.m / (np.sqrt(self.v) + self.eps)
+
+
+class ES(Algorithm):
+    _default_policy_class = ESJaxPolicy
+
+    @classmethod
+    def get_default_config(cls) -> ESConfig:
+        return ESConfig(cls)
+
+    def setup(self, config: Dict) -> None:
+        # The standard WorkerSet serves evaluation/checkpointing only;
+        # perturbation rollouts run on dedicated ES actors.
+        self._es_num_workers = max(1, int(config.get("num_workers", 2)))
+        config = dict(config, num_workers=0)
+        super().setup(config)
+        policy = self.get_policy()
+        self._theta = policy.get_flat_weights()
+        self.noise = SharedNoiseTable(
+            int(config.get("noise_size", 25_000_000))
+        )
+        self._filter = get_filter(
+            config.get("observation_filter", "MeanStdFilter"),
+            policy.observation_space.shape,
+        )
+        self._optimizer = _FlatAdam(
+            self._theta.size, float(config.get("stepsize", 0.01))
+        )
+        seed = int(config.get("seed") or 0)
+        # Strip driver-only runtime objects (the jax Mesh in "_mesh")
+        # before shipping the config to worker processes.
+        worker_config = {
+            k: v for k, v in config.items() if not k.startswith("_")
+        }
+        self._es_workers = [
+            _ESWorker.remote(
+                worker_config, config.get("env"), seed * 1000 + i
+            )
+            for i in range(self._es_num_workers)
+        ]
+        self._eval_returns: List[float] = []
+
+    def _pairs_per_iteration(self) -> int:
+        return max(
+            1, int(self.config.get("episodes_per_batch", 40)) // 2
+        )
+
+    def _collect(self, num_pairs_total: int) -> List[Dict]:
+        per = -(-num_pairs_total // len(self._es_workers))
+        filter_state = self._filter.as_serializable()
+        refs = [
+            w.do_rollouts.remote(self._theta, filter_state, per)
+            for w in self._es_workers
+        ]
+        return ray.get(refs)
+
+    def _gather_iteration(self) -> Dict:
+        """Collect perturbation rollouts until BOTH the episode floor
+        (episodes_per_batch) and the timestep floor (train_batch_size)
+        are met — reference es.py _collect_results loops on exactly
+        these two minima. Merges worker results, filter deltas, and
+        episode metrics."""
+        from ray_tpu.evaluation.metrics import RolloutMetrics
+
+        pairs_target = self._pairs_per_iteration()
+        min_steps = int(self.config.get("train_batch_size", 0) or 0)
+        agg = {
+            "indices": [],
+            "pos": [],
+            "neg": [],
+            "steps": 0,
+        }
+        self._eval_returns = []
+        while True:
+            remaining = max(1, pairs_target - len(agg["indices"]))
+            for r in self._collect(remaining):
+                agg["indices"] += list(r["indices"])
+                agg["pos"] += list(r["pos_returns"])
+                agg["neg"] += list(r["neg_returns"])
+                agg["steps"] += r["steps"]
+                self._eval_returns += list(r["eval_returns"])
+                self._filter.apply_changes(
+                    r["filter_buffer"], with_buffer=False
+                )
+                lens = list(r.get("lengths", []))
+                rets = list(r["pos_returns"]) + list(r["neg_returns"])
+                lens = lens[0::2] + lens[1::2]  # pos-then-neg order
+                lens += [0] * (len(rets) - len(lens))
+                for ret, ln in zip(rets, lens):
+                    self._episode_history.append(
+                        RolloutMetrics(ln, ret)
+                    )
+            if (
+                len(agg["indices"]) >= pairs_target
+                and agg["steps"] >= min_steps
+            ):
+                break
+        self._counters[NUM_ENV_STEPS_SAMPLED] += agg["steps"]
+        self._counters[NUM_AGENT_STEPS_SAMPLED] += agg["steps"]
+        # Keep the learned normalization visible outside the ES rollout
+        # path: checkpoints and evaluation read the local worker's
+        # filters (reference es.py syncs policy.observation_filter).
+        lw = self.workers.local_worker()
+        if lw is not None and hasattr(lw, "filters"):
+            for f in lw.filters.values():
+                f.sync(self._filter.as_serializable())
+        return agg
+
+    def _apply_results(self, agg: Dict) -> Dict:
+        """Centered-rank weighted noise update (reference es.py step)."""
+        cfg = self.config
+        stdev = float(cfg.get("noise_stdev", 0.02))
+        indices, pos, neg = agg["indices"], agg["pos"], agg["neg"]
+        if not indices:
+            return {"episodes_this_iter": 0}
+        returns = np.stack(
+            [np.asarray(pos, np.float32), np.asarray(neg, np.float32)],
+            axis=1,
+        )  # (P, 2)
+        ranks = compute_centered_ranks(returns)
+        weights = ranks[:, 0] - ranks[:, 1]  # (P,)
+        dim = self._theta.size
+        rows = np.stack([self.noise.get(i, dim) for i in indices])
+        grad = weights @ rows / (len(indices) * stdev)
+        # gradient ASCENT with L2 decay toward 0 (reference es.py:~320)
+        update = -grad + float(cfg.get("l2_coeff", 0.005)) * self._theta
+        self._theta = self._optimizer.update(self._theta, update)
+        self.get_policy().set_flat_weights(self._theta)
+        return {
+            "episodes_this_iter": 2 * len(indices),
+            "weights_norm": float(np.linalg.norm(self._theta)),
+            "grad_norm": float(np.linalg.norm(grad)),
+            "update_ratio": float(
+                np.linalg.norm(self._optimizer.m)
+                / (np.linalg.norm(self._theta) + 1e-8)
+            ),
+            "noise_std": stdev,
+            "mean_pos_return": float(np.mean(pos)),
+            "mean_neg_return": float(np.mean(neg)),
+            "episode_reward_mean_perturbed": float(np.mean(returns)),
+        }
+
+    def training_step(self) -> Dict:
+        agg = self._gather_iteration()
+        info = self._apply_results(agg)
+        if self._eval_returns:
+            info["eval_reward_mean"] = float(
+                np.mean(self._eval_returns)
+            )
+        return info
+
+    # -- checkpointing ---------------------------------------------------
+
+    def __getstate__(self) -> Dict:
+        state = super().__getstate__()
+        state["es"] = {
+            "theta": self._theta,
+            "optimizer": self._optimizer.__dict__,
+            "filter": self._filter.as_serializable(),
+        }
+        return state
+
+    def __setstate__(self, state: Dict) -> None:
+        super().__setstate__(state)
+        es = state.get("es")
+        if es:
+            self._theta = np.asarray(es["theta"], np.float32)
+            self._optimizer.__dict__.update(es["optimizer"])
+            self._filter.sync(es["filter"])
+            self.get_policy().set_flat_weights(self._theta)
+
+    def cleanup(self) -> None:
+        for w in getattr(self, "_es_workers", []):
+            try:
+                ray.kill(w)
+            except Exception:
+                pass
+        super().cleanup()
+
+
+class ARS(ES):
+    """reference ars.py: top-k direction selection + reward-std scaling
+    + plain SGD instead of Adam."""
+
+    @classmethod
+    def get_default_config(cls) -> ARSConfig:
+        return ARSConfig(cls)
+
+    def _pairs_per_iteration(self) -> int:
+        return max(1, int(self.config.get("num_rollouts", 32)))
+
+    def _apply_results(self, agg: Dict) -> Dict:
+        cfg = self.config
+        stdev = float(cfg.get("noise_stdev", 0.02))
+        indices, pos, neg = agg["indices"], agg["pos"], agg["neg"]
+        if not indices:
+            return {"episodes_this_iter": 0}
+        pos_a = np.asarray(pos, np.float32)
+        neg_a = np.asarray(neg, np.float32)
+        # top-k directions by max(pos, neg) (Mania et al. alg. 2)
+        k = min(
+            int(cfg.get("rollouts_used", len(indices))), len(indices)
+        )
+        order = np.argsort(-np.maximum(pos_a, neg_a))[:k]
+        used_rewards = np.concatenate([pos_a[order], neg_a[order]])
+        reward_std = max(float(used_rewards.std()), 1e-6)
+        dim = self._theta.size
+        rows = np.stack([self.noise.get(indices[i], dim) for i in order])
+        grad = (pos_a[order] - neg_a[order]) @ rows / (k * reward_std)
+        step_size = float(cfg.get("sgd_stepsize", 0.01))
+        self._theta = self._theta + step_size * grad
+        self.get_policy().set_flat_weights(self._theta)
+        return {
+            "episodes_this_iter": 2 * len(indices),
+            "weights_norm": float(np.linalg.norm(self._theta)),
+            "grad_norm": float(np.linalg.norm(grad)),
+            "reward_std": reward_std,
+            "noise_std": stdev,
+            "mean_pos_return": float(np.mean(pos_a)),
+            "mean_neg_return": float(np.mean(neg_a)),
+            "episode_reward_mean_perturbed": float(
+                np.mean(np.stack([pos_a, neg_a]))
+            ),
+        }

@@ -20,6 +20,8 @@ _BUILTINS: Dict[str, Tuple[str, str]] = {
     "PG": ("ray_tpu.algorithms.pg.pg", "PG"),
     "DDPG": ("ray_tpu.algorithms.ddpg.ddpg", "DDPG"),
     "TD3": ("ray_tpu.algorithms.ddpg.ddpg", "TD3"),
+    "ES": ("ray_tpu.algorithms.es.es", "ES"),
+    "ARS": ("ray_tpu.algorithms.es.es", "ARS"),
 }
 
 
