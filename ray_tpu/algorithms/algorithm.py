@@ -111,6 +111,12 @@ class Algorithm(Trainable):
                 **config,
                 **(config.get("evaluation_config") or {}),
                 "num_workers": 0,
+                # Never mirror evaluation rollouts into the offline
+                # dataset — they come from a different (often
+                # deterministic) distribution than training samples.
+                "output": (config.get("evaluation_config") or {}).get(
+                    "output"
+                ),
             }
             self.evaluation_workers = WorkerSet(
                 env_creator=env_creator,

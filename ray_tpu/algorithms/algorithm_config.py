@@ -56,6 +56,12 @@ class AlgorithmConfig:
         self.explore = True
         self.exploration_config: Dict = {}
 
+        # offline data (reference :offline_data)
+        self.input_ = None  # "sampler" | path/glob of JSON shards
+        self.output = None  # path to write sampled batches to
+        self.output_max_file_size = 64 * 1024 * 1024
+        self.off_policy_estimation_methods: list = []
+
         # evaluation (reference :800)
         self.evaluation_interval = None
         self.evaluation_duration = 10
@@ -189,6 +195,28 @@ class AlgorithmConfig:
             self.learner_devices = learner_devices
         return self
 
+    def offline_data(
+        self,
+        *,
+        input_=None,
+        output: Optional[str] = None,
+        output_max_file_size: Optional[int] = None,
+        off_policy_estimation_methods=None,
+        **kwargs,
+    ) -> "AlgorithmConfig":
+        """reference algorithm_config.py offline_data()."""
+        if input_ is not None:
+            self.input_ = input_
+        if output is not None:
+            self.output = output
+        if output_max_file_size is not None:
+            self.output_max_file_size = output_max_file_size
+        if off_policy_estimation_methods is not None:
+            self.off_policy_estimation_methods = (
+                off_policy_estimation_methods
+            )
+        return self
+
     def exploration(
         self, *, explore: Optional[bool] = None,
         exploration_config: Optional[Dict] = None, **kwargs,
@@ -277,6 +305,9 @@ class AlgorithmConfig:
             if k == "framework_str":
                 out["framework"] = v
                 continue
+            if k == "input_":
+                out["input"] = v
+                continue
             out[k] = v
         return copy.deepcopy(
             {k: v for k, v in out.items()}
@@ -288,6 +319,8 @@ class AlgorithmConfig:
                 self.framework_str = v
             elif k == "num_rollout_workers":
                 self.num_workers = v
+            elif k == "input":
+                self.input_ = v
             else:
                 setattr(self, k, v)
         return self

@@ -1,0 +1,3 @@
+from ray_tpu.algorithms.cql.cql import CQL, CQLConfig, CQLJaxPolicy
+
+__all__ = ["CQL", "CQLConfig", "CQLJaxPolicy"]

@@ -1,0 +1,3 @@
+from ray_tpu.algorithms.crr.crr import CRR, CRRConfig, CRRJaxPolicy
+
+__all__ = ["CRR", "CRRConfig", "CRRJaxPolicy"]

@@ -1,0 +1,9 @@
+from ray_tpu.algorithms.marwil.marwil import (
+    BC,
+    BCConfig,
+    MARWIL,
+    MARWILConfig,
+    MARWILJaxPolicy,
+)
+
+__all__ = ["MARWIL", "MARWILConfig", "MARWILJaxPolicy", "BC", "BCConfig"]

@@ -1,0 +1,276 @@
+"""MARWIL (advantage-weighted imitation) and BC (behavior cloning).
+
+Counterpart of the reference's ``rllib/algorithms/marwil/marwil.py``
+(MARWILConfig; trains from offline JSON input) and
+``marwil_torch_policy.py`` (exponentially advantage-weighted logp loss
+with a moving-average squared-advantage normalizer; BC is MARWIL with
+beta=0 — ``rllib/algorithms/bc/bc.py``).
+
+The moving-average normalizer is host-side state fed into the jitted
+loss as a traced scalar coefficient and updated from the returned
+``adv_sqd_mean`` stat after each learn call (MARWIL's default
+num_sgd_iter=1 makes this exactly the reference's per-SGD-step update).
+ADVANTAGES in the batch are plain discounted returns (use_gae=False,
+use_critic=False — reference marwil_tf_policy.py PostprocessAdvantages).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.algorithms.algorithm import (
+    Algorithm,
+    NUM_AGENT_STEPS_SAMPLED,
+    NUM_ENV_STEPS_SAMPLED,
+)
+from ray_tpu.algorithms.algorithm_config import AlgorithmConfig
+from ray_tpu.data.sample_batch import DEFAULT_POLICY_ID, SampleBatch
+from ray_tpu.evaluation.postprocessing import compute_gae_for_sample_batch
+from ray_tpu.execution.train_ops import train_one_step
+from ray_tpu.policy.jax_policy import JaxPolicy
+
+
+class MARWILConfig(AlgorithmConfig):
+    """reference marwil.py MARWILConfig."""
+
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class or MARWIL)
+        self.beta = 1.0
+        self.vf_coeff = 1.0
+        self.bc_logstd_coeff = 0.0
+        self.moving_average_sqd_adv_norm_start = 100.0
+        self.moving_average_sqd_adv_norm_update_rate = 1e-8
+        self.lr = 1e-4
+        self.train_batch_size = 2000
+        self.num_sgd_iter = 1
+        self.use_gae = False
+        self.use_critic = False
+        self.off_policy_estimation_methods = ["is", "wis"]
+
+    def training(
+        self,
+        *,
+        beta: Optional[float] = None,
+        vf_coeff: Optional[float] = None,
+        bc_logstd_coeff: Optional[float] = None,
+        moving_average_sqd_adv_norm_start: Optional[float] = None,
+        moving_average_sqd_adv_norm_update_rate: Optional[float] = None,
+        **kwargs,
+    ) -> "MARWILConfig":
+        super().training(**kwargs)
+        if beta is not None:
+            self.beta = beta
+        if vf_coeff is not None:
+            self.vf_coeff = vf_coeff
+        if bc_logstd_coeff is not None:
+            self.bc_logstd_coeff = bc_logstd_coeff
+        if moving_average_sqd_adv_norm_start is not None:
+            self.moving_average_sqd_adv_norm_start = (
+                moving_average_sqd_adv_norm_start
+            )
+        if moving_average_sqd_adv_norm_update_rate is not None:
+            self.moving_average_sqd_adv_norm_update_rate = (
+                moving_average_sqd_adv_norm_update_rate
+            )
+        return self
+
+class BCConfig(MARWILConfig):
+    """reference bc.py BCConfig: MARWIL with beta=0 (no advantage
+    weighting, no value learning)."""
+
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class or BC)
+        self.beta = 0.0
+        self.vf_coeff = 0.0
+
+
+class MARWILJaxPolicy(JaxPolicy):
+    """reference marwil_torch_policy.py loss."""
+
+    def _init_coeffs(self):
+        self.coeff_values["ma_sqd_adv_norm"] = float(
+            self.config.get("moving_average_sqd_adv_norm_start", 100.0)
+        )
+
+    def loss(self, params, batch, rng, coeffs):
+        cfg = self.config
+        beta = float(cfg.get("beta", 1.0))
+        dist_inputs, values, _ = self.model_forward(
+            params, batch[SampleBatch.OBS]
+        )
+        dist = self.dist_class(dist_inputs)
+        logp = dist.logp(batch[SampleBatch.ACTIONS])
+
+        stats = {}
+        if beta != 0.0:
+            returns = batch[SampleBatch.ADVANTAGES]
+            adv = returns - values
+            adv_sqd_mean = jnp.mean(jnp.square(adv))
+            exp_advs = jax.lax.stop_gradient(
+                jnp.exp(
+                    beta
+                    * (
+                        adv
+                        / (
+                            1e-8
+                            + jnp.sqrt(coeffs["ma_sqd_adv_norm"])
+                        )
+                    )
+                )
+            )
+            v_loss = 0.5 * adv_sqd_mean
+            stats["adv_sqd_mean"] = adv_sqd_mean
+            stats["vf_loss"] = v_loss
+        else:
+            exp_advs = 1.0
+            v_loss = 0.0
+
+        p_loss = -jnp.mean(exp_advs * logp)
+        total = p_loss + float(cfg.get("vf_coeff", 1.0)) * v_loss
+        stats.update(
+            policy_loss=p_loss,
+            entropy=jnp.mean(dist.entropy()),
+        )
+        return total, stats
+
+    def after_learn_on_batch(self, stats: Dict[str, float]) -> Dict:
+        """Advance the moving-average squared-advantage normalizer
+        (reference updates the torch buffer inside the loss; here the
+        scalar rides the traced coeffs dict)."""
+        if "adv_sqd_mean" in stats:
+            rate = float(
+                self.config.get(
+                    "moving_average_sqd_adv_norm_update_rate", 1e-8
+                )
+            )
+            cur = self.coeff_values["ma_sqd_adv_norm"]
+            self.coeff_values["ma_sqd_adv_norm"] = cur + rate * (
+                stats["adv_sqd_mean"] - cur
+            )
+            return {
+                "moving_average_sqd_adv_norm": self.coeff_values[
+                    "ma_sqd_adv_norm"
+                ]
+            }
+        return {}
+
+    def postprocess_trajectory(
+        self, sample_batch, other_agent_batches=None, episode=None
+    ):
+        # ADVANTAGES := discounted cumulative rewards (no GAE/critic),
+        # bootstrapped by V(last obs) on truncation.
+        return compute_gae_for_sample_batch(
+            self, sample_batch, other_agent_batches, episode
+        )
+
+
+
+class MARWIL(Algorithm):
+    _default_policy_class = MARWILJaxPolicy
+
+    @classmethod
+    def get_default_config(cls) -> MARWILConfig:
+        return MARWILConfig(cls)
+
+    def setup(self, config: Dict) -> None:
+        super().setup(config)
+        from ray_tpu.offline.offline_ops import setup_offline_reader
+
+        self._reader = setup_offline_reader(config)
+        self._estimators = []
+        if self._reader is not None:
+            from ray_tpu.offline import (
+                ImportanceSampling,
+                WeightedImportanceSampling,
+            )
+
+            methods = config.get(
+                "off_policy_estimation_methods", ["is", "wis"]
+            )
+            gamma = config.get("gamma", 0.99)
+            pol = self.get_policy()
+            if "is" in methods:
+                self._estimators.append(ImportanceSampling(pol, gamma))
+            if "wis" in methods:
+                self._estimators.append(
+                    WeightedImportanceSampling(pol, gamma)
+                )
+
+    def _next_offline_batch(self) -> SampleBatch:
+        from ray_tpu.data.sample_batch import concat_samples
+
+        target = int(self.config.get("train_batch_size", 2000))
+        out, steps = [], 0
+        policy = self.get_policy()
+        while steps < target:
+            b = self._reader.next()
+            # A written line concatenates multiple episodes; discounted
+            # returns must NOT leak across their boundaries, so
+            # postprocess each episode separately.
+            for ep in b.split_by_episode():
+                ep = policy.postprocess_trajectory(ep)
+                out.append(ep)
+                steps += ep.count
+        return concat_samples(out)
+
+    def training_step(self) -> Dict:
+        if self._reader is not None:
+            train_batch = self._next_offline_batch()
+        else:
+            from ray_tpu.execution.rollout_ops import (
+                synchronous_parallel_sample,
+            )
+
+            train_batch = synchronous_parallel_sample(
+                worker_set=self.workers,
+                max_env_steps=self.config["train_batch_size"],
+            )
+        self._counters[NUM_ENV_STEPS_SAMPLED] += train_batch.env_steps()
+        self._counters[NUM_AGENT_STEPS_SAMPLED] += (
+            train_batch.env_steps()
+        )
+        info = train_one_step(self, train_batch)
+        # Off-policy estimation of the learned policy vs the behavior
+        # data (reference marwil.py wires "is"/"wis" estimators).
+        if self._estimators:
+            if isinstance(info, dict) and DEFAULT_POLICY_ID in info:
+                sub = info[DEFAULT_POLICY_ID]
+            else:
+                sub = info
+            batch = (
+                train_batch
+                if not hasattr(train_batch, "policy_batches")
+                else train_batch.policy_batches[DEFAULT_POLICY_ID]
+            )
+            for est in self._estimators:
+                name = type(est).__name__
+                try:
+                    sub[f"off_policy_estimation/{name}"] = est.estimate(
+                        batch
+                    )
+                except Exception as e:
+                    if not getattr(self, "_est_warned", False):
+                        self._est_warned = True
+                        import warnings
+
+                        warnings.warn(
+                            f"off-policy estimation ({name}) failed "
+                            f"and is disabled for this run: {e!r} — "
+                            "does the dataset carry ACTION_LOGP?"
+                        )
+        self.workers.sync_weights(
+            global_vars={
+                "timestep": self._counters[NUM_ENV_STEPS_SAMPLED]
+            }
+        )
+        return info
+
+
+class BC(MARWIL):
+    @classmethod
+    def get_default_config(cls) -> BCConfig:
+        return BCConfig(cls)

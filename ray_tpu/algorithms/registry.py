@@ -22,6 +22,10 @@ _BUILTINS: Dict[str, Tuple[str, str]] = {
     "TD3": ("ray_tpu.algorithms.ddpg.ddpg", "TD3"),
     "ES": ("ray_tpu.algorithms.es.es", "ES"),
     "ARS": ("ray_tpu.algorithms.es.es", "ARS"),
+    "MARWIL": ("ray_tpu.algorithms.marwil.marwil", "MARWIL"),
+    "BC": ("ray_tpu.algorithms.marwil.marwil", "BC"),
+    "CQL": ("ray_tpu.algorithms.cql.cql", "CQL"),
+    "CRR": ("ray_tpu.algorithms.crr.crr", "CRR"),
 }
 
 

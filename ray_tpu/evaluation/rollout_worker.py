@@ -174,9 +174,26 @@ class RolloutWorker:
     # -- sampling --------------------------------------------------------
 
     def sample(self):
-        """reference rollout_worker.py:824."""
+        """reference rollout_worker.py:824 (+ the output-writer wiring
+        of reference offline/output_writer.py: every sampled batch is
+        mirrored to the configured offline store)."""
         assert self.sampler is not None, "worker has no env"
-        return self.sampler.sample()
+        batch = self.sampler.sample()
+        out = self.config.get("output")
+        if out:
+            if not hasattr(self, "_output_writer"):
+                from ray_tpu.offline import JsonWriter
+
+                self._output_writer = JsonWriter(
+                    out,
+                    max_file_size=int(
+                        self.config.get(
+                            "output_max_file_size", 64 * 1024 * 1024
+                        )
+                    ),
+                )
+            self._output_writer.write(batch)
+        return batch
 
     def sample_with_count(self):
         batch = self.sample()

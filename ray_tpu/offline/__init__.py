@@ -1,0 +1,15 @@
+from ray_tpu.offline.json_reader import JsonReader
+from ray_tpu.offline.json_writer import JsonWriter
+from ray_tpu.offline.off_policy_estimator import (
+    ImportanceSampling,
+    OffPolicyEstimator,
+    WeightedImportanceSampling,
+)
+
+__all__ = [
+    "JsonReader",
+    "JsonWriter",
+    "OffPolicyEstimator",
+    "ImportanceSampling",
+    "WeightedImportanceSampling",
+]

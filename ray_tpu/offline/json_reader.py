@@ -1,0 +1,92 @@
+"""JSON-lines SampleBatch reader (reference
+``rllib/offline/json_reader.py``).
+
+Reads shards written by :class:`JsonWriter` (exact numpy round trip) and
+also tolerates reference-style plain-list columns. ``next()`` cycles
+shards forever, shuffling line order per pass."""
+
+from __future__ import annotations
+
+import base64
+import glob
+import json
+import os
+import random
+import zlib
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ray_tpu.data.sample_batch import SampleBatch, concat_samples
+
+
+def _decode_col(v):
+    if isinstance(v, dict) and v.get("__np__"):
+        raw = zlib.decompress(base64.b64decode(v["data"]))
+        return np.frombuffer(raw, np.dtype(v["dtype"])).reshape(
+            v["shape"]
+        ).copy()
+    return np.asarray(v)
+
+
+_META_KEYS = ("type", "count")
+
+
+def json_to_batch(obj: Dict) -> SampleBatch:
+    raw = obj.get("columns", obj)
+    cols = {
+        k: _decode_col(v)
+        for k, v in raw.items()
+        if k not in _META_KEYS  # reference-style lines keep metadata
+        # next to the columns instead of under a "columns" key
+    }
+    return SampleBatch(cols)
+
+
+class JsonReader:
+    """reference json_reader.py JsonReader."""
+
+    def __init__(self, inputs, ioctx=None, shuffle: bool = True):
+        if isinstance(inputs, str):
+            inputs = [inputs]
+        files: List[str] = []
+        for p in inputs:
+            p = os.path.expanduser(p)
+            if os.path.isdir(p):
+                files += sorted(glob.glob(os.path.join(p, "*.json")))
+            else:
+                files += sorted(glob.glob(p))
+        if not files:
+            raise ValueError(f"No offline data files found in {inputs}")
+        self.files = files
+        self.shuffle = shuffle
+        self._rng = random.Random(0)
+        self._lines: List[str] = []
+        self._cursor = 0
+        self._load_pass()
+
+    def _load_pass(self) -> None:
+        lines = []
+        for f in self.files:
+            with open(f) as fh:
+                lines += [ln for ln in fh if ln.strip()]
+        if self.shuffle:
+            self._rng.shuffle(lines)
+        self._lines = lines
+        self._cursor = 0
+
+    def next(self) -> SampleBatch:
+        """→ the next batch, cycling through all shards forever."""
+        if self._cursor >= len(self._lines):
+            self._load_pass()
+        line = self._lines[self._cursor]
+        self._cursor += 1
+        return json_to_batch(json.loads(line))
+
+    def read_all(self) -> SampleBatch:
+        """Entire dataset as one concatenated batch (estimators,
+        small-data BC)."""
+        batches = [
+            json_to_batch(json.loads(ln)) for ln in self._lines
+        ]
+        return concat_samples(batches)

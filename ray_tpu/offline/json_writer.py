@@ -1,0 +1,84 @@
+"""JSON-lines SampleBatch writer (reference
+``rllib/offline/json_writer.py``).
+
+One JSON object per line per batch. Numpy columns are stored exactly —
+dtype + shape + zlib-compressed base64 payload — instead of the
+reference's lossy float lists, so a write/read round trip is
+bit-identical."""
+
+from __future__ import annotations
+
+import base64
+import json
+import os
+import time
+import zlib
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from ray_tpu.data.sample_batch import MultiAgentBatch, SampleBatch
+
+
+def _encode_col(v: Any):
+    v = np.asarray(v)
+    if v.dtype == object:
+        return None  # unsupported column (e.g. infos dicts): dropped
+    return {
+        "__np__": True,
+        "dtype": str(v.dtype),
+        "shape": list(v.shape),
+        "data": base64.b64encode(
+            zlib.compress(np.ascontiguousarray(v).tobytes(), 3)
+        ).decode("ascii"),
+    }
+
+
+def batch_to_json(batch: SampleBatch) -> Dict:
+    cols = {}
+    for k, v in batch.items():
+        enc = _encode_col(v)
+        if enc is not None:
+            cols[k] = enc
+    return {"type": "SampleBatch", "count": batch.count, "columns": cols}
+
+
+class JsonWriter:
+    """Writes batches to ``<path>/output-<ts>_<pid>.json``, rolling to a
+    new shard at ``max_file_size`` bytes."""
+
+    def __init__(
+        self,
+        path: str,
+        max_file_size: int = 64 * 1024 * 1024,
+        compress_columns=None,
+    ):
+        self.path = path
+        self.max_file_size = max_file_size
+        os.makedirs(path, exist_ok=True)
+        self._f = None
+        self._bytes = 0
+
+    def _open(self):
+        name = f"output-{time.strftime('%Y-%m-%d_%H-%M-%S')}_{os.getpid()}_{int(time.time_ns() % 1_000_000)}.json"
+        self._f = open(os.path.join(self.path, name), "w")
+        self._bytes = 0
+
+    def write(self, batch) -> None:
+        if isinstance(batch, MultiAgentBatch):
+            for b in batch.policy_batches.values():
+                self.write(b)
+            return
+        line = json.dumps(batch_to_json(batch))
+        if self._f is None or self._bytes + len(line) > self.max_file_size:
+            if self._f:
+                self._f.close()
+            self._open()
+        self._f.write(line + "\n")
+        self._f.flush()
+        self._bytes += len(line) + 1
+
+    def close(self) -> None:
+        if self._f:
+            self._f.close()
+            self._f = None

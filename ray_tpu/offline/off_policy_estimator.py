@@ -1,0 +1,110 @@
+"""Off-policy evaluation estimators (reference
+``rllib/offline/is_estimator.py`` / ``wis_estimator.py`` /
+``off_policy_estimator.py``).
+
+Given logged trajectories with behavior-policy action log-probs, score a
+(new) target policy without running it in the env: per-step importance
+ratios rho_t = pi_new(a|s)/pi_behavior(a|s), cumulated within each
+episode.
+
+- IS:  V = mean_episodes sum_t gamma^t * P_t * r_t with
+  P_t = prod_{k<=t} rho_k.
+- WIS: same numerator, but each P_t is normalized by its average over
+  episodes at the same step index (weighted IS, lower variance)."""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+import numpy as np
+
+from ray_tpu.data.sample_batch import SampleBatch
+
+
+class OffPolicyEstimator:
+    def __init__(self, policy, gamma: float = 0.99):
+        self.policy = policy
+        self.gamma = gamma
+
+    @classmethod
+    def create_from_io_context(cls, ioctx) -> "OffPolicyEstimator":
+        return cls(ioctx.policy, ioctx.config.get("gamma", 0.99))
+
+    def _episodes(self, batch: SampleBatch) -> List[SampleBatch]:
+        if SampleBatch.EPS_ID not in batch:
+            return [batch]
+        eps = np.asarray(batch[SampleBatch.EPS_ID])
+        out = []
+        for eid in np.unique(eps):
+            idx = np.nonzero(eps == eid)[0]
+            out.append(
+                SampleBatch({k: np.asarray(v)[idx] for k, v in batch.items()})
+            )
+        return out
+
+    def _ratios(self, episode: SampleBatch) -> np.ndarray:
+        new_logp = self.policy.compute_log_likelihoods(
+            episode[SampleBatch.ACTIONS], episode[SampleBatch.OBS]
+        )
+        old_logp = np.asarray(episode[SampleBatch.ACTION_LOGP])
+        return np.exp(
+            np.clip(new_logp - old_logp, -20.0, 20.0)
+        )
+
+    def estimate(self, batch: SampleBatch) -> Dict[str, float]:
+        raise NotImplementedError
+
+
+class ImportanceSampling(OffPolicyEstimator):
+    """reference is_estimator.py."""
+
+    def estimate(self, batch: SampleBatch) -> Dict[str, float]:
+        v_behavior, v_target = [], []
+        for ep in self._episodes(batch):
+            rewards = np.asarray(ep[SampleBatch.REWARDS], np.float64)
+            T = len(rewards)
+            gammas = self.gamma ** np.arange(T)
+            p = np.cumprod(self._ratios(ep))
+            v_behavior.append(float((gammas * rewards).sum()))
+            v_target.append(float((gammas * p * rewards).sum()))
+        vb = float(np.mean(v_behavior))
+        vt = float(np.mean(v_target))
+        return {
+            "v_behavior": vb,
+            "v_target": vt,
+            "v_gain": vt / vb if vb != 0 else np.nan,
+        }
+
+
+class WeightedImportanceSampling(OffPolicyEstimator):
+    """reference wis_estimator.py."""
+
+    def estimate(self, batch: SampleBatch) -> Dict[str, float]:
+        episodes = self._episodes(batch)
+        all_p: List[np.ndarray] = [
+            np.cumprod(self._ratios(ep)) for ep in episodes
+        ]
+        max_t = max(len(p) for p in all_p)
+        # per-step-index average of the cumulative ratios across
+        # episodes (the WIS normalizer w_t)
+        sums = np.zeros(max_t)
+        counts = np.zeros(max_t)
+        for p in all_p:
+            sums[: len(p)] += p
+            counts[: len(p)] += 1
+        w = sums / np.maximum(counts, 1)
+        v_behavior, v_target = [], []
+        for ep, p in zip(episodes, all_p):
+            rewards = np.asarray(ep[SampleBatch.REWARDS], np.float64)
+            T = len(rewards)
+            gammas = self.gamma ** np.arange(T)
+            norm_p = p / np.maximum(w[:T], 1e-8)
+            v_behavior.append(float((gammas * rewards).sum()))
+            v_target.append(float((gammas * norm_p * rewards).sum()))
+        vb = float(np.mean(v_behavior))
+        vt = float(np.mean(v_target))
+        return {
+            "v_behavior": vb,
+            "v_target": vt,
+            "v_gain": vt / vb if vb != 0 else np.nan,
+        }

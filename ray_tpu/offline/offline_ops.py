@@ -1,0 +1,66 @@
+"""Shared offline training-loop helpers (the reader→train-batch path
+used by CQL/CRR; reference cql.py/crr.py keep SAC's loop and swap the
+input source)."""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import numpy as np
+
+from ray_tpu.data.sample_batch import SampleBatch, concat_samples
+
+
+def setup_offline_reader(config: Dict):
+    """Build the JsonReader for config["input"] (None when training
+    from the sampler). Shared by MARWIL/BC/CQL/CRR setup."""
+    inp = config.get("input_") or config.get("input")
+    if not inp or inp == "sampler":
+        return None
+    from ray_tpu.offline import JsonReader
+
+    return JsonReader(inp)
+
+
+def sample_offline_batch(
+    reader,
+    target: int,
+    *,
+    require_next_obs: bool = False,
+    seed: int = 0,
+) -> SampleBatch:
+    """Draw >= target rows from the reader, then subsample exactly
+    `target` rows uniformly (a fixed batch shape keeps the jitted learn
+    program from recompiling)."""
+    out, steps = [], 0
+    while steps < target:
+        b = reader.next()
+        if require_next_obs and SampleBatch.NEXT_OBS not in b:
+            raise ValueError(
+                "offline data requires NEXT_OBS columns for TD learning"
+            )
+        out.append(b)
+        steps += b.count
+    batch = concat_samples(out)
+    idx = np.random.default_rng(seed).permutation(batch.count)[:target]
+    return SampleBatch(
+        {k: np.asarray(v)[idx] for k, v in batch.items()}
+    )
+
+
+def offline_training_step(algo) -> Dict:
+    """One offline train step: draw, learn, count (shared by CQL/CRR)."""
+    from ray_tpu.data.sample_batch import DEFAULT_POLICY_ID
+    from ray_tpu.execution.train_ops import NUM_ENV_STEPS_TRAINED
+
+    target = int(algo.config.get("train_batch_size", 256))
+    batch = sample_offline_batch(
+        algo._reader,
+        target,
+        require_next_obs=True,
+        seed=algo._counters["offline_draws"],
+    )
+    algo._counters["offline_draws"] += 1
+    info = algo.get_policy().learn_on_batch(batch)
+    algo._counters[NUM_ENV_STEPS_TRAINED] += batch.count
+    return {DEFAULT_POLICY_ID: info}

@@ -270,6 +270,22 @@ class JaxPolicy(Policy):
             {k: np.asarray(v) for k, v in extra.items()},
         )
 
+    def compute_log_likelihoods(
+        self, actions, obs_batch, state_batches=None
+    ) -> np.ndarray:
+        """Log-prob of given actions under the current policy (reference
+        Policy.compute_log_likelihoods :660 — used by the IS/WIS
+        off-policy estimators). Deliberately NOT jitted: callers pass
+        variable-length per-episode slices, and a jit cache keyed on
+        every distinct episode length would recompile constantly for a
+        sub-millisecond MLP forward."""
+        dist_inputs, _, _ = self.model_forward(
+            self.params, jnp.asarray(obs_batch)
+        )
+        return np.asarray(
+            self.dist_class(dist_inputs).logp(jnp.asarray(actions))
+        )
+
     def value_batch(self, obs_batch, state_batches=None) -> np.ndarray:
         """Bootstrap values for GAE (reference ppo value branch)."""
         if self._value_fn is None:
