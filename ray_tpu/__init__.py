@@ -33,6 +33,7 @@ from ray_tpu.core.api import (
     timeline,
     kill,
     cancel,
+    free,
 )
 from ray_tpu.core.object_store import ObjectRef
 
@@ -47,6 +48,7 @@ __all__ = [
     "wait",
     "method",
     "kill",
+    "free",
     "cancel",
     "get_runtime_context",
     "available_resources",

@@ -67,12 +67,14 @@ class _TaskRecord:
 
 
 class _ActorRecord:
-    def __init__(self, actor_id, worker, cls_blob, init_msg, max_restarts):
+    def __init__(self, actor_id, worker, cls_blob, init_msg, max_restarts,
+                 daemon: bool = True):
         self.actor_id = actor_id
         self.worker = worker
         self.cls_blob = cls_blob
         self.init_msg = init_msg
         self.max_restarts = max_restarts
+        self.daemon = daemon
         self.restarts = 0
         self.name: Optional[str] = None
         self.dead = False
@@ -100,13 +102,18 @@ class _Runtime:
 
     # -- worker lifecycle ------------------------------------------------
 
-    def _spawn_worker(self, dedicated: bool = False) -> _WorkerHandle:
+    def _spawn_worker(
+        self, dedicated: bool = False, daemon: bool = True
+    ) -> _WorkerHandle:
         worker_id = uuid.uuid4().hex[:12]
         parent_conn, child_conn = self.ctx.Pipe(duplex=True)
+        # daemon=False is for actors that must spawn children of their
+        # own (e.g. tune trial actors hosting an Algorithm with rollout
+        # workers) — daemonic processes cannot have children.
         proc = self.ctx.Process(
             target=worker_main,
             args=(child_conn, worker_id, dict(self._worker_env)),
-            daemon=True,
+            daemon=daemon,
             name=f"ray_tpu_worker_{worker_id}",
         )
         proc.start()
@@ -227,7 +234,7 @@ class _Runtime:
                 rec.dead = True
                 return
             rec.restarts += 1
-            w = self._spawn_worker(dedicated=True)
+            w = self._spawn_worker(dedicated=True, daemon=rec.daemon)
             rec.worker = w
         with w.send_lock:
             w.conn.send(rec.init_msg)
@@ -385,7 +392,10 @@ class _Runtime:
     def create_actor(self, cls, args, kwargs, options) -> "ActorHandle":
         actor_id = uuid.uuid4().hex
         cls_blob = ser.dumps(cls)
-        w = self._spawn_worker(dedicated=True)
+        w = self._spawn_worker(
+            dedicated=True,
+            daemon=bool(options.get("daemon", True)),
+        )
         init_msg = {
             "type": "actor_init",
             "actor_id": actor_id,
@@ -401,6 +411,7 @@ class _Runtime:
         rec = _ActorRecord(
             actor_id, w, cls_blob, init_msg,
             options.get("max_restarts", 0),
+            daemon=bool(options.get("daemon", True)),
         )
         name = options.get("name")
         with self.lock:

@@ -127,15 +127,18 @@ class PopulationBasedTraining(TrialScheduler):
         last = self._last_perturb.get(trial.trial_id, 0)
         if t - last < self.interval:
             return CONTINUE
-        self._last_perturb[trial.trial_id] = t
 
         trials = [
             tr
             for tr in runner.trials
             if tr.last_result and tr.status != "ERROR"
         ]
+        # Don't burn the interval while the population is still sparse
+        # (actors starting asynchronously): wait until a comparison is
+        # actually possible.
         if len(trials) < 2:
             return CONTINUE
+        self._last_perturb[trial.trial_id] = t
         ranked = sorted(trials, key=self._score, reverse=True)
         n_q = max(1, int(len(ranked) * self.quantile))
         top, bottom = ranked[:n_q], ranked[-n_q:]
@@ -145,17 +148,7 @@ class PopulationBasedTraining(TrialScheduler):
         return CONTINUE
 
     def _exploit_and_explore(self, trial, donor) -> None:
-        # exploit: copy weights through a checkpoint
-        if donor.runner is not None and trial.runner is not None:
-            state = donor.runner.__getstate__() if hasattr(
-                donor.runner, "__getstate__"
-            ) else None
-            if state is not None:
-                try:
-                    trial.runner.__setstate__(copy.deepcopy(state))
-                except Exception:
-                    pass
-        # explore: perturb mutated hyperparams
+        # explore: perturb mutated hyperparams from the donor's config
         new_config = copy.deepcopy(donor.config)
         for key, spec in self.mutations.items():
             if self._rng.random() < self.resample_probability:
@@ -169,25 +162,26 @@ class PopulationBasedTraining(TrialScheduler):
                 if isinstance(base, (int, float)):
                     new_config[key] = type(base)(base * factor)
         trial.config = new_config
-        # Push mutated scalars into the live policy. update_config
-        # rebuilds lr/entropy schedules and drops the compiled learn
-        # programs (loss constants are baked into the XLA programs, and
-        # scheduled coeffs are overwritten each learn call — plain
-        # coeff_values/config writes would silently have no effect).
-        if trial.runner is not None and hasattr(
-            trial.runner, "get_policy"
-        ):
+        # exploit: clone donor state + apply mutated scalars through
+        # the Trainable exploit protocol (works identically for
+        # in-process trainables and remote trial actors). The two steps
+        # fail independently: a dead donor must not cancel the explore
+        # push, or trial.config would silently diverge from the live
+        # policy's actual hyperparameters.
+        if trial.runner is not None:
+            state = None
+            if donor.runner is not None:
+                try:
+                    state = donor.runner.get_exploit_state()
+                except Exception:
+                    state = None
+            scalars = {
+                k: v
+                for k, v in new_config.items()
+                if not isinstance(v, dict)
+            }
             try:
-                pol = trial.runner.get_policy()
-                scalars = {
-                    k: v
-                    for k, v in new_config.items()
-                    if not isinstance(v, dict)
-                }
-                if hasattr(pol, "update_config"):
-                    pol.update_config(scalars)
-                else:
-                    pol.config.update(scalars)
+                trial.runner.apply_exploit(state, scalars)
             except Exception:
                 pass
         self.num_perturbations += 1

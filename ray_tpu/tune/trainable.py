@@ -110,3 +110,41 @@ class Trainable:
 
     def stop(self) -> None:
         self.cleanup()
+
+    # -- PBT exploit protocol ---------------------------------------------
+    # Narrow surface the schedulers use, identical for in-process
+    # trainables and remote trial actors (reference PBT does this via
+    # checkpoint save + restore + full trial restart).
+
+    def get_exploit_state(self):
+        """Cloneable training state for a PBT exploit donor. Only
+        classes with a real __setstate__ participate: object.__getstate__
+        (Python 3.11+) would otherwise ship the entire __dict__ — replay
+        buffers, env handles — that the recipient cannot apply anyway."""
+        if not hasattr(type(self), "__setstate__"):
+            return None
+        return self.__getstate__()
+
+    def apply_exploit(self, state, scalar_overrides: Dict) -> None:
+        """Adopt a donor's state + mutated scalar hyperparams."""
+        import copy as _copy
+
+        if state is not None and hasattr(type(self), "__setstate__"):
+            try:
+                self.__setstate__(_copy.deepcopy(state))
+            except Exception:
+                pass
+        self.config.update(scalar_overrides)
+        # Push mutated scalars into the live policy: update_config
+        # rebuilds schedules and drops compiled learn programs (loss
+        # constants are baked into the XLA programs, so plain config
+        # writes would silently have no effect).
+        if hasattr(self, "get_policy"):
+            try:
+                pol = self.get_policy()
+                if hasattr(pol, "update_config"):
+                    pol.update_config(scalar_overrides)
+                else:
+                    pol.config.update(scalar_overrides)
+            except Exception:
+                pass

@@ -1,11 +1,20 @@
 """tune.run: experiment runner.
 
 Counterpart of the reference's ``ray/tune/tune.py:118`` (tune.run) +
-``tune/execution/trial_runner.py:226`` (TrialRunner.step :793). Trials run
-time-sliced in-process (one TPU learner per host; the reference's
-placement-group-per-trial model maps to sequential mesh occupancy here),
-which preserves ASHA/PBT semantics: every trial advances one
-``train()`` per scheduling round.
+``tune/execution/trial_runner.py:226`` (TrialRunner.step :793) +
+``tune/execution/ray_trial_executor.py`` (trials as concurrently
+scheduled actors).
+
+Two execution modes:
+- **parallel** (default for multi-trial experiments): each trial is a
+  dedicated non-daemon actor process hosting the Trainable; up to
+  ``max_concurrent`` trials advance truly concurrently, results are
+  processed as they complete (schedulers see them event-driven, like
+  the reference's RayTrialExecutor event loop). Trial actors run on the
+  CPU JAX platform (the chip belongs to the driver), so this is the
+  searcher/scheduler path, not the single-big-run path.
+- **sequential in-process** (``parallel=False``, or one trial): trials
+  time-slice the driver — the mode that owns the real TPU mesh.
 """
 
 from __future__ import annotations
@@ -14,6 +23,7 @@ import os
 import traceback
 from typing import Any, Dict, List, Optional, Type, Union
 
+import ray_tpu as ray
 from ray_tpu.tune.schedulers import (
     CONTINUE,
     STOP,
@@ -28,6 +38,66 @@ from ray_tpu.tune.trial import (
     TERMINATED,
     Trial,
 )
+
+
+@ray.remote
+class _TrialActor:
+    """One trial's Trainable, hosted in a dedicated process
+    (reference ray_trial_executor.py wraps trainables the same way)."""
+
+    def __init__(self, trainable_cls, config):
+        self._t = trainable_cls(config=config)
+
+    def train(self):
+        return self._t.train()
+
+    def save(self, checkpoint_dir=None):
+        return self._t.save(checkpoint_dir)
+
+    def restore(self, path):
+        self._t.restore(path)
+
+    def stop(self):
+        self._t.stop()
+
+    def get_exploit_state(self):
+        return self._t.get_exploit_state()
+
+    def apply_exploit(self, state, scalars):
+        self._t.apply_exploit(state, scalars)
+
+
+class _RemoteTrainableProxy:
+    """Synchronous facade over a _TrialActor, so schedulers (PBT
+    exploit protocol, checkpointing) treat remote and in-process
+    trials identically. Consumed refs are freed immediately — store
+    entries otherwise live until driver shutdown, and exploit states
+    carry full model weights."""
+
+    def __init__(self, actor):
+        self.actor = actor
+
+    def _call(self, method, *args):
+        ref = method.remote(*args)
+        try:
+            return ray.get(ref)
+        finally:
+            ray.free([ref])
+
+    def save(self, checkpoint_dir=None):
+        return self._call(self.actor.save, checkpoint_dir)
+
+    def restore(self, path):
+        self._call(self.actor.restore, path)
+
+    def stop(self):
+        self._call(self.actor.stop)
+
+    def get_exploit_state(self):
+        return self._call(self.actor.get_exploit_state)
+
+    def apply_exploit(self, state, scalars):
+        self._call(self.actor.apply_exploit, state, scalars)
 
 
 class ExperimentAnalysis:
@@ -86,6 +156,8 @@ class TrialRunner:
         checkpoint_freq: int = 0,
         local_dir: Optional[str] = None,
         callbacks: Optional[List] = None,
+        parallel: bool = False,
+        max_concurrent: Optional[int] = None,
     ):
         self.trainable_cls = trainable_cls
         self.trials = trials
@@ -94,13 +166,57 @@ class TrialRunner:
         self.checkpoint_freq = checkpoint_freq
         self.local_dir = local_dir
         self.callbacks = callbacks or []
+        self.parallel = parallel
+        self.max_concurrent = max_concurrent or (os.cpu_count() or 4)
+        self._in_flight: Dict = {}  # train ref -> trial
+        self._parallel_proven = False  # any actor created successfully
 
     def is_finished(self) -> bool:
         return all(
             t.status in (TERMINATED, ERROR) for t in self.trials
         )
 
+    # -- shared result handling -------------------------------------------
+
+    def _process_result(self, trial: Trial, result: Dict) -> bool:
+        """Record + schedule one result. Returns True if the trial
+        should continue training."""
+        trial.last_result = result
+        trial.results.append(result)
+        for cb in self.callbacks:
+            cb(trial, result)
+        if self.checkpoint_freq and (
+            result["training_iteration"] % self.checkpoint_freq == 0
+        ):
+            trial.checkpoint_path = trial.runner.save()
+        decision = self.scheduler.on_trial_result(self, trial, result)
+        if (
+            decision == STOP
+            or trial.should_stop(result)
+            or result["training_iteration"] >= self.max_iterations
+        ):
+            trial.status = TERMINATED
+            self.scheduler.on_trial_complete(self, trial, result)
+            if self.checkpoint_freq:
+                trial.checkpoint_path = trial.runner.save()
+            self._cleanup_trial(trial)
+            return False
+        return True
+
+    def _fail_trial(self, trial: Trial, err: str) -> None:
+        trial.status = ERROR
+        trial.error = err
+        self._cleanup_trial(trial)
+
     def step(self) -> None:
+        if self.parallel:
+            self._step_parallel()
+        else:
+            self._step_sequential()
+
+    # -- sequential in-process mode ----------------------------------------
+
+    def _step_sequential(self) -> None:
         """Advance every live trial by one training iteration
         (reference trial_runner.py:793)."""
         for trial in self.trials:
@@ -113,37 +229,82 @@ class TrialRunner:
                     )
                     trial.status = RUNNING
                 except Exception:
-                    trial.status = ERROR
-                    trial.error = traceback.format_exc()
+                    self._fail_trial(trial, traceback.format_exc())
                     continue
             try:
                 result = trial.runner.train()
             except Exception:
-                trial.status = ERROR
-                trial.error = traceback.format_exc()
-                self._cleanup_trial(trial)
+                self._fail_trial(trial, traceback.format_exc())
                 continue
-            trial.last_result = result
-            trial.results.append(result)
-            for cb in self.callbacks:
-                cb(trial, result)
-            if self.checkpoint_freq and (
-                result["training_iteration"] % self.checkpoint_freq
-                == 0
-            ):
-                trial.checkpoint_path = trial.runner.save()
-            decision = self.scheduler.on_trial_result(
-                self, trial, result
+            self._process_result(trial, result)
+
+    # -- parallel actor mode -------------------------------------------------
+
+    def _start_trial_actor(self, trial: Trial) -> None:
+        try:
+            actor = _TrialActor.options(daemon=False).remote(
+                self.trainable_cls, trial.config
             )
-            if (
-                decision == STOP
-                or trial.should_stop(result)
-                or result["training_iteration"] >= self.max_iterations
-            ):
-                trial.status = TERMINATED
-                self.scheduler.on_trial_complete(self, trial, result)
-                if self.checkpoint_freq:
-                    trial.checkpoint_path = trial.runner.save()
+        except Exception:
+            # Typically an unpicklable trainable/config. Before any
+            # actor has proven viable, degrade gracefully to the
+            # in-process mode rather than failing the experiment.
+            if not self._parallel_proven:
+                import warnings
+
+                warnings.warn(
+                    "trial actor creation failed "
+                    f"({traceback.format_exc(limit=1).strip()}); "
+                    "falling back to in-process sequential trials — "
+                    "pass parallel=False to silence this"
+                )
+                self.parallel = False
+            else:
+                self._fail_trial(trial, traceback.format_exc())
+            return
+        self._parallel_proven = True
+        trial.runner = _RemoteTrainableProxy(actor)
+        trial.status = RUNNING
+        self._in_flight[actor.train.remote()] = trial
+
+    def _step_parallel(self) -> None:
+        """Event-driven execution over trial actors (reference
+        ray_trial_executor.py event loop): keep up to max_concurrent
+        trials running, process results as they complete."""
+        live = set(self._in_flight.values())
+        for trial in self.trials:
+            if len(live) >= self.max_concurrent or not self.parallel:
+                break
+            if trial.status == PENDING and trial not in live:
+                self._start_trial_actor(trial)
+                if trial.status == RUNNING:
+                    live.add(trial)
+        if not self._in_flight:
+            return
+        ready, _ = ray.wait(
+            list(self._in_flight.keys()), num_returns=1, timeout=10.0
+        )
+        for ref in ready:
+            trial = self._in_flight.pop(ref)
+            try:
+                result = ray.get(ref)
+            except Exception:
+                self._fail_trial(trial, traceback.format_exc())
+                continue
+            finally:
+                ray.free([ref])
+            if self._process_result(trial, result):
+                self._in_flight[
+                    trial.runner.actor.train.remote()
+                ] = trial
+
+    def cleanup(self) -> None:
+        """Stop any still-live trials (crash/interrupt path)."""
+        for ref, trial in list(self._in_flight.items()):
+            ray.free([ref])
+        self._in_flight.clear()
+        for trial in self.trials:
+            if trial.runner is not None:
                 self._cleanup_trial(trial)
 
     def _cleanup_trial(self, trial: Trial) -> None:
@@ -152,6 +313,11 @@ class TrialRunner:
                 trial.runner.stop()
             except Exception:
                 pass
+            if isinstance(trial.runner, _RemoteTrainableProxy):
+                try:
+                    ray.kill(trial.runner.actor)
+                except Exception:
+                    pass
             trial.runner = None
 
 
@@ -170,8 +336,15 @@ def run(
     callbacks: Optional[List] = None,
     verbose: int = 1,
     seed: int = 0,
+    parallel: Optional[bool] = None,
+    max_concurrent_trials: Optional[int] = None,
 ) -> ExperimentAnalysis:
-    """reference tune/tune.py:118."""
+    """reference tune/tune.py:118.
+
+    parallel: None (default) runs multi-trial experiments as concurrent
+    actors and single-trial experiments in-process (where they own the
+    TPU mesh). Force with True/False.
+    """
     if isinstance(run_or_experiment, str):
         from ray_tpu.algorithms.registry import get_algorithm_class
 
@@ -188,6 +361,8 @@ def run(
         Trial(name, v, stopping_criterion=stop)
         for v in iter(gen.next_variant, None)
     ]
+    if parallel is None:
+        parallel = len(trials) > 1
     runner = TrialRunner(
         trainable_cls,
         trials,
@@ -196,18 +371,25 @@ def run(
         checkpoint_freq=checkpoint_freq,
         local_dir=local_dir,
         callbacks=callbacks,
+        parallel=parallel,
+        max_concurrent=max_concurrent_trials,
     )
-    while not runner.is_finished():
-        runner.step()
-        if verbose:
-            live = sum(1 for t in trials if t.status == RUNNING)
-            best = ExperimentAnalysis(
-                trials, metric, mode
-            ).get_best_trial()
-            if best is not None:
-                print(
-                    f"[tune] live={live} "
-                    f"best[{metric}]="
-                    f"{best.last_result.get(metric)}"
-                )
+    try:
+        while not runner.is_finished():
+            runner.step()
+            if verbose:
+                live = sum(1 for t in trials if t.status == RUNNING)
+                best = ExperimentAnalysis(
+                    trials, metric, mode
+                ).get_best_trial()
+                if best is not None:
+                    print(
+                        f"[tune] live={live} "
+                        f"best[{metric}]="
+                        f"{best.last_result.get(metric)}"
+                    )
+    finally:
+        # Crash/interrupt path: without this, live non-daemon trial
+        # actors (whole Trainables) outlive the experiment.
+        runner.cleanup()
     return ExperimentAnalysis(trials, metric, mode)

