@@ -1,0 +1,7 @@
+from ray_tpu.algorithms.apex_dqn.apex_dqn import (
+    ApexDQN,
+    ApexDQNConfig,
+    ReplayActor,
+)
+
+__all__ = ["ApexDQN", "ApexDQNConfig", "ReplayActor"]

@@ -1,0 +1,302 @@
+"""Ape-X DQN: distributed prioritized experience replay.
+
+Counterpart of the reference's ``rllib/algorithms/apex_dqn/apex_dqn.py``
+(Horgan et al. 2018): many rollout workers with a per-worker epsilon
+ladder feed sharded replay-buffer ACTORS; the learner continuously draws
+prioritized samples from the shards, trains, and pushes per-sample
+priority updates back; weights broadcast to workers periodically.
+
+TPU-first shape: the learner is the driver's jitted DQN TD-step (one
+XLA program per draw); replay shards are plain actors on the CPU fleet;
+sampling, replay insertion, learning, and priority updates all overlap
+through in-flight futures (the reference overlaps via its learner
+thread + @ray.remote replay actors the same way)."""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+import ray_tpu as ray
+from ray_tpu.algorithms.algorithm import NUM_ENV_STEPS_SAMPLED
+from ray_tpu.algorithms.dqn.dqn import (
+    DQN,
+    DQNConfig,
+    DQNJaxPolicy,
+    adjust_nstep,
+)
+from ray_tpu.data.sample_batch import DEFAULT_POLICY_ID, SampleBatch
+from ray_tpu.execution.replay_buffer import PrioritizedReplayBuffer
+from ray_tpu.execution.train_ops import NUM_ENV_STEPS_TRAINED
+
+
+@ray.remote
+class ReplayActor:
+    """One prioritized replay shard (reference apex ReplayActor)."""
+
+    def __init__(
+        self,
+        capacity: int,
+        alpha: float,
+        beta: float,
+        seed: Optional[int] = None,
+    ):
+        self.buffer = PrioritizedReplayBuffer(
+            capacity=capacity, alpha=alpha, seed=seed
+        )
+        self.beta = beta
+
+    def add(self, batch: SampleBatch, priorities=None):
+        if priorities is not None:
+            self.buffer.add_with_priorities(batch, priorities)
+        else:
+            self.buffer.add(batch)
+        return self.buffer.num_added
+
+    def sample(self, num_items: int) -> Optional[SampleBatch]:
+        if len(self.buffer) < num_items:
+            return None
+        return self.buffer.sample(num_items, beta=self.beta)
+
+    def update_priorities(self, batch_indexes, priorities):
+        self.buffer.update_priorities(batch_indexes, priorities)
+
+    def size(self) -> int:
+        return len(self.buffer)
+
+    def stats(self) -> Dict:
+        return self.buffer.stats()
+
+
+class ApexDQNConfig(DQNConfig):
+    """reference apex_dqn.py ApexDQNConfig."""
+
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class or ApexDQN)
+        self.num_workers = 4
+        self.num_replay_buffer_shards = 2
+        self.per_worker_exploration = True
+        self.worker_side_prioritization = False
+        self.n_step = 3
+        self.train_batch_size = 512
+        self.rollout_fragment_length = 50
+        self.target_network_update_freq = 2500
+        self.num_steps_sampled_before_learning_starts = 1000
+        self.max_sample_requests_in_flight_per_worker = 2
+        self.broadcast_interval = 1
+        self.replay_buffer_config = {
+            "capacity": 100000,
+            "prioritized_replay": True,
+            "prioritized_replay_alpha": 0.6,
+            "prioritized_replay_beta": 0.4,
+        }
+
+    def training(
+        self,
+        *,
+        num_replay_buffer_shards: Optional[int] = None,
+        per_worker_exploration: Optional[bool] = None,
+        **kwargs,
+    ) -> "ApexDQNConfig":
+        super().training(**kwargs)
+        if num_replay_buffer_shards is not None:
+            self.num_replay_buffer_shards = num_replay_buffer_shards
+        if per_worker_exploration is not None:
+            self.per_worker_exploration = per_worker_exploration
+        return self
+
+
+class ApexDQN(DQN):
+    _default_policy_class = DQNJaxPolicy
+
+    @classmethod
+    def get_default_config(cls) -> ApexDQNConfig:
+        return ApexDQNConfig(cls)
+
+    def setup(self, config: Dict) -> None:
+        super().setup(config)  # DQN.setup builds the local buffer (unused)
+        self.local_replay_buffer = None
+        rb = config.get("replay_buffer_config") or {}
+        n_shards = max(1, int(config.get("num_replay_buffer_shards", 2)))
+        per_shard = max(
+            1, int(rb.get("capacity", 100000)) // n_shards
+        )
+        seed = config.get("seed")
+        self.replay_actors = [
+            ReplayActor.remote(
+                per_shard,
+                rb.get("prioritized_replay_alpha", 0.6),
+                rb.get("prioritized_replay_beta", 0.4),
+                None if seed is None else seed + 100 + i,
+            )
+            for i in range(n_shards)
+        ]
+        self._sample_in_flight: Dict = {}  # ref -> worker
+        self._replay_in_flight: Dict = {}  # ref -> replay actor
+        self._shard_rr = 0
+        self._last_target_update = 0
+        self._batches_since_broadcast: Dict = {}
+
+    def _route_to_replay(self, batch: SampleBatch) -> None:
+        """n-step fold, optional initial priorities, round-robin shard
+        insert. By default new samples insert at max priority (standard
+        prioritized-replay behavior); worker_side_prioritization=True
+        computes real initial TD errors on the driver's learner policy —
+        an extra jitted forward per fragment on the learning critical
+        path, so it is opt-in."""
+        config = self.config
+        n_step = config.get("n_step", 1)
+        if n_step > 1:
+            adjust_nstep(n_step, config["gamma"], batch)
+        prios = None
+        if config.get("worker_side_prioritization"):
+            try:
+                prios = (
+                    self.get_policy().compute_td_error(batch) + 1e-6
+                )
+            except Exception:
+                prios = None
+        shard = self.replay_actors[
+            self._shard_rr % len(self.replay_actors)
+        ]
+        self._shard_rr += 1
+        shard.add.remote(batch, prios)
+
+    def training_step(self) -> Dict:
+        """reference apex_dqn.py training_step: overlap sampling,
+        replay insertion, learning, and priority updates."""
+        config = self.config
+        workers = self.workers.remote_workers()
+        policy = self.get_policy()
+        train_info: Dict = {}
+
+        # ---- keep rollout workers saturated ----
+        if workers:
+            max_inflight = config.get(
+                "max_sample_requests_in_flight_per_worker", 2
+            )
+            counts: Dict = {}
+            for ref, w in self._sample_in_flight.items():
+                counts[id(w)] = counts.get(id(w), 0) + 1
+            for w in workers:
+                while counts.get(id(w), 0) < max_inflight:
+                    self._sample_in_flight[w.sample.remote()] = w
+                    counts[id(w)] = counts.get(id(w), 0) + 1
+            ready, _ = ray.wait(
+                list(self._sample_in_flight.keys()),
+                num_returns=1,
+                timeout=1.0,
+            )
+            weights_ref = None
+            for ref in ready:
+                w = self._sample_in_flight.pop(ref)
+                try:
+                    batch = ray.get(ref)
+                except (
+                    ray.core.object_store.RayActorError,
+                    ray.core.object_store.WorkerCrashedError,
+                ):
+                    continue
+                finally:
+                    ray.free([ref])
+                self._counters[NUM_ENV_STEPS_SAMPLED] += (
+                    batch.env_steps()
+                )
+                if hasattr(batch, "policy_batches"):
+                    batch = batch.policy_batches[DEFAULT_POLICY_ID]
+                self._route_to_replay(batch)
+                # periodic weight broadcast to the producing worker
+                k = id(w)
+                self._batches_since_broadcast[k] = (
+                    self._batches_since_broadcast.get(k, 0) + 1
+                )
+                if self._batches_since_broadcast[k] >= config.get(
+                    "broadcast_interval", 1
+                ):
+                    if weights_ref is None:
+                        weights_ref = ray.put(
+                            self.workers.local_worker().get_weights()
+                        )
+                    w.set_weights.remote(
+                        weights_ref,
+                        {
+                            "timestep": self._counters[
+                                NUM_ENV_STEPS_SAMPLED
+                            ]
+                        },
+                    )
+                    self._batches_since_broadcast[k] = 0
+        else:
+            # degenerate single-process mode (tests)
+            batch = self.workers.local_worker().sample()
+            self._counters[NUM_ENV_STEPS_SAMPLED] += batch.env_steps()
+            if hasattr(batch, "policy_batches"):
+                batch = batch.policy_batches[DEFAULT_POLICY_ID]
+            self._route_to_replay(batch)
+
+        # ---- learn from replay shards ----
+        if (
+            self._counters[NUM_ENV_STEPS_SAMPLED]
+            >= config.get("num_steps_sampled_before_learning_starts", 0)
+        ):
+            # top up replay sample requests (one per shard in flight)
+            shards_busy = set(
+                id(a) for a in self._replay_in_flight.values()
+            )
+            for actor in self.replay_actors:
+                if id(actor) not in shards_busy:
+                    self._replay_in_flight[
+                        actor.sample.remote(config["train_batch_size"])
+                    ] = actor
+            ready, _ = ray.wait(
+                list(self._replay_in_flight.keys()),
+                num_returns=1,
+                timeout=1.0,
+            )
+            for ref in ready:
+                actor = self._replay_in_flight.pop(ref)
+                try:
+                    train_batch = ray.get(ref)
+                finally:
+                    ray.free([ref])
+                if train_batch is None:
+                    continue
+                info = policy.learn_on_batch(train_batch)
+                train_info = {DEFAULT_POLICY_ID: info}
+                self._counters[NUM_ENV_STEPS_TRAINED] += (
+                    train_batch.count
+                )
+                # push per-sample priority refresh back to the shard
+                td = policy.compute_td_error(train_batch)
+                actor.update_priorities.remote(
+                    np.asarray(train_batch["batch_indexes"]),
+                    td + 1e-6,
+                )
+                # target network sync
+                if (
+                    self._counters[NUM_ENV_STEPS_TRAINED]
+                    - self._last_target_update
+                    >= config.get("target_network_update_freq", 2500)
+                ):
+                    policy.update_target()
+                    self._last_target_update = self._counters[
+                        NUM_ENV_STEPS_TRAINED
+                    ]
+                    self._counters["num_target_updates"] += 1
+
+        if not workers:
+            self.workers.sync_weights(
+                global_vars={
+                    "timestep": self._counters[NUM_ENV_STEPS_SAMPLED]
+                }
+            )
+        return train_info
+
+    def cleanup(self) -> None:
+        for a in getattr(self, "replay_actors", []):
+            try:
+                ray.kill(a)
+            except Exception:
+                pass
+        super().cleanup()

@@ -147,6 +147,20 @@ def _epsilon_exploration_config(config: Dict, force_keys=()) -> Dict:
     for key in _EPSILON_KEYS:
         if key in config and (key not in ec or key in force_keys):
             ec[key] = config[key]
+    # Ape-X per-worker epsilon ladder (reference apex_dqn.py /
+    # rllib per_worker_exploration): worker i (1-based) of n explores
+    # with the constant eps_i = 0.4 ** (1 + 7*(i-1)/(n-1)).
+    if config.get("per_worker_exploration"):
+        i = int(config.get("worker_index", 0))
+        n = max(1, int(config.get("num_workers", 1)))
+        if i > 0:
+            exponent = 1.0 + 7.0 * (i - 1) / max(1, n - 1)
+            eps = 0.4**exponent
+            ec.update(
+                initial_epsilon=eps,
+                final_epsilon=eps,
+                epsilon_timesteps=1,
+            )
     return ec
 
 

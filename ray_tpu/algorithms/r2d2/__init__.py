@@ -1,0 +1,3 @@
+from ray_tpu.algorithms.r2d2.r2d2 import R2D2, R2D2Config, R2D2JaxPolicy
+
+__all__ = ["R2D2", "R2D2Config", "R2D2JaxPolicy"]

@@ -1,0 +1,339 @@
+"""R2D2: Recurrent Replay Distributed DQN.
+
+Counterpart of the reference's ``rllib/algorithms/r2d2/r2d2.py``
+(Kapturowski et al. 2019: sequence replay with stored recurrent states,
+burn-in, the invertible value-rescaling h-function) and
+``r2d2_torch_policy.py`` (r2d2_loss).
+
+TPU-first: replay stores FIXED-length (T,) sequences with their stored
+initial LSTM state (the "stored state" strategy; zero_init_states=True
+gives the zero-state strategy) — fixed shapes mean one compiled loss
+program; the whole sequence loss (burn-in forward with stopped
+gradients folded in via masking, double-Q targets over (B, T), h-scaled
+TD) is one jitted program."""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.algorithms.algorithm import NUM_ENV_STEPS_SAMPLED
+from ray_tpu.algorithms.dqn.dqn import DQN, DQNConfig, DQNJaxPolicy
+from ray_tpu.data.sample_batch import DEFAULT_POLICY_ID, SampleBatch
+from ray_tpu.execution.rollout_ops import synchronous_parallel_sample
+from ray_tpu.execution.train_ops import NUM_ENV_STEPS_TRAINED
+
+
+def h_function(x, epsilon: float = 1e-3):
+    """Invertible value rescaling (reference r2d2_torch_policy.py:209):
+    h(x) = sign(x) * (sqrt(|x|+1) - 1) + eps*x."""
+    return jnp.sign(x) * (jnp.sqrt(jnp.abs(x) + 1.0) - 1.0) + epsilon * x
+
+
+def h_inverse(x, epsilon: float = 1e-3):
+    """Closed-form inverse of h (reference r2d2_torch_policy.py:220):
+    h⁻¹(x) = [2εx + (2ε+1) ∓ sqrt(±4εx + (2ε+1)²)] / (2ε²), the sign
+    choice depending on x's sign."""
+    two_eps = 2.0 * epsilon
+    if_pos = (
+        two_eps * x
+        + (two_eps + 1.0)
+        - jnp.sqrt(4.0 * epsilon * x + (two_eps + 1.0) ** 2)
+    ) / (2.0 * epsilon**2)
+    if_neg = (
+        two_eps * x
+        - (two_eps + 1.0)
+        + jnp.sqrt(-4.0 * epsilon * x + (two_eps + 1.0) ** 2)
+    ) / (2.0 * epsilon**2)
+    return jnp.where(x < 0.0, if_neg, if_pos)
+
+
+class SequenceReplayBuffer:
+    """Uniform replay over fixed-length sequences with stored initial
+    recurrent state (reference replay_sequence_length storage mode of
+    ``utils/replay_buffers``)."""
+
+    def __init__(self, capacity_sequences: int, seed=None):
+        self.capacity = capacity_sequences
+        self._storage: List[Dict[str, np.ndarray]] = []
+        self._idx = 0
+        self._rng = np.random.default_rng(seed)
+        self.num_added = 0
+
+    def add_sequence(self, seq: Dict[str, np.ndarray]) -> None:
+        if len(self._storage) < self.capacity:
+            self._storage.append(seq)
+        else:
+            self._storage[self._idx] = seq
+        self._idx = (self._idx + 1) % self.capacity
+        self.num_added += 1
+
+    def __len__(self):
+        return len(self._storage)
+
+    def sample(self, num_sequences: int) -> Dict[str, np.ndarray]:
+        idx = self._rng.integers(0, len(self._storage), num_sequences)
+        seqs = [self._storage[i] for i in idx]
+        return {
+            k: np.stack([s[k] for s in seqs]) for k in seqs[0].keys()
+        }
+
+
+class R2D2Config(DQNConfig):
+    """reference r2d2.py R2D2Config."""
+
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class or R2D2)
+        self.replay_sequence_length = 20
+        self.replay_burn_in = 0
+        self.zero_init_states = True
+        self.use_h_function = True
+        self.h_function_epsilon = 1e-3
+        self.train_batch_size = 16  # sequences per draw
+        self.rollout_fragment_length = 20
+        self.num_steps_sampled_before_learning_starts = 500
+        self.target_network_update_freq = 1000
+        self.model = {"use_lstm": True, "lstm_cell_size": 64}
+        self.replay_buffer_config = {"capacity": 2000}  # sequences
+
+    def training(
+        self,
+        *,
+        replay_sequence_length: Optional[int] = None,
+        replay_burn_in: Optional[int] = None,
+        zero_init_states: Optional[bool] = None,
+        use_h_function: Optional[bool] = None,
+        **kwargs,
+    ) -> "R2D2Config":
+        super().training(**kwargs)
+        if replay_sequence_length is not None:
+            self.replay_sequence_length = replay_sequence_length
+        if replay_burn_in is not None:
+            self.replay_burn_in = replay_burn_in
+        if zero_init_states is not None:
+            self.zero_init_states = zero_init_states
+        if use_h_function is not None:
+            self.use_h_function = use_h_function
+        return self
+
+
+class R2D2JaxPolicy(DQNJaxPolicy):
+    """Sequence double-Q loss with burn-in over the recurrent model
+    (reference r2d2_torch_policy.py r2d2_loss). The model's Q head is
+    the recurrent wrapper's logits head."""
+
+    def __init__(self, observation_space, action_space, config):
+        config = dict(config)
+        model = dict(config.get("model") or {})
+        model.setdefault("use_lstm", True)
+        config["model"] = model
+        # one SGD pass over the whole sequence batch per learn call
+        config.setdefault("num_sgd_iter", 1)
+        config["sgd_minibatch_size"] = config.get("train_batch_size", 16)
+        super().__init__(observation_space, action_space, config)
+        self.seq_len = int(config.get("replay_sequence_length", 20))
+        self.burn_in = int(config.get("replay_burn_in", 0))
+
+    def _batch_to_train_tree(self, samples):
+        """Sequences arrive pre-stacked as (B, T, ...) from the
+        SequenceReplayBuffer."""
+        drop = {SampleBatch.INFOS, SampleBatch.SEQ_LENS}
+        return {
+            k: np.asarray(v)
+            for k, v in samples.items()
+            if k not in drop and np.asarray(v).dtype != object
+        }
+
+    def loss_with_aux(self, params, aux, batch, rng, coeffs):
+        cfg = self.config
+        gamma = cfg.get("gamma", 0.99)
+        use_h = cfg.get("use_h_function", True)
+        h_eps = cfg.get("h_function_epsilon", 1e-3)
+        burn_in = self.burn_in
+
+        obs = batch[SampleBatch.OBS]  # (B, T, ...)
+        B, T = obs.shape[0], obs.shape[1]
+        state0 = (batch["state_in_0"], batch["state_in_1"])  # (B, C)
+        resets = batch["resets"]  # (B, T) 1.0 where episode restarted
+        actions = batch[SampleBatch.ACTIONS].astype(jnp.int32)
+        rewards = batch[SampleBatch.REWARDS]
+        not_done = 1.0 - batch[SampleBatch.TERMINATEDS]
+        mask = batch["mask"]  # (B, T) valid rows
+
+        # online and target forward over the WHOLE sequence (burn-in is
+        # folded in by masking the loss, matching the reference's
+        # seq_mask[:, :burn_in] = False)
+        q_flat, _, _ = self.model.apply(params, obs, state0, resets=resets)
+        q = q_flat.reshape(B, T, -1)
+        tq_flat, _, _ = self.model.apply(
+            aux["target_params"], obs, state0, resets=resets
+        )
+        tq = jax.lax.stop_gradient(tq_flat.reshape(B, T, -1))
+
+        q_sel = jnp.take_along_axis(
+            q, actions[..., None], axis=-1
+        ).squeeze(-1)  # (B, T)
+
+        # double-Q one-step targets within the sequence: target value of
+        # t+1 under online argmax
+        online_next_argmax = jnp.argmax(q[:, 1:], axis=-1)  # (B, T-1)
+        tq_next = jnp.take_along_axis(
+            tq[:, 1:], online_next_argmax[..., None], axis=-1
+        ).squeeze(-1)  # (B, T-1)
+        if use_h:
+            tq_next = h_inverse(tq_next, h_eps)
+        target = rewards[:, :-1] + gamma * not_done[:, :-1] * tq_next
+        if use_h:
+            target = h_function(target, h_eps)
+        target = jax.lax.stop_gradient(target)
+
+        td_error = q_sel[:, :-1] - target  # (B, T-1)
+        # valid-step mask: drop burn-in prefix, padding, the last step
+        # (no bootstrap successor inside the sequence), and steps whose
+        # successor starts a new episode (truncation boundary — its
+        # "next Q" belongs to a different episode).
+        valid = mask[:, :-1] * (1.0 - resets[:, 1:])
+        if burn_in > 0:
+            valid = valid * (
+                jnp.arange(T - 1)[None, :] >= burn_in
+            ).astype(valid.dtype)
+        n_valid = jnp.maximum(valid.sum(), 1.0)
+        huber = jnp.where(
+            jnp.abs(td_error) < 1.0,
+            0.5 * jnp.square(td_error),
+            jnp.abs(td_error) - 0.5,
+        )
+        loss = (huber * valid).sum() / n_valid
+        stats = {
+            "mean_q": (q_sel[:, :-1] * valid).sum() / n_valid,
+            "mean_td_error": (td_error * valid).sum() / n_valid,
+        }
+        return loss, stats
+
+
+class R2D2(DQN):
+    _default_policy_class = R2D2JaxPolicy
+
+    @classmethod
+    def get_default_config(cls) -> R2D2Config:
+        return R2D2Config(cls)
+
+    def setup(self, config: Dict) -> None:
+        super().setup(config)
+        rb = config.get("replay_buffer_config") or {}
+        self.local_replay_buffer = None  # DQN's flat buffer unused
+        self.seq_buffer = SequenceReplayBuffer(
+            rb.get("capacity", 2000), seed=config.get("seed")
+        )
+        self._last_target_update = 0
+
+    def _fragments_to_sequences(self, batch: SampleBatch) -> None:
+        """Chop a rollout fragment into fixed-length sequences with the
+        stored (or zero) initial state, resets, and padding mask."""
+        cfg = self.config
+        T = int(cfg.get("replay_sequence_length", 20))
+        zero_init = bool(cfg.get("zero_init_states", True))
+        policy = self.get_policy()
+        cell = policy.model.initial_state(1)
+        n = batch.count
+        eps_ids = np.asarray(
+            batch.get(
+                SampleBatch.EPS_ID, np.zeros(n, np.int64)
+            )
+        )
+        # Episode-restart flags per row (first row of each episode).
+        # The fragment's first row only counts as a restart under the
+        # zero-init strategy: with stored state, the sampler's state_in
+        # at offset 0 is already correct (zero iff a real episode
+        # start), and a forced reset would wipe mid-episode carries.
+        resets_all = np.zeros(n, np.float32)
+        resets_all[0] = 1.0 if zero_init else 0.0
+        resets_all[1:] = (eps_ids[1:] != eps_ids[:-1]).astype(
+            np.float32
+        )
+        for start in range(0, n, T):
+            end = min(start + T, n)
+            L = end - start
+            seq: Dict[str, np.ndarray] = {}
+            for k in (
+                SampleBatch.OBS,
+                SampleBatch.ACTIONS,
+                SampleBatch.REWARDS,
+                SampleBatch.TERMINATEDS,
+            ):
+                v = np.asarray(batch[k])[start:end]
+                if L < T:  # right-zero-pad to the fixed length
+                    pad = np.zeros((T - L,) + v.shape[1:], v.dtype)
+                    v = np.concatenate([v, pad], axis=0)
+                seq[k] = (
+                    v
+                    if np.issubdtype(v.dtype, np.integer)
+                    else v.astype(np.float32)
+                )
+            mask = np.zeros(T, np.float32)
+            mask[:L] = 1.0
+            seq["mask"] = mask
+            resets = resets_all[start:end]
+            if L < T:
+                resets = np.concatenate(
+                    [resets, np.zeros(T - L, np.float32)]
+                )
+            seq["resets"] = resets
+            if zero_init or f"state_in_0" not in batch:
+                seq["state_in_0"] = np.zeros_like(
+                    np.asarray(cell[0][0])
+                )
+                seq["state_in_1"] = np.zeros_like(
+                    np.asarray(cell[1][0])
+                )
+            else:
+                seq["state_in_0"] = np.asarray(
+                    batch["state_in_0"]
+                )[start]
+                seq["state_in_1"] = np.asarray(
+                    batch["state_in_1"]
+                )[start]
+            self.seq_buffer.add_sequence(seq)
+
+    def training_step(self) -> Dict:
+        config = self.config
+        batch = synchronous_parallel_sample(
+            worker_set=self.workers,
+            max_env_steps=config.get("rollout_fragment_length", 20),
+        )
+        self._counters[NUM_ENV_STEPS_SAMPLED] += batch.env_steps()
+        if hasattr(batch, "policy_batches"):
+            batch = batch.policy_batches[DEFAULT_POLICY_ID]
+        self._fragments_to_sequences(batch)
+
+        train_info: Dict = {}
+        if (
+            self._counters[NUM_ENV_STEPS_SAMPLED]
+            >= config.get("num_steps_sampled_before_learning_starts", 0)
+            and len(self.seq_buffer) >= config["train_batch_size"]
+        ):
+            seqs = self.seq_buffer.sample(config["train_batch_size"])
+            policy = self.get_policy()
+            info = policy.learn_on_batch(SampleBatch(seqs))
+            train_info = {DEFAULT_POLICY_ID: info}
+            steps = int(seqs["mask"].sum())
+            self._counters[NUM_ENV_STEPS_TRAINED] += steps
+            if (
+                self._counters[NUM_ENV_STEPS_TRAINED]
+                - self._last_target_update
+                >= config.get("target_network_update_freq", 1000)
+            ):
+                policy.update_target()
+                self._last_target_update = self._counters[
+                    NUM_ENV_STEPS_TRAINED
+                ]
+                self._counters["num_target_updates"] += 1
+        self.workers.sync_weights(
+            global_vars={
+                "timestep": self._counters[NUM_ENV_STEPS_SAMPLED]
+            }
+        )
+        return train_info

@@ -26,6 +26,9 @@ _BUILTINS: Dict[str, Tuple[str, str]] = {
     "BC": ("ray_tpu.algorithms.marwil.marwil", "BC"),
     "CQL": ("ray_tpu.algorithms.cql.cql", "CQL"),
     "CRR": ("ray_tpu.algorithms.crr.crr", "CRR"),
+    "APEX": ("ray_tpu.algorithms.apex_dqn.apex_dqn", "ApexDQN"),
+    "ApexDQN": ("ray_tpu.algorithms.apex_dqn.apex_dqn", "ApexDQN"),
+    "R2D2": ("ray_tpu.algorithms.r2d2.r2d2", "R2D2"),
 }
 
 

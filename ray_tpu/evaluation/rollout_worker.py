@@ -108,7 +108,12 @@ class RolloutWorker:
         for pid, (cls, obs_space, act_space, overrides) in (
             policy_specs or {}
         ).items():
-            pol_config = {**self.config, **(overrides or {})}
+            pol_config = {
+                **self.config,
+                **(overrides or {}),
+                "worker_index": worker_index,
+                "num_workers": num_workers,
+            }
             prep = ModelCatalog.get_preprocessor_for_space(obs_space)
             eff_obs_space = prep.observation_space
             if pid == DEFAULT_POLICY_ID or self.preprocessor is None:

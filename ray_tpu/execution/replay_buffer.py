@@ -112,14 +112,24 @@ class PrioritizedReplayBuffer(ReplayBuffer):
         self._max_priority = 1.0
 
     def add(self, batch: SampleBatch) -> None:
+        # new samples enter at max priority so they are trained on at
+        # least once (one insertion code path: add_with_priorities)
+        self.add_with_priorities(
+            batch, np.full(batch.count, self._max_priority)
+        )
+
+    def add_with_priorities(
+        self, batch: SampleBatch, priorities: np.ndarray
+    ) -> None:
+        """Insert with caller-supplied initial priorities (Ape-X:
+        workers/driver compute initial TD errors; reference
+        apex ReplayActor.add_batch)."""
         n = batch.count
         if n == 0:
             return
         idx = (self._idx + np.arange(n)) % self.capacity
-        super().add(batch)
-        pri = self._max_priority**self._alpha
-        self._sum_tree.set_items(idx, np.full(n, pri))
-        self._min_tree.set_items(idx, np.full(n, pri))
+        ReplayBuffer.add(self, batch)
+        self.update_priorities(idx, np.asarray(priorities, np.float64))
 
     def sample(self, num_items: int, beta: float = 0.4) -> SampleBatch:
         total = self._sum_tree.sum(0, self._size)
