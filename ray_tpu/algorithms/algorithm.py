@@ -64,6 +64,16 @@ class Algorithm(Trainable):
         env_creator = get_env_creator(env_spec) if env_spec else None
         policy_cls = self.get_default_policy_class(config)
 
+        # Multi-controller (DCN) bring-up: when RAY_TPU_COORDINATOR is
+        # set, every host running this same script joins the jax
+        # distributed runtime FIRST, so the learner mesh below spans
+        # all hosts' devices and gradient pmean rides ICI within a host
+        # and DCN across (reference: torch.distributed init in
+        # train/torch/config.py:83 / NCCL group setup).
+        from ray_tpu.parallel import distributed as dist_lib
+
+        dist_lib.initialize()
+
         # learner mesh (driver-side policies)
         n_learner = config.get("learner_devices")
         import jax

@@ -1,0 +1,280 @@
+"""Multi-host (DCN) runtime: jax.distributed bring-up, a KV/rendezvous
+service, and cross-host weight broadcast.
+
+Plays the multi-host roles of the reference's L1 stack — GCS KV +
+rendezvous (``src/ray/gcs/gcs_server/gcs_kv_manager.cc``), heartbeat
+liveness (``gcs_heartbeat_manager.h:33``), and NCCL/gloo rendezvous for
+collective groups (``python/ray/util/collective/collective.py:120``) —
+the TPU way: the heavy lifting (device enumeration across hosts, ICI+
+DCN collective routing) belongs to ``jax.distributed.initialize`` + XLA;
+this module supplies the thin control plane around it (who is the
+coordinator, app-level KV, liveness) over plain TCP.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pickle
+import socket
+import socketserver
+import threading
+import time
+from typing import Any, Dict, Optional
+
+# ---------------------------------------------------------------------------
+# KV / rendezvous service (the GCS-KV role)
+# ---------------------------------------------------------------------------
+
+
+class _KVHandler(socketserver.StreamRequestHandler):
+    def handle(self):
+        store = self.server.kv_store  # type: ignore[attr-defined]
+        try:
+            header = self.rfile.readline()
+            if not header:
+                return
+            req = json.loads(header)
+            op = req["op"]
+            if op == "put":
+                blob = self.rfile.read(req["len"])
+                with store.lock:
+                    store.data[req["key"]] = blob
+                    store.cv.notify_all()
+                self.wfile.write(b'{"ok": true}\n')
+            elif op == "get":
+                deadline = time.monotonic() + req.get("timeout", 30.0)
+                with store.lock:
+                    while req["key"] not in store.data:
+                        remaining = deadline - time.monotonic()
+                        if remaining <= 0:
+                            break
+                        store.cv.wait(remaining)
+                    blob = store.data.get(req["key"])
+                if blob is None:
+                    self.wfile.write(b'{"ok": false}\n')
+                else:
+                    self.wfile.write(
+                        json.dumps({"ok": True, "len": len(blob)}).encode()
+                        + b"\n"
+                    )
+                    self.wfile.write(blob)
+            elif op == "heartbeat":
+                with store.lock:
+                    store.heartbeats[req["node"]] = time.time()
+                self.wfile.write(b'{"ok": true}\n')
+            elif op == "nodes":
+                horizon = req.get("horizon", 30.0)
+                now = time.time()
+                with store.lock:
+                    alive = {
+                        n: now - t
+                        for n, t in store.heartbeats.items()
+                        if now - t <= horizon
+                    }
+                self.wfile.write(
+                    json.dumps({"ok": True, "alive": alive}).encode()
+                    + b"\n"
+                )
+        except Exception:
+            try:
+                self.wfile.write(b'{"ok": false}\n')
+            except Exception:
+                pass
+
+
+class KVServer:
+    """Blocking-get KV + heartbeat service, one per cluster (runs on the
+    coordinator host).
+
+    Trust model: values are pickled, so the service must only be
+    reachable from cluster hosts (same as the reference's GCS, which is
+    also unauthenticated by default). The default bind is loopback;
+    pass host="0.0.0.0" explicitly for a real multi-host cluster and
+    keep the port firewalled to the cluster network."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self.data: Dict[str, bytes] = {}
+        self.heartbeats: Dict[str, float] = {}
+        self.lock = threading.Lock()
+        self.cv = threading.Condition(self.lock)
+        self._server = socketserver.ThreadingTCPServer(
+            (host, port), _KVHandler, bind_and_activate=True
+        )
+        self._server.daemon_threads = True
+        self._server.kv_store = self  # type: ignore[attr-defined]
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True
+        )
+        self._thread.start()
+
+    @property
+    def address(self) -> str:
+        return f"{socket.gethostname()}:{self.port}"
+
+    def shutdown(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+
+
+class KVClient:
+    """Client for KVServer (usable from any host)."""
+
+    def __init__(self, address: str):
+        host, port = address.rsplit(":", 1)
+        self.host, self.port = host, int(port)
+
+    def _roundtrip(self, req: Dict, payload: bytes = b"") -> Any:
+        # socket deadline must outlast a server-side blocking get, or
+        # long waits surface as TimeoutError instead of KeyError
+        sock_timeout = float(req.get("timeout", 30.0)) + 30.0
+        with socket.create_connection(
+            (self.host, self.port), timeout=sock_timeout
+        ) as s:
+            f = s.makefile("rwb")
+            f.write(json.dumps(req).encode() + b"\n")
+            if payload:
+                f.write(payload)
+            f.flush()
+            resp = json.loads(f.readline())
+            if req["op"] == "get" and resp.get("ok"):
+                resp["blob"] = f.read(resp["len"])
+            return resp
+
+    def put(self, key: str, value: Any) -> None:
+        blob = pickle.dumps(value)
+        self._roundtrip(
+            {"op": "put", "key": key, "len": len(blob)}, blob
+        )
+
+    def get(self, key: str, timeout: float = 30.0) -> Any:
+        resp = self._roundtrip(
+            {"op": "get", "key": key, "timeout": timeout}
+        )
+        if not resp.get("ok"):
+            raise KeyError(key)
+        return pickle.loads(resp["blob"])
+
+    def heartbeat(self, node: str) -> None:
+        self._roundtrip({"op": "heartbeat", "node": node})
+
+    def alive_nodes(self, horizon: float = 30.0) -> Dict[str, float]:
+        return self._roundtrip({"op": "nodes", "horizon": horizon})[
+            "alive"
+        ]
+
+
+class HeartbeatReporter:
+    """Background liveness pings (the gcs_heartbeat_manager role)."""
+
+    def __init__(self, client: KVClient, node: str, interval: float = 5.0):
+        self.client = client
+        self.node = node
+        self.interval = interval
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        while not self._stop.wait(self.interval):
+            try:
+                self.client.heartbeat(self.node)
+            except Exception:
+                pass
+
+    def stop(self):
+        self._stop.set()
+
+
+# ---------------------------------------------------------------------------
+# jax.distributed bring-up
+# ---------------------------------------------------------------------------
+
+_initialized = False
+
+
+def initialize(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+    local_device_ids=None,
+) -> None:
+    """Join the multi-controller jax runtime (DCN). Reads
+    RAY_TPU_COORDINATOR / RAY_TPU_NUM_PROCESSES / RAY_TPU_PROCESS_ID
+    when args are omitted, so every host runs the same script.
+
+    Replaces the reference's NCCL/gloo rendezvous
+    (``util/collective/collective.py:120`` init_collective_group): after
+    this, a global Mesh over ``jax.devices()`` spans all hosts and XLA
+    routes collectives over ICI within a host/pod slice and DCN across.
+    """
+    global _initialized
+    if _initialized:
+        return
+    import jax
+
+    coordinator_address = coordinator_address or os.environ.get(
+        "RAY_TPU_COORDINATOR"
+    )
+    if coordinator_address is None:
+        return  # single-host: nothing to do
+    num_processes = int(
+        num_processes
+        if num_processes is not None
+        else os.environ.get("RAY_TPU_NUM_PROCESSES", 1)
+    )
+    process_id = int(
+        process_id
+        if process_id is not None
+        else os.environ.get("RAY_TPU_PROCESS_ID", 0)
+    )
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+        local_device_ids=local_device_ids,
+    )
+    _initialized = True
+
+
+def process_index() -> int:
+    import jax
+
+    return jax.process_index()
+
+
+def process_count() -> int:
+    import jax
+
+    return jax.process_count()
+
+
+def global_mesh():
+    """Mesh over ALL devices of ALL processes (DCN+ICI) — the same
+    construction Algorithm.setup uses, so the axis naming cannot
+    drift between the two paths."""
+    import jax
+
+    from ray_tpu.parallel.mesh import make_mesh
+
+    return make_mesh(devices=jax.devices())
+
+
+def broadcast_weights(tree, is_source: Optional[bool] = None):
+    """Cross-host weight broadcast: every process returns process 0's
+    pytree (reference WorkerSet.sync_weights across nodes / NCCL
+    broadcast ``collective.py:373``). Rides XLA collectives over DCN via
+    multihost_utils."""
+    from jax.experimental import multihost_utils
+
+    return multihost_utils.broadcast_one_to_all(
+        tree, is_source=is_source
+    )
+
+
+def sync_global(name: str = "barrier") -> None:
+    """Cross-host barrier (reference collective barrier)."""
+    from jax.experimental import multihost_utils
+
+    multihost_utils.sync_global_devices(name)

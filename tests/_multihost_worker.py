@@ -1,0 +1,131 @@
+"""Subprocess entry for the 2-process DCN test (launched by
+test_multihost.py with JAX_PLATFORMS=cpu and a 2-device virtual host).
+Exercises: jax.distributed bring-up, a global mesh psum across hosts,
+cross-host weight broadcast, KV rendezvous, heartbeats."""
+
+import os
+import sys
+
+
+def main() -> None:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ray_tpu.parallel import distributed as dist
+
+    rank = int(os.environ["RAY_TPU_PROCESS_ID"])
+    dist.initialize()
+    assert dist.process_count() == 2, dist.process_count()
+    assert dist.process_index() == rank
+    assert jax.local_device_count() == 2
+    assert jax.device_count() == 4
+
+    # ---- KV + heartbeat (control plane) ----
+    kv = dist.KVClient(os.environ["RAY_TPU_KV_ADDRESS"])
+    hb = dist.HeartbeatReporter(kv, f"host{rank}", interval=2.0)
+    kv.heartbeat(f"host{rank}")
+    kv.put(f"hello_{rank}", {"rank": rank})
+    other = kv.get(f"hello_{1 - rank}", timeout=30.0)
+    assert other["rank"] == 1 - rank
+
+    # ---- data plane: psum over the global (DCN) mesh ----
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh = dist.global_mesh()
+
+    x = jnp.ones((4,), jnp.float32)  # one row per global device
+    sharded = jax.device_put(
+        x, NamedSharding(mesh, P("data"))
+    )
+    out = jax.jit(
+        jax.shard_map(
+            lambda v: jax.lax.psum(v, "data"),
+            mesh=mesh,
+            in_specs=P("data"),
+            out_specs=P(),
+        )
+    )(sharded)
+    total = float(np.asarray(out)[0])
+    assert total == 4.0, total
+
+    # ---- cross-host weight broadcast ----
+    weights = {
+        "w": jnp.full((3,), float(rank + 1)),
+        "b": jnp.asarray(float(rank * 10)),
+    }
+    synced = dist.broadcast_weights(weights)
+    np.testing.assert_allclose(np.asarray(synced["w"]), 1.0)
+    assert float(synced["b"]) == 0.0  # process 0's values everywhere
+
+    # ---- multi-controller learner: PPO SGD nest over the GLOBAL mesh,
+    # each process feeding its local batch shard; gradient pmean spans
+    # hosts (DCN) ----
+    import gymnasium as gym
+
+    from ray_tpu.algorithms.ppo.ppo import PPOJaxPolicy
+    from ray_tpu.data.sample_batch import SampleBatch
+
+    obs_space = gym.spaces.Box(-1.0, 1.0, (8,), np.float32)
+    act_space = gym.spaces.Discrete(4)
+    B = 8  # global rows; 2 per device
+    policy = PPOJaxPolicy(
+        obs_space,
+        act_space,
+        {
+            "_mesh": mesh,
+            "model": {"fcnet_hiddens": [16]},
+            "train_batch_size": B,
+            "sgd_minibatch_size": B,
+            "num_sgd_iter": 1,
+            "lr": 1e-3,
+            "seed": 0,  # identical init on every process
+        },
+    )
+    data_rng = np.random.default_rng(42)  # same stream on all hosts
+    host_batch = {
+        SampleBatch.OBS: data_rng.standard_normal((B, 8)).astype(
+            np.float32
+        ),
+        SampleBatch.ACTIONS: data_rng.integers(0, 4, B).astype(
+            np.int64
+        ),
+        SampleBatch.ACTION_LOGP: np.full(B, -1.4, np.float32),
+        SampleBatch.ACTION_DIST_INPUTS: data_rng.standard_normal(
+            (B, 4)
+        ).astype(np.float32),
+        SampleBatch.ADVANTAGES: data_rng.standard_normal(B).astype(
+            np.float32
+        ),
+        SampleBatch.VALUE_TARGETS: data_rng.standard_normal(B).astype(
+            np.float32
+        ),
+    }
+    tree, bsize = policy.prepare_batch(SampleBatch(host_batch))
+    # each process contributes its local slice of the global batch
+    local = jax.local_device_count() * (B // jax.device_count())
+    lo = rank * local
+    global_batch = {
+        k: jax.make_array_from_process_local_data(
+            policy.data_sharding, v[lo : lo + local]
+        )
+        for k, v in tree.items()
+    }
+    stats = policy.learn_on_device_batch(global_batch, bsize)
+    assert np.isfinite(stats["total_loss"]), stats
+    # identical data + params + lockstep pmean => identical loss
+    kv.put(f"loss_{rank}", stats["total_loss"])
+    other_loss = kv.get(f"loss_{1 - rank}", timeout=60.0)
+    assert abs(other_loss - stats["total_loss"]) < 1e-5
+
+    dist.sync_global("done")
+    alive = kv.alive_nodes()
+    assert f"host{rank}" in alive
+    hb.stop()
+    print(f"MULTIHOST_OK rank={rank}")
+
+
+if __name__ == "__main__":
+    main()
