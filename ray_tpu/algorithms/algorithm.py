@@ -127,6 +127,11 @@ class Algorithm(Trainable):
                 "output": (config.get("evaluation_config") or {}).get(
                     "output"
                 ),
+                # Nor re-run an input factory (a PolicyServerInput
+                # would try to bind the same port twice).
+                "input": (config.get("evaluation_config") or {}).get(
+                    "input"
+                ),
             }
             self.evaluation_workers = WorkerSet(
                 env_creator=env_creator,
@@ -211,7 +216,7 @@ class Algorithm(Trainable):
             ):
                 episodes.extend(eps)
         lw = self.workers.local_worker()
-        if lw is not None and lw.sampler is not None:
+        if lw is not None:
             episodes.extend(lw.get_metrics())
         # smooth over a sliding window (reference metrics smoothing)
         self._episode_history.extend(episodes)

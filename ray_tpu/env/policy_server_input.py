@@ -1,0 +1,218 @@
+"""PolicyServerInput: serve actions to external envs over HTTP.
+
+Counterpart of the reference's ``rllib/env/policy_server_input.py:26``:
+an input reader the algorithm samples from — external environment
+processes connect via :class:`~ray_tpu.env.policy_client.PolicyClient`,
+request actions (computed on-policy here), log rewards, and finish
+episodes; completed episodes become postprocessed SampleBatches the
+training loop consumes like any sampler output.
+
+Wire-up (reference examples/serving pattern):
+
+    config.offline_data(input_=lambda ioctx: PolicyServerInput(
+        ioctx, "127.0.0.1", 9900))
+
+Transport is stdlib HTTP + JSON (obs/actions as nested lists) — no
+external deps, adequate for the control-rate traffic of external envs.
+"""
+
+from __future__ import annotations
+
+import json
+import queue
+import threading
+import uuid
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ray_tpu.data.sample_batch import SampleBatch
+from ray_tpu.evaluation.metrics import RolloutMetrics
+
+
+class _EpisodeState:
+    __slots__ = ("rows", "pending", "total_reward", "training")
+
+    def __init__(self, training: bool = True):
+        self.rows: List[Dict] = []
+        self.pending: Optional[Dict] = None  # row awaiting its reward
+        self.total_reward = 0.0
+        self.training = training
+
+
+class PolicyServerInput:
+    """reference policy_server_input.py:26 (input-reader API: next())."""
+
+    def __init__(self, ioctx, address: str, port: int):
+        self.worker = getattr(ioctx, "worker", None)
+        policy_map = getattr(self.worker, "policy_map", None) or {}
+        from ray_tpu.data.sample_batch import DEFAULT_POLICY_ID
+
+        self.policy = policy_map.get(DEFAULT_POLICY_ID) or next(
+            iter(policy_map.values())
+        )
+        # the same obs pipeline the SyncSampler applies (_transform):
+        # preprocessor (one-hot/flatten for non-Box spaces — the policy
+        # was built on the preprocessed space) then observation filter
+        self.preprocessor = getattr(self.worker, "preprocessor", None)
+        filters = getattr(self.worker, "filters", None) or {}
+        self.obs_filter = filters.get(DEFAULT_POLICY_ID)
+        self._episodes: Dict[str, _EpisodeState] = {}
+        self._lock = threading.Lock()
+        self._batches: "queue.Queue" = queue.Queue()
+        self._metrics: List[RolloutMetrics] = []
+
+        server_self = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):  # silence per-request spam
+                pass
+
+            def do_POST(self):
+                length = int(self.headers.get("Content-Length", 0))
+                try:
+                    req = json.loads(self.rfile.read(length))
+                    out = server_self._handle(req)
+                    blob = json.dumps(out).encode()
+                    self.send_response(200)
+                except Exception as e:
+                    blob = json.dumps({"error": repr(e)}).encode()
+                    self.send_response(500)
+                self.send_header("Content-Length", str(len(blob)))
+                self.end_headers()
+                self.wfile.write(blob)
+
+        self._server = ThreadingHTTPServer((address, port), Handler)
+        self._server.daemon_threads = True
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True
+        )
+        self._thread.start()
+
+    # -- protocol ---------------------------------------------------------
+
+    def _transform(self, obs) -> np.ndarray:
+        if self.preprocessor is not None:
+            obs = self.preprocessor.transform(obs)
+        if self.obs_filter is not None:
+            obs = self.obs_filter(obs)
+        return np.asarray(obs, np.float32)
+
+    def _handle(self, req: Dict) -> Dict:
+        cmd = req["command"]
+        if cmd == "START_EPISODE":
+            eid = req.get("episode_id") or uuid.uuid4().hex[:12]
+            with self._lock:
+                self._episodes[eid] = _EpisodeState(
+                    training=req.get("training_enabled", True)
+                )
+            return {"episode_id": eid}
+        ep = self._episodes.get(req["episode_id"])
+        if ep is None:
+            raise KeyError(f"unknown episode {req['episode_id']}")
+        if cmd == "GET_ACTION":
+            obs = self._transform(np.asarray(req["observation"]))
+            action, _, extra = self.policy.compute_single_action(
+                obs, explore=ep.training
+            )
+            row = {
+                SampleBatch.OBS: obs,
+                SampleBatch.ACTIONS: np.asarray(action),
+                SampleBatch.REWARDS: np.float32(0.0),
+                SampleBatch.TERMINATEDS: np.bool_(False),
+                SampleBatch.TRUNCATEDS: np.bool_(False),
+            }
+            for k, v in extra.items():
+                row[k] = np.asarray(v)
+            with self._lock:
+                self._finish_pending(ep, obs)
+                ep.pending = row
+            return {"action": np.asarray(action).tolist()}
+        if cmd == "LOG_RETURNS":
+            with self._lock:
+                if ep.pending is not None:
+                    ep.pending[SampleBatch.REWARDS] = np.float32(
+                        float(ep.pending[SampleBatch.REWARDS])
+                        + float(req["reward"])
+                    )
+                ep.total_reward += float(req["reward"])
+            return {}
+        if cmd == "END_EPISODE":
+            obs = self._transform(np.asarray(req["observation"]))
+            truncated = bool(req.get("truncated", False))
+            # build under the lock, postprocess (GAE = a model forward)
+            # outside it so concurrent envs aren't stalled
+            with self._lock:
+                self._finish_pending(
+                    ep, obs, done=True, truncated=truncated
+                )
+                batch = self._build_episode_batch(
+                    req["episode_id"], ep
+                )
+            if batch is not None:
+                self._postprocess_and_enqueue(batch)
+            return {}
+        raise ValueError(f"unknown command {cmd!r}")
+
+    def _finish_pending(
+        self,
+        ep: _EpisodeState,
+        next_obs,
+        done: bool = False,
+        truncated: bool = False,
+    ) -> None:
+        if ep.pending is None:
+            return
+        row = ep.pending
+        row[SampleBatch.NEXT_OBS] = np.asarray(next_obs, np.float32)
+        if done:
+            # truncation (time limit) keeps TERMINATEDS False so GAE
+            # bootstraps V(s_T) instead of zero (sampler parity)
+            row[SampleBatch.TERMINATEDS] = np.bool_(not truncated)
+            row[SampleBatch.TRUNCATEDS] = np.bool_(truncated)
+        ep.rows.append(row)
+        ep.pending = None
+
+    def _build_episode_batch(
+        self, eid: str, ep: _EpisodeState
+    ) -> Optional[SampleBatch]:
+        """Lock-held: detach the episode and assemble its columns."""
+        self._episodes.pop(eid, None)
+        self._metrics.append(
+            RolloutMetrics(len(ep.rows), ep.total_reward)
+        )
+        if not ep.rows or not ep.training:
+            return None
+        cols: Dict[str, np.ndarray] = {}
+        for k in ep.rows[0].keys():
+            cols[k] = np.stack([r[k] for r in ep.rows])
+        cols[SampleBatch.EPS_ID] = np.full(
+            len(ep.rows), abs(hash(eid)) % (2**31), np.int64
+        )
+        return SampleBatch(cols)
+
+    def _postprocess_and_enqueue(self, batch: SampleBatch) -> None:
+        expl = getattr(self.policy, "exploration", None)
+        if expl is not None:
+            batch = expl.postprocess_trajectory(self.policy, batch)
+        batch = self.policy.postprocess_trajectory(batch)
+        self._batches.put(batch)
+
+    # -- input-reader API -------------------------------------------------
+
+    def next(self) -> SampleBatch:
+        """Block until an episode's batch is available (reference
+        PolicyServerInput.next blocks on its queue the same way)."""
+        return self._batches.get()
+
+    def get_metrics(self) -> List[RolloutMetrics]:
+        with self._lock:
+            out = self._metrics
+            self._metrics = []
+        return out
+
+    def shutdown(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()

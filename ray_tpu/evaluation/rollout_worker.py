@@ -128,11 +128,34 @@ class RolloutWorker:
                 eff_obs_space.shape,
             )
 
+        # ---- input reader (external envs / policy server) ----
+        # config["input"] may be a callable(ioctx) -> reader with a
+        # .next() method (reference offline/io_context + the
+        # PolicyServerInput wiring); strings are offline paths handled
+        # by the offline algorithms.
+        self.input_reader = None
+        inp = self.config.get("input")
+        if callable(inp):
+            from types import SimpleNamespace
+
+            self.input_reader = inp(
+                SimpleNamespace(worker=self, config=self.config)
+            )
+
         # ---- sampler ----
         self.sampler = None
-        if self.vector_env is not None and self.policy_map:
+        if (
+            self.input_reader is None
+            and self.vector_env is not None
+            and self.policy_map
+        ):
             pid = DEFAULT_POLICY_ID
-            self.sampler = SyncSampler(
+            sampler_cls = SyncSampler
+            from ray_tpu.evaluation.sampler import AsyncSampler
+
+            if self.config.get("sample_async"):
+                sampler_cls = AsyncSampler
+            self.sampler = sampler_cls(
                 vector_env=self.vector_env,
                 policy=self.policy_map[pid],
                 preprocessor=self.preprocessor,
@@ -182,8 +205,11 @@ class RolloutWorker:
         """reference rollout_worker.py:824 (+ the output-writer wiring
         of reference offline/output_writer.py: every sampled batch is
         mirrored to the configured offline store)."""
-        assert self.sampler is not None, "worker has no env"
-        batch = self.sampler.sample()
+        if self.input_reader is not None:
+            batch = self.input_reader.next()
+        else:
+            assert self.sampler is not None, "worker has no env"
+            batch = self.sampler.sample()
         out = self.config.get("output")
         if out:
             if not hasattr(self, "_output_writer"):
@@ -205,6 +231,10 @@ class RolloutWorker:
         return batch, batch.env_steps()
 
     def get_metrics(self) -> List:
+        if self.input_reader is not None and hasattr(
+            self.input_reader, "get_metrics"
+        ):
+            return self.input_reader.get_metrics()
         return self.sampler.get_metrics() if self.sampler else []
 
     # -- learning --------------------------------------------------------
@@ -298,6 +328,19 @@ class RolloutWorker:
         return [fn(p, pid) for pid, p in self.policy_map.items()]
 
     def stop(self) -> None:
+        # stop the async sampling thread BEFORE closing its envs
+        if self.sampler is not None and hasattr(self.sampler, "stop"):
+            try:
+                self.sampler.stop()
+            except Exception:
+                pass
+        if self.input_reader is not None and hasattr(
+            self.input_reader, "shutdown"
+        ):
+            try:
+                self.input_reader.shutdown()
+            except Exception:
+                pass
         if self.vector_env is not None:
             for e in self.vector_env.get_sub_environments():
                 try:

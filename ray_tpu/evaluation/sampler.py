@@ -242,3 +242,61 @@ class SyncSampler:
         out = self.metrics_queue
         self.metrics_queue = []
         return out
+
+
+class AsyncSampler:
+    """Background-thread sampler (reference ``sampler.py:320``
+    AsyncSampler): env stepping + postprocessing run continuously on a
+    daemon thread, queueing finished fragments; ``sample()`` pops. Use
+    for slow/IO-bound envs so env stepping overlaps learning — policy
+    weight swaps are atomic (the same sharing contract as IMPALA's
+    learner thread)."""
+
+    def __init__(self, *, queue_size: int = 8, **sync_kwargs):
+        import queue as _queue
+        import threading
+
+        self._sync = SyncSampler(**sync_kwargs)
+        self.policy = self._sync.policy
+        self._queue: "_queue.Queue" = _queue.Queue(maxsize=queue_size)
+        self._stop = threading.Event()
+        self._error = None
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="async_sampler"
+        )
+        self._thread.start()
+
+    def _run(self):
+        import queue as _queue
+
+        while not self._stop.is_set():
+            try:
+                batch = self._sync.sample()
+            except Exception as e:  # surface on next sample() call
+                self._error = e
+                return
+            while not self._stop.is_set():
+                try:
+                    self._queue.put(batch, timeout=0.5)
+                    break
+                except _queue.Full:
+                    continue
+
+    def sample(self) -> SampleBatch:
+        import queue as _queue
+
+        while True:
+            if self._error is not None:
+                raise self._error
+            try:
+                return self._queue.get(timeout=1.0)
+            except _queue.Empty:
+                if not self._thread.is_alive() and self._error is None:
+                    raise RuntimeError("async sampler thread died")
+
+    def get_metrics(self) -> List[RolloutMetrics]:
+        return self._sync.get_metrics()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=5.0)
