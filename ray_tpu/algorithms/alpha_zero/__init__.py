@@ -1,0 +1,7 @@
+from ray_tpu.algorithms.alpha_zero.alpha_zero import (
+    AlphaZero,
+    AlphaZeroConfig,
+    MCTS,
+)
+
+__all__ = ["AlphaZero", "AlphaZeroConfig", "MCTS"]

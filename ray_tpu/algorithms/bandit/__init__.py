@@ -1,0 +1,13 @@
+from ray_tpu.algorithms.bandit.bandit import (
+    BanditLinTS,
+    BanditLinTSConfig,
+    BanditLinUCB,
+    BanditLinUCBConfig,
+)
+
+__all__ = [
+    "BanditLinTS",
+    "BanditLinTSConfig",
+    "BanditLinUCB",
+    "BanditLinUCBConfig",
+]

@@ -1,0 +1,3 @@
+from ray_tpu.algorithms.maddpg.maddpg import MADDPG, MADDPGConfig
+
+__all__ = ["MADDPG", "MADDPGConfig"]

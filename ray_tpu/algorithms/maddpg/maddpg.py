@@ -1,0 +1,452 @@
+"""MADDPG: multi-agent DDPG with centralized critics.
+
+Counterpart of the reference's ``rllib/algorithms/maddpg/maddpg.py``
+(Lowe et al. 2017): each agent has a deterministic actor over its own
+observation, and a CENTRALIZED critic Q_i(s_all, a_all) trained with the
+other agents' target actions — decentralized execution, centralized
+training.
+
+TPU-first shape: all agents' actors and critics are stacked along a
+leading agent axis and the whole multi-agent update — every critic's TD
+step, every actor's policy gradient through its own critic, both polyak
+blends — is ONE jitted program vmapped over agents (the reference
+builds N separate torch graphs). Collection is the same driver-side
+joint collector pattern as QMIX."""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import flax.linen as nn
+import gymnasium as gym
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.algorithms.algorithm import (
+    Algorithm,
+    NUM_AGENT_STEPS_SAMPLED,
+    NUM_ENV_STEPS_SAMPLED,
+)
+from ray_tpu.algorithms.algorithm_config import AlgorithmConfig
+from ray_tpu.data.sample_batch import DEFAULT_POLICY_ID
+from ray_tpu.env.registry import get_env_creator
+from ray_tpu.evaluation.metrics import RolloutMetrics
+from ray_tpu.execution.train_ops import NUM_ENV_STEPS_TRAINED
+from ray_tpu.models.base import get_activation
+
+
+class _Actor(nn.Module):
+    act_dim: int
+    low: float
+    high: float
+    hiddens: tuple = (64, 64)
+
+    @nn.compact
+    def __call__(self, obs):
+        act = get_activation("relu")
+        x = obs.astype(jnp.float32)
+        for i, h in enumerate(self.hiddens):
+            x = act(nn.Dense(h, name=f"fc_{i}")(x))
+        raw = jnp.tanh(nn.Dense(self.act_dim, name="out")(x))
+        mid = (self.high + self.low) / 2.0
+        half = (self.high - self.low) / 2.0
+        return mid + half * raw
+
+
+class _CentralCritic(nn.Module):
+    hiddens: tuple = (64, 64)
+
+    @nn.compact
+    def __call__(self, joint_obs, joint_actions):
+        act = get_activation("relu")
+        x = jnp.concatenate(
+            [
+                joint_obs.astype(jnp.float32),
+                joint_actions.astype(jnp.float32),
+            ],
+            axis=-1,
+        )
+        for i, h in enumerate(self.hiddens):
+            x = act(nn.Dense(h, name=f"fc_{i}")(x))
+        return nn.Dense(1, name="q")(x).squeeze(-1)
+
+
+class MADDPGConfig(AlgorithmConfig):
+    """reference maddpg.py MADDPGConfig."""
+
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class or MADDPG)
+        self.actor_hiddens = [64, 64]
+        self.critic_hiddens = [64, 64]
+        self.actor_lr = 1e-3
+        self.critic_lr = 1e-3
+        self.tau = 0.01
+        self.gamma = 0.95
+        self.train_batch_size = 64
+        self.rollout_fragment_length = 16
+        self.buffer_size = 10000
+        self.num_steps_sampled_before_learning_starts = 500
+        self.exploration_stddev = 0.1
+
+    def training(
+        self,
+        *,
+        actor_lr: Optional[float] = None,
+        critic_lr: Optional[float] = None,
+        tau: Optional[float] = None,
+        buffer_size: Optional[int] = None,
+        num_steps_sampled_before_learning_starts: Optional[int] = None,
+        exploration_stddev: Optional[float] = None,
+        **kwargs,
+    ) -> "MADDPGConfig":
+        super().training(**kwargs)
+        if actor_lr is not None:
+            self.actor_lr = actor_lr
+        if critic_lr is not None:
+            self.critic_lr = critic_lr
+        if tau is not None:
+            self.tau = tau
+        if buffer_size is not None:
+            self.buffer_size = buffer_size
+        if num_steps_sampled_before_learning_starts is not None:
+            self.num_steps_sampled_before_learning_starts = (
+                num_steps_sampled_before_learning_starts
+            )
+        if exploration_stddev is not None:
+            self.exploration_stddev = exploration_stddev
+        return self
+
+
+class MADDPG(Algorithm):
+    @classmethod
+    def get_default_config(cls) -> MADDPGConfig:
+        return MADDPGConfig(cls)
+
+    def setup(self, config: Dict) -> None:
+        env_spec = config.get("env")
+        super().setup(dict(config, env=None))
+        self.env = get_env_creator(env_spec)(
+            config.get("env_config") or {}
+        )
+        obs, _ = self.env.reset(seed=config.get("seed"))
+        self.agent_ids: List = sorted(obs.keys())
+        self.n_agents = len(self.agent_ids)
+        a0 = self.agent_ids[0]
+        self.obs_dim = int(np.prod(np.asarray(obs[a0]).shape))
+        space = getattr(self.env, "action_space", None)
+        if isinstance(space, dict):
+            space = space[a0]
+        elif isinstance(space, gym.spaces.Dict):
+            space = next(iter(space.spaces.values()))
+        assert isinstance(space, gym.spaces.Box), (
+            "MADDPG requires Box agent actions"
+        )
+        self.act_dim = int(np.prod(space.shape))
+        self.low = float(np.min(space.low))
+        self.high = float(np.max(space.high))
+        self._cur_obs = obs
+        self._episode_reward = 0.0
+        self._episode_len = 0
+
+        seed = int(config.get("seed") or 0)
+        self._rng = jax.random.PRNGKey(seed)
+        self._np_rng = np.random.default_rng(seed)
+        self.actor = _Actor(
+            self.act_dim,
+            self.low,
+            self.high,
+            tuple(config.get("actor_hiddens", (64, 64))),
+        )
+        self.critic = _CentralCritic(
+            tuple(config.get("critic_hiddens", (64, 64)))
+        )
+
+        # stacked per-agent parameters via vmapped init
+        n = self.n_agents
+        self._rng, ra, rc = jax.random.split(self._rng, 3)
+        dummy_obs = jnp.zeros((2, self.obs_dim), jnp.float32)
+        dummy_jobs = jnp.zeros(
+            (2, self.obs_dim * n), jnp.float32
+        )
+        dummy_jact = jnp.zeros((2, self.act_dim * n), jnp.float32)
+        actor_params = jax.vmap(
+            lambda r: self.actor.init(r, dummy_obs)
+        )(jax.random.split(ra, n))
+        critic_params = jax.vmap(
+            lambda r: self.critic.init(r, dummy_jobs, dummy_jact)
+        )(jax.random.split(rc, n))
+        self.params = {"actor": actor_params, "critic": critic_params}
+        self.target_params = jax.tree_util.tree_map(
+            lambda x: x, self.params
+        )
+        self._tx_a = optax.adam(float(config.get("actor_lr", 1e-3)))
+        self._tx_c = optax.adam(float(config.get("critic_lr", 1e-3)))
+        self.opt_state = {
+            "actor": self._tx_a.init(actor_params),
+            "critic": self._tx_c.init(critic_params),
+        }
+        self._buffer: List[Dict] = []
+        self._buffer_idx = 0
+        self._act_fn = None
+        self._learn_fn = None
+
+    # -- acting -----------------------------------------------------------
+
+    def _actions(self, obs_stack: np.ndarray, explore: bool):
+        if self._act_fn is None:
+
+            def fn(params, obs, rng, stddev):
+                # vmap actors over the agent axis
+                acts = jax.vmap(self.actor.apply)(
+                    params["actor"], obs[:, None]
+                ).squeeze(1)  # (n, act_dim)
+                noise = stddev * jax.random.normal(rng, acts.shape)
+                return jnp.clip(acts + noise, self.low, self.high)
+
+            self._act_fn = jax.jit(fn)
+        self._rng, rng = jax.random.split(self._rng)
+        stddev = (
+            float(self.config.get("exploration_stddev", 0.1))
+            if explore
+            else 0.0
+        )
+        return np.asarray(
+            self._act_fn(
+                self.params,
+                jnp.asarray(obs_stack),
+                rng,
+                jnp.asarray(stddev, jnp.float32),
+            )
+        )
+
+    def _collect(self, num_steps: int) -> None:
+        cap = int(self.config.get("buffer_size", 10000))
+        for _ in range(num_steps):
+            obs_stack = np.stack(
+                [
+                    np.asarray(self._cur_obs[a], np.float32).reshape(-1)
+                    for a in self.agent_ids
+                ]
+            )
+            acts = self._actions(obs_stack, explore=True)
+            action_dict = {
+                a: acts[i] for i, a in enumerate(self.agent_ids)
+            }
+            next_obs, rewards, terms, truncs, _ = self.env.step(
+                action_dict
+            )
+            done = bool(
+                terms.get("__all__", False)
+                or truncs.get("__all__", False)
+            )
+            rew_vec = np.asarray(
+                [rewards.get(a, 0.0) for a in self.agent_ids],
+                np.float32,
+            )
+            next_stack = (
+                np.stack(
+                    [
+                        np.asarray(
+                            next_obs.get(a, self._cur_obs[a]),
+                            np.float32,
+                        ).reshape(-1)
+                        for a in self.agent_ids
+                    ]
+                )
+                if next_obs
+                else obs_stack
+            )
+            row = {
+                "obs": obs_stack,
+                "actions": acts.astype(np.float32),
+                "rewards": rew_vec,
+                "next_obs": next_stack,
+                "done": np.float32(done),
+            }
+            if len(self._buffer) < cap:
+                self._buffer.append(row)
+            else:
+                self._buffer[self._buffer_idx] = row
+            self._buffer_idx = (self._buffer_idx + 1) % cap
+            self._episode_reward += float(rew_vec.sum())
+            self._episode_len += 1
+            self._counters[NUM_ENV_STEPS_SAMPLED] += 1
+            self._counters[NUM_AGENT_STEPS_SAMPLED] += self.n_agents
+            if done:
+                self._episode_history.append(
+                    RolloutMetrics(
+                        self._episode_len, self._episode_reward
+                    )
+                )
+                self._episodes_total += 1
+                self._episode_reward = 0.0
+                self._episode_len = 0
+                next_obs, _ = self.env.reset()
+            self._cur_obs = next_obs
+
+    # -- learning ---------------------------------------------------------
+
+    def _build_learn_fn(self):
+        gamma = float(self.config.get("gamma", 0.95))
+        tau = float(self.config.get("tau", 0.01))
+        actor, critic = self.actor, self.critic
+        tx_a, tx_c = self._tx_a, self._tx_c
+        n = self.n_agents
+
+        def fn(params, target_params, opt_state, batch):
+            obs = batch["obs"]  # (B, n, d)
+            next_obs = batch["next_obs"]
+            actions = batch["actions"]  # (B, n, a)
+            B = obs.shape[0]
+            joint_obs = obs.reshape(B, -1)
+            joint_next_obs = next_obs.reshape(B, -1)
+            joint_actions = actions.reshape(B, -1)
+
+            # target joint next actions from all target actors
+            next_acts = jax.vmap(
+                actor.apply, in_axes=(0, 1), out_axes=1
+            )(target_params["actor"], next_obs)  # (B, n, a)
+            joint_next_acts = next_acts.reshape(B, -1)
+
+            # per-agent centralized critic TD targets
+            tq = jax.vmap(
+                lambda cp: critic.apply(
+                    cp, joint_next_obs, joint_next_acts
+                )
+            )(target_params["critic"])  # (n, B)
+            y = jax.lax.stop_gradient(
+                batch["rewards"].T
+                + gamma * (1.0 - batch["done"])[None, :] * tq
+            )  # (n, B)
+
+            def critic_loss(cps):
+                q = jax.vmap(
+                    lambda cp: critic.apply(
+                        cp, joint_obs, joint_actions
+                    )
+                )(cps)  # (n, B)
+                return jnp.mean(jnp.square(q - y)), q
+
+            (c_loss, q), c_grads = jax.value_and_grad(
+                critic_loss, has_aux=True
+            )(params["critic"])
+            c_upd, c_opt = tx_c.update(
+                c_grads, opt_state["critic"], params["critic"]
+            )
+            new_critic = optax.apply_updates(params["critic"], c_upd)
+
+            # actor gradients: each agent maximizes ITS critic with its
+            # own action substituted into the joint action
+            def actor_loss(aps):
+                my_acts = jax.vmap(
+                    actor.apply, in_axes=(0, 1), out_axes=1
+                )(aps, obs)  # (B, n, a)
+
+                def one_agent(i):
+                    # substitute agent i's fresh action, others logged
+                    mixed = actions.at[:, i, :].set(my_acts[:, i, :])
+                    cp_i = jax.tree_util.tree_map(
+                        lambda x: x[i], new_critic
+                    )
+                    return -jnp.mean(
+                        critic.apply(
+                            cp_i, joint_obs, mixed.reshape(B, -1)
+                        )
+                    )
+
+                losses = jnp.stack(
+                    [one_agent(i) for i in range(n)]
+                )
+                return jnp.sum(losses)
+
+            a_loss, a_grads = jax.value_and_grad(actor_loss)(
+                params["actor"]
+            )
+            a_upd, a_opt = tx_a.update(
+                a_grads, opt_state["actor"], params["actor"]
+            )
+            new_actor = optax.apply_updates(params["actor"], a_upd)
+
+            new_target = jax.tree_util.tree_map(
+                lambda t, o: (1.0 - tau) * t + tau * o,
+                target_params,
+                {"actor": new_actor, "critic": new_critic},
+            )
+            stats = {
+                "critic_loss": c_loss,
+                "actor_loss": a_loss,
+                "mean_q": jnp.mean(q),
+            }
+            return (
+                {"actor": new_actor, "critic": new_critic},
+                new_target,
+                {"actor": a_opt, "critic": c_opt},
+                stats,
+            )
+
+        return jax.jit(fn)
+
+    def training_step(self) -> Dict:
+        config = self.config
+        self._collect(int(config.get("rollout_fragment_length", 16)))
+        train_info: Dict = {}
+        if (
+            self._counters[NUM_ENV_STEPS_SAMPLED]
+            >= config.get("num_steps_sampled_before_learning_starts", 0)
+            and len(self._buffer) >= config["train_batch_size"]
+        ):
+            if self._learn_fn is None:
+                self._learn_fn = self._build_learn_fn()
+            idx = self._np_rng.integers(
+                0, len(self._buffer), config["train_batch_size"]
+            )
+            rows = [self._buffer[i] for i in idx]
+            batch = {
+                k: jnp.asarray(np.stack([r[k] for r in rows]))
+                for k in rows[0]
+            }
+            (
+                self.params,
+                self.target_params,
+                self.opt_state,
+                stats,
+            ) = self._learn_fn(
+                self.params, self.target_params, self.opt_state, batch
+            )
+            stats = {
+                k: float(v) for k, v in jax.device_get(stats).items()
+            }
+            train_info = {DEFAULT_POLICY_ID: stats}
+            self._counters[NUM_ENV_STEPS_TRAINED] += int(
+                config["train_batch_size"]
+            )
+        return train_info
+
+    def __getstate__(self) -> Dict:
+        return {
+            "params": jax.device_get(self.params),
+            "target_params": jax.device_get(self.target_params),
+            "opt_state": jax.device_get(self.opt_state),
+            "counters": dict(self._counters),
+            "episodes_total": self._episodes_total,
+        }
+
+    def __setstate__(self, state: Dict) -> None:
+        import collections
+
+        self.params = jax.device_put(state["params"])
+        self.target_params = jax.device_put(state["target_params"])
+        self.opt_state = jax.device_put(state["opt_state"])
+        self._counters = collections.defaultdict(
+            int, state.get("counters", {})
+        )
+        self._episodes_total = state.get("episodes_total", 0)
+
+    def cleanup(self) -> None:
+        try:
+            self.env.close()
+        except Exception:
+            pass
+        super().cleanup()

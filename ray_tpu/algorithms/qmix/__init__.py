@@ -1,0 +1,3 @@
+from ray_tpu.algorithms.qmix.qmix import QMIX, QMIXConfig
+
+__all__ = ["QMIX", "QMIXConfig"]

