@@ -203,6 +203,16 @@ class Algorithm(Trainable):
             == 0
         ):
             results["evaluation"] = self.evaluate()
+        # feed the dashboard-lite results ring (reference: the tune/job
+        # dashboard modules read equivalent state from the GCS)
+        try:
+            from ray_tpu.dashboard import publish_result
+
+            publish_result(
+                {"training_iteration": self._iteration + 1, **results}
+            )
+        except Exception:
+            pass
         return results
 
     def _collect_rollout_metrics(self) -> Dict:

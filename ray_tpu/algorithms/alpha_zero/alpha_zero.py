@@ -47,18 +47,22 @@ class _Node:
         "done",
         "reward",
         "priors",
+        "net_value",
         "child_n",
         "child_w",
         "children",
         "num_actions",
     )
 
-    def __init__(self, state, obs, done, reward, priors, num_actions):
+    def __init__(
+        self, state, obs, done, reward, priors, net_value, num_actions
+    ):
         self.state = state
         self.obs = obs
         self.done = done
         self.reward = reward
         self.priors = priors
+        self.net_value = net_value  # value from the SAME forward pass
         self.num_actions = num_actions
         self.child_n = np.zeros(num_actions, np.float32)
         self.child_w = np.zeros(num_actions, np.float32)
@@ -102,9 +106,10 @@ class MCTS:
         self.rng = rng
 
     def _make_node(self, env, state, obs, done, reward) -> _Node:
-        priors, _ = self.eval_fn(obs)
+        priors, value = self.eval_fn(obs)
         return _Node(
-            state, obs, done, reward, priors, self.num_actions
+            state, obs, done, reward, priors, float(value),
+            self.num_actions,
         )
 
     def search(self, env, obs) -> np.ndarray:
@@ -143,12 +148,9 @@ class MCTS:
                 )
                 node.children[a] = child
                 node = child
-            # evaluate
-            if node.done:
-                value = 0.0
-            else:
-                _, value = self.eval_fn(node.obs)
-                value = float(value)
+            # evaluate: reuse the value from the expansion forward
+            # pass (one network call per simulation, not two)
+            value = 0.0 if node.done else node.net_value
             # backup with per-edge rewards (single-player discounted)
             for parent, a in reversed(path):
                 child = parent.children.get(a)

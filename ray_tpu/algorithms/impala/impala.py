@@ -63,6 +63,10 @@ class IMPALAConfig(AlgorithmConfig):
         self.learner_queue_size = 16
         self.max_sample_requests_in_flight_per_worker = 2
         self.min_time_s_per_iteration = 1
+        # >0 routes sample refs through aggregation actors that concat
+        # fragments to train batches off-driver (reference
+        # impala.py:874 process_experiences_tree_aggregation)
+        self.num_aggregation_workers = 0
 
     def training(
         self,
@@ -96,6 +100,13 @@ class IMPALAConfig(AlgorithmConfig):
             self.broadcast_interval = broadcast_interval
         if learner_queue_size is not None:
             self.learner_queue_size = learner_queue_size
+        return self
+
+    def aggregation(
+        self, *, num_aggregation_workers: Optional[int] = None, **kwargs
+    ) -> "IMPALAConfig":
+        if num_aggregation_workers is not None:
+            self.num_aggregation_workers = num_aggregation_workers
         return self
 
 
@@ -204,6 +215,34 @@ class ImpalaJaxPolicy(JaxPolicy):
         return total, stats
 
 
+@ray.remote
+class AggregatorWorker:
+    """Off-driver batch concatenation (reference impala.py:946
+    AggregatorWorker + execution/tree_agg.py): rollout fragments are
+    routed here by reference and concatenated to full train batches in
+    the aggregator's process, so the concat/copy work moves off the
+    driver thread (on this single-host object plane the values still
+    stage through driver shm; cross-node transfer is the DCN layer's
+    job)."""
+
+    def __init__(self, target_size: int):
+        self.target_size = int(target_size)
+        self._buf = []
+        self._steps = 0
+
+    def aggregate(self, batch):
+        from ray_tpu.data.sample_batch import concat_samples
+
+        self._buf.append(batch)
+        self._steps += batch.env_steps()
+        if self._steps < self.target_size:
+            return None
+        out = concat_samples(self._buf)
+        self._buf = []
+        self._steps = 0
+        return out
+
+
 class IMPALA(Algorithm):
     _default_policy_class = ImpalaJaxPolicy
 
@@ -221,6 +260,13 @@ class IMPALA(Algorithm):
         self._learner_thread.start()
         self._in_flight: Dict = {}  # ref -> worker
         self._batches_since_broadcast: Dict = {}
+        n_agg = int(config.get("num_aggregation_workers", 0))
+        self._aggregators = [
+            AggregatorWorker.remote(config.get("train_batch_size", 500))
+            for _ in range(n_agg)
+        ]
+        self._agg_rr = 0
+        self._agg_in_flight: list = []
 
     def training_step(self) -> Dict:
         """reference impala.py:614."""
@@ -265,17 +311,45 @@ class IMPALA(Algorithm):
             weights_ref = None
             for ref in ready:
                 w = self._in_flight.pop(ref)
-                try:
-                    batch = ray.get(ref)
-                except (
-                    ray.core.object_store.RayActorError,
-                    ray.core.object_store.WorkerCrashedError,
-                ):
-                    continue
-                self._counters[NUM_ENV_STEPS_SAMPLED] += (
-                    batch.env_steps()
-                )
-                lt.add_batch(batch, block=False)
+                if self._aggregators:
+                    # tree aggregation: hand the fragment ref to an
+                    # aggregation actor; the concat to a full train
+                    # batch happens in ITS process, not the driver's.
+                    # Marshalling happens synchronously at .remote(),
+                    # so the fragment ref can be freed right after —
+                    # and a crashed worker's errored ref re-raises
+                    # here, which must skip the fragment like the
+                    # direct path does.
+                    agg = self._aggregators[
+                        self._agg_rr % len(self._aggregators)
+                    ]
+                    self._agg_rr += 1
+                    try:
+                        self._agg_in_flight.append(
+                            agg.aggregate.remote(ref)
+                        )
+                    except (
+                        ray.core.object_store.RayActorError,
+                        ray.core.object_store.WorkerCrashedError,
+                        ray.core.object_store.RayTaskError,
+                    ):
+                        continue
+                    finally:
+                        ray.free([ref])
+                else:
+                    try:
+                        batch = ray.get(ref)
+                    except (
+                        ray.core.object_store.RayActorError,
+                        ray.core.object_store.WorkerCrashedError,
+                    ):
+                        continue
+                    finally:
+                        ray.free([ref])
+                    self._counters[NUM_ENV_STEPS_SAMPLED] += (
+                        batch.env_steps()
+                    )
+                    lt.add_batch(batch, block=False)
                 # broadcast current weights back to the producer
                 # (reference update_workers_if_necessary, impala.py:645)
                 k = id(w)
@@ -299,6 +373,28 @@ class IMPALA(Algorithm):
                     )
                     self._batches_since_broadcast[k] = 0
                 self._in_flight[w.sample.remote()] = w
+            if weights_ref is not None:
+                # set_weights.remote marshalled the blob synchronously
+                ray.free([weights_ref])
+
+        # collect aggregated train batches (tree-aggregation mode)
+        if self._agg_in_flight:
+            ready_agg, _ = ray.wait(
+                self._agg_in_flight,
+                num_returns=len(self._agg_in_flight),
+                timeout=0,
+            )
+            for r in ready_agg:
+                self._agg_in_flight.remove(r)
+                try:
+                    agg_batch = ray.get(r)
+                finally:
+                    ray.free([r])
+                if agg_batch is not None:
+                    self._counters[NUM_ENV_STEPS_SAMPLED] += (
+                        agg_batch.env_steps()
+                    )
+                    lt.add_batch(agg_batch, block=False)
 
         # drain learner results
         learner_info = {}
@@ -320,4 +416,9 @@ class IMPALA(Algorithm):
     def cleanup(self) -> None:
         if hasattr(self, "_learner_thread"):
             self._learner_thread.stop()
+        for a in getattr(self, "_aggregators", []):
+            try:
+                ray.kill(a)
+            except Exception:
+                pass
         super().cleanup()

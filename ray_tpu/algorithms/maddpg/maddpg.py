@@ -237,10 +237,8 @@ class MADDPG(Algorithm):
             next_obs, rewards, terms, truncs, _ = self.env.step(
                 action_dict
             )
-            done = bool(
-                terms.get("__all__", False)
-                or truncs.get("__all__", False)
-            )
+            terminated = bool(terms.get("__all__", False))
+            done = terminated or bool(truncs.get("__all__", False))
             rew_vec = np.asarray(
                 [rewards.get(a, 0.0) for a in self.agent_ids],
                 np.float32,
@@ -263,7 +261,9 @@ class MADDPG(Algorithm):
                 "actions": acts.astype(np.float32),
                 "rewards": rew_vec,
                 "next_obs": next_stack,
-                "done": np.float32(done),
+                # bootstrap mask uses TERMINATION only: a time-limit
+                # truncation must still bootstrap Q(s')
+                "done": np.float32(terminated),
             }
             if len(self._buffer) < cap:
                 self._buffer.append(row)

@@ -1,0 +1,295 @@
+"""Connectors: composable transforms between envs and policies.
+
+Counterpart of the reference's ``rllib/connectors/connector.py``
+(``Connector :78``, ``AgentConnector :126``, ``ActionConnector :235``,
+``ConnectorPipeline :273``) and the concrete connectors under
+``rllib/connectors/{agent,action}/``: a serializable pipeline of small
+transforms applied to observations on the way INTO a policy
+(AgentConnector) and to sampled actions on the way OUT
+(ActionConnector).
+
+The rollout hot path stays batched and jit-friendly: agent connectors
+here operate on numpy observation batches (one call per vector-env
+step), not per-agent Python objects — the decomposition the reference's
+new stack performs per AgentConnectorDataType collapses into array
+ops."""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+
+class ConnectorContext:
+    """Construction-time info for connectors (reference
+    ConnectorContext.from_policy)."""
+
+    def __init__(
+        self,
+        observation_space=None,
+        action_space=None,
+        config: Optional[Dict] = None,
+    ):
+        self.observation_space = observation_space
+        self.action_space = action_space
+        self.config = config or {}
+
+    @classmethod
+    def from_policy(cls, policy) -> "ConnectorContext":
+        return cls(
+            policy.observation_space,
+            policy.action_space,
+            policy.config,
+        )
+
+
+class Connector:
+    """reference connector.py:78."""
+
+    def __init__(self, ctx: ConnectorContext):
+        self.ctx = ctx
+        self.is_training = True
+
+    def in_training(self, is_training: bool) -> None:
+        self.is_training = is_training
+
+    def __call__(self, data):
+        raise NotImplementedError
+
+    def to_config(self) -> Tuple[str, List[Any]]:
+        return type(self).__name__, []
+
+    @classmethod
+    def from_config(
+        cls, ctx: ConnectorContext, params: List[Any]
+    ) -> "Connector":
+        return cls(ctx, *params)
+
+    def __repr__(self):
+        return type(self).__name__
+
+
+class AgentConnector(Connector):
+    """Transforms observation batches env → policy
+    (reference connector.py:126)."""
+
+
+class ActionConnector(Connector):
+    """Transforms action batches policy → env
+    (reference connector.py:235)."""
+
+
+class ConnectorPipeline(Connector):
+    """Sequential composition (reference connector.py:273); itself a
+    connector, so pipelines nest."""
+
+    def __init__(self, ctx: ConnectorContext, connectors: List[Connector]):
+        super().__init__(ctx)
+        self.connectors = list(connectors)
+
+    def __call__(self, data):
+        for c in self.connectors:
+            data = c(data)
+        return data
+
+    def in_training(self, is_training: bool) -> None:
+        for c in self.connectors:
+            c.in_training(is_training)
+
+    def append(self, connector: Connector) -> None:
+        self.connectors.append(connector)
+
+    def prepend(self, connector: Connector) -> None:
+        self.connectors.insert(0, connector)
+
+    def remove(self, name: str) -> None:
+        self.connectors = [
+            c for c in self.connectors if type(c).__name__ != name
+        ]
+
+    def to_config(self) -> Tuple[str, List[Any]]:
+        return "ConnectorPipeline", [
+            c.to_config() for c in self.connectors
+        ]
+
+    @classmethod
+    def from_config(
+        cls, ctx: ConnectorContext, params: List[Any]
+    ) -> "ConnectorPipeline":
+        return cls(
+            ctx, [restore_connector(ctx, p) for p in params]
+        )
+
+    def __repr__(self):
+        inner = ", ".join(repr(c) for c in self.connectors)
+        return f"ConnectorPipeline[{inner}]"
+
+
+# -- concrete agent connectors ---------------------------------------------
+
+
+class ObsPreprocessorConnector(AgentConnector):
+    """Applies the catalog preprocessor (one-hot/flatten) — reference
+    connectors/agent/obs_preproc.py."""
+
+    def __init__(self, ctx: ConnectorContext):
+        super().__init__(ctx)
+        from ray_tpu.models.catalog import ModelCatalog
+
+        self._prep = ModelCatalog.get_preprocessor_for_space(
+            ctx.observation_space
+        )
+        self.observation_space = self._prep.observation_space
+
+    def __call__(self, obs):
+        return np.stack([self._prep.transform(o) for o in obs])
+
+
+class FlattenObsConnector(AgentConnector):
+    """Flattens trailing obs dims to 1-D per row."""
+
+    def __call__(self, obs):
+        obs = np.asarray(obs)
+        return obs.reshape(obs.shape[0], -1)
+
+
+class MeanStdFilterConnector(AgentConnector):
+    """Running mean/std normalization (reference
+    connectors/agent/mean_std_filter.py); stats update only in
+    training mode."""
+
+    def __init__(self, ctx: ConnectorContext, shape=None):
+        super().__init__(ctx)
+        from ray_tpu.utils.filter import MeanStdFilter
+
+        shape = shape or (
+            ctx.observation_space.shape
+            if ctx.observation_space is not None
+            else None
+        )
+        self.filter = MeanStdFilter(shape)
+
+    def __call__(self, obs):
+        return np.stack(
+            [
+                self.filter(np.asarray(o), update=self.is_training)
+                for o in obs
+            ]
+        )
+
+    def to_config(self):
+        return "MeanStdFilterConnector", [None]
+
+
+class ClipRewardConnector(AgentConnector):
+    """Clips rewards (sign or bound) — reference
+    connectors/agent/clip_reward.py. Operates on reward arrays."""
+
+    def __init__(
+        self,
+        ctx: ConnectorContext,
+        sign: bool = False,
+        limit: Optional[float] = None,
+    ):
+        super().__init__(ctx)
+        self.sign = sign
+        self.limit = limit
+
+    def __call__(self, rewards):
+        rewards = np.asarray(rewards, np.float32)
+        if self.sign:
+            return np.sign(rewards)
+        if self.limit is not None:
+            return np.clip(rewards, -self.limit, self.limit)
+        return rewards
+
+    def to_config(self):
+        return "ClipRewardConnector", [self.sign, self.limit]
+
+
+def LambdaAgentConnector(fn: Callable) -> type:
+    """reference connectors/agent/lambdas.py."""
+
+    class _Lambda(AgentConnector):
+        def __call__(self, data):
+            return fn(data)
+
+    _Lambda.__name__ = f"LambdaAgentConnector({fn.__name__})"
+    return _Lambda
+
+
+# -- concrete action connectors --------------------------------------------
+
+
+class ClipActionsConnector(ActionConnector):
+    """reference connectors/action/clip.py."""
+
+    def __call__(self, actions):
+        space = self.ctx.action_space
+        import gymnasium as gym
+
+        if isinstance(space, gym.spaces.Box):
+            return np.clip(actions, space.low, space.high)
+        return actions
+
+
+class NormalizeActionsConnector(ActionConnector):
+    """Maps [-1,1]-normalized actions to the space bounds — reference
+    connectors/action/normalize.py."""
+
+    def __call__(self, actions):
+        from ray_tpu.evaluation.sampler import unsquash_action
+
+        return np.asarray(
+            [
+                unsquash_action(a, self.ctx.action_space)
+                for a in np.asarray(actions)
+            ]
+        )
+
+
+def LambdaActionConnector(fn: Callable) -> type:
+    class _Lambda(ActionConnector):
+        def __call__(self, data):
+            return fn(data)
+
+    _Lambda.__name__ = f"LambdaActionConnector({fn.__name__})"
+    return _Lambda
+
+
+# -- registry / (de)serialization ------------------------------------------
+
+_CONNECTORS: Dict[str, type] = {}
+
+
+def register_connector(name: str, cls: type) -> None:
+    """reference connector.py register_connector."""
+    _CONNECTORS[name] = cls
+
+
+def get_connector(name: str) -> type:
+    if name not in _CONNECTORS:
+        raise ValueError(
+            f"Unknown connector {name!r}; known: {sorted(_CONNECTORS)}"
+        )
+    return _CONNECTORS[name]
+
+
+def restore_connector(ctx: ConnectorContext, config: Tuple) -> Connector:
+    """Rebuild a connector (or pipeline) from to_config output."""
+    name, params = config
+    if name == "ConnectorPipeline":
+        return ConnectorPipeline.from_config(ctx, params)
+    return get_connector(name).from_config(ctx, params)
+
+
+for _cls in (
+    ObsPreprocessorConnector,
+    FlattenObsConnector,
+    MeanStdFilterConnector,
+    ClipRewardConnector,
+    ClipActionsConnector,
+    NormalizeActionsConnector,
+):
+    register_connector(_cls.__name__, _cls)

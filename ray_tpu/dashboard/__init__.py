@@ -1,0 +1,3 @@
+from ray_tpu.dashboard.dashboard import DashboardLite, publish_result
+
+__all__ = ["DashboardLite", "publish_result"]

@@ -1,0 +1,148 @@
+"""Dashboard-lite: cluster + training state over HTTP.
+
+Counterpart of the reference's dashboard head + modules
+(``dashboard/head.py:59``, ``dashboard/modules/{node,actor,job,...}``)
+scoped to the single-host runtime: JSON endpoints for cluster state
+(workers/actors/resources), the chrome-trace timeline, registered
+metrics, and the latest training results, plus a small HTML index.
+
+Start via ``DashboardLite()`` (any process that ran ray.init) or
+``ray.init(dashboard=True)``."""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional
+
+_RESULTS_LOCK = threading.Lock()
+_RESULTS: List[Dict] = []  # ring of latest training results
+
+
+def publish_result(result: Dict, keep: int = 200) -> None:
+    """Algorithms push per-iteration results here (the reference's
+    tune/job modules read equivalent state from the GCS)."""
+    slim = {
+        k: v
+        for k, v in result.items()
+        if isinstance(v, (int, float, str, bool))
+    }
+    slim["_time"] = time.time()
+    with _RESULTS_LOCK:
+        _RESULTS.append(slim)
+        del _RESULTS[:-keep]
+
+
+def _cluster_state() -> Dict:
+    from ray_tpu.core import api as core_api
+
+    rt = core_api._runtime
+    if rt is None:
+        return {"initialized": False}
+    with rt.lock:
+        workers = [
+            {
+                "worker_id": w.worker_id,
+                "idle": w.idle,
+                "dead": w.dead,
+                "dedicated": w.dedicated,
+                "ring_results": w.ring_results,
+                "pid": w.proc.pid if w.proc else None,
+            }
+            for w in rt.pool
+        ]
+        actors = [
+            {
+                "actor_id": rec.actor_id[:12],
+                "name": rec.name,
+                "dead": rec.dead,
+                "restarts": rec.restarts,
+                "pid": rec.worker.proc.pid if rec.worker.proc else None,
+            }
+            for rec in rt.actors.values()
+        ]
+        pending = len(rt.pending)
+    return {
+        "initialized": True,
+        "num_cpus": rt.num_cpus,
+        "workers": workers,
+        "actors": actors,
+        "pending_tasks": pending,
+    }
+
+
+_INDEX_HTML = """<!doctype html>
+<html><head><title>ray_tpu dashboard</title></head>
+<body style="font-family: monospace">
+<h2>ray_tpu dashboard-lite</h2>
+<ul>
+<li><a href="/api/cluster">/api/cluster</a> — workers, actors, queue</li>
+<li><a href="/api/results">/api/results</a> — latest training results</li>
+<li><a href="/api/timeline">/api/timeline</a> — chrome-trace events
+ (load in chrome://tracing)</li>
+<li><a href="/metrics">/metrics</a> — Prometheus metrics</li>
+</ul>
+</body></html>"""
+
+
+class DashboardLite:
+    """reference dashboard/head.py:59, scoped to one host."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):
+                pass
+
+            def do_GET(self):
+                path = self.path.rstrip("/")
+                try:
+                    if path in ("", "/index.html"):
+                        blob = _INDEX_HTML.encode()
+                        ctype = "text/html"
+                    elif path == "/api/cluster":
+                        blob = json.dumps(_cluster_state()).encode()
+                        ctype = "application/json"
+                    elif path == "/api/results":
+                        with _RESULTS_LOCK:
+                            blob = json.dumps(_RESULTS).encode()
+                        ctype = "application/json"
+                    elif path == "/api/timeline":
+                        import ray_tpu as ray
+
+                        blob = json.dumps(ray.timeline()).encode()
+                        ctype = "application/json"
+                    elif path == "/metrics":
+                        from ray_tpu.utils.metrics_exporter import (
+                            format_prometheus,
+                        )
+
+                        blob = format_prometheus().encode()
+                        ctype = "text/plain; version=0.0.4"
+                    else:
+                        self.send_response(404)
+                        self.end_headers()
+                        return
+                    self.send_response(200)
+                except Exception as e:
+                    blob = json.dumps({"error": repr(e)}).encode()
+                    ctype = "application/json"
+                    self.send_response(500)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(blob)))
+                self.end_headers()
+                self.wfile.write(blob)
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self._server.daemon_threads = True
+        self.port = self._server.server_address[1]
+        self.url = f"http://{host}:{self.port}"
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True
+        )
+        self._thread.start()
+
+    def shutdown(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()

@@ -132,6 +132,14 @@ class SyncSampler:
             [s.copy() for s in init_state] for _ in range(n)
         ]
         self._has_state = bool(init_state)
+        # View-requirement-driven shifted columns (reference
+        # view_requirement.py:15 shift=-1): populate prev_actions /
+        # prev_rewards only when the policy asks for them.
+        vr = getattr(self.policy, "view_requirements", {}) or {}
+        self._want_prev_actions = SampleBatch.PREV_ACTIONS in vr
+        self._want_prev_rewards = SampleBatch.PREV_REWARDS in vr
+        self._prev_actions = [None] * n
+        self._prev_rewards = [np.float32(0.0)] * n
 
     def _transform(self, obs):
         return transform_obs(self.preprocessor, self.obs_filter, obs)
@@ -172,8 +180,21 @@ class SyncSampler:
                 np.stack([self.states[i][k] for i in range(n)])
                 for k in range(len(self.states[0]))
             ]
+        prev_kwargs = {}
+        if self._want_prev_actions:
+            shape = self.env.action_space.shape
+            zero = np.zeros(
+                shape or (), np.float32 if shape else np.int64
+            )
+            prev_kwargs["prev_action_batch"] = np.stack(
+                [zero if a is None else a for a in self._prev_actions]
+            )
+        if self._want_prev_rewards:
+            prev_kwargs["prev_reward_batch"] = np.asarray(
+                self._prev_rewards, np.float32
+            )
         actions, state_out, extras = self.policy.compute_actions(
-            obs_batch, state_batches, explore=True
+            obs_batch, state_batches, explore=True, **prev_kwargs
         )
 
         env_actions = []
@@ -207,6 +228,16 @@ class SyncSampler:
             if self._has_state:
                 for k in range(len(self.states[i])):
                     row[f"state_in_{k}"] = self.states[i][k]
+            if self._want_prev_actions:
+                row[SampleBatch.PREV_ACTIONS] = (
+                    np.zeros_like(np.asarray(actions[i]))
+                    if self._prev_actions[i] is None
+                    else self._prev_actions[i]
+                )
+                self._prev_actions[i] = np.asarray(actions[i])
+            if self._want_prev_rewards:
+                row[SampleBatch.PREV_REWARDS] = self._prev_rewards[i]
+                self._prev_rewards[i] = np.float32(rewards[i])
             self.collectors[i].add(row)
             self.episodes[i].add(float(rewards[i]))
 
@@ -222,6 +253,8 @@ class SyncSampler:
                 truncs[i] = True
             if ep_done:
                 done_any = True
+                self._prev_actions[i] = None
+                self._prev_rewards[i] = np.float32(0.0)
                 if self.flush_on_episode_end:
                     self._flush_slot(i, out)
                 with self._metrics_lock:

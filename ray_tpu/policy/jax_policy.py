@@ -78,8 +78,18 @@ class JaxPolicy(Policy):
         dummy_obs = self._dummy_obs(batch=2)
         init_state = self.model.initial_state(2)
         if self.model.is_recurrent:
+            init_kwargs = {}
+            if getattr(self.model, "use_prev_action", False):
+                init_kwargs["prev_actions"] = jnp.zeros(
+                    (2, 1) + tuple(action_space.shape or ()),
+                    jnp.float32,
+                )
+            if getattr(self.model, "use_prev_reward", False):
+                init_kwargs["prev_rewards"] = jnp.zeros(
+                    (2, 1), jnp.float32
+                )
             self.params = self.model.init(
-                init_rng, dummy_obs[:, None], init_state
+                init_rng, dummy_obs[:, None], init_state, **init_kwargs
             )
         else:
             self.params = self.model.init(init_rng, dummy_obs)
@@ -128,6 +138,25 @@ class JaxPolicy(Policy):
 
         # ---- exploration ----
         self._init_exploration()
+
+        # ---- view requirements (reference view_requirement.py:15) ----
+        # Shifted columns the sampler should populate for this policy.
+        from ray_tpu.policy.policy import ViewRequirement
+
+        mc = self.model_config
+        if mc.get("lstm_use_prev_action") or mc.get("use_prev_action"):
+            self.view_requirements[SampleBatch.PREV_ACTIONS] = (
+                ViewRequirement(
+                    data_col=SampleBatch.ACTIONS, shift=-1,
+                    space=action_space,
+                )
+            )
+        if mc.get("lstm_use_prev_reward") or mc.get("use_prev_reward"):
+            self.view_requirements[SampleBatch.PREV_REWARDS] = (
+                ViewRequirement(
+                    data_col=SampleBatch.REWARDS, shift=-1
+                )
+            )
 
     # -- subclass hooks --------------------------------------------------
 
@@ -193,13 +222,27 @@ class JaxPolicy(Policy):
         dtype = self.observation_space.dtype
         return jnp.zeros((batch,) + tuple(shape), dtype)
 
-    def model_forward(self, params, obs, state=(), resets=None):
+    def model_forward(
+        self,
+        params,
+        obs,
+        state=(),
+        resets=None,
+        prev_actions=None,
+        prev_rewards=None,
+    ):
         """Uniform forward: handles recurrent (B, T) vs flat (B,) models.
-        Returns (dist_inputs, value, state_out) flattened over (B*T,)."""
+        Returns (dist_inputs, value, state_out) flattened over (B*T,).
+        prev_actions/prev_rewards feed recurrent models configured with
+        lstm_use_prev_action/_reward (view-requirement columns)."""
         if self.model.is_recurrent:
             kwargs = {}
             if resets is not None:
                 kwargs["resets"] = resets
+            if prev_actions is not None:
+                kwargs["prev_actions"] = prev_actions
+            if prev_rewards is not None:
+                kwargs["prev_rewards"] = prev_rewards
             return self.model.apply(params, obs, state, **kwargs)
         return self.model.apply(params, obs)
 
@@ -212,12 +255,26 @@ class JaxPolicy(Policy):
         model = self.model
         dist_class = self.dist_class
         recurrent = model.is_recurrent
+        use_prev_a = recurrent and getattr(
+            model, "use_prev_action", False
+        )
+        use_prev_r = recurrent and getattr(
+            model, "use_prev_reward", False
+        )
         exploration = self.exploration
 
-        def fn(params, obs, states, rng, explore, coeffs, expl_state):
+        def fn(
+            params, obs, states, rng, explore, coeffs, expl_state,
+            prev_a, prev_r,
+        ):
             if recurrent:
+                kwargs = {}
+                if use_prev_a:
+                    kwargs["prev_actions"] = prev_a[:, None]
+                if use_prev_r:
+                    kwargs["prev_rewards"] = prev_r[:, None]
                 dist_inputs, value, state_out = model.apply(
-                    params, obs[:, None], states
+                    params, obs[:, None], states, **kwargs
                 )
             else:
                 dist_inputs, value, state_out = model.apply(params, obs)
@@ -260,9 +317,24 @@ class JaxPolicy(Policy):
         if self._expl_state_batch != bsize:
             self._expl_state = self.exploration.initial_state(bsize)
             self._expl_state_batch = bsize
+        # prev-action/reward inputs for recurrent models that want them
+        # (zeros at episode starts / when the caller passes nothing)
+        if prev_action_batch is not None:
+            prev_a = jnp.asarray(prev_action_batch)
+        else:
+            prev_a = jnp.zeros(
+                (bsize,) + tuple(self.action_space.shape), jnp.float32
+            ) if self.action_space.shape else jnp.zeros(
+                (bsize,), jnp.int32
+            )
+        prev_r = (
+            jnp.asarray(prev_reward_batch, jnp.float32)
+            if prev_reward_batch is not None
+            else jnp.zeros((bsize,), jnp.float32)
+        )
         actions, state_out, extra, self._expl_state = self._action_fn(
             params, obs, states, rng, bool(explore),
-            self._coeff_array(), self._expl_state,
+            self._coeff_array(), self._expl_state, prev_a, prev_r,
         )
         return (
             np.asarray(actions),

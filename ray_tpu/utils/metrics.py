@@ -1,0 +1,122 @@
+"""User-defined metrics: Counter / Gauge / Histogram.
+
+Counterpart of the reference's ``python/ray/util/metrics.py``
+(``Counter :155``, ``Histogram :220``, ``Gauge :288``) and the
+OpenCensus→Prometheus export chain (``src/ray/stats/metric.h:102``,
+``_private/metrics_agent.py:63``), collapsed to a process-local
+registry + a Prometheus-text endpoint (ray_tpu.utils.metrics_exporter).
+Tag-based metric series are supported via tag dicts."""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+_REGISTRY_LOCK = threading.Lock()
+_REGISTRY: Dict[str, "Metric"] = {}
+
+
+def _tag_key(tags: Optional[Dict[str, str]]) -> Tuple:
+    return tuple(sorted((tags or {}).items()))
+
+
+class Metric:
+    kind = "untyped"
+
+    def __init__(
+        self,
+        name: str,
+        description: str = "",
+        tag_keys: Optional[Sequence[str]] = None,
+    ):
+        self.name = name
+        self.description = description
+        self.tag_keys = tuple(tag_keys or ())
+        self._lock = threading.Lock()
+        self._series: Dict[Tuple, float] = {}
+        with _REGISTRY_LOCK:
+            _REGISTRY[name] = self
+
+    def series(self) -> List[Tuple[Tuple, float]]:
+        with self._lock:
+            return list(self._series.items())
+
+
+class Counter(Metric):
+    """Monotonic counter (reference metrics.py:155)."""
+
+    kind = "counter"
+
+    def inc(self, value: float = 1.0, tags: Optional[Dict] = None):
+        if value < 0:
+            raise ValueError("counters only increase")
+        k = _tag_key(tags)
+        with self._lock:
+            self._series[k] = self._series.get(k, 0.0) + value
+
+
+class Gauge(Metric):
+    """Point-in-time value (reference metrics.py:288)."""
+
+    kind = "gauge"
+
+    def set(self, value: float, tags: Optional[Dict] = None):
+        with self._lock:
+            self._series[_tag_key(tags)] = float(value)
+
+
+class Histogram(Metric):
+    """Bucketed observations (reference metrics.py:220)."""
+
+    kind = "histogram"
+
+    def __init__(
+        self,
+        name: str,
+        description: str = "",
+        boundaries: Optional[Sequence[float]] = None,
+        tag_keys: Optional[Sequence[str]] = None,
+    ):
+        super().__init__(name, description, tag_keys)
+        self.boundaries = list(
+            boundaries or (0.005, 0.05, 0.5, 5.0, 50.0)
+        )
+        self._buckets: Dict[Tuple, List[float]] = {}
+        self._sums: Dict[Tuple, float] = {}
+        self._counts: Dict[Tuple, int] = {}
+
+    def observe(self, value: float, tags: Optional[Dict] = None):
+        k = _tag_key(tags)
+        with self._lock:
+            counts = self._buckets.setdefault(
+                k, [0.0] * (len(self.boundaries) + 1)
+            )
+            import bisect
+
+            counts[bisect.bisect_left(self.boundaries, value)] += 1
+            self._sums[k] = self._sums.get(k, 0.0) + float(value)
+            self._counts[k] = self._counts.get(k, 0) + 1
+
+    def series(self):
+        with self._lock:
+            return [
+                (
+                    k,
+                    {
+                        "buckets": list(self._buckets.get(k, [])),
+                        "sum": self._sums.get(k, 0.0),
+                        "count": self._counts.get(k, 0),
+                    },
+                )
+                for k in self._counts
+            ]
+
+
+def all_metrics() -> List[Metric]:
+    with _REGISTRY_LOCK:
+        return list(_REGISTRY.values())
+
+
+def clear_registry() -> None:
+    with _REGISTRY_LOCK:
+        _REGISTRY.clear()

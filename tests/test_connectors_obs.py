@@ -1,0 +1,228 @@
+"""Connector pipelines, view-requirement columns, metrics export,
+dashboard-lite, IMPALA tree aggregation (reference
+rllib/connectors/tests, rllib/policy/tests/test_view_requirement*,
+python/ray/tests/test_metrics_agent.py, dashboard tests)."""
+
+import json
+import time
+import urllib.request
+
+import gymnasium as gym
+import numpy as np
+
+import ray_tpu as ray
+from ray_tpu.connectors import (
+    ClipActionsConnector,
+    ClipRewardConnector,
+    ConnectorContext,
+    ConnectorPipeline,
+    FlattenObsConnector,
+    MeanStdFilterConnector,
+)
+from ray_tpu.connectors.connector import restore_connector
+
+
+def test_connector_pipeline_and_serialization():
+    ctx = ConnectorContext(
+        observation_space=gym.spaces.Box(-1, 1, (4,), np.float32),
+        action_space=gym.spaces.Box(-2, 2, (2,), np.float32),
+    )
+    pipe = ConnectorPipeline(
+        ctx,
+        [
+            FlattenObsConnector(ctx),
+            MeanStdFilterConnector(ctx, shape=(4,)),
+        ],
+    )
+    obs = np.random.default_rng(0).standard_normal((8, 2, 2)).astype(
+        np.float32
+    )
+    out = pipe(obs)
+    assert out.shape == (8, 4)
+    # serialization round trip preserves structure
+    cfg = pipe.to_config()
+    rebuilt = restore_connector(ctx, cfg)
+    assert type(rebuilt).__name__ == "ConnectorPipeline"
+    assert [type(c).__name__ for c in rebuilt.connectors] == [
+        "FlattenObsConnector",
+        "MeanStdFilterConnector",
+    ]
+    # eval mode freezes filter stats
+    pipe.in_training(False)
+    n_before = pipe.connectors[1].filter.rs.n
+    pipe(obs)
+    assert pipe.connectors[1].filter.rs.n == n_before
+
+
+def test_clip_connectors():
+    ctx = ConnectorContext(
+        action_space=gym.spaces.Box(-1.0, 1.0, (2,), np.float32)
+    )
+    clip = ClipActionsConnector(ctx)
+    out = clip(np.array([[5.0, -3.0], [0.5, 0.2]], np.float32))
+    assert out.max() <= 1.0 and out.min() >= -1.0
+    cr = ClipRewardConnector(ctx, sign=True)
+    np.testing.assert_array_equal(
+        cr(np.array([3.0, -0.2, 0.0])), [1.0, -1.0, 0.0]
+    )
+
+
+def test_view_requirements_prev_columns():
+    from ray_tpu.algorithms.ppo import PPOConfig
+
+    algo = (
+        PPOConfig()
+        .environment("CartPole-v1")
+        .rollouts(num_rollout_workers=0, rollout_fragment_length=16)
+        .training(
+            train_batch_size=32,
+            sgd_minibatch_size=16,
+            model={"use_prev_action": True, "use_prev_reward": True},
+        )
+        .debugging(seed=0)
+        .build()
+    )
+    lw = algo.workers.local_worker()
+    batch = lw.sample()
+    from ray_tpu.data.sample_batch import SampleBatch
+
+    assert SampleBatch.PREV_ACTIONS in batch
+    assert SampleBatch.PREV_REWARDS in batch
+    # shifted by one: prev_action[t] == action[t-1] within an episode
+    eps = np.asarray(batch[SampleBatch.EPS_ID])
+    acts = np.asarray(batch[SampleBatch.ACTIONS])
+    prev = np.asarray(batch[SampleBatch.PREV_ACTIONS])
+    same_ep = eps[1:] == eps[:-1]
+    np.testing.assert_array_equal(
+        prev[1:][same_ep], acts[:-1][same_ep]
+    )
+    algo.cleanup()
+
+
+def test_prev_action_reaches_recurrent_model():
+    """lstm_use_prev_action must actually change the forward pass, not
+    just populate a batch column."""
+    from ray_tpu.algorithms.ppo.ppo import PPOJaxPolicy
+
+    pol = PPOJaxPolicy(
+        gym.spaces.Box(-1, 1, (4,), np.float32),
+        gym.spaces.Discrete(2),
+        {
+            "model": {
+                "use_lstm": True,
+                "lstm_cell_size": 16,
+                "lstm_use_prev_action": True,
+                "lstm_use_prev_reward": True,
+            },
+            "train_batch_size": 8,
+            "seed": 0,
+        },
+    )
+    obs = np.zeros((4, 4), np.float32)
+    state = [np.zeros((4, 16), np.float32) for _ in range(2)]
+    _, _, extra0 = pol.compute_actions(
+        obs, state, explore=False,
+        prev_action_batch=np.zeros(4, np.int64),
+        prev_reward_batch=np.zeros(4, np.float32),
+    )
+    _, _, extra1 = pol.compute_actions(
+        obs, state, explore=False,
+        prev_action_batch=np.ones(4, np.int64),
+        prev_reward_batch=np.full(4, 5.0, np.float32),
+    )
+    from ray_tpu.data.sample_batch import SampleBatch
+
+    assert not np.allclose(
+        extra0[SampleBatch.ACTION_DIST_INPUTS],
+        extra1[SampleBatch.ACTION_DIST_INPUTS],
+    ), "prev action/reward inputs did not reach the model"
+
+
+def test_metrics_prometheus_export():
+    from ray_tpu.utils import metrics as m
+    from ray_tpu.utils.metrics_exporter import (
+        MetricsServer,
+        format_prometheus,
+    )
+
+    m.clear_registry()
+    c = m.Counter("test_requests", "reqs", ("path",))
+    c.inc(2, {"path": "/a"})
+    c.inc(1, {"path": "/b"})
+    g = m.Gauge("test_queue_len", "queue")
+    g.set(7)
+    h = m.Histogram("test_latency", "lat", boundaries=[0.1, 1.0])
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(5.0)
+    text = format_prometheus()
+    assert 'test_requests{path="/a"} 2.0' in text
+    assert "test_queue_len 7.0" in text
+    assert "test_latency_count 3" in text
+    assert "test_latency_sum" in text
+
+    server = MetricsServer()
+    blob = urllib.request.urlopen(
+        f"http://127.0.0.1:{server.port}/metrics", timeout=10
+    ).read()
+    assert b"test_queue_len" in blob
+    server.shutdown()
+    m.clear_registry()
+
+
+def test_dashboard_lite_endpoints():
+    from ray_tpu.dashboard import DashboardLite, publish_result
+
+    ray.init(num_cpus=1, ignore_reinit_error=True)
+
+    @ray.remote
+    def f():
+        return 1
+
+    ray.get(f.remote())
+    publish_result(
+        {"training_iteration": 3, "episode_reward_mean": 42.0}
+    )
+    dash = DashboardLite()
+    cluster = json.loads(
+        urllib.request.urlopen(
+            f"{dash.url}/api/cluster", timeout=10
+        ).read()
+    )
+    assert cluster["initialized"]
+    assert len(cluster["workers"]) >= 1
+    results = json.loads(
+        urllib.request.urlopen(
+            f"{dash.url}/api/results", timeout=10
+        ).read()
+    )
+    assert any(r.get("training_iteration") == 3 for r in results)
+    index = urllib.request.urlopen(dash.url, timeout=10).read()
+    assert b"dashboard-lite" in index
+    dash.shutdown()
+
+
+def test_impala_tree_aggregation():
+    from ray_tpu.algorithms.impala import IMPALAConfig
+
+    algo = (
+        IMPALAConfig()
+        .environment("CartPole-v1")
+        .rollouts(num_rollout_workers=2, rollout_fragment_length=16)
+        .training(train_batch_size=64, lr=5e-4)
+        .aggregation(num_aggregation_workers=2)
+        .debugging(seed=0)
+        .build()
+    )
+    assert len(algo._aggregators) == 2
+    trained = 0
+    deadline = time.time() + 180
+    while time.time() < deadline:
+        result = algo.train()
+        trained = algo._counters.get("num_env_steps_trained", 0)
+        if trained >= 128:
+            break
+    assert trained >= 128, "learner consumed no aggregated batches"
+    info = result["info"]["learner"].get("default_policy", {})
+    assert np.isfinite(info.get("total_loss", np.nan))
+    algo.cleanup()
