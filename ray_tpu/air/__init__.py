@@ -1,0 +1,4 @@
+from ray_tpu.air.checkpoint import Checkpoint
+from ray_tpu.air import session
+
+__all__ = ["Checkpoint", "session"]

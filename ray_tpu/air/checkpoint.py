@@ -1,0 +1,79 @@
+"""AIR Checkpoint: the shared checkpoint currency across libraries.
+
+Counterpart of the reference's ``python/ray/air/checkpoint.py``: one
+object convertible between dict / directory / bytes forms, passed
+between Train workers, Tune trials, and user code."""
+
+from __future__ import annotations
+
+import os
+import pickle
+import shutil
+import tempfile
+from typing import Any, Dict, Optional
+
+
+class Checkpoint:
+    """reference air/checkpoint.py Checkpoint."""
+
+    def __init__(
+        self,
+        data: Optional[Dict] = None,
+        directory: Optional[str] = None,
+    ):
+        if (data is None) == (directory is None):
+            raise ValueError(
+                "exactly one of data/directory must be given"
+            )
+        self._data = data
+        self._directory = directory
+
+    # -- constructors -----------------------------------------------------
+
+    @classmethod
+    def from_dict(cls, data: Dict) -> "Checkpoint":
+        return cls(data=dict(data))
+
+    @classmethod
+    def from_directory(cls, path: str) -> "Checkpoint":
+        return cls(directory=str(path))
+
+    @classmethod
+    def from_bytes(cls, blob: bytes) -> "Checkpoint":
+        return cls(data=pickle.loads(blob))
+
+    # -- conversions ------------------------------------------------------
+
+    def to_dict(self) -> Dict:
+        if self._data is not None:
+            return dict(self._data)
+        path = os.path.join(self._directory, "checkpoint.pkl")
+        if os.path.exists(path):
+            with open(path, "rb") as f:
+                return pickle.load(f)
+        raise ValueError(
+            f"directory checkpoint {self._directory} has no "
+            "checkpoint.pkl; use to_directory()"
+        )
+
+    def to_directory(self, path: Optional[str] = None) -> str:
+        path = path or tempfile.mkdtemp(prefix="ray_tpu_ckpt_")
+        os.makedirs(path, exist_ok=True)
+        if self._directory is not None:
+            if os.path.abspath(self._directory) != os.path.abspath(path):
+                shutil.copytree(
+                    self._directory, path, dirs_exist_ok=True
+                )
+        else:
+            with open(
+                os.path.join(path, "checkpoint.pkl"), "wb"
+            ) as f:
+                pickle.dump(self._data, f)
+        return path
+
+    def to_bytes(self) -> bytes:
+        return pickle.dumps(self.to_dict())
+
+    def __repr__(self):
+        kind = "dict" if self._data is not None else "directory"
+        return f"Checkpoint({kind})"

@@ -1,0 +1,60 @@
+"""Training session context (reference ``python/ray/air/session.py`` /
+``train/_internal/session.py:261`` session.report): inside a Train
+worker's train_func, ``session.report(metrics, checkpoint=...)``
+streams results to the driver and ``get_world_rank()``/
+``get_world_size()`` expose the worker's place in the group."""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Optional
+
+_CTX = threading.local()
+
+
+class _Session:
+    def __init__(self, rank: int, world_size: int, report_fn):
+        self.rank = rank
+        self.world_size = world_size
+        self.report_fn = report_fn
+        self.last_checkpoint = None
+        self.loaded_checkpoint = None
+
+
+def _init_session(
+    rank: int, world_size: int, report_fn, checkpoint=None
+) -> None:
+    _CTX.session = _Session(rank, world_size, report_fn)
+    _CTX.session.loaded_checkpoint = checkpoint
+
+
+def _get_session() -> Optional[_Session]:
+    return getattr(_CTX, "session", None)
+
+
+def report(metrics: Dict[str, Any], *, checkpoint=None) -> None:
+    """reference session.report :261."""
+    s = _get_session()
+    if s is None:
+        raise RuntimeError(
+            "session.report() called outside a Train worker"
+        )
+    if checkpoint is not None:
+        s.last_checkpoint = checkpoint
+    s.report_fn(dict(metrics), checkpoint)
+
+
+def get_world_rank() -> int:
+    s = _get_session()
+    return 0 if s is None else s.rank
+
+
+def get_world_size() -> int:
+    s = _get_session()
+    return 1 if s is None else s.world_size
+
+
+def get_checkpoint():
+    """The checkpoint to resume from (if the Trainer got one)."""
+    s = _get_session()
+    return None if s is None else s.loaded_checkpoint

@@ -1,3 +1,20 @@
-from ray_tpu.data.sample_batch import SampleBatch, MultiAgentBatch, concat_samples
+from ray_tpu.data.dataset import Dataset
+from ray_tpu.data.sample_batch import (
+    MultiAgentBatch,
+    SampleBatch,
+    concat_samples,
+)
 
-__all__ = ["SampleBatch", "MultiAgentBatch", "concat_samples"]
+from_items = Dataset.from_items
+range = Dataset.range  # noqa: A001 — reference ray.data.range
+from_numpy = Dataset.from_numpy
+
+__all__ = [
+    "SampleBatch",
+    "MultiAgentBatch",
+    "concat_samples",
+    "Dataset",
+    "from_items",
+    "range",
+    "from_numpy",
+]

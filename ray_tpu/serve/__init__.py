@@ -1,0 +1,17 @@
+from ray_tpu.serve.serve import (
+    Deployment,
+    DeploymentHandle,
+    deployment,
+    get_deployment,
+    run,
+    shutdown,
+)
+
+__all__ = [
+    "deployment",
+    "Deployment",
+    "DeploymentHandle",
+    "run",
+    "get_deployment",
+    "shutdown",
+]

@@ -1,0 +1,248 @@
+"""serve-lite: model serving over actor replica groups.
+
+Counterpart of the reference's Serve core path — ``@serve.deployment``
+(``serve/deployment.py:34``), controller-managed replica actors
+(``serve/replica.py:218`` handle_request), round-robin routing, and the
+HTTP proxy (``serve/http_proxy.py:190``) — scoped to one host: a
+deployment is a group of replica actors behind a round-robin
+DeploymentHandle, optionally exposed over a stdlib HTTP ingress that
+POSTs JSON to the deployment's __call__."""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable, Dict, List, Optional
+
+import ray_tpu as ray
+
+_DEPLOYMENTS: Dict[str, "RunningDeployment"] = {}
+_HTTP_SERVER = None
+
+
+@ray.remote
+class _Replica:
+    """Hosts one instance of the deployment class (reference
+    replica.py:218)."""
+
+    def __init__(self, cls_or_fn, init_args, init_kwargs):
+        if isinstance(cls_or_fn, type):
+            self._obj = cls_or_fn(*init_args, **(init_kwargs or {}))
+        elif init_args or init_kwargs:
+            # function deployment: bind args become leading call args
+            import functools
+
+            self._obj = functools.partial(
+                cls_or_fn, *init_args, **(init_kwargs or {})
+            )
+        else:
+            self._obj = cls_or_fn
+        self.num_requests = 0
+
+    def handle(self, args, kwargs):
+        self.num_requests += 1
+        return self._obj(*args, **kwargs)
+
+    def call_method(self, method, args, kwargs):
+        self.num_requests += 1
+        return getattr(self._obj, method)(*args, **kwargs)
+
+    def stats(self):
+        return {"num_requests": self.num_requests}
+
+
+class DeploymentHandle:
+    """Round-robin client to a replica group (reference
+    serve/handle.py)."""
+
+    def __init__(self, name: str, replicas: List):
+        self.name = name
+        self._replicas = replicas
+        self._rr = 0
+        self._lock = threading.Lock()
+
+    def _next(self):
+        with self._lock:
+            r = self._replicas[self._rr % len(self._replicas)]
+            self._rr += 1
+        return r
+
+    def remote(self, *args, **kwargs):
+        return self._next().handle.remote(list(args), kwargs)
+
+    def method(self, name: str):
+        handle = self
+
+        class _M:
+            def remote(self, *args, **kwargs):
+                return handle._next().call_method.remote(
+                    name, list(args), kwargs
+                )
+
+        return _M()
+
+
+class RunningDeployment:
+    def __init__(self, name, replicas, handle):
+        self.name = name
+        self.replicas = replicas
+        self.handle = handle
+
+
+class Deployment:
+    """Bound-but-not-running deployment (reference deployment.py:34)."""
+
+    def __init__(
+        self,
+        cls_or_fn,
+        name: str,
+        num_replicas: int = 1,
+        init_args=(),
+        init_kwargs=None,
+    ):
+        self._cls_or_fn = cls_or_fn
+        self.name = name
+        self.num_replicas = num_replicas
+        self._init_args = tuple(init_args)
+        self._init_kwargs = dict(init_kwargs or {})
+
+    def bind(self, *args, **kwargs) -> "Deployment":
+        return Deployment(
+            self._cls_or_fn,
+            self.name,
+            self.num_replicas,
+            args,
+            kwargs,
+        )
+
+    def options(
+        self,
+        num_replicas: Optional[int] = None,
+        name: Optional[str] = None,
+    ) -> "Deployment":
+        return Deployment(
+            self._cls_or_fn,
+            name or self.name,
+            num_replicas or self.num_replicas,
+            self._init_args,
+            self._init_kwargs,
+        )
+
+    def deploy(self) -> DeploymentHandle:
+        ray.init(ignore_reinit_error=True)
+        replicas = [
+            _Replica.remote(
+                self._cls_or_fn, self._init_args, self._init_kwargs
+            )
+            for _ in range(self.num_replicas)
+        ]
+        handle = DeploymentHandle(self.name, replicas)
+        _DEPLOYMENTS[self.name] = RunningDeployment(
+            self.name, replicas, handle
+        )
+        return handle
+
+
+def deployment(
+    _cls=None, *, name: Optional[str] = None, num_replicas: int = 1
+):
+    """reference @serve.deployment decorator."""
+
+    def wrap(cls):
+        return Deployment(cls, name or cls.__name__, num_replicas)
+
+    if _cls is not None:
+        return wrap(_cls)
+    return wrap
+
+
+def run(
+    target: Deployment,
+    *,
+    http_host: Optional[str] = None,
+    http_port: int = 0,
+) -> DeploymentHandle:
+    """Deploy + optionally start the HTTP ingress (reference
+    serve.run + http_proxy.py)."""
+    handle = target.deploy()
+    if http_host is not None:
+        _start_http(http_host, http_port)
+    return handle
+
+
+def get_deployment(name: str) -> DeploymentHandle:
+    return _DEPLOYMENTS[name].handle
+
+
+def _start_http(host: str, port: int):
+    global _HTTP_SERVER
+    if _HTTP_SERVER is not None:
+        bound_host, bound_port = _HTTP_SERVER.server_address[:2]
+        if (host, port) not in (
+            (bound_host, bound_port),
+            (bound_host, 0),
+        ):
+            raise RuntimeError(
+                f"HTTP ingress already bound to {bound_host}:"
+                f"{bound_port}; serve.shutdown() before rebinding to "
+                f"{host}:{port}"
+            )
+        return _HTTP_SERVER
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *args):
+            pass
+
+        def do_POST(self):
+            name = self.path.strip("/")
+            dep = _DEPLOYMENTS.get(name)
+            if dep is None:
+                self.send_response(404)
+                self.end_headers()
+                return
+            length = int(self.headers.get("Content-Length", 0))
+            try:
+                payload = (
+                    json.loads(self.rfile.read(length))
+                    if length
+                    else {}
+                )
+                out = ray.get(dep.handle.remote(payload))
+                blob = json.dumps({"result": out}).encode()
+                self.send_response(200)
+            except Exception as e:
+                blob = json.dumps({"error": repr(e)}).encode()
+                self.send_response(500)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(blob)))
+            self.end_headers()
+            self.wfile.write(blob)
+
+    _HTTP_SERVER = ThreadingHTTPServer((host, port), Handler)
+    _HTTP_SERVER.daemon_threads = True
+    threading.Thread(
+        target=_HTTP_SERVER.serve_forever, daemon=True
+    ).start()
+    return _HTTP_SERVER
+
+
+def http_port() -> Optional[int]:
+    return (
+        _HTTP_SERVER.server_address[1] if _HTTP_SERVER else None
+    )
+
+
+def shutdown() -> None:
+    global _HTTP_SERVER
+    for dep in _DEPLOYMENTS.values():
+        for r in dep.replicas:
+            try:
+                ray.kill(r)
+            except Exception:
+                pass
+    _DEPLOYMENTS.clear()
+    if _HTTP_SERVER is not None:
+        _HTTP_SERVER.shutdown()
+        _HTTP_SERVER.server_close()
+        _HTTP_SERVER = None

@@ -1,0 +1,3 @@
+from ray_tpu.train.trainer import DataParallelTrainer, Trainer
+
+__all__ = ["Trainer", "DataParallelTrainer"]

@@ -1,0 +1,3 @@
+from ray_tpu.workflow.workflow import run, run_async, step
+
+__all__ = ["step", "run", "run_async"]

@@ -1,0 +1,174 @@
+"""L4 library tests: Train, Data, Serve, Workflow, AIR Checkpoint
+(reference python/ray/{train,data,serve,workflow,air}/tests)."""
+
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+import ray_tpu as ray
+from ray_tpu.air import Checkpoint, session
+from ray_tpu.data import Dataset
+from ray_tpu.train import DataParallelTrainer, Trainer
+
+
+def test_checkpoint_dict_dir_roundtrip(tmp_path):
+    ck = Checkpoint.from_dict({"w": [1, 2, 3], "step": 7})
+    d = ck.to_directory(str(tmp_path / "ck"))
+    back = Checkpoint.from_directory(d)
+    assert back.to_dict() == {"w": [1, 2, 3], "step": 7}
+    assert Checkpoint.from_bytes(ck.to_bytes()).to_dict()["step"] == 7
+
+
+def test_trainer_runs_on_worker_group():
+    def train_func(config):
+        for i in range(3):
+            session.report(
+                {
+                    "iter": i,
+                    "rank": session.get_world_rank(),
+                    "world": session.get_world_size(),
+                }
+            )
+        if session.get_world_rank() == 0:
+            session.report(
+                {"final": True},
+                checkpoint=Checkpoint.from_dict({"weights": [1.0]}),
+            )
+        return "done"
+
+    trainer = Trainer(num_workers=2)
+    result = trainer.run(train_func, {"lr": 0.1})
+    assert len(result.metrics_per_worker) == 2
+    ranks = {m[0]["rank"] for m in result.metrics_per_worker}
+    assert ranks == {0, 1}
+    assert all(
+        m[0]["world"] == 2 for m in result.metrics_per_worker
+    )
+    assert result.checkpoint.to_dict() == {"weights": [1.0]}
+    trainer.shutdown()
+
+
+def test_data_parallel_trainer_shards_dataset():
+    ds = Dataset.range(20)
+
+    def train_func(config):
+        rows = config["_dataset_rows"]
+        session.report({"n": len(rows), "total": sum(rows)})
+
+    trainer = DataParallelTrainer(num_workers=2)
+    result = trainer.run(train_func, {}, dataset=ds)
+    ns = [m[-1]["n"] for m in result.metrics_per_worker]
+    assert sum(ns) == 20
+    totals = sum(m[-1]["total"] for m in result.metrics_per_worker)
+    assert totals == sum(range(20))
+    trainer.shutdown()
+
+
+def test_dataset_lazy_transforms_and_consumption():
+    ds = (
+        Dataset.range(100, parallelism=5)
+        .map(lambda x: x * 2)
+        .filter(lambda x: x % 4 == 0)
+    )
+    # lazy: nothing ran yet
+    assert ds._stages
+    out = ds.take_all()
+    assert out == [x * 2 for x in range(100) if (x * 2) % 4 == 0]
+    assert ds.count() == len(out)
+    batches = list(
+        Dataset.range(10).iter_batches(batch_size=4)
+    )
+    assert [len(b) for b in batches] == [4, 4, 2]
+
+
+def test_dataset_shuffle_split_repartition():
+    ds = Dataset.range(50, parallelism=4)
+    shuffled = ds.random_shuffle(seed=0)
+    assert sorted(shuffled.take_all()) == list(range(50))
+    assert shuffled.take_all() != list(range(50))
+    shards = ds.split(3)
+    assert sum(s.count() for s in shards) == 50
+    rp = ds.repartition(10)
+    assert rp.num_blocks() == 10
+    assert rp.sort().take_all() == list(range(50))
+
+
+def test_serve_deployment_and_http():
+    from ray_tpu import serve
+
+    @serve.deployment(num_replicas=2)
+    class Doubler:
+        def __init__(self, offset=0):
+            self.offset = offset
+
+        def __call__(self, payload):
+            return payload["x"] * 2 + self.offset
+
+        def ping(self):
+            return "pong"
+
+    handle = serve.run(
+        Doubler.bind(offset=1), http_host="127.0.0.1"
+    )
+    assert ray.get(handle.remote({"x": 5})) == 11
+    assert ray.get(handle.method("ping").remote()) == "pong"
+    # round robin spreads requests over both replicas
+    for _ in range(4):
+        ray.get(handle.remote({"x": 1}))
+    stats = ray.get(
+        [
+            r.stats.remote()
+            for r in serve.serve._DEPLOYMENTS["Doubler"].replicas
+        ]
+    )
+    assert all(s["num_requests"] >= 2 for s in stats)
+
+    from ray_tpu.serve.serve import http_port
+
+    port = http_port()
+    resp = json.loads(
+        urllib.request.urlopen(
+            urllib.request.Request(
+                f"http://127.0.0.1:{port}/Doubler",
+                data=json.dumps({"x": 3}).encode(),
+                headers={"Content-Type": "application/json"},
+                method="POST",
+            ),
+            timeout=30,
+        ).read()
+    )
+    assert resp["result"] == 7
+    serve.shutdown()
+
+
+def test_workflow_durable_resume(tmp_path):
+    from ray_tpu import workflow
+
+    calls = {"n": 0}
+
+    @workflow.step
+    def add(a, b):
+        calls["n"] += 1
+        return a + b
+
+    @workflow.step
+    def mul(a, b):
+        calls["n"] += 1
+        return a * b
+
+    dag = mul.bind(add.bind(2, 3), add.bind(4, 6))
+    out = workflow.run(
+        dag, workflow_id="wf1", storage=str(tmp_path)
+    )
+    assert out == 50
+    assert calls["n"] == 3
+    # resume: all steps cached, nothing re-executes
+    out2 = workflow.run(
+        dag, workflow_id="wf1", storage=str(tmp_path)
+    )
+    assert out2 == 50
+    assert calls["n"] == 3
+    ex = workflow.run.last_execution
+    assert len(ex.steps_cached) == 3 and not ex.steps_run
