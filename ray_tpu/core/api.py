@@ -58,11 +58,18 @@ class _WorkerHandle:
 
 
 class _TaskRecord:
-    def __init__(self, task_id, msg, retries_left, name):
+    def __init__(self, task_id, msg, retries_left, name,
+                 num_cpus: float = 1.0, resources=None,
+                 placement_group=None, bundle_index: int = -1):
         self.task_id = task_id
         self.msg = msg
         self.retries_left = retries_left
         self.name = name
+        self.num_cpus = float(num_cpus)
+        self.resources = dict(resources or {})
+        self.placement_group = placement_group
+        self.bundle_index = int(bundle_index)
+        self.acquired_bundle = -1  # set at admission
         self.submit_time = time.time()
 
 
@@ -84,9 +91,17 @@ class _Runtime:
     """Global driver state (reference: the global ``Worker`` in
     ``_private/worker.py:397``)."""
 
-    def __init__(self, num_cpus: int, object_store_memory=None):
+    def __init__(self, num_cpus: int, object_store_memory=None,
+                 resources=None):
         self.num_cpus = num_cpus
-        self.store = ObjectStore()
+        # Resource-aware scheduling (reference ClusterResourceScheduler
+        # cluster_resource_scheduler.h:45, fixed-point bookkeeping):
+        # dispatch admits a task only when its CPU + custom-resource
+        # demand fits; placement groups carve out their own pools.
+        self.available_cpus = float(num_cpus)
+        self.total_resources = dict(resources or {})
+        self.available_resources = dict(self.total_resources)
+        self.store = ObjectStore(max_bytes=object_store_memory)
         self.ctx = mp.get_context("spawn")
         self.lock = threading.RLock()
         self.pool: List[_WorkerHandle] = []
@@ -180,6 +195,8 @@ class _Runtime:
             self.store.put_error(task_id, err)
         if rec:
             self._record_event(rec, w)
+            with self.lock:
+                self._release(rec)
         with self.lock:
             if not w.dedicated:
                 w.idle = True
@@ -198,6 +215,8 @@ class _Runtime:
                 w.ring = None
             inflight = list(w.inflight.values())
             w.inflight.clear()
+            for trec in inflight:
+                self._release(trec)
             if not w.dedicated:
                 if w in self.pool:
                     self.pool.remove(w)
@@ -246,6 +265,42 @@ class _Runtime:
             self.pending.append(trec)
         self._dispatch_pending()
 
+    def _fits(self, trec) -> bool:
+        """Lock held: does the task's resource demand fit right now?"""
+        pg = trec.placement_group
+        if pg is not None:
+            return pg._fits(trec.num_cpus, trec.bundle_index)
+        if trec.num_cpus > self.available_cpus + 1e-9:
+            return False
+        for k, v in trec.resources.items():
+            if v > self.available_resources.get(k, 0.0) + 1e-9:
+                return False
+        return True
+
+    def _acquire(self, trec) -> None:
+        pg = trec.placement_group
+        if pg is not None:
+            trec.acquired_bundle = pg._acquire(
+                trec.num_cpus, trec.bundle_index
+            )
+            return
+        self.available_cpus -= trec.num_cpus
+        for k, v in trec.resources.items():
+            self.available_resources[k] = (
+                self.available_resources.get(k, 0.0) - v
+            )
+
+    def _release(self, trec) -> None:
+        pg = trec.placement_group
+        if pg is not None:
+            pg._release(trec.num_cpus, trec.acquired_bundle)
+            return
+        self.available_cpus += trec.num_cpus
+        for k, v in trec.resources.items():
+            self.available_resources[k] = (
+                self.available_resources.get(k, 0.0) + v
+            )
+
     def _dispatch_pending(self):
         while True:
             with self.lock:
@@ -261,7 +316,17 @@ class _Runtime:
                     self.pool.append(w)
                 if w is None:
                     return
-                trec = self.pending.popleft()
+                # FIFO with skip: the first pending task whose resource
+                # demand fits (reference cluster_task_manager queueing)
+                trec = None
+                for i, cand_t in enumerate(self.pending):
+                    if self._fits(cand_t):
+                        trec = cand_t
+                        del self.pending[i]
+                        break
+                if trec is None:
+                    return
+                self._acquire(trec)
                 w.idle = False
                 w.inflight[trec.task_id] = trec
             self._send_task(w, trec)
@@ -329,6 +394,16 @@ class _Runtime:
             ]
             self._register_split(task_id, refs)
 
+        pg = None
+        bundle_index = -1
+        strategy = options.get("scheduling_strategy")
+        if strategy is not None and hasattr(
+            strategy, "placement_group"
+        ):
+            pg = strategy.placement_group
+            bundle_index = getattr(
+                strategy, "placement_group_bundle_index", -1
+            )
         trec = _TaskRecord(
             task_id,
             {
@@ -341,6 +416,13 @@ class _Runtime:
             },
             retries_left=options.get("max_retries", 3),
             name=name,
+            num_cpus=(
+                1 if options.get("num_cpus") is None
+                else options["num_cpus"]
+            ),
+            resources=options.get("resources"),
+            placement_group=pg,
+            bundle_index=bundle_index,
         )
         self._submit_when_ready(trec, args, kwargs)
         return refs
@@ -454,6 +536,9 @@ class _Runtime:
             },
             retries_left=0,
             name=f"{method}",
+            # actor calls run on the actor's dedicated process: they
+            # neither acquire nor release scheduler CPUs
+            num_cpus=0,
         )
         w = rec.worker
         with self.lock:
@@ -531,7 +616,8 @@ def init(
             "ray_tpu.init() called twice; pass ignore_reinit_error=True"
         )
     n = num_cpus if num_cpus is not None else max(4, os.cpu_count() or 1)
-    _runtime = _Runtime(n, object_store_memory)
+    resources = kwargs.get("resources")
+    _runtime = _Runtime(n, object_store_memory, resources=resources)
     if worker_env:
         _runtime._worker_env.update(worker_env)
     return {"address": "local", "num_cpus": n}
@@ -792,13 +878,15 @@ def get_runtime_context() -> RuntimeContext:
 def available_resources() -> Dict[str, float]:
     rt = _require_runtime()
     with rt.lock:
-        used = sum(1 for w in rt.pool if not w.idle)
-    return {"CPU": float(rt.num_cpus - used)}
+        out = {"CPU": float(rt.available_cpus)}
+        out.update(rt.available_resources)
+    return out
 
 
 def cluster_resources() -> Dict[str, float]:
     rt = _require_runtime()
     res = {"CPU": float(rt.num_cpus)}
+    res.update(rt.total_resources)
     try:
         import jax
 

@@ -7,16 +7,21 @@ scoped to one host. Objects above ``SHM_THRESHOLD`` are serialized into a
 POSIX shared-memory segment so any worker process on the node can map them
 zero-copy; small objects travel inline over the control pipes.
 
-Disposition vs the reference (SURVEY §2.1): distributed refcounting /
-spilling / lineage reconstruction are host-scoped here — a put object lives
-until ``free()`` or driver shutdown; cross-host transfer belongs to the
-(future) DCN object transport, not this file.
+Disposition vs the reference (SURVEY §2.1): distributed refcounting and
+lineage reconstruction are host-scoped here — a put object lives until
+``free()``, eviction, or driver shutdown; cross-host transfer belongs to
+the DCN layer (ray_tpu.parallel.distributed), not this file. Spilling
+(reference ``_private/external_storage.py:71`` + plasma eviction
+``plasma/eviction_policy.h``): when resident shm exceeds
+``object_store_memory``, least-recently-used unspilled entries move
+their serialized bytes to disk and are restored transparently on access.
 """
 
 from __future__ import annotations
 
 import os
 import threading
+import time
 import uuid
 from multiprocessing import shared_memory
 from typing import Any, Dict, Optional
@@ -72,7 +77,15 @@ class ObjectRef:
 
 
 class _Entry:
-    __slots__ = ("value", "shm", "event", "error", "callbacks")
+    __slots__ = (
+        "value",
+        "shm",
+        "event",
+        "error",
+        "callbacks",
+        "spill_path",
+        "_restore_buf",
+    )
 
     def __init__(self):
         self.value = None
@@ -80,6 +93,8 @@ class _Entry:
         self.event = threading.Event()
         self.error: Optional[BaseException] = None
         self.callbacks = []
+        self.spill_path: Optional[str] = None
+        self._restore_buf = None
 
     def fire(self):
         self.event.set()
@@ -91,9 +106,73 @@ class _Entry:
 class ObjectStore:
     """Driver-side object table. Thread-safe."""
 
-    def __init__(self):
+    def __init__(self, max_bytes: Optional[int] = None):
         self._lock = threading.Lock()
         self._entries: Dict[str, _Entry] = {}
+        self.max_bytes = max_bytes  # None → never spill
+        self._resident_bytes = 0
+        self._lru: Dict[str, float] = {}  # obj_id -> last access
+        self._spill_dir = None
+
+    def _spill_path(self, obj_id: str) -> str:
+        import tempfile
+
+        if self._spill_dir is None:
+            self._spill_dir = tempfile.mkdtemp(
+                prefix="ray_tpu_spill_"
+            )
+        return os.path.join(self._spill_dir, f"{obj_id}.bin")
+
+    def _track_shm(self, obj_id: str, e: _Entry) -> None:
+        """Lock held: account a new shm-resident entry, spilling LRU
+        entries if over budget."""
+        self._resident_bytes += e.shm.size
+        self._lru[obj_id] = time.monotonic()
+        if self.max_bytes is None:
+            return
+        while self._resident_bytes > self.max_bytes:
+            victim = None
+            for oid in sorted(self._lru, key=self._lru.get):
+                cand = self._entries.get(oid)
+                if cand is not None and cand.shm is not None and (
+                    oid != obj_id
+                ):
+                    victim = (oid, cand)
+                    break
+            if victim is None:
+                return  # nothing else evictable
+            self._spill_entry(*victim)
+
+    def _spill_entry(self, obj_id: str, e: _Entry) -> None:
+        """Lock held: move the serialized bytes to disk and release the
+        shm segment. User-held zero-copy views stay valid (the mapping
+        lives until they are GC'd); OUR references are dropped."""
+        path = self._spill_path(obj_id)
+        with open(path, "wb") as f:
+            f.write(bytes(e.shm.buf))
+        self._resident_bytes -= e.shm.size
+        self._lru.pop(obj_id, None)
+        e.spill_path = path
+        e.value = None
+        try:
+            e.shm.unlink()
+        except FileNotFoundError:
+            pass
+        try:
+            e.shm.close()
+        except BufferError:
+            pass  # live views; mapping reclaimed at their GC
+        e.shm = None
+
+    def _maybe_restore(self, e: _Entry) -> None:
+        """Lock held: bring a spilled entry back (reference
+        external_storage restore path)."""
+        if e.spill_path is None or e.value is not None:
+            return
+        with open(e.spill_path, "rb") as f:
+            blob = f.read()
+        e.value = ser.read_from_buffer(memoryview(blob))
+        e._restore_buf = blob  # keep the backing bytes alive
 
     def _entry(self, obj_id: str) -> _Entry:
         with self._lock:
@@ -117,6 +196,8 @@ class ObjectStore:
                 ser.write_to_buffer(shm.buf, meta, buffers)
                 e.shm = shm
                 shm_name = shm.name
+                with self._lock:
+                    self._track_shm(obj_id, e)
         e.value = value
         e.fire()
         return shm_name
@@ -132,6 +213,8 @@ class ObjectStore:
         shm = Segment(name=shm_name)
         e.shm = shm
         e.value = ser.read_from_buffer(shm.buf)
+        with self._lock:
+            self._track_shm(obj_id, e)
         e.fire()
 
     def is_ready(self, obj_id: str) -> bool:
@@ -146,7 +229,12 @@ class ObjectStore:
             raise GetTimeoutError(f"Timed out getting object {obj_id}")
         if e.error is not None:
             raise e.error
-        return e.value
+        with self._lock:
+            if e.spill_path is not None and e.value is None:
+                self._maybe_restore(e)
+            if obj_id in self._lru:
+                self._lru[obj_id] = time.monotonic()
+            return e.value
 
     def on_ready(self, obj_id: str, callback) -> None:
         """Run callback when the object becomes available (or immediately)."""
@@ -180,7 +268,15 @@ class ObjectStore:
         with self._lock:
             for oid in obj_ids:
                 e = self._entries.pop(oid, None)
+                if e is not None and e.spill_path is not None:
+                    try:
+                        os.remove(e.spill_path)
+                    except FileNotFoundError:
+                        pass
+                    e.spill_path = None
                 if e and e.shm:
+                    self._resident_bytes -= e.shm.size
+                    self._lru.pop(oid, None)
                     e.value = None  # drop zero-copy views first
                     try:
                         e.shm.unlink()

@@ -1,0 +1,13 @@
+from ray_tpu.util.placement_group import (
+    PlacementGroup,
+    PlacementGroupSchedulingStrategy,
+    placement_group,
+    remove_placement_group,
+)
+
+__all__ = [
+    "placement_group",
+    "remove_placement_group",
+    "PlacementGroup",
+    "PlacementGroupSchedulingStrategy",
+]

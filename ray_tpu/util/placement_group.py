@@ -1,0 +1,184 @@
+"""Placement groups: gang resource reservation.
+
+Counterpart of the reference's ``python/ray/util/placement_group.py:32``
+(PlacementGroup, ``placement_group() :126``) and the raylet-side 2PC
+bundle reservation (``raylet/placement_group_resource_manager.h``),
+scoped to the single-host runtime: a group atomically reserves its
+bundles' CPUs out of the scheduler pool; tasks/actors submitted with
+``PlacementGroupSchedulingStrategy`` draw admission from the group's
+reservation instead of the global pool. On a TPU pod the accelerator
+side of gang placement is the jax mesh itself (devices are co-scheduled
+by construction); this covers the CPU-fleet side."""
+
+from __future__ import annotations
+
+import threading
+import time
+import uuid
+from typing import Dict, List, Optional
+
+
+class PlacementGroup:
+    """reference placement_group.py:32."""
+
+    def __init__(self, bundles: List[Dict[str, float]], strategy: str,
+                 name: str = ""):
+        self.id = uuid.uuid4().hex[:16]
+        self.bundles = [dict(b) for b in bundles]
+        self.strategy = strategy
+        self.name = name
+        self._lock = threading.Lock()
+        self._reserved = False
+        self._removed = False
+        self._ready_event = threading.Event()
+        # per-bundle used CPUs (admission control inside the group)
+        self._bundle_used = [0.0] * len(bundles)
+
+    @property
+    def bundle_count(self) -> int:
+        return len(self.bundles)
+
+    def total_cpus(self) -> float:
+        return float(sum(b.get("CPU", 0.0) for b in self.bundles))
+
+    # -- reservation against the runtime ----------------------------------
+
+    def _try_reserve(self, rt) -> bool:
+        with rt.lock:
+            need = self.total_cpus()
+            if need > rt.available_cpus + 1e-9:
+                return False
+            rt.available_cpus -= need
+        with self._lock:
+            self._reserved = True
+        self._ready_event.set()
+        # tasks queued against this group may now be admissible
+        rt._dispatch_pending()
+        return True
+
+    def ready(self, timeout: Optional[float] = None) -> bool:
+        """Block until the bundles are reserved (reference pg.ready()).
+        Retries as capacity frees up."""
+        from ray_tpu.core.api import _require_runtime
+
+        rt = _require_runtime()
+        deadline = (
+            None if timeout is None else time.monotonic() + timeout
+        )
+        while not self._ready_event.is_set():
+            if self._removed:
+                return False
+            if self._try_reserve(rt):
+                break
+            if (
+                deadline is not None
+                and time.monotonic() >= deadline
+            ):
+                return False
+            time.sleep(0.01)
+        return True
+
+    def wait(self, timeout_seconds: float = 30.0) -> bool:
+        return self.ready(timeout=timeout_seconds)
+
+    # -- admission for member tasks (runtime lock held) -------------------
+
+    def _fits(self, num_cpus: float, bundle_index: int = -1) -> bool:
+        if not self._reserved or self._removed:
+            return False
+        with self._lock:
+            if bundle_index >= 0:
+                cap = self.bundles[bundle_index].get("CPU", 0.0)
+                return (
+                    self._bundle_used[bundle_index] + num_cpus
+                    <= cap + 1e-9
+                )
+            for i, b in enumerate(self.bundles):
+                if (
+                    self._bundle_used[i] + num_cpus
+                    <= b.get("CPU", 0.0) + 1e-9
+                ):
+                    return True
+            return False
+
+    def _acquire(self, num_cpus: float, bundle_index: int = -1) -> int:
+        """→ the bundle index actually charged (the admission record
+        releases exactly this bundle later)."""
+        with self._lock:
+            if bundle_index < 0:
+                for i, b in enumerate(self.bundles):
+                    if (
+                        self._bundle_used[i] + num_cpus
+                        <= b.get("CPU", 0.0) + 1e-9
+                    ):
+                        bundle_index = i
+                        break
+            self._bundle_used[bundle_index] += num_cpus
+            return bundle_index
+
+    def _release(self, num_cpus: float, bundle_index: int) -> None:
+        with self._lock:
+            if 0 <= bundle_index < len(self._bundle_used):
+                self._bundle_used[bundle_index] = max(
+                    0.0, self._bundle_used[bundle_index] - num_cpus
+                )
+
+    def remove(self) -> None:
+        from ray_tpu.core.api import _require_runtime
+
+        if self._removed:
+            return
+        self._removed = True
+        if self._reserved:
+            rt = _require_runtime()
+            with rt.lock:
+                rt.available_cpus += self.total_cpus()
+            self._reserved = False
+        _GROUPS.pop(self.id, None)
+
+    def __repr__(self):
+        return (
+            f"PlacementGroup(id={self.id[:8]}, "
+            f"bundles={self.bundles}, reserved={self._reserved})"
+        )
+
+
+class PlacementGroupSchedulingStrategy:
+    """reference util/scheduling_strategies.py:44."""
+
+    def __init__(
+        self,
+        placement_group: PlacementGroup,
+        placement_group_bundle_index: int = -1,
+        placement_group_capture_child_tasks: bool = False,
+    ):
+        self.placement_group = placement_group
+        self.placement_group_bundle_index = (
+            placement_group_bundle_index
+        )
+        self.placement_group_capture_child_tasks = (
+            placement_group_capture_child_tasks
+        )
+
+
+_GROUPS: Dict[str, PlacementGroup] = {}
+
+
+def placement_group(
+    bundles: List[Dict[str, float]],
+    strategy: str = "PACK",
+    name: str = "",
+) -> PlacementGroup:
+    """reference placement_group() :126. Reservation is attempted
+    immediately; pg.ready() blocks until it succeeds."""
+    pg = PlacementGroup(bundles, strategy, name)
+    _GROUPS[pg.id] = pg
+    from ray_tpu.core.api import _require_runtime
+
+    pg._try_reserve(_require_runtime())
+    return pg
+
+
+def remove_placement_group(pg: PlacementGroup) -> None:
+    """reference remove_placement_group."""
+    pg.remove()
