@@ -1,0 +1,3 @@
+from ray_tpu.algorithms.ddppo.ddppo import DDPPO, DDPPOConfig
+
+__all__ = ["DDPPO", "DDPPOConfig"]

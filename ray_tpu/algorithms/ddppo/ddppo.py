@@ -1,0 +1,139 @@
+"""DD-PPO: decentralized distributed PPO.
+
+Counterpart of the reference's ``rllib/algorithms/ddppo/ddppo.py:157``
+(Wijmans et al. 2020): every rollout worker both samples AND learns —
+gradients are allreduced among the workers (the reference sets up a
+torch.distributed gloo/nccl group, ``:260-275``; per-worker
+``_sample_and_train_torch_distributed :331``) so no central learner or
+weight broadcast exists; the driver only coordinates and aggregates
+metrics.
+
+TPU-first disposition: on a TPU pod the reference's NCCL allreduce
+among GPU workers IS the jax multi-controller mesh (every host learns,
+gradient pmean over ICI/DCN — see tests/_multihost_worker.py for that
+path). This module supplies the CPU-fleet analog over the actor group:
+each decentralized SGD epoch computes one gradient per worker over its
+locally held (GAE-postprocessed, advantage-standardized) batch, the
+driver allreduces (mean) and pushes the update back — the
+driver-as-root gloo topology of parallel/collectives.HostGroup."""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import numpy as np
+
+import ray_tpu as ray
+from ray_tpu.algorithms.algorithm import (
+    NUM_AGENT_STEPS_SAMPLED,
+    NUM_ENV_STEPS_SAMPLED,
+)
+from ray_tpu.algorithms.ppo.ppo import PPO, PPOConfig, PPOJaxPolicy
+from ray_tpu.data.sample_batch import DEFAULT_POLICY_ID
+from ray_tpu.execution.train_ops import NUM_ENV_STEPS_TRAINED
+
+import jax
+
+
+class DDPPOConfig(PPOConfig):
+    """reference ddppo.py DDPPOConfig."""
+
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class or DDPPO)
+        self.num_workers = 2
+        self.num_sgd_iter = 10
+        self.sgd_minibatch_size = 0  # whole local batch per epoch
+        self.rollout_fragment_length = 100
+        self.train_batch_size = -1  # per-worker rollout IS the batch
+
+
+class DDPPO(PPO):
+    _default_policy_class = PPOJaxPolicy
+
+    @classmethod
+    def get_default_config(cls) -> DDPPOConfig:
+        return DDPPOConfig(cls)
+
+    def setup(self, config: Dict) -> None:
+        if int(config.get("num_workers", 0)) < 1:
+            raise ValueError(
+                "DDPPO is decentralized: it requires num_workers >= 1 "
+                "(reference ddppo.py validates the same)"
+            )
+        # every worker learns; fixed train_batch_size is meaningless
+        config["train_batch_size"] = -1
+        super().setup(config)
+
+    def training_step(self) -> Dict:
+        """reference ddppo.py:283 training_step."""
+        workers = self.workers.remote_workers()
+        num_sgd_iter = int(self.config.get("num_sgd_iter", 10))
+
+        # 1. every worker samples + postprocesses + holds its batch
+        steps = ray.get(
+            [w.sample_and_hold.remote() for w in workers]
+        )
+        total = int(sum(steps))
+        self._counters[NUM_ENV_STEPS_SAMPLED] += total
+        self._counters[NUM_AGENT_STEPS_SAMPLED] += total
+
+        # 2. decentralized SGD: per epoch, one gradient per worker over
+        # its local batch, mean-allreduced and applied everywhere
+        stats_last: Dict = {}
+        for _ in range(num_sgd_iter):
+            outs = ray.get(
+                [w.grads_on_held_batch.remote() for w in workers]
+            )
+            grads_list = [g for g, _ in outs]
+            stats_last = {
+                k: float(
+                    np.mean([s.get(k, np.nan) for _, s in outs])
+                )
+                for k in outs[0][1]
+            }
+            leaves = [
+                jax.tree_util.tree_leaves(g) for g in grads_list
+            ]
+            treedef = jax.tree_util.tree_structure(grads_list[0])
+            mean_leaves = [
+                np.mean([l[i] for l in leaves], axis=0)
+                for i in range(len(leaves[0]))
+            ]
+            mean_grads = jax.tree_util.tree_unflatten(
+                treedef, mean_leaves
+            )
+            gref = ray.put(mean_grads)
+            ray.get(
+                [w.apply_gradients.remote(gref) for w in workers]
+            )
+            ray.free([gref])
+        self._counters[NUM_ENV_STEPS_TRAINED] += total
+
+        # 3. advance worker-side schedules (lr/entropy/exploration read
+        # global_timestep) and merge observation-filter stats — the
+        # jobs PPO's sync_weights/sync_filters do centrally
+        global_vars = {
+            "timestep": self._counters[NUM_ENV_STEPS_SAMPLED]
+        }
+        ray.get(
+            [
+                w.set_global_vars.remote(global_vars)
+                for w in workers
+            ]
+        )
+        if self.config.get("observation_filter") not in (
+            None,
+            "NoFilter",
+        ):
+            self.workers.sync_filters()
+
+        # 4. keep the (checkpointing/evaluating) local worker in sync
+        # with the decentralized fleet — ALWAYS: a stale local worker
+        # would also be re-broadcast by recreate_failed_workers after a
+        # crash, resetting the whole fleet to init weights
+        wref = workers[0].get_weights.remote()
+        weights = ray.get(wref)
+        ray.free([wref])
+        self.workers.local_worker().set_weights(weights)
+        self.workers.local_worker().set_global_vars(global_vars)
+        return {DEFAULT_POLICY_ID: stats_last}

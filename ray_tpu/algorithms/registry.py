@@ -11,6 +11,7 @@ _ALGORITHMS: Dict[str, Callable] = {}
 _BUILTINS: Dict[str, Tuple[str, str]] = {
     "PPO": ("ray_tpu.algorithms.ppo.ppo", "PPO"),
     "APPO": ("ray_tpu.algorithms.appo.appo", "APPO"),
+    "DDPPO": ("ray_tpu.algorithms.ddppo.ddppo", "DDPPO"),
     "IMPALA": ("ray_tpu.algorithms.impala.impala", "IMPALA"),
     "SAC": ("ray_tpu.algorithms.sac.sac", "SAC"),
     "DQN": ("ray_tpu.algorithms.dqn.dqn", "DQN"),

@@ -1,0 +1,3 @@
+from ray_tpu.autoscaler.autoscaler import StandardAutoscaler
+
+__all__ = ["StandardAutoscaler"]

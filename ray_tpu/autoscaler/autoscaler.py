@@ -1,0 +1,99 @@
+"""Autoscaler-lite: demand-driven worker-pool scaling.
+
+Counterpart of the reference's ``autoscaler/_private/autoscaler.py:145``
+(StandardAutoscaler) + ``monitor.py:125`` + the resource-demand
+scheduler (``resource_demand_scheduler.py:46``), collapsed to the
+single-host runtime: the "cloud nodes" are worker processes. Upscaling
+on demand already lives in the runtime's dispatch path (a pending task
+with no idle worker spawns one, up to the CPU cap — the node-provider
+role); this monitor owns the OTHER direction of the reference loop:
+reaping workers idle longer than ``idle_timeout_s`` down to
+``min_workers``, plus utilization stats.
+
+On a real TPU cluster the accelerator fleet is statically provisioned
+(pod slices); this scales the CPU rollout fleet around it."""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Optional
+
+
+class StandardAutoscaler:
+    def __init__(
+        self,
+        min_workers: int = 0,
+        max_workers: Optional[int] = None,
+        idle_timeout_s: float = 30.0,
+        update_interval_s: float = 1.0,
+    ):
+        from ray_tpu.core.api import _require_runtime
+
+        self.rt = _require_runtime()
+        self.min_workers = int(min_workers)
+        self.max_workers = int(
+            max_workers
+            if max_workers is not None
+            else self.rt.num_cpus
+        )
+        self.idle_timeout_s = float(idle_timeout_s)
+        self.update_interval_s = float(update_interval_s)
+        self._idle_since: Dict[str, float] = {}
+        self._stop = threading.Event()
+        self.num_downscales = 0
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="autoscaler"
+        )
+        self._thread.start()
+
+    # -- the monitor loop (reference monitor.py:125) ----------------------
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.update_interval_s):
+            try:
+                self.update()
+            except Exception:
+                pass
+
+    def update(self) -> None:
+        """One reconcile pass: reap long-idle workers (upscaling is the
+        dispatch path's job — one owner per direction)."""
+        rt = self.rt
+        now = time.monotonic()
+        with rt.lock:
+            backlog = len(rt.pending)
+        # ---- downscale: reap long-idle workers ----
+        with rt.lock:
+            for w in list(rt.pool):
+                if w.dead or not w.idle:
+                    self._idle_since.pop(w.worker_id, None)
+                    continue
+                t0 = self._idle_since.setdefault(
+                    w.worker_id, now
+                )
+                if (
+                    now - t0 >= self.idle_timeout_s
+                    and len(rt.pool) > self.min_workers
+                    and backlog == 0
+                ):
+                    rt.pool.remove(w)
+                    self._idle_since.pop(w.worker_id, None)
+                    self.num_downscales += 1
+                    try:
+                        with w.send_lock:
+                            w.conn.send({"type": "shutdown"})
+                    except Exception:
+                        pass
+
+    def stats(self) -> Dict:
+        with self.rt.lock:
+            return {
+                "num_workers": len(self.rt.pool),
+                "pending_tasks": len(self.rt.pending),
+                "num_downscales": self.num_downscales,
+            }
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=5.0)

@@ -604,6 +604,7 @@ def init(
     ignore_reinit_error: bool = False,
     local_mode: bool = False,
     worker_env: Optional[Dict[str, str]] = None,
+    log_dir: Optional[str] = None,
     **kwargs,
 ) -> Dict:
     """Start the local runtime (reference ray.init,
@@ -620,6 +621,8 @@ def init(
     _runtime = _Runtime(n, object_store_memory, resources=resources)
     if worker_env:
         _runtime._worker_env.update(worker_env)
+    if log_dir:
+        _runtime._worker_env.setdefault("RAY_TPU_LOG_DIR", log_dir)
     return {"address": "local", "num_cpus": n}
 
 

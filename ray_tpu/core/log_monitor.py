@@ -1,0 +1,96 @@
+"""Worker log capture + tailing.
+
+Counterpart of the reference's per-process log files in the session dir
+plus ``LogMonitor`` (``_private/log_monitor.py:86``), which tails worker
+logs and pushes new lines to drivers: workers redirect stdout/stderr to
+``<log_dir>/worker-<id>.{out,err}`` (set ``log_dir=...`` in ray.init);
+the driver-side LogMonitor polls the files and forwards new lines to a
+callback (default: print with a worker prefix, the reference's
+log_to_driver behavior)."""
+
+from __future__ import annotations
+
+import glob
+import os
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+
+class LogMonitor:
+    """reference log_monitor.py:86."""
+
+    def __init__(
+        self,
+        log_dir: str,
+        callback: Optional[Callable[[str, str], None]] = None,
+        poll_interval_s: float = 0.25,
+    ):
+        self.log_dir = log_dir
+        self.callback = callback or (
+            lambda worker, line: print(f"({worker}) {line}")
+        )
+        self.poll_interval_s = poll_interval_s
+        self._offsets: Dict[str, int] = {}
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="log_monitor"
+        )
+        self._thread.start()
+
+    def _files(self) -> List[str]:
+        return sorted(
+            glob.glob(os.path.join(self.log_dir, "worker-*.out"))
+            + glob.glob(os.path.join(self.log_dir, "worker-*.err"))
+        )
+
+    def poll_once(self) -> int:
+        """Forward any new complete lines; returns the number
+        forwarded. Reads in binary with raw byte offsets (decode-then-
+        re-encode drifts on non-UTF-8 output) and buffers a trailing
+        partial line until its newline arrives."""
+        n = 0
+        for path in self._files():
+            worker = os.path.basename(path).rsplit(".", 1)[0]
+            try:
+                size = os.path.getsize(path)
+                off = self._offsets.get(path, 0)
+                if size <= off:
+                    continue
+                with open(path, "rb") as f:
+                    f.seek(off)
+                    chunk = f.read()
+                last_nl = chunk.rfind(b"\n")
+                if last_nl < 0:
+                    continue  # no complete line yet
+                complete, _rest = chunk[: last_nl + 1], chunk[last_nl + 1 :]
+                self._offsets[path] = off + last_nl + 1
+                for raw in complete.splitlines():
+                    line = raw.decode(errors="replace")
+                    if line.strip():
+                        self.callback(worker, line)
+                        n += 1
+            except OSError:
+                continue
+        return n
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.poll_interval_s):
+            self.poll_once()
+
+    def tail(self, n: int = 100) -> List[str]:
+        """Last n lines across all worker logs (dashboard/debug API)."""
+        lines: List[str] = []
+        for path in self._files():
+            worker = os.path.basename(path).rsplit(".", 1)[0]
+            try:
+                with open(path, "r", errors="replace") as f:
+                    for line in f.read().splitlines()[-n:]:
+                        lines.append(f"({worker}) {line}")
+            except OSError:
+                continue
+        return lines[-n:]
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=5.0)

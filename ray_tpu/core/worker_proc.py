@@ -61,6 +61,24 @@ class _ObjArg:
 def worker_main(conn, worker_id: str, env_overrides: Dict[str, str]):
     """Entry point for spawned worker processes."""
     os.environ.update(env_overrides or {})
+    # per-worker log files (reference: per-process files in the session
+    # dir, tailed by the LogMonitor)
+    log_dir = os.environ.get("RAY_TPU_LOG_DIR")
+    if log_dir:
+        try:
+            os.makedirs(log_dir, exist_ok=True)
+            sys.stdout = open(
+                os.path.join(log_dir, f"worker-{worker_id}.out"),
+                "a",
+                buffering=1,
+            )
+            sys.stderr = open(
+                os.path.join(log_dir, f"worker-{worker_id}.err"),
+                "a",
+                buffering=1,
+            )
+        except OSError:
+            pass
     # Rollout workers must never claim the accelerator — it belongs to
     # the driver/learner. The inherited env (and the image's
     # sitecustomize, which registers the TPU PJRT plugin in every

@@ -265,6 +265,36 @@ class RolloutWorker:
             samples = samples.policy_batches[DEFAULT_POLICY_ID]
         return self.policy_map[DEFAULT_POLICY_ID].compute_gradients(samples)
 
+    # -- DD-PPO worker-side learning (reference ddppo.py:331
+    # _sample_and_train_torch_distributed, split into the sample/grad
+    # phases the driver-mediated allreduce loop drives) ----------------
+
+    def sample_and_hold(self) -> int:
+        """Sample + postprocess a batch and keep it locally for the
+        decentralized SGD epochs; returns env steps collected."""
+        batch = self.sample()
+        if isinstance(batch, MultiAgentBatch):
+            batch = batch.policy_batches[DEFAULT_POLICY_ID]
+        if SampleBatch.ADVANTAGES in batch:
+            adv = np.asarray(
+                batch[SampleBatch.ADVANTAGES], np.float32
+            )
+            batch[SampleBatch.ADVANTAGES] = (
+                (adv - adv.mean()) / max(1e-4, adv.std())
+            ).astype(np.float32)
+        self._held_batch = batch
+        return batch.env_steps()
+
+    def grads_on_held_batch(self):
+        """One gradient over the locally held batch (one decentralized
+        SGD epoch; the driver allreduces across workers). A restarted
+        actor has no held batch — resample rather than crash the run."""
+        if getattr(self, "_held_batch", None) is None:
+            self.sample_and_hold()
+        return self.policy_map[DEFAULT_POLICY_ID].compute_gradients(
+            self._held_batch
+        )
+
     def apply_gradients(self, grads) -> None:
         self.policy_map[DEFAULT_POLICY_ID].apply_gradients(grads)
 
