@@ -1,7 +1,15 @@
 from ray_tpu.algorithms.apex_dqn.apex_dqn import (
+    ApexDDPG,
+    ApexDDPGConfig,
     ApexDQN,
     ApexDQNConfig,
     ReplayActor,
 )
 
-__all__ = ["ApexDQN", "ApexDQNConfig", "ReplayActor"]
+__all__ = [
+    "ApexDQN",
+    "ApexDQNConfig",
+    "ApexDDPG",
+    "ApexDDPGConfig",
+    "ReplayActor",
+]

@@ -300,3 +300,51 @@ class ApexDQN(DQN):
             except Exception:
                 pass
         super().cleanup()
+
+
+class ApexDDPGConfig(ApexDQNConfig):
+    """reference rllib/algorithms/apex_ddpg/apex_ddpg.py: the Ape-X
+    distributed-replay loop around DDPG's continuous-control policy."""
+
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class or ApexDDPG)
+        from ray_tpu.algorithms.ddpg.ddpg import DDPGConfig
+        from ray_tpu.algorithms.dqn.dqn import DQNConfig
+
+        # Pull in every DDPG policy-side knob on top of the Ape-X loop
+        # settings: any attribute DDPGConfig adds or changes vs the
+        # shared DQNConfig base is DDPG policy surface — derived by
+        # diff so new DDPG knobs can't silently drift out of sync.
+        ddpg, base = DDPGConfig(), DQNConfig()
+        loop_keys = {
+            "algo_class",
+            "num_workers",
+            "train_batch_size",
+            "rollout_fragment_length",
+            "n_step",
+            "num_steps_sampled_before_learning_starts",
+            "replay_buffer_config",
+            "target_network_update_freq",
+        }
+        for key, val in vars(ddpg).items():
+            if key in loop_keys:
+                continue
+            if (
+                key not in vars(base)
+                or vars(base)[key] != val
+            ):
+                setattr(self, key, val)
+        self.n_step = 3
+        self.per_worker_exploration = False
+        self.train_batch_size = 256
+
+
+class ApexDDPG(ApexDQN):
+    @classmethod
+    def get_default_config(cls) -> "ApexDDPGConfig":
+        return ApexDDPGConfig(cls)
+
+    def get_default_policy_class(self, config):
+        from ray_tpu.algorithms.ddpg.ddpg import DDPGJaxPolicy
+
+        return DDPGJaxPolicy

@@ -290,7 +290,15 @@ class DDPGJaxPolicy(JaxPolicy):
         not_done = 1.0 - batch[SampleBatch.TERMINATEDS].astype(
             jnp.float32
         )
-        gamma_n = self.gamma**self.n_step
+        # per-row fold counts from adjust_nstep: fragment tails fold
+        # fewer than n_step rewards, so their bootstrap discounts by
+        # gamma**k, not a uniform gamma**n_step (dqn.py does the same)
+        if "n_steps" in batch:
+            gamma_n = self.gamma ** batch["n_steps"].astype(
+                jnp.float32
+            )
+        else:
+            gamma_n = self.gamma**self.n_step
         next_a = self.actor.apply(aux["target_actor"], next_obs)
         if cfg.get("smooth_target_policy"):
             noise = jnp.clip(
@@ -326,6 +334,12 @@ class DDPGJaxPolicy(JaxPolicy):
             td_target = self._td_targets(params, aux, batch, rng)
 
             # ---- critic step ----
+            # prioritized-replay importance weights (Ape-X DDPG path);
+            # absent column -> uniform
+            is_weights = batch.get(
+                "weights", jnp.ones_like(td_target)
+            )
+
             def critic_loss(cp):
                 q1, q2 = critic.apply(cp, obs, actions)
                 err1 = q1 - td_target
@@ -341,9 +355,11 @@ class DDPGJaxPolicy(JaxPolicy):
                         )
                     return jnp.square(err)
 
-                loss = jnp.mean(base_loss(err1))
+                loss = jnp.mean(is_weights * base_loss(err1))
                 if twin_q:
-                    loss = loss + jnp.mean(base_loss(err2))
+                    loss = loss + jnp.mean(
+                        is_weights * base_loss(err2)
+                    )
                 if l2_reg:
                     loss = loss + l2_reg * optax.global_norm(cp) ** 2
                 return loss, (q1, err1)
@@ -469,6 +485,8 @@ class DDPGJaxPolicy(JaxPolicy):
             SampleBatch.ACTIONS,
             SampleBatch.REWARDS,
             SampleBatch.TERMINATEDS,
+            "weights",  # PER importance correction (Ape-X)
+            "n_steps",  # per-row n-step fold counts
         ]
         return {
             k: np.asarray(samples[k]) for k in keys if k in samples

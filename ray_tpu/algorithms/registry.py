@@ -30,6 +30,8 @@ _BUILTINS: Dict[str, Tuple[str, str]] = {
     "APEX": ("ray_tpu.algorithms.apex_dqn.apex_dqn", "ApexDQN"),
     "ApexDQN": ("ray_tpu.algorithms.apex_dqn.apex_dqn", "ApexDQN"),
     "R2D2": ("ray_tpu.algorithms.r2d2.r2d2", "R2D2"),
+    "ApexDDPG": ("ray_tpu.algorithms.apex_dqn.apex_dqn", "ApexDDPG"),
+    "APEX_DDPG": ("ray_tpu.algorithms.apex_dqn.apex_dqn", "ApexDDPG"),
     "BanditLinUCB": ("ray_tpu.algorithms.bandit.bandit", "BanditLinUCB"),
     "BanditLinTS": ("ray_tpu.algorithms.bandit.bandit", "BanditLinTS"),
     "QMIX": ("ray_tpu.algorithms.qmix.qmix", "QMIX"),

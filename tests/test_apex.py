@@ -161,3 +161,34 @@ def test_r2d2_sequence_replay_and_training():
     assert np.isfinite(info["mean_td_error"])
     assert len(algo.seq_buffer) > 0
     algo.cleanup()
+
+
+def test_apex_ddpg_trains_on_pendulum():
+    from ray_tpu.algorithms.apex_dqn import ApexDDPGConfig
+
+    algo = (
+        ApexDDPGConfig()
+        .environment("Pendulum-v1")
+        .rollouts(num_rollout_workers=1, rollout_fragment_length=16)
+        .training(
+            train_batch_size=32,
+            num_steps_sampled_before_learning_starts=64,
+            num_replay_buffer_shards=1,
+            target_network_update_freq=10**9,  # polyak inside learn
+        )
+        .debugging(seed=0)
+        .build()
+    )
+    from ray_tpu.algorithms.ddpg.ddpg import DDPGJaxPolicy
+
+    assert isinstance(algo.get_policy(), DDPGJaxPolicy)
+    deadline = time.time() + 180
+    result = {}
+    while time.time() < deadline:
+        result = algo.train()
+        if algo._counters.get("num_env_steps_trained", 0) >= 64:
+            break
+    assert algo._counters["num_env_steps_trained"] >= 64
+    info = result["info"]["learner"].get("default_policy", {})
+    assert np.isfinite(info.get("critic_loss", np.nan))
+    algo.cleanup()
