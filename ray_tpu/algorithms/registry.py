@@ -32,6 +32,7 @@ _BUILTINS: Dict[str, Tuple[str, str]] = {
     "R2D2": ("ray_tpu.algorithms.r2d2.r2d2", "R2D2"),
     "ApexDDPG": ("ray_tpu.algorithms.apex_dqn.apex_dqn", "ApexDDPG"),
     "APEX_DDPG": ("ray_tpu.algorithms.apex_dqn.apex_dqn", "ApexDDPG"),
+    "SlateQ": ("ray_tpu.algorithms.slateq.slateq", "SlateQ"),
     "BanditLinUCB": ("ray_tpu.algorithms.bandit.bandit", "BanditLinUCB"),
     "BanditLinTS": ("ray_tpu.algorithms.bandit.bandit", "BanditLinTS"),
     "QMIX": ("ray_tpu.algorithms.qmix.qmix", "QMIX"),

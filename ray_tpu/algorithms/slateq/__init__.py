@@ -1,0 +1,13 @@
+from ray_tpu.algorithms.slateq.slateq import (
+    SlateQ,
+    SlateQConfig,
+    SlateQJaxPolicy,
+    SyntheticSlateEnv,
+)
+
+__all__ = [
+    "SlateQ",
+    "SlateQConfig",
+    "SlateQJaxPolicy",
+    "SyntheticSlateEnv",
+]

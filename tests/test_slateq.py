@@ -1,0 +1,69 @@
+"""SlateQ tests (reference rllib/algorithms/slateq/tests)."""
+
+import time
+
+import numpy as np
+
+from ray_tpu.algorithms.slateq import (
+    SlateQConfig,
+    SyntheticSlateEnv,
+)
+from ray_tpu.env.registry import register_env
+
+
+def _register():
+    register_env("slate_env", lambda cfg: SyntheticSlateEnv(cfg))
+
+
+def test_synthetic_slate_env_contract():
+    env = SyntheticSlateEnv({"num_candidates": 6, "slate_size": 2})
+    obs, _ = env.reset(seed=0)
+    assert obs.shape == env.observation_space.shape
+    obs2, r, term, trunc, _ = env.step([0, 1])
+    assert obs2.shape == obs.shape
+    assert r >= 0.0
+    # response slice carries the click/watch of the step just taken
+    resp = obs2[-4:].reshape(2, 2)
+    assert resp[0].sum() in (0.0, 1.0)
+
+
+def test_slateq_greedy_slate_beats_random():
+    _register()
+    algo = (
+        SlateQConfig()
+        .environment(
+            "slate_env",
+            env_config={"num_candidates": 8, "slate_size": 2},
+        )
+        .rollouts(num_rollout_workers=0, rollout_fragment_length=20)
+        .training(
+            train_batch_size=64,
+            lr=2e-3,
+            num_steps_sampled_before_learning_starts=200,
+            target_network_update_freq=200,
+            epsilon_timesteps=2000,
+            final_epsilon=0.05,
+        )
+        .debugging(seed=0)
+        .build()
+    )
+    pol = algo.get_policy()
+    assert pol.slates.shape == (8 * 7, 2)  # ordered 2-permutations
+    best = -np.inf
+    deadline = time.time() + 240
+    while time.time() < deadline:
+        result = algo.train()
+        r = result.get("episode_reward_mean", np.nan)
+        if (
+            np.isfinite(r)
+            and result.get("episodes_total", 0) >= 30
+        ):
+            best = max(best, r)
+        # measured baselines on this env: random slates ~5.0/episode,
+        # per-step oracle (true-score top-k) ~10.4; the learned policy
+        # reaches ~11 (it also steers interest drift). Bar: well above
+        # random, near oracle.
+        if best >= 9.0:
+            break
+    algo.cleanup()
+    assert best >= 9.0, f"SlateQ failed to learn: best={best}"
