@@ -1,0 +1,15 @@
+from ray_tpu.algorithms.alpha_star.alpha_star import (
+    AlphaStar,
+    AlphaStarConfig,
+)
+from ray_tpu.algorithms.alpha_star.league_builder import (
+    MAIN_POLICY_ID,
+    LeagueBuilder,
+)
+
+__all__ = [
+    "AlphaStar",
+    "AlphaStarConfig",
+    "LeagueBuilder",
+    "MAIN_POLICY_ID",
+]

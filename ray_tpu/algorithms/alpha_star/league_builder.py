@@ -1,0 +1,106 @@
+"""League builder: self-play league management for AlphaStar-style
+training.
+
+Counterpart of the reference's
+``rllib/algorithms/alpha_star/league_builder.py`` (AlphaStar league of
+main agents + frozen snapshots with prioritized fictitious self-play
+matchmaking), scoped to the single-main-agent league: the trainable
+"main" policy plays against frozen snapshots of itself; when its league
+win rate crosses ``win_rate_threshold`` a new snapshot joins; opponents
+are sampled PFSP-style — harder opponents (lower main win rate) drawn
+more often."""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+MAIN_POLICY_ID = "main"
+
+
+class LeagueBuilder:
+    """reference league_builder.py AlphaStarLeagueBuilder (scoped)."""
+
+    def __init__(
+        self,
+        win_rate_threshold: float = 0.7,
+        window: int = 50,
+        pfsp_power: float = 2.0,
+        max_league_size: int = 8,
+        seed: Optional[int] = None,
+    ):
+        self.win_rate_threshold = win_rate_threshold
+        self.window = window
+        self.pfsp_power = pfsp_power
+        self.max_league_size = max_league_size
+        self._rng = np.random.default_rng(seed)
+        self.members: List[str] = []  # frozen snapshot policy ids
+        # per-opponent recent outcomes from main's perspective (1 win,
+        # 0.5 draw, 0 loss)
+        self._outcomes: Dict[str, List[float]] = {}
+        self.num_snapshots = 0
+
+    # -- matchmaking ------------------------------------------------------
+
+    def sample_opponent(self) -> str:
+        """PFSP: weight opponents by (1 - winrate)^p so the hardest
+        get played most (reference pfsp weighting)."""
+        if not self.members:
+            raise RuntimeError("league has no members yet")
+        weights = []
+        for m in self.members:
+            wr = self.win_rate(m)
+            weights.append(max(1e-3, (1.0 - wr)) ** self.pfsp_power)
+        w = np.asarray(weights)
+        return str(
+            self._rng.choice(self.members, p=w / w.sum())
+        )
+
+    # -- bookkeeping ------------------------------------------------------
+
+    def record_outcome(self, opponent: str, outcome: float) -> None:
+        buf = self._outcomes.setdefault(opponent, [])
+        buf.append(float(outcome))
+        del buf[: -self.window]
+
+    def win_rate(self, opponent: Optional[str] = None) -> float:
+        if opponent is not None:
+            buf = self._outcomes.get(opponent, [])
+            return float(np.mean(buf)) if buf else 0.5
+        rates = [self.win_rate(m) for m in self.members]
+        return float(np.mean(rates)) if rates else 0.5
+
+    def games_played(self) -> int:
+        return sum(len(v) for v in self._outcomes.values())
+
+    # -- league growth ----------------------------------------------------
+
+    def should_snapshot(self) -> bool:
+        """Main dominates the current league → freeze a copy of it as
+        a new member (reference build() snapshot condition)."""
+        if len(self.members) >= self.max_league_size:
+            return False
+        if self.games_played() < self.window:
+            return False
+        return self.win_rate() >= self.win_rate_threshold
+
+    def register_member(self, policy_id: str) -> None:
+        self.members.append(policy_id)
+        self.num_snapshots += 1
+        # fresh evaluation window vs the NEW league composition — the
+        # old outcomes would keep should_snapshot() true and fill the
+        # league with near-identical duplicates
+        self._outcomes = {m: [] for m in self.members}
+
+    def next_member_id(self) -> str:
+        return f"league_{self.num_snapshots}"
+
+    def state(self) -> Dict:
+        return {
+            "members": list(self.members),
+            "win_rates": {
+                m: self.win_rate(m) for m in self.members
+            },
+            "games_played": self.games_played(),
+        }

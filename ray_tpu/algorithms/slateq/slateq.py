@@ -367,9 +367,15 @@ class SlateQJaxPolicy(JaxPolicy):
                 clicked_q = jnp.sum(slate_q * click, axis=1)  # (B,)
                 clicked = click.sum(axis=1)  # 0/1
                 td = (clicked_q - y) * clicked  # only clicked rows
-                n = jnp.maximum(clicked.sum(), 1.0)
+                # normalize by the GLOBAL clicked count so gradient
+                # weight per sample doesn't depend on how clicks land
+                # across shards (pmean of grads follows)
+                n = jnp.maximum(
+                    jax.lax.psum(clicked.sum(), "data"), 1.0
+                )
+                shards = jax.lax.psum(1.0, "data")
                 return (
-                    jnp.sum(is_weights * jnp.square(td)) / n,
+                    shards * jnp.sum(is_weights * jnp.square(td)) / n,
                     (clicked_q, td, n),
                 )
 

@@ -66,6 +66,10 @@ class MultiAgentSyncSampler:
 
     def _reset_env(self):
         raw_obs, _ = self.env.reset()
+        # re-consult the mapping fn each episode (league matchmaking
+        # assigns fresh opponents per game — reference policy_mapping_fn
+        # receives the episode for exactly this)
+        self.agent_policy = {}
         self.cur_obs = {}
         for aid, o in raw_obs.items():
             pid = self._pid(aid)

@@ -234,6 +234,50 @@ class RolloutWorker:
         batch = self.sample()
         return batch, batch.env_steps()
 
+    def add_policy(
+        self,
+        policy_id: str,
+        policy_cls,
+        observation_space,
+        action_space,
+        config_overrides: Optional[Dict] = None,
+        weights=None,
+    ) -> None:
+        """Add a policy at runtime (reference Algorithm.add_policy →
+        rollout_worker add_policy; league builders snapshot into the
+        live policy map this way)."""
+        pol_config = {
+            **self.config,
+            **(config_overrides or {}),
+            "worker_index": self.worker_index,
+            "num_workers": self.num_workers,
+        }
+        if self.worker_index > 0:
+            pol_config.pop("_mesh", None)
+        prep = ModelCatalog.get_preprocessor_for_space(
+            observation_space
+        )
+        self.policy_map[policy_id] = policy_cls(
+            prep.observation_space, action_space, pol_config
+        )
+        self.filters[policy_id] = get_filter(
+            self.config.get("observation_filter", "NoFilter"),
+            prep.observation_space.shape,
+        )
+        if weights is not None:
+            self.policy_map[policy_id].set_weights(weights)
+
+    def set_policy_mapping_fn(self, fn: Callable) -> None:
+        """Swap the mapping fn; takes effect at the NEXT episode reset
+        (the sampler re-consults it per episode) — remapping agents
+        mid-episode would train a trajectory's tail under a policy
+        that didn't produce its ACTION_LOGP/VF_PREDS."""
+        self.policy_mapping_fn = fn
+        if self.sampler is not None and hasattr(
+            self.sampler, "policy_mapping_fn"
+        ):
+            self.sampler.policy_mapping_fn = fn
+
     def get_metrics(self) -> List:
         if self.input_reader is not None and hasattr(
             self.input_reader, "get_metrics"
@@ -247,11 +291,16 @@ class RolloutWorker:
         return self.policy_map[pid]
 
     def learn_on_batch(self, samples) -> Dict:
-        """reference rollout_worker.py:929."""
+        """reference rollout_worker.py:929. Policies outside
+        config["policies_to_train"] (league opponents, frozen experts)
+        are skipped."""
+        to_train = self.config.get("policies_to_train")
         if isinstance(samples, MultiAgentBatch):
             info = {}
             for pid, batch in samples.policy_batches.items():
-                if pid in self.policy_map:
+                if pid in self.policy_map and (
+                    to_train is None or pid in to_train
+                ):
                     info[pid] = self.policy_map[pid].learn_on_batch(batch)
             return info
         return {

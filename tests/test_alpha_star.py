@@ -1,0 +1,150 @@
+"""AlphaStar league self-play tests (reference
+rllib/algorithms/alpha_star/tests)."""
+
+import time
+
+import gymnasium as gym
+import numpy as np
+
+from ray_tpu.algorithms.alpha_star import (
+    AlphaStarConfig,
+    LeagueBuilder,
+    MAIN_POLICY_ID,
+)
+from ray_tpu.env.multi_agent_env import MultiAgentEnv
+from ray_tpu.env.registry import register_env
+
+
+class RepeatedRPS(MultiAgentEnv):
+    """Two-player repeated rock-paper-scissors: obs = one-hot of the
+    opponent's previous move, zero-sum ±1 per round. Any fixed/biased
+    strategy is exploitable — exactly the league's job."""
+
+    def __init__(self, config=None):
+        super().__init__()
+        config = config or {}
+        self.rounds = int(config.get("rounds", 8))
+        self.agents = ["p0", "p1"]
+        self._agent_ids = set(self.agents)
+        self.observation_space = gym.spaces.Box(
+            0.0, 1.0, (4,), np.float32
+        )
+        self.action_space = gym.spaces.Discrete(3)
+
+    def _obs(self, last=None):
+        out = {}
+        for i, a in enumerate(self.agents):
+            o = np.zeros(4, np.float32)
+            if last is None:
+                o[3] = 1.0  # episode-start marker
+            else:
+                o[last[self.agents[1 - i]]] = 1.0
+            out[a] = o
+        return out
+
+    def reset(self, *, seed=None, options=None):
+        self._t = 0
+        return self._obs(), {a: {} for a in self.agents}
+
+    def step(self, action_dict):
+        a0 = int(action_dict["p0"]) % 3
+        a1 = int(action_dict["p1"]) % 3
+        # 0=rock 1=paper 2=scissors; (a - b) % 3 == 1 → a wins
+        if a0 == a1:
+            r0 = 0.0
+        elif (a0 - a1) % 3 == 1:
+            r0 = 1.0
+        else:
+            r0 = -1.0
+        self._t += 1
+        done = self._t >= self.rounds
+        return (
+            self._obs({"p0": a0, "p1": a1}),
+            {"p0": r0, "p1": -r0},
+            {"__all__": done},
+            {"__all__": False},
+            {},
+        )
+
+
+def test_league_builder_pfsp_and_snapshots():
+    lb = LeagueBuilder(
+        win_rate_threshold=0.7, window=10, pfsp_power=2.0, seed=0
+    )
+    lb.register_member("league_0")
+    lb.register_member("league_1")
+    # main crushes league_0, struggles vs league_1
+    for _ in range(10):
+        lb.record_outcome("league_0", 1.0)
+        lb.record_outcome("league_1", 0.2)
+    assert lb.win_rate("league_0") == 1.0
+    # PFSP prefers the harder opponent
+    picks = [lb.sample_opponent() for _ in range(200)]
+    assert picks.count("league_1") > picks.count("league_0")
+    # overall 0.6 < 0.7 threshold → no snapshot yet
+    assert not lb.should_snapshot()
+    for _ in range(10):
+        lb.record_outcome("league_1", 1.0)
+    assert lb.should_snapshot()
+
+
+def test_alpha_star_league_grows_and_main_exploits():
+    register_env("rps", lambda cfg: RepeatedRPS(cfg))
+    algo = (
+        AlphaStarConfig()
+        .environment("rps")
+        .rollouts(num_rollout_workers=0, rollout_fragment_length=64)
+        .training(
+            train_batch_size=256,
+            sgd_minibatch_size=128,
+            num_sgd_iter=4,
+            lr=3e-3,
+            entropy_coeff=0.01,
+            clip_param=0.2,
+            kl_coeff=0.0,
+            win_rate_threshold=0.55,
+            league_window=30,
+            max_league_size=4,
+        )
+        .debugging(seed=0)
+        .build()
+    )
+    lw = algo.workers.local_worker()
+    assert MAIN_POLICY_ID in lw.policy_map
+    assert "league_0" in lw.policy_map
+    deadline = time.time() + 300
+    while time.time() < deadline:
+        result = algo.train()
+        league = result["info"]["learner"]["league"]
+        # stop once main exploited its way to a grown league
+        if len(league["members"]) >= 2 and league[
+            "games_played"
+        ] >= 30:
+            break
+    league = algo.league.state()
+    # The league snapshotted at least once — which by construction
+    # required main to exploit the frozen league at >= the 0.55
+    # win-rate threshold over a full window. (Post-snapshot win rate
+    # re-measures against the NEW league, which contains a copy of
+    # main itself, so ~0.5 is expected there.)
+    assert len(league["members"]) >= 2, league
+    # snapshots are frozen copies: their weights differ from main's
+    # current (trained-on) weights
+    import jax
+
+    main_w = jax.tree_util.tree_leaves(
+        lw.policy_map[MAIN_POLICY_ID].get_weights()
+    )
+    snap_w = jax.tree_util.tree_leaves(
+        lw.policy_map[league["members"][-1]].get_weights()
+    )
+    # newest snapshot equals main at snapshot time but main kept
+    # training afterwards unless the run stopped immediately; just
+    # check the FIRST (random-init) member differs from main
+    first_w = jax.tree_util.tree_leaves(
+        lw.policy_map["league_0"].get_weights()
+    )
+    assert any(
+        not np.allclose(a, b) for a, b in zip(main_w, first_w)
+    )
+    algo.cleanup()
