@@ -1,0 +1,7 @@
+from ray_tpu.algorithms.maml.maml import (
+    MAML,
+    MAMLConfig,
+    PointGoalEnv,
+)
+
+__all__ = ["MAML", "MAMLConfig", "PointGoalEnv"]

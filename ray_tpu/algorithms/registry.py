@@ -34,6 +34,7 @@ _BUILTINS: Dict[str, Tuple[str, str]] = {
     "APEX_DDPG": ("ray_tpu.algorithms.apex_dqn.apex_dqn", "ApexDDPG"),
     "SlateQ": ("ray_tpu.algorithms.slateq.slateq", "SlateQ"),
     "AlphaStar": ("ray_tpu.algorithms.alpha_star.alpha_star", "AlphaStar"),
+    "MAML": ("ray_tpu.algorithms.maml.maml", "MAML"),
     "BanditLinUCB": ("ray_tpu.algorithms.bandit.bandit", "BanditLinUCB"),
     "BanditLinTS": ("ray_tpu.algorithms.bandit.bandit", "BanditLinTS"),
     "QMIX": ("ray_tpu.algorithms.qmix.qmix", "QMIX"),
