@@ -1,0 +1,5 @@
+from ray_tpu.algorithms.dreamer.dreamer import (  # noqa: F401
+    Dreamer,
+    DreamerConfig,
+    EpisodicBuffer,
+)

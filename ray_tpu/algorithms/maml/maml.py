@@ -92,6 +92,72 @@ class PointGoalEnv(gym.Env):
         return self.pos.copy(), reward, False, truncated, {}
 
 
+def build_act_fn(model, dist_cls):
+    """Jitted (params, obs, rng) → (sampled action, logp) for host-side
+    rollout loops. Shared by MAML and MBMPO."""
+
+    def fn(params, obs, rng):
+        dist_inputs, _, _ = model.apply(params, obs)
+        return dist_cls(dist_inputs).sampled_action_logp(rng)
+
+    return jax.jit(fn)
+
+
+def build_meta_objective(model, dist_cls, tx, *, inner_lr, clip, inner_steps):
+    """The MAML meta-objective as composed JAX transforms: inner PG
+    adaptation differentiated through (second-order term included),
+    PPO-clipped surrogate outside, vmapped over the task batch.
+
+    Shared by MAML (tasks = env task distribution) and MBMPO (tasks =
+    dynamics-ensemble members). Returns ``(adapted_jit, meta_step_jit)``
+    where batches are dicts with obs/actions/logp/advantages columns —
+    per-task stacked (leading task axis) for ``meta_step``."""
+
+    def pg_loss(params, batch):
+        dist_inputs, _, _ = model.apply(params, batch["obs"])
+        logp = dist_cls(dist_inputs).logp(batch["actions"])
+        return -jnp.mean(logp * batch["advantages"])
+
+    def adapted(params, pre):
+        """θ' after `inner_steps` inner PG steps; the meta-gradients
+        flow through every one (second-order MAML)."""
+        for _ in range(inner_steps):
+            grads = jax.grad(pg_loss)(params, pre)
+            params = jax.tree_util.tree_map(
+                lambda p, g: p - inner_lr * g, params, grads
+            )
+        return params
+
+    def surrogate(params, batch):
+        dist_inputs, _, _ = model.apply(params, batch["obs"])
+        logp = dist_cls(dist_inputs).logp(batch["actions"])
+        ratio = jnp.exp(logp - batch["logp"])
+        adv = batch["advantages"]
+        return -jnp.mean(
+            jnp.minimum(
+                ratio * adv,
+                jnp.clip(ratio, 1 - clip, 1 + clip) * adv,
+            )
+        )
+
+    def meta_loss(params, pre_batches, post_batches):
+        def one_task(pre, post):
+            return surrogate(adapted(params, pre), post)
+
+        losses = jax.vmap(one_task)(pre_batches, post_batches)
+        return jnp.mean(losses)
+
+    def meta_step(params, opt_state, pre_batches, post_batches):
+        loss, grads = jax.value_and_grad(meta_loss)(
+            params, pre_batches, post_batches
+        )
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return jax.jit(adapted), jax.jit(meta_step)
+
+
 class MAMLConfig(AlgorithmConfig):
     """reference maml.py MAMLConfig."""
 
@@ -169,13 +235,7 @@ class MAML(Algorithm):
         returns as advantages (vanilla PG baseline-free, like the
         reference's inner adaptation)."""
         if self._act_fn is None:
-
-            def fn(params, obs, rng):
-                dist_inputs, _, _ = self.model.apply(params, obs)
-                dist = self.dist_cls(dist_inputs)
-                return dist.sampled_action_logp(rng)
-
-            self._act_fn = jax.jit(fn)
+            self._act_fn = build_act_fn(self.model, self.dist_cls)
         gamma = float(self.config.get("gamma", 0.99))
         obs_l, act_l, logp_l, ret_l = [], [], [], []
         total_steps = 0
@@ -224,59 +284,17 @@ class MAML(Algorithm):
     # -- the meta-objective (one jitted program) --------------------------
 
     def _build_meta_fn(self):
-        inner_lr = float(self.config.get("inner_lr", 0.1))
-        clip = float(self.config.get("clip_param", 0.3))
-        model, dist_cls = self.model, self.dist_cls
-        tx = self._tx
-
-        def pg_loss(params, batch):
-            dist_inputs, _, _ = model.apply(params, batch["obs"])
-            logp = dist_cls(dist_inputs).logp(batch["actions"])
-            return -jnp.mean(logp * batch["advantages"])
-
-        inner_steps = int(
-            self.config.get("inner_adaptation_steps", 1)
+        self._adapted_jit, meta_step = build_meta_objective(
+            self.model,
+            self.dist_cls,
+            self._tx,
+            inner_lr=float(self.config.get("inner_lr", 0.1)),
+            clip=float(self.config.get("clip_param", 0.3)),
+            inner_steps=int(
+                self.config.get("inner_adaptation_steps", 1)
+            ),
         )
-
-        def adapted(params, pre):
-            """θ' after `inner_adaptation_steps` inner PG steps; the
-            meta-gradients flow through every one (second-order MAML)."""
-            for _ in range(inner_steps):
-                grads = jax.grad(pg_loss)(params, pre)
-                params = jax.tree_util.tree_map(
-                    lambda p, g: p - inner_lr * g, params, grads
-                )
-            return params
-
-        def surrogate(params, batch):
-            dist_inputs, _, _ = model.apply(params, batch["obs"])
-            logp = dist_cls(dist_inputs).logp(batch["actions"])
-            ratio = jnp.exp(logp - batch["logp"])
-            adv = batch["advantages"]
-            return -jnp.mean(
-                jnp.minimum(
-                    ratio * adv,
-                    jnp.clip(ratio, 1 - clip, 1 + clip) * adv,
-                )
-            )
-
-        def meta_loss(params, pre_batches, post_batches):
-            def one_task(pre, post):
-                return surrogate(adapted(params, pre), post)
-
-            losses = jax.vmap(one_task)(pre_batches, post_batches)
-            return jnp.mean(losses)
-
-        def meta_step(params, opt_state, pre_batches, post_batches):
-            loss, grads = jax.value_and_grad(meta_loss)(
-                params, pre_batches, post_batches
-            )
-            updates, opt_state = tx.update(grads, opt_state, params)
-            params = optax.apply_updates(params, updates)
-            return params, opt_state, loss
-
-        self._adapted_jit = jax.jit(adapted)
-        return jax.jit(meta_step)
+        return meta_step
 
     def _adapt(self, pre_batch):
         """θ' from the jitted inner update on a host batch."""

@@ -1,0 +1,6 @@
+from ray_tpu.algorithms.mbmpo.mbmpo import (  # noqa: F401
+    MBMPO,
+    DynamicsEnsemble,
+    MBMPOConfig,
+    TDModel,
+)

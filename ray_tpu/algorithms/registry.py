@@ -40,6 +40,8 @@ _BUILTINS: Dict[str, Tuple[str, str]] = {
     "QMIX": ("ray_tpu.algorithms.qmix.qmix", "QMIX"),
     "MADDPG": ("ray_tpu.algorithms.maddpg.maddpg", "MADDPG"),
     "AlphaZero": ("ray_tpu.algorithms.alpha_zero.alpha_zero", "AlphaZero"),
+    "Dreamer": ("ray_tpu.algorithms.dreamer.dreamer", "Dreamer"),
+    "MBMPO": ("ray_tpu.algorithms.mbmpo.mbmpo", "MBMPO"),
 }
 
 
