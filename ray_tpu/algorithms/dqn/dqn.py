@@ -32,6 +32,10 @@ from ray_tpu.execution.train_ops import (
     NUM_AGENT_STEPS_TRAINED,
     NUM_ENV_STEPS_TRAINED,
 )
+from ray_tpu.algorithms.dqn.dqn_model import (
+    DQNModel,
+    categorical_projection,
+)
 from ray_tpu.policy.jax_policy import JaxPolicy
 
 
@@ -49,6 +53,13 @@ class DQNConfig(AlgorithmConfig):
         self.double_q = True
         self.dueling = True
         self.n_step = 1
+        # Rainbow knobs (reference dqn.py: num_atoms/v_min/v_max for
+        # C51 distributional Q, noisy/sigma0 for NoisyNet exploration)
+        self.num_atoms = 1
+        self.v_min = -10.0
+        self.v_max = 10.0
+        self.noisy = False
+        self.sigma0 = 0.5
         self.replay_buffer_config = {
             "capacity": 50000,
             "prioritized_replay": False,
@@ -68,6 +79,11 @@ class DQNConfig(AlgorithmConfig):
         double_q: Optional[bool] = None,
         dueling: Optional[bool] = None,
         n_step: Optional[int] = None,
+        num_atoms: Optional[int] = None,
+        v_min: Optional[float] = None,
+        v_max: Optional[float] = None,
+        noisy: Optional[bool] = None,
+        sigma0: Optional[float] = None,
         replay_buffer_config: Optional[Dict] = None,
         num_steps_sampled_before_learning_starts: Optional[int] = None,
         epsilon_timesteps: Optional[int] = None,
@@ -83,6 +99,15 @@ class DQNConfig(AlgorithmConfig):
             self.dueling = dueling
         if n_step is not None:
             self.n_step = n_step
+        for name, val in (
+            ("num_atoms", num_atoms),
+            ("v_min", v_min),
+            ("v_max", v_max),
+            ("noisy", noisy),
+            ("sigma0", sigma0),
+        ):
+            if val is not None:
+                setattr(self, name, val)
         if replay_buffer_config is not None:
             self.replay_buffer_config.update(replay_buffer_config)
         if num_steps_sampled_before_learning_starts is not None:
@@ -174,8 +199,79 @@ class DQNJaxPolicy(JaxPolicy):
     def __init__(self, observation_space, action_space, config):
         config = dict(config)
         config["exploration_config"] = _epsilon_exploration_config(config)
-        # model's "logits" head = per-action Q values (+ optional dueling
-        # value stream handled by vf head reuse)
+        # Non-recurrent configs get the dedicated dueling/C51/noisy
+        # Q-model (reference dqn_torch_model.py DQNTorchModel); the
+        # recurrent path (R2D2's use_lstm) keeps the catalog LSTM whose
+        # logits head IS the Q head.
+        model_cfg = dict(config.get("model") or {})
+        self._uses_dqn_model = not any(
+            model_cfg.get(k)
+            for k in ("use_lstm", "use_attention", "custom_model")
+        )
+        if not self._uses_dqn_model:
+            # the fallback treats the model's logits head as Q values —
+            # atom-level outputs and weight noise need the built-in model
+            if int(config.get("num_atoms", 1)) > 1:
+                raise ValueError(
+                    "distributional Q (num_atoms > 1) requires the "
+                    "built-in DQNModel; it is unavailable with "
+                    "use_lstm/use_attention/custom_model"
+                )
+            if config.get("noisy"):
+                raise ValueError(
+                    "noisy nets require the built-in DQNModel; "
+                    "unavailable with use_lstm/use_attention/"
+                    "custom_model"
+                )
+        if self._uses_dqn_model:
+            from ray_tpu.models.catalog import MODEL_DEFAULTS
+            from ray_tpu.models.cnn import get_filter_config
+
+            defaults = MODEL_DEFAULTS
+            is_image = len(observation_space.shape) == 3
+            # image trunks take their post-conv widths from
+            # post_fcnet_hiddens (the VisionNet convention); vector
+            # trunks from fcnet_hiddens
+            if is_image:
+                hiddens = model_cfg.get(
+                    "post_fcnet_hiddens",
+                    defaults.get("post_fcnet_hiddens", (512,)),
+                )
+            else:
+                hiddens = model_cfg.get(
+                    "fcnet_hiddens",
+                    defaults.get("fcnet_hiddens", (256, 256)),
+                )
+            config["model"] = {
+                **model_cfg,
+                "custom_model": DQNModel,
+                "custom_model_config": {
+                    "hiddens": tuple(hiddens),
+                    "activation": model_cfg.get(
+                        "fcnet_activation",
+                        defaults.get("fcnet_activation", "tanh"),
+                    ),
+                    "use_conv": is_image,
+                    "conv_filters": (
+                        tuple(
+                            tuple(f)
+                            for f in model_cfg["conv_filters"]
+                        )
+                        if model_cfg.get("conv_filters")
+                        else (
+                            get_filter_config(observation_space.shape)
+                            if is_image
+                            else None
+                        )
+                    ),
+                    "num_atoms": int(config.get("num_atoms", 1)),
+                    "v_min": float(config.get("v_min", -10.0)),
+                    "v_max": float(config.get("v_max", 10.0)),
+                    "dueling": bool(config.get("dueling", True)),
+                    "noisy": bool(config.get("noisy", False)),
+                    "sigma0": float(config.get("sigma0", 0.5)),
+                },
+            }
         super().__init__(observation_space, action_space, config)
         self._steps_since_target_update = 0
 
@@ -187,7 +283,20 @@ class DQNJaxPolicy(JaxPolicy):
             self.config, force_keys=new_config
         )
 
+    # knobs baked into the built model's architecture/support grid: the
+    # loss would retrace but the model cannot change post-init
+    _ARCH_KEYS = ("num_atoms", "noisy", "dueling", "v_min", "v_max", "sigma0")
+
     def update_config(self, new_config: Dict) -> None:
+        for key in self._ARCH_KEYS:
+            if key in new_config and new_config[key] != self.config.get(
+                key
+            ):
+                raise ValueError(
+                    f"DQN architecture knob {key!r} is baked into the "
+                    "built Q-model and cannot be mutated via "
+                    "update_config; rebuild the policy instead"
+                )
         super().update_config(new_config)
         if hasattr(self, "_td_error_fn"):
             del self._td_error_fn
@@ -197,6 +306,14 @@ class DQNJaxPolicy(JaxPolicy):
         dqn_torch_policy)."""
         self.aux_state = {"target_params": self.params}
 
+    def _apply_model_for_actions(self, params, obs, rng, explore):
+        """NoisyNet exploration: resample weight noise per action call
+        while exploring (the reference's NoisyLayer resamples every
+        training-mode forward); evaluation uses the mean weights."""
+        if explore and self._uses_dqn_model and self.config.get("noisy"):
+            return self.model.apply(params, obs, noise_key=rng)
+        return super()._apply_model_for_actions(params, obs, rng, explore)
+
     def extra_action_out(self, dist_inputs, value, dist, rng):
         # The per-action Q values already ride ACTION_DIST_INPUTS (the
         # model head IS the Q head); don't duplicate them as a second
@@ -205,18 +322,41 @@ class DQNJaxPolicy(JaxPolicy):
 
     # -- loss ------------------------------------------------------------
 
-    def _td_error(self, params, aux, batch):
+    def _q_dist(self, params, obs, noise_key=None):
+        """→ (q_values, support_logits (B, A, atoms), support_probs or
+        None). The DQNModel path exposes atom-level outputs; the
+        recurrent/custom fallback treats the logits head as Q values."""
+        if self._uses_dqn_model:
+            return self.model.apply(
+                params, obs, noise_key=noise_key,
+                method=DQNModel.q_dist,
+            )
+        q, _, _ = self.model_forward(params, obs)
+        return q, q[..., None], None
+
+    def _td_error(self, params, aux, batch, rng=None):
         """Per-sample TD error (shared by the loss and the PER priority
         refresh; reference dqn_torch_policy computes it inside QLoss and
-        exposes policy.compute_td_error)."""
+        exposes policy.compute_td_error). For distributional Q
+        (num_atoms > 1) the "TD error" is the per-sample softmax
+        cross-entropy to the projected target distribution, exactly the
+        quantity the reference feeds PER in the C51 case."""
         cfg = self.config
         gamma = cfg.get("gamma", 0.99)
         n_step = cfg.get("n_step", 1)
+        num_atoms = int(cfg.get("num_atoms", 1))
         target_params = aux["target_params"]
+        # independent weight noise for online / target / selection nets
+        # (NoisyNet training regime); None → mean weights
+        k1 = k2 = k3 = None
+        if rng is not None and cfg.get("noisy"):
+            k1, k2, k3 = jax.random.split(rng, 3)
 
-        q_all, _, _ = self.model_forward(params, batch[SampleBatch.OBS])
-        q_next_target, _, _ = self.model_forward(
-            target_params, batch[SampleBatch.NEXT_OBS]
+        q_all, logits_all, _ = self._q_dist(
+            params, batch[SampleBatch.OBS], k1
+        )
+        q_next_target, _, probs_next_target = self._q_dist(
+            target_params, batch[SampleBatch.NEXT_OBS], k2
         )
         actions = batch[SampleBatch.ACTIONS].astype(jnp.int32)
         q_sel = jnp.take_along_axis(
@@ -224,15 +364,12 @@ class DQNJaxPolicy(JaxPolicy):
         ).squeeze(-1)
 
         if cfg.get("double_q", True):
-            q_next_online, _, _ = self.model_forward(
-                params, batch[SampleBatch.NEXT_OBS]
+            q_next_online, _, _ = self._q_dist(
+                params, batch[SampleBatch.NEXT_OBS], k3
             )
             next_actions = jnp.argmax(q_next_online, axis=-1)
         else:
             next_actions = jnp.argmax(q_next_target, axis=-1)
-        q_next = jnp.take_along_axis(
-            q_next_target, next_actions[:, None], axis=-1
-        ).squeeze(-1)
 
         not_done = 1.0 - batch[SampleBatch.TERMINATEDS].astype(
             jnp.float32
@@ -243,6 +380,36 @@ class DQNJaxPolicy(JaxPolicy):
         bootstrap_discount = (
             gamma ** steps if steps is not None else gamma**n_step
         )
+        if isinstance(bootstrap_discount, float):
+            bootstrap_discount = jnp.full_like(q_sel, bootstrap_discount)
+
+        if num_atoms > 1:
+            # C51: cross-entropy to the projected target distribution
+            p_next = jnp.take_along_axis(
+                probs_next_target,
+                next_actions[:, None, None],
+                axis=1,
+            ).squeeze(1)  # (B, atoms)
+            m = categorical_projection(
+                p_next,
+                batch[SampleBatch.REWARDS],
+                bootstrap_discount,
+                not_done,
+                float(cfg.get("v_min", -10.0)),
+                float(cfg.get("v_max", 10.0)),
+            )
+            m = jax.lax.stop_gradient(m)
+            logits_sel = jnp.take_along_axis(
+                logits_all, actions[:, None, None], axis=1
+            ).squeeze(1)  # (B, atoms)
+            td_error = -jnp.sum(
+                m * jax.nn.log_softmax(logits_sel, axis=-1), axis=-1
+            )
+            return td_error, q_sel, q_all
+
+        q_next = jnp.take_along_axis(
+            q_next_target, next_actions[:, None], axis=-1
+        ).squeeze(-1)
         td_target = (
             batch[SampleBatch.REWARDS]
             + bootstrap_discount
@@ -253,16 +420,20 @@ class DQNJaxPolicy(JaxPolicy):
         return td_error, q_sel, q_all
 
     def loss_with_aux(self, params, aux, batch, rng, coeffs):
-        td_error, q_sel, q_all = self._td_error(params, aux, batch)
-        # Huber loss (reference huber_loss, delta=1)
-        abs_err = jnp.abs(td_error)
-        huber = jnp.where(
-            abs_err < 1.0, 0.5 * jnp.square(td_error), abs_err - 0.5
-        )
-        weights = batch.get(
-            "weights", jnp.ones_like(huber)
-        )
-        loss = jnp.mean(weights * huber)
+        td_error, q_sel, q_all = self._td_error(params, aux, batch, rng)
+        if int(self.config.get("num_atoms", 1)) > 1:
+            # td_error is already the per-sample cross-entropy loss
+            per_sample = td_error
+        else:
+            # Huber loss (reference huber_loss, delta=1)
+            abs_err = jnp.abs(td_error)
+            per_sample = jnp.where(
+                abs_err < 1.0,
+                0.5 * jnp.square(td_error),
+                abs_err - 0.5,
+            )
+        weights = batch.get("weights", jnp.ones_like(per_sample))
+        loss = jnp.mean(weights * per_sample)
         stats = {
             "mean_q": jnp.mean(q_sel),
             "mean_td_error": jnp.mean(td_error),

@@ -249,6 +249,13 @@ class JaxPolicy(Policy):
     def get_initial_state(self) -> List[np.ndarray]:
         return [np.asarray(s[0]) for s in self.model.initial_state(1)]
 
+    def _apply_model_for_actions(self, params, obs, rng, explore):
+        """Non-recurrent inference forward inside the jitted action fn.
+        Override to thread inference-time randomness into the model
+        (e.g. NoisyNet weight noise in the DQN family); ``explore`` is
+        static under jit. The default ignores both."""
+        return self.model.apply(params, obs)
+
     # -- inference -------------------------------------------------------
 
     def _build_action_fn(self):
@@ -277,7 +284,12 @@ class JaxPolicy(Policy):
                     params, obs[:, None], states, **kwargs
                 )
             else:
-                dist_inputs, value, state_out = model.apply(params, obs)
+                rng_m, rng = jax.random.split(rng)
+                dist_inputs, value, state_out = (
+                    self._apply_model_for_actions(
+                        params, obs, rng_m, explore
+                    )
+                )
             dist = dist_class(dist_inputs)
             rng_x, rng = jax.random.split(rng)
             actions, logp, expl_state = exploration.sample_fn(

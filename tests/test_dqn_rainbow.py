@@ -1,0 +1,186 @@
+"""Rainbow DQN components: dueling combine, C51 distributional loss,
+NoisyNet layers (reference rllib/algorithms/dqn tests + dqn_torch_model)."""
+
+import gymnasium as gym
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.algorithms.dqn.dqn import DQNJaxPolicy
+from ray_tpu.algorithms.dqn.dqn_model import (
+    DQNModel,
+    NoisyDense,
+    categorical_projection,
+)
+from ray_tpu.data.sample_batch import SampleBatch
+
+OBS_SPACE = gym.spaces.Box(-1.0, 1.0, (6,), np.float32)
+ACT_SPACE = gym.spaces.Discrete(3)
+
+
+def _batch(rng, b=32):
+    return SampleBatch(
+        {
+            SampleBatch.OBS: rng.standard_normal((b, 6)).astype(
+                np.float32
+            ),
+            SampleBatch.NEXT_OBS: rng.standard_normal((b, 6)).astype(
+                np.float32
+            ),
+            SampleBatch.ACTIONS: rng.integers(0, 3, b).astype(np.int64),
+            SampleBatch.REWARDS: rng.standard_normal(b).astype(
+                np.float32
+            ),
+            SampleBatch.TERMINATEDS: (
+                rng.random(b) < 0.1
+            ).astype(np.float32),
+        }
+    )
+
+
+def test_noisy_dense_determinism_and_noise():
+    layer = NoisyDense(8, sigma0=0.5)
+    x = jnp.ones((4, 5))
+    params = layer.init(jax.random.PRNGKey(0), x)
+    assert "w_sigma" in params["params"] and "b_sigma" in params["params"]
+    # no key → mean weights, deterministic
+    y1 = layer.apply(params, x)
+    y2 = layer.apply(params, x)
+    np.testing.assert_allclose(np.asarray(y1), np.asarray(y2))
+    # different keys → different outputs; same key → same output
+    za = layer.apply(params, x, noise_key=jax.random.PRNGKey(1))
+    zb = layer.apply(params, x, noise_key=jax.random.PRNGKey(2))
+    zc = layer.apply(params, x, noise_key=jax.random.PRNGKey(1))
+    assert not np.allclose(np.asarray(za), np.asarray(zb))
+    np.testing.assert_allclose(np.asarray(za), np.asarray(zc))
+
+
+def test_dueling_combine_matches_formula():
+    model = DQNModel(
+        num_outputs=3, hiddens=(16,), num_atoms=1, dueling=True
+    )
+    obs = jnp.asarray(
+        np.random.default_rng(0).standard_normal((5, 6)), jnp.float32
+    )
+    params = model.init(jax.random.PRNGKey(0), obs)
+    q, support, probs = model.apply(
+        params, obs, method=DQNModel.q_dist
+    )
+    assert q.shape == (5, 3) and probs is None
+    # dueling: q rows must satisfy q = V + A - mean(A) → the mean-
+    # centered advantages reconstruct from q minus its action-mean
+    centered = q - q.mean(axis=1, keepdims=True)
+    assert np.isfinite(np.asarray(centered)).all()
+    # non-dueling model with same seed differs in head structure
+    model_nd = DQNModel(
+        num_outputs=3, hiddens=(16,), num_atoms=1, dueling=False
+    )
+    params_nd = model_nd.init(jax.random.PRNGKey(0), obs)
+    flat = jax.tree_util.tree_leaves(params)
+    flat_nd = jax.tree_util.tree_leaves(params_nd)
+    assert len(flat) == len(flat_nd) + 2  # extra value-head kernel+bias
+
+
+def test_categorical_projection_golden():
+    """Compare the vectorized projection against a per-sample numpy
+    reference implementation."""
+    rng = np.random.default_rng(0)
+    B, atoms = 16, 11
+    v_min, v_max = -2.0, 2.0
+    p = rng.random((B, atoms)).astype(np.float32)
+    p /= p.sum(-1, keepdims=True)
+    rewards = rng.uniform(-3, 3, B).astype(np.float32)
+    disc = np.full(B, 0.9, np.float32)
+    not_done = (rng.random(B) > 0.3).astype(np.float32)
+
+    m = np.asarray(
+        categorical_projection(
+            jnp.asarray(p), jnp.asarray(rewards), jnp.asarray(disc),
+            jnp.asarray(not_done), v_min, v_max,
+        )
+    )
+
+    z = np.linspace(v_min, v_max, atoms)
+    dz = (v_max - v_min) / (atoms - 1)
+    expect = np.zeros((B, atoms), np.float32)
+    for i in range(B):
+        for j in range(atoms):
+            tz = np.clip(
+                rewards[i] + disc[i] * not_done[i] * z[j], v_min, v_max
+            )
+            b = (tz - v_min) / dz
+            lo, hi = int(np.floor(b)), int(np.ceil(b))
+            if lo == hi:
+                expect[i, lo] += p[i, j]
+            else:
+                expect[i, lo] += p[i, j] * (hi - b)
+                expect[i, hi] += p[i, j] * (b - lo)
+    np.testing.assert_allclose(m, expect, atol=1e-5)
+    # projected distributions remain normalized
+    np.testing.assert_allclose(m.sum(-1), 1.0, atol=1e-5)
+
+
+def _policy(**overrides):
+    cfg = {
+        "model": {"fcnet_hiddens": [32]},
+        "train_batch_size": 32,
+        "sgd_minibatch_size": 32,
+        "lr": 5e-3,
+        "double_q": True,
+        "dueling": True,
+    }
+    cfg.update(overrides)
+    return DQNJaxPolicy(OBS_SPACE, ACT_SPACE, cfg)
+
+
+def test_c51_loss_decreases_on_fixed_batch():
+    policy = _policy(num_atoms=21, v_min=-5.0, v_max=5.0)
+    rng = np.random.default_rng(0)
+    batch = _batch(rng)
+    losses = []
+    for _ in range(40):
+        stats = policy.learn_on_batch(batch)
+        losses.append(float(stats["total_loss"]))
+        assert np.isfinite(losses[-1]), stats
+    # the cross-entropy floor is H(m) > 0 (the fixed target net's
+    # projected distribution), so assert approach, not collapse
+    assert losses[-1] < losses[0] - 0.3, (losses[0], losses[-1])
+
+
+def test_noisy_rainbow_policy_learns_and_explores():
+    policy = _policy(
+        num_atoms=11, noisy=True, sigma0=0.5,
+        exploration_config={
+            "initial_epsilon": 0.0,
+            "final_epsilon": 0.0,
+            "epsilon_timesteps": 1,
+        },
+    )
+    rng = np.random.default_rng(0)
+    batch = _batch(rng)
+    first = float(policy.learn_on_batch(batch)["total_loss"])
+    for _ in range(30):
+        stats = policy.learn_on_batch(batch)
+    assert float(stats["total_loss"]) < first
+
+    # with epsilon 0, exploration comes from resampled weight noise:
+    # repeated action computations on the same obs must not all agree
+    obs = rng.standard_normal((16, 6)).astype(np.float32)
+    seen = set()
+    for _ in range(8):
+        actions, _, _ = policy.compute_actions(obs, explore=True)
+        seen.add(tuple(int(a) for a in actions))
+    assert len(seen) > 1, "noisy nets produced identical actions"
+    # eval mode (explore=False) is deterministic: mean weights
+    a1, _, _ = policy.compute_actions(obs, explore=False)
+    a2, _, _ = policy.compute_actions(obs, explore=False)
+    np.testing.assert_array_equal(a1, a2)
+
+
+def test_per_priorities_with_c51():
+    policy = _policy(num_atoms=11)
+    rng = np.random.default_rng(0)
+    batch = _batch(rng)
+    td = policy.compute_td_error(batch)
+    assert td.shape == (32,)
+    assert (td >= 0).all() and np.isfinite(td).all()
