@@ -227,43 +227,37 @@ class DQNJaxPolicy(JaxPolicy):
             from ray_tpu.models.catalog import MODEL_DEFAULTS
             from ray_tpu.models.cnn import get_filter_config
 
-            defaults = MODEL_DEFAULTS
+            cfg = {**MODEL_DEFAULTS, **model_cfg}
             is_image = len(observation_space.shape) == 3
-            # image trunks take their post-conv widths from
-            # post_fcnet_hiddens (the VisionNet convention); vector
-            # trunks from fcnet_hiddens
             if is_image:
-                hiddens = model_cfg.get(
-                    "post_fcnet_hiddens",
-                    defaults.get("post_fcnet_hiddens", (512,)),
+                # VisionNet conventions: post-conv widths/activation
+                # from post_fcnet_*, empty coerces to [512]
+                hiddens = tuple(cfg["post_fcnet_hiddens"] or [512])
+                activation = cfg["post_fcnet_activation"]
+                filters = cfg["conv_filters"] or get_filter_config(
+                    observation_space.shape
+                )
+                conv_filters = tuple(
+                    (
+                        int(c),
+                        tuple(k) if isinstance(k, (list, tuple)) else (k, k),
+                        tuple(s) if isinstance(s, (list, tuple)) else (s, s),
+                    )
+                    for c, k, s in filters
                 )
             else:
-                hiddens = model_cfg.get(
-                    "fcnet_hiddens",
-                    defaults.get("fcnet_hiddens", (256, 256)),
-                )
+                hiddens = tuple(cfg["fcnet_hiddens"])
+                activation = cfg["fcnet_activation"]
+                conv_filters = None
             config["model"] = {
                 **model_cfg,
                 "custom_model": DQNModel,
                 "custom_model_config": {
-                    "hiddens": tuple(hiddens),
-                    "activation": model_cfg.get(
-                        "fcnet_activation",
-                        defaults.get("fcnet_activation", "tanh"),
-                    ),
+                    "hiddens": hiddens,
+                    "activation": activation,
                     "use_conv": is_image,
-                    "conv_filters": (
-                        tuple(
-                            tuple(f)
-                            for f in model_cfg["conv_filters"]
-                        )
-                        if model_cfg.get("conv_filters")
-                        else (
-                            get_filter_config(observation_space.shape)
-                            if is_image
-                            else None
-                        )
-                    ),
+                    "conv_filters": conv_filters,
+                    "conv_activation": cfg["conv_activation"],
                     "num_atoms": int(config.get("num_atoms", 1)),
                     "v_min": float(config.get("v_min", -10.0)),
                     "v_max": float(config.get("v_max", 10.0)),

@@ -410,3 +410,9 @@ class MAML(Algorithm):
         except Exception:
             pass
         super().cleanup()
+
+
+# default example-env registration so tuned_examples yamls resolve it
+from ray_tpu.env.registry import register_env  # noqa: E402
+
+register_env("PointGoal-v0", lambda cfg: PointGoalEnv(cfg))

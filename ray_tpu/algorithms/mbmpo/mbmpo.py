@@ -63,6 +63,41 @@ from ray_tpu.models.catalog import ModelCatalog
 from ray_tpu.models.distributions import DiagGaussian
 
 
+class PointMassEnv(gym.Env):
+    """1D double-integrator: obs = [pos, vel], action = accel; reward =
+    -(pos² + 0.1 vel²). ``reward`` is written with array operators so it
+    traces inside the jitted imagined rollout (the MBMPO env contract;
+    the reference's counterpart task suite is ``rllib/env/wrappers/
+    model_vector_env``-compatible mujoco envs)."""
+
+    def __init__(self, config=None):
+        config = config or {}
+        self.horizon = int(config.get("horizon", 30))
+        self.observation_space = gym.spaces.Box(
+            -np.inf, np.inf, (2,), np.float32
+        )
+        self.action_space = gym.spaces.Box(-1.0, 1.0, (1,), np.float32)
+        self._rng = np.random.default_rng(config.get("seed", 0))
+
+    def reward(self, obs, action, next_obs):
+        return -(next_obs[..., 0] ** 2 + 0.1 * next_obs[..., 1] ** 2)
+
+    def reset(self, *, seed=None, options=None):
+        self.x = self._rng.normal(0, 1.0, 2).astype(np.float32)
+        self._t = 0
+        return self.x.copy(), {}
+
+    def step(self, action):
+        a = float(np.clip(np.asarray(action).reshape(-1)[0], -1, 1))
+        pos, vel = float(self.x[0]), float(self.x[1])
+        vel = vel + 0.2 * a
+        pos = pos + 0.2 * vel
+        self.x = np.array([pos, vel], np.float32)
+        self._t += 1
+        r = float(self.reward(None, None, self.x))
+        return self.x.copy(), r, False, self._t >= self.horizon, {}
+
+
 class TDModel(nn.Module):
     """One transition-dynamics model: (obs, action) → Δobs
     (reference ``model_ensemble.py:53`` TDModel)."""
@@ -548,3 +583,9 @@ class MBMPO(Algorithm):
         except Exception:
             pass
         super().cleanup()
+
+
+# default example-env registration so tuned_examples yamls resolve it
+from ray_tpu.env.registry import register_env  # noqa: E402
+
+register_env("PointMass-v0", lambda cfg: PointMassEnv(cfg))

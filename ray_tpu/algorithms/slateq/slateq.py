@@ -494,3 +494,9 @@ class SlateQ(DQN):
                 "lr_schedule is not supported yet"
             )
         super().setup(config)
+
+
+# default example-env registration so tuned_examples yamls resolve it
+from ray_tpu.env.registry import register_env  # noqa: E402
+
+register_env("SyntheticSlate-v0", lambda cfg: SyntheticSlateEnv(cfg))

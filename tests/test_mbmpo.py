@@ -1,45 +1,12 @@
 """MBMPO tests (reference rllib/algorithms/mbmpo/tests)."""
 
-import gymnasium as gym
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from ray_tpu.algorithms.mbmpo import DynamicsEnsemble, MBMPOConfig
+from ray_tpu.algorithms.mbmpo.mbmpo import PointMassEnv
 from ray_tpu.env.registry import register_env
-
-
-class PointMassEnv(gym.Env):
-    """1D double-integrator: obs = [pos, vel], action = accel; reward =
-    -(pos² + 0.1 vel²). ``reward`` is written with array operators so it
-    traces inside the jitted imagined rollout (the MBMPO env contract)."""
-
-    def __init__(self, config=None):
-        config = config or {}
-        self.horizon = int(config.get("horizon", 30))
-        self.observation_space = gym.spaces.Box(
-            -np.inf, np.inf, (2,), np.float32
-        )
-        self.action_space = gym.spaces.Box(-1.0, 1.0, (1,), np.float32)
-        self._rng = np.random.default_rng(config.get("seed", 0))
-
-    def reward(self, obs, action, next_obs):
-        return -(next_obs[..., 0] ** 2 + 0.1 * next_obs[..., 1] ** 2)
-
-    def reset(self, *, seed=None, options=None):
-        self.x = self._rng.normal(0, 1.0, 2).astype(np.float32)
-        self._t = 0
-        return self.x.copy(), {}
-
-    def step(self, action):
-        a = float(np.clip(np.asarray(action).reshape(-1)[0], -1, 1))
-        pos, vel = float(self.x[0]), float(self.x[1])
-        vel = vel + 0.2 * a
-        pos = pos + 0.2 * vel
-        self.x = np.array([pos, vel], np.float32)
-        self._t += 1
-        r = float(self.reward(None, None, self.x))
-        return self.x.copy(), r, False, self._t >= self.horizon, {}
 
 
 def test_dynamics_ensemble_learns_transitions():
