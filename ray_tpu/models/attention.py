@@ -16,6 +16,7 @@ import flax.linen as nn
 import jax.numpy as jnp
 
 from ray_tpu.models.base import RTModel
+from ray_tpu.ops.flash_attention import flash_attention
 
 
 class _GRUGate(nn.Module):
@@ -78,11 +79,9 @@ class GTrXLNet(RTModel):
         new_state = []
         M = self.memory_len
         S = M + T
-        # causal mask over the concatenated [memory | fragment] window:
-        # query t may attend to all memory plus fragment steps <= t.
-        q_pos = jnp.arange(T)[:, None]
-        k_pos = jnp.arange(S)[None, :] - M
-        mask = k_pos <= q_pos  # (T, S)
+        # the causal band over the concatenated [memory | fragment]
+        # window (query t attends all memory plus fragment steps <= t)
+        # is expressed as flash_attention's causal_offset=M below
 
         pos_emb = _rel_positional_embedding(S, self.attention_dim)
 
@@ -102,12 +101,10 @@ class GTrXLNet(RTModel):
             q = q.reshape(B, T, H, Dh).transpose(0, 2, 1, 3)
             k = k.reshape(B, S, H, Dh).transpose(0, 2, 1, 3)
             v = v.reshape(B, S, H, Dh).transpose(0, 2, 1, 3)
-            scores = jnp.einsum("bhtd,bhsd->bhts", q, k) / jnp.sqrt(
-                jnp.float32(Dh)
-            )
-            scores = jnp.where(mask[None, None], scores, -1e9)
-            attn = nn.softmax(scores, axis=-1)
-            out = jnp.einsum("bhts,bhsd->bhtd", attn, v)
+            # the [memory | fragment] band (k_pos - M <= q_pos) is
+            # flash_attention's causal_offset=M; fused Pallas kernel on
+            # TPU, identical XLA math elsewhere (ops/flash_attention.py)
+            out = flash_attention(q, k, v, causal_offset=M)
             out = out.transpose(0, 2, 1, 3).reshape(B, T, H * Dh)
             out = nn.Dense(self.attention_dim, name=f"proj_{layer}")(out)
             x = _GRUGate(

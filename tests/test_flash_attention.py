@@ -1,0 +1,96 @@
+"""Flash-attention Pallas kernel vs the XLA reference (interpret mode
+runs the real kernel on CPU)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ray_tpu.ops.flash_attention import (
+    _reference_attention,
+    flash_attention,
+)
+
+
+def _qkv(rng, B=2, H=2, T=24, S=40, D=16, dtype=jnp.float32):
+    q = jnp.asarray(rng.standard_normal((B, H, T, D)), dtype)
+    k = jnp.asarray(rng.standard_normal((B, H, S, D)), dtype)
+    v = jnp.asarray(rng.standard_normal((B, H, S, D)), dtype)
+    return q, k, v
+
+
+@pytest.mark.parametrize(
+    "T,S,offset",
+    [
+        (24, 40, None),  # full attention, uneven non-multiple shapes
+        (24, 40, 16),    # GTrXL band: memory_len offset
+        (32, 32, 0),     # plain causal self-attention
+        (130, 200, 7),   # spills over the 128 block size
+    ],
+)
+def test_kernel_matches_reference(T, S, offset):
+    rng = np.random.default_rng(0)
+    q, k, v = _qkv(rng, T=T, S=S)
+    out = flash_attention(
+        q, k, v, causal_offset=offset, interpret=True
+    )
+    ref = flash_attention(
+        q, k, v, causal_offset=offset, use_pallas=False
+    )
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5
+    )
+
+
+def test_rows_with_no_valid_keys_are_zero_in_both_paths():
+    # offset -3: queries 0..2 have no valid keys; the op defines those
+    # rows as ZERO in both the kernel and the XLA reference (which is
+    # also the backward pass), so forward and vjp agree
+    rng = np.random.default_rng(1)
+    q, k, v = _qkv(rng, T=8, S=8)
+    out = flash_attention(q, k, v, causal_offset=-3, interpret=True)
+    ref = flash_attention(q, k, v, causal_offset=-3, use_pallas=False)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5
+    )
+    np.testing.assert_allclose(np.asarray(out[:, :, :3]), 0.0)
+    assert np.abs(np.asarray(out[:, :, 3:])).max() > 0
+
+
+def test_gradients_flow_and_match_reference():
+    rng = np.random.default_rng(2)
+    q, k, v = _qkv(rng, T=16, S=16, D=8)
+
+    def loss_kernel(q, k, v):
+        return jnp.sum(
+            flash_attention(q, k, v, causal_offset=0, interpret=True)
+            ** 2
+        )
+
+    def loss_ref(q, k, v):
+        return jnp.sum(
+            flash_attention(q, k, v, causal_offset=0, use_pallas=False)
+            ** 2
+        )
+
+    g_kernel = jax.grad(loss_kernel, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_kernel, g_ref):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-4, rtol=1e-4
+        )
+
+
+def test_bf16_inputs():
+    rng = np.random.default_rng(3)
+    q, k, v = _qkv(rng, T=16, S=16, dtype=jnp.bfloat16)
+    out = flash_attention(q, k, v, interpret=True)
+    ref = _reference_attention(
+        q.reshape(4, 16, 16), k.reshape(4, 16, 16),
+        v.reshape(4, 16, 16), None,
+    ).reshape(2, 2, 16, 16)
+    assert out.dtype == jnp.bfloat16
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(ref, np.float32),
+        atol=3e-2, rtol=3e-2,
+    )
