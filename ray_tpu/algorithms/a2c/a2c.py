@@ -78,9 +78,7 @@ class A2CJaxPolicy(JaxPolicy):
 
     def loss(self, params, batch, rng, coeffs):
         cfg = self.config
-        dist_inputs, values, _ = self.model_forward(
-            params, batch[SampleBatch.OBS]
-        )
+        dist_inputs, values, _ = self.model_forward_train(params, batch)
         dist = self.dist_class(dist_inputs)
         logp = dist.logp(batch[SampleBatch.ACTIONS])
         adv = batch[SampleBatch.ADVANTAGES]

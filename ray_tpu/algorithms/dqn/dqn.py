@@ -195,6 +195,10 @@ class DQNJaxPolicy(JaxPolicy):
     (reference rllib/utils/exploration/epsilon_greedy.py)."""
 
     default_exploration = "EpsilonGreedy"
+    # recurrent Q needs sequence replay with burn-in — that's R2D2's
+    # machinery (which sets this True); plain DQN's uniform/PER row
+    # replay cannot train an LSTM correctly
+    _supports_recurrent = False
 
     def __init__(self, observation_space, action_space, config):
         config = dict(config)
@@ -204,6 +208,14 @@ class DQNJaxPolicy(JaxPolicy):
         # recurrent path (R2D2's use_lstm) keeps the catalog LSTM whose
         # logits head IS the Q head.
         model_cfg = dict(config.get("model") or {})
+        if (
+            model_cfg.get("use_lstm") or model_cfg.get("use_attention")
+        ) and not self._supports_recurrent:
+            raise ValueError(
+                "DQN with a recurrent model (use_lstm/use_attention) "
+                "requires sequence replay — use the R2D2 algorithm "
+                "(reference r2d2.py) instead"
+            )
         self._uses_dqn_model = not any(
             model_cfg.get(k)
             for k in ("use_lstm", "use_attention", "custom_model")
@@ -267,6 +279,11 @@ class DQNJaxPolicy(JaxPolicy):
                 },
             }
         super().__init__(observation_space, action_space, config)
+        if self.model.is_recurrent and not self._supports_recurrent:
+            raise ValueError(
+                "DQN cannot train a recurrent custom model with "
+                "row replay — use R2D2 (reference r2d2.py)"
+            )
         self._steps_since_target_update = 0
 
     def _init_aux_state(self):

@@ -99,9 +99,7 @@ class MARWILJaxPolicy(JaxPolicy):
     def loss(self, params, batch, rng, coeffs):
         cfg = self.config
         beta = float(cfg.get("beta", 1.0))
-        dist_inputs, values, _ = self.model_forward(
-            params, batch[SampleBatch.OBS]
-        )
+        dist_inputs, values, _ = self.model_forward_train(params, batch)
         dist = self.dist_class(dist_inputs)
         logp = dist.logp(batch[SampleBatch.ACTIONS])
 

@@ -42,9 +42,7 @@ class PGJaxPolicy(JaxPolicy):
     """reference pg_torch_policy.py pg_torch_loss."""
 
     def loss(self, params, batch, rng, coeffs):
-        dist_inputs, _, _ = self.model_forward(
-            params, batch[SampleBatch.OBS]
-        )
+        dist_inputs, _, _ = self.model_forward_train(params, batch)
         dist = self.dist_class(dist_inputs)
         logp = dist.logp(batch[SampleBatch.ACTIONS])
         advantages = batch[SampleBatch.ADVANTAGES]

@@ -125,6 +125,8 @@ class R2D2JaxPolicy(DQNJaxPolicy):
     (reference r2d2_torch_policy.py r2d2_loss). The model's Q head is
     the recurrent wrapper's logits head."""
 
+    _supports_recurrent = True
+
     def __init__(self, observation_space, action_space, config):
         config = dict(config)
         model = dict(config.get("model") or {})
@@ -136,6 +138,11 @@ class R2D2JaxPolicy(DQNJaxPolicy):
         super().__init__(observation_space, action_space, config)
         self.seq_len = int(config.get("replay_sequence_length", 20))
         self.burn_in = int(config.get("replay_burn_in", 0))
+        # R2D2 train rows are WHOLE stored sequences (leading dim =
+        # sequence index, columns already (B, T, ...)) — the base
+        # class's flat-row unroll chopping and its T-multiple
+        # tiling/trim in prepare_batch must not apply.
+        self._unroll_T = 1
 
     def _batch_to_train_tree(self, samples):
         """Sequences arrive pre-stacked as (B, T, ...) from the

@@ -228,6 +228,9 @@ class SyncSampler:
             if self._has_state:
                 for k in range(len(self.states[i])):
                     row[f"state_in_{k}"] = self.states[i][k]
+                    # per-step state_out: GAE's recurrent bootstrap
+                    # (postprocessing.py) reads the LAST row's state
+                    row[f"state_out_{k}"] = np.asarray(state_out[k][i])
             if self._want_prev_actions:
                 row[SampleBatch.PREV_ACTIONS] = (
                     np.zeros_like(np.asarray(actions[i]))

@@ -101,10 +101,42 @@ class GTrXLNet(RTModel):
             q = q.reshape(B, T, H, Dh).transpose(0, 2, 1, 3)
             k = k.reshape(B, S, H, Dh).transpose(0, 2, 1, 3)
             v = v.reshape(B, S, H, Dh).transpose(0, 2, 1, 3)
-            # the [memory | fragment] band (k_pos - M <= q_pos) is
-            # flash_attention's causal_offset=M; fused Pallas kernel on
-            # TPU, identical XLA math elsewhere (ops/flash_attention.py)
-            out = flash_attention(q, k, v, causal_offset=M)
+            if resets is None:
+                # the [memory | fragment] band (k_pos - M <= q_pos) is
+                # flash_attention's causal_offset=M; fused Pallas
+                # kernel on TPU, identical XLA math elsewhere
+                out = flash_attention(q, k, v, causal_offset=M)
+            else:
+                # train-path episode isolation: attention must not
+                # cross a reset. Segment ids (cumsum of resets) gate
+                # fragment keys; memory keys belong to the pre-chunk
+                # segment 0, so any query past a reset (seg > 0)
+                # ignores them. Dynamic per-batch mask → XLA path.
+                seg = jnp.cumsum(
+                    resets.astype(jnp.int32), axis=1
+                )  # (B, T)
+                key_seg = jnp.concatenate(
+                    [jnp.zeros((B, M), jnp.int32), seg], axis=1
+                )  # (B, S)
+                band = (
+                    jnp.arange(S)[None, :] - M
+                    <= jnp.arange(T)[:, None]
+                )  # (T, S)
+                full_mask = (
+                    band[None]
+                    & (seg[:, :, None] == key_seg[:, None, :])
+                )  # (B, T, S)
+                scores = jnp.einsum(
+                    "bhtd,bhsd->bhts", q, k
+                ) / jnp.sqrt(jnp.float32(Dh))
+                scores = jnp.where(
+                    full_mask[:, None], scores, -1e9
+                )
+                out = jnp.einsum(
+                    "bhts,bhsd->bhtd",
+                    nn.softmax(scores, axis=-1),
+                    v,
+                )
             out = out.transpose(0, 2, 1, 3).reshape(B, T, H * Dh)
             out = nn.Dense(self.attention_dim, name=f"proj_{layer}")(out)
             x = _GRUGate(

@@ -53,6 +53,11 @@ class JaxPolicy(Policy):
     # (reference Policy._create_exploration default per algorithm).
     default_exploration: str = "StochasticSampling"
 
+    # Recurrent unroll length; instance-overridden in __init__ for
+    # recurrent models. A class default so bespoke-net policies that
+    # bypass JaxPolicy.__init__ (SAC/DDPG families) stay feedforward.
+    _unroll_T: int = 1
+
     def __init__(self, observation_space, action_space, config: Dict):
         super().__init__(observation_space, action_space, config)
         self.model_config = dict(config.get("model") or {})
@@ -63,6 +68,15 @@ class JaxPolicy(Policy):
         self.model = ModelCatalog.get_model(
             observation_space, action_space, self.num_outputs,
             self.model_config,
+        )
+        # Recurrent learn-path unroll length (reference max_seq_len,
+        # rnn_sequencing.py chop length): flat train rows are chopped
+        # into fixed (B, T) unrolls with zero initial state at chunk
+        # starts and a `resets` column at episode/fragment boundaries.
+        self._unroll_T = (
+            int(self.model_config.get("max_seq_len", 20))
+            if self.model.is_recurrent
+            else 1
         )
 
         # ---- mesh / shardings ----
@@ -420,6 +434,15 @@ class JaxPolicy(Policy):
             )
         b_loc = max(1, batch_size // n_shards)
         mb_loc = min(b_loc, max(1, self.minibatch_size // n_shards))
+        # recurrent: shuffle/gather whole T-row sequences, never rows
+        T_seq = self._unroll_T
+        if T_seq > 1:
+            if b_loc % T_seq:
+                raise ValueError(
+                    f"per-shard batch {b_loc} not a multiple of "
+                    f"max_seq_len={T_seq}"
+                )
+            mb_loc = max(T_seq, (mb_loc // T_seq) * T_seq)
         num_mb = max(1, b_loc // mb_loc)
         num_iters = self.num_sgd_iter
         tx = self._tx
@@ -450,7 +473,16 @@ class JaxPolicy(Policy):
 
             def epoch(carry, rng_e):
                 perm_rng, scan_rng = jax.random.split(rng_e)
-                perm = jax.random.permutation(perm_rng, b_loc)
+                if T_seq > 1:
+                    seq_perm = jax.random.permutation(
+                        perm_rng, b_loc // T_seq
+                    )
+                    perm = (
+                        seq_perm[:, None] * T_seq
+                        + jnp.arange(T_seq)[None, :]
+                    ).reshape(-1)
+                else:
+                    perm = jax.random.permutation(perm_rng, b_loc)
                 idx = perm[: num_mb * mb_loc].reshape(num_mb, mb_loc)
                 mb_rngs = jax.random.split(scan_rng, num_mb)
                 carry, stats = jax.lax.scan(
@@ -499,17 +531,17 @@ class JaxPolicy(Policy):
                 if isinstance(v, np.ndarray) and v.dtype != object
             }
         bsize = int(next(iter(batch.values())).shape[0])
-        if bsize < self.n_shards:
-            reps = -(-self.n_shards // bsize)
+        # recurrent batches must also divide into whole T-row unrolls
+        div = self.n_shards * self._unroll_T
+        if bsize < div:
+            reps = -(-div // bsize)
             batch = {
-                k: np.tile(v, (reps,) + (1,) * (v.ndim - 1))[
-                    : self.n_shards
-                ]
+                k: np.tile(v, (reps,) + (1,) * (v.ndim - 1))[:div]
                 for k, v in batch.items()
             }
-            bsize = self.n_shards
+            bsize = div
         else:
-            trim = (bsize // self.n_shards) * self.n_shards
+            trim = (bsize // div) * div
             if trim != bsize:
                 batch = {k: v[:trim] for k, v in batch.items()}
                 bsize = trim
@@ -580,14 +612,90 @@ class JaxPolicy(Policy):
         return {}
 
     def _batch_to_train_tree(self, samples: SampleBatch) -> Dict[str, np.ndarray]:
-        """Select training columns as a flat dict of arrays."""
+        """Select training columns as a flat dict of arrays. For
+        recurrent models, derive the per-row ``resets`` column the
+        (B, T) unroll forward consumes: 1 wherever the trajectory is
+        discontinuous (episode change, or a non-contiguous step counter
+        marking a fragment boundary between different env slots)."""
         drop = {SampleBatch.INFOS, SampleBatch.SEQ_LENS}
-        return {
+        tree = {
             k: np.asarray(v)
             for k, v in samples.items()
-            if k not in drop and isinstance(v, np.ndarray)
+            if k not in drop
+            # per-row recurrent states are rollout-side plumbing (the
+            # GAE bootstrap reads the last row host-side); the learn
+            # program builds zero chunk-start states itself, so don't
+            # ship them to device (R2D2 overrides this method and keeps
+            # the state columns its sequence loss needs)
+            and not k.startswith(("state_in_", "state_out_"))
+            and isinstance(v, np.ndarray)
             and v.dtype != object
         }
+        if self.model.is_recurrent and "resets" not in tree:
+            n = len(next(iter(tree.values())))
+            resets = np.zeros(n, np.float32)
+            # row 0 is always a trajectory start (also makes tiled
+            # copies in prepare_batch reset at each wrap point)
+            resets[0] = 1.0
+            eps = tree.get(SampleBatch.EPS_ID)
+            tcol = tree.get(SampleBatch.T)
+            if eps is not None:
+                resets[1:] = np.maximum(
+                    resets[1:], (eps[1:] != eps[:-1]).astype(np.float32)
+                )
+            if tcol is not None:
+                resets[1:] = np.maximum(
+                    resets[1:],
+                    (tcol[1:] != tcol[:-1] + 1).astype(np.float32),
+                )
+            tree["resets"] = resets
+        return tree
+
+    def model_forward_train(self, params, batch):
+        """Learn-path forward over a flat training batch. Feedforward
+        models pass through; recurrent models reshape the N flat rows
+        into (N/T, T) unrolls — zero initial state at chunk starts, the
+        ``resets`` column zeroing the carry at trajectory boundaries —
+        and return flattened (N,) outputs, so losses written against
+        flat rows work unchanged (the reference's rnn_sequencing role,
+        fixed-shape style)."""
+        obs = batch[SampleBatch.OBS]
+        if not self.model.is_recurrent:
+            return self.model.apply(params, obs)
+        T = self._unroll_T
+        N = obs.shape[0]
+        if N % T:
+            raise ValueError(
+                f"recurrent train batch of {N} rows is not a multiple "
+                f"of the unroll length max_seq_len={T}"
+            )
+        B = N // T
+        kwargs = {}
+        resets = batch.get("resets")
+        if resets is not None:
+            kwargs["resets"] = resets.reshape(B, T)
+        if getattr(self.model, "use_prev_action", False):
+            pa = batch.get(SampleBatch.PREV_ACTIONS)
+            if pa is not None:
+                kwargs["prev_actions"] = pa.reshape(
+                    (B, T) + pa.shape[1:]
+                )
+        if getattr(self.model, "use_prev_reward", False):
+            pr = batch.get(SampleBatch.PREV_REWARDS)
+            if pr is not None:
+                kwargs["prev_rewards"] = pr.reshape(B, T)
+        # Zero initial state, derived from the batch (0 * anchor) so
+        # the scan carry is device-varying under shard_map — plain
+        # jnp.zeros is axis-unvarying and trips the scan vma check.
+        anchor = obs.reshape(B, -1)[:, 0].astype(jnp.float32)
+        state0 = tuple(
+            s + 0.0 * anchor.reshape((B,) + (1,) * (s.ndim - 1))
+            for s in self.model.initial_state(B)
+        )
+        return self.model.apply(
+            params, obs.reshape((B, T) + obs.shape[1:]), state0,
+            **kwargs,
+        )
 
     # -- gradients API (A3C-style parity) --------------------------------
 
@@ -603,6 +711,18 @@ class JaxPolicy(Policy):
 
             self._grad_fn = jax.jit(gfn)
         batch = self._batch_to_train_tree(samples)
+        if self._unroll_T > 1:
+            # async-gradient batches bypass prepare_batch: trim to
+            # whole unrolls so model_forward_train's reshape holds
+            n = len(next(iter(batch.values())))
+            trim = (n // self._unroll_T) * self._unroll_T
+            if trim == 0:
+                raise ValueError(
+                    f"compute_gradients batch of {n} rows is shorter "
+                    f"than one max_seq_len={self._unroll_T} unroll"
+                )
+            if trim != n:
+                batch = {k: v[:trim] for k, v in batch.items()}
         self._rng, rng = jax.random.split(self._rng)
         grads, stats = self._grad_fn(
             self.params, self.aux_state, batch, rng, self._coeff_array()
