@@ -100,16 +100,14 @@ class APPOJaxPolicy(ImpalaJaxPolicy):
         use_kl = cfg.get("use_kl_loss", False)
         obs = batch[SampleBatch.OBS]
         B, T = obs.shape[0], obs.shape[1]
-        flat_obs = obs.reshape((B * T,) + obs.shape[2:])
 
-        dist_inputs, values, _ = self.model_forward(params, flat_obs)
-        old_inputs, _, _ = self.model_forward(
-            aux["target_params"], flat_obs
+        dist_inputs, values, bootstrap_value = self._forward_unrolls(
+            params, batch
+        )
+        old_inputs, _, _ = self._forward_unrolls(
+            aux["target_params"], batch
         )
         old_inputs = jax.lax.stop_gradient(old_inputs)
-        _, bootstrap_value, _ = self.model_forward(
-            params, batch["bootstrap_obs"]
-        )
         dist = self.dist_class(dist_inputs)
         old_dist = self.dist_class(old_inputs)
 
@@ -123,7 +121,7 @@ class APPOJaxPolicy(ImpalaJaxPolicy):
         vtr = vtrace_from_logits(
             behaviour_action_log_probs=batch[SampleBatch.ACTION_LOGP],
             target_action_log_probs=old_logp.reshape(B, T),
-            discounts=gamma * (1.0 - batch[SampleBatch.TERMINATEDS]),
+            discounts=gamma * (1.0 - batch["dones"]),
             rewards=batch[SampleBatch.REWARDS],
             values=values.reshape(B, T),
             bootstrap_value=bootstrap_value,

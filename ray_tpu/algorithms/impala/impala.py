@@ -125,6 +125,11 @@ class ImpalaJaxPolicy(JaxPolicy):
         )
         super().__init__(observation_space, action_space, config)
         self.unroll_len = T
+        # IMPALA train rows are whole (T,)-fragments shaped by
+        # _batch_to_train_tree; time-major handling lives in the loss
+        # (_forward_unrolls), so the base class's flat-row unroll
+        # chopping and T-multiple tiling must not apply.
+        self._unroll_T = 1
 
     def _batch_to_train_tree(self, samples: SampleBatch) -> Dict[str, np.ndarray]:
         """Reshape flat rows → (num_unrolls, T, ...) + bootstrap obs."""
@@ -145,6 +150,20 @@ class ImpalaJaxPolicy(JaxPolicy):
             SampleBatch.TERMINATEDS: shape_col(
                 samples[SampleBatch.TERMINATEDS]
             ).astype(np.float32),
+            # episode boundary of either kind (the reference's "dones"
+            # drives both the V-trace discount and, for recurrent
+            # models, the hidden-state reset)
+            "dones": (
+                shape_col(samples[SampleBatch.TERMINATEDS]).astype(
+                    np.float32
+                )
+                + shape_col(
+                    samples.get(
+                        SampleBatch.TRUNCATEDS,
+                        np.zeros(samples.count, np.float32),
+                    )
+                ).astype(np.float32)
+            ).clip(max=1.0),
             SampleBatch.ACTION_LOGP: shape_col(
                 samples[SampleBatch.ACTION_LOGP]
             ).astype(np.float32),
@@ -154,17 +173,49 @@ class ImpalaJaxPolicy(JaxPolicy):
         }
         return out
 
+    def _forward_unrolls(self, params, batch):
+        """Forward the (B, T) fragment batch and its bootstrap obs in
+        ONE pass over T+1 steps. Recurrent models run time-major with a
+        zero fragment-start state and within-fragment resets driven by
+        terminateds (dones already reset the V-trace discounts; this
+        makes the hidden state agree). → (dist_inputs flattened over
+        the T real steps, values (B, T), bootstrap_value (B,))."""
+        obs = batch[SampleBatch.OBS]
+        B, T = obs.shape[0], obs.shape[1]
+        obs_ext = jnp.concatenate(
+            [obs, batch["bootstrap_obs"][:, None]], axis=1
+        )
+        if self.model.is_recurrent:
+            # episodes end by termination OR truncation; the hidden
+            # state must reset at both (the rollout side did)
+            dones = batch["dones"].astype(jnp.float32)
+            resets = jnp.concatenate(
+                [jnp.ones((B, 1), jnp.float32), dones], axis=1
+            )
+            state0 = self._zero_initial_state(obs_ext, B)
+            dist_all, val_all, _ = self.model.apply(
+                params, obs_ext, state0, resets=resets
+            )
+        else:
+            flat = obs_ext.reshape((B * (T + 1),) + obs.shape[2:])
+            dist_all, val_all, _ = self.model_forward(params, flat)
+        dist_all = dist_all.reshape((B, T + 1) + dist_all.shape[1:])
+        val_all = val_all.reshape(B, T + 1)
+        dist_inputs = dist_all[:, :T].reshape(
+            (B * T,) + dist_all.shape[2:]
+        )
+        return dist_inputs, val_all[:, :T], val_all[:, -1]
+
     def loss(self, params, batch, rng, coeffs):
         cfg = self.config
         gamma = cfg.get("gamma", 0.99)
         obs = batch[SampleBatch.OBS]
         B, T = obs.shape[0], obs.shape[1]
-        flat_obs = obs.reshape((B * T,) + obs.shape[2:])
 
-        dist_inputs, values, _ = self.model_forward(params, flat_obs)
-        _, bootstrap_value, _ = self.model_forward(
-            params, batch["bootstrap_obs"]
+        dist_inputs, values, bootstrap_value = self._forward_unrolls(
+            params, batch
         )
+        values = values.reshape(B * T)
         dist = self.dist_class(dist_inputs)
 
         actions = batch[SampleBatch.ACTIONS]
@@ -175,8 +226,7 @@ class ImpalaJaxPolicy(JaxPolicy):
         vtr = vtrace_from_logits(
             behaviour_action_log_probs=batch[SampleBatch.ACTION_LOGP],
             target_action_log_probs=target_logp.reshape(B, T),
-            discounts=gamma
-            * (1.0 - batch[SampleBatch.TERMINATEDS]),
+            discounts=gamma * (1.0 - batch["dones"]),
             rewards=batch[SampleBatch.REWARDS],
             values=values.reshape(B, T),
             bootstrap_value=bootstrap_value,

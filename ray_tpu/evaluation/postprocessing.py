@@ -82,10 +82,23 @@ def compute_gae_for_sample_batch(
         last_obs = sample_batch[SampleBatch.NEXT_OBS][-1]
         state = None
         if policy.is_recurrent:
-            state = [
-                sample_batch[f"state_out_{i}"][-1][None]
-                for i in range(len(policy.get_initial_state()))
-            ]
+            last = getattr(sample_batch, "last_state_out", None)
+            if last is not None:
+                # sampler side-channel: state AFTER the last step
+                state = [np.asarray(s)[None] for s in last]
+            elif "state_out_0" in sample_batch:
+                state = [
+                    sample_batch[f"state_out_{i}"][-1][None]
+                    for i in range(len(policy.get_initial_state()))
+                ]
+            else:
+                state = [
+                    s[None]
+                    for s in (
+                        np.asarray(x)
+                        for x in policy.get_initial_state()
+                    )
+                ]
         last_r = float(policy.value_batch(last_obs[None], state)[0])
     return compute_advantages(
         sample_batch,

@@ -228,9 +228,6 @@ class SyncSampler:
             if self._has_state:
                 for k in range(len(self.states[i])):
                     row[f"state_in_{k}"] = self.states[i][k]
-                    # per-step state_out: GAE's recurrent bootstrap
-                    # (postprocessing.py) reads the LAST row's state
-                    row[f"state_out_{k}"] = np.asarray(state_out[k][i])
             if self._want_prev_actions:
                 row[SampleBatch.PREV_ACTIONS] = (
                     np.zeros_like(np.asarray(actions[i]))
@@ -287,6 +284,13 @@ class SyncSampler:
             batch.count, self.unroll_id, np.int64
         )
         self.unroll_id += 1
+        if self._has_state:
+            # side-channel for GAE's recurrent bootstrap: only the
+            # state AFTER the fragment's last step is ever needed, so
+            # don't pay a per-row state_out column for it
+            batch.last_state_out = [
+                np.asarray(s) for s in self.states[i]
+            ]
         out.append(postprocess_batch(self.policy, batch))
 
     def get_metrics(self) -> List[RolloutMetrics]:

@@ -109,22 +109,24 @@ class GTrXLNet(RTModel):
             else:
                 # train-path episode isolation: attention must not
                 # cross a reset. Segment ids (cumsum of resets) gate
-                # fragment keys; memory keys belong to the pre-chunk
-                # segment 0, so any query past a reset (seg > 0)
-                # ignores them. Dynamic per-batch mask → XLA path.
+                # FRAGMENT keys only; memory keys stay attendable like
+                # at inference (train-path memory is the zero state, so
+                # attending it reproduces the rollout-time softmax
+                # exactly — masking it would shift the denominator and
+                # bias the stored-logp ratios). Dynamic mask → XLA path.
                 seg = jnp.cumsum(
                     resets.astype(jnp.int32), axis=1
                 )  # (B, T)
-                key_seg = jnp.concatenate(
-                    [jnp.zeros((B, M), jnp.int32), seg], axis=1
-                )  # (B, S)
                 band = (
                     jnp.arange(S)[None, :] - M
                     <= jnp.arange(T)[:, None]
                 )  # (T, S)
-                full_mask = (
-                    band[None]
-                    & (seg[:, :, None] == key_seg[:, None, :])
+                frag_ok = (
+                    seg[:, :, None] == seg[:, None, :]
+                )  # (B, T, T)
+                mem_ok = jnp.ones((B, T, M), bool)
+                full_mask = band[None] & jnp.concatenate(
+                    [mem_ok, frag_ok], axis=-1
                 )  # (B, T, S)
                 scores = jnp.einsum(
                     "bhtd,bhsd->bhts", q, k

@@ -684,17 +684,21 @@ class JaxPolicy(Policy):
             pr = batch.get(SampleBatch.PREV_REWARDS)
             if pr is not None:
                 kwargs["prev_rewards"] = pr.reshape(B, T)
-        # Zero initial state, derived from the batch (0 * anchor) so
-        # the scan carry is device-varying under shard_map — plain
-        # jnp.zeros is axis-unvarying and trips the scan vma check.
-        anchor = obs.reshape(B, -1)[:, 0].astype(jnp.float32)
-        state0 = tuple(
-            s + 0.0 * anchor.reshape((B,) + (1,) * (s.ndim - 1))
-            for s in self.model.initial_state(B)
-        )
+        state0 = self._zero_initial_state(obs, B)
         return self.model.apply(
             params, obs.reshape((B, T) + obs.shape[1:]), state0,
             **kwargs,
+        )
+
+    def _zero_initial_state(self, obs, B: int):
+        """Zero recurrent state for B unrolls, derived from the batch
+        (0 * anchor) so the scan carry is device-varying under
+        shard_map — plain jnp.zeros is axis-unvarying and trips the
+        lax.scan vma check inside the sharded learn program."""
+        anchor = obs.reshape(B, -1)[:, 0].astype(jnp.float32)
+        return tuple(
+            s + 0.0 * anchor.reshape((B,) + (1,) * (s.ndim - 1))
+            for s in self.model.initial_state(B)
         )
 
     # -- gradients API (A3C-style parity) --------------------------------
