@@ -51,6 +51,55 @@ def h_inverse(x, epsilon: float = 1e-3):
     return jnp.where(x < 0.0, if_neg, if_pos)
 
 
+def chop_fragment_into_sequences(
+    batch, T: int, columns, *, first_row_is_reset: bool = True
+):
+    """Chop a flat rollout fragment into fixed-length sequence dicts
+    with ``resets`` (episode-restart flags from EPS_ID changes) and a
+    right-zero ``mask`` column. Shared by R2D2 and RNNSAC. Integer
+    columns keep their dtype; everything else casts to float32.
+
+    The fragment's first row only counts as a restart when
+    ``first_row_is_reset`` (the zero-init strategy): with stored state,
+    the sampler's state_in at offset 0 is already correct (zero iff a
+    real episode start), and a forced reset would wipe mid-episode
+    carries. Yields ``(start_row, seq_dict)`` so callers can attach
+    stored-state columns."""
+    n = batch.count
+    eps_ids = np.asarray(
+        batch.get(SampleBatch.EPS_ID, np.zeros(n, np.int64))
+    )
+    resets_all = np.zeros(n, np.float32)
+    resets_all[0] = 1.0 if first_row_is_reset else 0.0
+    resets_all[1:] = (eps_ids[1:] != eps_ids[:-1]).astype(np.float32)
+    out = []
+    for start in range(0, n, T):
+        end = min(start + T, n)
+        L = end - start
+        seq: Dict[str, np.ndarray] = {}
+        for k in columns:
+            v = np.asarray(batch[k])[start:end]
+            if L < T:  # right-zero-pad to the fixed length
+                pad = np.zeros((T - L,) + v.shape[1:], v.dtype)
+                v = np.concatenate([v, pad], axis=0)
+            seq[k] = (
+                v
+                if np.issubdtype(v.dtype, np.integer)
+                else v.astype(np.float32)
+            )
+        mask = np.zeros(T, np.float32)
+        mask[:L] = 1.0
+        seq["mask"] = mask
+        resets = resets_all[start:end]
+        if L < T:
+            resets = np.concatenate(
+                [resets, np.zeros(T - L, np.float32)]
+            )
+        seq["resets"] = resets
+        out.append((start, seq))
+    return out
+
+
 class SequenceReplayBuffer:
     """Uniform replay over fixed-length sequences with stored initial
     recurrent state (reference replay_sequence_length storage mode of
@@ -245,50 +294,17 @@ class R2D2(DQN):
         zero_init = bool(cfg.get("zero_init_states", True))
         policy = self.get_policy()
         cell = policy.model.initial_state(1)
-        n = batch.count
-        eps_ids = np.asarray(
-            batch.get(
-                SampleBatch.EPS_ID, np.zeros(n, np.int64)
-            )
-        )
-        # Episode-restart flags per row (first row of each episode).
-        # The fragment's first row only counts as a restart under the
-        # zero-init strategy: with stored state, the sampler's state_in
-        # at offset 0 is already correct (zero iff a real episode
-        # start), and a forced reset would wipe mid-episode carries.
-        resets_all = np.zeros(n, np.float32)
-        resets_all[0] = 1.0 if zero_init else 0.0
-        resets_all[1:] = (eps_ids[1:] != eps_ids[:-1]).astype(
-            np.float32
-        )
-        for start in range(0, n, T):
-            end = min(start + T, n)
-            L = end - start
-            seq: Dict[str, np.ndarray] = {}
-            for k in (
+        for start, seq in chop_fragment_into_sequences(
+            batch,
+            T,
+            (
                 SampleBatch.OBS,
                 SampleBatch.ACTIONS,
                 SampleBatch.REWARDS,
                 SampleBatch.TERMINATEDS,
-            ):
-                v = np.asarray(batch[k])[start:end]
-                if L < T:  # right-zero-pad to the fixed length
-                    pad = np.zeros((T - L,) + v.shape[1:], v.dtype)
-                    v = np.concatenate([v, pad], axis=0)
-                seq[k] = (
-                    v
-                    if np.issubdtype(v.dtype, np.integer)
-                    else v.astype(np.float32)
-                )
-            mask = np.zeros(T, np.float32)
-            mask[:L] = 1.0
-            seq["mask"] = mask
-            resets = resets_all[start:end]
-            if L < T:
-                resets = np.concatenate(
-                    [resets, np.zeros(T - L, np.float32)]
-                )
-            seq["resets"] = resets
+            ),
+            first_row_is_reset=zero_init,
+        ):
             if zero_init or f"state_in_0" not in batch:
                 seq["state_in_0"] = np.zeros_like(
                     np.asarray(cell[0][0])

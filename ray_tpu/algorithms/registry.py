@@ -14,6 +14,7 @@ _BUILTINS: Dict[str, Tuple[str, str]] = {
     "DDPPO": ("ray_tpu.algorithms.ddppo.ddppo", "DDPPO"),
     "IMPALA": ("ray_tpu.algorithms.impala.impala", "IMPALA"),
     "SAC": ("ray_tpu.algorithms.sac.sac", "SAC"),
+    "RNNSAC": ("ray_tpu.algorithms.sac.rnnsac", "RNNSAC"),
     "DQN": ("ray_tpu.algorithms.dqn.dqn", "DQN"),
     "SimpleQ": ("ray_tpu.algorithms.dqn.dqn", "SimpleQ"),
     "A2C": ("ray_tpu.algorithms.a2c.a2c", "A2C"),

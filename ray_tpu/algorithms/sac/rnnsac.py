@@ -1,0 +1,411 @@
+"""RNNSAC: recurrent soft actor-critic.
+
+Counterpart of the reference's ``rllib/algorithms/sac/rnnsac.py`` (+
+``rnnsac_torch_model.py``, ``rnnsac_torch_policy.py``): SAC where the
+actor and both twin Q functions carry their own LSTM over the
+observation (and action, for Q) sequence, trained on fixed-length
+replayed sequences with an optional burn-in prefix excluded from the
+losses.
+
+TPU-first shape: the reference threads seq-lens and per-net state dicts
+through three torch optimizers; here each net is a flax module whose
+sequence forward is one ``nn.scan`` (reset-masked LSTM carry, zero
+initial state — the ``zero_init_states=True`` strategy; the stored-state
+strategy is R2D2's corner and out of scope here), and the whole
+actor/critic/alpha update over a [B, T] sequence batch stays ONE jitted
+shard_map program like flat SAC. With zero-init states the reference's
+"forward next-obs sequences with the time-t state" equals our zero-state
+next-obs forward exactly.
+
+Replay mirrors R2D2: rollout fragments are chopped into fixed-length
+sequences with resets + padding masks (``r2d2.py _fragments_to_sequences``)
+and sampled uniformly from a sequence buffer.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.algorithms.r2d2.r2d2 import (
+    SequenceReplayBuffer,
+    chop_fragment_into_sequences,
+)
+from ray_tpu.algorithms.sac.sac import (
+    SAC,
+    SACConfig,
+    SACJaxPolicy,
+)
+from ray_tpu.data.sample_batch import DEFAULT_POLICY_ID, SampleBatch
+from ray_tpu.execution.rollout_ops import synchronous_parallel_sample
+from ray_tpu.execution.train_ops import (
+    NUM_ENV_STEPS_TRAINED,
+)
+from ray_tpu.algorithms.algorithm import NUM_ENV_STEPS_SAMPLED
+from ray_tpu.models.base import get_activation
+from ray_tpu.models.distributions import SquashedGaussian
+from jax.sharding import PartitionSpec as P
+
+
+def _lstm_scan(cell, x, resets, cell_size):
+    """Reset-masked LSTM over (B, T, F); zero initial carry made
+    device-varying by anchoring to the input (shard_map vma)."""
+    B = x.shape[0]
+    anchor = 0.0 * x[:, 0, :1]  # (B, 1) zeros, varying
+    zeros = jnp.zeros((B, cell_size), jnp.float32) + anchor
+    carry0 = (zeros, zeros)
+
+    def step(cell, carry, inputs):
+        xt, reset_t = inputs
+        keep = (1.0 - reset_t)[:, None]
+        carry = (carry[0] * keep, carry[1] * keep)
+        carry, y = cell(carry, xt)
+        return carry, y
+
+    scan = nn.scan(
+        step,
+        variable_broadcast="params",
+        split_rngs={"params": False},
+        in_axes=1,
+        out_axes=1,
+    )
+    carry, y = scan(cell, carry0, (x, resets.astype(jnp.float32)))
+    return carry, y
+
+
+class _RNNActorNet(nn.Module):
+    """Dense trunk → LSTM → squashed-Gaussian head, over sequences
+    (reference rnnsac policy model: use_lstm wrapper on the actor)."""
+
+    action_dim: int
+    hiddens: Sequence[int] = (256,)
+    cell_size: int = 64
+    activation: str = "relu"
+
+    def setup(self):
+        self._fcs = [nn.Dense(h) for h in self.hiddens]
+        self._cell = nn.OptimizedLSTMCell(self.cell_size)
+        self._head = nn.Dense(2 * self.action_dim)
+
+    def _trunk(self, x):
+        act = get_activation(self.activation)
+        for fc in self._fcs:
+            x = act(fc(x))
+        return x
+
+    def __call__(self, obs, resets):
+        """obs (B, T, obs…), resets (B, T) → dist inputs (B, T, 2A)."""
+        B, T = obs.shape[:2]
+        x = self._trunk(obs.astype(jnp.float32).reshape(B, T, -1))
+        _, y = _lstm_scan(self._cell, x, resets, self.cell_size)
+        return self._head(y)
+
+    def step(self, obs, h, c):
+        """One acting step: obs (B, obs…), carried (h, c) → (dist
+        inputs (B, 2A), new_h, new_c)."""
+        x = self._trunk(
+            obs.astype(jnp.float32).reshape(obs.shape[0], -1)
+        )
+        (new_c, new_h), y = self._cell((c, h), x)
+        return self._head(y), new_h, new_c
+
+
+class _RNNTwinQNet(nn.Module):
+    """Two independent recurrent Q functions over (obs, action)
+    sequences (reference rnnsac q/twin_q nets with use_lstm)."""
+
+    hiddens: Sequence[int] = (256,)
+    cell_size: int = 64
+    activation: str = "relu"
+
+    def setup(self):
+        self._fcs = {
+            name: [nn.Dense(h) for h in self.hiddens]
+            for name in ("q1", "q2")
+        }
+        self._cells = {
+            name: nn.OptimizedLSTMCell(self.cell_size)
+            for name in ("q1", "q2")
+        }
+        self._heads = {name: nn.Dense(1) for name in ("q1", "q2")}
+
+    def __call__(self, obs, actions, resets):
+        """→ (q1 (B, T), q2 (B, T))."""
+        B, T = obs.shape[:2]
+        x0 = jnp.concatenate(
+            [
+                obs.astype(jnp.float32).reshape(B, T, -1),
+                actions.astype(jnp.float32).reshape(B, T, -1),
+            ],
+            axis=-1,
+        )
+        act = get_activation(self.activation)
+        qs = []
+        for name in ("q1", "q2"):
+            x = x0
+            for fc in self._fcs[name]:
+                x = act(fc(x))
+            _, y = _lstm_scan(
+                self._cells[name], x, resets, self.cell_size
+            )
+            qs.append(self._heads[name](y)[..., 0])
+        return qs[0], qs[1]
+
+
+class RNNSACConfig(SACConfig):
+    """reference rnnsac.py RNNSACConfig."""
+
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class or RNNSAC)
+        self.replay_sequence_length = 20
+        self.replay_burn_in = 0
+        self.zero_init_states = True
+        # capacity counts SEQUENCES here (SAC's inherited value counts
+        # flat transitions; 2000 sequences ≈ 40k transitions, matching
+        # R2D2's default)
+        self.replay_buffer_config = {
+            **(getattr(self, "replay_buffer_config", None) or {}),
+            "capacity": 2000,
+        }
+        self.policy_model_config = {
+            **(getattr(self, "policy_model_config", None) or {}),
+            "lstm_cell_size": 64,
+        }
+        self.q_model_config = {
+            **(getattr(self, "q_model_config", None) or {}),
+            "lstm_cell_size": 64,
+        }
+
+    def training(
+        self,
+        *,
+        replay_sequence_length: Optional[int] = None,
+        replay_burn_in: Optional[int] = None,
+        **kwargs,
+    ) -> "RNNSACConfig":
+        super().training(**kwargs)
+        if replay_sequence_length is not None:
+            self.replay_sequence_length = replay_sequence_length
+        if replay_burn_in is not None:
+            self.replay_burn_in = replay_burn_in
+        return self
+
+
+class RNNSACJaxPolicy(SACJaxPolicy):
+    """Sequence-shaped fused actor/critic/alpha update. Train batches
+    are stacked fixed-length sequences (leading dim = sequence)."""
+
+    def _make_nets(self, pm_cfg, qm_cfg):
+        actor = _RNNActorNet(
+            self.action_dim,
+            tuple(pm_cfg.get("fcnet_hiddens", (256,))),
+            int(pm_cfg.get("lstm_cell_size", 64)),
+            pm_cfg.get("fcnet_activation", "relu"),
+        )
+        critic = _RNNTwinQNet(
+            tuple(qm_cfg.get("fcnet_hiddens", (256,))),
+            int(qm_cfg.get("lstm_cell_size", 64)),
+            qm_cfg.get("fcnet_activation", "relu"),
+        )
+        return actor, critic
+
+    def _init_net_params(self, r1, r2):
+        obs_shape = tuple(self.observation_space.shape)
+        dummy_obs = jnp.zeros((2, 3) + obs_shape, jnp.float32)
+        dummy_act = jnp.zeros((2, 3, self.action_dim), jnp.float32)
+        dummy_resets = jnp.zeros((2, 3), jnp.float32)
+        return (
+            self.actor.init(r1, dummy_obs, dummy_resets),
+            self.critic.init(r2, dummy_obs, dummy_act, dummy_resets),
+        )
+
+    def get_initial_state(self):
+        cell = int(
+            (self.config.get("policy_model_config") or {}).get(
+                "lstm_cell_size", 64
+            )
+        )
+        return [
+            np.zeros(cell, np.float32),  # h
+            np.zeros(cell, np.float32),  # c
+        ]
+
+    # -- acting (recurrent step) ------------------------------------------
+
+    def _build_action_fn(self):
+        actor = self.actor
+        low, high = self.low, self.high
+        exploration = self.exploration
+
+        def fn(params, obs, h, c, rng, explore, coeffs, expl_state):
+            dist_inputs, new_h, new_c = actor.apply(
+                params["actor"], obs, h, c,
+                method=_RNNActorNet.step,
+            )
+            dist = SquashedGaussian(dist_inputs, low=low, high=high)
+            actions, logp, expl_state = exploration.sample_fn(
+                dist, rng, explore, coeffs, expl_state
+            )
+            return (
+                actions,
+                new_h,
+                new_c,
+                {SampleBatch.ACTION_LOGP: logp},
+                expl_state,
+            )
+
+        return jax.jit(fn, static_argnames=("explore",))
+
+    def compute_actions(
+        self, obs_batch, state_batches=None, explore=True, **kwargs
+    ):
+        if self._action_fn is None:
+            self._action_fn = self._build_action_fn()
+        self.exploration.update_coeffs(
+            self.coeff_values, self.global_timestep
+        )
+        params = self.exploration.params_for_inference(self, explore)
+        self._rng, rng = jax.random.split(self._rng)
+        obs = jnp.asarray(obs_batch)
+        bsize = int(obs.shape[0])
+        if not state_batches:
+            init = self.get_initial_state()
+            state_batches = [
+                np.tile(s[None], (bsize, 1)) for s in init
+            ]
+        h = jnp.asarray(state_batches[0], jnp.float32)
+        c = jnp.asarray(state_batches[1], jnp.float32)
+        if self._expl_state_batch != bsize:
+            self._expl_state = self.exploration.initial_state(bsize)
+            self._expl_state_batch = bsize
+        actions, new_h, new_c, extra, self._expl_state = (
+            self._action_fn(
+                params, obs, h, c, rng, bool(explore),
+                self._coeff_array(), self._expl_state,
+            )
+        )
+        return (
+            np.asarray(actions),
+            [np.asarray(new_h), np.asarray(new_c)],
+            {k: np.asarray(v) for k, v in extra.items()},
+        )
+
+    # -- learning ----------------------------------------------------------
+    # The fused actor/critic/alpha device_fn is SACJaxPolicy's; the
+    # three hooks below make it sequence-shaped.
+
+    def _batch_to_train_tree(self, samples):
+        tree = super()._batch_to_train_tree(samples)
+        tree["resets"] = np.asarray(samples["resets"], np.float32)
+        tree["mask"] = np.asarray(samples["mask"], np.float32)
+        return tree
+
+    def _seq_resets(self, batch):
+        resets = batch["resets"].astype(jnp.float32)
+        not_done = 1.0 - batch[SampleBatch.TERMINATEDS].astype(
+            jnp.float32
+        )
+        # next-obs sequences: the boundary AFTER a done row starts the
+        # next episode, so shift dones into the resets stream
+        resets_tp1 = jnp.concatenate(
+            [resets[:, :1], (1.0 - not_done)[:, :-1]], axis=1
+        )
+        return resets, jnp.maximum(resets_tp1, resets)
+
+    def _net_forward(self, net, params, *args, resets=None):
+        return net.apply(params, *args, resets)
+
+    def _loss_mask(self, batch):
+        mask = batch["mask"].astype(jnp.float32)
+        burn_in = int(self.config.get("replay_burn_in", 0))
+        if burn_in > 0:
+            T = mask.shape[1]
+            mask = mask * (
+                jnp.arange(T)[None, :] >= burn_in
+            ).astype(jnp.float32)
+        return mask
+
+
+class RNNSAC(SAC):
+    """Sequence-replay SAC trainer (reference rnnsac.py RNNSAC):
+    fragments chop into fixed-length sequences like R2D2; the policy's
+    polyak target update happens inside the fused step, so no separate
+    target sync is needed."""
+
+    _default_policy_class = RNNSACJaxPolicy
+
+    @classmethod
+    def get_default_config(cls) -> RNNSACConfig:
+        return RNNSACConfig(cls)
+
+    def setup(self, config: Dict) -> None:
+        if not config.get("zero_init_states", True):
+            raise ValueError(
+                "RNNSAC supports only zero_init_states=True (the "
+                "stored-state strategy is R2D2's corner — "
+                "r2d2.py _fragments_to_sequences)"
+            )
+        super().setup(config)
+        rb = config.get("replay_buffer_config") or {}
+        self.local_replay_buffer = None  # SAC's flat buffer unused
+        self.seq_buffer = SequenceReplayBuffer(
+            rb.get("capacity", 2000), seed=config.get("seed")
+        )
+
+    def _fragments_to_sequences(self, batch: SampleBatch) -> None:
+        """The shared chopper with SAC's columns (adds NEXT_OBS)."""
+        T = int(self.config.get("replay_sequence_length", 20))
+        for _, seq in chop_fragment_into_sequences(
+            batch,
+            T,
+            (
+                SampleBatch.OBS,
+                SampleBatch.NEXT_OBS,
+                SampleBatch.ACTIONS,
+                SampleBatch.REWARDS,
+                SampleBatch.TERMINATEDS,
+            ),
+            first_row_is_reset=True,
+        ):
+            self.seq_buffer.add_sequence(seq)
+
+    def training_step(self) -> Dict:
+        config = self.config
+        batch = synchronous_parallel_sample(
+            worker_set=self.workers,
+            max_env_steps=config.get("rollout_fragment_length", 20),
+        )
+        self._counters[NUM_ENV_STEPS_SAMPLED] += batch.env_steps()
+        if hasattr(batch, "policy_batches"):
+            batch = batch.policy_batches[DEFAULT_POLICY_ID]
+        self._fragments_to_sequences(batch)
+
+        train_info: Dict = {}
+        num_seqs = max(
+            1,
+            int(config["train_batch_size"])
+            // int(config.get("replay_sequence_length", 20)),
+        )
+        if (
+            self._counters[NUM_ENV_STEPS_SAMPLED]
+            >= config.get("num_steps_sampled_before_learning_starts", 0)
+            and len(self.seq_buffer) >= num_seqs
+        ):
+            seqs = self.seq_buffer.sample(num_seqs)
+            policy = self.get_policy()
+            info = policy.learn_on_batch(SampleBatch(seqs))
+            train_info = {DEFAULT_POLICY_ID: info}
+            self._counters[NUM_ENV_STEPS_TRAINED] += int(
+                seqs["mask"].sum()
+            )
+        self.workers.sync_weights(
+            global_vars={
+                "timestep": self._counters[NUM_ENV_STEPS_SAMPLED]
+            }
+        )
+        return train_info

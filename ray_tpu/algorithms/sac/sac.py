@@ -146,24 +146,12 @@ class SACJaxPolicy(JaxPolicy):
 
         pm_cfg = config.get("policy_model_config") or {}
         qm_cfg = config.get("q_model_config") or {}
-        self.actor = _ActorNet(
-            self.action_dim,
-            tuple(pm_cfg.get("fcnet_hiddens", (256, 256))),
-            pm_cfg.get("fcnet_activation", "relu"),
-        )
-        self.critic = _TwinQNet(
-            tuple(qm_cfg.get("fcnet_hiddens", (256, 256))),
-            qm_cfg.get("fcnet_activation", "relu"),
-        )
+        self.actor, self.critic = self._make_nets(pm_cfg, qm_cfg)
 
         seed = int(config.get("seed") or 0)
         self._rng = jax.random.PRNGKey(seed)
         self._rng, r1, r2 = jax.random.split(self._rng, 3)
-        obs_shape = observation_space.shape
-        dummy_obs = jnp.zeros((2,) + tuple(obs_shape), jnp.float32)
-        dummy_act = jnp.zeros((2, self.action_dim), jnp.float32)
-        actor_params = self.actor.init(r1, dummy_obs)
-        critic_params = self.critic.init(r2, dummy_obs, dummy_act)
+        actor_params, critic_params = self._init_net_params(r1, r2)
         log_alpha = jnp.asarray(
             np.log(config.get("initial_alpha", 1.0)), jnp.float32
         )
@@ -219,6 +207,30 @@ class SACJaxPolicy(JaxPolicy):
     def get_initial_state(self):
         return []
 
+    # -- net construction (overridden by RNNSAC) -------------------------
+
+    def _make_nets(self, pm_cfg, qm_cfg):
+        actor = _ActorNet(
+            self.action_dim,
+            tuple(pm_cfg.get("fcnet_hiddens", (256, 256))),
+            pm_cfg.get("fcnet_activation", "relu"),
+        )
+        critic = _TwinQNet(
+            tuple(qm_cfg.get("fcnet_hiddens", (256, 256))),
+            qm_cfg.get("fcnet_activation", "relu"),
+        )
+        return actor, critic
+
+    def _init_net_params(self, r1, r2):
+        dummy_obs = jnp.zeros(
+            (2,) + tuple(self.observation_space.shape), jnp.float32
+        )
+        dummy_act = jnp.zeros((2, self.action_dim), jnp.float32)
+        return (
+            self.actor.init(r1, dummy_obs),
+            self.critic.init(r2, dummy_obs, dummy_act),
+        )
+
     # -- inference -------------------------------------------------------
 
     def _build_action_fn(self):
@@ -269,6 +281,22 @@ class SACJaxPolicy(JaxPolicy):
 
     # -- learning --------------------------------------------------------
 
+    # Hooks the recurrent subclass overrides so ONE fused device_fn
+    # serves both flat and sequence SAC:
+
+    def _seq_resets(self, batch):
+        """→ (resets for time-t forwards, resets for next-obs
+        forwards); None for feedforward nets."""
+        return None, None
+
+    def _net_forward(self, net, params, *args, resets=None):
+        """Apply an actor/critic net; feedforward nets ignore resets."""
+        return net.apply(params, *args)
+
+    def _loss_mask(self, batch):
+        """Per-element validity mask for the losses (None = all)."""
+        return None
+
     def _build_learn_fn(self, batch_size: int):
         actor, critic = self.actor, self.critic
         tx_a, tx_c, tx_al = (
@@ -289,6 +317,16 @@ class SACJaxPolicy(JaxPolicy):
                 jnp.float32
             )
             actions = batch[SampleBatch.ACTIONS].astype(jnp.float32)
+            resets_t, resets_tp1 = self._seq_resets(batch)
+            mask = self._loss_mask(batch)
+            if mask is None:
+                mean = jnp.mean
+            else:
+                denom = jnp.maximum(jnp.sum(mask), 1.0)
+
+                def mean(x):
+                    return jnp.sum(x * mask) / denom
+
             rng = jax.random.fold_in(
                 rng, jax.lax.axis_index("data")
             )
@@ -297,11 +335,16 @@ class SACJaxPolicy(JaxPolicy):
 
             # ---- critic update ----
             next_dist = SquashedGaussian(
-                actor.apply(params["actor"], next_obs), low=low, high=high
+                self._net_forward(
+                    actor, params["actor"], next_obs,
+                    resets=resets_tp1,
+                ),
+                low=low, high=high,
             )
             next_a, next_logp = next_dist.sampled_action_logp(rng_t)
-            tq1, tq2 = critic.apply(
-                aux["target_critic"], next_obs, next_a
+            tq1, tq2 = self._net_forward(
+                critic, aux["target_critic"], next_obs, next_a,
+                resets=resets_tp1,
             )
             target_q = jnp.minimum(tq1, tq2) - alpha * next_logp
             td_target = jax.lax.stop_gradient(
@@ -309,10 +352,12 @@ class SACJaxPolicy(JaxPolicy):
             )
 
             def critic_loss(cp):
-                q1, q2 = critic.apply(cp, obs, actions)
+                q1, q2 = self._net_forward(
+                    critic, cp, obs, actions, resets=resets_t
+                )
                 return (
-                    jnp.mean(jnp.square(q1 - td_target))
-                    + jnp.mean(jnp.square(q2 - td_target))
+                    mean(jnp.square(q1 - td_target))
+                    + mean(jnp.square(q2 - td_target))
                 ), (q1, q2)
 
             (c_loss, (q1, q2)), c_grads = jax.value_and_grad(
@@ -327,11 +372,16 @@ class SACJaxPolicy(JaxPolicy):
             # ---- actor update (uses the fresh critic) ----
             def actor_loss(ap):
                 dist = SquashedGaussian(
-                    actor.apply(ap, obs), low=low, high=high
+                    self._net_forward(
+                        actor, ap, obs, resets=resets_t
+                    ),
+                    low=low, high=high,
                 )
                 a, logp = dist.sampled_action_logp(rng_a)
-                aq1, aq2 = critic.apply(new_critic, obs, a)
-                return jnp.mean(
+                aq1, aq2 = self._net_forward(
+                    critic, new_critic, obs, a, resets=resets_t
+                )
+                return mean(
                     alpha * logp - jnp.minimum(aq1, aq2)
                 ), logp
 
@@ -346,7 +396,7 @@ class SACJaxPolicy(JaxPolicy):
 
             # ---- alpha update ----
             def alpha_loss(log_alpha):
-                return -jnp.mean(
+                return -mean(
                     log_alpha
                     * jax.lax.stop_gradient(logp_pi + target_entropy)
                 )
@@ -385,7 +435,7 @@ class SACJaxPolicy(JaxPolicy):
                 "critic_loss": c_loss,
                 "alpha_loss": al_loss,
                 "alpha_value": alpha,
-                "mean_q": jnp.mean(jnp.minimum(q1, q2)),
+                "mean_q": mean(jnp.minimum(q1, q2)),
                 "total_loss": a_loss + c_loss + al_loss,
             }
             stats = jax.tree_util.tree_map(
