@@ -69,20 +69,18 @@ def _reference_attention(q, k, v, causal_offset):
     )
 
 
-def _fwd_kernel(
-    q_ref, k_ref, v_ref, o_ref, *, s_actual, causal_offset, block_k
+def _online_softmax_stream(
+    q_ref, k_ref, v_ref, row, offset, s_actual, block_k
 ):
-    """One (batch·head, query-block) program: stream key blocks through
-    VMEM carrying the online-softmax (m, l, acc) statistics."""
-    qi = pl.program_id(1)
+    """The shared online-softmax recurrence: stream key blocks through
+    VMEM carrying (m, l, acc). ``offset`` may be a static int or a
+    traced scalar (key j valid iff ``j <= row + offset``); ``None``
+    disables the band. Returns float32 (m (BQ,1), l (BQ,1),
+    acc (BQ,D) UNNORMALIZED)."""
     q = q_ref[0].astype(jnp.float32)  # (BQ, D)
     bq, d = q.shape
-    scale = 1.0 / jnp.sqrt(jnp.float32(d))
-    q = q * scale
-    s_pad = k_ref.shape[1]
-    num_kb = s_pad // block_k
-
-    row = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, 1), 0)
+    q = q * (1.0 / jnp.sqrt(jnp.float32(d)))
+    num_kb = k_ref.shape[1] // block_k
 
     def body(kb, carry):
         m_prev, l_prev, acc = carry
@@ -97,8 +95,8 @@ def _fwd_kernel(
             jnp.int32, (1, block_k), 1
         )
         valid = col < s_actual
-        if causal_offset is not None:
-            valid = valid & (col <= row + causal_offset)
+        if offset is not None:
+            valid = valid & (col <= row + offset)
         s = jnp.where(valid, s, _NEG_INF)
         m_cur = jnp.max(s, axis=-1, keepdims=True)
         m_new = jnp.maximum(m_prev, m_cur)
@@ -113,9 +111,94 @@ def _fwd_kernel(
     m0 = jnp.full((bq, 1), _NEG_INF, jnp.float32)
     l0 = jnp.zeros((bq, 1), jnp.float32)
     acc0 = jnp.zeros((bq, d), jnp.float32)
-    _, l, acc = jax.lax.fori_loop(0, num_kb, body, (m0, l0, acc0))
-    out = acc / jnp.maximum(l, 1e-30)
-    o_ref[0] = out.astype(o_ref.dtype)
+    return jax.lax.fori_loop(0, num_kb, body, (m0, l0, acc0))
+
+
+def _fwd_kernel(
+    q_ref, k_ref, v_ref, o_ref, *, s_actual, causal_offset, block_k
+):
+    """One (batch·head, query-block) program producing NORMALIZED
+    attention output (static banded offset)."""
+    qi = pl.program_id(1)
+    bq = q_ref.shape[1]
+    row = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, 1), 0)
+    _, l, acc = _online_softmax_stream(
+        q_ref, k_ref, v_ref, row, causal_offset, s_actual, block_k
+    )
+    o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
+def _block_kernel(
+    off_ref, q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, *,
+    s_actual, block_k,
+):
+    """Stats-returning variant for ring attention: the same shared
+    online-softmax stream, but the banded-causal offset is a RUNTIME
+    scalar (SMEM) — inside a shard_map ring the offset depends on the
+    traced device index — and the per-row (max, sum) statistics are
+    emitted so ring hops can merge partial results exactly."""
+    qi = pl.program_id(1)
+    bq = q_ref.shape[1]
+    row = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, 1), 0)
+    m, l, acc = _online_softmax_stream(
+        q_ref, k_ref, v_ref, row, off_ref[0], s_actual, block_k
+    )
+    o_ref[0] = acc  # UNNORMALIZED accumulator (caller merges/divides)
+    m_ref[0] = m
+    l_ref[0] = l
+
+
+def flash_block_attention_stats(q, k, v, offset, *, interpret=False):
+    """One attention block with running statistics, for ring attention.
+
+    q: (N, T, D); k, v: (N, S, D); offset: int32 scalar array — key j
+    is visible to query i iff ``j <= i + offset`` (pass S for "no
+    mask"). Returns (acc (N, T, D) float32 UNNORMALIZED, m (N, T), l
+    (N, T)) — exactly the quantities the flash merge combines across
+    blocks. Forward-only (ring-level callers own differentiation)."""
+    n, t, d = q.shape
+    s = k.shape[1]
+    bq = min(_BLOCK_Q, max(8, t))
+    bk = min(_BLOCK_K, max(8, s))
+    qp = _pad_to(q, 1, bq)
+    kp = _pad_to(k, 1, bk)
+    vp = _pad_to(v, 1, bk)
+    tp = qp.shape[1]
+    grid = (n, tp // bq)
+    vmem = {} if _VMEM is None else {"memory_space": _VMEM}
+    smem = (
+        {}
+        if _VMEM is None
+        else {"memory_space": pltpu.SMEM}
+    )
+    acc, m, l = pl.pallas_call(
+        functools.partial(
+            _block_kernel, s_actual=s, block_k=bk
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct((n, tp, d), jnp.float32),
+            jax.ShapeDtypeStruct((n, tp, 1), jnp.float32),
+            jax.ShapeDtypeStruct((n, tp, 1), jnp.float32),
+        ],
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1,), lambda b, i: (0,), **smem),
+            pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0), **vmem),
+            pl.BlockSpec(
+                (1, kp.shape[1], d), lambda b, i: (b, 0, 0), **vmem
+            ),
+            pl.BlockSpec(
+                (1, kp.shape[1], d), lambda b, i: (b, 0, 0), **vmem
+            ),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0), **vmem),
+            pl.BlockSpec((1, bq, 1), lambda b, i: (b, i, 0), **vmem),
+            pl.BlockSpec((1, bq, 1), lambda b, i: (b, i, 0), **vmem),
+        ],
+        interpret=interpret,
+    )(jnp.asarray(offset, jnp.int32).reshape(1), qp, kp, vp)
+    return acc[:, :t], m[:, :t, 0], l[:, :t, 0]
 
 
 def _pad_to(x, axis, multiple):
