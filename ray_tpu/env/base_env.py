@@ -1,0 +1,226 @@
+"""BaseEnv: the unified poll/send async environment interface.
+
+Counterpart of the reference's ``rllib/env/base_env.py`` (``BaseEnv
+:18``, ``poll :121``, ``send_actions :146``): the lowest-level env API
+every other env type converts down to — ``poll()`` returns whatever
+observations are ready as ``{env_id: {agent_id: obs}}`` dicts and
+``send_actions()`` pushes the matching actions. Gym envs, VectorEnv and
+MultiAgentEnv all convert via :func:`convert_to_base_env`.
+
+In this framework the samplers drive :class:`VectorEnv` directly (the
+hot path stays dict-free for static batching), so BaseEnv is the
+compatibility surface for ASYNC and external envs — anything whose
+observations arrive irregularly — mirroring how reference users plug
+custom async simulators in. Done episodes auto-reset like the
+reference's ``_VectorEnvToBaseEnv``; the terminal observation is
+surfaced in the same poll inside each agent's info dict:
+``infos[env_id][agent_id]["__terminal_obs__"]``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Tuple
+
+from ray_tpu.env.multi_agent_env import MultiAgentEnv
+from ray_tpu.env.vector_env import VectorEnv
+
+# Single-agent envs report under this agent key (reference
+# base_env.py _DUMMY_AGENT_ID).
+_DUMMY_AGENT_ID = "agent0"
+
+
+class BaseEnv:
+    """poll/send contract. All returned dicts are keyed
+    ``{env_id: {agent_id: value}}``; ``dones[env_id]["__all__"]``
+    marks episode end for the whole sub-env."""
+
+    def poll(
+        self,
+    ) -> Tuple[Dict, Dict, Dict, Dict, Dict]:
+        """→ (obs, rewards, terminateds, truncateds, infos) for every
+        sub-env with data ready. Non-blocking w.r.t. envs that have
+        nothing new."""
+        raise NotImplementedError
+
+    def send_actions(self, action_dict: Dict[Any, Dict]) -> None:
+        """Push actions for the env_ids returned by the last poll."""
+        raise NotImplementedError
+
+    def try_reset(self, env_id) -> Optional[Dict]:
+        """Force-reset one sub-env → its first obs dict (or None if
+        unsupported)."""
+        return None
+
+    def get_sub_environments(self):
+        return []
+
+    def stop(self) -> None:
+        for e in self.get_sub_environments():
+            try:
+                e.close()
+            except Exception:
+                pass
+
+
+class _VectorEnvToBaseEnv(BaseEnv):
+    """Synchronous VectorEnv behind the async contract (reference
+    ``base_env.py`` VectorEnvWrapper): every poll has all sub-envs
+    ready; dones auto-reset and the fresh obs appears in the SAME poll
+    (the terminal obs rides infos)."""
+
+    def __init__(self, vector_env: VectorEnv):
+        self.vector_env = vector_env
+        obs, infos = vector_env.vector_reset()
+        self._pending = {
+            i: (obs[i], 0.0, False, False, infos[i])
+            for i in range(vector_env.num_envs)
+        }
+        self._awaiting_actions = False
+
+    def poll(self):
+        if self._awaiting_actions:
+            raise RuntimeError(
+                "poll() called twice without send_actions()"
+            )
+        self._awaiting_actions = True
+        obs, rewards, terms, truncs, infos = {}, {}, {}, {}, {}
+        for i, (o, r, te, tr, info) in self._pending.items():
+            obs[i] = {_DUMMY_AGENT_ID: o}
+            rewards[i] = {_DUMMY_AGENT_ID: r}
+            terms[i] = {_DUMMY_AGENT_ID: te, "__all__": te}
+            truncs[i] = {_DUMMY_AGENT_ID: tr, "__all__": tr}
+            infos[i] = {_DUMMY_AGENT_ID: info}
+        return obs, rewards, terms, truncs, infos
+
+    def send_actions(self, action_dict: Dict[Any, Dict]) -> None:
+        if not self._awaiting_actions:
+            raise RuntimeError("send_actions() without a poll()")
+        self._awaiting_actions = False
+        n = self.vector_env.num_envs
+        actions = [
+            action_dict[i][_DUMMY_AGENT_ID] for i in range(n)
+        ]
+        obs, rewards, terms, truncs, infos = (
+            self.vector_env.vector_step(actions)
+        )
+        pending = {}
+        for i in range(n):
+            done = bool(terms[i]) or bool(truncs[i])
+            info = dict(infos[i] or {})
+            o = obs[i]
+            if done:
+                # auto-reset; terminal obs surfaces for bootstrapping
+                info["__terminal_obs__"] = o
+                o, _ = self.vector_env.reset_at(i)
+            pending[i] = (
+                o, float(rewards[i]), bool(terms[i]),
+                bool(truncs[i]), info,
+            )
+        self._pending = pending
+
+    def try_reset(self, env_id) -> Optional[Dict]:
+        o, _ = self.vector_env.reset_at(env_id)
+        self._pending[env_id] = (o, 0.0, False, False, {})
+        return {_DUMMY_AGENT_ID: o}
+
+    def get_sub_environments(self):
+        return self.vector_env.get_sub_environments()
+
+
+class _MultiAgentEnvToBaseEnv(BaseEnv):
+    """MultiAgentEnv behind the async contract: per-agent dicts pass
+    through; '__all__' drives the auto-reset."""
+
+    def __init__(self, make_env: Callable[[int], MultiAgentEnv], num_envs: int):
+        self.envs = [make_env(i) for i in range(num_envs)]
+        self._pending = {}
+        for i, e in enumerate(self.envs):
+            obs, infos = e.reset()
+            flags = {aid: False for aid in obs}
+            flags["__all__"] = False
+            self._pending[i] = (
+                obs,
+                {aid: 0.0 for aid in obs},
+                dict(flags),
+                dict(flags),
+                infos,
+            )
+        self._awaiting_actions = False
+
+    def poll(self):
+        if self._awaiting_actions:
+            raise RuntimeError(
+                "poll() called twice without send_actions()"
+            )
+        self._awaiting_actions = True
+        obs, rewards, terms, truncs, infos = {}, {}, {}, {}, {}
+        for i, (o, r, te, tr, info) in self._pending.items():
+            obs[i], rewards[i] = o, r
+            terms[i], truncs[i], infos[i] = te, tr, info
+        return obs, rewards, terms, truncs, infos
+
+    def send_actions(self, action_dict: Dict[Any, Dict]) -> None:
+        if not self._awaiting_actions:
+            raise RuntimeError("send_actions() without a poll()")
+        self._awaiting_actions = False
+        pending = {}
+        for i, env in enumerate(self.envs):
+            obs, rewards, terms, truncs, infos = env.step(
+                action_dict[i]
+            )
+            done = bool(terms.get("__all__")) or bool(
+                truncs.get("__all__")
+            )
+            if done:
+                # per-agent terminal obs inside each agent's info,
+                # matching the vector wrapper's nesting
+                infos = {
+                    aid: {
+                        **(infos.get(aid) or {}),
+                        "__terminal_obs__": obs.get(aid),
+                    }
+                    for aid in obs
+                }
+                obs, _ = env.reset()
+            pending[i] = (obs, rewards, terms, truncs, infos)
+        self._pending = pending
+
+    def get_sub_environments(self):
+        return list(self.envs)
+
+
+def convert_to_base_env(
+    env,
+    *,
+    make_env: Optional[Callable[[int], Any]] = None,
+    num_envs: int = 1,
+) -> BaseEnv:
+    """Normalize any supported env type to BaseEnv (reference
+    ``base_env.py convert_to_base_env``): BaseEnv passes through;
+    VectorEnv and MultiAgentEnv wrap; a plain gym env vectorizes to
+    ``num_envs`` copies via ``make_env`` (or deepcopy-free re-creation
+    of the given instance when ``num_envs == 1``)."""
+    if isinstance(env, BaseEnv):
+        return env
+    if isinstance(env, VectorEnv):
+        return _VectorEnvToBaseEnv(env)
+    if isinstance(env, MultiAgentEnv):
+        creator = make_env or (lambda i: env)
+        if make_env is None and num_envs > 1:
+            raise ValueError(
+                "vectorizing a MultiAgentEnv needs make_env"
+            )
+        return _MultiAgentEnvToBaseEnv(creator, num_envs)
+    # plain gym env
+    if make_env is None:
+        if num_envs > 1:
+            raise ValueError(
+                "vectorizing a gym env needs make_env"
+            )
+
+        def make_env(i):  # noqa: F811 — single-instance fallback
+            return env
+
+    return _VectorEnvToBaseEnv(
+        VectorEnv.vectorize_gym_envs(make_env, num_envs)
+    )

@@ -156,6 +156,41 @@ def flash_block_attention_stats(q, k, v, offset, *, interpret=False):
     mask"). Returns (acc (N, T, D) float32 UNNORMALIZED, m (N, T), l
     (N, T)) — exactly the quantities the flash merge combines across
     blocks. Forward-only (ring-level callers own differentiation)."""
+    setup = _pallas_setup(q, k, v)
+    n, t, d = q.shape
+    bq, bk, qp, kp, vp, tp, grid, vmem = setup
+    smem = (
+        {}
+        if _VMEM is None
+        else {"memory_space": pltpu.SMEM}
+    )
+    acc, m, l = pl.pallas_call(
+        functools.partial(
+            _block_kernel, s_actual=k.shape[1], block_k=bk
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct((n, tp, d), jnp.float32),
+            jax.ShapeDtypeStruct((n, tp, 1), jnp.float32),
+            jax.ShapeDtypeStruct((n, tp, 1), jnp.float32),
+        ],
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1,), lambda b, i: (0,), **smem),
+            *_qkv_specs(bq, kp.shape[1], d, vmem),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0), **vmem),
+            pl.BlockSpec((1, bq, 1), lambda b, i: (b, i, 0), **vmem),
+            pl.BlockSpec((1, bq, 1), lambda b, i: (b, i, 0), **vmem),
+        ],
+        interpret=interpret,
+    )(jnp.asarray(offset, jnp.int32).reshape(1), qp, kp, vp)
+    return acc[:, :t], m[:, :t, 0], l[:, :t, 0]
+
+
+def _pallas_setup(q, k, v):
+    """Shared block-size / padding / grid scaffolding for both
+    pallas_call wrappers."""
     n, t, d = q.shape
     s = k.shape[1]
     bq = min(_BLOCK_Q, max(8, t))
@@ -166,39 +201,16 @@ def flash_block_attention_stats(q, k, v, offset, *, interpret=False):
     tp = qp.shape[1]
     grid = (n, tp // bq)
     vmem = {} if _VMEM is None else {"memory_space": _VMEM}
-    smem = (
-        {}
-        if _VMEM is None
-        else {"memory_space": pltpu.SMEM}
-    )
-    acc, m, l = pl.pallas_call(
-        functools.partial(
-            _block_kernel, s_actual=s, block_k=bk
-        ),
-        out_shape=[
-            jax.ShapeDtypeStruct((n, tp, d), jnp.float32),
-            jax.ShapeDtypeStruct((n, tp, 1), jnp.float32),
-            jax.ShapeDtypeStruct((n, tp, 1), jnp.float32),
-        ],
-        grid=grid,
-        in_specs=[
-            pl.BlockSpec((1,), lambda b, i: (0,), **smem),
-            pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0), **vmem),
-            pl.BlockSpec(
-                (1, kp.shape[1], d), lambda b, i: (b, 0, 0), **vmem
-            ),
-            pl.BlockSpec(
-                (1, kp.shape[1], d), lambda b, i: (b, 0, 0), **vmem
-            ),
-        ],
-        out_specs=[
-            pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0), **vmem),
-            pl.BlockSpec((1, bq, 1), lambda b, i: (b, i, 0), **vmem),
-            pl.BlockSpec((1, bq, 1), lambda b, i: (b, i, 0), **vmem),
-        ],
-        interpret=interpret,
-    )(jnp.asarray(offset, jnp.int32).reshape(1), qp, kp, vp)
-    return acc[:, :t], m[:, :t, 0], l[:, :t, 0]
+    return bq, bk, qp, kp, vp, tp, grid, vmem
+
+
+def _qkv_specs(bq, s_pad, d, vmem):
+    """The q (blocked) + k/v (full) input BlockSpecs both wrappers use."""
+    return [
+        pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0), **vmem),
+        pl.BlockSpec((1, s_pad, d), lambda b, i: (b, 0, 0), **vmem),
+        pl.BlockSpec((1, s_pad, d), lambda b, i: (b, 0, 0), **vmem),
+    ]
 
 
 def _pad_to(x, axis, multiple):
@@ -212,36 +224,20 @@ def _pad_to(x, axis, multiple):
 
 
 def _flash_fwd_pallas(q, k, v, causal_offset, interpret):
-    n, t, d = q.shape
-    s = k.shape[1]
-    bq = min(_BLOCK_Q, max(8, t))
-    bk = min(_BLOCK_K, max(8, s))
-    qp = _pad_to(q, 1, bq)
-    kp = _pad_to(k, 1, bk)
-    vp = _pad_to(v, 1, bk)
-    tp = qp.shape[1]
-    grid = (n, tp // bq)
-    kwargs = {} if _VMEM is None else {"memory_space": _VMEM}
+    t, d = q.shape[1:]
+    bq, bk, qp, kp, vp, tp, grid, vmem = _pallas_setup(q, k, v)
     out = pl.pallas_call(
         functools.partial(
             _fwd_kernel,
-            s_actual=s,
+            s_actual=k.shape[1],
             causal_offset=causal_offset,
             block_k=bk,
         ),
         out_shape=jax.ShapeDtypeStruct(qp.shape, q.dtype),
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0), **kwargs),
-            pl.BlockSpec(
-                (1, kp.shape[1], d), lambda b, i: (b, 0, 0), **kwargs
-            ),
-            pl.BlockSpec(
-                (1, kp.shape[1], d), lambda b, i: (b, 0, 0), **kwargs
-            ),
-        ],
+        in_specs=_qkv_specs(bq, kp.shape[1], d, vmem),
         out_specs=pl.BlockSpec(
-            (1, bq, d), lambda b, i: (b, i, 0), **kwargs
+            (1, bq, d), lambda b, i: (b, i, 0), **vmem
         ),
         interpret=interpret,
     )(qp, kp, vp)

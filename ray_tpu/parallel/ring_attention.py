@@ -48,17 +48,16 @@ def _block_attn(q, k, v, mask):
     return o, m, l
 
 
-def _block_attn_flash(qf, k, v, offset, interpret):
+def _block_attn_flash(qf, kf, vf, offset, shape, interpret):
     """The same (unnormalized out, row max, row sum) block computation
     as :func:`_block_attn`, via the fused Pallas kernel
     (``ops/flash_attention.py flash_block_attention_stats``); ``offset``
-    is the runtime banded-causal bound (j <= i + offset). ``qf`` is the
-    pre-transposed (B·H, Tq, D) query block — hoisted out of the ring
-    scan since it is hop-invariant."""
-    BH, Tq, D = qf.shape
-    B, Tk, H = v.shape[0], k.shape[1], v.shape[2]
-    kf = k.transpose(0, 2, 1, 3).reshape(BH, Tk, D)
-    vf = v.transpose(0, 2, 1, 3).reshape(BH, Tk, D)
+    is the runtime banded-causal bound (j <= i + offset). qf/kf/vf are
+    pre-flattened (B·H, T, D) blocks — the layout transform is
+    hop-invariant, so callers hoist it out of the ring scan and rotate
+    the flattened K/V directly."""
+    B, H = shape
+    Tq, D = qf.shape[1:]
     acc, m, l = flash_block_attention_stats(
         qf, kf, vf, offset, interpret=interpret
     )
@@ -104,11 +103,11 @@ def ring_attention_local(
     Tk = k.shape[1]
 
     q_pos = my * Tq + jnp.arange(Tq)  # global positions of local Q rows
-    qf = (
-        q.transpose(0, 2, 1, 3).reshape(B * H, Tq, D)
-        if use_pallas
-        else None
-    )
+    if use_pallas:
+        # flatten once; the ring rotates the flattened K/V blocks
+        q = q.transpose(0, 2, 1, 3).reshape(B * H, Tq, D)
+        k = k.transpose(0, 2, 1, 3).reshape(B * H, Tk, D)
+        v = v.transpose(0, 2, 1, 3).reshape(B * H, Tk, D)
 
     def hop(carry, step):
         o_acc, m_acc, l_acc, k_cur, v_cur = carry
@@ -121,7 +120,7 @@ def ring_attention_local(
                 else jnp.asarray(Tk, jnp.int32)
             )
             o, m, l = _block_attn_flash(
-                qf, k_cur, v_cur, offset, interpret
+                q, k_cur, v_cur, offset, (B, H), interpret
             )
         else:
             if causal:

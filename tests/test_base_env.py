@@ -1,0 +1,104 @@
+"""BaseEnv poll/send contract (reference rllib/env/base_env.py)."""
+
+import gymnasium as gym
+import numpy as np
+import pytest
+
+from ray_tpu.env.base_env import (
+    _DUMMY_AGENT_ID,
+    BaseEnv,
+    convert_to_base_env,
+)
+from ray_tpu.env.multi_agent_env import make_multi_agent
+from ray_tpu.env.vector_env import VectorEnv
+
+
+def test_gym_env_converts_and_steps():
+    base = convert_to_base_env(
+        None, make_env=lambda i: gym.make("CartPole-v1"), num_envs=3
+    )
+    obs, rewards, terms, truncs, infos = base.poll()
+    assert set(obs) == {0, 1, 2}
+    assert obs[0][_DUMMY_AGENT_ID].shape == (4,)
+    assert rewards[1][_DUMMY_AGENT_ID] == 0.0
+    assert terms[2]["__all__"] is False
+
+    for _ in range(5):
+        base.send_actions(
+            {i: {_DUMMY_AGENT_ID: 0} for i in range(3)}
+        )
+        obs, rewards, terms, truncs, infos = base.poll()
+        assert set(obs) == {0, 1, 2}
+        assert all(
+            np.asarray(o[_DUMMY_AGENT_ID]).shape == (4,)
+            for o in obs.values()
+        )
+    base.stop()
+
+
+def test_poll_send_ordering_enforced():
+    base = convert_to_base_env(
+        None, make_env=lambda i: gym.make("CartPole-v1"), num_envs=1
+    )
+    base.poll()
+    with pytest.raises(RuntimeError, match="poll"):
+        base.poll()
+    base.send_actions({0: {_DUMMY_AGENT_ID: 0}})
+    with pytest.raises(RuntimeError, match="send_actions"):
+        base.send_actions({0: {_DUMMY_AGENT_ID: 0}})
+    base.stop()
+
+
+def test_auto_reset_surfaces_terminal_obs():
+    base = convert_to_base_env(
+        None, make_env=lambda i: gym.make("CartPole-v1"), num_envs=1
+    )
+    base.poll()
+    # drive one env until a done; the same poll must contain the fresh
+    # obs and the terminal obs in infos
+    for _ in range(500):
+        base.send_actions({0: {_DUMMY_AGENT_ID: 0}})
+        obs, rewards, terms, truncs, infos = base.poll()
+        if terms[0]["__all__"] or truncs[0]["__all__"]:
+            assert "__terminal_obs__" in infos[0][_DUMMY_AGENT_ID]
+            assert obs[0][_DUMMY_AGENT_ID].shape == (4,)
+            break
+    else:
+        raise AssertionError("cartpole never terminated under action 0")
+    # next poll continues the fresh episode
+    base.send_actions({0: {_DUMMY_AGENT_ID: 0}})
+    obs, _, terms, _, _ = base.poll()
+    assert terms[0]["__all__"] is False
+    base.stop()
+
+
+def test_vector_env_passthrough():
+    venv = VectorEnv.vectorize_gym_envs(
+        lambda i: gym.make("CartPole-v1"), 2
+    )
+    base = convert_to_base_env(venv)
+    obs, *_ = base.poll()
+    assert set(obs) == {0, 1}
+    assert len(base.get_sub_environments()) == 2
+    base.stop()
+
+
+def test_multi_agent_env_converts():
+    ma_cls = make_multi_agent("CartPole-v1")
+    base = convert_to_base_env(ma_cls({"num_agents": 2}))
+    obs, rewards, terms, truncs, infos = base.poll()
+    agent_ids = set(obs[0])
+    assert len(agent_ids) == 2
+    base.send_actions({0: {aid: 0 for aid in agent_ids}})
+    obs2, rewards2, terms2, _, _ = base.poll()
+    assert set(obs2[0]) == agent_ids
+    assert all(isinstance(r, float) for r in rewards2[0].values())
+    base.stop()
+
+
+def test_base_env_passthrough_identity():
+    base = convert_to_base_env(
+        None, make_env=lambda i: gym.make("CartPole-v1"), num_envs=1
+    )
+    assert convert_to_base_env(base) is base
+    base.stop()
