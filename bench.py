@@ -28,15 +28,23 @@ H, W, C, NUM_ACTIONS = 84, 84, 4, 6
 TIMED_ROUNDS = 4
 
 
-def make_frames(rng, n, h=H, w=W, c=C):
-    """Blocky 84x84 frames approximating Atari content."""
+def make_frames(rng, n, h=H, w=W, c=1):
+    """Blocky 84x84 single frames approximating Atari content."""
     base = rng.integers(0, 255, (n, h // 4, w // 4, c), dtype=np.uint8)
     return np.kron(base, np.ones((1, 4, 4, 1), np.uint8))
 
 
 def make_batch(rng, b=B, h=H, w=W, c=C, num_actions=NUM_ACTIONS):
+    """A trajectory-shaped PPO train batch: rows are sliding
+    ``c``-frame stacks over one contiguous frame stream (real Atari
+    layout), shipped in the deduplicated frame-pool format
+    (``ray_tpu.ops.framestack``) — the obs column moves host→device
+    once per unique frame instead of ``c`` times."""
+    from ray_tpu.ops.framestack import frame_stream_columns
+
+    frames = make_frames(rng, b + c - 1, h, w, 1)
     return {
-        "obs": make_frames(rng, b, h, w, c),
+        **frame_stream_columns(frames, b, c),
         "actions": rng.integers(0, num_actions, b).astype(np.int64),
         "action_logp": np.full(b, -1.79, np.float32),
         "action_dist_inputs": rng.standard_normal(
@@ -45,6 +53,21 @@ def make_batch(rng, b=B, h=H, w=W, c=C, num_actions=NUM_ACTIONS):
         "advantages": rng.standard_normal(b).astype(np.float32),
         "value_targets": rng.standard_normal(b).astype(np.float32),
     }
+
+
+def materialize_stacks(batch, c=C):
+    """(N, H, W, c) stacked obs from a frame-pool batch — what the
+    torch baseline (and the reference's loader thread) moves per row."""
+    frames = batch["obs_frames"]
+    idx = batch["obs_frame_idx"]
+    return np.stack(
+        [
+            np.concatenate(
+                [frames[i + j] for j in range(c)], axis=-1
+            )
+            for i in idx
+        ]
+    )
 
 
 def bench_jax(
@@ -73,11 +96,12 @@ def bench_jax(
         for _ in range(3)
     ]
 
-    feeder = DeviceFeeder(policy.data_sharding)
+    feeder = DeviceFeeder(policy.batch_shardings)
     feeder.put(*host_batches[0])
     dev, bsize = feeder.get()
-    # compile + warm (learn_fn is the supported program accessor)
-    policy.learn_fn(bsize)
+    # compile + warm through the supported entry point (this batch is
+    # in the deduplicated frame-pool format; the stacks rebuild on
+    # device before the SGD nest)
     policy.learn_on_device_batch(dev, bsize)
 
     # steady state: feeder transfers batch k+1 while learner runs batch k
@@ -121,7 +145,11 @@ def bench_torch(b=B, mb=MB, iters=ITERS) -> float:
     opt = torch.optim.Adam(net.parameters(), lr=5e-5)
     rng = np.random.default_rng(0)
     batch = make_batch(rng, b)
-    obs_u8 = torch.from_numpy(batch["obs"].transpose(0, 3, 1, 2).copy())
+    # the reference's collector hands the loader fully-materialized
+    # (N, H, W, c) stacks; same data, same compute
+    obs_u8 = torch.from_numpy(
+        materialize_stacks(batch).transpose(0, 3, 1, 2).copy()
+    )
     actions = torch.from_numpy(batch["actions"])
     old_logp = torch.from_numpy(batch["action_logp"])
     adv = torch.from_numpy(batch["advantages"])

@@ -45,8 +45,27 @@ STATE_IN_PREFIX = "state_in_"
 STATE_OUT_PREFIX = "state_out_"
 
 
+from ray_tpu.ops.framestack import FRAMES as _FRAME_POOL
+
+
 def _is_array_col(key: str) -> bool:
-    return key != SEQ_LENS
+    # the frame POOL (ops/framestack) is not a per-row column — its
+    # length is rows + stack_k - 1 by design
+    return key not in (SEQ_LENS, _FRAME_POOL)
+
+
+def _reject_frame_pool(batch, op: str) -> None:
+    """Row transforms cannot preserve pool/index consistency; the
+    frame-pool format is a learner-side TRANSFER format built right
+    before learn_on_batch, not a storage format. Fail loudly instead
+    of silently dropping the pool."""
+    if _FRAME_POOL in batch:
+        raise ValueError(
+            f"SampleBatch.{op} does not support the deduplicated "
+            f"frame-pool format ({_FRAME_POOL!r}); materialize stacked "
+            "observations first (ops/framestack.build_stacks) or "
+            "apply the transform before decomposing"
+        )
 
 
 class SampleBatch(dict):
@@ -141,6 +160,7 @@ class SampleBatch(dict):
 
     def slice(self, start: int, end: int) -> "SampleBatch":
         """Row-slice [start, end) of every column (reference :407)."""
+        _reject_frame_pool(self, "slice")
         return SampleBatch(
             {k: v[start:end] for k, v in self.items() if _is_array_col(k)}
         )
@@ -247,6 +267,8 @@ def concat_samples(
         return SampleBatch()
     if isinstance(batches[0], MultiAgentBatch):
         return MultiAgentBatch.concat_samples(list(batches))
+    for b in batches:
+        _reject_frame_pool(b, "concat_samples")
     keys = batches[0].keys()
     out = {}
     for k in keys:

@@ -35,8 +35,16 @@ class DeviceFeeder:
                 return
             host_batch, meta = item
             try:
-                if self._sharding is not None:
-                    dev = jax.device_put(host_batch, self._sharding)
+                sharding = self._sharding
+                if callable(sharding) and not hasattr(
+                    sharding, "devices"
+                ):
+                    # per-batch sharding resolver (e.g.
+                    # JaxPolicy.batch_shardings: frame pools ride
+                    # replicated while row columns shard over data)
+                    sharding = sharding(host_batch)
+                if sharding is not None:
+                    dev = jax.device_put(host_batch, sharding)
                 else:
                     dev = jax.device_put(host_batch)
                 jax.block_until_ready(dev)

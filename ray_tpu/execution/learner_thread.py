@@ -56,7 +56,7 @@ class LearnerThread(threading.Thread):
         if self._feeder is None:
             from ray_tpu.execution.device_feed import DeviceFeeder
 
-            self._feeder = DeviceFeeder(self.policy.data_sharding)
+            self._feeder = DeviceFeeder(self.policy.batch_shardings)
         return self._feeder
 
     def run(self) -> None:

@@ -1,0 +1,85 @@
+"""Device-side framestack reconstruction (deduplicated obs transfer).
+
+Atari-style training batches are sliding-window framestacks: row n's
+observation is frames [f_n .. f_{n+k-1}], so consecutive rows share
+k-1 of their k frames and a naively-shipped (N, H, W, k) obs column
+carries each frame k times. The reference avoids SOME of this cost
+host-side (plasma stores a fragment's arrays once and workers map them
+zero-copy — ``src/ray/object_manager/plasma/store.h:55``), but still
+moves full stacks over the loader thread to the device
+(``rllib/execution/multi_gpu_learner_thread.py``).
+
+Here the dedup crosses the host→device boundary, where it matters most
+on TPU (HBM ingest is the learner's bottleneck once compute is one
+fused program): the host ships the UNIQUE frame stream plus a per-row
+int32 first-frame index (k× fewer obs bytes), and the jitted learn
+program rebuilds the (N, H, W, k) stacks with one gather before the
+SGD nest. ``JaxPolicy`` recognizes the ``obs_frames``/``obs_frame_idx``
+columns automatically (see ``policy/jax_policy.py``).
+
+Sharding note: the frame pool rides replicated while row columns shard
+over the data axis, so stacks build locally on every shard from the
+shared pool — correct on any mesh, sized for the single-host learner
+path where the transfer win lives.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+# Batch columns of the deduplicated format.
+FRAMES = "obs_frames"
+FRAME_IDX = "obs_frame_idx"
+
+
+def frame_stream_columns(
+    frames: np.ndarray, num_rows: int, k: int
+) -> Dict[str, np.ndarray]:
+    """Columns for a batch whose row n stacks frames [n .. n+k-1] of a
+    contiguous stream. ``frames``: (num_rows + k - 1, H, W, 1)."""
+    assert frames.shape[0] >= num_rows + k - 1, (
+        frames.shape, num_rows, k
+    )
+    assert frames.shape[-1] == 1, frames.shape
+    return {
+        FRAMES: np.asarray(frames),
+        FRAME_IDX: np.arange(num_rows, dtype=np.int32),
+    }
+
+
+def decompose_stacked_obs(
+    obs: np.ndarray,
+) -> Tuple[np.ndarray, np.ndarray] | None:
+    """Recover (frame_stream, idx) from a stacked (N, H, W, k) obs
+    column IF its rows really are a sliding window (consecutive rows
+    share k-1 frames); None when they don't. Host-side utility for
+    producers that only have stacked observations."""
+    n, h, w, k = obs.shape
+    if k <= 1 or n < 2:
+        return None
+    if not np.array_equal(obs[1:, :, :, : k - 1], obs[:-1, :, :, 1:]):
+        return None
+    stream = np.concatenate(
+        [
+            np.moveaxis(obs[0], -1, 0)[..., None],  # (k, H, W, 1)
+            obs[1:, :, :, -1][..., None],  # (N-1, H, W, 1)
+        ],
+        axis=0,
+    )
+    return stream, np.arange(n, dtype=np.int32)
+
+
+def build_stacks(frames: jnp.ndarray, idx: jnp.ndarray, k: int):
+    """Device-side: (M, H, W, 1) frame pool + (N,) first-frame indices
+    → (N, H, W, k) stacked observations (one gather, XLA-fusable)."""
+    assert frames.shape[-1] == 1, (
+        "frame pools are single-channel (stack depth k comes from the "
+        f"index expansion); got channel dim {frames.shape[-1]} — "
+        "multi-channel frames would silently train on one channel"
+    )
+    gathered = frames[idx[:, None] + jnp.arange(k)[None, :]]
+    # (N, k, H, W, 1) → (N, H, W, k)
+    return jnp.moveaxis(gathered[..., 0], 1, -1)

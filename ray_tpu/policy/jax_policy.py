@@ -32,6 +32,8 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ray_tpu.data.sample_batch import SampleBatch
 from ray_tpu.models.catalog import ModelCatalog
+from ray_tpu.ops.framestack import FRAME_IDX as _FRAME_IDX
+from ray_tpu.ops.framestack import FRAMES as _FRAMES
 from ray_tpu.parallel import mesh as mesh_lib
 from ray_tpu.policy.policy import Policy
 
@@ -143,7 +145,8 @@ class JaxPolicy(Policy):
         )
         self.num_sgd_iter = int(config.get("num_sgd_iter", 1))
 
-        self._learn_fns: Dict[int, Any] = {}  # batch_size -> compiled fn
+        # (batch_size, with_frames) -> compiled SGD-nest program
+        self._learn_fns: Dict[Tuple[int, bool], Any] = {}
         self._action_fn = None
         self._value_fn = None
         self.num_grad_updates = 0
@@ -424,9 +427,12 @@ class JaxPolicy(Policy):
         self.coeff_values["lr"] = float(self._lr_schedule(t))
         self.coeff_values["entropy_coeff"] = float(self._entropy_schedule(t))
 
-    def _build_learn_fn(self, batch_size: int):
+    def _build_learn_fn(self, batch_size: int, with_frames: bool = False):
         """Compile the full SGD nest for a given total batch size."""
         n_shards = self.n_shards
+        stack_k = int(self.observation_space.shape[-1]) if (
+            with_frames
+        ) else 0
         if batch_size % n_shards:
             raise ValueError(
                 f"batch size {batch_size} not divisible by "
@@ -450,6 +456,21 @@ class JaxPolicy(Policy):
         loss_fn = self.loss_with_aux
 
         def device_fn(params, opt_state, aux, batch, rng, coeffs):
+            if with_frames:
+                # rebuild stacked observations from the replicated
+                # frame pool (ops/framestack): one gather, then the
+                # nest proceeds on ordinary row columns
+                from ray_tpu.ops.framestack import build_stacks
+
+                frames = aux["__frames__"]
+                aux = {
+                    k: v for k, v in aux.items() if k != "__frames__"
+                }
+                batch = dict(batch)
+                obs = build_stacks(
+                    frames, batch.pop(_FRAME_IDX), stack_k
+                )
+                batch[SampleBatch.OBS] = obs
             # Different shuffle stream per data shard.
             rng = jax.random.fold_in(rng, jax.lax.axis_index("data"))
 
@@ -530,6 +551,10 @@ class JaxPolicy(Policy):
                 for k, v in samples.items()
                 if isinstance(v, np.ndarray) and v.dtype != object
             }
+        # the deduplicated frame pool is NOT a row column: it is
+        # exempt from row trimming/tiling (trimmed idx rows keep
+        # pointing at valid pool entries)
+        frames = batch.pop(_FRAMES, None)
         bsize = int(next(iter(batch.values())).shape[0])
         # recurrent batches must also divide into whole T-row unrolls
         div = self.n_shards * self._unroll_T
@@ -545,6 +570,8 @@ class JaxPolicy(Policy):
             if trim != bsize:
                 batch = {k: v[:trim] for k, v in batch.items()}
                 bsize = trim
+        if frames is not None:
+            batch[_FRAMES] = frames
         return batch, bsize
 
     @property
@@ -553,7 +580,24 @@ class JaxPolicy(Policy):
         DeviceFeeder wiring)."""
         return self._data_sharding
 
-    def learn_fn(self, batch_size: int):
+    def batch_shardings(self, host_tree):
+        """Per-column placement for a prepared train batch: row columns
+        shard over the data axis; the deduplicated frame pool
+        (``obs_frames``) replicates so every shard can gather stacks
+        locally. Pass this method itself as a DeviceFeeder's
+        ``sharding`` to get per-batch resolution."""
+        if isinstance(host_tree, dict) and _FRAMES in host_tree:
+            return {
+                k: (
+                    self._param_sharding
+                    if k == _FRAMES
+                    else self._data_sharding
+                )
+                for k in host_tree
+            }
+        return self._data_sharding
+
+    def learn_fn(self, batch_size: int, *, with_frames: bool = False):
         """Public accessor for the compiled SGD-nest program at a given
         (post-``prepare_batch``) batch size. Signature of the returned
         function is stable:
@@ -563,11 +607,21 @@ class JaxPolicy(Policy):
 
         Benchmarks and learner threads must obtain the program here (or
         use :meth:`learn_on_device_batch`) rather than via private
-        attributes, so internal refactors can't silently break them."""
-        fn = self._learn_fns.get(batch_size)
+        attributes, so internal refactors can't silently break them.
+        ``with_frames=True`` compiles the variant whose observations
+        arrive as a deduplicated frame pool in ``aux['__frames__']``
+        plus an ``obs_frame_idx`` row column (``ops/framestack``)."""
+        key = (batch_size, with_frames)
+        fn = self._learn_fns.get(key)
         if fn is None:
-            fn = self._build_learn_fn(batch_size)
-            self._learn_fns[batch_size] = fn
+            # bespoke-net policies (SAC family) override
+            # _build_learn_fn without the frames variant
+            fn = (
+                self._build_learn_fn(batch_size, with_frames=True)
+                if with_frames
+                else self._build_learn_fn(batch_size)
+            )
+            self._learn_fns[key] = fn
         return fn
 
     def learn_on_device_batch(
@@ -575,14 +629,29 @@ class JaxPolicy(Policy):
     ) -> Dict[str, float]:
         """Public phase 2 of learning: run the compiled SGD nest on an
         already-device-resident batch (e.g. transferred ahead of time by a
-        DeviceFeeder so host→device copy overlapped the previous step)."""
-        fn = self.learn_fn(batch_size)
+        DeviceFeeder so host→device copy overlapped the previous step).
+
+        Batches in the deduplicated framestack format (``obs_frames``
+        frame pool + ``obs_frame_idx`` rows — see ``ops/framestack``)
+        rebuild their observations device-side: the pool rides the
+        replicated aux slot (its sharding), so stacks gather locally on
+        every data shard."""
+        aux = self.aux_state
+        if _FRAMES in dev_batch:
+            dev_batch = dict(dev_batch)
+            frames = jax.device_put(
+                dev_batch.pop(_FRAMES), self._param_sharding
+            )
+            aux = {"__frames__": frames, **aux}
+            fn = self.learn_fn(batch_size, with_frames=True)
+        else:
+            fn = self.learn_fn(batch_size)
         self._update_scheduled_coeffs()
         self._rng, rng = jax.random.split(self._rng)
         self.params, self.opt_state, stats = fn(
             self.params,
             self.opt_state,
-            self.aux_state,
+            aux,
             dev_batch,
             rng,
             self._coeff_array(),
@@ -604,7 +673,18 @@ class JaxPolicy(Policy):
         ``jax.device_put`` dispatch is asynchronous, so the transfer
         overlaps this host code until the program consumes the buffers."""
         batch, bsize = self.prepare_batch(samples)
+        # the frame pool is replicated, not row-sharded
+        frames = batch.pop(_FRAMES, None)
         dev = _tree_to_device(batch, self._data_sharding)
+        if frames is not None:
+            dev = dict(
+                dev,
+                **{
+                    _FRAMES: jax.device_put(
+                        frames, self._param_sharding
+                    )
+                },
+            )
         return self.learn_on_device_batch(dev, bsize)
 
     def after_learn_on_batch(self, stats: Dict[str, float]) -> Dict[str, float]:
