@@ -1,3 +1,4 @@
+from ray_tpu.offline.dataset_reader import DatasetReader
 from ray_tpu.offline.json_reader import JsonReader
 from ray_tpu.offline.json_writer import JsonWriter
 from ray_tpu.offline.off_policy_estimator import (
@@ -7,6 +8,7 @@ from ray_tpu.offline.off_policy_estimator import (
 )
 
 __all__ = [
+    "DatasetReader",
     "JsonReader",
     "JsonWriter",
     "OffPolicyEstimator",

@@ -1,0 +1,59 @@
+"""Dataset-backed offline input (reference
+``rllib/offline/dataset_reader.py``): train from a
+:class:`ray_tpu.data.Dataset` of transition rows instead of JSON shards,
+so the Data layer's lazy map/filter/shuffle stages compose with offline
+RL (the reference reads parquet/json through ``ray.data`` the same way).
+
+Rows are dicts of per-transition column values (``obs``, ``actions``,
+``rewards``, ...); ``next()`` yields fixed-size ``SampleBatch``es,
+cycling and reshuffling the dataset every epoch."""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import numpy as np
+
+from ray_tpu.data.dataset import Dataset
+from ray_tpu.data.sample_batch import SampleBatch
+
+
+class DatasetReader:
+    """reference dataset_reader.py DatasetReader."""
+
+    def __init__(
+        self,
+        dataset: Dataset,
+        ioctx=None,
+        batch_size: int = 256,
+        shuffle: bool = True,
+        seed: Optional[int] = None,
+    ):
+        rows = dataset.take_all()
+        if not rows:
+            raise ValueError("empty dataset")
+        if not isinstance(rows[0], dict):
+            raise ValueError(
+                "DatasetReader needs dict rows (column -> value per "
+                f"transition), got {type(rows[0])}"
+            )
+        self._columns: Dict[str, np.ndarray] = {
+            k: np.asarray([r[k] for r in rows]) for k in rows[0]
+        }
+        self._n = len(rows)
+        self._batch_size = int(batch_size)
+        self._shuffle = shuffle
+        self._rng = np.random.default_rng(seed)
+        self._order = np.arange(self._n)
+        self._pos = self._n  # trigger (re)shuffle on first next()
+
+    def next(self) -> SampleBatch:
+        if self._pos + self._batch_size > self._n:
+            if self._shuffle:
+                self._rng.shuffle(self._order)
+            self._pos = 0
+        sel = self._order[self._pos : self._pos + self._batch_size]
+        self._pos += self._batch_size
+        return SampleBatch(
+            {k: v[sel] for k, v in self._columns.items()}
+        )

@@ -12,14 +12,24 @@ from ray_tpu.data.sample_batch import SampleBatch, concat_samples
 
 
 def setup_offline_reader(config: Dict):
-    """Build the JsonReader for config["input"] (None when training
-    from the sampler). Shared by MARWIL/BC/CQL/CRR setup."""
+    """Build the offline input for config["input"] (None when training
+    from the sampler). Shared by MARWIL/BC/CQL/CRR setup. Accepts a
+    JSON shard path/glob (JsonReader), a ``ray_tpu.data.Dataset`` of
+    transition rows (DatasetReader — reference dataset_reader.py), or
+    any object already exposing ``next() -> SampleBatch``."""
     inp = config.get("input_") or config.get("input")
-    if not inp or inp == "sampler":
+    if inp is None or inp == "sampler":
         return None
-    from ray_tpu.offline import JsonReader
+    from ray_tpu.data.dataset import Dataset
+    from ray_tpu.offline import DatasetReader, JsonReader
 
-    return JsonReader(inp)
+    if isinstance(inp, Dataset):
+        return DatasetReader(inp)
+    if isinstance(inp, str):
+        return JsonReader(inp)
+    if hasattr(inp, "next"):
+        return inp
+    raise ValueError(f"unsupported offline input: {type(inp)}")
 
 
 def sample_offline_batch(
