@@ -457,13 +457,20 @@ class DQNJaxPolicy(JaxPolicy):
         with the rows of ``samples`` (pre-tiling/trim: uses a plain jit
         forward, not the sharded nest)."""
         if not hasattr(self, "_td_error_fn"):
-            def fn(params, aux, batch):
-                td, _, _ = self._td_error(params, aux, batch)
+            def fn(params, aux, batch, rng):
+                td, _, _ = self._td_error(params, aux, batch, rng)
                 return td
 
             self._td_error_fn = jax.jit(fn)
         batch = self._batch_to_train_tree(samples)
-        td = self._td_error_fn(self.params, self.aux_state, batch)
+        # NoisyNet: sample weight noise for the priority pass too, so
+        # priorities are computed under the same training-mode network
+        # family the loss minimizes (mean weights would decorrelate PER
+        # priorities from the actual training TD errors).
+        rng = None
+        if self.config.get("noisy"):
+            self._rng, rng = jax.random.split(self._rng)
+        td = self._td_error_fn(self.params, self.aux_state, batch, rng)
         return np.abs(np.asarray(td))
 
     def after_learn_on_batch(self, stats):

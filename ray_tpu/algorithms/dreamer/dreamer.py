@@ -782,6 +782,21 @@ class Dreamer(Algorithm):
             RolloutMetrics(len(episode["obs"]) - 1, ep_reward)
         )
         self._episodes_total += 1
+        # a restored run starts with an empty (non-checkpointed) buffer:
+        # refill with on-policy episodes until one is long enough to
+        # sample batch_length windows from
+        for _ in range(100):
+            if any(
+                len(e["obs"]) >= self.buffer.length
+                for e in self.buffer.episodes
+            ):
+                break
+            episode, ep_reward, _ = self._collect_episode(explore=True)
+            self.buffer.add(episode)
+            self._episode_history.append(
+                RolloutMetrics(len(episode["obs"]) - 1, ep_reward)
+            )
+            self._episodes_total += 1
 
         batch_size = int(self.config.get("batch_size", 50))
         iters = int(self.config.get("dreamer_train_iters", 100))
@@ -817,6 +832,11 @@ class Dreamer(Algorithm):
             "opt_critic": jax.device_get(self.opt_critic),
             "counters": dict(self._counters),
             "episodes_total": self._episodes_total,
+            # restore must not re-run the random-action prefill on top
+            # of trained params (restarting training on a buffer
+            # dominated by random data); rng continues the stream
+            "prefilled": self._prefilled,
+            "rng": jax.device_get(self._rng),
         }
 
     def __setstate__(self, state: Dict) -> None:
@@ -831,6 +851,13 @@ class Dreamer(Algorithm):
             int, state.get("counters", {})
         )
         self._episodes_total = state.get("episodes_total", 0)
+        # the episodic buffer itself is not checkpointed (matches the
+        # reference's default store_buffer_in_checkpoints=False), but a
+        # restored run refills it with on-policy episodes, not the
+        # random prefill
+        self._prefilled = bool(state.get("prefilled", False))
+        if "rng" in state:
+            self._rng = jax.device_put(state["rng"])
 
     def cleanup(self) -> None:
         try:

@@ -361,6 +361,7 @@ class MBMPO(Algorithm):
         self._start_obs: list = []
         self._meta_fn = None
         self._rollout_fn = None
+        self._rollout_member_fn = None
         self._act_fn = None
 
     # -- real-env interaction ---------------------------------------------
@@ -435,29 +436,38 @@ class MBMPO(Algorithm):
             )
             return o, a, logp, rets, r
 
-        def sample_all(ens_params, norm, params, obs0, rng):
-            """obs0: (E, n, obs_dim) → (E, n*T) flat task batches."""
-            E = obs0.shape[0]
-            rngs = jax.random.split(rng, E)
-            o, a, logp, rets, r = jax.vmap(
-                one_member, in_axes=(0, None, None, 0, 0)
-            )(ens_params, norm, params, obs0, rngs)
-            # (E, T, n, ...) → (E, n*T, ...)
-            def flat(x):
-                x = jnp.moveaxis(x, 1, 2)
-                return x.reshape((E, -1) + x.shape[3:])
+        def make(params_axis):
+            """params_axis=None: one shared policy tree for every
+            member (pre-adaptation data). params_axis=0: a stacked tree
+            of per-member adapted policies θ'_m — each member's post
+            data is rolled out under its own θ'_m, so the PPO surrogate
+            in the meta-loss evaluates genuinely on-policy post data."""
 
-            adv = flat(rets)
-            adv = (adv - adv.mean()) / (adv.std() + 1e-4)
-            return {
-                "obs": flat(o),
-                "actions": flat(a),
-                "logp": flat(logp),
-                "advantages": adv,
-                "mean_reward": jnp.mean(r),
-            }
+            def sample_all(ens_params, norm, params, obs0, rng):
+                """obs0: (E, n, obs_dim) → (E, n*T) flat task batches."""
+                E = obs0.shape[0]
+                rngs = jax.random.split(rng, E)
+                o, a, logp, rets, r = jax.vmap(
+                    one_member, in_axes=(0, None, params_axis, 0, 0)
+                )(ens_params, norm, params, obs0, rngs)
+                # (E, T, n, ...) → (E, n*T, ...)
+                def flat(x):
+                    x = jnp.moveaxis(x, 1, 2)
+                    return x.reshape((E, -1) + x.shape[3:])
 
-        return jax.jit(sample_all)
+                adv = flat(rets)
+                adv = (adv - adv.mean()) / (adv.std() + 1e-4)
+                return {
+                    "obs": flat(o),
+                    "actions": flat(a),
+                    "logp": flat(logp),
+                    "advantages": adv,
+                    "mean_reward": jnp.mean(r),
+                }
+
+            return jax.jit(sample_all)
+
+        return make(None), make(0)
 
     # -- meta objective (shared shape with MAML) ---------------------------
 
@@ -472,7 +482,13 @@ class MBMPO(Algorithm):
                 self.config.get("inner_adaptation_steps", 1)
             ),
         )
+        # θ'_m per ensemble member: vmap the inner adaptation over the
+        # member axis of the pre batches (one stacked params tree out)
+        self._adapt_members = jax.jit(
+            jax.vmap(self._adapted_jit, in_axes=(None, 0))
+        )
         return meta_step
+
 
     # -- training ----------------------------------------------------------
 
@@ -501,7 +517,9 @@ class MBMPO(Algorithm):
             np.stack(self._real["next_obs"]),
         )
         if self._rollout_fn is None:
-            self._rollout_fn = self._build_rollout_fn()
+            self._rollout_fn, self._rollout_member_fn = (
+                self._build_rollout_fn()
+            )
 
         # 3. MAML over ensemble members as tasks
         meta_losses, imag_rewards = [], []
@@ -516,22 +534,18 @@ class MBMPO(Algorithm):
                 self.params, obs0, r1,
             )
             pre.pop("mean_reward")
-            # post-adaptation data: imagined rollouts under θ'_m.
-            # vmapping θ'_m per member would replicate the policy tree;
-            # adapting on the stacked batch keeps one tree and matches
-            # inner_adaptation_steps=1 semantics closely enough for the
-            # surrogate (scoped vs the reference's per-worker copies).
+            # post-adaptation data: imagined rollouts under θ'_m,
+            # adapted PER MEMBER on that member's pre batch (vmap) and
+            # rolled out under that member's own adapted policy — the
+            # same per-task adaptation build_meta_objective's meta-loss
+            # performs, so the PPO surrogate's clipped ratios are
+            # evaluated on on-policy post data (reference: per-worker
+            # adapted policy copies in mbmpo.py's inner loop).
             post_obs0 = self._sample_start_obs(self._np_rng)
-            adapted_params = self._adapted_jit(
-                self.params,
-                {
-                    k: v.reshape((-1,) + v.shape[2:])
-                    for k, v in pre.items()
-                },
-            )
-            post = self._rollout_fn(
+            adapted_stack = self._adapt_members(self.params, pre)
+            post = self._rollout_member_fn(
                 self.dynamics.params, self.dynamics.norm,
-                adapted_params, post_obs0, r2,
+                adapted_stack, post_obs0, r2,
             )
             # imagined post-adaptation reward: the standard MBMPO
             # model-rollout diagnostic

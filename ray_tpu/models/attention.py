@@ -62,6 +62,23 @@ class GTrXLNet(RTModel):
     def is_recurrent(self) -> bool:
         return True
 
+    @property
+    def supports_stored_train_state(self) -> bool:
+        # KNOWN APPROXIMATION (kept deliberately): the learn-path
+        # unroll feeds zero memory, while rollouts acted with real
+        # carried memory — for mid-episode chunks the stored
+        # ACTION_LOGP was produced under a different memory than the
+        # train-time forward, slightly biasing PPO/APPO importance
+        # ratios (episode-initial chunks are exact). Feeding stored
+        # memory would need per-SEGMENT memory swaps inside a chunk
+        # (after an in-chunk reset the rollout attended the FRESH zero
+        # memory, not the chunk-start memory), which one fixed-shape
+        # forward cannot express. The reference's attention path has
+        # the mirror-image compromise: it feeds stored memory and
+        # lets post-reset rows attend stale pre-reset memory. Use
+        # max_seq_len <= typical episode length to bound the bias.
+        return False
+
     def initial_state(self, batch_size: int = 1):
         return tuple(
             jnp.zeros(

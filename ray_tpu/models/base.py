@@ -38,6 +38,19 @@ class RTModel(nn.Module):
     def is_recurrent(self) -> bool:
         return False
 
+    @property
+    def supports_stored_train_state(self) -> bool:
+        """Whether the learn-path (B, T) unroll can be fed the
+        sampler's stored chunk-start states (exactly reproducing the
+        rollout-time forward for mid-episode chunks). Carry-style
+        models (LSTM) support this: the per-step ``resets`` mask zeroes
+        the carry at genuine episode boundaries, so a stored state is
+        correct wherever the chunk continues a trajectory. Models whose
+        state the resets mask cannot re-zero per segment (GTrXL's
+        attention memory) return False and train with zero initial
+        state — a documented approximation (see models/attention.py)."""
+        return False
+
 
 def get_activation(name: str):
     if name in (None, "linear"):

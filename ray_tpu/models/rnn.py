@@ -37,6 +37,15 @@ class LSTMWrapper(RTModel):
     def is_recurrent(self) -> bool:
         return True
 
+    @property
+    def supports_stored_train_state(self) -> bool:
+        # the resets mask zeroes the carry at episode boundaries, so
+        # feeding the sampler's stored chunk-start (h, c) makes the
+        # train-time forward match the rollout-time forward exactly
+        # for mid-episode chunks (reference precedent: R2D2's
+        # stored-state mode, rllib r2d2.py zero_init_states=False)
+        return True
+
     def initial_state(self, batch_size: int = 1):
         return (
             jnp.zeros((batch_size, self.cell_size), jnp.float32),

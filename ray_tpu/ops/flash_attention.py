@@ -188,13 +188,21 @@ def flash_block_attention_stats(q, k, v, offset, *, interpret=False):
     return acc[:, :t], m[:, :t, 0], l[:, :t, 0]
 
 
+def _ceil_to(x, m):
+    return ((x + m - 1) // m) * m
+
+
 def _pallas_setup(q, k, v):
     """Shared block-size / padding / grid scaffolding for both
-    pallas_call wrappers."""
+    pallas_call wrappers. Block sizes are rounded up to multiples of 8
+    so the (sublane, lane) tiles Mosaic carves out of each block stay
+    aligned to the TPU's native (8, 128) vreg tiling — an unaligned
+    block (e.g. bq=20 from a T=20 GTrXL unroll) would force Mosaic to
+    retile on every load. Padding (below) absorbs the rounding."""
     n, t, d = q.shape
     s = k.shape[1]
-    bq = min(_BLOCK_Q, max(8, t))
-    bk = min(_BLOCK_K, max(8, s))
+    bq = min(_BLOCK_Q, _ceil_to(max(8, t), 8))
+    bk = min(_BLOCK_K, _ceil_to(max(8, s), 8))
     qp = _pad_to(q, 1, bq)
     kp = _pad_to(k, 1, bk)
     vp = _pad_to(v, 1, bk)
@@ -244,6 +252,25 @@ def _flash_fwd_pallas(q, k, v, causal_offset, interpret):
     return out[:, :t]
 
 
+@functools.lru_cache(maxsize=None)
+def _pallas_lowers(t, s, d):
+    """One-time probe (cached per shape class): does the forward kernel
+    actually lower on this backend? Mosaic's supported-shape envelope
+    shifts between releases; when a shape class fails to lower we fall
+    back to the XLA reference path instead of crashing the hot loop.
+    The probe compiles n=1 (batch·head count never affects lowering —
+    it is only the leading grid dimension)."""
+    try:
+        zq = jnp.zeros((1, t, d), jnp.float32)
+        zk = jnp.zeros((1, s, d), jnp.float32)
+        jax.jit(
+            lambda a, b: _flash_fwd_pallas(a, b, b, 0, False)
+        ).lower(zq, zk).compile()
+        return True
+    except Exception:  # pragma: no cover - backend-dependent
+        return False
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
 def _flash_attention(q, k, v, causal_offset, interpret):
     return _flash_fwd_pallas(q, k, v, causal_offset, interpret)
@@ -281,7 +308,9 @@ def flash_attention(
     B, H, T, D = q.shape
     S = k.shape[2]
     if use_pallas is None:
-        use_pallas = interpret or jax.default_backend() == "tpu"
+        use_pallas = interpret or (
+            jax.default_backend() == "tpu" and _pallas_lowers(T, S, D)
+        )
     qf = q.reshape(B * H, T, D)
     kf = k.reshape(B * H, S, D)
     vf = v.reshape(B * H, S, D)

@@ -560,10 +560,18 @@ class JaxPolicy(Policy):
         div = self.n_shards * self._unroll_T
         if bsize < div:
             reps = -(-div // bsize)
+            orig = bsize
             batch = {
                 k: np.tile(v, (reps,) + (1,) * (v.ndim - 1))[:div]
                 for k, v in batch.items()
             }
+            if "resets" in batch:
+                # tile wrap points can land mid-unroll; the carry from
+                # the end of one copy must not leak into the next
+                # (stored chunk-start states only cover unroll row 0)
+                resets = batch["resets"].copy()
+                resets[orig::orig] = 1.0
+                batch["resets"] = resets
             bsize = div
         else:
             trim = (bsize // div) * div
@@ -698,27 +706,41 @@ class JaxPolicy(Policy):
         discontinuous (episode change, or a non-contiguous step counter
         marking a fragment boundary between different env slots)."""
         drop = {SampleBatch.INFOS, SampleBatch.SEQ_LENS}
+        # carry-style recurrent models (LSTM) train from the sampler's
+        # stored chunk-start states so the train-time forward matches
+        # the rollout-time forward exactly for mid-episode chunks;
+        # other models' per-row states are rollout-side plumbing (the
+        # GAE bootstrap reads the last row host-side) and never ship
+        # to device (R2D2 overrides this method and keeps the state
+        # columns its sequence loss needs)
+        stored_state = (
+            self.model.is_recurrent
+            and getattr(self.model, "supports_stored_train_state", False)
+        )
         tree = {
             k: np.asarray(v)
             for k, v in samples.items()
             if k not in drop
-            # per-row recurrent states are rollout-side plumbing (the
-            # GAE bootstrap reads the last row host-side); the learn
-            # program builds zero chunk-start states itself, so don't
-            # ship them to device (R2D2 overrides this method and keeps
-            # the state columns its sequence loss needs)
-            and not k.startswith(("state_in_", "state_out_"))
+            and (stored_state or not k.startswith("state_in_"))
+            and not k.startswith("state_out_")
             and isinstance(v, np.ndarray)
             and v.dtype != object
         }
         if self.model.is_recurrent and "resets" not in tree:
             n = len(next(iter(tree.values())))
             resets = np.zeros(n, np.float32)
-            # row 0 is always a trajectory start (also makes tiled
-            # copies in prepare_batch reset at each wrap point)
-            resets[0] = 1.0
             eps = tree.get(SampleBatch.EPS_ID)
             tcol = tree.get(SampleBatch.T)
+            if not stored_state:
+                # row 0 is always treated as a trajectory start (also
+                # makes tiled copies in prepare_batch reset at each
+                # wrap point); with stored state the chunk-start state
+                # column is itself correct at row 0 and at every tiled
+                # copy, so row 0 is a reset only when it genuinely
+                # starts an episode (step counter 0)
+                resets[0] = 1.0
+            elif tcol is None or tcol[0] == 0:
+                resets[0] = 1.0
             if eps is not None:
                 resets[1:] = np.maximum(
                     resets[1:], (eps[1:] != eps[:-1]).astype(np.float32)
@@ -734,11 +756,13 @@ class JaxPolicy(Policy):
     def model_forward_train(self, params, batch):
         """Learn-path forward over a flat training batch. Feedforward
         models pass through; recurrent models reshape the N flat rows
-        into (N/T, T) unrolls — zero initial state at chunk starts, the
-        ``resets`` column zeroing the carry at trajectory boundaries —
-        and return flattened (N,) outputs, so losses written against
-        flat rows work unchanged (the reference's rnn_sequencing role,
-        fixed-shape style)."""
+        into (N/T, T) unrolls — chunk starts use the sampler's stored
+        states when the model supports it (LSTM; exact rollout replay)
+        and zero states otherwise (GTrXL; documented approximation in
+        models/attention.py), with the ``resets`` column zeroing the
+        carry at trajectory boundaries — and return flattened (N,)
+        outputs, so losses written against flat rows work unchanged
+        (the reference's rnn_sequencing role, fixed-shape style)."""
         obs = batch[SampleBatch.OBS]
         if not self.model.is_recurrent:
             return self.model.apply(params, obs)
@@ -764,7 +788,25 @@ class JaxPolicy(Policy):
             pr = batch.get(SampleBatch.PREV_REWARDS)
             if pr is not None:
                 kwargs["prev_rewards"] = pr.reshape(B, T)
-        state0 = self._zero_initial_state(obs, B)
+        if (
+            getattr(self.model, "supports_stored_train_state", False)
+            and "state_in_0" in batch
+        ):
+            # stored-state mode: each unroll starts from the state the
+            # sampler recorded at its first row (exact rollout replay
+            # for mid-episode chunks; resets re-zero the carry at any
+            # in-chunk episode boundary)
+            state0 = []
+            k = 0
+            while f"state_in_{k}" in batch:
+                s = batch[f"state_in_{k}"]
+                state0.append(
+                    s.reshape((B, T) + s.shape[1:])[:, 0]
+                )
+                k += 1
+            state0 = tuple(state0)
+        else:
+            state0 = self._zero_initial_state(obs, B)
         return self.model.apply(
             params, obs.reshape((B, T) + obs.shape[1:]), state0,
             **kwargs,

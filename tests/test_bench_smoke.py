@@ -7,13 +7,16 @@ any future signature drift fails tests instead of the driver run.
 """
 
 import numpy as np
+import pytest
 
 import bench
 
+pytestmark = pytest.mark.smoke
+
 
 def test_bench_jax_path_runs():
-    sps = bench.bench_jax(b=64, mb=32, iters=2, timed_rounds=1)
-    assert sps > 0
+    sps, times = bench.bench_jax(b=64, mb=32, iters=2, timed_rounds=1)
+    assert sps > 0 and len(times) == 1
 
 
 def test_bench_batch_schema_matches_policy():

@@ -135,6 +135,56 @@ def test_unroll_forward_matches_per_episode_forwards():
     )
 
 
+def test_stored_state_train_forward_matches_rollout_mid_episode():
+    """A chunk that CONTINUES an episode (t[0] > 0) must train from the
+    sampler's stored chunk-start state and reproduce the rollout-time
+    forward exactly — not restart from zero state (which would bias the
+    stored-logp importance ratios; the fix for the zero-chunk-start
+    approximation)."""
+    policy = _lstm_policy()
+    rng = np.random.default_rng(0)
+    T = 5
+    # one 10-step episode rolled out step by step with carried state
+    obs_all = rng.standard_normal((2 * T, 3)).astype(np.float32)
+    state = policy.model.initial_state(1)
+    states_per_row = []
+    logits_rollout = []
+    for t in range(2 * T):
+        states_per_row.append([np.asarray(s[0]) for s in state])
+        lg, _, state = policy.model.apply(
+            policy.params, jax.numpy.asarray(obs_all[t][None, None]),
+            state,
+        )
+        logits_rollout.append(np.asarray(lg[0]))
+    # the SECOND chunk (rows 5..9) is mid-episode: t starts at 5
+    chunk = slice(T, 2 * T)
+    batch = SampleBatch(
+        {
+            SampleBatch.OBS: obs_all[chunk],
+            SampleBatch.EPS_ID: np.full(T, 42, np.int64),
+            SampleBatch.T: np.arange(T, 2 * T, dtype=np.int64),
+            "state_in_0": np.stack(
+                [states_per_row[i][0] for i in range(T, 2 * T)]
+            ),
+            "state_in_1": np.stack(
+                [states_per_row[i][1] for i in range(T, 2 * T)]
+            ),
+        }
+    )
+    tree = policy._batch_to_train_tree(batch)
+    # mid-episode chunk start: no forced reset, states kept
+    assert tree["resets"].tolist() == [0.0] * T
+    assert "state_in_0" in tree
+    logits, _, _ = policy.model_forward_train(
+        policy.params, {k: jax.numpy.asarray(v) for k, v in tree.items()}
+    )
+    np.testing.assert_allclose(
+        np.asarray(logits),
+        np.stack(logits_rollout[T:]),
+        atol=1e-5,
+    )
+
+
 def test_learn_on_batch_recurrent_shapes_and_trim():
     policy = _lstm_policy()
     rng = np.random.default_rng(0)
