@@ -114,6 +114,60 @@ class _Runtime:
         self.timeline_events: List[Dict] = []
         self.shutting_down = False
         self._worker_env = {}
+        # Cross-host fleet (core/cluster.py): the head's listener and
+        # the map of actors placed on remote agents
+        self.cluster = None
+        self.remote_actors: Dict[str, Any] = {}
+        # Durable job/actor metadata tables (the gcs_job_manager /
+        # gcs_actor_manager storage role, reference
+        # gcs/gcs_table_storage.cc): enabled via ray.init(state_path=)
+        # or RAY_TPU_STATE_PATH. Driver death keeps the record; a
+        # restarted driver (or `list_jobs`) can inspect prior runs.
+        self.state_store = None
+        self.job_id = f"job_{uuid.uuid4().hex[:8]}"
+        state_path = os.environ.get("RAY_TPU_STATE_PATH")
+        if state_path:
+            self._open_state_store(state_path)
+
+    def _open_state_store(self, path: str) -> None:
+        import json as _json
+        import time as _time
+
+        from ray_tpu.core.store_client import make_store_client
+
+        self.state_store = make_store_client(path)
+        self.state_store.put(
+            "jobs",
+            self.job_id,
+            _json.dumps(
+                {
+                    "job_id": self.job_id,
+                    "status": "RUNNING",
+                    "start_time": _time.time(),
+                    "pid": os.getpid(),
+                }
+            ).encode(),
+        )
+
+    def _record_named_actor(self, name: str, actor_id: str, cls_name: str):
+        if self.state_store is None:
+            return
+        import json as _json
+        import time as _time
+
+        self.state_store.put(
+            "actors",
+            name,
+            _json.dumps(
+                {
+                    "name": name,
+                    "actor_id": actor_id,
+                    "class": cls_name,
+                    "job_id": self.job_id,
+                    "time": _time.time(),
+                }
+            ).encode(),
+        )
 
     # -- worker lifecycle ------------------------------------------------
 
@@ -471,7 +525,60 @@ class _Runtime:
 
     # -- actors ----------------------------------------------------------
 
+    def _resolve_for_remote(self, args, kwargs):
+        """Top-level ObjectRef args become their values: remote hosts
+        share no shm plane with the head, so arguments ship inline
+        (driver-owned pull-on-submit — the scoped slice of the
+        reference's object_manager push/pull)."""
+
+        def res(v):
+            if isinstance(v, ObjectRef):
+                return self.store.get(v.id, timeout=60.0)
+            return v
+
+        return [res(a) for a in args], {
+            k: res(v) for k, v in kwargs.items()
+        }
+
     def create_actor(self, cls, args, kwargs, options) -> "ActorHandle":
+        node_name = options.get("placement_node")
+        if node_name is not None and self.cluster is not None:
+            try:
+                node = self.cluster.pick_node(
+                    None if node_name == "any" else node_name
+                )
+            except ValueError:
+                # requested node is gone (e.g. recreate_failed_workers
+                # after a host death): fall back to local placement so
+                # the fault-tolerance path keeps the run alive rather
+                # than throwing (reference: dead-node leases respawn
+                # wherever the cluster scheduler finds room)
+                import warnings
+
+                warnings.warn(
+                    f"cluster node {node_name!r} unavailable; placing "
+                    "actor locally"
+                )
+                node = None
+            if node is not None:
+                actor_id = uuid.uuid4().hex
+                name = options.get("name")
+                r_args, r_kwargs = self._resolve_for_remote(args, kwargs)
+                with self.lock:
+                    if name:
+                        if name in self.named_actors:
+                            raise ValueError(
+                                f"Actor name {name} already taken"
+                            )
+                        self.named_actors[name] = actor_id
+                        self._record_named_actor(
+                            name, actor_id, cls.__name__
+                        )
+                    self.remote_actors[actor_id] = node
+                node.create_actor(
+                    actor_id, cls, r_args, r_kwargs, options
+                )
+                return ActorHandle(actor_id, cls.__name__)
         actor_id = uuid.uuid4().hex
         cls_blob = ser.dumps(cls)
         w = self._spawn_worker(
@@ -503,11 +610,27 @@ class _Runtime:
                     raise ValueError(f"Actor name {name} already taken")
                 self.named_actors[name] = actor_id
                 rec.name = name
+                self._record_named_actor(name, actor_id, cls.__name__)
         with w.send_lock:
             w.conn.send(init_msg)
         return ActorHandle(actor_id, cls.__name__)
 
     def call_actor(self, actor_id, method, args, kwargs, num_returns=1):
+        node = self.remote_actors.get(actor_id)
+        if node is not None:
+            if node.dead:
+                ref = ObjectRef(uuid.uuid4().hex, self.store)
+                self.store.put_error(
+                    ref.id,
+                    RayActorError(
+                        f"Actor {actor_id}'s node {node.node_id} is dead"
+                    ),
+                )
+                return [ref] * num_returns
+            r_args, r_kwargs = self._resolve_for_remote(args, kwargs)
+            return node.call(
+                actor_id, method, r_args, r_kwargs, num_returns
+            )
         with self.lock:
             rec = self.actors.get(actor_id)
         if rec is None or rec.dead:
@@ -554,6 +677,10 @@ class _Runtime:
         return refs
 
     def kill_actor(self, actor_id: str, no_restart: bool = True):
+        node = self.remote_actors.pop(actor_id, None)
+        if node is not None:
+            node.kill(actor_id)
+            return
         with self.lock:
             rec = self.actors.get(actor_id)
             if rec is None:
@@ -587,6 +714,21 @@ class _Runtime:
             if w.proc.is_alive():
                 w.proc.terminate()
         self.store.clear()
+        if self.state_store is not None:
+            import json as _json
+
+            try:
+                rec = self.state_store.get("jobs", self.job_id)
+                if rec:
+                    job = _json.loads(rec.decode())
+                    job["status"] = "FINISHED"
+                    job["end_time"] = time.time()
+                    self.state_store.put(
+                        "jobs", self.job_id, _json.dumps(job).encode()
+                    )
+            finally:
+                self.state_store.close()
+                self.state_store = None
 
 
 class _UnreadyDep(Exception):
@@ -605,10 +747,17 @@ def init(
     local_mode: bool = False,
     worker_env: Optional[Dict[str, str]] = None,
     log_dir: Optional[str] = None,
+    address: Optional[str] = None,
     **kwargs,
 ) -> Dict:
     """Start the local runtime (reference ray.init,
-    ``_private/worker.py:984``)."""
+    ``_private/worker.py:984``).
+
+    address="host:port" JOINS an existing head's fleet as a worker
+    agent: this process's runtime hosts actors the head places here
+    (reference: ray start --address joining a raylet to the GCS). The
+    head enables its listener with
+    ``ray_tpu.core.cluster.start_cluster_server()``."""
     global _runtime
     if _runtime is not None:
         if ignore_reinit_error:
@@ -623,7 +772,55 @@ def init(
         _runtime._worker_env.update(worker_env)
     if log_dir:
         _runtime._worker_env.setdefault("RAY_TPU_LOG_DIR", log_dir)
+    state_path = kwargs.get("state_path")
+    if state_path and _runtime.state_store is None:
+        _runtime._open_state_store(state_path)
+    if address and address not in ("local", "auto"):
+        from ray_tpu.core.cluster import NodeAgent
+
+        _runtime.node_agent = NodeAgent(
+            address,
+            node_id=kwargs.get("node_id"),
+            num_cpus=num_cpus,
+        )
+        return {
+            "address": address,
+            "num_cpus": n,
+            "node_id": _runtime.node_agent.node_id,
+        }
     return {"address": "local", "num_cpus": n}
+
+
+def list_jobs(state_path: Optional[str] = None) -> List[Dict]:
+    """Jobs recorded in the durable state store — including those of
+    PREVIOUS (dead) drivers, which is the point (reference
+    gcs_job_manager.cc job table + `ray job list`). Reads the running
+    runtime's store, or the file at ``state_path``/RAY_TPU_STATE_PATH
+    without a runtime."""
+    import json as _json
+
+    if _runtime is not None and _runtime.state_store is not None:
+        store = _runtime.state_store
+        close = False
+    else:
+        path = state_path or os.environ.get("RAY_TPU_STATE_PATH")
+        if not path or not os.path.exists(path):
+            return []
+        from ray_tpu.core.store_client import make_store_client
+
+        store = make_store_client(path)
+        close = True
+    try:
+        return sorted(
+            (
+                _json.loads(v.decode())
+                for v in store.all("jobs").values()
+            ),
+            key=lambda j: j.get("start_time", 0),
+        )
+    finally:
+        if close:
+            store.close()
 
 
 def is_initialized() -> bool:
@@ -868,7 +1065,9 @@ def get_actor(name: str) -> ActorHandle:
 class RuntimeContext:
     def __init__(self):
         self.node_id = "local"
-        self.job_id = "job_local"
+        self.job_id = (
+            _runtime.job_id if _runtime is not None else "job_local"
+        )
 
     def get(self):
         return {"node_id": self.node_id, "job_id": self.job_id}

@@ -1,0 +1,490 @@
+"""Cross-host actor fleet, first rung: a head node that places actors on
+remote worker agents over TCP.
+
+Plays the multi-host scheduling/transport roles of the reference's
+raylet + object manager, scoped to what distributed rollout needs:
+
+- per-host worker agent that spawns/hosts actors
+  (``src/ray/raylet/node_manager.h:142`` NodeManager,
+  ``raylet/worker_pool.h:153`` WorkerPool),
+- task/actor submission and result return over a persistent TCP
+  connection (the gRPC transports of ``rpc/grpc_server.h:64`` +
+  ``core_worker/transport/direct_actor_task_submitter.h:67``),
+- argument objects resolved head-side and shipped inline
+  (``object_manager/object_manager.h:114`` chunked push, scoped to
+  driver-owned pull-on-submit: batches are produced once, consumed
+  once, and weight broadcasts re-ship per node the way the reference
+  re-pulls per node).
+
+TPU-first disposition: the head is the single controller (the TPU
+learner lives there); agents host CPU rollout actors only, so the
+protocol is deliberately head↔agent star-shaped — no agent↔agent
+object transfer, no distributed scheduler consensus. An agent joins
+with ``ray.init(address="head:port")`` (or
+``python -m ray_tpu.core.node_agent``); the head enables the fleet
+with ``start_cluster_server()``.
+
+Framing: 4-byte big-endian length + pickled dict; binary payloads ride
+inside via ``core/serialization`` (pickle-5 out-of-band numpy). Trust
+model matches the KV service: cluster hosts only, bind loopback by
+default (``parallel/distributed.KVServer`` docstring).
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import socket
+import struct
+import threading
+import uuid
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.core import serialization as ser
+
+
+def _send_frame(sock: socket.socket, lock: threading.Lock, msg: Dict) -> None:
+    blob = pickle.dumps(msg, protocol=5)
+    with lock:
+        sock.sendall(struct.pack(">I", len(blob)) + blob)
+
+
+def _recv_frame(sock: socket.socket) -> Optional[Dict]:
+    header = _recv_exact(sock, 4)
+    if header is None:
+        return None
+    (n,) = struct.unpack(">I", header)
+    blob = _recv_exact(sock, n)
+    if blob is None:
+        return None
+    return pickle.loads(blob)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            return None
+        buf += chunk
+    return buf
+
+
+# ---------------------------------------------------------------------------
+# Head side
+# ---------------------------------------------------------------------------
+
+
+class RemoteNode:
+    """Head-side proxy for one registered agent (the NodeManager client
+    role). Owns the connection; a recv thread routes results into the
+    head's object store."""
+
+    def __init__(self, runtime, node_id: str, num_cpus: int, sock):
+        self.runtime = runtime
+        self.node_id = node_id
+        self.num_cpus = num_cpus
+        self.sock = sock
+        self.send_lock = threading.Lock()
+        self.actor_ids: set = set()
+        # guards inflight + dead against the call()/_on_disconnect()
+        # race: a call that slips past a dead check must still get its
+        # refs failed, never a forever-pending ray.get
+        self.state_lock = threading.Lock()
+        self.inflight: Dict[str, int] = {}  # task_id -> num_returns
+        self.dead = False
+        self._thread = threading.Thread(
+            target=self._recv_loop, daemon=True,
+            name=f"cluster_recv_{node_id}",
+        )
+        self._thread.start()
+
+    def _recv_loop(self):
+        while True:
+            try:
+                msg = _recv_frame(self.sock)
+            except OSError:
+                msg = None
+            if msg is None:
+                self._on_disconnect()
+                return
+            op = msg.get("op")
+            if op == "result":
+                task_id = msg["task_id"]
+                with self.state_lock:
+                    self.inflight.pop(task_id, None)
+                if msg.get("ok"):
+                    self.runtime.store.put(
+                        task_id,
+                        ser.loads(msg["payload"]),
+                        use_shm=False,
+                    )
+                else:
+                    from ray_tpu.core.api import RayTaskError
+
+                    self.runtime.store.put_error(
+                        task_id,
+                        RayTaskError(
+                            msg.get("name", "remote"),
+                            msg.get("traceback", ""),
+                        ),
+                    )
+
+    def _on_disconnect(self):
+        """Agent died / network split: fail everything it owed us
+        (the reference marks the node dead via GCS heartbeat timeout
+        and fails its leases)."""
+        from ray_tpu.core.api import RayActorError
+
+        with self.state_lock:
+            if self.dead:
+                return
+            self.dead = True
+            pending = list(self.inflight)
+            self.inflight.clear()
+        for task_id in pending:
+            self.runtime.store.put_error(
+                task_id,
+                RayActorError(
+                    f"node {self.node_id} disconnected mid-call"
+                ),
+            )
+        cluster = getattr(self.runtime, "cluster", None)
+        if cluster is not None:
+            cluster.nodes.pop(self.node_id, None)
+
+    # -- actor ops -------------------------------------------------------
+
+    def create_actor(self, actor_id, cls, args, kwargs, options):
+        _send_frame(
+            self.sock,
+            self.send_lock,
+            {
+                "op": "create_actor",
+                "actor_id": actor_id,
+                "cls": ser.dumps(cls),
+                "payload": ser.dumps((args, kwargs)),
+                "options": {
+                    k: v
+                    for k, v in options.items()
+                    if k in ("max_restarts", "daemon", "num_cpus")
+                },
+            },
+        )
+        self.actor_ids.add(actor_id)
+
+    def call(self, actor_id, method, args, kwargs, num_returns):
+        from ray_tpu.core.api import RayActorError
+
+        task_id = uuid.uuid4().hex
+        with self.state_lock:
+            alive = not self.dead
+            if alive:
+                self.inflight[task_id] = num_returns
+        if alive:
+            try:
+                _send_frame(
+                    self.sock,
+                    self.send_lock,
+                    {
+                        "op": "actor_call",
+                        "task_id": task_id,
+                        "actor_id": actor_id,
+                        "method": method,
+                        "payload": ser.dumps((args, kwargs)),
+                    },
+                )
+            except OSError:
+                alive = False
+        if not alive:
+            # registered (or send failed) against a dead node: fail the
+            # ref now — _on_disconnect may already have drained inflight
+            with self.state_lock:
+                still = self.inflight.pop(task_id, None)
+            if still is not None or self.dead:
+                self.runtime.store.put_error(
+                    task_id,
+                    RayActorError(
+                        f"node {self.node_id} disconnected mid-call"
+                    ),
+                )
+        from ray_tpu.core.api import ObjectRef
+
+        refs = [ObjectRef(task_id, self.runtime.store)]
+        if num_returns > 1:
+            refs = [
+                ObjectRef(f"{task_id}_{i}", self.runtime.store)
+                for i in range(num_returns)
+            ]
+            self.runtime._register_split(task_id, refs)
+        return refs
+
+    def kill(self, actor_id):
+        try:
+            _send_frame(
+                self.sock,
+                self.send_lock,
+                {"op": "kill_actor", "actor_id": actor_id},
+            )
+        except OSError:
+            pass
+        self.actor_ids.discard(actor_id)
+
+
+class ClusterServer:
+    """Head-side listener: agents connect, register, and become
+    placement targets (the gcs_node_manager registration role)."""
+
+    def __init__(self, runtime, host: str = "127.0.0.1", port: int = 0):
+        self.runtime = runtime
+        self.nodes: Dict[str, RemoteNode] = {}
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen()
+        self.port = self._sock.getsockname()[1]
+        self.host = host
+        self._thread = threading.Thread(
+            target=self._accept_loop, daemon=True, name="cluster_accept"
+        )
+        self._thread.start()
+
+    @property
+    def address(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    def _accept_loop(self):
+        while True:
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            msg = _recv_frame(conn)
+            if not msg or msg.get("op") != "register":
+                conn.close()
+                continue
+            node = RemoteNode(
+                self.runtime,
+                msg["node_id"],
+                int(msg.get("num_cpus", 1)),
+                conn,
+            )
+            self.nodes[msg["node_id"]] = node
+            _send_frame(
+                conn, node.send_lock, {"op": "registered", "ok": True}
+            )
+
+    def wait_for_nodes(self, n: int, timeout: float = 60.0) -> List[str]:
+        import time
+
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            alive = [k for k, v in self.nodes.items() if not v.dead]
+            if len(alive) >= n:
+                return alive
+            time.sleep(0.1)
+        raise TimeoutError(
+            f"only {len(self.nodes)} cluster nodes joined within "
+            f"{timeout}s (wanted {n})"
+        )
+
+    def pick_node(self, name: Optional[str] = None) -> RemoteNode:
+        alive = {k: v for k, v in self.nodes.items() if not v.dead}
+        if name is not None:
+            if name not in alive:
+                raise ValueError(f"no live cluster node {name!r}")
+            return alive[name]
+        if not alive:
+            raise ValueError("no live cluster nodes")
+        # least-loaded by placed actors (the hybrid scheduling policy's
+        # spread half, scheduling_policy.cc, scoped to actor counts)
+        return min(alive.values(), key=lambda nd: len(nd.actor_ids))
+
+    def shutdown(self):
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        for node in self.nodes.values():
+            try:
+                node.sock.close()
+            except OSError:
+                pass
+
+
+def start_cluster_server(
+    host: str = "127.0.0.1", port: int = 0
+) -> str:
+    """Enable the head's fleet listener; returns 'host:port' for agents
+    to join. Idempotent per runtime."""
+    from ray_tpu.core import api
+
+    rt = api._require_runtime()
+    if getattr(rt, "cluster", None) is None:
+        rt.cluster = ClusterServer(rt, host, port)
+    return rt.cluster.address
+
+
+# ---------------------------------------------------------------------------
+# Agent side
+# ---------------------------------------------------------------------------
+
+
+class NodeAgent:
+    """Joins a head's fleet and hosts actors in the LOCAL runtime
+    (worker pool, object store) of this process — the raylet role for
+    one host. Created by ``ray.init(address=...)``."""
+
+    def __init__(
+        self,
+        address: str,
+        node_id: Optional[str] = None,
+        num_cpus: Optional[int] = None,
+    ):
+        from ray_tpu.core import api
+
+        host, port = address.rsplit(":", 1)
+        self.node_id = node_id or f"node_{uuid.uuid4().hex[:8]}"
+        self.runtime = api._require_runtime()
+        self.num_cpus = num_cpus or int(self.runtime.num_cpus)
+        self.sock = socket.create_connection((host, int(port)))
+        self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self.send_lock = threading.Lock()
+        self.actors: Dict[str, str] = {}  # head actor_id -> local id
+        _send_frame(
+            self.sock,
+            self.send_lock,
+            {
+                "op": "register",
+                "node_id": self.node_id,
+                "num_cpus": self.num_cpus,
+            },
+        )
+        resp = _recv_frame(self.sock)
+        if not resp or not resp.get("ok"):
+            raise ConnectionError(
+                f"cluster head at {address} rejected registration"
+            )
+        self._thread = threading.Thread(
+            target=self._serve_loop, daemon=True, name="node_agent"
+        )
+        self._thread.start()
+
+    def _serve_loop(self):
+        while True:
+            try:
+                msg = _recv_frame(self.sock)
+            except OSError:
+                msg = None
+            if msg is None:
+                return
+            try:
+                self._handle(msg)
+            except Exception:
+                import traceback
+
+                if msg.get("task_id"):
+                    self._send_result(
+                        msg["task_id"],
+                        ok=False,
+                        name=msg.get("method", "cluster"),
+                        tb=traceback.format_exc(),
+                    )
+
+    def _send_result(self, task_id, *, ok, payload=b"", name="", tb=""):
+        _send_frame(
+            self.sock,
+            self.send_lock,
+            {
+                "op": "result",
+                "task_id": task_id,
+                "ok": ok,
+                "payload": payload,
+                "name": name,
+                "traceback": tb,
+            },
+        )
+
+    def _handle(self, msg: Dict):
+        op = msg["op"]
+        if op == "create_actor":
+            cls = ser.loads(msg["cls"])
+            args, kwargs = ser.loads(msg["payload"])
+            handle = self.runtime.create_actor(
+                cls, args, kwargs, dict(msg.get("options") or {})
+            )
+            self.actors[msg["actor_id"]] = handle._actor_id
+        elif op == "actor_call":
+            task_id = msg["task_id"]
+            local_id = self.actors.get(msg["actor_id"])
+            if local_id is None:
+                self._send_result(
+                    task_id,
+                    ok=False,
+                    name=msg["method"],
+                    tb=f"unknown actor {msg['actor_id']}",
+                )
+                return
+            args, kwargs = ser.loads(msg["payload"])
+            refs = self.runtime.call_actor(
+                local_id, msg["method"], args, kwargs, num_returns=1
+            )
+            ref = refs[0]
+
+            # result callback keeps the serve loop free for the next
+            # message (actor ordering is preserved by the actor's own
+            # pipe queue, not by this thread)
+            def on_ready(task_id=task_id, ref=ref, name=msg["method"]):
+                try:
+                    value = self.runtime.store.get(ref.id, timeout=0)
+                except Exception:
+                    import traceback
+
+                    self._send_result(
+                        task_id,
+                        ok=False,
+                        name=name,
+                        tb=traceback.format_exc(),
+                    )
+                    return
+                self._send_result(
+                    task_id, ok=True, payload=ser.dumps(value)
+                )
+                self.runtime.store.free([ref.id])
+
+            self.runtime.store.on_ready(ref.id, on_ready)
+        elif op == "kill_actor":
+            local_id = self.actors.pop(msg["actor_id"], None)
+            if local_id is not None:
+                self.runtime.kill_actor(local_id)
+
+    def close(self):
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+def main():  # pragma: no cover - thin CLI
+    import argparse
+    import time
+
+    parser = argparse.ArgumentParser(
+        description="ray_tpu node agent: join a head's actor fleet"
+    )
+    parser.add_argument("--address", required=True, help="head host:port")
+    parser.add_argument("--node-id", default=None)
+    parser.add_argument("--num-cpus", type=int, default=None)
+    args = parser.parse_args()
+    import ray_tpu.core.api as api
+
+    api.init(num_cpus=args.num_cpus)
+    agent = NodeAgent(args.address, args.node_id, args.num_cpus)
+    print(f"node agent {agent.node_id} joined {args.address}", flush=True)
+    while True:
+        time.sleep(3600)
+
+
+if __name__ == "__main__":  # pragma: no cover
+    main()

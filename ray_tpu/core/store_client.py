@@ -1,0 +1,145 @@
+"""Pluggable control-plane metadata storage (the GCS storage role).
+
+Counterpart of the reference's GCS store clients —
+``src/ray/gcs/store_client/in_memory_store_client.h:31`` (default,
+volatile) and ``redis_store_client.h:27`` (external store that survives
+GCS restart, exercised by ``python/ray/tests/test_gcs_fault_tolerance.py``)
+— behind the table interface of ``gcs/gcs_table_storage.cc``.
+
+TPU-first disposition: the control plane here is a single coordinator
+process (no quorum), so durability means "survives driver/coordinator
+restart", and the idiomatic single-host durable backend is sqlite (WAL
+mode, stdlib, crash-safe) rather than an external Redis. The interface
+is the seam: a Redis-backed client can slot in for a real multi-host
+control plane without touching callers (``parallel/distributed.KVServer``,
+the job table, Tune experiment state).
+"""
+
+from __future__ import annotations
+
+import os
+import sqlite3
+import threading
+from typing import Dict, List, Optional
+
+
+class StoreClient:
+    """Key → bytes tables ('kv', 'jobs', 'actors', ...)."""
+
+    def put(self, table: str, key: str, value: bytes) -> None:
+        raise NotImplementedError
+
+    def get(self, table: str, key: str) -> Optional[bytes]:
+        raise NotImplementedError
+
+    def delete(self, table: str, key: str) -> None:
+        raise NotImplementedError
+
+    def keys(self, table: str) -> List[str]:
+        raise NotImplementedError
+
+    def all(self, table: str) -> Dict[str, bytes]:
+        return {k: self.get(table, k) for k in self.keys(table)}
+
+    def close(self) -> None:
+        pass
+
+
+class InMemoryStoreClient(StoreClient):
+    """reference in_memory_store_client.h:31 (volatile default)."""
+
+    def __init__(self):
+        self._tables: Dict[str, Dict[str, bytes]] = {}
+        self._lock = threading.Lock()
+
+    def put(self, table, key, value):
+        with self._lock:
+            self._tables.setdefault(table, {})[key] = value
+
+    def get(self, table, key):
+        with self._lock:
+            return self._tables.get(table, {}).get(key)
+
+    def delete(self, table, key):
+        with self._lock:
+            self._tables.get(table, {}).pop(key, None)
+
+    def keys(self, table):
+        with self._lock:
+            return list(self._tables.get(table, {}))
+
+    def all(self, table):
+        with self._lock:
+            return dict(self._tables.get(table, {}))
+
+
+class SqliteStoreClient(StoreClient):
+    """Durable single-file backend (the redis_store_client.h:27 role
+    for a single-coordinator control plane): a restarted coordinator
+    reloads every table from the file."""
+
+    def __init__(self, path: str):
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self._path = path
+        # one connection guarded by a lock: the control plane's write
+        # rate is metadata-scale, not data-plane-scale
+        self._conn = sqlite3.connect(path, check_same_thread=False)
+        self._conn.execute("PRAGMA journal_mode=WAL")
+        self._conn.execute("PRAGMA synchronous=NORMAL")
+        self._conn.execute(
+            "CREATE TABLE IF NOT EXISTS store ("
+            " tbl TEXT NOT NULL, key TEXT NOT NULL, value BLOB,"
+            " PRIMARY KEY (tbl, key))"
+        )
+        self._conn.commit()
+        self._lock = threading.Lock()
+
+    def put(self, table, key, value):
+        with self._lock:
+            self._conn.execute(
+                "INSERT INTO store (tbl, key, value) VALUES (?, ?, ?) "
+                "ON CONFLICT(tbl, key) DO UPDATE SET value=excluded.value",
+                (table, key, value),
+            )
+            self._conn.commit()
+
+    def get(self, table, key):
+        with self._lock:
+            row = self._conn.execute(
+                "SELECT value FROM store WHERE tbl=? AND key=?",
+                (table, key),
+            ).fetchone()
+        return None if row is None else row[0]
+
+    def delete(self, table, key):
+        with self._lock:
+            self._conn.execute(
+                "DELETE FROM store WHERE tbl=? AND key=?", (table, key)
+            )
+            self._conn.commit()
+
+    def keys(self, table):
+        with self._lock:
+            rows = self._conn.execute(
+                "SELECT key FROM store WHERE tbl=?", (table,)
+            ).fetchall()
+        return [r[0] for r in rows]
+
+    def all(self, table):
+        with self._lock:
+            rows = self._conn.execute(
+                "SELECT key, value FROM store WHERE tbl=?", (table,)
+            ).fetchall()
+        return dict(rows)
+
+    def close(self):
+        with self._lock:
+            self._conn.close()
+
+
+def make_store_client(persist_path: Optional[str]) -> StoreClient:
+    """persist_path=None → volatile; else the durable sqlite backend
+    (reference: storage type is a GCS boot option, gcs_server.h:70)."""
+    if persist_path:
+        return SqliteStoreClient(persist_path)
+    return InMemoryStoreClient()

@@ -92,9 +92,8 @@ class PongLite(gym.Env):
             else:
                 reward = -1.0
             self.rallies += 1
-            if reward < 0 or self.rallies < self.rallies_per_episode:
-                if self.rallies < self.rallies_per_episode:
-                    self._serve()
+            if self.rallies < self.rallies_per_episode:
+                self._serve()
 
         terminated = self.rallies >= self.rallies_per_episode
         truncated = self.steps >= self.max_steps

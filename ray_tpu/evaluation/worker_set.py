@@ -55,14 +55,21 @@ class WorkerSet:
             ray.init()
         RemoteWorker = ray.remote(RolloutWorker)
         start = len(self._remote_workers)
+        # cross-host fleet: round-robin rollout actors over the named
+        # cluster nodes ("any" = least-loaded); without the config key
+        # all actors stay on the head host (core/cluster.py)
+        nodes = self._config.get("worker_nodes") or []
         for i in range(num_workers):
+            opts = dict(
+                max_restarts=int(
+                    self._config.get("recreate_failed_workers", False)
+                )
+                and 3
+            )
+            if nodes:
+                opts["placement_node"] = nodes[(start + i) % len(nodes)]
             self._remote_workers.append(
-                RemoteWorker.options(
-                    max_restarts=int(
-                        self._config.get("recreate_failed_workers", False)
-                    )
-                    and 3
-                ).remote(
+                RemoteWorker.options(**opts).remote(
                     env_creator=self._env_creator,
                     policy_cls=self._policy_cls,
                     policy_specs=self._policy_specs,

@@ -40,6 +40,8 @@ class _KVHandler(socketserver.StreamRequestHandler):
                 blob = self.rfile.read(req["len"])
                 with store.lock:
                     store.data[req["key"]] = blob
+                    if store.persist is not None:
+                        store.persist.put("kv", req["key"], blob)
                     store.cv.notify_all()
                 self.wfile.write(b'{"ok": true}\n')
             elif op == "get":
@@ -91,10 +93,33 @@ class KVServer:
     reachable from cluster hosts (same as the reference's GCS, which is
     also unauthenticated by default). The default bind is loopback;
     pass host="0.0.0.0" explicitly for a real multi-host cluster and
-    keep the port firewalled to the cluster network."""
+    keep the port firewalled to the cluster network.
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 0):
-        self.data: Dict[str, bytes] = {}
+    Durability: ``persist_path`` (or ``RAY_TPU_KV_PERSIST``) backs the
+    KV table with a durable store client — a restarted coordinator
+    reloads every key, so driver death no longer loses cluster KV state
+    (reference: GCS fault tolerance via external Redis,
+    ``gcs/store_client/redis_store_client.h:27``,
+    ``test_gcs_fault_tolerance.py``). Heartbeats stay volatile by
+    design — liveness must be re-proven after a restart."""
+
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        persist_path: Optional[str] = None,
+    ):
+        from ray_tpu.core.store_client import make_store_client
+
+        persist_path = persist_path or os.environ.get(
+            "RAY_TPU_KV_PERSIST"
+        )
+        self.persist = (
+            make_store_client(persist_path) if persist_path else None
+        )
+        self.data: Dict[str, bytes] = (
+            dict(self.persist.all("kv")) if self.persist else {}
+        )
         self.heartbeats: Dict[str, float] = {}
         self.lock = threading.Lock()
         self.cv = threading.Condition(self.lock)
@@ -116,6 +141,8 @@ class KVServer:
     def shutdown(self) -> None:
         self._server.shutdown()
         self._server.server_close()
+        if self.persist is not None:
+            self.persist.close()
 
 
 class KVClient:

@@ -477,7 +477,17 @@ class JaxPolicy(Policy):
             def mb_step(carry, mb_rng_idx):
                 params, opt_state = carry
                 idx, mb_rng = mb_rng_idx
-                mb = jax.tree_util.tree_map(lambda x: x[idx], batch)
+                # __chunk__ columns hold one row per T-row unroll
+                # (chunk-start recurrent states); gather them by the
+                # unroll indices the row permutation selected
+                mb = {
+                    k: (
+                        v[idx.reshape(-1, T_seq)[:, 0] // T_seq]
+                        if k.startswith("__chunk__")
+                        else v[idx]
+                    )
+                    for k, v in batch.items()
+                }
                 (loss, stats), grads = jax.value_and_grad(
                     loss_fn, has_aux=True
                 )(params, aux, mb, mb_rng, coeffs)
@@ -578,6 +588,20 @@ class JaxPolicy(Policy):
             if trim != bsize:
                 batch = {k: v[:trim] for k, v in batch.items()}
                 bsize = trim
+        if self._unroll_T > 1 and "state_in_0" in batch:
+            # stored-state mode ships ONE state per unroll, not per
+            # row: only chunk-start states are ever read (the [:, 0]
+            # in model_forward_train), so slicing here cuts the
+            # host→device state transfer by T. Sliced AFTER trim/tile
+            # so tiled layouts keep the state of each final chunk
+            # start.
+            T = self._unroll_T
+            k = 0
+            while f"state_in_{k}" in batch:
+                batch[f"__chunk__state_in_{k}"] = batch.pop(
+                    f"state_in_{k}"
+                )[::T]
+                k += 1
         if frames is not None:
             batch[_FRAMES] = frames
         return batch, bsize
@@ -788,11 +812,18 @@ class JaxPolicy(Policy):
             pr = batch.get(SampleBatch.PREV_REWARDS)
             if pr is not None:
                 kwargs["prev_rewards"] = pr.reshape(B, T)
-        if (
-            getattr(self.model, "supports_stored_train_state", False)
-            and "state_in_0" in batch
-        ):
-            # stored-state mode: each unroll starts from the state the
+        stored = getattr(self.model, "supports_stored_train_state", False)
+        if stored and "__chunk__state_in_0" in batch:
+            # prepare_batch already sliced to one state per unroll
+            state0 = []
+            k = 0
+            while f"__chunk__state_in_{k}" in batch:
+                state0.append(batch[f"__chunk__state_in_{k}"])
+                k += 1
+            state0 = tuple(state0)
+        elif stored and "state_in_0" in batch:
+            # per-row columns (compute_gradients path, which bypasses
+            # prepare_batch): each unroll starts from the state the
             # sampler recorded at its first row (exact rollout replay
             # for mid-episode chunks; resets re-zero the carry at any
             # in-chunk episode boundary)

@@ -20,6 +20,7 @@ Two execution modes:
 from __future__ import annotations
 
 import os
+import pickle
 import traceback
 from typing import Any, Dict, List, Optional, Type, Union
 
@@ -158,6 +159,8 @@ class TrialRunner:
         callbacks: Optional[List] = None,
         parallel: bool = False,
         max_concurrent: Optional[int] = None,
+        experiment_dir: Optional[str] = None,
+        resume: bool = False,
     ):
         self.trainable_cls = trainable_cls
         self.trials = trials
@@ -170,6 +173,63 @@ class TrialRunner:
         self.max_concurrent = max_concurrent or (os.cpu_count() or 4)
         self._in_flight: Dict = {}  # train ref -> trial
         self._parallel_proven = False  # any actor created successfully
+        self.experiment_dir = experiment_dir
+        if resume:
+            self._restore_experiment_state()
+
+    # -- experiment-state durability (driver-restart resume) ---------------
+    #
+    # The reference checkpoints TrialRunner state to
+    # experiment_state-*.json in the experiment dir
+    # (tune/execution/trial_runner.py checkpoint()/resume()); a killed
+    # driver resumes with tune.run(..., resume=True). Same protocol
+    # here: per-trial status/last_result/checkpoint_path snapshots,
+    # written atomically after every processed result.
+
+    @property
+    def _state_path(self) -> Optional[str]:
+        if not self.experiment_dir:
+            return None
+        return os.path.join(self.experiment_dir, "experiment_state.pkl")
+
+    def _save_experiment_state(self) -> None:
+        path = self._state_path
+        if not path:
+            return
+        os.makedirs(self.experiment_dir, exist_ok=True)
+        state = {
+            t.trial_id: {
+                "status": t.status
+                if t.status in (TERMINATED, ERROR)
+                else PENDING,
+                "config": t.config,
+                "last_result": t.last_result,
+                "checkpoint_path": t.checkpoint_path,
+                "error": t.error,
+            }
+            for t in self.trials
+        }
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            pickle.dump(state, f)
+        os.replace(tmp, path)  # atomic: a crash never corrupts state
+
+    def _restore_experiment_state(self) -> None:
+        path = self._state_path
+        if not path or not os.path.exists(path):
+            return
+        with open(path, "rb") as f:
+            saved = pickle.load(f)
+        for trial in self.trials:
+            s = saved.get(trial.trial_id)
+            if s is None:
+                continue
+            trial.last_result = s["last_result"]
+            trial.checkpoint_path = s["checkpoint_path"]
+            trial.error = s["error"]
+            trial.status = s["status"]
+            # PENDING trials with a checkpoint restart from it (the
+            # restore happens when their runner starts)
 
     def is_finished(self) -> bool:
         return all(
@@ -200,13 +260,16 @@ class TrialRunner:
             if self.checkpoint_freq:
                 trial.checkpoint_path = trial.runner.save()
             self._cleanup_trial(trial)
+            self._save_experiment_state()
             return False
+        self._save_experiment_state()
         return True
 
     def _fail_trial(self, trial: Trial, err: str) -> None:
         trial.status = ERROR
         trial.error = err
         self._cleanup_trial(trial)
+        self._save_experiment_state()
 
     def step(self) -> None:
         if self.parallel:
@@ -227,6 +290,8 @@ class TrialRunner:
                     trial.runner = self.trainable_cls(
                         config=trial.config
                     )
+                    if trial.checkpoint_path:  # driver-restart resume
+                        trial.runner.restore(trial.checkpoint_path)
                     trial.status = RUNNING
                 except Exception:
                     self._fail_trial(trial, traceback.format_exc())
@@ -264,6 +329,12 @@ class TrialRunner:
             return
         self._parallel_proven = True
         trial.runner = _RemoteTrainableProxy(actor)
+        if trial.checkpoint_path:  # driver-restart resume
+            try:
+                trial.runner.restore(trial.checkpoint_path)
+            except Exception:
+                self._fail_trial(trial, traceback.format_exc())
+                return
         trial.status = RUNNING
         self._in_flight[actor.train.remote()] = trial
 
@@ -338,31 +409,55 @@ def run(
     seed: int = 0,
     parallel: Optional[bool] = None,
     max_concurrent_trials: Optional[int] = None,
+    name: Optional[str] = None,
+    resume: bool = False,
 ) -> ExperimentAnalysis:
     """reference tune/tune.py:118.
 
     parallel: None (default) runs multi-trial experiments as concurrent
     actors and single-trial experiments in-process (where they own the
     TPU mesh). Force with True/False.
+
+    resume: reattach to a previous run of the same experiment
+    (``local_dir``/``name``): trials that finished stay finished,
+    interrupted trials restart from their latest checkpoint (requires
+    ``checkpoint_freq``; reference trial_runner.py resume()). Trial
+    identity is positional — the deterministic variant generator must
+    see the same config/num_samples/seed.
     """
     if isinstance(run_or_experiment, str):
         from ray_tpu.algorithms.registry import get_algorithm_class
 
         trainable_cls = get_algorithm_class(run_or_experiment)
-        name = run_or_experiment
+        exp_name = name or run_or_experiment
     else:
         trainable_cls = run_or_experiment
-        name = trainable_cls.__name__
+        exp_name = name or trainable_cls.__name__
 
+    if resume and not local_dir:
+        raise ValueError(
+            "tune.run(resume=True) needs local_dir: experiment state "
+            "lives in <local_dir>/<name>/experiment_state.pkl"
+        )
     stop = dict(stop or {})
     max_iters = int(stop.pop("training_iteration", max_iterations))
     gen = BasicVariantGenerator(config or {}, num_samples, seed)
     trials = [
-        Trial(name, v, stopping_criterion=stop)
-        for v in iter(gen.next_variant, None)
+        Trial(
+            exp_name,
+            v,
+            stopping_criterion=stop,
+            # stable across driver restarts so resume can match trials
+            # to their saved state
+            trial_id=f"{exp_name}_{i:05d}",
+        )
+        for i, v in enumerate(iter(gen.next_variant, None))
     ]
     if parallel is None:
         parallel = len(trials) > 1
+    experiment_dir = (
+        os.path.join(local_dir, exp_name) if local_dir else None
+    )
     runner = TrialRunner(
         trainable_cls,
         trials,
@@ -373,6 +468,8 @@ def run(
         callbacks=callbacks,
         parallel=parallel,
         max_concurrent=max_concurrent_trials,
+        experiment_dir=experiment_dir,
+        resume=resume,
     )
     try:
         while not runner.is_finished():
