@@ -2,23 +2,46 @@
 
 Counterpart of the reference's Serve core path — ``@serve.deployment``
 (``serve/deployment.py:34``), controller-managed replica actors
-(``serve/replica.py:218`` handle_request), round-robin routing, and the
-HTTP proxy (``serve/http_proxy.py:190``) — scoped to one host: a
-deployment is a group of replica actors behind a round-robin
-DeploymentHandle, optionally exposed over a stdlib HTTP ingress that
-POSTs JSON to the deployment's __call__."""
+(``serve/replica.py:218`` handle_request), round-robin routing, the
+HTTP proxy (``serve/http_proxy.py:190``), queue-depth autoscaling
+(``serve/autoscaling_policy.py`` BasicAutoscalingPolicy), and long-poll
+config push (``serve/long_poll.py``) — scoped to one host: a
+deployment is a group of replica actors behind a DeploymentHandle.
+
+Autoscaling: each deployment may carry an ``autoscaling_config``
+(min_replicas / max_replicas / target_num_ongoing_requests_per_replica
+/ upscale_delay_s / downscale_delay_s); a controller thread samples the
+handle's in-flight request count and adds/removes replica actors. The
+new membership is pushed to handles via the long-poll host — requests
+spread onto new replicas without the caller doing anything.
+
+Config push: ``update_deployment(name, user_config=...)`` calls
+``reconfigure(user_config)`` on every LIVE replica (no restart — the
+reference's Deployment.user_config contract) and publishes the change.
+"""
 
 from __future__ import annotations
 
 import json
 import threading
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+import time
 from typing import Any, Callable, Dict, List, Optional
 
 import ray_tpu as ray
+from ray_tpu.serve.long_poll import LongPollHost
 
 _DEPLOYMENTS: Dict[str, "RunningDeployment"] = {}
 _HTTP_SERVER = None
+_LONG_POLL = LongPollHost()
+
+DEFAULT_AUTOSCALING = {
+    "min_replicas": 1,
+    "max_replicas": 4,
+    "target_num_ongoing_requests_per_replica": 2.0,
+    "upscale_delay_s": 0.5,
+    "downscale_delay_s": 2.0,
+    "interval_s": 0.25,
+}
 
 
 @ray.remote
@@ -26,7 +49,7 @@ class _Replica:
     """Hosts one instance of the deployment class (reference
     replica.py:218)."""
 
-    def __init__(self, cls_or_fn, init_args, init_kwargs):
+    def __init__(self, cls_or_fn, init_args, init_kwargs, user_config=None):
         if isinstance(cls_or_fn, type):
             self._obj = cls_or_fn(*init_args, **(init_kwargs or {}))
         elif init_args or init_kwargs:
@@ -39,6 +62,9 @@ class _Replica:
         else:
             self._obj = cls_or_fn
         self.num_requests = 0
+        self.num_reconfigures = 0
+        if user_config is not None:
+            self.reconfigure(user_config)
 
     def handle(self, args, kwargs):
         self.num_requests += 1
@@ -48,19 +74,50 @@ class _Replica:
         self.num_requests += 1
         return getattr(self._obj, method)(*args, **kwargs)
 
+    def reconfigure(self, user_config):
+        """In-place config update, NO restart (reference
+        replica.py reconfigure / user_config contract)."""
+        self.num_reconfigures += 1
+        if hasattr(self._obj, "reconfigure"):
+            self._obj.reconfigure(user_config)
+
     def stats(self):
-        return {"num_requests": self.num_requests}
+        return {
+            "num_requests": self.num_requests,
+            "num_reconfigures": self.num_reconfigures,
+        }
 
 
 class DeploymentHandle:
-    """Round-robin client to a replica group (reference
-    serve/handle.py)."""
+    """Routing client to a replica group (reference serve/handle.py):
+    round-robin over the CURRENT membership, which a long-poll listener
+    keeps fresh as the autoscaler adds/removes replicas."""
 
     def __init__(self, name: str, replicas: List):
         self.name = name
-        self._replicas = replicas
+        self._replicas = list(replicas)
         self._rr = 0
         self._lock = threading.Lock()
+        self._inflight = 0
+        self._version = 0
+        self._stop = threading.Event()
+        self._listener = threading.Thread(
+            target=self._listen_loop, daemon=True,
+            name=f"serve_listen_{name}",
+        )
+        self._listener.start()
+
+    def _listen_loop(self):
+        while not self._stop.is_set():
+            out = _LONG_POLL.listen(
+                f"replicas:{self.name}", self._version, timeout=1.0
+            )
+            if out is None:
+                continue
+            version, replicas = out
+            with self._lock:
+                self._version = version
+                self._replicas = list(replicas)
 
     def _next(self):
         with self._lock:
@@ -68,26 +125,180 @@ class DeploymentHandle:
             self._rr += 1
         return r
 
+    def _track(self, ref):
+        with self._lock:
+            self._inflight += 1
+
+        def done():
+            with self._lock:
+                self._inflight -= 1
+
+        ref._store.on_ready(ref.id, done)
+        return ref
+
+    def num_inflight(self) -> int:
+        with self._lock:
+            return self._inflight
+
+    def num_replicas(self) -> int:
+        with self._lock:
+            return len(self._replicas)
+
     def remote(self, *args, **kwargs):
-        return self._next().handle.remote(list(args), kwargs)
+        return self._track(
+            self._next().handle.remote(list(args), kwargs)
+        )
 
     def method(self, name: str):
         handle = self
 
         class _M:
             def remote(self, *args, **kwargs):
-                return handle._next().call_method.remote(
-                    name, list(args), kwargs
+                return handle._track(
+                    handle._next().call_method.remote(
+                        name, list(args), kwargs
+                    )
                 )
 
         return _M()
 
+    def stop(self):
+        self._stop.set()
+
 
 class RunningDeployment:
-    def __init__(self, name, replicas, handle):
-        self.name = name
-        self.replicas = replicas
+    """Controller state for one deployment: replica membership, config
+    version, and the autoscale loop (the ServeController role,
+    reference serve/controller.py:55 + autoscaling_policy.py)."""
+
+    def __init__(self, spec: "Deployment", replicas: List, handle):
+        self.spec = spec
+        self.name = spec.name
+        self.replicas = list(replicas)
+        # guards membership against the scaler thread racing
+        # update_deployment / shutdown callers
+        self._members_lock = threading.Lock()
         self.handle = handle
+        self.user_config = spec.user_config
+        self._stop = threading.Event()
+        self._last_scale = time.monotonic()
+        self._scaler = None
+        if spec.autoscaling_config:
+            cfg = {**DEFAULT_AUTOSCALING, **spec.autoscaling_config}
+            # scale-to-zero is out of scope (an empty group would
+            # deadlock routing: no request can complete to raise the
+            # inflight signal) — the reference queues at the proxy
+            cfg["min_replicas"] = max(1, cfg["min_replicas"])
+            self.autoscaling = cfg
+            self._scaler = threading.Thread(
+                target=self._autoscale_loop, daemon=True,
+                name=f"serve_scaler_{self.name}",
+            )
+            self._scaler.start()
+        else:
+            self.autoscaling = None
+
+    def _spawn_replica(self):
+        return _Replica.remote(
+            self.spec._cls_or_fn,
+            self.spec._init_args,
+            self.spec._init_kwargs,
+            self.user_config,
+        )
+
+    def _publish(self):
+        with self._members_lock:
+            members = list(self.replicas)
+        _LONG_POLL.notify(f"replicas:{self.name}", members)
+
+    def _retire(self, victim) -> None:
+        """Drain-then-kill: membership was already republished (no new
+        traffic routes here), and the actor's ordered call queue means
+        a completed stats() proves every earlier request finished."""
+        try:
+            ray.get(victim.stats.remote(), timeout=30.0)
+        except Exception:
+            pass
+        try:
+            ray.kill(victim)
+        except Exception:
+            pass
+
+    def _autoscale_loop(self):
+        cfg = self.autoscaling
+        while not self._stop.wait(cfg["interval_s"]):
+            ongoing = self.handle.num_inflight()
+            with self._members_lock:
+                n = len(self.replicas)
+            per = ongoing / max(1, n)
+            target = cfg["target_num_ongoing_requests_per_replica"]
+            now = time.monotonic()
+            if (
+                per > target
+                and n < cfg["max_replicas"]
+                and now - self._last_scale >= cfg["upscale_delay_s"]
+            ):
+                replica = self._spawn_replica()
+                with self._members_lock:
+                    if self._stop.is_set():  # racing shutdown
+                        try:
+                            ray.kill(replica)
+                        except Exception:
+                            pass
+                        return
+                    self.replicas.append(replica)
+                self._last_scale = now
+                self._publish()
+            elif (
+                per < 0.5 * target
+                and n > cfg["min_replicas"]
+                and now - self._last_scale >= cfg["downscale_delay_s"]
+            ):
+                with self._members_lock:
+                    if len(self.replicas) <= cfg["min_replicas"]:
+                        continue
+                    victim = self.replicas.pop()
+                self._last_scale = now
+                self._publish()
+                self._retire(victim)
+
+    def reconfigure(self, user_config) -> None:
+        """Push a new user_config to every live replica, no restart."""
+        self.user_config = user_config
+        with self._members_lock:
+            members = list(self.replicas)
+        for r in members:
+            try:
+                ray.get(r.reconfigure.remote(user_config))
+            except Exception:
+                # racing a concurrent downscale: the victim is gone,
+                # and gone replicas don't need the new config
+                pass
+        self._publish()
+
+    def set_num_replicas(self, n: int) -> None:
+        n = max(1, n)
+        victims = []
+        with self._members_lock:
+            while len(self.replicas) < n:
+                self.replicas.append(self._spawn_replica())
+            while len(self.replicas) > n:
+                victims.append(self.replicas.pop())
+        self._publish()
+        for victim in victims:
+            self._retire(victim)
+
+    def stop(self):
+        self._stop.set()
+        self.handle.stop()
+        with self._members_lock:
+            members = list(self.replicas)
+            self.replicas = []
+        for r in members:
+            try:
+                ray.kill(r)
+            except Exception:
+                pass
 
 
 class Deployment:
@@ -100,12 +311,16 @@ class Deployment:
         num_replicas: int = 1,
         init_args=(),
         init_kwargs=None,
+        autoscaling_config: Optional[Dict] = None,
+        user_config: Optional[Any] = None,
     ):
         self._cls_or_fn = cls_or_fn
         self.name = name
         self.num_replicas = num_replicas
         self._init_args = tuple(init_args)
         self._init_kwargs = dict(init_kwargs or {})
+        self.autoscaling_config = autoscaling_config
+        self.user_config = user_config
 
     def bind(self, *args, **kwargs) -> "Deployment":
         return Deployment(
@@ -114,12 +329,16 @@ class Deployment:
             self.num_replicas,
             args,
             kwargs,
+            self.autoscaling_config,
+            self.user_config,
         )
 
     def options(
         self,
         num_replicas: Optional[int] = None,
         name: Optional[str] = None,
+        autoscaling_config: Optional[Dict] = None,
+        user_config: Optional[Any] = None,
     ) -> "Deployment":
         return Deployment(
             self._cls_or_fn,
@@ -127,30 +346,65 @@ class Deployment:
             num_replicas or self.num_replicas,
             self._init_args,
             self._init_kwargs,
+            (
+                autoscaling_config
+                if autoscaling_config is not None
+                else self.autoscaling_config
+            ),
+            (
+                user_config
+                if user_config is not None
+                else self.user_config
+            ),
         )
 
     def deploy(self) -> DeploymentHandle:
         ray.init(ignore_reinit_error=True)
+        n = self.num_replicas
+        if self.autoscaling_config:
+            n = max(
+                self.autoscaling_config.get("min_replicas", 1), 1
+            )
         replicas = [
             _Replica.remote(
-                self._cls_or_fn, self._init_args, self._init_kwargs
+                self._cls_or_fn,
+                self._init_args,
+                self._init_kwargs,
+                self.user_config,
             )
-            for _ in range(self.num_replicas)
+            for _ in range(n)
         ]
+        old = _DEPLOYMENTS.pop(self.name, None)
+        if old is not None:
+            # redeploy: retire the previous generation first, or its
+            # scaler thread keeps publishing stale membership onto the
+            # shared long-poll key and its replicas leak
+            old.stop()
         handle = DeploymentHandle(self.name, replicas)
         _DEPLOYMENTS[self.name] = RunningDeployment(
-            self.name, replicas, handle
+            self, replicas, handle
         )
         return handle
 
 
 def deployment(
-    _cls=None, *, name: Optional[str] = None, num_replicas: int = 1
+    _cls=None,
+    *,
+    name: Optional[str] = None,
+    num_replicas: int = 1,
+    autoscaling_config: Optional[Dict] = None,
+    user_config: Optional[Any] = None,
 ):
     """reference @serve.deployment decorator."""
 
     def wrap(cls):
-        return Deployment(cls, name or cls.__name__, num_replicas)
+        return Deployment(
+            cls,
+            name or cls.__name__,
+            num_replicas,
+            autoscaling_config=autoscaling_config,
+            user_config=user_config,
+        )
 
     if _cls is not None:
         return wrap(_cls)
@@ -175,8 +429,27 @@ def get_deployment(name: str) -> DeploymentHandle:
     return _DEPLOYMENTS[name].handle
 
 
+def update_deployment(
+    name: str,
+    *,
+    user_config: Optional[Any] = None,
+    num_replicas: Optional[int] = None,
+) -> None:
+    """Live config update (reference controller deploy-on-update +
+    long-poll broadcast): user_config reconfigures replicas in place,
+    num_replicas rescales the group; both propagate to handles without
+    a restart."""
+    dep = _DEPLOYMENTS[name]
+    if user_config is not None:
+        dep.reconfigure(user_config)
+    if num_replicas is not None:
+        dep.set_num_replicas(num_replicas)
+
+
 def _start_http(host: str, port: int):
     global _HTTP_SERVER
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
     if _HTTP_SERVER is not None:
         bound_host, bound_port = _HTTP_SERVER.server_address[:2]
         if (host, port) not in (
@@ -236,11 +509,7 @@ def http_port() -> Optional[int]:
 def shutdown() -> None:
     global _HTTP_SERVER
     for dep in _DEPLOYMENTS.values():
-        for r in dep.replicas:
-            try:
-                ray.kill(r)
-            except Exception:
-                pass
+        dep.stop()
     _DEPLOYMENTS.clear()
     if _HTTP_SERVER is not None:
         _HTTP_SERVER.shutdown()

@@ -1,0 +1,260 @@
+"""Pluggable trial searchers (ask/tell) + a pure-python TPE fallback.
+
+Counterpart of the reference's ``tune/suggest/suggestion.py`` (Searcher
+ABC: ``suggest``/``on_trial_complete``) and its external integrations
+(``tune/suggest/optuna.py``, ``hyperopt.py``, ``bohb.py``). The seam is
+the same ask/tell contract; external libraries plug in behind
+:class:`ExternalSearcher` when importable, and :class:`TPELiteSearcher`
+is the in-repo model-based fallback so suggestion-driven tuning works
+with zero extra dependencies.
+
+TPE-lite: the Tree-structured Parzen Estimator recipe (Bergstra et al.,
+NeurIPS 2011 — the algorithm behind hyperopt/optuna's default sampler):
+after ``n_startup`` random trials, split observations at the gamma
+quantile into good/bad sets, model each set with a kernel density per
+parameter (Gaussian over continuous/int domains, smoothed categorical
+over choices), sample candidates from the good model, and suggest the
+candidate maximizing the density ratio l(x)/g(x).
+"""
+
+from __future__ import annotations
+
+import copy
+import math
+import random
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu.tune.search import (
+    Choice,
+    Domain,
+    LogUniform,
+    Randint,
+    Uniform,
+)
+
+
+class Searcher:
+    """reference tune/suggest/suggestion.py Searcher."""
+
+    def __init__(self, metric: str = "episode_reward_mean",
+                 mode: str = "max"):
+        self.metric = metric
+        self.mode = mode
+
+    def suggest(self, trial_id: str) -> Optional[Dict]:
+        """→ a concrete config for a new trial (None = exhausted)."""
+        raise NotImplementedError
+
+    def on_trial_result(self, trial_id: str, result: Dict) -> None:
+        pass
+
+    def on_trial_complete(
+        self,
+        trial_id: str,
+        result: Optional[Dict] = None,
+        error: bool = False,
+    ) -> None:
+        pass
+
+
+def _flatten_space(config: Dict, prefix=()) -> List[Tuple[tuple, Domain]]:
+    out = []
+    for k, v in config.items():
+        if isinstance(v, Domain):
+            out.append((prefix + (k,), v))
+        elif isinstance(v, dict) and "grid_search" not in v:
+            out.extend(_flatten_space(v, prefix + (k,)))
+    return out
+
+
+def _set_path(d: Dict, path, value):
+    for k in path[:-1]:
+        d = d[k]
+    d[path[-1]] = value
+
+
+class TPELiteSearcher(Searcher):
+    def __init__(
+        self,
+        space: Dict,
+        metric: str = "episode_reward_mean",
+        mode: str = "max",
+        n_startup: int = 8,
+        gamma: float = 0.25,
+        n_candidates: int = 24,
+        explore_prob: float = 0.2,
+        seed: int = 0,
+    ):
+        super().__init__(metric, mode)
+        # ε-greedy prior draws keep exploring after the good-set KDE
+        # tightens (the role hyperopt's prior-weighted mixture plays:
+        # without it the searcher freezes on the best startup point)
+        self.explore_prob = explore_prob
+        self._template = copy.deepcopy(space)
+        self._space = _flatten_space(self._template)
+        self._rng = random.Random(seed)
+        self.n_startup = n_startup
+        self.gamma = gamma
+        self.n_candidates = n_candidates
+        self._suggested: Dict[str, Dict[tuple, Any]] = {}
+        self._observed: List[Tuple[Dict[tuple, Any], float]] = []
+
+    # -- domain helpers ---------------------------------------------------
+
+    def _rand(self, dom: Domain) -> Any:
+        return dom.sample(self._rng)
+
+    def _numeric_repr(self, dom, v) -> Optional[float]:
+        if isinstance(dom, LogUniform):
+            return math.log(v)
+        if isinstance(dom, (Uniform, Randint)):
+            return float(v)
+        return None  # categorical
+
+    def _from_numeric(self, dom, x: float):
+        if isinstance(dom, LogUniform):
+            lo, hi = dom.log_low, dom.log_high
+            return math.exp(min(max(x, lo), hi))
+        if isinstance(dom, Randint):
+            return int(round(min(max(x, dom.low), dom.high - 1)))
+        return min(max(x, dom.low), dom.high)
+
+    def _kde_sample(self, dom, values: List[Any]):
+        """Draw from the per-parameter density of one observation set."""
+        if isinstance(dom, Choice):
+            # smoothed categorical (counts + 1)
+            cats = dom.categories
+            weights = [1.0] * len(cats)
+            for v in values:
+                weights[cats.index(v)] += 1.0
+            return self._rng.choices(cats, weights=weights)[0]
+        xs = [self._numeric_repr(dom, v) for v in values]
+        mu = self._rng.choice(xs)
+        spread = max(xs) - min(xs) if len(xs) > 1 else 0.0
+        bw = max(spread / 2.0, self._range(dom) / 10.0)
+        return self._from_numeric(dom, self._rng.gauss(mu, bw))
+
+    def _kde_logpdf(self, dom, values: List[Any], v) -> float:
+        if isinstance(dom, Choice):
+            cats = dom.categories
+            weights = [1.0] * len(cats)
+            for obs in values:
+                weights[cats.index(obs)] += 1.0
+            total = sum(weights)
+            return math.log(weights[cats.index(v)] / total)
+        xs = [self._numeric_repr(dom, obs) for obs in values]
+        x = self._numeric_repr(dom, v)
+        spread = max(xs) - min(xs) if len(xs) > 1 else 0.0
+        bw = max(spread / 2.0, self._range(dom) / 10.0)
+        acc = 0.0
+        for mu in xs:
+            acc += math.exp(-0.5 * ((x - mu) / bw) ** 2)
+        return math.log(max(acc / (len(xs) * bw), 1e-300))
+
+    @staticmethod
+    def _range(dom) -> float:
+        if isinstance(dom, LogUniform):
+            return dom.log_high - dom.log_low
+        return float(dom.high - dom.low)
+
+    # -- ask / tell -------------------------------------------------------
+
+    def suggest(self, trial_id: str) -> Optional[Dict]:
+        if (
+            len(self._observed) < self.n_startup
+            or len(self._space) == 0
+            or self._rng.random() < self.explore_prob
+        ):
+            values = {p: self._rand(d) for p, d in self._space}
+        else:
+            ranked = sorted(
+                self._observed,
+                key=lambda ov: ov[1],
+                reverse=(self.mode == "max"),
+            )
+            n_good = max(1, int(self.gamma * len(ranked)))
+            good = [v for v, _ in ranked[:n_good]]
+            bad = [v for v, _ in ranked[n_good:]] or good
+            best_score, values = -math.inf, None
+            for _ in range(self.n_candidates):
+                cand = {
+                    p: self._kde_sample(d, [g[p] for g in good])
+                    for p, d in self._space
+                }
+                score = sum(
+                    self._kde_logpdf(d, [g[p] for g in good], cand[p])
+                    - self._kde_logpdf(d, [b[p] for b in bad], cand[p])
+                    for p, d in self._space
+                )
+                if score > best_score:
+                    best_score, values = score, cand
+        self._suggested[trial_id] = values
+        config = copy.deepcopy(self._template)
+        for path, _ in self._space:
+            _set_path(config, path, values[path])
+        return config
+
+    def on_trial_complete(self, trial_id, result=None, error=False):
+        values = self._suggested.pop(trial_id, None)
+        if values is None or error or not result:
+            return
+        metric = result.get(self.metric)
+        if metric is None:
+            return
+        self._observed.append((values, float(metric)))
+
+
+class ExternalSearcher(Searcher):
+    """Adapter seam for ask/tell suggestion libraries (the
+    tune/suggest/optuna.py role). Wraps any object with
+    ``ask() -> (trial_key, config)`` and
+    ``tell(trial_key, value)``; import failures raise here — callers
+    fall back to :class:`TPELiteSearcher`."""
+
+    def __init__(self, backend, metric="episode_reward_mean", mode="max"):
+        super().__init__(metric, mode)
+        self._backend = backend
+        self._keys: Dict[str, Any] = {}
+
+    def suggest(self, trial_id):
+        out = self._backend.ask()
+        if out is None:
+            return None
+        key, config = out
+        self._keys[trial_id] = key
+        return config
+
+    def on_trial_complete(self, trial_id, result=None, error=False):
+        key = self._keys.pop(trial_id, None)
+        if key is None or error or not result:
+            return
+        value = result.get(self.metric)
+        if value is not None:
+            self._backend.tell(key, float(value))
+
+
+def create_searcher(
+    name: str,
+    space: Dict,
+    metric: str = "episode_reward_mean",
+    mode: str = "max",
+    **kwargs,
+) -> Searcher:
+    """reference tune/suggest/__init__.py create_searcher."""
+    name = name.lower()
+    if name in ("tpe", "tpe-lite", "tpelite"):
+        return TPELiteSearcher(space, metric, mode, **kwargs)
+    if name == "optuna":  # external integration when available
+        try:
+            import optuna  # noqa: F401
+        except ImportError as e:
+            raise ImportError(
+                "optuna is not installed; use create_searcher('tpe', "
+                "...) for the in-repo TPE fallback"
+            ) from e
+        from ray_tpu.tune.suggest_optuna import OptunaBackend
+
+        return ExternalSearcher(
+            OptunaBackend(space, metric, mode), metric, mode
+        )
+    raise ValueError(f"unknown searcher {name!r}")

@@ -1,0 +1,56 @@
+"""Optuna backend for :class:`ray_tpu.tune.suggest.ExternalSearcher`
+(reference ``tune/suggest/optuna.py`` OptunaSearch). Import requires
+``optuna``; environments without it use the in-repo TPE fallback
+(``create_searcher('tpe', ...)``)."""
+
+from __future__ import annotations
+
+import copy
+from typing import Dict
+
+import optuna
+
+from ray_tpu.tune.search import Choice, LogUniform, Randint, Uniform
+from ray_tpu.tune.suggest import _flatten_space, _set_path
+
+
+class OptunaBackend:
+    """ask/tell bridge: Domain DSL → optuna distributions."""
+
+    def __init__(self, space: Dict, metric: str, mode: str):
+        self._template = copy.deepcopy(space)
+        self._space = _flatten_space(self._template)
+        self._study = optuna.create_study(
+            direction="maximize" if mode == "max" else "minimize"
+        )
+        self._trials: Dict[int, optuna.trial.Trial] = {}
+
+    def ask(self):
+        trial = self._study.ask()
+        config = copy.deepcopy(self._template)
+        for path, dom in self._space:
+            name = ".".join(path)
+            if isinstance(dom, LogUniform):
+                import math
+
+                v = trial.suggest_float(
+                    name,
+                    math.exp(dom.log_low),
+                    math.exp(dom.log_high),
+                    log=True,
+                )
+            elif isinstance(dom, Uniform):
+                v = trial.suggest_float(name, dom.low, dom.high)
+            elif isinstance(dom, Randint):
+                v = trial.suggest_int(name, dom.low, dom.high - 1)
+            elif isinstance(dom, Choice):
+                v = trial.suggest_categorical(name, dom.categories)
+            else:
+                v = dom.sample(__import__("random").Random())
+            _set_path(config, path, v)
+        self._trials[trial.number] = trial
+        return trial.number, config
+
+    def tell(self, key: int, value: float) -> None:
+        self._study.tell(key, value)
+        self._trials.pop(key, None)

@@ -32,6 +32,7 @@ from ray_tpu.tune.schedulers import (
     TrialScheduler,
 )
 from ray_tpu.tune.search import BasicVariantGenerator
+from ray_tpu.tune.search import Domain as SearchDomain
 from ray_tpu.tune.trial import (
     ERROR,
     PENDING,
@@ -161,6 +162,11 @@ class TrialRunner:
         max_concurrent: Optional[int] = None,
         experiment_dir: Optional[str] = None,
         resume: bool = False,
+        search_alg=None,
+        num_samples: int = 1,
+        trial_name: str = "trial",
+        stopping_criterion: Optional[Dict] = None,
+        base_config: Optional[Dict] = None,
     ):
         self.trainable_cls = trainable_cls
         self.trials = trials
@@ -174,8 +180,55 @@ class TrialRunner:
         self._in_flight: Dict = {}  # train ref -> trial
         self._parallel_proven = False  # any actor created successfully
         self.experiment_dir = experiment_dir
+        # ask/tell suggestion mode (reference SearchGenerator wrapping
+        # a Searcher): trials are created lazily from search_alg up to
+        # num_samples, and results are told back
+        self.search_alg = search_alg
+        self.search_num_samples = num_samples
+        self._search_stop = dict(stopping_criterion or {})
+        self._search_name = trial_name
+        self._search_base = dict(base_config or {})
+        self._search_exhausted = False
         if resume:
             self._restore_experiment_state()
+
+    def _maybe_ask_searcher(self) -> None:
+        if self.search_alg is None:
+            return
+        # only ask for as many live trials as can actually run: TPE-
+        # style searchers model completed results, so over-asking up
+        # front would degrade them to random search
+        cap = self.max_concurrent if self.parallel else 1
+        while len(self.trials) < self.search_num_samples and (
+            sum(
+                1
+                for t in self.trials
+                if t.status not in (TERMINATED, ERROR)
+            )
+            < cap
+        ):
+            trial_id = (
+                f"{self._search_name}_{len(self.trials):05d}"
+            )
+            config = self.search_alg.suggest(trial_id)
+            if config is None:
+                # searcher exhausted before num_samples: record it so
+                # is_finished() doesn't wait for trials that will
+                # never exist
+                self._search_exhausted = True
+                break
+            # constants from tune.run(config=...) merge under the
+            # suggested keys (real-Tune semantics: config is both the
+            # space template and the shared base)
+            merged = {**self._search_base, **config}
+            self.trials.append(
+                Trial(
+                    self._search_name,
+                    merged,
+                    stopping_criterion=self._search_stop,
+                    trial_id=trial_id,
+                )
+            )
 
     # -- experiment-state durability (driver-restart resume) ---------------
     #
@@ -232,6 +285,12 @@ class TrialRunner:
             # restore happens when their runner starts)
 
     def is_finished(self) -> bool:
+        if (
+            self.search_alg is not None
+            and not self._search_exhausted
+            and len(self.trials) < self.search_num_samples
+        ):
+            return False
         return all(
             t.status in (TERMINATED, ERROR) for t in self.trials
         )
@@ -243,6 +302,8 @@ class TrialRunner:
         should continue training."""
         trial.last_result = result
         trial.results.append(result)
+        if self.search_alg is not None:
+            self.search_alg.on_trial_result(trial.trial_id, result)
         for cb in self.callbacks:
             cb(trial, result)
         if self.checkpoint_freq and (
@@ -256,6 +317,10 @@ class TrialRunner:
             or result["training_iteration"] >= self.max_iterations
         ):
             trial.status = TERMINATED
+            if self.search_alg is not None:
+                self.search_alg.on_trial_complete(
+                    trial.trial_id, result
+                )
             self.scheduler.on_trial_complete(self, trial, result)
             if self.checkpoint_freq:
                 trial.checkpoint_path = trial.runner.save()
@@ -268,10 +333,15 @@ class TrialRunner:
     def _fail_trial(self, trial: Trial, err: str) -> None:
         trial.status = ERROR
         trial.error = err
+        if self.search_alg is not None:
+            self.search_alg.on_trial_complete(
+                trial.trial_id, error=True
+            )
         self._cleanup_trial(trial)
         self._save_experiment_state()
 
     def step(self) -> None:
+        self._maybe_ask_searcher()
         if self.parallel:
             self._step_parallel()
         else:
@@ -411,12 +481,23 @@ def run(
     max_concurrent_trials: Optional[int] = None,
     name: Optional[str] = None,
     resume: bool = False,
+    search_alg=None,
+    resources_per_trial: Optional[Dict] = None,
 ) -> ExperimentAnalysis:
     """reference tune/tune.py:118.
 
     parallel: None (default) runs multi-trial experiments as concurrent
     actors and single-trial experiments in-process (where they own the
     TPU mesh). Force with True/False.
+
+    resources_per_trial: {"TPU": n} (n > 0) declares accelerator
+    trials: they run IN-PROCESS, time-slicing the driver's mesh
+    across the population — each trainable jits onto the real TPU
+    devices (a single chip/tunnel cannot be claimed by concurrent
+    trial processes, so time-slicing is the single-host analog of the
+    reference's GPU allocation via placement groups,
+    tune/execution/ray_trial_executor.py). CPU-only trials keep the
+    concurrent-actor path.
 
     resume: reattach to a previous run of the same experiment
     (``local_dir``/``name``): trials that finished stay finished,
@@ -441,20 +522,33 @@ def run(
         )
     stop = dict(stop or {})
     max_iters = int(stop.pop("training_iteration", max_iterations))
-    gen = BasicVariantGenerator(config or {}, num_samples, seed)
-    trials = [
-        Trial(
-            exp_name,
-            v,
-            stopping_criterion=stop,
-            # stable across driver restarts so resume can match trials
-            # to their saved state
-            trial_id=f"{exp_name}_{i:05d}",
+    if search_alg is not None:
+        # suggestion mode: trials are created lazily from the searcher
+        # (reference SearchGenerator); config is its space template
+        trials = []
+        parallel = bool(parallel) if parallel is not None else (
+            num_samples > 1
         )
-        for i, v in enumerate(iter(gen.next_variant, None))
-    ]
-    if parallel is None:
-        parallel = len(trials) > 1
+    else:
+        gen = BasicVariantGenerator(config or {}, num_samples, seed)
+        trials = [
+            Trial(
+                exp_name,
+                v,
+                stopping_criterion=stop,
+                # stable across driver restarts so resume can match
+                # trials to their saved state
+                trial_id=f"{exp_name}_{i:05d}",
+            )
+            for i, v in enumerate(iter(gen.next_variant, None))
+        ]
+        if parallel is None:
+            parallel = len(trials) > 1
+    if resources_per_trial and resources_per_trial.get("TPU", 0) > 0:
+        # accelerator trials time-slice the driver's mesh in-process
+        # (see docstring); concurrent actor processes cannot share the
+        # chip claim
+        parallel = False
     experiment_dir = (
         os.path.join(local_dir, exp_name) if local_dir else None
     )
@@ -470,6 +564,17 @@ def run(
         max_concurrent=max_concurrent_trials,
         experiment_dir=experiment_dir,
         resume=resume,
+        search_alg=search_alg,
+        num_samples=num_samples,
+        trial_name=exp_name,
+        stopping_criterion=stop,
+        # constants shared by every suggested trial; Domain entries are
+        # excluded (in suggestion mode the searcher owns the space)
+        base_config={
+            k: v
+            for k, v in (config or {}).items()
+            if not isinstance(v, SearchDomain)
+        },
     )
     try:
         while not runner.is_finished():

@@ -1,0 +1,115 @@
+"""Serve autoscaling + long-poll config push (reference
+``serve/autoscaling_policy.py`` BasicAutoscalingPolicy and
+``serve/long_poll.py``)."""
+
+import time
+
+import pytest
+
+import ray_tpu as ray
+from ray_tpu.serve import serve
+from ray_tpu.serve.long_poll import LongPollHost
+
+
+@pytest.fixture(autouse=True)
+def _cleanup():
+    yield
+    serve.shutdown()
+
+
+def test_long_poll_host_versions():
+    host = LongPollHost()
+    assert host.listen("k", 0, timeout=0.05) is None  # nothing yet
+    v1 = host.notify("k", "a")
+    got = host.listen("k", 0, timeout=1.0)
+    assert got == (v1, "a")
+    # same version: blocks until the next change
+    import threading
+
+    out = []
+    t = threading.Thread(
+        target=lambda: out.append(host.listen("k", v1, timeout=5.0))
+    )
+    t.start()
+    time.sleep(0.1)
+    v2 = host.notify("k", "b")
+    t.join(timeout=5.0)
+    assert out == [(v2, "b")]
+
+
+def test_autoscales_up_under_load_and_back_down():
+    @serve.deployment(
+        name="slow",
+        autoscaling_config={
+            "min_replicas": 1,
+            "max_replicas": 3,
+            "target_num_ongoing_requests_per_replica": 1.0,
+            "upscale_delay_s": 0.1,
+            "downscale_delay_s": 0.5,
+            "interval_s": 0.1,
+        },
+    )
+    class SlowModel:
+        def __call__(self, x):
+            time.sleep(0.4)
+            return x
+
+    handle = serve.run(SlowModel.bind())
+    assert handle.num_replicas() == 1
+
+    # sustained load: keep many requests in flight
+    refs = [handle.remote(i) for i in range(12)]
+    deadline = time.time() + 20
+    while time.time() < deadline and handle.num_replicas() < 2:
+        refs.extend(handle.remote(i) for i in range(2))
+        time.sleep(0.2)
+    assert handle.num_replicas() >= 2, "no upscale under load"
+    ray.get(refs)
+
+    # drain: the controller scales back toward min_replicas
+    deadline = time.time() + 20
+    while time.time() < deadline and handle.num_replicas() > 1:
+        time.sleep(0.2)
+    assert handle.num_replicas() == 1, "no downscale after drain"
+
+
+def test_user_config_push_without_restart():
+    @serve.deployment(name="cfg", user_config={"scale": 2})
+    class Scaler:
+        def __init__(self):
+            self.scale = 1
+
+        def reconfigure(self, config):
+            self.scale = config["scale"]
+
+        def __call__(self, x):
+            return x * self.scale
+
+    handle = serve.run(Scaler.bind())
+    assert ray.get(handle.remote(10)) == 20  # init-time user_config
+
+    serve.update_deployment("cfg", user_config={"scale": 5})
+    assert ray.get(handle.remote(10)) == 50
+
+    # no restart: the replica kept serving the same instance — its
+    # cumulative request count includes the pre-update call
+    dep = serve._DEPLOYMENTS["cfg"]
+    stats = ray.get(dep.replicas[0].stats.remote())
+    assert stats["num_requests"] >= 2
+    assert stats["num_reconfigures"] >= 2  # init + push
+
+
+def test_rescale_propagates_to_handle_via_long_poll():
+    @serve.deployment(name="fixed", num_replicas=1)
+    class M:
+        def __call__(self, x):
+            return x + 1
+
+    handle = serve.run(M.bind())
+    assert handle.num_replicas() == 1
+    serve.update_deployment("fixed", num_replicas=3)
+    deadline = time.time() + 10
+    while time.time() < deadline and handle.num_replicas() != 3:
+        time.sleep(0.1)
+    assert handle.num_replicas() == 3
+    assert ray.get(handle.remote(1)) == 2

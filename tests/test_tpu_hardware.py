@@ -71,3 +71,63 @@ def test_pallas_probe_caches():
     assert _pallas_lowers(20, 70, 32) is True
     # cached second call is instant
     assert _pallas_lowers(20, 70, 32) is True
+
+
+def test_pbt_trials_jit_on_tpu(tmp_path):
+    """Tune trials with resources_per_trial={'TPU': 1} time-slice the
+    driver's mesh: every trainable's jitted step runs on the REAL TPU
+    backend, and PBT exploit still works across the population
+    (reference: GPU trial resources via placement groups,
+    tune/execution/ray_trial_executor.py)."""
+    import ray_tpu.tune.tune as tune
+    from ray_tpu.tune.schedulers import PopulationBasedTraining
+    from ray_tpu.tune.search import uniform
+    from ray_tpu.tune.trainable import Trainable
+
+    platforms = []
+
+    class JitTrainable(Trainable):
+        def setup(self, config):
+            self.lr = config["lr"]
+            self.w = jnp.zeros(())
+            self._step_fn = jax.jit(lambda w, lr: w + lr)
+
+        def step(self):
+            self.w = self._step_fn(self.w, self.lr)
+            platforms.append(
+                next(iter(self.w.devices())).platform
+            )
+            return {"episode_reward_mean": float(self.w)}
+
+        def get_exploit_state(self):
+            return {"w": jax.device_get(self.w)}
+
+        def apply_exploit(self, state, scalars):
+            self.w = jnp.asarray(state["w"])
+            self.lr = scalars.get("lr", self.lr)
+
+        def get_exploit_scalars(self):
+            return {"lr": self.lr}
+
+    ana = tune.run(
+        JitTrainable,
+        config={"lr": uniform(0.01, 0.1)},
+        num_samples=3,
+        scheduler=PopulationBasedTraining(
+            time_attr="training_iteration",
+            perturbation_interval=2,
+            hyperparam_mutations={"lr": uniform(0.01, 0.1)},
+        ),
+        resources_per_trial={"TPU": 1},
+        max_iterations=6,
+        local_dir=str(tmp_path),
+        verbose=0,
+    )
+    assert len(ana.trials) == 3
+    assert platforms and all(p == "tpu" for p in platforms), set(
+        platforms
+    )
+    assert all(
+        t.last_result.get("training_iteration") == 6
+        for t in ana.trials
+    )

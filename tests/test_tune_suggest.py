@@ -1,0 +1,168 @@
+"""Searcher plug-ins: TPE-lite fallback + ask/tell adapter seam
+(reference ``tune/suggest/suggestion.py`` Searcher,
+``tune/suggest/optuna.py`` integration)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu.tune.tune as tune
+from ray_tpu.tune.search import choice, loguniform, uniform
+from ray_tpu.tune.suggest import (
+    ExternalSearcher,
+    TPELiteSearcher,
+    create_searcher,
+)
+from ray_tpu.tune.trainable import Trainable
+
+
+def test_tpe_concentrates_on_optimum():
+    """Pure ask/tell loop on -(x-3)^2: after the random startup phase,
+    TPE suggestions must concentrate near the optimum."""
+    searcher = TPELiteSearcher(
+        {"x": uniform(-10.0, 10.0)},
+        metric="score",
+        mode="max",
+        n_startup=8,
+        seed=0,
+    )
+    xs = []
+    for i in range(40):
+        cfg = searcher.suggest(f"t{i}")
+        x = cfg["x"]
+        xs.append(x)
+        searcher.on_trial_complete(
+            f"t{i}", {"score": -((x - 3.0) ** 2)}
+        )
+    startup = np.abs(np.array(xs[:8]) - 3.0)
+    tail = np.abs(np.array(xs[-10:]) - 3.0)
+    assert tail.mean() < startup.mean(), (
+        f"TPE no better than random: tail {tail.mean():.2f} vs "
+        f"startup {startup.mean():.2f}"
+    )
+    assert tail.min() < 1.0
+
+
+def test_tpe_handles_mixed_space():
+    searcher = TPELiteSearcher(
+        {
+            "lr": loguniform(1e-5, 1e-1),
+            "layers": choice([1, 2, 3]),
+            "nested": {"width": uniform(8, 64)},
+        },
+        metric="score",
+        mode="min",
+        n_startup=4,
+        seed=1,
+    )
+    # optimum: lr near 1e-3, layers == 2, width near 32
+    for i in range(30):
+        cfg = searcher.suggest(f"t{i}")
+        loss = (
+            (np.log10(cfg["lr"]) + 3) ** 2
+            + (cfg["layers"] - 2) ** 2
+            + ((cfg["nested"]["width"] - 32) / 16) ** 2
+        )
+        searcher.on_trial_complete(f"t{i}", {"score": loss})
+    best = min(searcher._observed, key=lambda ov: ov[1])
+    assert best[1] < 2.0
+
+
+class _Quadratic(Trainable):
+    def setup(self, config):
+        self.x = config["x"]
+
+    def step(self):
+        return {"episode_reward_mean": -((self.x - 3.0) ** 2)}
+
+
+def test_tune_run_with_search_alg():
+    searcher = create_searcher(
+        "tpe", {"x": uniform(-10.0, 10.0)}, n_startup=6, seed=0
+    )
+    ana = tune.run(
+        _Quadratic,
+        config={},
+        num_samples=24,
+        search_alg=searcher,
+        max_iterations=1,
+        parallel=False,
+        verbose=0,
+    )
+    assert len(ana.trials) == 24
+    best = ana.get_best_trial()
+    assert abs(best.config["x"] - 3.0) < 1.5, best.config
+
+
+def test_external_searcher_adapter():
+    """The ask/tell adapter drives trials from any backend object."""
+
+    class FakeBackend:
+        def __init__(self):
+            self.told = []
+            self.n = 0
+
+        def ask(self):
+            self.n += 1
+            if self.n > 3:
+                return None
+            return self.n, {"x": float(self.n)}
+
+        def tell(self, key, value):
+            self.told.append((key, value))
+
+    backend = FakeBackend()
+    s = ExternalSearcher(backend, metric="m")
+    cfgs = [s.suggest(f"t{i}") for i in range(4)]
+    assert cfgs[-1] is None and cfgs[0] == {"x": 1.0}
+    s.on_trial_complete("t0", {"m": 7.0})
+    assert backend.told == [(1, 7.0)]
+
+
+class _NeedsBase(Trainable):
+    def setup(self, config):
+        self.x = config["x"]
+        self.offset = config["offset"]  # from the base config
+
+    def step(self):
+        return {"episode_reward_mean": self.x + self.offset}
+
+
+def test_search_alg_merges_base_config_and_handles_exhaustion():
+    """Constants in tune.run(config=...) reach every suggested trial,
+    and a searcher that exhausts early terminates the run instead of
+    spinning forever."""
+
+    class TwoShot:
+        def __init__(self):
+            self.n = 0
+
+        def ask(self):
+            self.n += 1
+            return (
+                None if self.n > 2 else (self.n, {"x": float(self.n)})
+            )
+
+        def tell(self, key, value):
+            pass
+
+    ana = tune.run(
+        _NeedsBase,
+        config={"offset": 100.0},
+        num_samples=5,  # searcher only yields 2
+        search_alg=ExternalSearcher(TwoShot()),
+        max_iterations=1,
+        parallel=False,
+        verbose=0,
+    )
+    assert len(ana.trials) == 2
+    rewards = sorted(
+        t.last_result["episode_reward_mean"] for t in ana.trials
+    )
+    assert rewards == [101.0, 102.0]
+
+
+def test_create_searcher_optuna_absent():
+    with pytest.raises(ImportError, match="tpe"):
+        create_searcher("optuna", {"x": uniform(0, 1)})
+    with pytest.raises(ValueError):
+        create_searcher("nope", {})
