@@ -1,19 +1,28 @@
 """AlphaStar-style league self-play training.
 
 Counterpart of the reference's ``rllib/algorithms/alpha_star/
-alpha_star.py:2,102`` (league-based asynchronous multi-agent training)
-scoped to the single-main-agent league: a trainable "main" PPO policy
-plays two-player zero-sum MultiAgentEnv games against frozen snapshots
-of itself; PFSP matchmaking (prioritized fictitious self-play) picks
-opponents per episode; when main dominates the league a new snapshot
-joins (``league_builder.py``). The reference's distributed per-policy
-learner shards map to the single-mesh learner here — only "main"
-trains (config policies_to_train), so the league costs inference only.
+alpha_star.py:2,102`` (league-based asynchronous multi-agent training
+with DISTRIBUTED PER-POLICY LEARNERS) and ``league_builder.py``:
+
+- TWO trainable league roles — "main" (PFSP against frozen league
+  snapshots, prioritized fictitious self-play) and "main_exploiter"
+  (trains exclusively against the current main, the reference's
+  exploiter role) — plus frozen snapshots that join the league when
+  main dominates.
+- Per-policy learner sharding, the TPU way: the reference places each
+  trainable policy's learner on its own GPU shard
+  (``alpha_star.py:102`` distributed learner actors); here each
+  trainable policy compiles its SGD nest over its OWN SUBMESH of the
+  device mesh (mesh split across trainables when enough devices
+  exist), so the per-policy updates are independent XLA programs on
+  disjoint devices — dispatched asynchronously from one controller,
+  they run concurrently like the reference's learner shards.
 
 Env contract: exactly two agents per game; agent ids are arbitrary but
-sorted order decides sides — sorted[0] plays "main", sorted[1] plays
-the sampled opponent. Zero-sum outcome is read from per-agent episode
-rewards."""
+sorted order decides sides — sorted[0] plays the first role of the
+current matchup, sorted[1] the second. Matchups alternate between
+(main vs PFSP-sampled snapshot) and (main_exploiter vs main).
+Zero-sum outcome is read from per-agent episode rewards."""
 
 from __future__ import annotations
 
@@ -46,6 +55,9 @@ class AlphaStarConfig(PPOConfig):
         self.max_league_size = 8
         self.pfsp_power = 2.0
         self.num_workers = 0  # league matchmaking is driver-side
+        # the exploiter role (reference league_builder main exploiters);
+        # False = single-main league
+        self.train_exploiter = True
 
     def training(
         self,
@@ -53,6 +65,7 @@ class AlphaStarConfig(PPOConfig):
         win_rate_threshold: Optional[float] = None,
         league_window: Optional[int] = None,
         max_league_size: Optional[int] = None,
+        train_exploiter: Optional[bool] = None,
         **kwargs,
     ) -> "AlphaStarConfig":
         super().training(**kwargs)
@@ -62,7 +75,12 @@ class AlphaStarConfig(PPOConfig):
             self.league_window = league_window
         if max_league_size is not None:
             self.max_league_size = max_league_size
+        if train_exploiter is not None:
+            self.train_exploiter = train_exploiter
         return self
+
+
+EXPLOITER_POLICY_ID = "main_exploiter"
 
 
 class AlphaStar(Algorithm):
@@ -98,20 +116,55 @@ class AlphaStar(Algorithm):
             seed=config.get("seed"),
         )
         first = self.league.next_member_id()
+        self._train_exploiter = bool(
+            config.get("train_exploiter", True)
+        )
+        trainable = [MAIN_POLICY_ID] + (
+            [EXPLOITER_POLICY_ID] if self._train_exploiter else []
+        )
+        # per-policy learner shards: split the mesh across trainable
+        # policies when enough devices exist (the reference's
+        # distributed per-policy learner actors, alpha_star.py:102);
+        # fewer devices than trainables → everyone shares the full mesh
+        import jax
+
+        from ray_tpu.parallel import mesh as mesh_lib
+
+        devices = list(jax.devices())
+        per = len(devices) // len(trainable)
+        submeshes = {}
+        if per >= 1 and len(trainable) > 1 and len(devices) > 1:
+            for i, pid in enumerate(trainable):
+                submeshes[pid] = mesh_lib.make_mesh(
+                    devices=devices[i * per : (i + 1) * per]
+                )
+        self._learner_submeshes = submeshes
         config["policies"] = {
-            MAIN_POLICY_ID: (None, obs_space, act_space, {}),
-            first: (None, obs_space, act_space, {}),
+            pid: (
+                None,
+                obs_space,
+                act_space,
+                (
+                    {"_mesh": submeshes[pid]}
+                    if pid in submeshes
+                    else {}
+                ),
+            )
+            for pid in trainable
         }
-        config["policies_to_train"] = [MAIN_POLICY_ID]
+        config["policies"][first] = (None, obs_space, act_space, {})
+        config["policies_to_train"] = trainable
         self._current_opponent = first
         self._obs_space, self._act_space = obs_space, act_space
         self._mapping_calls = 0
+        self._matchup_idx = 0
         self._side_order = [MAIN_POLICY_ID, first]
 
         # The sampler re-consults the mapping fn for every agent at
         # each episode reset (exactly two agents per game), so every
-        # even-numbered call starts a fresh PFSP matchup: the first
-        # consulted agent plays main, the second the sampled opponent.
+        # even-numbered call starts a fresh matchup: the first
+        # consulted agent plays the matchup's first role, the second
+        # its opponent.
         def mapping_fn(agent_id, **kw):
             if self._mapping_calls % 2 == 0:
                 self._new_matchup()
@@ -124,7 +177,14 @@ class AlphaStar(Algorithm):
         self.league.register_member(first)
 
     def _new_matchup(self) -> None:
-        """Per-episode PFSP matchmaking."""
+        """Per-episode matchmaking: alternate (main vs PFSP snapshot)
+        with (main_exploiter vs main) — the reference's main-exploiter
+        games train the exploiter against the CURRENT main while main
+        keeps learning from the same episodes."""
+        self._matchup_idx += 1
+        if self._train_exploiter and self._matchup_idx % 2 == 0:
+            self._side_order = [EXPLOITER_POLICY_ID, MAIN_POLICY_ID]
+            return
         if self.league.members:
             self._current_opponent = self.league.sample_opponent()
         self._side_order = [MAIN_POLICY_ID, self._current_opponent]
@@ -140,14 +200,18 @@ class AlphaStar(Algorithm):
             if hasattr(train_batch, "agent_steps")
             else train_batch.env_steps()
         )
-        # standardize main's advantages (PPO semantics)
+        # standardize every trainable policy's advantages (PPO
+        # semantics, per learner shard)
         pb = getattr(train_batch, "policy_batches", {})
-        if MAIN_POLICY_ID in pb:
-            b = pb[MAIN_POLICY_ID]
-            adv = np.asarray(b[SampleBatch.ADVANTAGES], np.float32)
-            b[SampleBatch.ADVANTAGES] = (
-                (adv - adv.mean()) / max(1e-4, adv.std())
-            ).astype(np.float32)
+        for pid in self.config.get("policies_to_train") or []:
+            if pid in pb:
+                b = pb[pid]
+                adv = np.asarray(
+                    b[SampleBatch.ADVANTAGES], np.float32
+                )
+                b[SampleBatch.ADVANTAGES] = (
+                    (adv - adv.mean()) / max(1e-4, adv.std())
+                ).astype(np.float32)
         info = train_one_step(self, train_batch)
 
         # league bookkeeping from finished episodes' per-agent rewards
@@ -162,6 +226,10 @@ class AlphaStar(Algorithm):
                 opp = next(
                     p for p in by_pid if p != MAIN_POLICY_ID
                 )
+                # PFSP stats track league snapshots only; exploiter
+                # games don't count toward snapshot win rates
+                if opp not in self.league.members:
+                    continue
                 diff = by_pid[MAIN_POLICY_ID] - by_pid[opp]
                 outcome = (
                     1.0 if diff > 0 else (0.0 if diff < 0 else 0.5)

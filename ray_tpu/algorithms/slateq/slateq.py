@@ -8,13 +8,17 @@ greedy slate maximizes that over ALL candidate slates, and the TD
 target bootstraps the max next-slate value (``build_slateq_losses``,
 ``get_per_slate_q_values``, ``score_documents``).
 
-Scope vs the reference: the choice model is the fixed proportional
-dot-product scorer (the reference additionally learns a choice model
-with lr_choice_model); slates are ordered S-permutations enumerated at
-init (same as the reference's precomputed ``policy.slates``). The whole
-TD step — per-item Q net, slate enumeration via gather, choice-weighted
-decomposition, target max — is ONE jitted program; slate enumeration is
-a static (A, S) index table so XLA sees fixed shapes.
+The user-choice model is LEARNED, like the reference's UserChoiceModel:
+a multinomial-logit with learnable affinity scale (beta) and no-click
+score, fit by cross-entropy on the observed click/no-click events with
+its own learning rate (``lr_choice_model``), and its probabilities
+drive both the slate decomposition and the TD targets (stop-gradient:
+the TD loss never reshapes the choice model). Slates are ordered
+S-permutations enumerated at init (same as the reference's precomputed
+``policy.slates``). The whole step — choice NLL, per-item Q net, slate
+enumeration via gather, choice-weighted decomposition, target max —
+is ONE jitted program; slate enumeration is a static (A, S) index
+table so XLA sees fixed shapes.
 
 Because the stock samplers stack flat observation arrays, observations
 are the FLAT RecSim layout ``[user(E) | docs(C*E) | response(2S)]``
@@ -175,11 +179,41 @@ class SlateQConfig(DQNConfig):
 
 
 def _score_documents(user, docs, no_click_score=1.0, min_normalizer=-1.0):
-    """reference score_documents: proportional choice scores."""
+    """reference score_documents: proportional choice scores (the
+    FIXED scorer; kept for choice_model="proportional")."""
     scores = jnp.sum(user[:, None, :] * docs, axis=-1)  # (B, C)
     scores = scores - min_normalizer
     no_click = jnp.full((user.shape[0],), no_click_score - min_normalizer)
     return scores, no_click
+
+
+class _ChoiceModel(nn.Module):
+    """LEARNED multinomial-logit user-choice model (reference
+    slateq_torch_policy.py UserChoiceModel: learnable ``beta`` scaling
+    the user·doc affinity and a learnable no-click score, fit by
+    cross-entropy on observed clicks with its own learning rate,
+    ``lr_choice_model``)."""
+
+    @nn.compact
+    def __call__(self, user, docs):
+        beta = self.param(
+            "beta", lambda k: jnp.asarray(1.0, jnp.float32)
+        )
+        score_no_click = self.param(
+            "score_no_click", lambda k: jnp.asarray(0.0, jnp.float32)
+        )
+        dots = jnp.sum(user[:, None, :] * docs, axis=-1)  # (B, C)
+        scores = jnp.clip(beta * dots, -15.0, 15.0)
+        no_click = jnp.broadcast_to(
+            jnp.clip(score_no_click, -15.0, 15.0), (user.shape[0],)
+        )
+        return scores, no_click
+
+
+def _choice_masses(scores, no_click):
+    """Multinomial-logit masses: exp(score) per doc, exp(no_click)
+    abstention mass — the v_i the slate decomposition normalizes."""
+    return jnp.exp(scores), jnp.exp(no_click)
 
 
 class SlateQJaxPolicy(JaxPolicy):
@@ -213,18 +247,38 @@ class SlateQJaxPolicy(JaxPolicy):
         self._data_sharding = mesh_lib.data_sharding(self.mesh)
 
         self.qnet = _ItemQNet(tuple(config.get("hiddens", (64, 64))))
+        self.choice_model = _ChoiceModel()
         seed = int(config.get("seed") or 0)
         self._rng = jax.random.PRNGKey(seed)
-        self._rng, r1 = jax.random.split(self._rng)
+        self._rng, r1, r2 = jax.random.split(self._rng, 3)
         dummy_u = jnp.zeros((2, self.E), jnp.float32)
         dummy_d = jnp.zeros((2, self.C, self.E), jnp.float32)
         self.params = _tree_to_device(
-            self.qnet.init(r1, dummy_u, dummy_d), self._param_sharding
+            {
+                "q": self.qnet.init(r1, dummy_u, dummy_d),
+                "choice": self.choice_model.init(
+                    r2, dummy_u, dummy_d
+                ),
+            },
+            self._param_sharding,
         )
         self.aux_state = _tree_to_device(
-            {"target_params": self.params}, self._param_sharding
+            {"target_params": self.params["q"]}, self._param_sharding
         )
-        self._tx = optax.adam(float(config.get("lr", 1e-3)))
+        # separate learning rates: TD net vs the choice model's NLL
+        # (reference lr_choice_model vs lr_q_model)
+        self._tx = optax.multi_transform(
+            {
+                "q": optax.adam(float(config.get("lr", 1e-3))),
+                "choice": optax.adam(
+                    float(config.get("lr_choice_model", 1e-2))
+                ),
+            },
+            lambda params: {
+                k: jax.tree_util.tree_map(lambda _: k, sub)
+                for k, sub in params.items()
+            },
+        )
         self.opt_state = _tree_to_device(
             self._tx.init(self.params), self._param_sharding
         )
@@ -276,8 +330,10 @@ class SlateQJaxPolicy(JaxPolicy):
     def _build_action_fn(self):
         def fn(params, obs, rng, explore, epsilon):
             user, docs, _ = self._split_obs(obs)
-            q = self.qnet.apply(params, user, docs)
-            scores, no_click = _score_documents(user, docs)
+            q = self.qnet.apply(params["q"], user, docs)
+            scores, no_click = _choice_masses(
+                *self.choice_model.apply(params["choice"], user, docs)
+            )
             slate_vals = self._slate_values(q, scores, no_click)
             greedy = jnp.argmax(slate_vals, axis=-1)  # (B,)
             if explore:
@@ -340,13 +396,19 @@ class SlateQJaxPolicy(JaxPolicy):
             # reference evaluates its target model on current obs with
             # a "TODO: find out whether obs or next_obs is correct"
             # (slateq_torch_policy.py:137); with per-step candidate
-            # resampling only the next-obs pairing is coherent.
+            # resampling only the next-obs pairing is coherent. Choice
+            # probabilities come from the CURRENT learned choice model
+            # (stop-gradient: the TD loss must not reshape it).
             tq = self.qnet.apply(
                 aux["target_params"], next_user, next_docs
             )
-            n_scores, n_no_click = _score_documents(
-                next_user, next_docs
+            n_scores, n_no_click = _choice_masses(
+                *self.choice_model.apply(
+                    params["choice"], next_user, next_docs
+                )
             )
+            n_scores = jax.lax.stop_gradient(n_scores)
+            n_no_click = jax.lax.stop_gradient(n_no_click)
             target_slate_vals = self._slate_values(
                 tq, n_scores, n_no_click
             )
@@ -360,7 +422,7 @@ class SlateQJaxPolicy(JaxPolicy):
             )  # PER importance correction
 
             def loss_fn(p):
-                q = self.qnet.apply(p, user, docs)  # (B, C)
+                q = self.qnet.apply(p["q"], user, docs)  # (B, C)
                 slate_q = jnp.take_along_axis(
                     q, actions, axis=1
                 )  # (B, S)
@@ -374,19 +436,48 @@ class SlateQJaxPolicy(JaxPolicy):
                     jax.lax.psum(clicked.sum(), "data"), 1.0
                 )
                 shards = jax.lax.psum(1.0, "data")
+                td_loss = (
+                    shards * jnp.sum(is_weights * jnp.square(td)) / n
+                )
+                # choice-model NLL on the OBSERVED event: which of the
+                # S shown docs was clicked, or no-click (class S) —
+                # reference slateq_torch_policy.py choice_loss with
+                # lr_choice_model
+                c_scores, c_no_click = self.choice_model.apply(
+                    p["choice"], user, docs
+                )
+                shown = jnp.take_along_axis(
+                    c_scores, actions, axis=1
+                )  # (B, S)
+                logits = jnp.concatenate(
+                    [shown, c_no_click[:, None]], axis=1
+                )  # (B, S+1)
+                label = jnp.where(
+                    clicked > 0,
+                    jnp.argmax(click, axis=1),
+                    jnp.full_like(actions[:, 0], self.S),
+                )
+                logp = jax.nn.log_softmax(logits, axis=-1)
+                nll = -jnp.take_along_axis(
+                    logp, label[:, None], axis=1
+                ).squeeze(1)
+                choice_loss = jnp.mean(nll)
                 return (
-                    shards * jnp.sum(is_weights * jnp.square(td)) / n,
-                    (clicked_q, td, n),
+                    td_loss + choice_loss,
+                    (clicked_q, td, n, choice_loss),
                 )
 
-            (loss, (clicked_q, td, n)), grads = jax.value_and_grad(
-                loss_fn, has_aux=True
-            )(params)
+            (
+                (loss, (clicked_q, td, n, choice_loss)),
+                grads,
+            ) = jax.value_and_grad(loss_fn, has_aux=True)(params)
             grads = jax.lax.pmean(grads, "data")
             updates, opt_state = tx.update(grads, opt_state, params)
             params = optax.apply_updates(params, updates)
             stats = {
                 "total_loss": loss,
+                "choice_loss": choice_loss,
+                "choice_beta": params["choice"]["params"]["beta"],
                 "mean_q_clicked": jnp.sum(clicked_q) / n,
                 "mean_td_error": jnp.sum(td) / n,
                 "click_fraction": jnp.mean(click.sum(axis=1)),
@@ -414,7 +505,9 @@ class SlateQJaxPolicy(JaxPolicy):
         )
 
     def update_target(self) -> None:
-        self.aux_state = {"target_params": self.params}
+        # the choice model has no target copy: TD targets always use
+        # the freshest learned choice probabilities
+        self.aux_state = {"target_params": self.params["q"]}
 
     def _batch_to_train_tree(self, samples: SampleBatch):
         keys = [
@@ -450,15 +543,17 @@ class SlateQJaxPolicy(JaxPolicy):
                 tq = self.qnet.apply(
                     aux["target_params"], next_user, next_docs
                 )
-                n_scores, n_no_click = _score_documents(
-                    next_user, next_docs
+                n_scores, n_no_click = _choice_masses(
+                    *self.choice_model.apply(
+                        params["choice"], next_user, next_docs
+                    )
                 )
                 next_max = jnp.max(
                     self._slate_values(tq, n_scores, n_no_click),
                     axis=-1,
                 )
                 y = reward + self.gamma * (1.0 - done) * next_max
-                q = self.qnet.apply(params, user, docs)
+                q = self.qnet.apply(params["q"], user, docs)
                 clicked_q = jnp.sum(
                     jnp.take_along_axis(q, actions, axis=1) * click,
                     axis=1,

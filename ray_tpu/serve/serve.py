@@ -99,7 +99,10 @@ class DeploymentHandle:
         self._rr = 0
         self._lock = threading.Lock()
         self._inflight = 0
-        self._version = 0
+        # start at the key's CURRENT version: a redeploy must not
+        # adopt the previous generation's (killed) membership still
+        # sitting on the shared long-poll key
+        self._version = _LONG_POLL.current(f"replicas:{name}")[0]
         self._stop = threading.Event()
         self._listener = threading.Thread(
             target=self._listen_loop, daemon=True,
@@ -183,6 +186,9 @@ class RunningDeployment:
         self._stop = threading.Event()
         self._last_scale = time.monotonic()
         self._scaler = None
+        # publish the initial membership so handles listening from an
+        # older generation's version converge onto THIS generation
+        self._publish()
         if spec.autoscaling_config:
             cfg = {**DEFAULT_AUTOSCALING, **spec.autoscaling_config}
             # scale-to-zero is out of scope (an empty group would
@@ -212,9 +218,14 @@ class RunningDeployment:
         _LONG_POLL.notify(f"replicas:{self.name}", members)
 
     def _retire(self, victim) -> None:
-        """Drain-then-kill: membership was already republished (no new
-        traffic routes here), and the actor's ordered call queue means
-        a completed stats() proves every earlier request finished."""
+        """Drain-then-kill. Membership was already republished; the
+        grace sleep lets handle listener threads adopt it (the
+        long-poll push is asynchronous), then the actor's ordered call
+        queue means a completed stats() proves every earlier request
+        finished. A handle that somehow routes to the victim after the
+        drain probe still fails fast (killed actors put_error their
+        pending refs) rather than hanging."""
+        time.sleep(0.25)
         try:
             ray.get(victim.stats.remote(), timeout=30.0)
         except Exception:

@@ -226,11 +226,18 @@ class ExternalSearcher(Searcher):
 
     def on_trial_complete(self, trial_id, result=None, error=False):
         key = self._keys.pop(trial_id, None)
-        if key is None or error or not result:
+        if key is None:
             return
-        value = result.get(self.metric)
-        if value is not None:
-            self._backend.tell(key, float(value))
+        value = (result or {}).get(self.metric)
+        if error or value is None:
+            # the backend must learn the trial FAILED, or ask/tell
+            # libraries (optuna) leave it RUNNING forever and their
+            # samplers never leave the startup phase
+            fail = getattr(self._backend, "tell_failure", None)
+            if fail is not None:
+                fail(key)
+            return
+        self._backend.tell(key, float(value))
 
 
 def create_searcher(

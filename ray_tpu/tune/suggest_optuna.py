@@ -54,3 +54,9 @@ class OptunaBackend:
     def tell(self, key: int, value: float) -> None:
         self._study.tell(key, value)
         self._trials.pop(key, None)
+
+    def tell_failure(self, key: int) -> None:
+        self._study.tell(
+            key, state=optuna.trial.TrialState.FAIL
+        )
+        self._trials.pop(key, None)
