@@ -1,0 +1,237 @@
+"""Node providers + node-level autoscaler.
+
+Counterpart of the reference's node-scaling stack —
+``autoscaler/_private/autoscaler.py:145`` (StandardAutoscaler),
+``resource_demand_scheduler.py:46`` (demand → node count),
+``node_provider.py`` (cloud provider abstraction) and
+``fake_multi_node/node_provider.py:237`` (FakeMultiNodeProvider, the
+test double) — sized to this framework's cluster model: a "node" is a
+worker-agent process that joins the head's fleet
+(``core/cluster.py``), so the LOCAL provider launches real agent
+subprocesses on this machine (the fake-multi-node testing strategy,
+but with genuine agents), and a cloud provider would launch VMs that
+run ``python -m ray_tpu.core.node_agent --address head:port``.
+
+Demand enters through :meth:`NodeAutoscaler.request_resources` (the
+``autoscaler.sdk.request_resources`` role): the reconcile loop sizes
+the fleet to ``ceil(requested_cpus / cpus_per_node)`` clamped to
+[min_nodes, max_nodes], terminates nodes idle (no placed actors)
+longer than ``idle_timeout_s``, and replaces nodes that died.
+"""
+
+from __future__ import annotations
+
+import subprocess
+import sys
+import threading
+import time
+import uuid
+from typing import Dict, List, Optional
+
+
+class NodeProvider:
+    """reference autoscaler/node_provider.py NodeProvider ABC."""
+
+    def create_node(self, node_config: Dict) -> str:
+        raise NotImplementedError
+
+    def terminate_node(self, node_id: str) -> None:
+        raise NotImplementedError
+
+    def non_terminated_nodes(self) -> List[str]:
+        raise NotImplementedError
+
+    def is_running(self, node_id: str) -> bool:
+        return node_id in self.non_terminated_nodes()
+
+
+class FakeMultiNodeProvider(NodeProvider):
+    """In-memory provider for autoscaler-logic tests (reference
+    fake_multi_node/node_provider.py:237). Also supports killing a
+    node out from under the autoscaler (chaos testing)."""
+
+    def __init__(self):
+        self.nodes: Dict[str, Dict] = {}
+        self.created = 0
+        self.terminated = 0
+
+    def create_node(self, node_config: Dict) -> str:
+        node_id = f"fake_{self.created}"
+        self.created += 1
+        self.nodes[node_id] = dict(node_config)
+        return node_id
+
+    def terminate_node(self, node_id: str) -> None:
+        if self.nodes.pop(node_id, None) is not None:
+            self.terminated += 1
+
+    def non_terminated_nodes(self) -> List[str]:
+        return list(self.nodes)
+
+    def kill_node(self, node_id: str) -> None:
+        """Simulate a crash (no terminate bookkeeping)."""
+        self.nodes.pop(node_id, None)
+
+
+class LocalSubprocessProvider(NodeProvider):
+    """Real provider for one machine: each node is a worker-agent
+    SUBPROCESS that joins the head's cluster server, so scaled-up
+    nodes genuinely host actors (``core/cluster.py`` NodeAgent)."""
+
+    def __init__(self, head_address: str, num_cpus: int = 2):
+        self.head_address = head_address
+        self.num_cpus = num_cpus
+        self.procs: Dict[str, subprocess.Popen] = {}
+
+    def create_node(self, node_config: Dict) -> str:
+        import os
+
+        node_id = f"asnode_{uuid.uuid4().hex[:6]}"
+        repo = os.path.dirname(
+            os.path.dirname(os.path.dirname(__file__))
+        )
+        env = {
+            **os.environ,
+            "JAX_PLATFORMS": "cpu",
+            "PYTHONPATH": (
+                f"{repo}:{os.environ.get('PYTHONPATH', '')}"
+            ),
+        }
+        proc = subprocess.Popen(
+            [
+                sys.executable,
+                "-m",
+                "ray_tpu.core.node_agent",
+                "--address",
+                self.head_address,
+                "--node-id",
+                node_id,
+                "--num-cpus",
+                str(node_config.get("num_cpus", self.num_cpus)),
+            ],
+            cwd=repo,
+            env=env,
+            stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL,
+        )
+        self.procs[node_id] = proc
+        return node_id
+
+    def terminate_node(self, node_id: str) -> None:
+        proc = self.procs.pop(node_id, None)
+        if proc is not None:
+            proc.terminate()
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+
+    def non_terminated_nodes(self) -> List[str]:
+        return [
+            nid
+            for nid, p in self.procs.items()
+            if p.poll() is None
+        ]
+
+
+class NodeAutoscaler:
+    """reference StandardAutoscaler (autoscaler.py:145), node-level."""
+
+    def __init__(
+        self,
+        provider: NodeProvider,
+        *,
+        min_nodes: int = 0,
+        max_nodes: int = 4,
+        cpus_per_node: int = 2,
+        idle_timeout_s: float = 30.0,
+        update_interval_s: float = 1.0,
+        node_config: Optional[Dict] = None,
+        cluster=None,
+    ):
+        self.provider = provider
+        self.min_nodes = int(min_nodes)
+        self.max_nodes = int(max_nodes)
+        self.cpus_per_node = int(cpus_per_node)
+        self.idle_timeout_s = float(idle_timeout_s)
+        self.update_interval_s = float(update_interval_s)
+        self.node_config = dict(node_config or {})
+        self.cluster = cluster  # head ClusterServer (actor counts)
+        self._requested_cpus = 0
+        self._idle_since: Dict[str, float] = {}
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self.num_upscales = 0
+        self.num_downscales = 0
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="node_autoscaler"
+        )
+        self._thread.start()
+
+    def request_resources(self, num_cpus: int) -> None:
+        """Declare steady-state demand (the autoscaler.sdk
+        request_resources role); the loop converges the fleet to it."""
+        with self._lock:
+            self._requested_cpus = int(num_cpus)
+
+    def _node_busy(self, node_id: str) -> bool:
+        if self.cluster is None:
+            return False
+        node = self.cluster.nodes.get(node_id)
+        return bool(node and node.actor_ids)
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.update_interval_s):
+            try:
+                self.update()
+            except Exception:
+                pass
+
+    def update(self) -> None:
+        """One reconcile pass (reference autoscaler.py update())."""
+        with self._lock:
+            requested = self._requested_cpus
+        demand_nodes = -(-requested // self.cpus_per_node)
+        target = max(self.min_nodes, min(self.max_nodes, demand_nodes))
+        nodes = self.provider.non_terminated_nodes()
+
+        # upscale toward target
+        while len(nodes) < target:
+            self.provider.create_node(
+                dict(self.node_config, num_cpus=self.cpus_per_node)
+            )
+            self.num_upscales += 1
+            nodes = self.provider.non_terminated_nodes()
+
+        # downscale: reap idle nodes above target (never busy ones)
+        now = time.monotonic()
+        for nid in nodes:
+            if self._node_busy(nid):
+                self._idle_since.pop(nid, None)
+                continue
+            t0 = self._idle_since.setdefault(nid, now)
+            if (
+                len(self.provider.non_terminated_nodes()) > target
+                and now - t0 >= self.idle_timeout_s
+            ):
+                self.provider.terminate_node(nid)
+                self._idle_since.pop(nid, None)
+                self.num_downscales += 1
+
+        # garbage-collect idle bookkeeping for dead nodes
+        live = set(self.provider.non_terminated_nodes())
+        for nid in list(self._idle_since):
+            if nid not in live:
+                self._idle_since.pop(nid, None)
+
+    def stats(self) -> Dict:
+        return {
+            "num_nodes": len(self.provider.non_terminated_nodes()),
+            "requested_cpus": self._requested_cpus,
+            "num_upscales": self.num_upscales,
+            "num_downscales": self.num_downscales,
+        }
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=5.0)

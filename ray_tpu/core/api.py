@@ -114,6 +114,7 @@ class _Runtime:
         self.timeline_events: List[Dict] = []
         self.shutting_down = False
         self._worker_env = {}
+        self._job_runtime_env = None
         # Cross-host fleet (core/cluster.py): the head's listener and
         # the map of actors placed on remote agents
         self.cluster = None
@@ -188,6 +189,15 @@ class _Runtime:
         proc.start()
         child_conn.close()
         w = _WorkerHandle(proc, parent_conn, worker_id, dedicated)
+        if self._job_runtime_env:
+            # job-level runtime_env (ray.init) reaches every worker
+            # before any task does (pipe ordering)
+            w.conn.send(
+                {
+                    "type": "runtime_env",
+                    "packed": self._job_runtime_env,
+                }
+            )
         t = threading.Thread(
             target=self._recv_loop, args=(w,), daemon=True,
             name=f"recv_{worker_id}",
@@ -216,6 +226,10 @@ class _Runtime:
             except Exception:
                 w.ring = None
             return
+        if msg.get("spans"):
+            from ray_tpu.util import tracing
+
+            tracing.record_spans(msg["spans"])
         task_id = msg.get("task_id")
         with self.lock:
             rec = w.inflight.pop(task_id, None)
@@ -458,6 +472,9 @@ class _Runtime:
             bundle_index = getattr(
                 strategy, "placement_group_bundle_index", -1
             )
+        from ray_tpu.core.runtime_env import pack_runtime_env
+        from ray_tpu.util import tracing
+
         trec = _TaskRecord(
             task_id,
             {
@@ -465,6 +482,10 @@ class _Runtime:
                 "task_id": task_id,
                 "func_id": func_id,
                 "func_blob": func_blob,
+                "runtime_env": pack_runtime_env(
+                    options.get("runtime_env")
+                ),
+                "trace_ctx": tracing.inject_context(),
                 "args": args,
                 "kwargs": kwargs,
             },
@@ -541,6 +562,16 @@ class _Runtime:
         }
 
     def create_actor(self, cls, args, kwargs, options) -> "ActorHandle":
+        from ray_tpu.core.runtime_env import pack_runtime_env
+
+        # pack path-based runtime_env pieces HERE (driver-side), so
+        # the spec ships host-independently — including to remote node
+        # agents (reference runtime_env URI upload at submission time)
+        renv_packed = options.get("runtime_env_packed")
+        if renv_packed is None:
+            renv_packed = pack_runtime_env(
+                options.get("runtime_env")
+            )
         node_name = options.get("placement_node")
         if node_name is not None and self.cluster is not None:
             try:
@@ -563,6 +594,10 @@ class _Runtime:
             if node is not None:
                 actor_id = uuid.uuid4().hex
                 name = options.get("name")
+                if renv_packed is not None:
+                    options = dict(
+                        options, runtime_env_packed=renv_packed
+                    )
                 r_args, r_kwargs = self._resolve_for_remote(args, kwargs)
                 with self.lock:
                     if name:
@@ -590,6 +625,7 @@ class _Runtime:
             "actor_id": actor_id,
             "task_id": None,
             "cls": cls_blob,
+            "runtime_env": renv_packed,
             "payload": ser.dumps(
                 (
                     [self._marshal_arg(a) for a in args],
@@ -639,6 +675,8 @@ class _Runtime:
                 ref.id, RayActorError(f"Actor {actor_id} is dead")
             )
             return [ref]
+        from ray_tpu.util import tracing
+
         task_id = uuid.uuid4().hex
         trec = _TaskRecord(
             task_id,
@@ -647,6 +685,7 @@ class _Runtime:
                 "task_id": task_id,
                 "actor_id": actor_id,
                 "method": method,
+                "trace_ctx": tracing.inject_context(),
                 "payload": ser.dumps(
                     (
                         [self._marshal_arg(a) for a in args],
@@ -748,6 +787,7 @@ def init(
     worker_env: Optional[Dict[str, str]] = None,
     log_dir: Optional[str] = None,
     address: Optional[str] = None,
+    runtime_env: Optional[Dict] = None,
     **kwargs,
 ) -> Dict:
     """Start the local runtime (reference ray.init,
@@ -772,6 +812,10 @@ def init(
         _runtime._worker_env.update(worker_env)
     if log_dir:
         _runtime._worker_env.setdefault("RAY_TPU_LOG_DIR", log_dir)
+    if runtime_env:
+        from ray_tpu.core.runtime_env import pack_runtime_env
+
+        _runtime._job_runtime_env = pack_runtime_env(runtime_env)
     state_path = kwargs.get("state_path")
     if state_path and _runtime.state_store is None:
         _runtime._open_state_store(state_path)

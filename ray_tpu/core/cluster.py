@@ -167,7 +167,13 @@ class RemoteNode:
                 "options": {
                     k: v
                     for k, v in options.items()
-                    if k in ("max_restarts", "daemon", "num_cpus")
+                    if k
+                    in (
+                        "max_restarts",
+                        "daemon",
+                        "num_cpus",
+                        "runtime_env_packed",  # pre-packed, host-free
+                    )
                 },
             },
         )

@@ -1,0 +1,15 @@
+"""CLI entry point: join a head's actor fleet as a worker agent.
+
+    python -m ray_tpu.core.node_agent --address HEAD:PORT \
+        [--node-id NAME] [--num-cpus N]
+
+Thin wrapper over ``ray_tpu.core.cluster`` (NodeAgent); see that
+module for the protocol. The raylet-process analog
+(``src/ray/raylet/main.cc``): one per host, hosting actors the head
+places here.
+"""
+
+from ray_tpu.core.cluster import main
+
+if __name__ == "__main__":
+    main()

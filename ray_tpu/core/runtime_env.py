@@ -1,0 +1,179 @@
+"""Per-task/actor runtime environments (env_vars, working_dir,
+py_modules).
+
+Counterpart of the reference's ``python/ray/_private/runtime_env/``
+plugins (``working_dir.py``, ``py_modules.py``, env-var injection) with
+its URI-cache behavior: directories are zipped once driver-side,
+content-addressed by hash, shipped with the task/actor spec, and
+extracted exactly once per worker host into a shared cache directory —
+repeat uses hit the cache (the reference's
+``_private/runtime_env/uri_cache.py`` role).
+
+Scope vs the reference: conda/pip/container provisioning is out — this
+image is sealed (no package installs), and the TPU-first posture is
+one prebuilt environment per host. The seam is the same dict schema,
+so a provisioning plugin can slot in where ``_PACKERS`` dispatches.
+
+Supported keys::
+
+    {"env_vars": {"K": "V"},
+     "working_dir": "/path/to/dir",   # zipped, extracted, chdir'd
+     "py_modules": ["/path/to/pkg"]}  # zipped, extracted, sys.path
+
+Workers apply env_vars around each task/actor-init (actor processes
+are dedicated, so their env simply persists); extracted paths persist
+for the worker's lifetime.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import io
+import os
+import sys
+import tempfile
+import zipfile
+from typing import Any, Dict, List, Optional, Tuple
+
+# one entry PER PATH (latest content only): iterative edits of a big
+# working_dir must not accumulate stale archive copies in the driver
+_ZIP_CACHE: Dict[str, Tuple[Tuple[float, int], str, bytes]] = {}
+
+_MAX_ARCHIVE_BYTES = 256 * 1024 * 1024
+
+
+def _zip_dir(path: str) -> Tuple[str, bytes]:
+    """(content_hash, zip_bytes) for a directory; cached by
+    (realpath, latest_mtime) so repeat submissions don't re-zip."""
+    path = os.path.realpath(path)
+    latest = os.path.getmtime(path)
+    total = 0
+    for root, _, files in os.walk(path):
+        for f in files:
+            try:
+                st = os.stat(os.path.join(root, f))
+                latest = max(latest, st.st_mtime)
+                total += st.st_size
+            except OSError:
+                pass
+    # size rides the key because filesystem mtime granularity can
+    # swallow rapid successive edits
+    stamp = (latest, total)
+    hit = _ZIP_CACHE.get(path)
+    if hit is not None and hit[0] == stamp:
+        return hit[1], hit[2]
+    buf = io.BytesIO()
+    with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as zf:
+        for root, _, files in os.walk(path):
+            for f in sorted(files):
+                full = os.path.join(root, f)
+                rel = os.path.relpath(full, path)
+                zf.write(full, rel)
+    data = buf.getvalue()
+    if len(data) > _MAX_ARCHIVE_BYTES:
+        raise ValueError(
+            f"runtime_env archive for {path!r} is "
+            f"{len(data) / 1e6:.0f} MB (cap "
+            f"{_MAX_ARCHIVE_BYTES / 1e6:.0f} MB) — exclude data files"
+        )
+    digest = hashlib.sha256(data).hexdigest()[:16]
+    _ZIP_CACHE[path] = (stamp, digest, data)
+    return digest, data
+
+
+def pack_runtime_env(spec: Optional[Dict]) -> Optional[Dict]:
+    """Driver-side: resolve paths into content-addressed archives so
+    the packed env is host-independent (ships over the cluster wire
+    to remote node agents unchanged)."""
+    if not spec:
+        return None
+    unknown = set(spec) - {"env_vars", "working_dir", "py_modules"}
+    if unknown:
+        raise ValueError(
+            f"unsupported runtime_env keys {sorted(unknown)}; "
+            "supported: env_vars, working_dir, py_modules "
+            "(conda/pip/container are out of scope — see "
+            "core/runtime_env.py)"
+        )
+    packed: Dict[str, Any] = {}
+    env_vars = spec.get("env_vars")
+    if env_vars:
+        packed["env_vars"] = {
+            str(k): str(v) for k, v in env_vars.items()
+        }
+    archives: List[Dict] = []
+    wd = spec.get("working_dir")
+    if wd:
+        digest, data = _zip_dir(wd)
+        archives.append(
+            {"kind": "working_dir", "hash": digest, "data": data}
+        )
+    for mod in spec.get("py_modules") or []:
+        digest, data = _zip_dir(mod)
+        archives.append(
+            {
+                "kind": "py_module",
+                "hash": digest,
+                "name": os.path.basename(os.path.realpath(mod)),
+                "data": data,
+            }
+        )
+    if archives:
+        packed["archives"] = archives
+    return packed or None
+
+
+def _cache_root() -> str:
+    return os.path.join(
+        tempfile.gettempdir(), "ray_tpu_runtime_env"
+    )
+
+
+def _extract(archive: Dict) -> str:
+    """Idempotent per-host extraction (the URI cache): returns the
+    extracted directory for this content hash."""
+    dest = os.path.join(_cache_root(), archive["hash"])
+    marker = os.path.join(dest, ".complete")
+    if not os.path.exists(marker):
+        tmp = dest + f".tmp{os.getpid()}"
+        os.makedirs(tmp, exist_ok=True)
+        with zipfile.ZipFile(io.BytesIO(archive["data"])) as zf:
+            zf.extractall(tmp)
+        open(os.path.join(tmp, ".complete"), "w").close()
+        try:
+            os.replace(tmp, dest)  # atomic: concurrent workers race safely
+        except OSError:
+            import shutil
+
+            shutil.rmtree(tmp, ignore_errors=True)
+    return dest
+
+
+def apply_runtime_env(packed: Optional[Dict]) -> None:
+    """Worker-side: set env vars, extract + activate archives.
+    working_dir chdirs and heads sys.path (reference working_dir
+    semantics: relative paths and local imports resolve there);
+    py_modules become importable by their top-level name."""
+    if not packed:
+        return
+    for k, v in (packed.get("env_vars") or {}).items():
+        os.environ[k] = v
+    for archive in packed.get("archives") or []:
+        dest = _extract(archive)
+        if archive["kind"] == "working_dir":
+            os.chdir(dest)
+            if dest not in sys.path:
+                sys.path.insert(0, dest)
+        else:  # py_module: importable as its original top-level name
+            parent = os.path.join(
+                _cache_root(), f"mods_{archive['hash']}"
+            )
+            link = os.path.join(parent, archive["name"])
+            os.makedirs(parent, exist_ok=True)
+            if not os.path.exists(link):
+                try:
+                    os.symlink(dest, link)
+                except OSError:
+                    pass
+            if parent not in sys.path:
+                sys.path.insert(0, parent)

@@ -145,13 +145,67 @@ def worker_main(conn, worker_id: str, env_overrides: Dict[str, str]):
             if mtype == "register_func":
                 func_cache[msg["func_id"]] = ser.loads(msg["func"])
                 continue
+            elif mtype == "runtime_env":
+                # job-level env from ray.init(runtime_env=...)
+                from ray_tpu.core.runtime_env import apply_runtime_env
+
+                apply_runtime_env(msg.get("packed"))
+                continue
             elif mtype == "task":
+                from ray_tpu.util import tracing
+
                 fn = func_cache[msg["func_id"]]
                 args, kwargs = _resolve_args(
                     *ser.loads(msg["payload"]), shm_cache
                 )
-                value = fn(*args, **kwargs)
+                _span = tracing.remote_span(
+                    msg.get("trace_ctx"),
+                    f"task:{getattr(fn, '__name__', 'fn')}",
+                )
+                renv = msg.get("runtime_env")
+                if renv:
+                    # pooled workers: the WHOLE env (vars, cwd,
+                    # sys.path) applies only around the call, so a
+                    # later unrelated task on this worker doesn't
+                    # inherit another task's working_dir or modules.
+                    # Extracted archives persist via the cache. Actors
+                    # get dedicated processes, so theirs persist
+                    # wholesale.
+                    from ray_tpu.core.runtime_env import (
+                        apply_runtime_env,
+                    )
+
+                    saved = {
+                        k: os.environ.get(k)
+                        for k in (renv.get("env_vars") or {})
+                    }
+                    saved_cwd = os.getcwd()
+                    saved_path = list(sys.path)
+                    apply_runtime_env(renv)
+                    try:
+                        with _span:
+                            value = fn(*args, **kwargs)
+                    finally:
+                        for k, old in saved.items():
+                            if old is None:
+                                os.environ.pop(k, None)
+                            else:
+                                os.environ[k] = old
+                        try:
+                            os.chdir(saved_cwd)
+                        except OSError:
+                            pass
+                        sys.path[:] = saved_path
+                else:
+                    with _span:
+                        value = fn(*args, **kwargs)
             elif mtype == "actor_init":
+                if msg.get("runtime_env"):
+                    from ray_tpu.core.runtime_env import (
+                        apply_runtime_env,
+                    )
+
+                    apply_runtime_env(msg["runtime_env"])
                 cls = ser.loads(msg["cls"])
                 args, kwargs = _resolve_args(
                     *ser.loads(msg["payload"]), shm_cache
@@ -159,11 +213,19 @@ def worker_main(conn, worker_id: str, env_overrides: Dict[str, str]):
                 actors[msg["actor_id"]] = cls(*args, **kwargs)
                 value = None
             elif mtype == "actor_call":
+                from ray_tpu.util import tracing
+
                 actor = actors[msg["actor_id"]]
                 args, kwargs = _resolve_args(
                     *ser.loads(msg["payload"]), shm_cache
                 )
-                value = getattr(actor, msg["method"])(*args, **kwargs)
+                with tracing.remote_span(
+                    msg.get("trace_ctx"),
+                    f"actor:{type(actor).__name__}.{msg['method']}",
+                ):
+                    value = getattr(actor, msg["method"])(
+                        *args, **kwargs
+                    )
             elif mtype == "free":
                 for oid in msg["obj_ids"]:
                     ent = shm_cache.pop(oid, None)
@@ -175,6 +237,9 @@ def worker_main(conn, worker_id: str, env_overrides: Dict[str, str]):
         except BaseException as e:  # noqa: BLE001 — report, don't die
             tb = traceback.format_exc()
             try:
+                from ray_tpu.util import tracing as _tracing
+
+                _err_spans = _tracing.drain_finished()
                 conn.send(
                     {
                         "task_id": msg.get("task_id"),
@@ -182,6 +247,11 @@ def worker_main(conn, worker_id: str, env_overrides: Dict[str, str]):
                         "error": str(e),
                         "error_cls": type(e).__name__,
                         "traceback": tb,
+                        **(
+                            {"spans": _err_spans}
+                            if _err_spans
+                            else {}
+                        ),
                     }
                 )
             except Exception:
@@ -194,6 +264,12 @@ def worker_main(conn, worker_id: str, env_overrides: Dict[str, str]):
         # a fresh shm segment, small ones the pipe.
         meta, buffers = ser.serialize(value)
         size = ser.serialized_size(meta, buffers)
+        # finished spans ride the result message back to the driver's
+        # tracer (the reference exports via its OTel pipeline instead)
+        from ray_tpu.util import tracing
+
+        spans = tracing.drain_finished()
+        extra = {"spans": spans} if spans else {}
         if ring is not None and ring_min <= size <= ring_max:
             try:
                 # Zero-copy: the serializer writes straight into the
@@ -209,6 +285,7 @@ def worker_main(conn, worker_id: str, env_overrides: Dict[str, str]):
                         "task_id": msg["task_id"],
                         "status": "ok_ring",
                         "nbytes": size,
+                        **extra,
                     }
                 )
                 continue
@@ -225,6 +302,7 @@ def worker_main(conn, worker_id: str, env_overrides: Dict[str, str]):
                     "task_id": msg["task_id"],
                     "status": "ok_shm",
                     "shm_name": shm.name,
+                    **extra,
                 }
             )
             shm.close()  # driver now owns the segment (it will unlink)
@@ -234,6 +312,7 @@ def worker_main(conn, worker_id: str, env_overrides: Dict[str, str]):
                     "task_id": msg["task_id"],
                     "status": "ok",
                     "value_blob": ser.dumps(value),
+                    **extra,
                 }
             )
 

@@ -1,12 +1,21 @@
 """ray_tpu.data: block-based distributed Dataset.
 
 Counterpart of the reference's ``python/ray/data/dataset.py:114``
-(Dataset on Arrow blocks with a lazy ExecutionPlan —
-``data/_internal/plan.py``): data lives as a list of blocks (plain
-Python lists / numpy arrays); transforms are lazy stages executed
-per-block as remote tasks when the dataset is consumed. Shuffle is a
-single-stage scatter (the reference's push_based_shuffle collapses to
-one exchange on a single host)."""
+(Dataset on Arrow blocks with a lazy ExecutionPlan,
+``data/_internal/plan.py``). Blocks are either Arrow tables (tabular
+data, parquet IO, columnar batch formats) or plain Python lists
+(simple rows); they live in the OBJECT PLANE as refs — the driver
+routes references, workers move the bytes over shared memory —
+and transforms are lazy stages fused into one task per block at
+consumption time.
+
+Shuffle and sort are DISTRIBUTED two-stage exchanges in the shape of
+the reference's push-based shuffle (``_internal/push_based_shuffle.py``,
+``sort.py``): stage one partitions every block (hash for shuffle,
+sampled range boundaries for sort) into P parts as remote tasks; stage
+two merges part (i) of every block in P parallel tasks. Row data never
+gathers on the driver.
+"""
 
 from __future__ import annotations
 
@@ -16,6 +25,13 @@ from typing import Any, Callable, Dict, List, Optional, Sequence
 import numpy as np
 
 import ray_tpu as ray
+
+try:
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+except ImportError:  # pragma: no cover - pyarrow is in the image
+    pa = None
+    pq = None
 
 
 def _chunk(items: Sequence, n_blocks: int) -> List[List]:
@@ -28,33 +44,193 @@ def _chunk(items: Sequence, n_blocks: int) -> List[List]:
     ]
 
 
+# ---------------------------------------------------------------------------
+# Block helpers (list blocks vs arrow-table blocks)
+# ---------------------------------------------------------------------------
+
+
+def _block_rows(block) -> List:
+    if pa is not None and isinstance(block, pa.Table):
+        return block.to_pylist()
+    return list(block)
+
+
+def _block_len(block) -> int:
+    if pa is not None and isinstance(block, pa.Table):
+        return block.num_rows
+    return len(block)
+
+
+def _rows_to_block(rows: List, like) -> Any:
+    """Rebuild a block of the same family as ``like`` from rows."""
+    if pa is not None and isinstance(like, pa.Table):
+        if not rows:
+            return like.schema.empty_table()
+        return pa.Table.from_pylist(rows, schema=like.schema)
+    return rows
+
+
+def _concat_blocks(parts: List):
+    tables = [
+        p for p in parts if pa is not None and isinstance(p, pa.Table)
+    ]
+    if tables:
+        lists = [p for p in parts if not isinstance(p, pa.Table)]
+        out = pa.concat_tables(tables)
+        if lists:  # mixed families: degrade to rows
+            rows = out.to_pylist()
+            for p in lists:
+                rows.extend(p)
+            return rows
+        return out
+    out: List = []
+    for p in parts:
+        out.extend(p)
+    return out
+
+
+def _format_batch(block, batch_format: str):
+    if batch_format == "pyarrow":
+        if isinstance(block, pa.Table):
+            return block
+        return pa.Table.from_pylist(list(block))
+    if batch_format == "pandas":
+        if isinstance(block, pa.Table):
+            return block.to_pandas()
+        import pandas as pd
+
+        return pd.DataFrame(list(block))
+    if batch_format == "numpy":
+        if pa is not None and isinstance(block, pa.Table):
+            return {
+                name: np.asarray(col)
+                for name, col in zip(
+                    block.column_names, block.columns
+                )
+            }
+        return np.asarray(list(block))
+    return _block_rows(block)  # "rows" / default
+
+
+def _unformat_batch(out) -> Any:
+    """Whatever fn returned becomes a block again."""
+    if pa is not None and isinstance(out, pa.Table):
+        return out
+    try:
+        import pandas as pd
+
+        if isinstance(out, pd.DataFrame):
+            return pa.Table.from_pandas(out, preserve_index=False)
+    except ImportError:  # pragma: no cover
+        pass
+    if isinstance(out, dict):  # numpy column dict
+        return pa.Table.from_pydict(
+            {k: np.asarray(v) for k, v in out.items()}
+        )
+    if isinstance(out, np.ndarray):
+        return list(out)
+    return list(out)
+
+
+# ---------------------------------------------------------------------------
+# Remote stage / shuffle tasks
+# ---------------------------------------------------------------------------
+
+
 @ray.remote
-def _apply_stages(block: List, stages) -> List:
+def _apply_stages(block, stages):
     """All pending stages fuse into ONE task per block: no per-stage
     driver barrier or intermediate block round trips."""
-    for kind, fn in stages:
-        if kind == "map":
-            block = [fn(x) for x in block]
+    for kind, fn, extra in stages:
+        if kind == "read_parquet":
+            block = pq.read_table(fn)  # fn = path
+        elif kind == "map":
+            block = _rows_to_block(
+                [fn(x) for x in _block_rows(block)], block
+            )
         elif kind == "map_batches":
-            block = list(fn(block))
+            batch = _format_batch(block, extra or "rows")
+            block = _unformat_batch(fn(batch))
         elif kind == "filter":
-            block = [x for x in block if fn(x)]
+            block = _rows_to_block(
+                [x for x in _block_rows(block) if fn(x)], block
+            )
         elif kind == "flat_map":
             out = []
-            for x in block:
+            for x in _block_rows(block):
                 out.extend(fn(x))
-            block = out
+            block = _rows_to_block(out, block)
         else:
             raise ValueError(kind)
     return block
 
 
+@ray.remote
+def _partition_block(block, n_parts, mode, key, bounds, seed):
+    """Stage 1 of the exchange: split one block into n_parts
+    (hash-random for shuffle, range for sort)."""
+    rows = _block_rows(block)
+    parts: List[List] = [[] for _ in range(n_parts)]
+    if mode == "shuffle":
+        rng = np.random.default_rng(seed)
+        assign = rng.integers(0, n_parts, len(rows))
+        for row, p in zip(rows, assign):
+            parts[int(p)].append(row)
+    else:  # range partition by sort key against sampled bounds
+        for row in rows:
+            k = key(row)
+            p = int(np.searchsorted(bounds, k, side="right"))
+            parts[p].append(row)
+    return tuple(_rows_to_block(p, block) for p in parts)
+
+
+@ray.remote
+def _merge_parts(mode, key, seed, *parts):
+    """Stage 2: merge part i of every block (sorting or reshuffling
+    locally)."""
+    merged = _concat_blocks(list(parts))
+    rows = _block_rows(merged)
+    if mode == "shuffle":
+        rng = np.random.default_rng(seed)
+        rows = [rows[i] for i in rng.permutation(len(rows))]
+    else:
+        rows.sort(key=key)
+    return _rows_to_block(rows, merged)
+
+
+@ray.remote
+def _sample_keys(block, key, k, seed):
+    rows = _block_rows(block)
+    if not rows:
+        return []
+    rng = np.random.default_rng(seed)
+    idx = rng.integers(0, len(rows), min(k, len(rows)))
+    return [key(rows[int(i)]) for i in idx]
+
+
+@ray.remote
+def _write_parquet_block(block, path):
+    if not (pa is not None and isinstance(block, pa.Table)):
+        block = pa.Table.from_pylist(_block_rows(block))
+    pq.write_table(block, path)
+    return path
+
+
+@ray.remote
+def _block_count(block) -> int:
+    return _block_len(block)
+
+
 class Dataset:
     """reference data/dataset.py:114 (lazy per-block execution)."""
 
-    def __init__(self, blocks: List[List], stages=None):
+    def __init__(self, blocks: List, stages=None, *, refs=None):
+        # blocks may be in-memory values or object refs; they are
+        # normalized to refs on first execution
         self._blocks = blocks
+        self._refs = refs  # List[ObjectRef] once normalized
         self._stages: List = list(stages or [])
+        self._per_block_stages = None  # read_parquet per-path stages
 
     # -- constructors -----------------------------------------------------
 
@@ -74,45 +250,121 @@ class Dataset:
     ) -> "Dataset":
         return cls.from_items(list(arr), parallelism)
 
-    # -- lazy transforms --------------------------------------------------
+    @classmethod
+    def from_arrow(cls, tables) -> "Dataset":
+        if pa is not None and isinstance(tables, pa.Table):
+            tables = [tables]
+        return cls(list(tables))
 
-    def map(self, fn: Callable) -> "Dataset":
-        return Dataset(self._blocks, self._stages + [("map", fn)])
+    @classmethod
+    def from_pandas(cls, dfs) -> "Dataset":
+        import pandas as pd
 
-    def map_batches(self, fn: Callable) -> "Dataset":
-        """fn(list_of_rows) -> list_of_rows, applied per block."""
-        return Dataset(
-            self._blocks, self._stages + [("map_batches", fn)]
+        if isinstance(dfs, pd.DataFrame):
+            dfs = [dfs]
+        return cls(
+            [
+                pa.Table.from_pandas(df, preserve_index=False)
+                for df in dfs
+            ]
         )
 
+    @classmethod
+    def read_parquet(cls, paths) -> "Dataset":
+        """One block per file, read INSIDE the tasks (lazy — the
+        driver never holds the file bytes; reference
+        data/read_api.py read_parquet)."""
+        import glob as _glob
+        import os
+
+        if isinstance(paths, str):
+            if os.path.isdir(paths):
+                paths = sorted(
+                    _glob.glob(os.path.join(paths, "*.parquet"))
+                )
+            else:
+                paths = sorted(_glob.glob(paths)) or [paths]
+        ds = cls([None] * len(paths))
+        # each block gets its own read stage: blocks are per-path
+        ds._per_block_stages = [
+            [("read_parquet", p, None)] for p in paths
+        ]
+        return ds
+
+    # -- lazy transforms --------------------------------------------------
+
+    def _with_stage(self, stage) -> "Dataset":
+        out = Dataset(
+            self._blocks, self._stages + [stage], refs=self._refs
+        )
+        out._per_block_stages = getattr(
+            self, "_per_block_stages", None
+        )
+        return out
+
+    def map(self, fn: Callable) -> "Dataset":
+        return self._with_stage(("map", fn, None))
+
+    def map_batches(
+        self, fn: Callable, batch_format: str = "rows"
+    ) -> "Dataset":
+        """fn(batch) -> batch per block; batch_format selects the
+        in-task representation: "rows" (list), "pyarrow" (Table),
+        "pandas" (DataFrame), "numpy" (dict of columns / array)
+        (reference dataset.map_batches batch_format)."""
+        return self._with_stage(("map_batches", fn, batch_format))
+
     def filter(self, fn: Callable) -> "Dataset":
-        return Dataset(self._blocks, self._stages + [("filter", fn)])
+        return self._with_stage(("filter", fn, None))
 
     def flat_map(self, fn: Callable) -> "Dataset":
-        return Dataset(self._blocks, self._stages + [("flat_map", fn)])
+        return self._with_stage(("flat_map", fn, None))
 
     # -- execution --------------------------------------------------------
 
-    def _materialize(self) -> List[List]:
-        """Run pending stages over all blocks as parallel tasks."""
-        blocks = self._blocks
-        if self._stages:
-            ray.init(ignore_reinit_error=True)
+    def _materialize_refs(self) -> List:
+        """→ one object ref per fully-transformed block; stages and
+        per-block read stages execute as parallel tasks."""
+        ray.init(ignore_reinit_error=True)
+        per_block = getattr(self, "_per_block_stages", None)
+        if self._refs is None:
+            if per_block is not None:
+                refs = [
+                    _apply_stages.remote(
+                        None, pb + self._stages
+                    )
+                    for pb in per_block
+                ]
+            elif self._stages:
+                refs = [
+                    _apply_stages.remote(b, self._stages)
+                    for b in self._blocks
+                ]
+            else:
+                refs = [ray.put(b) for b in self._blocks]
+        elif self._stages:
             refs = [
-                _apply_stages.remote(b, self._stages) for b in blocks
+                _apply_stages.remote(r, self._stages)
+                for r in self._refs
             ]
-            blocks = ray.get(refs)
-            ray.free(refs)
-        self._blocks = blocks
+        else:
+            refs = self._refs
+        self._refs = refs
+        self._per_block_stages = None
         self._stages = []
+        return refs
+
+    def _materialize(self) -> List:
+        """Blocks as in-memory values (driver-side consumption)."""
+        blocks = ray.get(self._materialize_refs())
         return blocks
 
     # -- consumption ------------------------------------------------------
 
     def take(self, n: int = 20) -> List:
         out: List = []
-        for b in self._materialize():
-            out.extend(b)
+        for ref in self._materialize_refs():
+            out.extend(_block_rows(ray.get(ref)))
             if len(out) >= n:
                 return out[:n]
         return out
@@ -120,39 +372,148 @@ class Dataset:
     def take_all(self) -> List:
         out: List = []
         for b in self._materialize():
-            out.extend(b)
+            out.extend(_block_rows(b))
         return out
 
     def count(self) -> int:
-        return sum(len(b) for b in self._materialize())
+        refs = self._materialize_refs()
+        counts = ray.get(
+            [_block_count.remote(r) for r in refs]
+        )
+        return sum(counts)
 
-    def iter_batches(self, batch_size: int = 256):
+    def iter_batches(
+        self, batch_size: int = 256, batch_format: str = "rows"
+    ):
         buf: List = []
-        for b in self._materialize():
-            buf.extend(b)
+        for ref in self._materialize_refs():
+            buf.extend(_block_rows(ray.get(ref)))
             while len(buf) >= batch_size:
-                yield buf[:batch_size]
+                yield _maybe_format_rows(
+                    buf[:batch_size], batch_format
+                )
                 buf = buf[batch_size:]
         if buf:
-            yield buf
+            yield _maybe_format_rows(buf, batch_format)
 
     def iter_rows(self):
-        for b in self._materialize():
-            yield from b
+        for ref in self._materialize_refs():
+            yield from _block_rows(ray.get(ref))
 
-    # -- reshaping --------------------------------------------------------
+    def to_pandas(self):
+        import pandas as pd
+
+        blocks = self._materialize()
+        frames = [
+            b.to_pandas()
+            if pa is not None and isinstance(b, pa.Table)
+            else pd.DataFrame(_block_rows(b))
+            for b in blocks
+        ]
+        return pd.concat(frames, ignore_index=True)
+
+    def write_parquet(self, dir_path: str) -> List[str]:
+        """Per-block parallel parquet writes (reference
+        dataset.write_parquet)."""
+        import os
+
+        os.makedirs(dir_path, exist_ok=True)
+        refs = self._materialize_refs()
+        return ray.get(
+            [
+                _write_parquet_block.remote(
+                    r, os.path.join(dir_path, f"block_{i:05d}.parquet")
+                )
+                for i, r in enumerate(refs)
+            ]
+        )
+
+    # -- reshaping (distributed exchanges) --------------------------------
 
     def repartition(self, num_blocks: int) -> "Dataset":
-        return Dataset(_chunk(self.take_all(), num_blocks))
+        rows = self.take_all()
+        return Dataset(_chunk(rows, num_blocks))
 
     def random_shuffle(self, seed: Optional[int] = None) -> "Dataset":
-        rows = self.take_all()
-        rng = np.random.default_rng(seed)
-        idx = rng.permutation(len(rows))
-        n_blocks = max(1, len(self._blocks))
-        return Dataset(
-            _chunk([rows[i] for i in idx], n_blocks)
+        """Two-stage distributed exchange (the push_based_shuffle
+        shape): partition tasks fan rows out by hash, merge tasks
+        reassemble — the driver only routes refs."""
+        refs = self._materialize_refs()
+        n = max(1, len(refs))
+        # unseeded shuffles must differ per call (fresh OS entropy);
+        # seeded ones stay deterministic
+        base = (
+            int(seed)
+            if seed is not None
+            else int(np.random.SeedSequence().entropy % (2**31))
         )
+        if n == 1:
+            rows = self.take_all()
+            rng = np.random.default_rng(seed)
+            return Dataset(
+                [[rows[i] for i in rng.permutation(len(rows))]]
+            )
+        part_refs = [
+            _partition_block.options(num_returns=n).remote(
+                r, n, "shuffle", None, None, base + 1000 + i
+            )
+            for i, r in enumerate(refs)
+        ]
+        merged = [
+            _merge_parts.remote(
+                "shuffle",
+                None,
+                base + 2000 + j,
+                *[parts[j] for parts in part_refs],
+            )
+            for j in range(n)
+        ]
+        _free_when_done(
+            [p for parts in part_refs for p in parts], merged
+        )
+        return Dataset(None, refs=merged)
+
+    def sort(self, key: Optional[Callable] = None) -> "Dataset":
+        """Distributed range-partition sort (reference
+        _internal/sort.py): sample keys → boundary quantiles →
+        partition tasks → per-range merge-sort tasks."""
+        key = key or (lambda x: x)
+        refs = self._materialize_refs()
+        n = max(1, len(refs))
+        if n == 1:
+            rows = sorted(self.take_all(), key=key)
+            return Dataset([rows])
+        samples: List = []
+        for s in ray.get(
+            [
+                _sample_keys.remote(r, key, 32, i)
+                for i, r in enumerate(refs)
+            ]
+        ):
+            samples.extend(s)
+        samples.sort()
+        if not samples:
+            return Dataset([[]])
+        bounds = [
+            samples[int(len(samples) * (j + 1) / n)]
+            for j in range(n - 1)
+        ]
+        part_refs = [
+            _partition_block.options(num_returns=n).remote(
+                r, n, "sort", key, bounds, 0
+            )
+            for r in refs
+        ]
+        merged = [
+            _merge_parts.remote(
+                "sort", key, 0, *[parts[j] for parts in part_refs]
+            )
+            for j in range(n)
+        ]
+        _free_when_done(
+            [p for parts in part_refs for p in parts], merged
+        )
+        return Dataset(None, refs=merged)
 
     def split(self, n: int) -> List["Dataset"]:
         """reference dataset.split: n equal-ish shards (Train wiring)."""
@@ -165,18 +526,55 @@ class Dataset:
             )
         return shards
 
-    def sort(self, key: Optional[Callable] = None) -> "Dataset":
-        rows = sorted(self.take_all(), key=key)
-        return Dataset(_chunk(rows, max(1, len(self._blocks))))
-
     def sum(self):
         return sum(self.take_all())
 
     def num_blocks(self) -> int:
+        if self._refs is not None:
+            return len(self._refs)
+        per_block = getattr(self, "_per_block_stages", None)
+        if per_block is not None:
+            return len(per_block)
         return len(self._blocks)
+
+    def schema(self):
+        refs = self._materialize_refs()
+        first = ray.get(refs[0]) if refs else None
+        if pa is not None and isinstance(first, pa.Table):
+            return first.schema
+        return type(first[0]) if first else None
 
     def __repr__(self):
         return (
-            f"Dataset(num_blocks={len(self._blocks)}, "
+            f"Dataset(num_blocks={self.num_blocks()}, "
             f"pending_stages={len(self._stages)})"
         )
+
+
+def _maybe_format_rows(rows: List, batch_format: str):
+    if batch_format == "rows":
+        return rows
+    return _format_batch(rows, batch_format)
+
+
+def _free_when_done(dep_refs: List, out_refs: List) -> None:
+    """Free intermediate refs (exchange partitions) once every output
+    consuming them is ready — without this, shuffle/sort would pin n*n
+    partition blocks in the object store until driver shutdown (the
+    reference's refcounting handles this; here lifetimes are explicit,
+    DISPOSITIONS single-owner posture)."""
+    remaining = {"n": len(out_refs)}
+    lock = __import__("threading").Lock()
+
+    def on_one_done():
+        with lock:
+            remaining["n"] -= 1
+            done = remaining["n"] == 0
+        if done:
+            try:
+                ray.free(dep_refs)
+            except Exception:
+                pass
+
+    for ref in out_refs:
+        ref._store.on_ready(ref.id, on_one_done)

@@ -1,0 +1,85 @@
+"""Experiment-dir syncing (reference ``python/ray/tune/syncer.py``).
+
+The reference uploads trial checkpoints + experiment state to cloud
+storage (``SyncConfig(upload_dir=...)``, Syncer subclasses per
+backend) so a dead head node's experiments resume elsewhere. Same
+seam here: a :class:`Syncer` ABC with an mtime-delta filesystem
+implementation (shared-FS / NFS posture — the idiomatic durable
+storage on TPU pods; an object-store backend can subclass Syncer
+without touching callers). ``tune.run(sync_config=SyncConfig(...))``
+syncs after every experiment-state write, and ``resume=True`` pulls
+the mirror down first when the local dir is missing."""
+
+from __future__ import annotations
+
+import os
+import shutil
+from typing import Optional
+
+
+class SyncConfig:
+    """reference tune/syncer.py SyncConfig."""
+
+    def __init__(
+        self,
+        upload_dir: Optional[str] = None,
+        syncer: Optional["Syncer"] = None,
+        sync_period_s: float = 0.0,
+    ):
+        self.upload_dir = upload_dir
+        self.syncer = syncer or (
+            FileSyncer() if upload_dir else None
+        )
+        self.sync_period_s = float(sync_period_s)
+
+
+class Syncer:
+    def sync_up(self, local_dir: str, remote_dir: str) -> None:
+        raise NotImplementedError
+
+    def sync_down(self, remote_dir: str, local_dir: str) -> None:
+        raise NotImplementedError
+
+    def exists(self, remote_dir: str) -> bool:
+        """Whether the remote location has anything to pull — the
+        backend owns remote-path semantics (an object-store syncer
+        checks its bucket; callers never os.path a remote URI)."""
+        raise NotImplementedError
+
+
+class FileSyncer(Syncer):
+    """mtime-delta directory mirror: only new/changed files copy."""
+
+    @staticmethod
+    def _copy_delta(src: str, dst: str) -> int:
+        copied = 0
+        for root, _, files in os.walk(src):
+            rel = os.path.relpath(root, src)
+            out_root = (
+                dst if rel == "." else os.path.join(dst, rel)
+            )
+            os.makedirs(out_root, exist_ok=True)
+            for f in files:
+                s = os.path.join(root, f)
+                d = os.path.join(out_root, f)
+                try:
+                    if (
+                        not os.path.exists(d)
+                        or os.path.getmtime(s) > os.path.getmtime(d)
+                        or os.path.getsize(s) != os.path.getsize(d)
+                    ):
+                        shutil.copy2(s, d)
+                        copied += 1
+                except OSError:
+                    pass
+        return copied
+
+    def sync_up(self, local_dir: str, remote_dir: str) -> None:
+        os.makedirs(remote_dir, exist_ok=True)
+        self._copy_delta(local_dir, remote_dir)
+
+    def sync_down(self, remote_dir: str, local_dir: str) -> None:
+        self._copy_delta(remote_dir, local_dir)
+
+    def exists(self, remote_dir: str) -> bool:
+        return os.path.exists(remote_dir)

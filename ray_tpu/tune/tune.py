@@ -167,6 +167,7 @@ class TrialRunner:
         trial_name: str = "trial",
         stopping_criterion: Optional[Dict] = None,
         base_config: Optional[Dict] = None,
+        sync_config=None,
     ):
         self.trainable_cls = trainable_cls
         self.trials = trials
@@ -189,8 +190,58 @@ class TrialRunner:
         self._search_name = trial_name
         self._search_base = dict(base_config or {})
         self._search_exhausted = False
+        self.sync_config = sync_config
         if resume:
+            self._maybe_sync_down()
             self._restore_experiment_state()
+
+    def _maybe_sync_down(self) -> None:
+        """Pull the mirrored experiment dir before resuming when the
+        local one is missing (head died; the upload_dir survived —
+        reference tune/syncer.py restore path)."""
+        sc = self.sync_config
+        if (
+            sc is None
+            or sc.syncer is None
+            or not self.experiment_dir
+        ):
+            return
+        if not os.path.exists(
+            os.path.join(self.experiment_dir, "experiment_state.pkl")
+        ):
+            remote = self._remote_dir(sc)
+            # the SYNCER owns remote-path semantics (an object-store
+            # backend answers for s3:// URIs; never os.path them here)
+            if sc.syncer.exists(remote):
+                sc.syncer.sync_down(remote, self.experiment_dir)
+
+    def _remote_dir(self, sc) -> str:
+        return os.path.join(
+            sc.upload_dir, os.path.basename(self.experiment_dir)
+        )
+
+    def _maybe_sync_up(self) -> None:
+        sc = self.sync_config
+        if (
+            sc is None
+            or sc.syncer is None
+            or not self.experiment_dir
+            or not os.path.exists(self.experiment_dir)
+        ):
+            return
+        import time as _time
+
+        # the final save (all trials terminal) always syncs, or a
+        # throttled last write would leave the mirror stale
+        force = all(
+            t.status in (TERMINATED, ERROR) for t in self.trials
+        )
+        now = _time.monotonic()
+        last = getattr(self, "_last_sync_up", 0.0)
+        if not force and now - last < sc.sync_period_s:
+            return  # throttle (SyncConfig.sync_period_s)
+        self._last_sync_up = now
+        sc.syncer.sync_up(self.experiment_dir, self._remote_dir(sc))
 
     def _maybe_ask_searcher(self) -> None:
         if self.search_alg is None:
@@ -266,6 +317,7 @@ class TrialRunner:
         with open(tmp, "wb") as f:
             pickle.dump(state, f)
         os.replace(tmp, path)  # atomic: a crash never corrupts state
+        self._maybe_sync_up()
 
     def _restore_experiment_state(self) -> None:
         path = self._state_path
@@ -297,6 +349,18 @@ class TrialRunner:
 
     # -- shared result handling -------------------------------------------
 
+    def _trial_checkpoint_dir(self, trial: Trial) -> Optional[str]:
+        """Checkpoints land under the experiment dir when one exists,
+        so experiment-state persistence and the syncer cover them
+        (reference: trial logdirs inside the experiment dir)."""
+        if not self.experiment_dir:
+            return None
+        return os.path.join(
+            self.experiment_dir,
+            trial.trial_id,
+            f"checkpoint_{trial.last_result.get('training_iteration', 0):06d}",
+        )
+
     def _process_result(self, trial: Trial, result: Dict) -> bool:
         """Record + schedule one result. Returns True if the trial
         should continue training."""
@@ -309,7 +373,9 @@ class TrialRunner:
         if self.checkpoint_freq and (
             result["training_iteration"] % self.checkpoint_freq == 0
         ):
-            trial.checkpoint_path = trial.runner.save()
+            trial.checkpoint_path = trial.runner.save(
+                self._trial_checkpoint_dir(trial)
+            )
         decision = self.scheduler.on_trial_result(self, trial, result)
         if (
             decision == STOP
@@ -323,7 +389,9 @@ class TrialRunner:
                 )
             self.scheduler.on_trial_complete(self, trial, result)
             if self.checkpoint_freq:
-                trial.checkpoint_path = trial.runner.save()
+                trial.checkpoint_path = trial.runner.save(
+                self._trial_checkpoint_dir(trial)
+            )
             self._cleanup_trial(trial)
             self._save_experiment_state()
             return False
@@ -483,6 +551,7 @@ def run(
     resume: bool = False,
     search_alg=None,
     resources_per_trial: Optional[Dict] = None,
+    sync_config=None,
 ) -> ExperimentAnalysis:
     """reference tune/tune.py:118.
 
@@ -575,6 +644,7 @@ def run(
             for k, v in (config or {}).items()
             if not isinstance(v, SearchDomain)
         },
+        sync_config=sync_config,
     )
     try:
         while not runner.is_finished():

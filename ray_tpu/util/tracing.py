@@ -1,0 +1,230 @@
+"""Distributed span tracing across task/actor boundaries.
+
+Counterpart of the reference's OpenTelemetry integration
+(``python/ray/util/tracing/tracing_helper.py``: every remote
+function/actor method is wrapped with span-propagating proxies,
+``_inject_tracing_into_function :324``, ``_inject_tracing_into_class
+:449``). Same shape without the OTel dependency: when tracing is
+enabled, submissions carry a trace context (trace_id + parent span
+id), workers open a child span around execution — user code can open
+nested spans via :func:`start_span` and they parent correctly — and
+finished spans ride back on the result message into the driver's
+tracer, exportable as a span list or a chrome://tracing file.
+
+Usage::
+
+    from ray_tpu.util import tracing
+    tracing.enable()
+    with tracing.start_span("rollout-phase"):
+        ray.get(worker.sample.remote())   # worker span is a child
+    spans = tracing.get_spans()
+    tracing.export_chrome_trace("/tmp/trace.json")
+
+Enable for every process with ``RAY_TPU_TRACE=1`` (workers inherit the
+env), or per-driver with :func:`enable`.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import json
+import os
+import threading
+import time
+import uuid
+from typing import Any, Dict, List, Optional
+
+_enabled = os.environ.get("RAY_TPU_TRACE") == "1"
+_current: contextvars.ContextVar[Optional["Span"]] = (
+    contextvars.ContextVar("ray_tpu_span", default=None)
+)
+_finished: List[Dict] = []
+_lock = threading.Lock()
+# bound the span buffer: long-running jobs must not grow driver memory
+# monotonically — oldest spans drop first (export/inspect regularly,
+# or raise via RAY_TPU_TRACE_BUFFER)
+_MAX_SPANS = int(os.environ.get("RAY_TPU_TRACE_BUFFER", 100_000))
+
+
+def _append_bounded(records: List[Dict]) -> None:
+    with _lock:
+        _finished.extend(records)
+        if len(_finished) > _MAX_SPANS:
+            del _finished[: len(_finished) - _MAX_SPANS]
+
+
+def enable() -> None:
+    global _enabled
+    _enabled = True
+
+
+def disable() -> None:
+    global _enabled
+    _enabled = False
+
+
+def is_enabled() -> bool:
+    return _enabled
+
+
+class Span:
+    __slots__ = (
+        "trace_id",
+        "span_id",
+        "parent_id",
+        "name",
+        "start",
+        "end",
+        "attributes",
+        "process",
+    )
+
+    def __init__(self, name: str, trace_id=None, parent_id=None):
+        self.trace_id = trace_id or uuid.uuid4().hex[:16]
+        self.span_id = uuid.uuid4().hex[:16]
+        self.parent_id = parent_id
+        self.name = name
+        self.start = time.time()
+        self.end: Optional[float] = None
+        self.attributes: Dict[str, Any] = {}
+        self.process = os.getpid()
+
+    def set_attribute(self, key: str, value: Any) -> None:
+        self.attributes[key] = value
+
+    def finish(self) -> Dict:
+        self.end = time.time()
+        record = {
+            "trace_id": self.trace_id,
+            "span_id": self.span_id,
+            "parent_id": self.parent_id,
+            "name": self.name,
+            "start": self.start,
+            "end": self.end,
+            "attributes": dict(self.attributes),
+            "pid": self.process,
+        }
+        if _enabled:  # disabled tracing records nothing
+            _append_bounded([record])
+        return record
+
+
+@contextlib.contextmanager
+def start_span(name: str, **attributes):
+    """Open a span under the current one (driver or worker side)."""
+    parent = _current.get()
+    span = Span(
+        name,
+        trace_id=parent.trace_id if parent else None,
+        parent_id=parent.span_id if parent else None,
+    )
+    for k, v in attributes.items():
+        span.set_attribute(k, v)
+    token = _current.set(span)
+    try:
+        yield span
+    finally:
+        _current.reset(token)
+        span.finish()
+
+
+def get_current_span() -> Optional[Span]:
+    return _current.get()
+
+
+# -- boundary plumbing (called by core/api.py and core/worker_proc.py) --
+
+
+def inject_context() -> Optional[Dict]:
+    """Driver-side: the context a submission carries
+    (tracing_helper's span injection role)."""
+    if not _enabled:
+        return None
+    parent = _current.get()
+    if parent is not None:
+        return {
+            "trace_id": parent.trace_id,
+            "parent_span_id": parent.span_id,
+        }
+    return {"trace_id": uuid.uuid4().hex[:16], "parent_span_id": None}
+
+
+@contextlib.contextmanager
+def remote_span(ctx: Optional[Dict], name: str):
+    """Worker-side: execution span as a child of the submitted
+    context; no-op when the submission carried none. A present
+    context IS the worker's enable signal (the driver's enable() flag
+    doesn't cross the process boundary; the injected context does),
+    so nested user spans inside the execution record too."""
+    global _enabled
+    if ctx is None:
+        yield None
+        return
+    span = Span(
+        name,
+        trace_id=ctx.get("trace_id"),
+        parent_id=ctx.get("parent_span_id"),
+    )
+    token = _current.set(span)
+    was_enabled = _enabled
+    _enabled = True
+    try:
+        yield span
+    finally:
+        _current.reset(token)
+        span.finish()
+        _enabled = was_enabled
+
+
+def drain_finished() -> List[Dict]:
+    """Worker-side: hand finished spans to the result pipe."""
+    with _lock:
+        out = list(_finished)
+        _finished.clear()
+    return out
+
+
+def record_spans(spans: List[Dict]) -> None:
+    """Driver-side: absorb spans shipped back from a worker."""
+    if not spans:
+        return
+    _append_bounded(spans)
+
+
+def get_spans() -> List[Dict]:
+    with _lock:
+        return list(_finished)
+
+
+def clear() -> None:
+    with _lock:
+        _finished.clear()
+
+
+def export_chrome_trace(path: str) -> str:
+    """chrome://tracing JSON (the reference's ray.timeline format,
+    _private/state.py:435, with span parent/trace ids attached)."""
+    with _lock:
+        spans = list(_finished)
+    events = [
+        {
+            "name": s["name"],
+            "cat": "span",
+            "ph": "X",
+            "ts": s["start"] * 1e6,
+            "dur": ((s["end"] or s["start"]) - s["start"]) * 1e6,
+            "pid": s["pid"],
+            "tid": 0,
+            "args": {
+                "trace_id": s["trace_id"],
+                "span_id": s["span_id"],
+                "parent_id": s["parent_id"],
+                **s["attributes"],
+            },
+        }
+        for s in spans
+    ]
+    with open(path, "w") as f:
+        json.dump({"traceEvents": events}, f)
+    return path

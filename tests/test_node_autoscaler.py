@@ -1,0 +1,106 @@
+"""Node-level autoscaling with the provider abstraction (reference
+``autoscaler/_private/autoscaler.py:145`` +
+``fake_multi_node/node_provider.py:237``)."""
+
+import time
+
+import pytest
+
+import ray_tpu.core.api as ray
+from ray_tpu.autoscaler.node_provider import (
+    FakeMultiNodeProvider,
+    LocalSubprocessProvider,
+    NodeAutoscaler,
+)
+
+
+def test_demand_scales_up_and_idle_scales_down():
+    provider = FakeMultiNodeProvider()
+    scaler = NodeAutoscaler(
+        provider,
+        min_nodes=1,
+        max_nodes=4,
+        cpus_per_node=2,
+        idle_timeout_s=0.3,
+        update_interval_s=0.05,
+    )
+    try:
+        deadline = time.time() + 5
+        while time.time() < deadline and len(provider.nodes) < 1:
+            time.sleep(0.05)
+        assert len(provider.nodes) == 1  # min_nodes floor
+
+        scaler.request_resources(num_cpus=7)  # ceil(7/2) = 4 nodes
+        deadline = time.time() + 5
+        while time.time() < deadline and len(provider.nodes) < 4:
+            time.sleep(0.05)
+        assert len(provider.nodes) == 4
+
+        scaler.request_resources(num_cpus=0)  # drain → min_nodes
+        deadline = time.time() + 10
+        while time.time() < deadline and len(provider.nodes) > 1:
+            time.sleep(0.05)
+        assert len(provider.nodes) == 1
+        assert provider.terminated == 3
+    finally:
+        scaler.stop()
+
+
+def test_dead_nodes_are_replaced():
+    provider = FakeMultiNodeProvider()
+    scaler = NodeAutoscaler(
+        provider,
+        min_nodes=2,
+        max_nodes=4,
+        update_interval_s=0.05,
+        idle_timeout_s=60.0,
+    )
+    try:
+        deadline = time.time() + 5
+        while time.time() < deadline and len(provider.nodes) < 2:
+            time.sleep(0.05)
+        victim = provider.non_terminated_nodes()[0]
+        provider.kill_node(victim)  # crash, not terminate
+        deadline = time.time() + 5
+        while time.time() < deadline and len(provider.nodes) < 2:
+            time.sleep(0.05)
+        assert len(provider.nodes) == 2  # replaced
+        assert victim not in provider.nodes
+    finally:
+        scaler.stop()
+
+
+@pytest.mark.regression
+def test_local_provider_scales_real_agent_nodes():
+    """The local provider launches REAL worker-agent subprocesses that
+    join the head's fleet; a scaled-up node hosts an actor."""
+    from ray_tpu.core.cluster import start_cluster_server
+
+    addr = start_cluster_server()
+    rt = ray._require_runtime()
+    provider = LocalSubprocessProvider(addr, num_cpus=2)
+    scaler = NodeAutoscaler(
+        provider,
+        min_nodes=0,
+        max_nodes=2,
+        cpus_per_node=2,
+        idle_timeout_s=60.0,
+        update_interval_s=0.2,
+        cluster=rt.cluster,
+    )
+    try:
+        scaler.request_resources(num_cpus=2)
+        rt.cluster.wait_for_nodes(1, timeout=90)
+
+        @ray.remote
+        class Echo:
+            def ping(self):
+                return "pong"
+
+        a = Echo.options(placement_node="any").remote()
+        assert ray.get(a.ping.remote(), timeout=60) == "pong"
+        ray.kill(a)
+    finally:
+        scaler.stop()
+        for nid in provider.non_terminated_nodes():
+            provider.terminate_node(nid)

@@ -1,0 +1,153 @@
+"""runtime_env provisioning: env_vars, working_dir, py_modules
+(reference ``python/ray/_private/runtime_env/`` plugins + URI cache)."""
+
+import os
+import pathlib
+import subprocess
+import sys
+
+import pytest
+
+import ray_tpu.core.api as ray
+from ray_tpu.core.runtime_env import (
+    _cache_root,
+    pack_runtime_env,
+)
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+
+
+def _make_working_dir(tmp_path):
+    wd = tmp_path / "proj"
+    wd.mkdir()
+    (wd / "data.txt").write_text("hello from working_dir")
+    (wd / "helper.py").write_text("VALUE = 41\n")
+    return str(wd)
+
+
+def test_pack_rejects_unknown_keys(tmp_path):
+    with pytest.raises(ValueError, match="conda"):
+        pack_runtime_env({"conda": {"deps": ["x"]}})
+    assert pack_runtime_env(None) is None
+    assert pack_runtime_env({}) is None
+
+
+def test_actor_env_vars_and_working_dir(tmp_path):
+    wd = _make_working_dir(tmp_path)
+
+    @ray.remote
+    class Probe:
+        def read(self):
+            # working_dir semantics: relative paths resolve there and
+            # local modules import
+            import helper
+
+            with open("data.txt") as f:
+                return (
+                    f.read(),
+                    helper.VALUE,
+                    os.environ.get("MY_FLAG"),
+                )
+
+    a = Probe.options(
+        runtime_env={
+            "working_dir": wd,
+            "env_vars": {"MY_FLAG": "on"},
+        }
+    ).remote()
+    text, value, flag = ray.get(a.read.remote())
+    assert text == "hello from working_dir"
+    assert value == 41
+    assert flag == "on"
+    ray.kill(a)
+
+
+def test_task_env_vars_restore_between_tasks(tmp_path):
+    @ray.remote
+    def get_flag():
+        return os.environ.get("TASK_FLAG")
+
+    with_env = get_flag.options(
+        runtime_env={"env_vars": {"TASK_FLAG": "set"}}
+    )
+    assert ray.get(with_env.remote()) == "set"
+    # pooled workers restore env vars after the task
+    assert ray.get(get_flag.remote()) is None
+
+
+def test_py_modules_importable(tmp_path):
+    pkg = tmp_path / "mylib"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("def answer():\n    return 42\n")
+
+    @ray.remote
+    def use_pkg():
+        import mylib
+
+        return mylib.answer()
+
+    out = ray.get(
+        use_pkg.options(
+            runtime_env={"py_modules": [str(pkg)]}
+        ).remote()
+    )
+    assert out == 42
+
+
+def test_archive_cache_is_content_addressed(tmp_path):
+    wd = _make_working_dir(tmp_path)
+    packed1 = pack_runtime_env({"working_dir": wd})
+    packed2 = pack_runtime_env({"working_dir": wd})
+    # second pack hits the zip cache: identical content hash
+    h1 = packed1["archives"][0]["hash"]
+    assert packed2["archives"][0]["hash"] == h1
+    # changing content changes the hash
+    with open(os.path.join(wd, "data.txt"), "a") as f:
+        f.write("!")
+    os.utime(wd)
+    packed3 = pack_runtime_env({"working_dir": wd})
+    assert packed3["archives"][0]["hash"] != h1
+
+
+def test_job_level_runtime_env(tmp_path):
+    """ray.init(runtime_env=...) reaches every worker (subprocess: the
+    pytest session's runtime is already initialized)."""
+    wd = _make_working_dir(tmp_path)
+    script = f"""
+import os
+import ray_tpu.core.api as ray
+
+if __name__ == "__main__":
+    ray.init(num_cpus=2, runtime_env={{
+        "working_dir": {wd!r},
+        "env_vars": {{"JOB_FLAG": "yes"}},
+    }})
+
+    @ray.remote
+    def probe():
+        import helper
+        with open("data.txt") as f:
+            return f.read(), helper.VALUE, os.environ["JOB_FLAG"]
+
+    text, value, flag = ray.get(probe.remote())
+    assert text.startswith("hello"), text
+    assert value == 41 and flag == "yes"
+    print("JOB_ENV_OK")
+    ray.shutdown()
+"""
+    driver = tmp_path / "driver.py"
+    driver.write_text(script)
+    out = subprocess.run(
+        [sys.executable, str(driver)],
+        cwd=REPO,
+        env={
+            **os.environ,
+            "JAX_PLATFORMS": "cpu",
+            "PYTHONPATH": f"{REPO}:{os.environ.get('PYTHONPATH', '')}",
+        },
+        capture_output=True,
+        text=True,
+        timeout=180,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "JOB_ENV_OK" in out.stdout

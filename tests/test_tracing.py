@@ -1,0 +1,84 @@
+"""Span tracing across task/actor boundaries (reference
+``python/ray/util/tracing/tracing_helper.py:324,449``)."""
+
+import json
+
+import ray_tpu.core.api as ray
+from ray_tpu.util import tracing
+
+
+def setup_function(_fn):
+    tracing.enable()
+    tracing.clear()
+
+
+def teardown_function(_fn):
+    tracing.disable()
+    tracing.clear()
+
+
+def test_task_span_is_child_of_driver_span():
+    @ray.remote
+    def work(x):
+        return x * 2
+
+    with tracing.start_span("driver-phase") as root:
+        assert ray.get(work.remote(21)) == 42
+
+    spans = tracing.get_spans()
+    by_name = {s["name"]: s for s in spans}
+    assert "driver-phase" in by_name
+    task_span = by_name["task:work"]
+    assert task_span["trace_id"] == root.trace_id
+    assert task_span["parent_id"] == root.span_id
+    assert task_span["end"] >= task_span["start"]
+    assert task_span["pid"] != by_name["driver-phase"]["pid"]
+
+
+def test_actor_method_spans_and_nested_user_spans():
+    @ray.remote
+    class Worker:
+        def compute(self):
+            from ray_tpu.util import tracing as wtracing
+
+            with wtracing.start_span("inner-step", k="v"):
+                return 7
+
+    a = Worker.remote()
+    with tracing.start_span("root") as root:
+        assert ray.get(a.compute.remote()) == 7
+    ray.kill(a)
+
+    spans = {s["name"]: s for s in tracing.get_spans()}
+    method = spans["actor:Worker.compute"]
+    inner = spans["inner-step"]
+    assert method["trace_id"] == root.trace_id
+    # the user's span nested under the method's execution span
+    assert inner["parent_id"] == method["span_id"]
+    assert inner["attributes"] == {"k": "v"}
+
+
+def test_no_context_without_enable():
+    tracing.disable()
+
+    @ray.remote
+    def work():
+        return 1
+
+    assert ray.get(work.remote()) == 1
+    assert tracing.get_spans() == []
+
+
+def test_chrome_trace_export(tmp_path):
+    @ray.remote
+    def work():
+        return 1
+
+    with tracing.start_span("phase"):
+        ray.get(work.remote())
+    path = tracing.export_chrome_trace(str(tmp_path / "t.json"))
+    events = json.load(open(path))["traceEvents"]
+    names = {e["name"] for e in events}
+    assert {"phase", "task:work"} <= names
+    for e in events:
+        assert e["ph"] == "X" and "trace_id" in e["args"]
