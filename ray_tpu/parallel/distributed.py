@@ -27,6 +27,20 @@ from typing import Any, Dict, Optional
 # ---------------------------------------------------------------------------
 
 
+def _request_hmac(token: str, req: Dict) -> str:
+    """Deterministic MAC over the request header (sorted-key JSON)."""
+    import hashlib
+    import hmac as _hmac
+
+    msg = json.dumps(
+        {k: v for k, v in req.items() if k != "hmac"},
+        sort_keys=True,
+    ).encode()
+    return _hmac.new(
+        token.encode(), msg, hashlib.sha256
+    ).hexdigest()
+
+
 class _KVHandler(socketserver.StreamRequestHandler):
     def handle(self):
         store = self.server.kv_store  # type: ignore[attr-defined]
@@ -36,6 +50,22 @@ class _KVHandler(socketserver.StreamRequestHandler):
                 return
             req = json.loads(header)
             op = req["op"]
+            if store.token is not None:
+                # shared-token HMAC gate: values are pickled, so an
+                # unauthenticated reachable KV is code execution — the
+                # reference's GCS has the same exposure and relies on
+                # network isolation; this adds a cheap second wall for
+                # multi-host deployments (RAY_TPU_KV_TOKEN)
+                import hmac as _hmac
+
+                if not _hmac.compare_digest(
+                    req.get("hmac", ""),
+                    _request_hmac(store.token, req),
+                ):
+                    self.wfile.write(
+                        b'{"ok": false, "error": "bad hmac"}\n'
+                    )
+                    return
             if op == "put":
                 blob = self.rfile.read(req["len"])
                 with store.lock:
@@ -108,9 +138,13 @@ class KVServer:
         host: str = "127.0.0.1",
         port: int = 0,
         persist_path: Optional[str] = None,
+        token: Optional[str] = None,
     ):
         from ray_tpu.core.store_client import make_store_client
 
+        # shared-secret request authentication (off by default on
+        # loopback; set for any non-loopback bind)
+        self.token = token or os.environ.get("RAY_TPU_KV_TOKEN")
         persist_path = persist_path or os.environ.get(
             "RAY_TPU_KV_PERSIST"
         )
@@ -148,11 +182,14 @@ class KVServer:
 class KVClient:
     """Client for KVServer (usable from any host)."""
 
-    def __init__(self, address: str):
+    def __init__(self, address: str, token: Optional[str] = None):
         host, port = address.rsplit(":", 1)
         self.host, self.port = host, int(port)
+        self.token = token or os.environ.get("RAY_TPU_KV_TOKEN")
 
     def _roundtrip(self, req: Dict, payload: bytes = b"") -> Any:
+        if self.token is not None:
+            req = dict(req, hmac=_request_hmac(self.token, req))
         # socket deadline must outlast a server-side blocking get, or
         # long waits surface as TimeoutError instead of KeyError
         sock_timeout = float(req.get("timeout", 30.0)) + 30.0

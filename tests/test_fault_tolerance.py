@@ -206,3 +206,26 @@ def test_tune_driver_kill_and_resume(tmp_path):
 
     saved = pickle.loads(state.read_bytes())
     assert all(s["status"] == "TERMINATED" for s in saved.values())
+
+
+def test_kv_hmac_token_gate():
+    """With a shared token configured, unauthenticated requests are
+    rejected and token-bearing clients work (the cheap second wall for
+    non-loopback KV deployments)."""
+    from ray_tpu.parallel.distributed import KVClient, KVServer
+
+    srv = KVServer(token="s3cret")
+    try:
+        good = KVClient(f"127.0.0.1:{srv.port}", token="s3cret")
+        good.put("k", 1)
+        assert good.get("k") == 1
+
+        bad = KVClient(f"127.0.0.1:{srv.port}", token="wrong")
+        with pytest.raises(Exception):
+            bad.get("k", timeout=1.0)
+        naked = KVClient(f"127.0.0.1:{srv.port}", token=None)
+        naked.token = None
+        with pytest.raises(Exception):
+            naked.get("k", timeout=1.0)
+    finally:
+        srv.shutdown()
