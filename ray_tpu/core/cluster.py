@@ -152,6 +152,9 @@ class RemoteNode:
         cluster = getattr(self.runtime, "cluster", None)
         if cluster is not None:
             cluster.nodes.pop(self.node_id, None)
+            cluster._publish_event(
+                "cluster.node_removed", {"node_id": self.node_id}
+            )
 
     # -- actor ops -------------------------------------------------------
 
@@ -241,9 +244,34 @@ class ClusterServer:
     """Head-side listener: agents connect, register, and become
     placement targets (the gcs_node_manager registration role)."""
 
-    def __init__(self, runtime, host: str = "127.0.0.1", port: int = 0):
+    def __init__(
+        self,
+        runtime,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        kv_address: Optional[str] = None,
+    ):
         self.runtime = runtime
         self.nodes: Dict[str, RemoteNode] = {}
+        # optional event publication: node lifecycle fans out to KV
+        # pubsub subscribers (the reference's GCS node-change channel,
+        # RAY_NODE_INFO_CHANNEL in gcs_node_manager.cc)
+        self._kv = None
+        self._event_thread = None
+        kv_address = kv_address or os.environ.get("RAY_TPU_KV_ADDRESS")
+        if kv_address:
+            import queue
+
+            from ray_tpu.parallel.distributed import KVClient
+
+            self._kv = KVClient(kv_address)
+            self._event_queue = queue.SimpleQueue()
+            self._event_thread = threading.Thread(
+                target=self._event_loop,
+                daemon=True,
+                name="cluster_event_pub",
+            )
+            self._event_thread.start()
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((host, port))
@@ -266,10 +294,18 @@ class ClusterServer:
             except OSError:
                 return
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            msg = _recv_frame(conn)
+            # bounded handshake: a connection that never sends its
+            # register frame (port scanner, wedged agent) must not
+            # park the accept loop forever
+            conn.settimeout(10.0)
+            try:
+                msg = _recv_frame(conn)
+            except (OSError, socket.timeout):
+                msg = None
             if not msg or msg.get("op") != "register":
                 conn.close()
                 continue
+            conn.settimeout(None)
             node = RemoteNode(
                 self.runtime,
                 msg["node_id"],
@@ -280,6 +316,31 @@ class ClusterServer:
             _send_frame(
                 conn, node.send_lock, {"op": "registered", "ok": True}
             )
+            self._publish_event(
+                "cluster.node_added",
+                {
+                    "node_id": msg["node_id"],
+                    "num_cpus": int(msg.get("num_cpus", 1)),
+                },
+            )
+
+    def _publish_event(self, channel: str, payload: Dict) -> None:
+        """Enqueue onto the single publisher thread: a slow/blackholed
+        KV service must not stall the accept loop (agent registration)
+        or the disconnect path, and one ordered queue keeps node_added
+        before node_removed for the same node. Events are advisory;
+        the fleet keeps working if they are lost."""
+        if self._kv is None:
+            return
+        self._event_queue.put((channel, payload))
+
+    def _event_loop(self):
+        while True:
+            channel, payload = self._event_queue.get()
+            try:
+                self._kv.publish(channel, payload)
+            except Exception:
+                pass
 
     def wait_for_nodes(self, n: int, timeout: float = 60.0) -> List[str]:
         import time
@@ -320,15 +381,17 @@ class ClusterServer:
 
 
 def start_cluster_server(
-    host: str = "127.0.0.1", port: int = 0
+    host: str = "127.0.0.1", port: int = 0, kv_address: Optional[str] = None
 ) -> str:
     """Enable the head's fleet listener; returns 'host:port' for agents
-    to join. Idempotent per runtime."""
+    to join. Idempotent per runtime. ``kv_address`` (or
+    ``RAY_TPU_KV_ADDRESS``) turns on node-lifecycle event publication
+    to that KV service's pubsub."""
     from ray_tpu.core import api
 
     rt = api._require_runtime()
     if getattr(rt, "cluster", None) is None:
-        rt.cluster = ClusterServer(rt, host, port)
+        rt.cluster = ClusterServer(rt, host, port, kv_address=kv_address)
     return rt.cluster.address
 
 
@@ -466,6 +529,14 @@ class NodeAgent:
                 self.runtime.kill_actor(local_id)
 
     def close(self):
+        try:
+            # shutdown() (not just close()) so the FIN goes out even
+            # while _serve_loop is parked in recv on this fd — close()
+            # alone leaves the kernel fd open under the blocked read
+            # and the head never learns the agent left
+            self.sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
         try:
             self.sock.close()
         except OSError:

@@ -23,6 +23,9 @@ def load_experiments(path: str) -> Dict:
 
 
 def main(argv=None) -> int:
+    from ray_tpu.utils.platform import apply_platform_override
+
+    apply_platform_override()
     parser = argparse.ArgumentParser(description="ray_tpu train CLI")
     parser.add_argument(
         "-f", "--file", type=str, default=None,

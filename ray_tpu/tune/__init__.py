@@ -1,6 +1,6 @@
 from ray_tpu.tune.trainable import Trainable
 from ray_tpu.tune.trial import Trial
-from ray_tpu.tune.trial_runner import TrialRunner
+from ray_tpu.tune.tune import TrialRunner
 from ray_tpu.tune.tune import run, ExperimentAnalysis
 from ray_tpu.tune.schedulers import (
     FIFOScheduler,

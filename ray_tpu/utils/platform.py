@@ -1,0 +1,20 @@
+"""Backend platform pinning shared by the CLI entry points.
+
+``RAY_TPU_PLATFORM=cpu`` (or any jax platform name) pins jax before
+the backend initializes. Needed because a deployment's sitecustomize
+may set ``jax.config.jax_platforms`` directly, which bypasses the
+``JAX_PLATFORMS`` env var — e.g. for CPU smoke runs of the train /
+evaluate CLIs on a host whose default backend is a tunneled TPU.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def apply_platform_override() -> None:
+    platform = os.environ.get("RAY_TPU_PLATFORM")
+    if platform:
+        import jax
+
+        jax.config.update("jax_platforms", platform)

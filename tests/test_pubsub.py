@@ -1,0 +1,202 @@
+"""Generalized pubsub fan-out on the KV service.
+
+Reference role: the GCS publisher's long-poll batch pubsub
+(``src/ray/pubsub/publisher.h:298`` bounded per-subscriber buffers,
+``subscriber.h`` long-poll client), scoped to the coordinator-hosted
+KV service: subscribers register channel lists (exact or ``prefix*``),
+publishers fan messages into bounded per-subscriber buffers, and
+long-polls drain them in batches. Node lifecycle events from the
+cluster head ride this channel (``core/cluster.py _publish_event``,
+the RAY_NODE_INFO_CHANNEL role of ``gcs_node_manager.cc``).
+"""
+
+import threading
+import time
+
+import pytest
+
+from ray_tpu.parallel.distributed import KVClient, KVServer, Subscriber
+
+
+@pytest.fixture()
+def kv():
+    server = KVServer()
+    client = KVClient(f"127.0.0.1:{server.port}")
+    yield server, client
+    server.shutdown()
+
+
+def test_publish_fanout_and_poll_batch(kv):
+    _, client = kv
+    client.subscribe("a", ["jobs"])
+    client.subscribe("b", ["jobs", "actors"])
+    assert client.publish("jobs", {"id": 1}) == 2
+    assert client.publish("actors", "spawn") == 1
+    msgs_a, dropped_a = client.poll("a", timeout=2.0)
+    assert msgs_a == [("jobs", {"id": 1})] and dropped_a == 0
+    # b's poll drains BOTH buffered messages in one batch
+    msgs_b, _ = client.poll("b", timeout=2.0)
+    assert msgs_b == [("jobs", {"id": 1}), ("actors", "spawn")]
+
+
+def test_prefix_pattern_and_unsubscribe(kv):
+    _, client = kv
+    client.subscribe("s", ["cluster.*"])
+    client.publish("cluster.node_added", {"node_id": "n1"})
+    client.publish("other", "ignored")
+    msgs, _ = client.poll("s", timeout=2.0)
+    assert msgs == [("cluster.node_added", {"node_id": "n1"})]
+    client.unsubscribe("s")
+    assert client.publish("cluster.node_added", {}) == 0
+    with pytest.raises(KeyError):
+        client.poll("s", timeout=0.1)
+
+
+def test_poll_blocks_until_publish(kv):
+    _, client = kv
+    client.subscribe("s", ["ch"])
+    got = []
+
+    def waiter():
+        got.append(client.poll("s", timeout=5.0))
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    time.sleep(0.2)
+    assert not got  # still parked in the long poll
+    client.publish("ch", 42)
+    t.join(timeout=5.0)
+    assert got and got[0][0] == [("ch", 42)]
+
+
+def test_bounded_buffer_drops_oldest(kv):
+    server, client = kv
+    server.sub_maxlen = 3
+    client.subscribe("slow", ["ch"])
+    for i in range(5):
+        client.publish("ch", i)
+    msgs, dropped = client.poll("slow", timeout=1.0)
+    assert [m for _, m in msgs] == [2, 3, 4] and dropped == 2
+    # drop counter resets after it is reported once
+    client.publish("ch", 9)
+    _, dropped2 = client.poll("slow", timeout=1.0)
+    assert dropped2 == 0
+
+
+def test_subscriber_thread_dispatches(kv):
+    _, client = kv
+    seen = []
+    sub = Subscriber(
+        client, ["evt.*"], lambda ch, m: seen.append((ch, m)),
+        poll_timeout=0.5,
+    )
+    client.publish("evt.a", 1)
+    client.publish("evt.b", 2)
+    deadline = time.time() + 5.0
+    while len(seen) < 2 and time.time() < deadline:
+        time.sleep(0.05)
+    sub.stop()
+    assert seen == [("evt.a", 1), ("evt.b", 2)]
+
+
+def test_token_covers_payload_bytes():
+    """With a token set, the MAC covers the payload via its sha256 in
+    the header — a captured header cannot be replayed with a
+    substituted pickle blob."""
+    import json
+    import socket
+
+    from ray_tpu.parallel.distributed import _request_hmac
+
+    server = KVServer(token="secret")
+    try:
+        client = KVClient(f"127.0.0.1:{server.port}", token="secret")
+        client.subscribe("s", ["ch"])
+        client.publish("ch", "legit")
+        msgs, _ = client.poll("s", timeout=2.0)
+        assert msgs == [("ch", "legit")]
+
+        # forge: valid header/hmac for a 5-byte body, different bytes
+        import pickle
+
+        blob = pickle.dumps("legit")
+        evil = b"x" * len(blob)
+        from ray_tpu.parallel.distributed import _body_digest
+
+        req = {
+            "op": "publish",
+            "channel": "ch",
+            "len": len(blob),
+            "body": _body_digest(blob),
+        }
+        req["hmac"] = _request_hmac("secret", req)
+        with socket.create_connection(
+            ("127.0.0.1", server.port), timeout=5
+        ) as s:
+            f = s.makefile("rwb")
+            f.write(json.dumps(req).encode() + b"\n" + evil)
+            f.flush()
+            resp = json.loads(f.readline())
+        assert resp == {"ok": False, "error": "bad body digest"}
+        msgs, _ = client.poll("s", timeout=0.3)
+        assert msgs == []
+    finally:
+        server.shutdown()
+
+
+def test_subscriber_survives_server_restart():
+    """Subscriptions are volatile across a KV restart; the Subscriber
+    re-registers itself and keeps delivering."""
+    import time as _time
+
+    server = KVServer()
+    client = KVClient(f"127.0.0.1:{server.port}")
+    seen = []
+    sub = Subscriber(
+        client, ["ch"], lambda c, m: seen.append(m), poll_timeout=0.5
+    )
+    client.publish("ch", 1)
+    deadline = _time.time() + 5
+    while not seen and _time.time() < deadline:
+        _time.sleep(0.05)
+    assert seen == [1]
+    port = server.port
+    server.shutdown()
+    server2 = KVServer(port=port)  # same address, empty subs table
+    try:
+        deadline = _time.time() + 10
+        while sub.sub_id not in server2.subs and _time.time() < deadline:
+            _time.sleep(0.1)
+        assert sub.sub_id in server2.subs
+        client.publish("ch", 2)
+        deadline = _time.time() + 5
+        while len(seen) < 2 and _time.time() < deadline:
+            _time.sleep(0.05)
+        assert 2 in seen
+    finally:
+        sub.stop()
+        server2.shutdown()
+
+
+def test_cluster_node_events_ride_pubsub(kv):
+    """The cluster head publishes node_added/node_removed; a subscriber
+    observes an agent joining and leaving the fleet."""
+    import ray_tpu as ray
+    from ray_tpu.core.cluster import NodeAgent, start_cluster_server
+
+    server, client = kv
+    client.subscribe("watch", ["cluster.*"])
+    ray.init(num_cpus=1, ignore_reinit_error=True)
+    try:
+        addr = start_cluster_server(
+            kv_address=f"127.0.0.1:{server.port}"
+        )
+        agent = NodeAgent(addr, num_cpus=1)
+        msgs, _ = client.poll("watch", timeout=5.0)
+        assert msgs[0][0] == "cluster.node_added"
+        assert msgs[0][1]["node_id"] == agent.node_id
+        agent.close()
+        msgs, _ = client.poll("watch", timeout=5.0)
+        assert ("cluster.node_removed", {"node_id": agent.node_id}) in msgs
+    finally:
+        ray.shutdown()
