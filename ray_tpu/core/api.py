@@ -768,6 +768,13 @@ class _Runtime:
             finally:
                 self.state_store.close()
                 self.state_store = None
+        dash = getattr(self, "dashboard", None)
+        if dash is not None:
+            try:
+                dash.shutdown()
+            except Exception:
+                pass
+            self.dashboard = None
 
 
 class _UnreadyDep(Exception):
@@ -819,6 +826,14 @@ def init(
     state_path = kwargs.get("state_path")
     if state_path and _runtime.state_store is None:
         _runtime._open_state_store(state_path)
+    if kwargs.get("dashboard"):
+        from ray_tpu.dashboard.dashboard import DashboardLite
+        from ray_tpu.job.job_manager import JobManager
+
+        _runtime.dashboard = DashboardLite(
+            port=int(kwargs.get("dashboard_port") or 0),
+            job_manager=JobManager(state_path=state_path),
+        )
     if address and address not in ("local", "auto"):
         from ray_tpu.core.cluster import NodeAgent
 

@@ -83,17 +83,93 @@ _INDEX_HTML = """<!doctype html>
 <li><a href="/api/timeline">/api/timeline</a> — chrome-trace events
  (load in chrome://tracing)</li>
 <li><a href="/metrics">/metrics</a> — Prometheus metrics</li>
+<li><a href="/api/jobs">/api/jobs</a> — submitted jobs (POST to
+ submit; /api/jobs/&lt;id&gt;, /&lt;id&gt;/logs, POST /&lt;id&gt;/stop)</li>
 </ul>
 </body></html>"""
 
 
 class DashboardLite:
-    """reference dashboard/head.py:59, scoped to one host."""
+    """reference dashboard/head.py:59, scoped to one host. Includes
+    the job-submission REST surface (reference
+    ``dashboard/modules/job/job_head.py``): POST /api/jobs submits,
+    GET /api/jobs lists, GET /api/jobs/<id> gets status, GET
+    /api/jobs/<id>/logs streams captured output, POST
+    /api/jobs/<id>/stop stops."""
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+    def __init__(
+        self, host: str = "127.0.0.1", port: int = 0, job_manager=None
+    ):
+        dash = self
+
         class Handler(BaseHTTPRequestHandler):
             def log_message(self, *args):
                 pass
+
+            def _reply(self, code: int, blob: bytes, ctype: str):
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(blob)))
+                self.end_headers()
+                self.wfile.write(blob)
+
+            def do_POST(self):
+                path = self.path.rstrip("/")
+                n = int(self.headers.get("Content-Length") or 0)
+                body = self.rfile.read(n) if n else b"{}"
+                try:
+                    req = json.loads(body or b"{}")
+                    jm = dash.job_manager
+                    if path == "/api/jobs":
+                        packed = req.get("packed_runtime_env")
+                        if packed and packed.get("archives"):
+                            import base64
+
+                            packed = dict(
+                                packed,
+                                archives=[
+                                    {
+                                        **a,
+                                        "data": base64.b64decode(
+                                            a["data"]
+                                        ),
+                                    }
+                                    for a in packed["archives"]
+                                ],
+                            )
+                        sid = jm.submit_job(
+                            req["entrypoint"],
+                            runtime_env=req.get("runtime_env"),
+                            submission_id=req.get("submission_id"),
+                            metadata=req.get("metadata"),
+                            packed_runtime_env=packed,
+                        )
+                        blob = json.dumps(
+                            {"submission_id": sid}
+                        ).encode()
+                    elif path.startswith("/api/jobs/") and path.endswith(
+                        "/stop"
+                    ):
+                        sid = path[len("/api/jobs/"):-len("/stop")]
+                        blob = json.dumps(
+                            {"stopped": jm.stop_job(sid)}
+                        ).encode()
+                    else:
+                        self._reply(404, b"{}", "application/json")
+                        return
+                    self._reply(200, blob, "application/json")
+                except KeyError as e:
+                    self._reply(
+                        404,
+                        json.dumps({"error": repr(e)}).encode(),
+                        "application/json",
+                    )
+                except Exception as e:
+                    self._reply(
+                        500,
+                        json.dumps({"error": repr(e)}).encode(),
+                        "application/json",
+                    )
 
             def do_GET(self):
                 path = self.path.rstrip("/")
@@ -101,6 +177,37 @@ class DashboardLite:
                     if path in ("", "/index.html"):
                         blob = _INDEX_HTML.encode()
                         ctype = "text/html"
+                    elif path == "/api/jobs":
+                        blob = json.dumps(
+                            [
+                                j.to_dict()
+                                for j in dash.job_manager.list_jobs()
+                            ]
+                        ).encode()
+                        ctype = "application/json"
+                    elif path.startswith("/api/jobs/"):
+                        sid = path[len("/api/jobs/"):]
+                        try:
+                            if sid.endswith("/logs"):
+                                logs = dash.job_manager.get_job_logs(
+                                    sid[: -len("/logs")]
+                                )
+                                blob = json.dumps(
+                                    {"logs": logs}
+                                ).encode()
+                            else:
+                                blob = json.dumps(
+                                    dash.job_manager.get_job_info(
+                                        sid
+                                    ).to_dict()
+                                ).encode()
+                        except KeyError as e:
+                            blob = json.dumps(
+                                {"error": repr(e)}
+                            ).encode()
+                            self._reply(404, blob, "application/json")
+                            return
+                        ctype = "application/json"
                     elif path == "/api/cluster":
                         blob = json.dumps(_cluster_state()).encode()
                         ctype = "application/json"
@@ -134,6 +241,8 @@ class DashboardLite:
                 self.end_headers()
                 self.wfile.write(blob)
 
+        self._job_manager = job_manager
+        self._job_lock = threading.Lock()
         self._server = ThreadingHTTPServer((host, port), Handler)
         self._server.daemon_threads = True
         self.port = self._server.server_address[1]
@@ -143,6 +252,18 @@ class DashboardLite:
         )
         self._thread.start()
 
+    @property
+    def job_manager(self):
+        if self._job_manager is None:
+            with self._job_lock:
+                if self._job_manager is None:
+                    from ray_tpu.job.job_manager import JobManager
+
+                    self._job_manager = JobManager()
+        return self._job_manager
+
     def shutdown(self) -> None:
         self._server.shutdown()
         self._server.server_close()
+        if self._job_manager is not None:
+            self._job_manager.shutdown()

@@ -1,0 +1,9 @@
+from ray_tpu.job.job_manager import JobInfo, JobManager, JobStatus
+from ray_tpu.job.client import JobSubmissionClient
+
+__all__ = [
+    "JobInfo",
+    "JobManager",
+    "JobStatus",
+    "JobSubmissionClient",
+]

@@ -1,0 +1,98 @@
+"""``python -m ray_tpu.job`` — job submission CLI.
+
+Counterpart of the reference's ``ray job submit/status/logs/list/stop``
+(``dashboard/modules/job/cli.py``), talking to a head's dashboard URL.
+
+    python -m ray_tpu.job submit --address http://head:8265 \
+        --working-dir ./proj -- python train_script.py
+    python -m ray_tpu.job status --address ... <submission_id>
+    python -m ray_tpu.job logs --address ... <submission_id>
+    python -m ray_tpu.job list --address ...
+    python -m ray_tpu.job stop --address ... <submission_id>
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import shlex
+import sys
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m ray_tpu.job", description="ray_tpu job CLI"
+    )
+    parser.add_argument(
+        "--address",
+        default="http://127.0.0.1:8265",
+        help="dashboard URL of the head",
+    )
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    p_submit = sub.add_parser("submit")
+    p_submit.add_argument("--working-dir", default=None)
+    p_submit.add_argument(
+        "--runtime-env-json", default=None,
+        help='full runtime_env as json, e.g. \'{"env_vars": {...}}\'',
+    )
+    p_submit.add_argument("--submission-id", default=None)
+    p_submit.add_argument(
+        "--no-wait", action="store_true",
+        help="return immediately instead of tailing to completion",
+    )
+    p_submit.add_argument("entrypoint", nargs=argparse.REMAINDER)
+
+    for name in ("status", "logs", "stop"):
+        p = sub.add_parser(name)
+        p.add_argument("submission_id")
+    sub.add_parser("list")
+
+    args = parser.parse_args(argv)
+    from ray_tpu.job.client import JobSubmissionClient
+
+    client = JobSubmissionClient(args.address)
+
+    if args.cmd == "submit":
+        entry = args.entrypoint
+        if entry and entry[0] == "--":
+            entry = entry[1:]
+        if not entry:
+            parser.error("no entrypoint given (after --)")
+        runtime_env = (
+            json.loads(args.runtime_env_json)
+            if args.runtime_env_json
+            else {}
+        )
+        if args.working_dir:
+            runtime_env["working_dir"] = args.working_dir
+        sid = client.submit_job(
+            shlex.join(entry),
+            runtime_env=runtime_env or None,
+            submission_id=args.submission_id,
+        )
+        print(f"submitted: {sid}")
+        if args.no_wait:
+            return 0
+        info = client.wait_until_terminal(sid)
+        sys.stdout.write(client.get_job_logs(sid))
+        print(f"status: {info['status']}")
+        return 0 if info["status"] == "SUCCEEDED" else 1
+    if args.cmd == "status":
+        print(json.dumps(client.get_job_info(args.submission_id)))
+        return 0
+    if args.cmd == "logs":
+        sys.stdout.write(client.get_job_logs(args.submission_id))
+        return 0
+    if args.cmd == "stop":
+        stopped = client.stop_job(args.submission_id)
+        print(f"stopped: {stopped}")
+        return 0
+    if args.cmd == "list":
+        print(json.dumps(client.list_jobs(), indent=2))
+        return 0
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

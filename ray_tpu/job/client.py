@@ -1,0 +1,118 @@
+"""HTTP client for the job-submission REST surface.
+
+Counterpart of the reference's ``ray.job_submission.JobSubmissionClient``
+(``dashboard/modules/job/sdk.py``): talks to a head's dashboard
+(``DashboardLite``) over plain HTTP with stdlib urllib — jobs can be
+submitted, listed, tailed, and stopped from any machine that can reach
+the dashboard port.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.request
+from typing import Dict, List, Optional
+
+
+class JobSubmissionClient:
+    def __init__(self, address: str):
+        """``address`` is the dashboard URL, e.g.
+        ``http://127.0.0.1:8265`` (scheme optional)."""
+        if "://" not in address:
+            address = f"http://{address}"
+        self.address = address.rstrip("/")
+
+    def _request(
+        self, path: str, payload: Optional[Dict] = None
+    ) -> Dict:
+        url = f"{self.address}{path}"
+        data = (
+            json.dumps(payload).encode() if payload is not None else None
+        )
+        req = urllib.request.Request(
+            url,
+            data=data,
+            method="POST" if data is not None else "GET",
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=30.0) as resp:
+                return json.loads(resp.read())
+        except urllib.error.HTTPError as e:
+            detail = e.read().decode(errors="replace")
+            if e.code == 404:
+                raise KeyError(detail) from None
+            raise RuntimeError(
+                f"job API {path} failed ({e.code}): {detail}"
+            ) from None
+
+    def submit_job(
+        self,
+        entrypoint: str,
+        runtime_env: Optional[Dict] = None,
+        submission_id: Optional[str] = None,
+        metadata: Optional[Dict[str, str]] = None,
+    ) -> str:
+        payload: Dict = {"entrypoint": entrypoint}
+        if runtime_env:
+            # pack CLIENT-side so working_dir/py_modules paths resolve
+            # on this machine, then ship the content-addressed archives
+            # in the request (the reference uploads working_dir
+            # packages to the GCS the same way, sdk.py upload_*)
+            import base64
+
+            from ray_tpu.core.runtime_env import pack_runtime_env
+
+            packed = pack_runtime_env(runtime_env) or {}
+            wire = {
+                k: v for k, v in packed.items() if k != "archives"
+            }
+            if packed.get("archives"):
+                wire["archives"] = [
+                    {
+                        **a,
+                        "data": base64.b64encode(a["data"]).decode(),
+                    }
+                    for a in packed["archives"]
+                ]
+            payload["packed_runtime_env"] = wire
+        if submission_id:
+            payload["submission_id"] = submission_id
+        if metadata:
+            payload["metadata"] = metadata
+        return self._request("/api/jobs", payload)["submission_id"]
+
+    def list_jobs(self) -> List[Dict]:
+        return self._request("/api/jobs")
+
+    def get_job_info(self, submission_id: str) -> Dict:
+        return self._request(f"/api/jobs/{submission_id}")
+
+    def get_job_status(self, submission_id: str) -> str:
+        return self.get_job_info(submission_id)["status"]
+
+    def get_job_logs(self, submission_id: str) -> str:
+        return self._request(f"/api/jobs/{submission_id}/logs")["logs"]
+
+    def stop_job(self, submission_id: str) -> bool:
+        return self._request(
+            f"/api/jobs/{submission_id}/stop", payload={}
+        )["stopped"]
+
+    def wait_until_terminal(
+        self, submission_id: str, timeout: float = 300.0
+    ) -> Dict:
+        import time
+
+        from ray_tpu.job.job_manager import JobStatus
+
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            info = self.get_job_info(submission_id)
+            if info["status"] in JobStatus.TERMINAL:
+                return info
+            time.sleep(0.2)
+        raise TimeoutError(
+            f"job {submission_id} not terminal within {timeout}s"
+        )

@@ -1,0 +1,326 @@
+"""Driver-side job manager: submitted entrypoints as supervised
+subprocesses.
+
+Counterpart of the reference's job submission stack
+(``dashboard/modules/job/job_manager.py`` JobManager,
+``job_head.py`` REST handlers): a job is a shell entrypoint run in its
+own process with a runtime_env applied, its output captured to a
+per-job log file, and its lifecycle tracked through the standard
+status machine (PENDING → RUNNING → SUCCEEDED/FAILED/STOPPED).
+
+TPU-first disposition: the reference runs each job through a
+JobSupervisor actor so the job can land on any node of the cluster;
+here the head host owns the chip, so jobs run as direct child
+processes of the head — same lifecycle surface, no actor hop. The
+job table persists through the pluggable store client
+(``core/store_client.py``) when a state path is configured, so a
+restarted head still lists finished jobs (reference: job table in the
+GCS, recovered from Redis).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import threading
+import time
+import uuid
+from dataclasses import asdict, dataclass, field
+from typing import Dict, List, Optional
+
+
+class JobStatus:
+    """reference ``job/common.py JobStatus``."""
+
+    PENDING = "PENDING"
+    RUNNING = "RUNNING"
+    STOPPED = "STOPPED"
+    SUCCEEDED = "SUCCEEDED"
+    FAILED = "FAILED"
+
+    TERMINAL = (STOPPED, SUCCEEDED, FAILED)
+
+
+@dataclass
+class JobInfo:
+    """reference ``job/common.py JobInfo``."""
+
+    submission_id: str
+    entrypoint: str
+    status: str = JobStatus.PENDING
+    message: str = ""
+    metadata: Dict[str, str] = field(default_factory=dict)
+    start_time: Optional[float] = None
+    end_time: Optional[float] = None
+    driver_exit_code: Optional[int] = None
+
+    def to_dict(self) -> Dict:
+        return asdict(self)
+
+
+class JobManager:
+    """Submit/supervise/stop jobs; one per head process."""
+
+    def __init__(
+        self,
+        log_dir: Optional[str] = None,
+        state_path: Optional[str] = None,
+    ):
+        import tempfile
+
+        self.log_dir = log_dir or os.path.join(
+            tempfile.gettempdir(), "ray_tpu_jobs"
+        )
+        os.makedirs(self.log_dir, exist_ok=True)
+        self.lock = threading.Lock()
+        self.jobs: Dict[str, JobInfo] = {}
+        self._procs: Dict[str, subprocess.Popen] = {}
+        self._store = None
+        state_path = state_path or os.environ.get("RAY_TPU_JOB_STATE")
+        if state_path:
+            from ray_tpu.core.store_client import make_store_client
+
+            self._store = make_store_client(state_path)
+            for blob in self._store.all("submissions").values():
+                info = JobInfo(**json.loads(blob))
+                if info.status not in JobStatus.TERMINAL:
+                    # the supervising process died with the old head;
+                    # the reference marks such jobs FAILED on recovery
+                    info.status = JobStatus.FAILED
+                    info.message = "head restarted while job was running"
+                self.jobs[info.submission_id] = info
+
+    # -- submission ------------------------------------------------------
+
+    def submit_job(
+        self,
+        entrypoint: str,
+        runtime_env: Optional[Dict] = None,
+        submission_id: Optional[str] = None,
+        metadata: Optional[Dict[str, str]] = None,
+        packed_runtime_env: Optional[Dict] = None,
+    ) -> str:
+        """Start ``entrypoint`` as a supervised subprocess; returns the
+        submission id (reference ``job_manager.py submit_job``).
+        ``runtime_env`` is a spec with paths local to THIS host;
+        ``packed_runtime_env`` is an already-packed env (archives
+        inline) as shipped by a remote ``JobSubmissionClient``."""
+        submission_id = submission_id or f"raysubmit_{uuid.uuid4().hex[:12]}"
+        with self.lock:
+            if submission_id in self.jobs:
+                raise ValueError(
+                    f"job {submission_id!r} already submitted"
+                )
+            info = JobInfo(
+                submission_id=submission_id,
+                entrypoint=entrypoint,
+                metadata=dict(metadata or {}),
+            )
+            self.jobs[submission_id] = info
+        self._persist(info)
+        env = dict(os.environ)
+        env["RAY_TPU_JOB_ID"] = submission_id
+        cwd = None
+        packed = packed_runtime_env
+        if runtime_env and packed is None:
+            from ray_tpu.core.runtime_env import pack_runtime_env
+
+            packed = pack_runtime_env(runtime_env)
+        if packed:
+            env.update(packed.get("env_vars") or {})
+            cwd, extra_paths = self._materialize(packed)
+            if extra_paths:
+                env["PYTHONPATH"] = os.pathsep.join(
+                    extra_paths
+                    + [p for p in env.get("PYTHONPATH", "").split(
+                        os.pathsep
+                    ) if p]
+                )
+        log_path = self.log_path(submission_id)
+        try:
+            log_f = open(log_path, "wb")
+            proc = subprocess.Popen(
+                entrypoint,
+                shell=True,
+                stdout=log_f,
+                stderr=subprocess.STDOUT,
+                env=env,
+                cwd=cwd,
+                start_new_session=True,  # signal the whole job group
+            )
+        except Exception as e:
+            with self.lock:
+                info.status = JobStatus.FAILED
+                info.message = f"failed to start: {e!r}"
+                info.end_time = time.time()
+            self._persist(info)
+            return submission_id
+        with self.lock:
+            info.status = JobStatus.RUNNING
+            info.start_time = time.time()
+            self._procs[submission_id] = proc
+        self._persist(info)
+        threading.Thread(
+            target=self._supervise,
+            args=(submission_id, proc, log_f),
+            daemon=True,
+            name=f"job_supervisor_{submission_id}",
+        ).start()
+        return submission_id
+
+    def _materialize(self, packed: Dict):
+        """Extract working_dir / py_modules archives for the job
+        subprocess (same per-host content-addressed cache as
+        task/actor runtime envs). working_dir becomes the job's cwd;
+        py_modules land on its PYTHONPATH."""
+        from ray_tpu.core.runtime_env import _cache_root, _extract
+
+        cwd = None
+        extra = []
+        for archive in packed.get("archives") or []:
+            dest = _extract(archive)
+            if archive["kind"] == "working_dir":
+                cwd = dest
+                extra.insert(0, dest)
+            else:
+                # the module dir itself must be importable by name:
+                # expose it via a parent dir holding a named symlink
+                # (mirrors apply_runtime_env's py_module path)
+                parent = os.path.join(
+                    _cache_root(), f"mods_{archive['hash']}"
+                )
+                link = os.path.join(parent, archive["name"])
+                os.makedirs(parent, exist_ok=True)
+                if not os.path.exists(link):
+                    try:
+                        os.symlink(dest, link)
+                    except OSError:
+                        pass
+                extra.append(parent)
+        return cwd, extra
+
+    def _supervise(self, submission_id: str, proc, log_f):
+        rc = proc.wait()
+        try:
+            log_f.close()
+        except Exception:
+            pass
+        with self.lock:
+            info = self.jobs[submission_id]
+            self._procs.pop(submission_id, None)
+            if info.status == JobStatus.STOPPED:
+                pass  # stop_job already wrote the terminal state
+            elif rc == 0:
+                info.status = JobStatus.SUCCEEDED
+            else:
+                info.status = JobStatus.FAILED
+                info.message = f"entrypoint exited with code {rc}"
+            info.driver_exit_code = rc
+            info.end_time = time.time()
+        self._persist(info)
+
+    # -- queries ---------------------------------------------------------
+
+    def get_job_status(self, submission_id: str) -> str:
+        return self._get(submission_id).status
+
+    def get_job_info(self, submission_id: str) -> JobInfo:
+        return self._get(submission_id)
+
+    def list_jobs(self) -> List[JobInfo]:
+        with self.lock:
+            return list(self.jobs.values())
+
+    def log_path(self, submission_id: str) -> str:
+        return os.path.join(self.log_dir, f"{submission_id}.log")
+
+    def get_job_logs(self, submission_id: str) -> str:
+        self._get(submission_id)  # raises on unknown id
+        try:
+            with open(self.log_path(submission_id), "rb") as f:
+                return f.read().decode(errors="replace")
+        except FileNotFoundError:
+            return ""
+
+    def _get(self, submission_id: str) -> JobInfo:
+        with self.lock:
+            if submission_id not in self.jobs:
+                raise KeyError(f"no such job: {submission_id}")
+            return self.jobs[submission_id]
+
+    # -- control ---------------------------------------------------------
+
+    def stop_job(self, submission_id: str, grace_s: float = 3.0) -> bool:
+        """SIGTERM the job's process group, escalate to SIGKILL after
+        ``grace_s`` (reference ``job_manager.py stop_job``'s
+        SIGTERM→SIGKILL ladder). Returns False if already terminal."""
+        with self.lock:
+            info = self._get_locked(submission_id)
+            proc = self._procs.get(submission_id)
+            if info.status in JobStatus.TERMINAL or proc is None:
+                return False
+            info.status = JobStatus.STOPPED
+            info.message = "stopped by user"
+        self._persist(info)
+        try:
+            os.killpg(os.getpgid(proc.pid), signal.SIGTERM)
+        except (ProcessLookupError, PermissionError):
+            return True
+        deadline = time.time() + grace_s
+        while time.time() < deadline:
+            if proc.poll() is not None:
+                return True
+            time.sleep(0.05)
+        try:
+            os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            pass
+        return True
+
+    def _get_locked(self, submission_id: str) -> JobInfo:
+        if submission_id not in self.jobs:
+            raise KeyError(f"no such job: {submission_id}")
+        return self.jobs[submission_id]
+
+    def wait(
+        self, submission_id: str, timeout: float = 60.0
+    ) -> JobInfo:
+        """Block until the job reaches a terminal status."""
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            info = self._get(submission_id)
+            if info.status in JobStatus.TERMINAL:
+                return info
+            time.sleep(0.05)
+        raise TimeoutError(
+            f"job {submission_id} not terminal within {timeout}s"
+        )
+
+    def _persist(self, info: JobInfo) -> None:
+        if self._store is None:
+            return
+        try:
+            # "submissions", not "jobs": the runtime's driver-session
+            # records own the "jobs" table in a shared state store
+            self._store.put(
+                "submissions",
+                info.submission_id,
+                json.dumps(info.to_dict()).encode(),
+            )
+        except Exception:
+            # a broken/closed state store must not take down job
+            # supervision or stop_job — persistence is best-effort
+            pass
+
+    def shutdown(self) -> None:
+        with self.lock:
+            procs = list(self._procs.items())
+        for sid, _ in procs:
+            try:
+                self.stop_job(sid)
+            except Exception:
+                pass
+        if self._store is not None:
+            self._store.close()

@@ -1,0 +1,196 @@
+"""Job submission: manager lifecycle, REST surface, client, CLI.
+
+Reference strategy: ``dashboard/modules/job/tests/test_job_manager.py``
+(+ ``test_http_job_server.py``) — submit entrypoints as supervised
+subprocesses, drive the status machine PENDING→RUNNING→terminal,
+capture logs, stop with SIGTERM→SIGKILL escalation, apply runtime_env,
+and survive a head restart with the job table intact.
+"""
+
+import json
+import os
+import sys
+
+import pytest
+
+from ray_tpu.job import JobManager, JobStatus, JobSubmissionClient
+
+
+@pytest.fixture()
+def jm(tmp_path):
+    m = JobManager(log_dir=str(tmp_path / "logs"))
+    yield m
+    m.shutdown()
+
+
+def test_job_succeeds_and_logs(jm):
+    sid = jm.submit_job(f"{sys.executable} -c \"print('hello job')\"")
+    info = jm.wait(sid, timeout=60)
+    assert info.status == JobStatus.SUCCEEDED
+    assert info.driver_exit_code == 0
+    assert "hello job" in jm.get_job_logs(sid)
+    assert info.start_time is not None and info.end_time is not None
+
+
+def test_job_failure_captures_exit_code(jm):
+    sid = jm.submit_job(f"{sys.executable} -c 'raise SystemExit(3)'")
+    info = jm.wait(sid, timeout=60)
+    assert info.status == JobStatus.FAILED
+    assert info.driver_exit_code == 3
+    assert "code 3" in info.message
+
+
+def test_stop_job_terminates(jm):
+    sid = jm.submit_job(
+        f"{sys.executable} -c 'import time; time.sleep(600)'"
+    )
+    assert jm.get_job_status(sid) == JobStatus.RUNNING
+    assert jm.stop_job(sid)
+    info = jm.wait(sid, timeout=30)
+    assert info.status == JobStatus.STOPPED
+    # stopping a terminal job is a no-op
+    assert not jm.stop_job(sid)
+
+
+def test_runtime_env_vars_and_working_dir(jm, tmp_path):
+    proj = tmp_path / "proj"
+    proj.mkdir()
+    (proj / "data.txt").write_text("payload")
+    sid = jm.submit_job(
+        f"{sys.executable} -c \"import os; "
+        "print(os.environ['JOB_FLAG'], "
+        "open('data.txt').read())\"",
+        runtime_env={
+            "env_vars": {"JOB_FLAG": "on"},
+            "working_dir": str(proj),
+        },
+    )
+    info = jm.wait(sid, timeout=60)
+    assert info.status == JobStatus.SUCCEEDED, jm.get_job_logs(sid)
+    assert "on payload" in jm.get_job_logs(sid)
+
+
+def test_job_table_survives_restart(tmp_path):
+    state = str(tmp_path / "jobs.db")
+    m1 = JobManager(log_dir=str(tmp_path / "l1"), state_path=state)
+    ok = m1.submit_job(f"{sys.executable} -c 'print(1)'")
+    m1.wait(ok, timeout=60)
+    running = m1.submit_job(
+        f"{sys.executable} -c 'import time; time.sleep(600)'"
+    )
+    m1.stop_job(running)
+    m1.wait(running, timeout=30)
+    hung = m1.submit_job(
+        f"{sys.executable} -c 'import time; time.sleep(600)'"
+    )
+    # head dies without stopping `hung`; new manager recovers the table
+    m1._store.close()
+    m2 = JobManager(log_dir=str(tmp_path / "l2"), state_path=state)
+    try:
+        assert m2.get_job_status(ok) == JobStatus.SUCCEEDED
+        assert m2.get_job_status(running) == JobStatus.STOPPED
+        # non-terminal at crash time -> FAILED on recovery
+        assert m2.get_job_status(hung) == JobStatus.FAILED
+        assert "head restarted" in m2.get_job_info(hung).message
+    finally:
+        m1.stop_job(hung)
+        m2.shutdown()
+
+
+def test_rest_client_end_to_end(tmp_path):
+    from ray_tpu.dashboard.dashboard import DashboardLite
+
+    dash = DashboardLite(
+        job_manager=JobManager(log_dir=str(tmp_path / "logs"))
+    )
+    try:
+        client = JobSubmissionClient(f"127.0.0.1:{dash.port}")
+        proj = tmp_path / "proj"
+        proj.mkdir()
+        (proj / "main.py").write_text(
+            "import os\nprint('ran', os.environ.get('K'))\n"
+        )
+        sid = client.submit_job(
+            f"{sys.executable} main.py",
+            runtime_env={
+                "working_dir": str(proj),
+                "env_vars": {"K": "v"},
+            },
+            metadata={"who": "test"},
+        )
+        info = client.wait_until_terminal(sid, timeout=60)
+        assert info["status"] == JobStatus.SUCCEEDED
+        assert info["metadata"] == {"who": "test"}
+        assert "ran v" in client.get_job_logs(sid)
+        assert any(
+            j["submission_id"] == sid for j in client.list_jobs()
+        )
+        with pytest.raises(KeyError):
+            client.get_job_status("nope")
+    finally:
+        dash.shutdown()
+
+
+def test_rest_stop_and_duplicate_id(tmp_path):
+    from ray_tpu.dashboard.dashboard import DashboardLite
+
+    dash = DashboardLite(
+        job_manager=JobManager(log_dir=str(tmp_path / "logs"))
+    )
+    try:
+        client = JobSubmissionClient(f"http://127.0.0.1:{dash.port}")
+        sid = client.submit_job(
+            f"{sys.executable} -c 'import time; time.sleep(600)'",
+            submission_id="fixed_id",
+        )
+        assert sid == "fixed_id"
+        with pytest.raises(RuntimeError):
+            client.submit_job("true", submission_id="fixed_id")
+        assert client.stop_job(sid)
+        info = client.wait_until_terminal(sid, timeout=30)
+        assert info["status"] == JobStatus.STOPPED
+    finally:
+        dash.shutdown()
+
+
+def test_init_dashboard_serves_jobs(tmp_path):
+    """ray.init(dashboard=True) exposes the job REST surface and
+    tears it down on shutdown."""
+    import ray_tpu as ray
+
+    ray.shutdown()
+    ray.init(num_cpus=1, dashboard=True)
+    try:
+        from ray_tpu.core import api
+
+        dash = api._require_runtime().dashboard
+        client = JobSubmissionClient(f"127.0.0.1:{dash.port}")
+        sid = client.submit_job(f"{sys.executable} -c 'print(7)'")
+        info = client.wait_until_terminal(sid, timeout=60)
+        assert info["status"] == JobStatus.SUCCEEDED
+    finally:
+        ray.shutdown()
+
+
+def test_cli_submit_waits_and_propagates_status(tmp_path, capsys):
+    from ray_tpu.dashboard.dashboard import DashboardLite
+    from ray_tpu.job.__main__ import main as job_cli
+
+    dash = DashboardLite(
+        job_manager=JobManager(log_dir=str(tmp_path / "logs"))
+    )
+    try:
+        addr = f"http://127.0.0.1:{dash.port}"
+        rc = job_cli(
+            ["--address", addr, "submit", "--",
+             sys.executable, "-c", "print('cli ok')"]
+        )
+        out = capsys.readouterr().out
+        assert rc == 0 and "cli ok" in out and "SUCCEEDED" in out
+        rc = job_cli(
+            ["--address", addr, "submit", "--",
+             sys.executable, "-c", "raise SystemExit(2)"]
+        )
+        assert rc == 1
+    finally:
+        dash.shutdown()
