@@ -33,6 +33,7 @@ from ray_tpu.core.object_store import (
     ObjectRef,
     ObjectStore,
     RayActorError,
+    RayOutOfMemoryError,
     RayTaskError,
     WorkerCrashedError,
 )
@@ -295,13 +296,19 @@ class _Runtime:
                     break
         if self.shutting_down:
             return
+        oom_reason = getattr(w, "oom_reason", None)
         for trec in inflight:
             if trec.retries_left > 0 and trec.msg["type"] == "task":
                 trec.retries_left -= 1
                 self._enqueue(trec)
             else:
                 err: BaseException
-                if actor_rec is not None:
+                if oom_reason is not None:
+                    err = RayOutOfMemoryError(
+                        f"Task {trec.name} was killed by the memory "
+                        f"monitor.\n{oom_reason}"
+                    )
+                elif actor_rec is not None:
                     err = RayActorError(
                         f"Actor {actor_rec.actor_id} died executing "
                         f"{trec.name}"
@@ -768,6 +775,10 @@ class _Runtime:
             finally:
                 self.state_store.close()
                 self.state_store = None
+        mon = getattr(self, "memory_monitor", None)
+        if mon is not None:
+            mon.stop()
+            self.memory_monitor = None
         dash = getattr(self, "dashboard", None)
         if dash is not None:
             try:
@@ -826,6 +837,13 @@ def init(
     state_path = kwargs.get("state_path")
     if state_path and _runtime.state_store is None:
         _runtime._open_state_store(state_path)
+    if (
+        kwargs.get("enable_memory_monitor")
+        or os.environ.get("RAY_TPU_MEMORY_MONITOR") == "1"
+    ):
+        from ray_tpu.core.memory_monitor import MemoryMonitor
+
+        _runtime.memory_monitor = MemoryMonitor(_runtime)
     if kwargs.get("dashboard"):
         from ray_tpu.dashboard.dashboard import DashboardLite
         from ray_tpu.job.job_manager import JobManager

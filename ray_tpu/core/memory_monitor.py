@@ -1,0 +1,169 @@
+"""Node memory monitor: kill workers under memory pressure instead of
+letting the OS OOM-killer take down the whole node.
+
+Counterpart of the reference's memory monitor + worker killing policy
+(``python/ray/_private/memory_monitor.py`` /proc-based usage readings,
+``src/ray/raylet/worker_killing_policy_group_by_owner.cc`` — under
+pressure, kill the LAST-started task first and prefer retriable work,
+so long-running computation is protected and the node relieves itself
+with the least lost progress).
+
+Scoped to the single-host runtime: one polling thread on the driver
+reads ``/proc/meminfo`` and per-worker RSS; when usage crosses the
+threshold it terminates the chosen worker's process. The normal
+worker-death path then retries the task (if retries remain) or fails
+it with :class:`RayOutOfMemoryError` carrying the usage breakdown.
+Enabled via ``ray.init(enable_memory_monitor=True)`` or
+``RAY_TPU_MEMORY_MONITOR=1``; threshold via
+``RAY_TPU_MEMORY_THRESHOLD`` (fraction of MemTotal, default 0.95).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Callable, List, Optional, Tuple
+
+
+def node_memory() -> Tuple[int, int]:
+    """(used_bytes, total_bytes) from /proc/meminfo, counting
+    reclaimable memory as free (MemAvailable, like the reference's
+    psutil path)."""
+    total = avail = 0
+    with open("/proc/meminfo") as f:
+        for line in f:
+            if line.startswith("MemTotal:"):
+                total = int(line.split()[1]) * 1024
+            elif line.startswith("MemAvailable:"):
+                avail = int(line.split()[1]) * 1024
+            if total and avail:
+                break
+    return total - avail, total
+
+
+def process_rss(pid: int) -> int:
+    """Resident set size of one process in bytes (0 if gone)."""
+    try:
+        with open(f"/proc/{pid}/statm") as f:
+            return int(f.read().split()[1]) * os.sysconf("SC_PAGE_SIZE")
+    except (FileNotFoundError, ProcessLookupError, ValueError, OSError):
+        return 0
+
+
+class MemoryMonitor:
+    """One per runtime; ``reader`` is injectable for tests."""
+
+    def __init__(
+        self,
+        runtime,
+        threshold: Optional[float] = None,
+        interval_s: float = 1.0,
+        reader: Optional[Callable[[], Tuple[int, int]]] = None,
+        start: bool = True,
+    ):
+        self.runtime = runtime
+        self.threshold = float(
+            threshold
+            if threshold is not None
+            else os.environ.get("RAY_TPU_MEMORY_THRESHOLD", 0.95)
+        )
+        self.interval_s = interval_s
+        self.reader = reader or node_memory
+        self.kills = 0
+        self._stop = threading.Event()
+        self._thread = None
+        if start:
+            self._thread = threading.Thread(
+                target=self._run, daemon=True, name="memory_monitor"
+            )
+            self._thread.start()
+
+    def _run(self):
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.check_once()
+            except Exception:
+                pass  # monitoring must never take down the driver
+
+    def stop(self):
+        self._stop.set()
+
+    # -- one sweep -------------------------------------------------------
+
+    def check_once(self) -> Optional[str]:
+        """If over threshold, kill one victim worker; returns its
+        worker_id (or None if below threshold / no candidate)."""
+        used, total = self.reader()
+        if total <= 0 or used < self.threshold * total:
+            return None
+        victim, started = self._pick_victim()
+        if victim is None:
+            return None
+        usage = self._usage_report(used, total)
+        victim.oom_reason = (
+            f"node memory pressure: {used / 2**30:.2f}/"
+            f"{total / 2**30:.2f} GiB used "
+            f"({100.0 * used / total:.1f}% >= threshold "
+            f"{100.0 * self.threshold:.0f}%). Killed worker "
+            f"{victim.worker_id} (newest task, started "
+            f"{time.time() - started:.1f}s ago) to relieve pressure.\n"
+            f"{usage}"
+        )
+        self.kills += 1
+        try:
+            victim.proc.terminate()
+        except Exception:
+            pass
+        return victim.worker_id
+
+    def _pick_victim(self):
+        """The reference's group-by-owner policy, scoped: among busy
+        POOL workers (plain tasks — retriable, cheapest to lose), the
+        one whose running task started LAST; actors only if no task
+        worker qualifies (restartable actors first)."""
+        rt = self.runtime
+        with rt.lock:
+            best, best_t = None, -1.0
+            for w in rt.pool:
+                if w.dead or not w.inflight:
+                    continue
+                started = max(
+                    t.submit_time for t in w.inflight.values()
+                )
+                if started > best_t:
+                    best, best_t = w, started
+            if best is not None:
+                return best, best_t
+            restartable = []
+            for rec in rt.actors.values():
+                if rec.dead or rec.worker.dead:
+                    continue
+                if rec.restarts < rec.max_restarts:
+                    restartable.append(rec)
+            if restartable:
+                rec = max(restartable, key=lambda r: r.restarts == 0)
+                return rec.worker, time.time()
+        return None, -1.0
+
+    def _usage_report(self, used: int, total: int, top: int = 5) -> str:
+        rt = self.runtime
+        rows: List[Tuple[int, str]] = []
+        with rt.lock:
+            procs = [
+                (w.worker_id, w.proc.pid)
+                for w in rt.pool
+                if not w.dead and w.proc
+            ] + [
+                (f"actor:{rec.actor_id[:12]}", rec.worker.proc.pid)
+                for rec in rt.actors.values()
+                if not rec.dead and rec.worker.proc
+            ]
+        for wid, pid in procs:
+            rss = process_rss(pid)
+            if rss:
+                rows.append((rss, f"  {wid} (pid {pid}): "
+                                  f"{rss / 2**20:.0f} MiB"))
+        rows.sort(reverse=True)
+        lines = [r for _, r in rows[:top]]
+        return "Top workers by RSS:\n" + "\n".join(lines) if lines else ""

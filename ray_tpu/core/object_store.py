@@ -319,3 +319,10 @@ class RayActorError(RuntimeError):
 
 class WorkerCrashedError(RuntimeError):
     """The worker process died unexpectedly."""
+
+
+class RayOutOfMemoryError(RuntimeError):
+    """A worker was killed by the node memory monitor (reference
+    ray.exceptions.OutOfMemoryError + ``_private/memory_monitor.py``
+    RayOutOfMemoryError); the message carries the node usage and the
+    top per-worker RSS breakdown at kill time."""
