@@ -39,3 +39,45 @@ def available() -> bool:
         return True
     except Exception:
         return False
+
+
+# -- sanitizer builds (reference: src/ray BUILD config with
+# --config=tsan / --config=asan; its release tests run under them) --
+
+STRESS_SRC = os.path.join(_DIR, "shm_ring_stress.cpp")
+
+_SAN_FLAGS = {
+    "none": ["-O2"],
+    "tsan": ["-fsanitize=thread", "-O1", "-g"],
+    "asan": [
+        "-fsanitize=address",
+        "-fsanitize=undefined",
+        "-fno-omit-frame-pointer",
+        "-O1",
+        "-g",
+    ],
+}
+
+
+def build_stress(kind: str) -> str:
+    """Build the SPSC stress binary (``shm_ring_stress.cpp`` +
+    ``shm_ring.cpp`` in one program) under a sanitizer; returns the
+    binary path. A standalone instrumented binary — rather than
+    LD_PRELOADing a sanitizer runtime into python — is the only
+    configuration TSan reliably supports, and it exercises the
+    acquire/release protocol with a real producer/consumer thread
+    pair so the lock-free claims in ``shm_ring.cpp`` are CHECKED, not
+    just argued (the race-detection role of SURVEY §5.2)."""
+    if kind not in _SAN_FLAGS:
+        raise ValueError(f"unknown sanitizer {kind!r}")
+    exe = os.path.join(_DIR, f"shm_ring_stress_{kind}")
+    newest = max(os.path.getmtime(SRC), os.path.getmtime(STRESS_SRC))
+    if os.path.exists(exe) and os.path.getmtime(exe) >= newest:
+        return exe
+    cmd = (
+        ["g++", "-std=c++17"]
+        + _SAN_FLAGS[kind]
+        + ["-o", exe, STRESS_SRC, SRC, "-lrt", "-pthread"]
+    )
+    subprocess.run(cmd, check=True, capture_output=True)
+    return exe

@@ -1,0 +1,115 @@
+// SPSC stress harness for shm_ring.cpp — built under TSan/ASan by
+// ray_tpu/native/build.py build_stress() and run by
+// tests/test_shm_ring_sanitizers.py.
+//
+// One producer thread reserve/write/commits records of varying sizes
+// (driving wrap-around and full-ring backoff); one consumer thread
+// peeks/pops and validates length + content. Both threads operate on
+// the SAME handle/mapping: TSan analyzes happens-before per address,
+// so a second attach (new mmap of the same segment) would hide the
+// cross-thread pairings the acquire/release protocol must order.
+// Exit 0 = all records verified; any sanitizer report fails the
+// harness via the sanitizer's own exit code / stderr.
+
+#include <atomic>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+extern "C" {
+void* shmring_create(const char* name, uint64_t capacity);
+int64_t shmring_reserve(void* ring, uint64_t len);
+void shmring_commit(void* ring);
+void* shmring_data(void* ring);
+int64_t shmring_peek_len(void* ring);
+int64_t shmring_pop(void* ring, uint8_t* buf, uint64_t maxlen);
+uint64_t shmring_num_pushed(void* ring);
+uint64_t shmring_num_popped(void* ring);
+void shmring_mark_closed(void* ring);
+int shmring_is_closed(void* ring);
+void shmring_close(void* ring);
+}
+
+namespace {
+
+constexpr int kMessages = 20000;
+constexpr uint64_t kCapacity = 1 << 16;  // small: force wraps + fulls
+
+// deterministic per-message size, 8..~6000 bytes, crossing the
+// contiguous-space boundary often
+uint64_t msg_len(int i) { return 8 + (uint64_t)((i * 2654435761u) % 6000); }
+
+uint8_t msg_byte(int i, uint64_t j) {
+  return (uint8_t)((i * 31 + j * 7) & 0xff);
+}
+
+}  // namespace
+
+int main() {
+  void* ring = shmring_create("/ray_tpu_stress_ring", kCapacity);
+  if (!ring) {
+    fprintf(stderr, "create failed\n");
+    return 2;
+  }
+  std::atomic<int> failures{0};
+
+  std::thread producer([&] {
+    uint8_t* data = (uint8_t*)shmring_data(ring);
+    for (int i = 0; i < kMessages; ++i) {
+      uint64_t len = msg_len(i);
+      int64_t off;
+      while ((off = shmring_reserve(ring, len)) == -1)
+        std::this_thread::yield();  // full: wait for the consumer
+      if (off < 0) {
+        fprintf(stderr, "reserve(%llu) -> %lld\n",
+                (unsigned long long)len, (long long)off);
+        failures.fetch_add(1);
+        return;
+      }
+      for (uint64_t j = 0; j < len; ++j) data[off + j] = msg_byte(i, j);
+      shmring_commit(ring);
+    }
+  });
+
+  std::thread consumer([&] {
+    std::vector<uint8_t> buf(1 << 14);
+    for (int i = 0; i < kMessages; ++i) {
+      int64_t len;
+      while ((len = shmring_pop(ring, buf.data(), buf.size())) == -1)
+        std::this_thread::yield();  // empty: wait for the producer
+      if (len != (int64_t)msg_len(i)) {
+        fprintf(stderr, "msg %d: len %lld != %llu\n", i, (long long)len,
+                (unsigned long long)msg_len(i));
+        failures.fetch_add(1);
+        return;
+      }
+      for (uint64_t j = 0; j < (uint64_t)len; ++j) {
+        if (buf[j] != msg_byte(i, j)) {
+          fprintf(stderr, "msg %d: byte %llu corrupt\n", i,
+                  (unsigned long long)j);
+          failures.fetch_add(1);
+          return;
+        }
+      }
+    }
+  });
+
+  producer.join();
+  consumer.join();
+  if (shmring_num_pushed(ring) != kMessages ||
+      shmring_num_popped(ring) != kMessages) {
+    fprintf(stderr, "counter mismatch: pushed %llu popped %llu\n",
+            (unsigned long long)shmring_num_pushed(ring),
+            (unsigned long long)shmring_num_popped(ring));
+    failures.fetch_add(1);
+  }
+  shmring_mark_closed(ring);
+  if (!shmring_is_closed(ring) || shmring_reserve(ring, 8) != -3)
+    failures.fetch_add(1);
+  shmring_close(ring);
+  if (failures.load() != 0) return 1;
+  printf("ok: %d messages verified\n", kMessages);
+  return 0;
+}

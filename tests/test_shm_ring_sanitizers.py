@@ -1,0 +1,47 @@
+"""Sanitizer builds of the native ring (SURVEY §5.2 race detection).
+
+Reference strategy: ``src/ray`` ships tsan/asan build configs
+(``.bazelrc --config=tsan/asan``) and runs core C++ tests under them.
+Here the single C++ surface is the lock-free SPSC ring; its
+acquire/release protocol is exercised by a producer/consumer thread
+pair in an instrumented standalone binary
+(``native/shm_ring_stress.cpp``) — TSan verifies the happens-before
+edges (commit's release-store of tail → peek's acquire-load), ASan+
+UBSan the memory/arith discipline across wrap-around.
+"""
+
+import subprocess
+
+import pytest
+
+from ray_tpu.native.build import build_stress
+
+
+def _toolchain_supports(kind: str) -> bool:
+    try:
+        build_stress(kind)
+        return True
+    except Exception:
+        return False
+
+
+@pytest.mark.parametrize("kind", ["none", "tsan", "asan"])
+def test_spsc_stress_clean(kind):
+    if not _toolchain_supports(kind):
+        pytest.skip(f"toolchain lacks {kind} runtime")
+    exe = build_stress(kind)
+    env = {
+        "TSAN_OPTIONS": "halt_on_error=1 exitcode=66",
+        "ASAN_OPTIONS": "detect_leaks=0 exitcode=66",
+        "UBSAN_OPTIONS": "halt_on_error=1",
+    }
+    proc = subprocess.run(
+        [exe], capture_output=True, text=True, timeout=300, env=env
+    )
+    assert proc.returncode == 0, (
+        f"{kind} stress failed rc={proc.returncode}\n"
+        f"stdout: {proc.stdout}\nstderr: {proc.stderr}"
+    )
+    assert "ok: 20000 messages verified" in proc.stdout
+    assert "WARNING: ThreadSanitizer" not in proc.stderr
+    assert "ERROR: AddressSanitizer" not in proc.stderr
