@@ -140,6 +140,12 @@ class SyncSampler:
         self._want_prev_rewards = SampleBatch.PREV_REWARDS in vr
         self._prev_actions = [None] * n
         self._prev_rewards = [np.float32(0.0)] * n
+        # everything else the policy/model declares (frame windows,
+        # n-step-back columns, ...) materializes from the declaration
+        # alone (reference simple_list_collector.py build_*)
+        from ray_tpu.evaluation.view_collector import ViewCollector
+
+        self._views = ViewCollector(vr, n)
 
     def _transform(self, obs):
         return transform_obs(self.preprocessor, self.obs_filter, obs)
@@ -193,6 +199,15 @@ class SyncSampler:
             prev_kwargs["prev_reward_batch"] = np.asarray(
                 self._prev_rewards, np.float32
             )
+        if self._views.active:
+            per_env = [
+                self._views.compute_action_views(
+                    i, {SampleBatch.OBS: self.cur_obs[i]}
+                )
+                for i in range(n)
+            ]
+            for k in per_env[0]:
+                prev_kwargs[k] = np.stack([pe[k] for pe in per_env])
         actions, state_out, extras = self.policy.compute_actions(
             obs_batch, state_batches, explore=True, **prev_kwargs
         )
@@ -238,6 +253,8 @@ class SyncSampler:
             if self._want_prev_rewards:
                 row[SampleBatch.PREV_REWARDS] = self._prev_rewards[i]
                 self._prev_rewards[i] = np.float32(rewards[i])
+            if self._views.active:
+                self._views.annotate_row(i, row)
             self.collectors[i].add(row)
             self.episodes[i].add(float(rewards[i]))
 
@@ -255,6 +272,8 @@ class SyncSampler:
                 done_any = True
                 self._prev_actions[i] = None
                 self._prev_rewards[i] = np.float32(0.0)
+                if self._views.active:
+                    self._views.reset_env(i)
                 if self.flush_on_episode_end:
                     self._flush_slot(i, out)
                 with self._metrics_lock:

@@ -1,0 +1,160 @@
+"""Trajectory-view collection: materialize declared shifted/window
+columns while sampling.
+
+Counterpart of the reference's trajectory view API
+(``rllib/policy/view_requirement.py:15`` +
+``rllib/evaluation/collectors/simple_list_collector.py`` build_* —
+the collectors read each policy's ``view_requirements`` and assemble
+both the compute_actions input dict and the train batch from the
+declarations). Here the :class:`ViewCollector` owns the derived
+(``data_col``-shifted) requirements: per-env bounded history buffers,
+zero-fill before the episode start, window stacking on a new leading
+axis, and a clean cut at episode boundaries.
+
+The base columns (obs/actions/rewards/...) and the hot prev-1
+shortcuts (PREV_ACTIONS / PREV_REWARDS) stay on the sampler's direct
+path; everything else a policy or model declares — frame windows for
+attention models, n-step-back actions, custom debug views — flows
+through here with no sampler changes.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ray_tpu.data.sample_batch import SampleBatch
+
+# columns the sampler itself produces every step
+_BASE = {
+    SampleBatch.OBS,
+    SampleBatch.NEXT_OBS,
+    SampleBatch.ACTIONS,
+    SampleBatch.REWARDS,
+    SampleBatch.TERMINATEDS,
+    SampleBatch.TRUNCATEDS,
+    SampleBatch.EPS_ID,
+    SampleBatch.AGENT_INDEX,
+    SampleBatch.T,
+    SampleBatch.PREV_ACTIONS,
+    SampleBatch.PREV_REWARDS,
+}
+
+
+def derived_requirements(view_requirements: Dict) -> Dict:
+    """The requirements the ViewCollector must materialize: anything
+    keyed off another column via ``data_col`` (except the sampler's
+    built-in prev-1 shortcuts and identity views)."""
+    out = {}
+    for key, req in (view_requirements or {}).items():
+        if key in _BASE:
+            continue
+        data_col = getattr(req, "data_col", None)
+        if data_col is None:
+            continue  # produced by the policy itself (extra fetches)
+        out[key] = req
+    return out
+
+
+class ViewCollector:
+    def __init__(self, view_requirements: Dict, num_envs: int):
+        self.reqs = derived_requirements(view_requirements)
+        self.lookback = max(
+            [r.lookback for r in self.reqs.values()], default=0
+        )
+        # per-env, per-source-column bounded history of PAST steps
+        self._hist: List[Dict[str, deque]] = [
+            {} for _ in range(num_envs)
+        ]
+
+    @property
+    def active(self) -> bool:
+        return bool(self.reqs)
+
+    # -- helpers ---------------------------------------------------------
+
+    def _zero(self, req, like: Optional[np.ndarray]) -> np.ndarray:
+        if like is not None:
+            return np.zeros_like(like)
+        space = getattr(req, "space", None)
+        if space is not None:
+            return np.zeros(space.shape, space.dtype)
+        raise ValueError(
+            f"view requirement on {req.data_col!r} needs a `space` to "
+            "zero-fill before any value was collected"
+        )
+
+    def _view_at(self, hist: deque, shift: int, req, like):
+        """Value of the source column ``shift`` steps back (shift<=0;
+        0 = the value being added this step, passed via ``like``)."""
+        if shift == 0:
+            if like is None:
+                raise ValueError(
+                    f"{req.data_col!r} shift 0 view has no current value"
+                )
+            return np.asarray(like)
+        idx = len(hist) + shift
+        if idx < 0:
+            return self._zero(req, like if like is not None
+                              else (hist[0] if hist else None))
+        return hist[idx]
+
+    def _materialize(self, env_i: int, key: str, req, current):
+        hist = self._hist[env_i].setdefault(
+            req.data_col, deque(maxlen=max(self.lookback, 1))
+        )
+        if req.is_window:
+            return np.stack(
+                [
+                    self._view_at(hist, s, req, current)
+                    for s in range(req.shift_from, req.shift_to + 1)
+                ]
+            )
+        return self._view_at(hist, req.shift_from, req, current)
+
+    # -- sampler hooks ---------------------------------------------------
+
+    def compute_action_views(
+        self, env_i: int, current: Dict[str, np.ndarray]
+    ) -> Dict[str, np.ndarray]:
+        """Views for this step's compute_actions call. ``current``
+        maps source columns to their this-step values (the not yet
+        recorded ones, e.g. the current obs); shift-0 references read
+        from it."""
+        out = {}
+        for key, req in self.reqs.items():
+            if not req.used_for_compute_actions:
+                continue
+            out[key] = self._materialize(
+                env_i, key, req, current.get(req.data_col)
+            )
+        return out
+
+    def annotate_row(self, env_i: int, row: Dict) -> None:
+        """Write the declared train-time views into the row, then
+        absorb the row's source columns into history. Call AFTER the
+        sampler filled the base columns for this step."""
+        for key, req in self.reqs.items():
+            if not req.used_for_training:
+                continue
+            if key in row:
+                continue  # policy extras win
+            row[key] = self._materialize(
+                env_i, key, req, row.get(req.data_col)
+            )
+        if self.lookback > 0:
+            needed = {r.data_col for r in self.reqs.values()}
+            hist_i = self._hist[env_i]
+            for col in needed:
+                if col in row:
+                    hist_i.setdefault(
+                        col, deque(maxlen=max(self.lookback, 1))
+                    ).append(np.asarray(row[col]))
+
+    def reset_env(self, env_i: int) -> None:
+        """Episode boundary: views never reach into the previous
+        episode."""
+        for h in self._hist[env_i].values():
+            h.clear()

@@ -17,12 +17,21 @@ from ray_tpu.data.sample_batch import SampleBatch
 
 class ViewRequirement:
     """Declares a column the policy needs at compute/train time
-    (reference view_requirement.py:15)."""
+    (reference view_requirement.py:15).
+
+    ``shift`` is an int (0 = this step, -1 = previous step, ...) or a
+    window string ``"a:b"`` with ``a <= b <= 0`` (e.g. ``"-3:0"`` =
+    the last four values including the current step, stacked on a new
+    leading axis, zero-filled before the episode start). Windowed and
+    negatively-shifted views are materialized by the sampler's
+    :class:`~ray_tpu.evaluation.view_collector.ViewCollector` from the
+    declaration alone — the policy/model never hand-wires collection.
+    Positive shifts are covered by the built-in NEXT_OBS column."""
 
     def __init__(
         self,
         data_col: Optional[str] = None,
-        shift: int = 0,
+        shift=0,
         used_for_compute_actions: bool = True,
         used_for_training: bool = True,
         space=None,
@@ -32,6 +41,24 @@ class ViewRequirement:
         self.used_for_compute_actions = used_for_compute_actions
         self.used_for_training = used_for_training
         self.space = space
+        if isinstance(shift, str):
+            lo, hi = (int(s) for s in shift.split(":"))
+            if lo > hi or hi > 0:
+                raise ValueError(
+                    f"window shift {shift!r} must satisfy a <= b <= 0"
+                )
+            self.shift_from, self.shift_to = lo, hi
+        else:
+            self.shift_from = self.shift_to = int(shift)
+
+    @property
+    def is_window(self) -> bool:
+        return isinstance(self.shift, str)
+
+    @property
+    def lookback(self) -> int:
+        """How many PAST steps this view reaches into."""
+        return max(0, -self.shift_from)
 
 
 class Policy:

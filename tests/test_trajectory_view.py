@@ -1,0 +1,147 @@
+"""Declarative trajectory-view collection.
+
+Reference strategy: ``rllib/evaluation/tests/test_trajectory_view_api.py``
+— a policy DECLARES shifted/window views (``view_requirement.py:15``)
+and the collectors materialize them for both compute_actions and the
+train batch, zero-filled before episode starts, never reaching across
+episode boundaries.
+"""
+
+import gymnasium as gym
+import numpy as np
+import pytest
+
+from ray_tpu.data.sample_batch import SampleBatch
+from ray_tpu.env.vector_env import VectorEnv
+from ray_tpu.evaluation.sampler import SyncSampler
+from ray_tpu.policy.policy import Policy, ViewRequirement
+
+
+class _CountEnv(gym.Env):
+    """obs = [episode-local t]; episode ends after 5 steps."""
+
+    observation_space = gym.spaces.Box(-1e9, 1e9, (1,), np.float32)
+    action_space = gym.spaces.Discrete(2)
+
+    def reset(self, *, seed=None, options=None):
+        self.t = 0
+        return np.array([0.0], np.float32), {}
+
+    def step(self, action):
+        self.t += 1
+        done = self.t >= 5
+        return (
+            np.array([float(self.t)], np.float32),
+            1.0,
+            done,
+            False,
+            {},
+        )
+
+
+class _ViewPolicy(Policy):
+    """Declares a 3-step obs window (incl. current) used at BOTH
+    compute and train time, plus a train-only action from 2 steps
+    back."""
+
+    def __init__(self, observation_space, action_space, config=None):
+        super().__init__(observation_space, action_space, config or {})
+        self.view_requirements["obs_3"] = ViewRequirement(
+            data_col=SampleBatch.OBS,
+            shift="-2:0",
+            space=observation_space,
+        )
+        self.view_requirements["action_m2"] = ViewRequirement(
+            data_col=SampleBatch.ACTIONS,
+            shift=-2,
+            used_for_compute_actions=False,
+            space=action_space,
+        )
+        self.seen_compute_views = []
+
+    def get_initial_state(self):
+        return []
+
+    def compute_actions(
+        self, obs_batch, state_batches=None, explore=True, **kwargs
+    ):
+        assert "obs_3" in kwargs, sorted(kwargs)
+        assert "action_m2" not in kwargs  # train-only view
+        self.seen_compute_views.append(np.asarray(kwargs["obs_3"]))
+        n = len(obs_batch)
+        return np.zeros(n, np.int64), [], {}
+
+    def postprocess_trajectory(self, batch, other_agent_batches=None,
+                               episode=None):
+        return batch
+
+
+def _sample_once(num_envs=1, frag=12):
+    env = VectorEnv.vectorize_gym_envs(
+        make_env=lambda i: _CountEnv(), num_envs=num_envs
+    )
+    policy = _ViewPolicy(
+        _CountEnv.observation_space, _CountEnv.action_space
+    )
+    sampler = SyncSampler(
+        vector_env=env,
+        policy=policy,
+        rollout_fragment_length=frag,
+    )
+    return policy, sampler.sample()
+
+
+def test_window_view_zero_filled_and_ordered():
+    policy, batch = _sample_once()
+    obs3 = batch["obs_3"]  # (N, 3, 1): [t-2, t-1, t]
+    obs = batch[SampleBatch.OBS]
+    t = batch[SampleBatch.T]
+    assert obs3.shape == (batch.count, 3, 1)
+    for r in range(batch.count):
+        assert obs3[r, 2] == obs[r]  # shift 0 = current
+        expect_m1 = 0.0 if t[r] < 1 else obs[r] - 1
+        expect_m2 = 0.0 if t[r] < 2 else obs[r] - 2
+        assert obs3[r, 1] == pytest.approx(expect_m1)
+        assert obs3[r, 0] == pytest.approx(expect_m2)
+
+
+def test_single_negative_shift_column():
+    policy, batch = _sample_once()
+    am2 = batch["action_m2"]
+    actions = batch[SampleBatch.ACTIONS]
+    t = batch[SampleBatch.T]
+    for r in range(batch.count):
+        if t[r] < 2:
+            assert am2[r] == 0  # zero-fill before episode start
+        else:
+            # same episode, two rows back
+            assert am2[r] == actions[r - 2]
+
+
+def test_compute_action_views_match_train_views():
+    policy, batch = _sample_once()
+    # stacked per-step compute views == the train column, row by row
+    seen = np.concatenate(policy.seen_compute_views)[: batch.count]
+    assert np.allclose(seen, batch["obs_3"])
+
+
+def test_views_do_not_cross_episode_boundary():
+    policy, batch = _sample_once(frag=12)
+    t = batch[SampleBatch.T]
+    obs3 = batch["obs_3"]
+    # the second episode's first rows (t=0,1) must be zero-filled even
+    # though the previous episode's obs are adjacent in the stream
+    starts = [r for r in range(batch.count) if t[r] == 0]
+    assert len(starts) >= 2  # 12 steps over 5-step episodes
+    for r in starts:
+        assert obs3[r, 0] == 0.0 and obs3[r, 1] == 0.0
+
+
+def test_policies_without_custom_views_pay_nothing():
+    from ray_tpu.evaluation.view_collector import ViewCollector
+
+    base = Policy(
+        _CountEnv.observation_space, _CountEnv.action_space, {}
+    )
+    vc = ViewCollector(base.view_requirements, 2)
+    assert not vc.active
