@@ -106,22 +106,29 @@ class _Entry:
 class ObjectStore:
     """Driver-side object table. Thread-safe."""
 
-    def __init__(self, max_bytes: Optional[int] = None):
+    def __init__(
+        self,
+        max_bytes: Optional[int] = None,
+        spill_uri: Optional[str] = None,
+    ):
         self._lock = threading.Lock()
         self._entries: Dict[str, _Entry] = {}
         self.max_bytes = max_bytes  # None → never spill
         self._resident_bytes = 0
         self._lru: Dict[str, float] = {}  # obj_id -> last access
-        self._spill_dir = None
+        # pluggable spill backend (reference object_spilling_config):
+        # file:// by default, s3://... via the external_storage seam
+        self._spill_uri = spill_uri or os.environ.get(
+            "RAY_TPU_SPILL_URI", "file://"
+        )
+        self._storage = None  # constructed on first spill
 
-    def _spill_path(self, obj_id: str) -> str:
-        import tempfile
+    def _spill_storage(self):
+        if self._storage is None:
+            from ray_tpu.core.external_storage import storage_from_uri
 
-        if self._spill_dir is None:
-            self._spill_dir = tempfile.mkdtemp(
-                prefix="ray_tpu_spill_"
-            )
-        return os.path.join(self._spill_dir, f"{obj_id}.bin")
+            self._storage = storage_from_uri(self._spill_uri)
+        return self._storage
 
     def _track_shm(self, obj_id: str, e: _Entry) -> None:
         """Lock held: account a new shm-resident entry, spilling LRU
@@ -147,9 +154,7 @@ class ObjectStore:
         """Lock held: move the serialized bytes to disk and release the
         shm segment. User-held zero-copy views stay valid (the mapping
         lives until they are GC'd); OUR references are dropped."""
-        path = self._spill_path(obj_id)
-        with open(path, "wb") as f:
-            f.write(bytes(e.shm.buf))
+        path = self._spill_storage().put(obj_id, bytes(e.shm.buf))
         self._resident_bytes -= e.shm.size
         self._lru.pop(obj_id, None)
         e.spill_path = path
@@ -169,8 +174,7 @@ class ObjectStore:
         external_storage restore path)."""
         if e.spill_path is None or e.value is not None:
             return
-        with open(e.spill_path, "rb") as f:
-            blob = f.read()
+        blob = self._spill_storage().get(e.spill_path)
         e.value = ser.read_from_buffer(memoryview(blob))
         e._restore_buf = blob  # keep the backing bytes alive
 
@@ -270,8 +274,8 @@ class ObjectStore:
                 e = self._entries.pop(oid, None)
                 if e is not None and e.spill_path is not None:
                     try:
-                        os.remove(e.spill_path)
-                    except FileNotFoundError:
+                        self._spill_storage().delete(e.spill_path)
+                    except Exception:
                         pass
                     e.spill_path = None
                 if e and e.shm:
