@@ -1,0 +1,74 @@
+"""In-process multi-node test cluster.
+
+Counterpart of the reference's ``ray.cluster_utils.Cluster``
+(``python/ray/cluster_utils.py:52`` — the harness behind its
+multi-node unit tests): a head runtime plus N worker-agent nodes, each
+a REAL subprocess joining the head's fleet over TCP
+(``core/cluster.py``), with add/remove/kill/wait primitives so tests
+can script topologies and failures.
+
+TPU-first disposition: the head owns the chip and the driver; nodes
+host CPU actors only (the star-shaped fleet of ``core/cluster.py``),
+so this harness scripts CPU-fleet topologies — the multi-host TPU
+axis is ``jax.distributed`` and is tested by
+``tests/test_multihost.py`` instead.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from ray_tpu.autoscaler.node_provider import LocalSubprocessProvider
+
+
+class Cluster:
+    """reference cluster_utils.py:52 (scoped: head + CPU agents)."""
+
+    def __init__(
+        self,
+        initialize_head: bool = True,
+        head_node_args: Optional[Dict] = None,
+    ):
+        import ray_tpu as ray
+        from ray_tpu.core.cluster import start_cluster_server
+
+        self._nodes: List[str] = []
+        self.address = None
+        if initialize_head:
+            ray.init(**(head_node_args or {"num_cpus": 2}))
+            self.address = start_cluster_server()
+            self._provider = LocalSubprocessProvider(self.address)
+
+    def add_node(self, num_cpus: int = 1, **_) -> str:
+        """Spawn a worker-agent node subprocess; returns its provider
+        node id (NOT the fleet node_id — use ``wait_for_nodes`` to
+        learn membership, as the reference does via the GCS)."""
+        node_id = self._provider.create_node({"num_cpus": num_cpus})
+        self._nodes.append(node_id)
+        return node_id
+
+    def remove_node(self, node_id: str, graceful: bool = True) -> None:
+        """Terminate a node (SIGTERM; the head fails its in-flight
+        work and drops it from membership)."""
+        self._provider.terminate_node(node_id)
+        if node_id in self._nodes:
+            self._nodes.remove(node_id)
+
+    def wait_for_nodes(self, n: int, timeout: float = 60.0) -> List[str]:
+        """Block until ``n`` agent nodes are registered with the head;
+        returns their fleet node_ids."""
+        from ray_tpu.core import api
+
+        rt = api._require_runtime()
+        return rt.cluster.wait_for_nodes(n, timeout=timeout)
+
+    @property
+    def alive_nodes(self) -> List[str]:
+        return self._provider.non_terminated_nodes()
+
+    def shutdown(self) -> None:
+        import ray_tpu as ray
+
+        for nid in list(self._nodes):
+            self.remove_node(nid)
+        ray.shutdown()

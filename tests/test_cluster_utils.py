@@ -1,0 +1,60 @@
+"""Multi-node test harness (reference ``python/ray/cluster_utils.py``
+Cluster + its usage across multi-node unit tests): script a head + N
+real agent-node subprocesses, place actors across them, kill a node
+mid-flight."""
+
+import time
+
+import pytest
+
+import ray_tpu as ray
+from ray_tpu.core import api
+
+
+@pytest.fixture()
+def cluster():
+    from ray_tpu.cluster_utils import Cluster
+
+    ray.shutdown()
+    c = Cluster(head_node_args={"num_cpus": 1})
+    yield c
+    c.shutdown()
+
+
+@ray.remote
+class Echo:
+    def __init__(self):
+        import os
+
+        self.pid = os.getpid()
+
+    def who(self):
+        return self.pid
+
+
+def test_two_nodes_host_actors_in_own_processes(cluster):
+    import os
+
+    cluster.add_node(num_cpus=1)
+    cluster.add_node(num_cpus=1)
+    fleet_ids = cluster.wait_for_nodes(2, timeout=60)
+    assert len(fleet_ids) == 2
+    a = Echo.options(placement_node=fleet_ids[0]).remote()
+    b = Echo.options(placement_node=fleet_ids[1]).remote()
+    pid_a = ray.get(a.who.remote(), timeout=60)
+    pid_b = ray.get(b.who.remote(), timeout=60)
+    assert pid_a != pid_b
+    assert os.getpid() not in (pid_a, pid_b)
+
+
+def test_remove_node_fails_its_actor(cluster):
+    cluster.add_node(num_cpus=1)
+    fleet_ids = cluster.wait_for_nodes(1, timeout=60)
+    a = Echo.options(placement_node=fleet_ids[0]).remote()
+    assert ray.get(a.who.remote(), timeout=60)
+    cluster.remove_node(cluster.alive_nodes[0])
+    deadline = time.time() + 30
+    rt = api._require_runtime()
+    while time.time() < deadline and rt.cluster.nodes:
+        time.sleep(0.1)
+    assert not rt.cluster.nodes  # head noticed the departure
