@@ -165,10 +165,21 @@ def _apply_stages(block, stages):
     return block
 
 
+def _stable_hash(value) -> int:
+    """Process-stable key hash: partition tasks run in DIFFERENT
+    worker processes, where python's own ``hash()`` is salted — the
+    same key would land in different partitions."""
+    import pickle
+    import zlib
+
+    return zlib.crc32(pickle.dumps(value))
+
+
 @ray.remote
 def _partition_block(block, n_parts, mode, key, bounds, seed):
     """Stage 1 of the exchange: split one block into n_parts
-    (hash-random for shuffle, range for sort)."""
+    (hash-random for shuffle, range for sort, stable key-hash for
+    groupby)."""
     rows = _block_rows(block)
     parts: List[List] = [[] for _ in range(n_parts)]
     if mode == "shuffle":
@@ -176,6 +187,9 @@ def _partition_block(block, n_parts, mode, key, bounds, seed):
         assign = rng.integers(0, n_parts, len(rows))
         for row, p in zip(rows, assign):
             parts[int(p)].append(row)
+    elif mode == "groupby":
+        for row in rows:
+            parts[_stable_hash(key(row)) % n_parts].append(row)
     else:  # range partition by sort key against sampled bounds
         for row in rows:
             k = key(row)
@@ -196,6 +210,26 @@ def _merge_parts(mode, key, seed, *parts):
     else:
         rows.sort(key=key)
     return _rows_to_block(rows, merged)
+
+
+@ray.remote
+def _aggregate_parts(key, init, accumulate, finalize, out_row, *parts):
+    """Groupby stage 2: every row with a given key is in exactly one
+    partition (stable hash), so each task folds its groups to
+    completion independently (the reference's per-partition
+    GroupbyMapBlock + combine)."""
+    groups: Dict = {}
+    for part in parts:
+        for row in _block_rows(part):
+            k = key(row)
+            if k not in groups:
+                groups[k] = init(k)
+            groups[k] = accumulate(groups[k], row)
+    rows = [
+        out_row(k, finalize(acc) if finalize else acc)
+        for k, acc in groups.items()
+    ]
+    return rows
 
 
 @ray.remote
@@ -529,6 +563,40 @@ class Dataset:
     def sum(self):
         return sum(self.take_all())
 
+    # -- relational ops (reference dataset.py groupby/union/zip) --------
+
+    def groupby(self, key) -> "GroupedDataset":
+        """Group rows by a column name (dict rows) or a key callable;
+        aggregations run as a distributed hash exchange (reference
+        dataset.py groupby + grouped_data.py)."""
+        return GroupedDataset(self, key)
+
+    def unique(self, key=None) -> List:
+        """Distinct keys (reference dataset.unique), via the groupby
+        exchange."""
+        grouped = self.groupby(key)
+        kn = grouped._key_name
+        return [r[kn] for r in grouped.count().take_all()]
+
+    def union(self, *others: "Dataset") -> "Dataset":
+        """Concatenate datasets WITHOUT materializing rows on the
+        driver — block refs are simply chained (reference
+        dataset.union)."""
+        refs = list(self._materialize_refs())
+        for o in others:
+            refs.extend(o._materialize_refs())
+        return Dataset(None, refs=refs)
+
+    def zip(self, other: "Dataset") -> "Dataset":
+        """Row-wise zip of two same-length datasets into (row_a,
+        row_b) tuples (reference dataset.zip, scoped to tuple rows)."""
+        a, b = self.take_all(), other.take_all()
+        if len(a) != len(b):
+            raise ValueError(
+                f"zip needs equal lengths, got {len(a)} vs {len(b)}"
+            )
+        return Dataset(_chunk(list(builtins.zip(a, b)), self.num_blocks()))
+
     def num_blocks(self) -> int:
         if self._refs is not None:
             return len(self._refs)
@@ -549,6 +617,116 @@ class Dataset:
             f"Dataset(num_blocks={self.num_blocks()}, "
             f"pending_stages={len(self._stages)})"
         )
+
+
+def _key_fn(key):
+    if key is None:
+        return lambda r: r
+    if callable(key):
+        return key
+    return lambda r, _k=key: r[_k]
+
+
+class GroupedDataset:
+    """reference ``data/grouped_data.py GroupedData``: aggregations
+    over a distributed hash exchange. Every key lands in exactly one
+    partition task (stable hash), so folds complete independently —
+    the driver never sees row data, only the per-group result rows."""
+
+    def __init__(self, ds: "Dataset", key):
+        self._ds = ds
+        self._key = _key_fn(key)
+        self._key_name = key if isinstance(key, str) else "key"
+
+    def aggregate(
+        self,
+        init: Callable,
+        accumulate: Callable,
+        finalize: Optional[Callable] = None,
+        name: str = "agg",
+    ) -> "Dataset":
+        """Generic fold (reference AggregateFn): ``init(key) -> acc``,
+        ``accumulate(acc, row) -> acc``, optional ``finalize(acc)``.
+        Returns a Dataset of ``{<key_name>: key, <name>: value}``
+        rows."""
+        kn, nm = self._key_name, name
+
+        def out_row(k, v):
+            return {kn: k, nm: v}
+
+        refs = self._ds._materialize_refs()
+        n = max(1, len(refs))
+        if n == 1:
+            rows = ray.get(
+                _aggregate_parts.remote(
+                    self._key, init, accumulate, finalize, out_row,
+                    *refs,
+                )
+            )
+            return Dataset([rows])
+        part_refs = [
+            _partition_block.options(num_returns=n).remote(
+                r, n, "groupby", self._key, None, 0
+            )
+            for r in refs
+        ]
+        agg = [
+            _aggregate_parts.remote(
+                self._key, init, accumulate, finalize, out_row,
+                *[parts[j] for parts in part_refs],
+            )
+            for j in range(n)
+        ]
+        _free_when_done(
+            [p for parts in part_refs for p in parts], agg
+        )
+        return Dataset(None, refs=agg)
+
+    def count(self) -> "Dataset":
+        return self.aggregate(
+            lambda k: 0, lambda a, r: a + 1, name="count()"
+        )
+
+    def sum(self, on=None) -> "Dataset":
+        v = _key_fn(on)
+        return self.aggregate(
+            lambda k: 0, lambda a, r: a + v(r), name=f"sum({on})"
+        )
+
+    def min(self, on=None) -> "Dataset":
+        v = _key_fn(on)
+        return self.aggregate(
+            lambda k: None,
+            lambda a, r: v(r) if a is None else min(a, v(r)),
+            name=f"min({on})",
+        )
+
+    def max(self, on=None) -> "Dataset":
+        v = _key_fn(on)
+        return self.aggregate(
+            lambda k: None,
+            lambda a, r: v(r) if a is None else max(a, v(r)),
+            name=f"max({on})",
+        )
+
+    def mean(self, on=None) -> "Dataset":
+        v = _key_fn(on)
+        return self.aggregate(
+            lambda k: (0.0, 0),
+            lambda a, r: (a[0] + v(r), a[1] + 1),
+            finalize=lambda a: a[0] / a[1],
+            name=f"mean({on})",
+        )
+
+    def map_groups(self, fn: Callable) -> "Dataset":
+        """Apply ``fn(rows) -> rows`` per group (reference
+        map_groups), riding the same exchange."""
+        collected = self.aggregate(
+            lambda k: [],
+            lambda a, r: a + [r],
+            name="rows",
+        )
+        return collected.flat_map(lambda row: fn(row["rows"]))
 
 
 def _maybe_format_rows(rows: List, batch_format: str):

@@ -1,0 +1,102 @@
+"""Dataset relational ops: groupby/aggregate exchange, union, zip,
+unique (reference ``data/grouped_data.py`` + ``tests/test_dataset.py``
+groupby cases). The groupby is a distributed hash exchange — keys are
+partitioned with a process-stable hash so the same key never lands in
+two aggregation tasks."""
+
+import pytest
+
+import ray_tpu as ray
+from ray_tpu.data.dataset import Dataset
+
+
+@pytest.fixture(autouse=True)
+def _init():
+    ray.init(num_cpus=2, ignore_reinit_error=True)
+
+
+def _rows():
+    return [
+        {"k": i % 3, "v": float(i)} for i in range(30)
+    ]
+
+
+def test_groupby_count_sum_mean():
+    ds = Dataset.from_items(_rows(), parallelism=4)
+    counts = {
+        r["k"]: r["count()"]
+        for r in ds.groupby("k").count().take_all()
+    }
+    assert counts == {0: 10, 1: 10, 2: 10}
+    sums = {
+        r["k"]: r["sum(v)"]
+        for r in ds.groupby("k").sum("v").take_all()
+    }
+    assert sums[0] == sum(float(i) for i in range(0, 30, 3))
+    means = {
+        r["k"]: r["mean(v)"]
+        for r in ds.groupby("k").mean("v").take_all()
+    }
+    assert means[1] == pytest.approx(sums[1] / 10 if False else
+                                     sum(float(i) for i in
+                                         range(1, 30, 3)) / 10)
+
+
+def test_groupby_min_max_and_callable_key():
+    ds = Dataset.range(20, parallelism=3)
+    lo = {
+        r["key"]: r["min(None)"]
+        for r in ds.groupby(lambda x: x % 2).min().take_all()
+    }
+    assert lo == {0: 0, 1: 1}
+    hi = {
+        r["key"]: r["max(None)"]
+        for r in ds.groupby(lambda x: x % 2).max().take_all()
+    }
+    assert hi == {0: 18, 1: 19}
+
+
+def test_groupby_custom_aggregate_and_map_groups():
+    ds = Dataset.from_items(_rows(), parallelism=4)
+    # custom fold: concatenate values as a sorted tuple
+    agg = ds.groupby("k").aggregate(
+        init=lambda k: [],
+        accumulate=lambda a, r: a + [r["v"]],
+        finalize=lambda a: tuple(sorted(a)),
+        name="vals",
+    )
+    vals = {r["k"]: r["vals"] for r in agg.take_all()}
+    assert vals[2] == tuple(float(i) for i in range(2, 30, 3))
+    # map_groups: emit one normalized row per group
+    out = ds.groupby("k").map_groups(
+        lambda rows: [
+            {
+                "k": rows[0]["k"],
+                "n": len(rows),
+                "span": max(r["v"] for r in rows)
+                - min(r["v"] for r in rows),
+            }
+        ]
+    )
+    spans = {r["k"]: (r["n"], r["span"]) for r in out.take_all()}
+    assert spans == {0: (10, 27.0), 1: (10, 27.0), 2: (10, 27.0)}
+
+
+def test_unique_and_union_and_zip():
+    ds = Dataset.from_items(_rows(), parallelism=3)
+    assert sorted(ds.unique("k")) == [0, 1, 2]
+    a = Dataset.range(5, parallelism=2)
+    b = Dataset.range(5, parallelism=2).map(lambda x: x + 100)
+    u = a.union(b)
+    assert u.count() == 10
+    assert sorted(u.take_all())[-1] == 104
+    z = a.zip(b)
+    assert z.take_all() == [(i, i + 100) for i in range(5)]
+    with pytest.raises(ValueError):
+        a.zip(Dataset.range(3))
+
+
+def test_groupby_single_block_local_path():
+    ds = Dataset.from_items([{"k": 0, "v": 1.0}], parallelism=1)
+    out = ds.groupby("k").sum("v").take_all()
+    assert out == [{"k": 0, "sum(v)": 1.0}]
