@@ -5,6 +5,8 @@ from ray_tpu.tune.tune import run, ExperimentAnalysis
 from ray_tpu.tune.schedulers import (
     FIFOScheduler,
     AsyncHyperBandScheduler,
+    HyperBandScheduler,
+    MedianStoppingRule,
     PopulationBasedTraining,
 )
 from ray_tpu.tune.search import (
@@ -24,6 +26,8 @@ __all__ = [
     "ExperimentAnalysis",
     "FIFOScheduler",
     "AsyncHyperBandScheduler",
+    "HyperBandScheduler",
+    "MedianStoppingRule",
     "PopulationBasedTraining",
     "grid_search",
     "uniform",

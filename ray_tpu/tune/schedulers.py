@@ -1,8 +1,10 @@
-"""Trial schedulers: FIFO, ASHA, PBT.
+"""Trial schedulers: FIFO, ASHA, synchronous HyperBand, median
+stopping, PBT.
 
 Counterpart of the reference's ``ray/tune/schedulers/``
-(``async_hyperband.py`` AsyncHyperBandScheduler, ``pbt.py``
-PopulationBasedTraining).
+(``async_hyperband.py`` AsyncHyperBandScheduler, ``hyperband.py``
+HyperBandScheduler, ``median_stopping_rule.py`` MedianStoppingRule,
+``pbt.py`` PopulationBasedTraining).
 """
 
 from __future__ import annotations
@@ -89,6 +91,162 @@ class AsyncHyperBandScheduler(TrialScheduler):
         if cur >= self.max_t:
             return STOP
         return self._bracket.on_result(trial.trial_id, cur, metric)
+
+
+class MedianStoppingRule(TrialScheduler):
+    """Stop a trial whose best result so far is worse than the median
+    of the other trials' running averages at the same point
+    (reference schedulers/median_stopping_rule.py — the Vizier
+    median stopping rule)."""
+
+    def __init__(
+        self,
+        metric: str = "episode_reward_mean",
+        mode: str = "max",
+        time_attr: str = "training_iteration",
+        grace_period: int = 1,
+        min_samples_required: int = 3,
+        hard_stop: bool = True,
+    ):
+        self.metric = metric
+        self.mode = mode
+        self.time_attr = time_attr
+        self.grace_period = grace_period
+        self.min_samples = min_samples_required
+        self.hard_stop = hard_stop
+        # trial_id -> list of (t, metric) results seen
+        self._history: Dict[str, List] = {}
+        self._completed: set = set()
+
+    def _sign(self, v: float) -> float:
+        return -v if self.mode == "min" else v
+
+    def _running_avg(self, trial_id: str, t: float) -> Optional[float]:
+        pts = [m for (ti, m) in self._history.get(trial_id, [])
+               if ti <= t]
+        return sum(pts) / len(pts) if pts else None
+
+    def on_trial_result(self, runner, trial, result: Dict) -> str:
+        metric = result.get(self.metric)
+        t = result.get(self.time_attr, 0)
+        if metric is None:
+            return CONTINUE
+        metric = self._sign(metric)
+        self._history.setdefault(trial.trial_id, []).append((t, metric))
+        if t < self.grace_period:
+            return CONTINUE
+        others = [
+            self._running_avg(tid, t)
+            for tid in self._history
+            if tid != trial.trial_id
+        ]
+        others = [a for a in others if a is not None]
+        if len(others) < self.min_samples:
+            return CONTINUE
+        others.sort()
+        median = others[len(others) // 2]
+        best = max(m for (_, m) in self._history[trial.trial_id])
+        if best < median:
+            return STOP if self.hard_stop else PAUSE
+        return CONTINUE
+
+    def on_trial_complete(self, runner, trial, result: Dict) -> None:
+        self._completed.add(trial.trial_id)
+
+
+class HyperBandScheduler(TrialScheduler):
+    """Synchronous HyperBand (reference schedulers/hyperband.py):
+    trials fill brackets of size s; at each rung every bracket member
+    must report before the bottom 1-1/eta fraction is stopped
+    together. Synchronous halving wastes less budget on stragglers
+    than ASHA when result cadences are uniform (the reference keeps
+    both for the same reason)."""
+
+    def __init__(
+        self,
+        metric: str = "episode_reward_mean",
+        mode: str = "max",
+        time_attr: str = "training_iteration",
+        max_t: int = 81,
+        reduction_factor: float = 3,
+    ):
+        self.metric = metric
+        self.mode = mode
+        self.time_attr = time_attr
+        self.max_t = max_t
+        self.eta = reduction_factor
+        # rung milestones: max_t / eta^k, ascending
+        self.milestones: List[int] = []
+        t = max_t
+        while t >= 1:
+            self.milestones.append(int(t))
+            t = t / self.eta
+        self.milestones = sorted(set(self.milestones))[:-1]
+        # milestone -> {trial_id: metric}; a rung decides once every
+        # trial that can still reach it has reported there
+        self._rungs: Dict[int, Dict[str, float]] = {
+            m: {} for m in self.milestones
+        }
+        self._decided: Dict[int, set] = {m: set() for m in self.milestones}
+        self._stopped_at: Dict[str, int] = {}  # cut at which rung
+        self._done: set = set()  # completed/errored on their own
+
+    def _sign(self, v: float) -> float:
+        return -v if self.mode == "min" else v
+
+    def _eligible(self, runner, m: int) -> List[str]:
+        """Trials a rung-m decision must wait for / rank: everyone
+        except those cut at an earlier rung and those that finished
+        without ever reaching m. Completed trials that DID report at
+        m stay in the ranking — under sequential trial execution the
+        bracket fills one trial at a time, and the reference's
+        pause-at-rung semantics degrade to exactly this."""
+        out = []
+        for t in getattr(runner, "trials", []):
+            tid = t.trial_id
+            cut = self._stopped_at.get(tid)
+            if cut is not None and cut < m:
+                continue
+            if tid in self._done and tid not in self._rungs[m]:
+                continue
+            out.append(tid)
+        return out
+
+    def on_trial_result(self, runner, trial, result: Dict) -> str:
+        metric = result.get(self.metric)
+        t = result.get(self.time_attr, 0)
+        if metric is None:
+            return CONTINUE
+        if t >= self.max_t:
+            return STOP
+        metric = self._sign(metric)
+        for m in self.milestones:
+            if t >= m and trial.trial_id not in self._rungs[m]:
+                self._rungs[m][trial.trial_id] = metric
+        # synchronous cut: once a rung's full population reported,
+        # stop the bottom 1-1/eta fraction together
+        for m in self.milestones:
+            rung = self._rungs[m]
+            undecided = [
+                tid
+                for tid in self._eligible(runner, m)
+                if tid not in self._decided[m]
+            ]
+            if undecided and all(tid in rung for tid in undecided):
+                ranked = sorted(
+                    undecided, key=lambda tid: rung[tid], reverse=True
+                )
+                keep = max(1, int(len(ranked) / self.eta))
+                for tid in ranked[keep:]:
+                    self._stopped_at.setdefault(tid, m)
+                for tid in undecided:
+                    self._decided[m].add(tid)
+        return (
+            STOP if trial.trial_id in self._stopped_at else CONTINUE
+        )
+
+    def on_trial_complete(self, runner, trial, result: Dict) -> None:
+        self._done.add(trial.trial_id)
 
 
 class PopulationBasedTraining(TrialScheduler):
