@@ -461,13 +461,17 @@ class _Runtime:
         num_returns = options.get("num_returns", 1)
         task_id = uuid.uuid4().hex
         name = options.get("name") or getattr(func, "__name__", "task")
-        refs = [ObjectRef(task_id, self.store)]
+        # NOTE: no base-task_id ObjectRef in the multi-return case —
+        # a created-then-discarded handle would refcount the base
+        # entry to zero and free the tuple out from under the split
         if num_returns > 1:
             refs = [
                 ObjectRef(f"{task_id}_{i}", self.store)
                 for i in range(num_returns)
             ]
             self._register_split(task_id, refs)
+        else:
+            refs = [ObjectRef(task_id, self.store)]
 
         pg = None
         bundle_index = -1
@@ -516,9 +520,12 @@ class _Runtime:
             except BaseException as e:  # propagate error to all returns
                 for r in refs:
                     self.store.put_error(r.id, e)
+                self.store.free([task_id])
                 return
             for r, v in zip(refs, values):
                 self.store.put(r.id, v, use_shm=False)
+            # nothing holds a handle to the base tuple entry
+            self.store.free([task_id])
 
         self.store.on_ready(task_id, split)
 
@@ -530,6 +537,17 @@ class _Runtime:
             if isinstance(a, ObjectRef) and not self.store.is_ready(a.id)
         ]
         if not deps:
+            # pin the argument refs on the record: marshalling strips
+            # them from the msg, but the entries (shm segments) must
+            # outlive dispatch AND any retries — the task record is
+            # exactly that lifetime (reference_count.h's
+            # task-dependency references)
+            trec.arg_refs = [
+                a
+                for a in list(trec.msg["args"])
+                + list(trec.msg["kwargs"].values())
+                if isinstance(a, ObjectRef)
+            ]
             m_args = [self._marshal_arg(a) for a in trec.msg["args"]]
             m_kwargs = {
                 k: self._marshal_arg(v) for k, v in trec.msg["kwargs"].items()
@@ -645,6 +663,13 @@ class _Runtime:
             options.get("max_restarts", 0),
             daemon=bool(options.get("daemon", True)),
         )
+        # constructor ref args stay pinned for the actor's LIFETIME:
+        # a restart replays init_msg, which re-attaches their shm
+        rec.arg_refs = [
+            a
+            for a in list(args) + list(kwargs.values())
+            if isinstance(a, ObjectRef)
+        ]
         name = options.get("name")
         with self.lock:
             self.actors[actor_id] = rec
@@ -709,17 +734,25 @@ class _Runtime:
             # neither acquire nor release scheduler CPUs
             num_cpus=0,
         )
+        # pin shm-backed argument refs until the call completes (see
+        # _submit_when_ready)
+        trec.arg_refs = [
+            a
+            for a in list(args) + list(kwargs.values())
+            if isinstance(a, ObjectRef)
+        ]
         w = rec.worker
         with self.lock:
             w.inflight[task_id] = trec
         self._send_task(w, trec)
-        refs = [ObjectRef(task_id, self.store)]
         if num_returns > 1:
             refs = [
                 ObjectRef(f"{task_id}_{i}", self.store)
                 for i in range(num_returns)
             ]
             self._register_split(task_id, refs)
+        else:
+            refs = [ObjectRef(task_id, self.store)]
         return refs
 
     def kill_actor(self, actor_id: str, no_restart: bool = True):

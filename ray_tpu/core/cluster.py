@@ -219,13 +219,14 @@ class RemoteNode:
                 )
         from ray_tpu.core.api import ObjectRef
 
-        refs = [ObjectRef(task_id, self.runtime.store)]
         if num_returns > 1:
             refs = [
                 ObjectRef(f"{task_id}_{i}", self.runtime.store)
                 for i in range(num_returns)
             ]
             self.runtime._register_split(task_id, refs)
+        else:
+            refs = [ObjectRef(task_id, self.runtime.store)]
         return refs
 
     def kill(self, actor_id):

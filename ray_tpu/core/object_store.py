@@ -49,15 +49,43 @@ class Segment(shared_memory.SharedMemory):
             pass
 
 
+def _ambient_store():
+    """The driver's object store, if this process is the driver.
+    Worker processes have no runtime, and node-AGENT processes (which
+    do run api.init) are not the owner either — refs deserialized
+    there point at the HEAD's objects, so counting them against the
+    agent's local store would only plant phantom entries. Both stay
+    untracked (the driver owns every object's lifetime, DISPOSITIONS
+    single-owner posture)."""
+    from ray_tpu.core import api
+
+    rt = api._runtime
+    if rt is None or getattr(rt, "node_agent", None) is not None:
+        return None
+    return rt.store
+
+
 class ObjectRef:
     """Future handle to a task result or put object
-    (reference ``python/ray/_raylet.pyx ObjectRef``)."""
+    (reference ``python/ray/_raylet.pyx ObjectRef``).
 
-    __slots__ = ("id", "_store")
+    Driver-side handles are REFERENCE COUNTED (the local-handle half
+    of the reference's ``core_worker/reference_count.h:61``): every
+    live ObjectRef instance in the driver process — including task
+    records pinning their argument refs for retries, and handles
+    deserialized from results — holds the object; when the last one
+    is garbage collected the entry is freed (immediately if ready,
+    else when the in-flight result lands). Explicit ``ray.free()``
+    still force-frees."""
+
+    __slots__ = ("id", "_store", "_owned")
 
     def __init__(self, id: Optional[str] = None, store=None):
         self.id = id or uuid.uuid4().hex
-        self._store = store
+        self._store = store if store is not None else _ambient_store()
+        self._owned = self._store is not None
+        if self._owned:
+            self._store.incref(self.id)
 
     def __hash__(self):
         return hash(self.id)
@@ -72,8 +100,16 @@ class ObjectRef:
         return f"ObjectRef({self.id[:16]})"
 
     def __reduce__(self):
-        # Refs pickle as bare ids; the receiving side re-binds its store.
+        # Refs pickle as bare ids; the receiving side re-binds its
+        # store (and takes its own count if it is the driver).
         return (ObjectRef, (self.id,))
+
+    def __del__(self):
+        if getattr(self, "_owned", False):
+            try:
+                self._store.decref(self.id)
+            except Exception:
+                pass  # interpreter/store teardown
 
 
 class _Entry:
@@ -111,7 +147,11 @@ class ObjectStore:
         max_bytes: Optional[int] = None,
         spill_uri: Optional[str] = None,
     ):
-        self._lock = threading.Lock()
+        # RLock: ObjectRef.__del__ → decref can fire at ANY point the
+        # GC drops a last handle — including inside store methods that
+        # already hold the lock (freeing an entry drops its callbacks'
+        # closed-over refs). A plain Lock would self-deadlock there.
+        self._lock = threading.RLock()
         self._entries: Dict[str, _Entry] = {}
         self.max_bytes = max_bytes  # None → never spill
         self._resident_bytes = 0
@@ -122,6 +162,9 @@ class ObjectStore:
             "RAY_TPU_SPILL_URI", "file://"
         )
         self._storage = None  # constructed on first spill
+        # live driver-side handles per object (reference
+        # reference_count.h:61 local references)
+        self._refcounts: Dict[str, int] = {}
 
     def _spill_storage(self):
         if self._storage is None:
@@ -268,9 +311,47 @@ class ObjectStore:
         e = self._entries.get(obj_id)
         return e.shm.name if e and e.shm else None
 
+    def incref(self, obj_id: str) -> None:
+        with self._lock:
+            self._refcounts[obj_id] = (
+                self._refcounts.get(obj_id, 0) + 1
+            )
+
+    def decref(self, obj_id: str) -> None:
+        """Last driver handle gone → free the entry: now if the value
+        is ready, else when the in-flight result lands (a handle
+        re-acquired in between cancels the deferred free)."""
+        with self._lock:
+            n = self._refcounts.get(obj_id)
+            if n is None:
+                return
+            if n > 1:
+                self._refcounts[obj_id] = n - 1
+                return
+            self._refcounts.pop(obj_id, None)
+            e = self._entries.get(obj_id)
+            if e is not None and e.event.is_set():
+                # free INSIDE the lock (RLock, reentrant): freeing
+                # after release would race a concurrent incref from a
+                # handle deserialized on another thread
+                self.free([obj_id])
+                return
+
+        def _free_if_unreferenced():
+            with self._lock:
+                if self._refcounts.get(obj_id, 0) > 0:
+                    return
+                self.free([obj_id])
+
+        self.on_ready(obj_id, _free_if_unreferenced)
+
     def free(self, obj_ids) -> None:
         with self._lock:
             for oid in obj_ids:
+                # drop the handle count too: a later decref on an
+                # explicitly freed id must be a no-op, not a deferred
+                # free that resurrects a phantom entry via on_ready
+                self._refcounts.pop(oid, None)
                 e = self._entries.pop(oid, None)
                 if e is not None and e.spill_path is not None:
                     try:

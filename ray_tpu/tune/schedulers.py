@@ -106,17 +106,18 @@ class MedianStoppingRule(TrialScheduler):
         time_attr: str = "training_iteration",
         grace_period: int = 1,
         min_samples_required: int = 3,
-        hard_stop: bool = True,
     ):
+        # the reference also offers hard_stop=False (PAUSE instead of
+        # STOP); this runner has no pause/resume, so below-median
+        # trials always hard-stop — offering the flag would be a
+        # silent no-op
         self.metric = metric
         self.mode = mode
         self.time_attr = time_attr
         self.grace_period = grace_period
         self.min_samples = min_samples_required
-        self.hard_stop = hard_stop
         # trial_id -> list of (t, metric) results seen
         self._history: Dict[str, List] = {}
-        self._completed: set = set()
 
     def _sign(self, v: float) -> float:
         return -v if self.mode == "min" else v
@@ -147,11 +148,8 @@ class MedianStoppingRule(TrialScheduler):
         median = others[len(others) // 2]
         best = max(m for (_, m) in self._history[trial.trial_id])
         if best < median:
-            return STOP if self.hard_stop else PAUSE
+            return STOP
         return CONTINUE
-
-    def on_trial_complete(self, runner, trial, result: Dict) -> None:
-        self._completed.add(trial.trial_id)
 
 
 class HyperBandScheduler(TrialScheduler):
@@ -170,6 +168,10 @@ class HyperBandScheduler(TrialScheduler):
         max_t: int = 81,
         reduction_factor: float = 3,
     ):
+        if reduction_factor <= 1:
+            raise ValueError(
+                f"reduction_factor must be > 1, got {reduction_factor}"
+            )
         self.metric = metric
         self.mode = mode
         self.time_attr = time_attr

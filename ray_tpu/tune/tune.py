@@ -405,6 +405,12 @@ class TrialRunner:
             self.search_alg.on_trial_complete(
                 trial.trial_id, error=True
             )
+        # schedulers must learn about errored trials too — a
+        # synchronous rung (HyperBand) would otherwise wait on the
+        # dead trial's report forever
+        self.scheduler.on_trial_complete(
+            self, trial, trial.last_result or {}
+        )
         self._cleanup_trial(trial)
         self._save_experiment_state()
 
