@@ -15,7 +15,11 @@ import ray_tpu as ray
 
 @pytest.fixture(scope="module")
 def ray_start():
-    ray.init(num_cpus=2, ignore_reinit_error=True)
+    # own the runtime: an earlier test file may have left one alive
+    # with fewer CPUs (ignore_reinit_error would silently keep it and
+    # break the resource-count assertions below)
+    ray.shutdown()
+    ray.init(num_cpus=2)
     yield
     ray.shutdown()
 
