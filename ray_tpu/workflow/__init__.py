@@ -1,3 +1,23 @@
-from ray_tpu.workflow.workflow import run, run_async, step
+from ray_tpu.workflow.workflow import (
+    WorkflowCanceledError,
+    cancel,
+    get_output,
+    get_status,
+    list_all,
+    resume,
+    run,
+    run_async,
+    step,
+)
 
-__all__ = ["step", "run", "run_async"]
+__all__ = [
+    "step",
+    "run",
+    "run_async",
+    "list_all",
+    "get_status",
+    "get_output",
+    "resume",
+    "cancel",
+    "WorkflowCanceledError",
+]

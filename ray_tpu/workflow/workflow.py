@@ -5,54 +5,108 @@ lazy DAG nodes of ``python/ray/dag/dag_node.py``: ``@workflow.step``
 functions bind into a DAG; ``workflow.run(node, workflow_id, storage)``
 executes it with every step's result checkpointed to disk, so a re-run
 of the same workflow_id resumes — completed steps are skipped and their
-stored results reused."""
+stored results reused.
+
+Beyond the DAG core, this module carries the reference's step options
+(``max_retries`` with backoff, ``catch_exceptions`` —
+``workflow/api.py step options``), dynamic continuations (a step may
+RETURN another ``StepNode``; the engine keeps resolving — the
+reference's ``workflow.continuation``), and the management surface
+(``list_all / get_status / get_output / resume / cancel`` —
+``workflow/api.py`` management functions) backed by per-workflow
+status + DAG files, so a workflow can be resumed by id alone after a
+driver restart.
+"""
 
 from __future__ import annotations
 
 import hashlib
+import json
 import os
 import pickle
-from typing import Any, Callable, Dict, List, Optional
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import ray_tpu as ray
 
 _DEFAULT_STORAGE = os.path.expanduser("~/.ray_tpu_workflows")
 
+RUNNING = "RUNNING"
+SUCCEEDED = "SUCCEEDED"
+FAILED = "FAILED"
+CANCELED = "CANCELED"
+
 
 class StepNode:
     """Lazy DAG node (reference dag/dag_node.py DAGNode)."""
 
-    def __init__(self, fn: Callable, args, kwargs):
+    def __init__(
+        self,
+        fn: Callable,
+        args,
+        kwargs,
+        *,
+        max_retries: int = 0,
+        retry_delay_s: float = 0.1,
+        catch_exceptions: bool = False,
+        name: Optional[str] = None,
+    ):
         self.fn = fn
         self.args = args
         self.kwargs = kwargs
+        self.max_retries = max_retries
+        self.retry_delay_s = retry_delay_s
+        self.catch_exceptions = catch_exceptions
+        self.name = name or fn.__name__
 
     def _step_id(self, resolved_args, resolved_kwargs) -> str:
-        """Deterministic id from the function name + argument values
+        """Deterministic id from the step name + argument values
         (content-addressed resume: same step, same inputs -> cached)."""
         try:
             blob = pickle.dumps(
-                (self.fn.__name__, resolved_args, resolved_kwargs)
+                (self.name, resolved_args, resolved_kwargs)
             )
         except Exception:
             blob = repr(
-                (self.fn.__name__, resolved_args, resolved_kwargs)
+                (self.name, resolved_args, resolved_kwargs)
             ).encode()
         return (
-            f"{self.fn.__name__}-"
+            f"{self.name}-"
             f"{hashlib.sha256(blob).hexdigest()[:16]}"
         )
 
     def __repr__(self):
-        return f"StepNode({self.fn.__name__})"
+        return f"StepNode({self.name})"
 
 
 class _StepFunction:
-    def __init__(self, fn: Callable):
+    def __init__(self, fn: Callable, opts: Optional[Dict] = None):
         self.fn = fn
+        self._opts = dict(opts or {})
+
+    def options(
+        self,
+        *,
+        max_retries: Optional[int] = None,
+        retry_delay_s: Optional[float] = None,
+        catch_exceptions: Optional[bool] = None,
+        name: Optional[str] = None,
+    ) -> "_StepFunction":
+        """reference ``Step.options(max_retries=…,
+        catch_exceptions=…)``."""
+        opts = dict(self._opts)
+        for k, v in (
+            ("max_retries", max_retries),
+            ("retry_delay_s", retry_delay_s),
+            ("catch_exceptions", catch_exceptions),
+            ("name", name),
+        ):
+            if v is not None:
+                opts[k] = v
+        return _StepFunction(self.fn, opts)
 
     def bind(self, *args, **kwargs) -> StepNode:
-        return StepNode(self.fn, args, kwargs)
+        return StepNode(self.fn, args, kwargs, **self._opts)
 
     # calling directly runs eagerly (convenience)
     def __call__(self, *args, **kwargs):
@@ -64,8 +118,14 @@ def step(fn: Callable) -> _StepFunction:
     return _StepFunction(fn)
 
 
+class _Canceled(BaseException):
+    pass
+
+
 class _Execution:
     def __init__(self, workflow_id: str, storage: str):
+        self.workflow_id = workflow_id
+        self.storage = storage
         self.dir = os.path.join(storage, workflow_id)
         os.makedirs(self.dir, exist_ok=True)
         self.steps_run: List[str] = []
@@ -73,6 +133,24 @@ class _Execution:
 
     def _path(self, step_id: str) -> str:
         return os.path.join(self.dir, f"{step_id}.pkl")
+
+    def _check_canceled(self):
+        if _read_status(self.dir).get("status") == CANCELED:
+            raise _Canceled(self.workflow_id)
+
+    def _run_step(self, node: StepNode, args, kwargs):
+        attempts = node.max_retries + 1
+        for k in range(attempts):
+            self._check_canceled()
+            try:
+                value = node.fn(*args, **kwargs)
+                return (value, None) if node.catch_exceptions else value
+            except Exception as e:
+                if k + 1 >= attempts:
+                    if node.catch_exceptions:
+                        return (None, e)
+                    raise
+                time.sleep(node.retry_delay_s * (2**k))
 
     def resolve(self, node: Any):
         if isinstance(node, StepNode):
@@ -86,8 +164,14 @@ class _Execution:
                 self.steps_cached.append(step_id)
                 with open(path, "rb") as f:
                     return pickle.load(f)
-            value = node.fn(*args, **kwargs)
-            tmp = path + ".tmp"
+            value = self._run_step(node, args, kwargs)
+            # dynamic continuation (reference workflow.continuation):
+            # a step returning a StepNode hands control to a NEW
+            # sub-DAG, resolved (and checkpointed) before this step's
+            # own result is recorded
+            while isinstance(value, StepNode):
+                value = self.resolve(value)
+            tmp = path + f".tmp{os.getpid()}"
             with open(tmp, "wb") as f:
                 pickle.dump(value, f)
             os.replace(tmp, path)  # atomic: crash-safe checkpoint
@@ -100,6 +184,26 @@ class _Execution:
         return node
 
 
+# -- per-workflow metadata (status + stored DAG) ---------------------------
+
+
+def _read_status(wf_dir: str) -> Dict:
+    try:
+        with open(os.path.join(wf_dir, "status.json")) as f:
+            return json.load(f)
+    except (FileNotFoundError, json.JSONDecodeError):
+        return {}
+
+
+def _write_status(wf_dir: str, **fields) -> None:
+    cur = _read_status(wf_dir)
+    cur.update(fields)
+    tmp = os.path.join(wf_dir, f"status.json.tmp{os.getpid()}")
+    with open(tmp, "w") as f:
+        json.dump(cur, f)
+    os.replace(tmp, os.path.join(wf_dir, "status.json"))
+
+
 def run(
     dag: StepNode,
     *,
@@ -108,11 +212,43 @@ def run(
 ) -> Any:
     """Execute the DAG durably; resuming a workflow_id skips completed
     steps (reference workflow.run + resume)."""
-    ex = _Execution(workflow_id, storage or _DEFAULT_STORAGE)
-    result = ex.resolve(dag)
+    storage = storage or _DEFAULT_STORAGE
+    ex = _Execution(workflow_id, storage)
+    # persist the DAG so resume(workflow_id) works from the id alone
+    # (cloudpickle: step closures serialize too)
+    dag_path = os.path.join(ex.dir, "dag.pkl")
+    if not os.path.exists(dag_path):
+        try:
+            from ray_tpu.core import serialization as _ser
+
+            with open(dag_path + ".tmp", "wb") as f:
+                f.write(_ser.dumps(dag))
+            os.replace(dag_path + ".tmp", dag_path)
+        except Exception:
+            pass  # truly unpicklable DAG: resume-by-id unavailable
+    _write_status(
+        ex.dir, status=RUNNING, start_time=time.time(), end_time=None
+    )
+    try:
+        result = ex.resolve(dag)
+    except _Canceled:
+        _write_status(ex.dir, end_time=time.time())
+        raise WorkflowCanceledError(workflow_id) from None
+    except BaseException as e:
+        _write_status(
+            ex.dir, status=FAILED, end_time=time.time(), error=repr(e)
+        )
+        raise
+    with open(os.path.join(ex.dir, "__result__.pkl"), "wb") as f:
+        pickle.dump(result, f)
+    _write_status(ex.dir, status=SUCCEEDED, end_time=time.time())
     # expose execution stats for tests/observability
     run.last_execution = ex  # type: ignore[attr-defined]
     return result
+
+
+class WorkflowCanceledError(RuntimeError):
+    pass
 
 
 @ray.remote
@@ -130,3 +266,67 @@ def run_async(
     return _run_remote.remote(
         dag, workflow_id, storage or _DEFAULT_STORAGE
     )
+
+
+# -- management API (reference workflow/api.py) ----------------------------
+
+
+def list_all(
+    storage: Optional[str] = None,
+) -> List[Tuple[str, str]]:
+    """[(workflow_id, status)] for every workflow in the storage
+    (reference workflow.list_all)."""
+    storage = storage or _DEFAULT_STORAGE
+    out = []
+    try:
+        ids = sorted(os.listdir(storage))
+    except FileNotFoundError:
+        return []
+    for wid in ids:
+        wf_dir = os.path.join(storage, wid)
+        if os.path.isdir(wf_dir):
+            out.append((wid, _read_status(wf_dir).get("status", "")))
+    return out
+
+
+def get_status(
+    workflow_id: str, storage: Optional[str] = None
+) -> Optional[str]:
+    wf_dir = os.path.join(storage or _DEFAULT_STORAGE, workflow_id)
+    return _read_status(wf_dir).get("status")
+
+
+def get_output(workflow_id: str, storage: Optional[str] = None) -> Any:
+    """Stored final result of a SUCCEEDED workflow (reference
+    workflow.get_output)."""
+    path = os.path.join(
+        storage or _DEFAULT_STORAGE, workflow_id, "__result__.pkl"
+    )
+    with open(path, "rb") as f:
+        return pickle.load(f)
+
+
+def resume(workflow_id: str, storage: Optional[str] = None) -> Any:
+    """Re-run a workflow from its stored DAG; completed steps load
+    from their checkpoints (reference workflow.resume)."""
+    storage = storage or _DEFAULT_STORAGE
+    dag_path = os.path.join(storage, workflow_id, "dag.pkl")
+    try:
+        from ray_tpu.core import serialization as _ser
+
+        with open(dag_path, "rb") as f:
+            dag = _ser.loads(f.read())
+    except FileNotFoundError:
+        raise ValueError(
+            f"workflow {workflow_id!r} has no stored DAG to resume"
+        ) from None
+    return run(dag, workflow_id=workflow_id, storage=storage)
+
+
+def cancel(workflow_id: str, storage: Optional[str] = None) -> None:
+    """Mark a workflow canceled; its execution stops before the next
+    step starts (reference workflow.cancel — cooperative, like the
+    reference's checkpoint-boundary cancellation)."""
+    wf_dir = os.path.join(storage or _DEFAULT_STORAGE, workflow_id)
+    os.makedirs(wf_dir, exist_ok=True)
+    _write_status(wf_dir, status=CANCELED, end_time=time.time())

@@ -1,0 +1,124 @@
+"""Workflow step options, continuations, and management API
+(reference ``python/ray/workflow/tests``: test_basic_workflows
+retry/catch cases, test_dag continuation, management API tests)."""
+
+import pytest
+
+from ray_tpu import workflow
+
+
+def test_step_retries_until_success(tmp_path):
+    calls = {"n": 0}
+
+    @workflow.step
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise RuntimeError("transient")
+        return "ok"
+
+    out = workflow.run(
+        flaky.options(max_retries=3, retry_delay_s=0.01).bind(),
+        workflow_id="wf_retry",
+        storage=str(tmp_path),
+    )
+    assert out == "ok" and calls["n"] == 3
+
+
+def test_catch_exceptions_returns_pair(tmp_path):
+    @workflow.step
+    def boom():
+        raise ValueError("nope")
+
+    @workflow.step
+    def fine():
+        return 7
+
+    v, err = workflow.run(
+        boom.options(catch_exceptions=True).bind(),
+        workflow_id="wf_catch1",
+        storage=str(tmp_path),
+    )
+    assert v is None and isinstance(err, ValueError)
+    v, err = workflow.run(
+        fine.options(catch_exceptions=True).bind(),
+        workflow_id="wf_catch2",
+        storage=str(tmp_path),
+    )
+    assert v == 7 and err is None
+
+
+def test_exhausted_retries_fail_workflow(tmp_path):
+    @workflow.step
+    def always():
+        raise RuntimeError("permanent")
+
+    with pytest.raises(RuntimeError, match="permanent"):
+        workflow.run(
+            always.options(max_retries=1, retry_delay_s=0.01).bind(),
+            workflow_id="wf_fail",
+            storage=str(tmp_path),
+        )
+    assert workflow.get_status("wf_fail", str(tmp_path)) == "FAILED"
+
+
+def test_dynamic_continuation(tmp_path):
+    """A step returning a StepNode continues the workflow (reference
+    workflow.continuation); recursion checkpoints each hop."""
+
+    @workflow.step
+    def countdown(n):
+        if n == 0:
+            return "liftoff"
+        return countdown.bind(n - 1)
+
+    out = workflow.run(
+        countdown.bind(3),
+        workflow_id="wf_cont",
+        storage=str(tmp_path),
+    )
+    assert out == "liftoff"
+    # each recursion level checkpointed (4 ids: n=3..0)
+    assert len(workflow.run.last_execution.steps_run) == 4
+
+
+def test_management_api_and_resume_by_id(tmp_path):
+    calls = {"n": 0}
+
+    @workflow.step
+    def work(x):
+        calls["n"] += 1
+        return x * 2
+
+    out = workflow.run(
+        work.bind(21), workflow_id="wf_mgmt", storage=str(tmp_path)
+    )
+    assert out == 42
+    assert ("wf_mgmt", "SUCCEEDED") in workflow.list_all(str(tmp_path))
+    assert workflow.get_status("wf_mgmt", str(tmp_path)) == "SUCCEEDED"
+    assert workflow.get_output("wf_mgmt", str(tmp_path)) == 42
+    # resume by id alone: stored DAG, cached steps -> no re-execution
+    assert workflow.resume("wf_mgmt", str(tmp_path)) == 42
+    assert calls["n"] == 1
+    with pytest.raises(ValueError):
+        workflow.resume("no_such_wf", str(tmp_path))
+
+
+def test_cancel_stops_before_next_step(tmp_path):
+    @workflow.step
+    def first():
+        # cancel mid-flight: the NEXT step must not start
+        workflow.cancel("wf_cancel", str(tmp_path))
+        return 1
+
+    @workflow.step
+    def second(x):
+        raise AssertionError("must not run")
+
+    with pytest.raises(workflow.WorkflowCanceledError):
+        workflow.run(
+            second.bind(first.bind()),
+            workflow_id="wf_cancel",
+            storage=str(tmp_path),
+        )
+    assert workflow.get_status("wf_cancel", str(tmp_path)) == "CANCELED"
