@@ -1,17 +1,25 @@
 from ray_tpu.serve.serve import (
     Deployment,
     DeploymentHandle,
+    DeploymentResponse,
+    HTTPDeploymentHandle,
     deployment,
     get_deployment,
+    get_deployment_handle,
     run,
     shutdown,
+    update_deployment,
 )
 
 __all__ = [
     "deployment",
     "Deployment",
     "DeploymentHandle",
+    "DeploymentResponse",
+    "HTTPDeploymentHandle",
     "run",
     "get_deployment",
+    "get_deployment_handle",
+    "update_deployment",
     "shutdown",
 ]

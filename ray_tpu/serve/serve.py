@@ -169,6 +169,26 @@ class DeploymentHandle:
         self._stop.set()
 
 
+def _spawn_replica_actor(spec: "Deployment", user_config):
+    """Spawn one replica actor. Replicas are born knowing the ingress
+    address so code INSIDE them can compose onto other deployments
+    via get_deployment_handle() (the reference's model-composition
+    DeploymentHandles, serve/handle.py — here routed over the HTTP
+    ingress, since replica processes hold no actor handles)."""
+    opts = {}
+    if _HTTP_SERVER is not None:
+        host, port = _HTTP_SERVER.server_address[:2]
+        opts["runtime_env"] = {
+            "env_vars": {"RAY_TPU_SERVE_HTTP": f"http://{host}:{port}"}
+        }
+    return _Replica.options(**opts).remote(
+        spec._cls_or_fn,
+        spec._init_args,
+        spec._init_kwargs,
+        user_config,
+    )
+
+
 class RunningDeployment:
     """Controller state for one deployment: replica membership, config
     version, and the autoscale loop (the ServeController role,
@@ -205,12 +225,7 @@ class RunningDeployment:
             self.autoscaling = None
 
     def _spawn_replica(self):
-        return _Replica.remote(
-            self.spec._cls_or_fn,
-            self.spec._init_args,
-            self.spec._init_kwargs,
-            self.user_config,
-        )
+        return _spawn_replica_actor(self.spec, self.user_config)
 
     def _publish(self):
         with self._members_lock:
@@ -377,12 +392,7 @@ class Deployment:
                 self.autoscaling_config.get("min_replicas", 1), 1
             )
         replicas = [
-            _Replica.remote(
-                self._cls_or_fn,
-                self._init_args,
-                self._init_kwargs,
-                self.user_config,
-            )
+            _spawn_replica_actor(self, self.user_config)
             for _ in range(n)
         ]
         old = _DEPLOYMENTS.pop(self.name, None)
@@ -429,11 +439,90 @@ def run(
     http_port: int = 0,
 ) -> DeploymentHandle:
     """Deploy + optionally start the HTTP ingress (reference
-    serve.run + http_proxy.py)."""
-    handle = target.deploy()
+    serve.run + http_proxy.py). The ingress starts FIRST so the
+    deployment's replicas are born knowing its address — composition
+    handles inside replicas route through it."""
     if http_host is not None:
         _start_http(http_host, http_port)
+    handle = target.deploy()
     return handle
+
+
+class DeploymentResponse:
+    """Future-shaped result of an HTTP-routed handle call (the
+    reference's ``DeploymentResponse``): ``.result(timeout)`` blocks
+    for the value."""
+
+    def __init__(self, fetch):
+        self._done = threading.Event()
+        self._value = None
+        self._error = None
+
+        def _run():
+            try:
+                self._value = fetch()
+            except BaseException as e:
+                self._error = e
+            finally:
+                self._done.set()
+
+        threading.Thread(target=_run, daemon=True).start()
+
+    def result(self, timeout: Optional[float] = 60.0):
+        if not self._done.wait(timeout):
+            raise TimeoutError("deployment call did not complete")
+        if self._error is not None:
+            raise self._error
+        return self._value
+
+
+class HTTPDeploymentHandle:
+    """Handle usable from INSIDE a replica (or any process that can
+    reach the ingress): calls route over HTTP, so composition works
+    without actor handles. Payloads and results are JSON."""
+
+    def __init__(self, name: str, base_url: str):
+        self.name = name
+        self.url = f"{base_url.rstrip('/')}/{name}"
+
+    def remote(self, payload=None) -> DeploymentResponse:
+        import urllib.request
+
+        def fetch():
+            req = urllib.request.Request(
+                self.url,
+                data=json.dumps(payload or {}).encode(),
+                headers={"Content-Type": "application/json"},
+                method="POST",
+            )
+            with urllib.request.urlopen(req, timeout=60.0) as resp:
+                out = json.loads(resp.read())
+            if "error" in out:
+                raise RuntimeError(out["error"])
+            return out["result"]
+
+        return DeploymentResponse(fetch)
+
+
+def get_deployment_handle(name: str):
+    """Composition-safe handle lookup (reference
+    ``serve.get_deployment_handle``): on the driver this is the
+    actor-routing DeploymentHandle; inside a replica it is an
+    HTTP-routing handle whose ``.remote()`` returns a
+    DeploymentResponse (use ``.result()``)."""
+    dep = _DEPLOYMENTS.get(name)
+    if dep is not None:
+        return dep.handle
+    import os
+
+    url = os.environ.get("RAY_TPU_SERVE_HTTP")
+    if url:
+        return HTTPDeploymentHandle(name, url)
+    raise ValueError(
+        f"no deployment {name!r} here and no ingress address "
+        "(RAY_TPU_SERVE_HTTP) — was the HTTP server started before "
+        "this replica spawned?"
+    )
 
 
 def get_deployment(name: str) -> DeploymentHandle:

@@ -1,0 +1,81 @@
+"""Serve model composition: a replica calls another deployment
+(reference ``serve/handle.py`` DeploymentHandle composition +
+``DeploymentResponse``). Replica processes hold no actor handles, so
+their composition handles route through the HTTP ingress; the driver
+gets the actor-routing handle from the same lookup."""
+
+import json
+import urllib.request
+
+import pytest
+
+import ray_tpu as ray
+from ray_tpu import serve
+
+
+@pytest.fixture(autouse=True)
+def _cluster():
+    ray.init(num_cpus=4, ignore_reinit_error=True)
+    yield
+    serve.shutdown()
+
+
+def test_replica_composes_onto_another_deployment():
+    @serve.deployment(name="adder")
+    class Adder:
+        def __call__(self, payload):
+            return payload["x"] + 1
+
+    @serve.deployment(name="chain")
+    class Chain:
+        def __call__(self, payload):
+            h = serve.get_deployment_handle("adder")
+            once = h.remote({"x": payload["x"]}).result()
+            twice = h.remote({"x": once}).result()
+            return {"twice": twice}
+
+    serve.run(Adder.bind(), http_host="127.0.0.1")
+    handle = serve.run(Chain.bind(), http_host="127.0.0.1")
+    out = ray.get(handle.remote({"x": 5}), timeout=60)
+    assert out == {"twice": 7}
+
+
+def test_driver_side_lookup_returns_actor_handle():
+    @serve.deployment(name="echo2")
+    class Echo:
+        def __call__(self, payload):
+            return payload
+
+    serve.run(Echo.bind(), http_host="127.0.0.1")
+    h = serve.get_deployment_handle("echo2")
+    assert isinstance(h, serve.DeploymentHandle)
+    assert ray.get(h.remote({"a": 1}), timeout=60) == {"a": 1}
+    with pytest.raises(ValueError):
+        serve.get_deployment_handle("nope")
+
+
+def test_composition_through_http_end_to_end():
+    """External request -> chain deployment -> adder deployment."""
+
+    @serve.deployment(name="base")
+    class Base:
+        def __call__(self, payload):
+            return payload["v"] * 10
+
+    @serve.deployment(name="front")
+    class Front:
+        def __call__(self, payload):
+            h = serve.get_deployment_handle("base")
+            return h.remote({"v": payload["v"]}).result() + 1
+
+    serve.run(Base.bind(), http_host="127.0.0.1")
+    serve.run(Front.bind(), http_host="127.0.0.1")
+    port = serve.serve.http_port()
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/front",
+        data=json.dumps({"v": 4}).encode(),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=60) as resp:
+        assert json.loads(resp.read())["result"] == 41
