@@ -35,7 +35,7 @@ class _TrainWorker:
         self._results: List[Dict] = []
         self._checkpoint = None
 
-    def run(self, train_func, config, checkpoint=None):
+    def run(self, train_func, config, checkpoint=None, ckpt_path=None):
         from ray_tpu.air import session as air_session
 
         # fresh state per run: workers are reused across Trainer.run
@@ -47,6 +47,16 @@ class _TrainWorker:
             self._results.append(metrics)
             if ckpt is not None:
                 self._checkpoint = ckpt
+                if ckpt_path and self.rank == 0:
+                    # durable mid-run checkpoint: the group-restart
+                    # path resumes from here if a worker dies
+                    # (reference train fault tolerance)
+                    import os
+
+                    tmp = f"{ckpt_path}.tmp{os.getpid()}"
+                    with open(tmp, "wb") as f:
+                        f.write(ckpt.to_bytes())
+                    os.replace(tmp, ckpt_path)
 
         air_session._init_session(
             self.rank, self.world_size, report_fn, checkpoint
@@ -78,10 +88,19 @@ class Trainer:
         num_workers: int = 1,
         use_distributed: bool = False,
         resources_per_worker: Optional[Dict] = None,
+        max_failures: int = 0,
+        checkpoint_dir: Optional[str] = None,
     ):
+        """``max_failures`` > 0 enables worker-group fault tolerance
+        (reference train fault tolerance: on a dead worker the whole
+        group restarts and the train_func resumes from the latest
+        reported checkpoint — which requires ``checkpoint_dir`` so
+        mid-run checkpoints survive the dead actors)."""
         self.backend = backend
         self.num_workers = int(num_workers)
         self.use_distributed = use_distributed
+        self.max_failures = int(max_failures)
+        self.checkpoint_dir = checkpoint_dir
         self._workers: List = []
 
     def _free_port(self) -> int:
@@ -148,12 +167,42 @@ class Trainer:
                 )
             return _fn(cfg)
 
-        refs = [
-            w.run.remote(wrapped, cfg, checkpoint)
-            for w, cfg in zip(self._workers, per_worker_config)
-        ]
-        outs = ray.get(refs)
-        ray.free(refs)
+        ckpt_path = None
+        if self.checkpoint_dir:
+            import os
+
+            os.makedirs(self.checkpoint_dir, exist_ok=True)
+            ckpt_path = os.path.join(
+                self.checkpoint_dir, "latest_checkpoint.bin"
+            )
+
+        failures_left = self.max_failures
+        while True:
+            refs = [
+                w.run.remote(wrapped, cfg, checkpoint, ckpt_path)
+                for w, cfg in zip(self._workers, per_worker_config)
+            ]
+            try:
+                outs = ray.get(refs)
+                ray.free(refs)
+                break
+            except Exception:
+                if failures_left <= 0:
+                    raise
+                failures_left -= 1
+                # a worker died: restart the whole group (reference
+                # backend_executor group restart) and resume from the
+                # latest durable checkpoint, if any
+                self.shutdown()
+                self.start()
+                if ckpt_path:
+                    import os
+
+                    if os.path.exists(ckpt_path):
+                        with open(ckpt_path, "rb") as f:
+                            checkpoint = Checkpoint.from_bytes(
+                                f.read()
+                            )
         metrics_per_worker = [o["results"] for o in outs]
         rank0 = metrics_per_worker[0]
         checkpoint_out = None

@@ -1,0 +1,65 @@
+"""Train worker-group fault tolerance (reference train fault
+tolerance tests: a dead worker restarts the group and training
+resumes from the latest reported checkpoint)."""
+
+import os
+
+import pytest
+
+import ray_tpu as ray
+from ray_tpu.air import session
+from ray_tpu.air.checkpoint import Checkpoint
+from ray_tpu.train.trainer import Trainer
+
+
+@pytest.fixture(autouse=True)
+def _init():
+    ray.init(num_cpus=4, ignore_reinit_error=True)
+
+
+def test_group_restarts_and_resumes_from_checkpoint(tmp_path):
+    marker = str(tmp_path / "crashed_once")
+
+    def train_func(config):
+        start = 0
+        ckpt = session.get_checkpoint()
+        if ckpt is not None:
+            start = ckpt.to_dict()["iteration"] + 1
+        for it in range(start, 6):
+            if (
+                it == 3
+                and session.get_world_rank() == 0
+                and not os.path.exists(config["marker"])
+            ):
+                open(config["marker"], "w").close()
+                os._exit(1)  # kill this worker process mid-training
+            session.report(
+                {"iteration": it},
+                checkpoint=Checkpoint.from_dict({"iteration": it}),
+            )
+        return start
+
+    trainer = Trainer(
+        num_workers=2,
+        max_failures=1,
+        checkpoint_dir=str(tmp_path / "ckpts"),
+    )
+    result = trainer.run(train_func, {"marker": marker})
+    trainer.shutdown()
+    assert result.metrics == {"iteration": 5}
+    # the retry resumed from iteration 2's checkpoint, not from zero
+    resumed_iters = [
+        m["iteration"] for m in result.metrics_per_worker[0]
+    ]
+    assert resumed_iters[0] == 3 and resumed_iters[-1] == 5
+    assert os.path.exists(marker)
+
+
+def test_failure_budget_exhausted_raises(tmp_path):
+    def always_dies(config):
+        os._exit(1)
+
+    trainer = Trainer(num_workers=1, max_failures=1)
+    with pytest.raises(Exception):
+        trainer.run(always_dies, {})
+    trainer.shutdown()
