@@ -103,6 +103,9 @@ class _Runtime:
         self.total_resources = dict(resources or {})
         self.available_resources = dict(self.total_resources)
         self.store = ObjectStore(max_bytes=object_store_memory)
+        # workers currently parked in a nested blocking get — they
+        # lend their CPU and pool slot to their children
+        self.blocked_workers = 0
         self.ctx = mp.get_context("spawn")
         self.lock = threading.RLock()
         self.pool: List[_WorkerHandle] = []
@@ -173,17 +176,33 @@ class _Runtime:
 
     # -- worker lifecycle ------------------------------------------------
 
+    def _worker_api_server(self):
+        """Lazy singleton worker-API listener (nested ray.* calls)."""
+        with self.lock:
+            if getattr(self, "_api_server", None) is None:
+                from ray_tpu.core.worker_api import WorkerAPIServer
+
+                self._api_server = WorkerAPIServer(self)
+            return self._api_server
+
     def _spawn_worker(
         self, dedicated: bool = False, daemon: bool = True
     ) -> _WorkerHandle:
         worker_id = uuid.uuid4().hex[:12]
         parent_conn, child_conn = self.ctx.Pipe(duplex=True)
+        env = dict(self._worker_env)
+        # nested ray.* calls inside this worker route back here over
+        # the worker-API channel (core/worker_api.py)
+        env.setdefault(
+            "RAY_TPU_DRIVER_API", self._worker_api_server().address
+        )
+        env["RAY_TPU_WORKER_ID"] = worker_id
         # daemon=False is for actors that must spawn children of their
         # own (e.g. tune trial actors hosting an Algorithm with rollout
         # workers) — daemonic processes cannot have children.
         proc = self.ctx.Process(
             target=worker_main,
-            args=(child_conn, worker_id, dict(self._worker_env)),
+            args=(child_conn, worker_id, env),
             daemon=daemon,
             name=f"ray_tpu_worker_{worker_id}",
         )
@@ -386,7 +405,12 @@ class _Runtime:
                     if cand.idle and not cand.dead:
                         w = cand
                         break
-                if w is None and len(self.pool) < self.num_cpus:
+                # workers parked in a nested ray.get lend out both
+                # their CPU and their pool slot (worker_api.py)
+                cap = self.num_cpus + getattr(
+                    self, "blocked_workers", 0
+                )
+                if w is None and len(self.pool) < cap:
                     w = self._spawn_worker()
                     self.pool.append(w)
                 if w is None:
@@ -808,6 +832,10 @@ class _Runtime:
             finally:
                 self.state_store.close()
                 self.state_store = None
+        srv = getattr(self, "_api_server", None)
+        if srv is not None:
+            srv.shutdown()
+            self._api_server = None
         mon = getattr(self, "memory_monitor", None)
         if mon is not None:
             mon.stop()
@@ -953,7 +981,22 @@ def _require_runtime() -> _Runtime:
     return _runtime
 
 
+def _ambient_client():
+    """Worker-context driver-API client, if this process is a worker
+    (nested ray.* calls route to the driver instead of booting a
+    private runtime inside the worker — reference: every worker is a
+    CoreWorker and submits through its own task path)."""
+    if _runtime is not None:
+        return None
+    from ray_tpu.core.worker_api import worker_client
+
+    return worker_client()
+
+
 def put(value: Any) -> ObjectRef:
+    client = _ambient_client()
+    if client is not None:
+        return ObjectRef(client.put(value))
     rt = _require_runtime()
     ref = ObjectRef(uuid.uuid4().hex, rt.store)
     rt.store.put(ref.id, value)
@@ -965,6 +1008,11 @@ def get(
     *,
     timeout: Optional[float] = None,
 ):
+    client = _ambient_client()
+    if client is not None:
+        if isinstance(refs, ObjectRef):
+            return client.get(refs.id, timeout)
+        return [client.get(r.id, timeout) for r in refs]
     rt = _require_runtime()
     if isinstance(refs, ObjectRef):
         return rt.store.get(refs.id, timeout)
@@ -979,6 +1027,17 @@ def wait(
     fetch_local: bool = True,
 ) -> Tuple[List[ObjectRef], List[ObjectRef]]:
     """reference ray.wait (worker.py)."""
+    client = _ambient_client()
+    if client is not None:
+        refs = list(refs)
+        by_id = {r.id: r for r in refs}
+        ready_ids, pending_ids = client.wait(
+            [r.id for r in refs], num_returns, timeout
+        )
+        return (
+            [by_id[i] for i in ready_ids],
+            [by_id[i] for i in pending_ids],
+        )
     rt = _require_runtime()
     refs = list(refs)
     deadline = None if timeout is None else time.time() + timeout
@@ -1043,17 +1102,29 @@ class RemoteFunction:
         return rf
 
     def remote(self, *args, **kwargs) -> Union[ObjectRef, List[ObjectRef]]:
-        rt = _require_runtime()
         if self._func_blob is None:
             self._func_blob = ser.dumps(self._func)
-        refs = rt.submit_task(
-            self._func,
-            self._func_id,
-            self._func_blob,
-            list(args),
-            dict(kwargs),
-            self._options,
-        )
+        client = _ambient_client()
+        if client is not None:  # nested submission from a worker
+            ids = client.submit(
+                self._func,
+                self._func_id,
+                self._func_blob,
+                list(args),
+                dict(kwargs),
+                self._options,
+            )
+            refs = [ObjectRef(i) for i in ids]
+        else:
+            rt = _require_runtime()
+            refs = rt.submit_task(
+                self._func,
+                self._func_id,
+                self._func_blob,
+                list(args),
+                dict(kwargs),
+                self._options,
+            )
         if self._options.get("num_returns", 1) == 1:
             return refs[0]
         return refs
@@ -1074,11 +1145,22 @@ class ActorMethod:
         return ActorMethod(self._handle, self._name, num_returns)
 
     def remote(self, *args, **kwargs):
-        rt = _require_runtime()
-        refs = rt.call_actor(
-            self._handle._actor_id, self._name, list(args), dict(kwargs),
-            self._num_returns,
-        )
+        client = _ambient_client()
+        if client is not None:  # actor call from inside a worker
+            ids = client.call_actor(
+                self._handle._actor_id,
+                self._name,
+                list(args),
+                dict(kwargs),
+                self._num_returns,
+            )
+            refs = [ObjectRef(i) for i in ids]
+        else:
+            rt = _require_runtime()
+            refs = rt.call_actor(
+                self._handle._actor_id, self._name, list(args),
+                dict(kwargs), self._num_returns,
+            )
         if self._num_returns == 1:
             return refs[0]
         return refs

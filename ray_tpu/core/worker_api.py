@@ -1,0 +1,287 @@
+"""Worker-side driver API: nested remote calls from inside tasks and
+actors.
+
+Counterpart of the reference's worker→core-worker task submission
+path (``core_worker/core_worker.h`` SubmitTask from any worker +
+``_raylet.pyx`` — in Ray, every worker IS a CoreWorker and may
+submit tasks, put objects, and call actors). Here the driver owns all
+scheduling state, so workers reach it over a lightweight loopback TCP
+RPC: ``ray.remote(...)``/``.remote()``/``ray.get/put/wait`` and actor
+method calls made INSIDE a worker route through this channel
+transparently (the api layer falls back to the ambient
+:func:`worker_client` when no runtime is present).
+
+Deadlock note: a worker blocked in a nested ``ray.get`` still holds
+its task's CPU. Like the reference (which releases the CPU while
+blocked and re-acquires on return, allowing transient
+oversubscription), the server releases the calling task's CPU for the
+duration of a blocking get and re-acquires it after — so a
+1-CPU pool can run ``f.remote()`` inside ``g.remote()`` without
+wedging.
+
+Trust model: loopback bind, pickled payloads — identical to the
+worker pipes it parallels.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import threading
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.core import serialization as ser
+from ray_tpu.core.cluster import _recv_frame, _send_frame
+
+ENV_ADDR = "RAY_TPU_DRIVER_API"
+
+
+class WorkerAPIServer:
+    """Driver-side listener; one handler thread per worker
+    connection."""
+
+    def __init__(self, runtime, host: str = "127.0.0.1"):
+        self.runtime = runtime
+        self._worker_put_refs: List = []  # pins worker-put objects
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, 0))
+        self._sock.listen()
+        self.port = self._sock.getsockname()[1]
+        self.address = f"{host}:{self.port}"
+        threading.Thread(
+            target=self._accept_loop, daemon=True, name="worker_api"
+        ).start()
+
+    def _accept_loop(self):
+        while True:
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            threading.Thread(
+                target=self._serve_conn,
+                args=(conn,),
+                daemon=True,
+                name="worker_api_conn",
+            ).start()
+
+    def _serve_conn(self, conn):
+        lock = threading.Lock()
+        while True:
+            try:
+                msg = _recv_frame(conn)
+            except OSError:
+                msg = None
+            if msg is None:
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+                return
+            try:
+                reply = self._handle(msg)
+            except BaseException as e:  # noqa: BLE001 - ship to caller
+                reply = {"ok": False, "error": ser.dumps(e)}
+            try:
+                _send_frame(conn, lock, reply)
+            except OSError:
+                return
+
+    # -- ops -------------------------------------------------------------
+
+    def _handle(self, msg: Dict) -> Dict:
+        rt = self.runtime
+        op = msg["op"]
+        if op == "submit":
+            func = ser.loads(msg["func_blob"])
+            args, kwargs = ser.loads(msg["payload"])
+            refs = rt.submit_task(
+                func,
+                msg["func_id"],
+                msg["func_blob"],
+                list(args),
+                dict(kwargs),
+                dict(msg.get("options") or {}),
+            )
+            return {"ok": True, "ref_ids": [r.id for r in refs]}
+        if op == "get":
+            released = self._release_caller_cpu(msg.get("worker_id"))
+            try:
+                value = rt.store.get(
+                    msg["obj_id"], timeout=msg.get("timeout")
+                )
+            finally:
+                self._reacquire_cpu(released)
+            return {"ok": True, "value": ser.dumps(value)}
+        if op == "put":
+            from ray_tpu.core.object_store import ObjectRef
+
+            ref = ObjectRef(store=rt.store)
+            rt.store.put(ref.id, ser.loads(msg["value"]))
+            # the worker's handle is untracked, so hold this tracked
+            # one server-side: worker-created objects live until an
+            # explicit free() (pre-refcount semantics)
+            self._worker_put_refs.append(ref)
+            return {"ok": True, "ref_id": ref.id}
+        if op == "wait":
+            from ray_tpu.core import api as api_mod
+            from ray_tpu.core.object_store import ObjectRef
+
+            refs = [
+                ObjectRef(i, rt.store) for i in msg["obj_ids"]
+            ]
+            released = self._release_caller_cpu(msg.get("worker_id"))
+            try:
+                ready, pending = api_mod.wait(
+                    refs,
+                    num_returns=msg.get("num_returns", 1),
+                    timeout=msg.get("timeout"),
+                )
+            finally:
+                self._reacquire_cpu(released)
+            return {
+                "ok": True,
+                "ready": [r.id for r in ready],
+                "pending": [r.id for r in pending],
+            }
+        if op == "call_actor":
+            args, kwargs = ser.loads(msg["payload"])
+            refs = rt.call_actor(
+                msg["actor_id"],
+                msg["method"],
+                list(args),
+                dict(kwargs),
+                num_returns=msg.get("num_returns", 1),
+            )
+            return {"ok": True, "ref_ids": [r.id for r in refs]}
+        return {"ok": False, "error": ser.dumps(
+            ValueError(f"unknown op {op!r}")
+        )}
+
+    def _release_caller_cpu(self, worker_id) -> float:
+        """Free the blocked task's CPU so nested work can schedule
+        (reference CPU borrowing while blocked in ray.get)."""
+        if worker_id is None:
+            return 0.0
+        rt = self.runtime
+        with rt.lock:
+            for w in rt.pool:
+                if w.worker_id == worker_id and w.inflight:
+                    cpus = sum(
+                        t.num_cpus for t in w.inflight.values()
+                    )
+                    rt.available_cpus += cpus
+                    rt.blocked_workers += 1
+                    break
+            else:
+                return 0.0
+        rt._dispatch_pending()
+        return cpus
+
+    def _reacquire_cpu(self, cpus: float) -> None:
+        if cpus:
+            with self.runtime.lock:
+                # transient oversubscription is allowed, as in the
+                # reference: the task already owned this CPU
+                self.runtime.available_cpus -= cpus
+                self.runtime.blocked_workers -= 1
+
+    def shutdown(self):
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+# -- worker-side client ------------------------------------------------------
+
+_client_lock = threading.Lock()
+_client: Optional["DriverAPIClient"] = None
+
+
+class DriverAPIClient:
+    def __init__(self, address: str, worker_id: Optional[str] = None):
+        host, port = address.rsplit(":", 1)
+        self.sock = socket.create_connection((host, int(port)))
+        self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self.lock = threading.Lock()
+        self.worker_id = worker_id
+
+    def _roundtrip(self, msg: Dict) -> Dict:
+        with self.lock:  # nested calls within a task are serial
+            _send_frame(self.sock, threading.Lock(), msg)
+            reply = _recv_frame(self.sock)
+        if reply is None:
+            raise ConnectionError("driver API connection lost")
+        if not reply.get("ok"):
+            raise ser.loads(reply["error"])
+        return reply
+
+    def submit(self, func, func_id, func_blob, args, kwargs, options):
+        reply = self._roundtrip(
+            {
+                "op": "submit",
+                "func_id": func_id,
+                "func_blob": func_blob,
+                "payload": ser.dumps((args, kwargs)),
+                "options": options,
+            }
+        )
+        return reply["ref_ids"]
+
+    def get(self, obj_id: str, timeout: Optional[float]) -> Any:
+        reply = self._roundtrip(
+            {
+                "op": "get",
+                "obj_id": obj_id,
+                "timeout": timeout,
+                "worker_id": self.worker_id,
+            }
+        )
+        return ser.loads(reply["value"])
+
+    def put(self, value: Any) -> str:
+        return self._roundtrip(
+            {"op": "put", "value": ser.dumps(value)}
+        )["ref_id"]
+
+    def wait(self, obj_ids, num_returns, timeout):
+        reply = self._roundtrip(
+            {
+                "op": "wait",
+                "obj_ids": list(obj_ids),
+                "num_returns": num_returns,
+                "timeout": timeout,
+                "worker_id": self.worker_id,
+            }
+        )
+        return reply["ready"], reply["pending"]
+
+    def call_actor(self, actor_id, method, args, kwargs, num_returns=1):
+        reply = self._roundtrip(
+            {
+                "op": "call_actor",
+                "actor_id": actor_id,
+                "method": method,
+                "payload": ser.dumps((args, kwargs)),
+                "num_returns": num_returns,
+            }
+        )
+        return reply["ref_ids"]
+
+
+def worker_client() -> Optional[DriverAPIClient]:
+    """The ambient driver-API client of a worker process (None on the
+    driver or when the runtime predates the server)."""
+    global _client
+    addr = os.environ.get(ENV_ADDR)
+    if not addr:
+        return None
+    with _client_lock:
+        if _client is None:
+            _client = DriverAPIClient(
+                addr, os.environ.get("RAY_TPU_WORKER_ID")
+            )
+        return _client

@@ -1,0 +1,111 @@
+"""Nested remote calls from inside workers.
+
+Reference strategy: ``python/ray/tests/test_basic.py`` nested-task
+cases — in Ray every worker is a CoreWorker that can submit tasks,
+put/get objects, and call actors. Here workers reach the driver's
+scheduler over the worker-API channel (``core/worker_api.py``); a
+blocked nested ``ray.get`` releases the caller's CPU so a small pool
+cannot deadlock on its own children.
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu as ray
+
+
+@pytest.fixture(autouse=True)
+def _init():
+    ray.shutdown()
+    ray.init(num_cpus=2)
+    yield
+    ray.shutdown()
+
+
+def test_task_submits_task():
+    @ray.remote
+    def child(x):
+        return x * 2
+
+    @ray.remote
+    def parent(x):
+        return ray.get(child.remote(x)) + 1
+
+    assert ray.get(parent.remote(10), timeout=120) == 21
+
+
+def test_single_cpu_pool_does_not_deadlock():
+    ray.shutdown()
+    ray.init(num_cpus=1)
+
+    @ray.remote
+    def leaf():
+        return 5
+
+    @ray.remote
+    def mid():
+        # with 1 CPU, this only works because the blocked get
+        # releases mid's CPU for leaf
+        return ray.get(leaf.remote(), timeout=60) + 1
+
+    assert ray.get(mid.remote(), timeout=120) == 6
+
+
+def test_recursion_three_deep():
+    @ray.remote
+    def fact(n):
+        if n <= 1:
+            return 1
+        return n * ray.get(fact.remote(n - 1), timeout=60)
+
+    assert ray.get(fact.remote(4), timeout=120) == 24
+
+
+def test_worker_put_get_and_wait():
+    @ray.remote
+    def producer():
+        ref = ray.put(np.arange(5))
+        ready, pending = ray.wait([ref], timeout=10)
+        assert len(ready) == 1 and not pending
+        return ray.get(ref).sum()
+
+    assert ray.get(producer.remote(), timeout=120) == 10
+
+
+def test_worker_calls_actor():
+    @ray.remote
+    class Counter:
+        def __init__(self):
+            self.n = 0
+
+        def add(self, k):
+            self.n += k
+            return self.n
+
+    c = Counter.remote()
+
+    @ray.remote
+    def bump(handle, k):
+        return ray.get(handle.add.remote(k), timeout=60)
+
+    assert ray.get(bump.remote(c, 3), timeout=120) == 3
+    assert ray.get(bump.remote(c, 4), timeout=120) == 7
+    # driver still sees the same actor state
+    assert ray.get(c.add.remote(0), timeout=60) == 7
+
+
+def test_nested_refs_pass_between_tasks():
+    """Top-level ref args resolve to values (reference semantics);
+    refs nested INSIDE containers stay refs and resolve with ray.get
+    in the consuming worker."""
+
+    @ray.remote
+    def make():
+        return ray.put("payload")
+
+    @ray.remote
+    def read(refs):
+        return ray.get(refs[0], timeout=60)
+
+    inner_ref = ray.get(make.remote(), timeout=120)
+    assert ray.get(read.remote([inner_ref]), timeout=120) == "payload"
