@@ -27,6 +27,7 @@ from ray_tpu.core.api import (
     wait,
     method,
     get_runtime_context,
+    get_actor,
     available_resources,
     cluster_resources,
     nodes,
@@ -51,6 +52,7 @@ __all__ = [
     "free",
     "cancel",
     "get_runtime_context",
+    "get_actor",
     "available_resources",
     "cluster_resources",
     "nodes",

@@ -1196,6 +1196,13 @@ class ActorClass:
         return ActorClass(self._cls, {**self._options, **kwargs})
 
     def remote(self, *args, **kwargs) -> ActorHandle:
+        client = _ambient_client()
+        if client is not None:  # actor creation from inside a worker
+            actor_id, class_name = client.create_actor(
+                ser.dumps(self._cls), list(args), dict(kwargs),
+                self._options,
+            )
+            return ActorHandle(actor_id, class_name)
         rt = _require_runtime()
         return rt.create_actor(self._cls, list(args), dict(kwargs),
                                self._options)
@@ -1246,6 +1253,9 @@ class TaskCancelledError(RuntimeError):
 
 
 def get_actor(name: str) -> ActorHandle:
+    client = _ambient_client()
+    if client is not None:  # named-actor lookup from inside a worker
+        return ActorHandle(client.get_actor(name))
     rt = _require_runtime()
     with rt.lock:
         actor_id = rt.named_actors.get(name)

@@ -146,6 +146,29 @@ class WorkerAPIServer:
                 "ready": [r.id for r in ready],
                 "pending": [r.id for r in pending],
             }
+        if op == "create_actor":
+            cls = ser.loads(msg["cls_blob"])
+            args, kwargs = ser.loads(msg["payload"])
+            handle = rt.create_actor(
+                cls, list(args), dict(kwargs),
+                dict(msg.get("options") or {}),
+            )
+            return {
+                "ok": True,
+                "actor_id": handle._actor_id,
+                "class_name": handle._class_name,
+            }
+        if op == "get_actor":
+            with rt.lock:
+                actor_id = rt.named_actors.get(msg["name"])
+            if actor_id is None:
+                return {
+                    "ok": False,
+                    "error": ser.dumps(
+                        ValueError(f"No actor named {msg['name']!r}")
+                    ),
+                }
+            return {"ok": True, "actor_id": actor_id}
         if op == "call_actor":
             args, kwargs = ser.loads(msg["payload"])
             refs = rt.call_actor(
@@ -258,6 +281,22 @@ class DriverAPIClient:
             }
         )
         return reply["ready"], reply["pending"]
+
+    def create_actor(self, cls_blob, args, kwargs, options):
+        reply = self._roundtrip(
+            {
+                "op": "create_actor",
+                "cls_blob": cls_blob,
+                "payload": ser.dumps((args, kwargs)),
+                "options": options,
+            }
+        )
+        return reply["actor_id"], reply["class_name"]
+
+    def get_actor(self, name: str) -> str:
+        return self._roundtrip({"op": "get_actor", "name": name})[
+            "actor_id"
+        ]
 
     def call_actor(self, actor_id, method, args, kwargs, num_returns=1):
         reply = self._roundtrip(

@@ -94,6 +94,35 @@ def test_worker_calls_actor():
     assert ray.get(c.add.remote(0), timeout=60) == 7
 
 
+def test_worker_creates_actor_and_finds_named_actor():
+    @ray.remote
+    class Store:
+        def __init__(self):
+            self.v = {}
+
+        def set(self, k, v):
+            self.v[k] = v
+            return True
+
+        def get(self, k):
+            return self.v.get(k)
+
+    Store.options(name="shared_store").remote()
+
+    @ray.remote
+    def writer():
+        # find the named actor AND create a private one, from a worker
+        shared = ray.get_actor("shared_store")
+        ray.get(shared.set.remote("k", 42), timeout=60)
+        mine = Store.remote()
+        ray.get(mine.set.remote("local", 1), timeout=60)
+        return ray.get(mine.get.remote("local"), timeout=60)
+
+    assert ray.get(writer.remote(), timeout=120) == 1
+    shared = ray.get_actor("shared_store")
+    assert ray.get(shared.get.remote("k"), timeout=60) == 42
+
+
 def test_nested_refs_pass_between_tasks():
     """Top-level ref args resolve to values (reference semantics);
     refs nested INSIDE containers stay refs and resolve with ray.get
