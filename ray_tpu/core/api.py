@@ -674,6 +674,9 @@ class _Runtime:
             "actor_id": actor_id,
             "task_id": None,
             "cls": cls_blob,
+            "max_concurrency": int(
+                options.get("max_concurrency", 1)
+            ),
             "runtime_env": renv_packed,
             "payload": ser.dumps(
                 (

@@ -133,6 +133,90 @@ def worker_main(conn, worker_id: str, env_overrides: Dict[str, str]):
         except Exception:
             ring = None
 
+    import threading
+
+    # one logical producer: concurrent actor threads serialize their
+    # sends (pipe AND ring — the ring is SPSC; the lock keeps this
+    # process a single producer)
+    send_lock = threading.Lock()
+    actor_pools: Dict[str, Any] = {}  # actor_id -> ThreadPoolExecutor
+
+    def send_error(msg, e, tb):
+        from ray_tpu.util import tracing as _tracing
+
+        _err_spans = _tracing.drain_finished()
+        with send_lock:
+            conn.send(
+                {
+                    "task_id": msg.get("task_id"),
+                    "status": "err",
+                    "error": str(e),
+                    "error_cls": type(e).__name__,
+                    "traceback": tb,
+                    **({"spans": _err_spans} if _err_spans else {}),
+                }
+            )
+
+    def send_value(msg, value):
+        # Serialize result; bulk payloads ride the ring, very large
+        # ones a fresh shm segment, small ones the pipe.
+        meta, buffers = ser.serialize(value)
+        size = ser.serialized_size(meta, buffers)
+        # finished spans ride the result message back to the driver's
+        # tracer (the reference exports via its OTel pipeline instead)
+        from ray_tpu.util import tracing
+
+        spans = tracing.drain_finished()
+        extra = {"spans": spans} if spans else {}
+        with send_lock:
+            if ring is not None and ring_min <= size <= ring_max:
+                try:
+                    # Zero-copy: the serializer writes straight into
+                    # the mapped ring memory (reserve→write→commit).
+                    pushed = ring.push_serialized(
+                        meta, buffers, size, timeout=5.0
+                    )
+                except (BrokenPipeError, ValueError):
+                    pushed = False
+                if pushed:
+                    conn.send(
+                        {
+                            "task_id": msg["task_id"],
+                            "status": "ok_ring",
+                            "nbytes": size,
+                            **extra,
+                        }
+                    )
+                    return
+                # ring congested/unusable: fall through
+            if size >= 256 * 1024:
+                from ray_tpu.core.object_store import Segment
+
+                shm = Segment(
+                    create=True,
+                    size=size,
+                    name=f"rt_{msg['task_id'][:24]}",
+                )
+                ser.write_to_buffer(shm.buf, meta, buffers)
+                conn.send(
+                    {
+                        "task_id": msg["task_id"],
+                        "status": "ok_shm",
+                        "shm_name": shm.name,
+                        **extra,
+                    }
+                )
+                shm.close()  # driver owns the segment now
+            else:
+                conn.send(
+                    {
+                        "task_id": msg["task_id"],
+                        "status": "ok",
+                        "value_blob": ser.dumps(value),
+                        **extra,
+                    }
+                )
+
     while True:
         try:
             msg = conn.recv()
@@ -211,6 +295,19 @@ def worker_main(conn, worker_id: str, env_overrides: Dict[str, str]):
                     *ser.loads(msg["payload"]), shm_cache
                 )
                 actors[msg["actor_id"]] = cls(*args, **kwargs)
+                mc = int(msg.get("max_concurrency", 1))
+                if mc > 1:
+                    # threaded actor (reference max_concurrency,
+                    # actor.py:options): calls dispatch to a pool and
+                    # may complete out of order; the user class is
+                    # responsible for its own thread safety — same
+                    # contract as the reference
+                    from concurrent.futures import ThreadPoolExecutor
+
+                    actor_pools[msg["actor_id"]] = ThreadPoolExecutor(
+                        max_workers=mc,
+                        thread_name_prefix=f"actor_{msg['actor_id'][:8]}",
+                    )
                 value = None
             elif mtype == "actor_call":
                 from ray_tpu.util import tracing
@@ -219,6 +316,30 @@ def worker_main(conn, worker_id: str, env_overrides: Dict[str, str]):
                 args, kwargs = _resolve_args(
                     *ser.loads(msg["payload"]), shm_cache
                 )
+                pool = actor_pools.get(msg["actor_id"])
+                if pool is not None:
+
+                    def _run_concurrent(
+                        msg=msg, actor=actor, args=args, kwargs=kwargs
+                    ):
+                        try:
+                            with tracing.remote_span(
+                                msg.get("trace_ctx"),
+                                f"actor:{type(actor).__name__}."
+                                f"{msg['method']}",
+                            ):
+                                out = getattr(actor, msg["method"])(
+                                    *args, **kwargs
+                                )
+                        except BaseException as e:  # noqa: BLE001
+                            send_error(
+                                msg, e, traceback.format_exc()
+                            )
+                            return
+                        send_value(msg, out)
+
+                    pool.submit(_run_concurrent)
+                    continue
                 with tracing.remote_span(
                     msg.get("trace_ctx"),
                     f"actor:{type(actor).__name__}.{msg['method']}",
@@ -237,85 +358,17 @@ def worker_main(conn, worker_id: str, env_overrides: Dict[str, str]):
         except BaseException as e:  # noqa: BLE001 — report, don't die
             tb = traceback.format_exc()
             try:
-                from ray_tpu.util import tracing as _tracing
-
-                _err_spans = _tracing.drain_finished()
-                conn.send(
-                    {
-                        "task_id": msg.get("task_id"),
-                        "status": "err",
-                        "error": str(e),
-                        "error_cls": type(e).__name__,
-                        "traceback": tb,
-                        **(
-                            {"spans": _err_spans}
-                            if _err_spans
-                            else {}
-                        ),
-                    }
-                )
+                send_error(msg, e, tb)
             except Exception:
                 break
             continue
 
         if msg.get("task_id") is None:
             continue
-        # Serialize result; bulk payloads ride the ring, very large ones
-        # a fresh shm segment, small ones the pipe.
-        meta, buffers = ser.serialize(value)
-        size = ser.serialized_size(meta, buffers)
-        # finished spans ride the result message back to the driver's
-        # tracer (the reference exports via its OTel pipeline instead)
-        from ray_tpu.util import tracing
+        send_value(msg, value)
 
-        spans = tracing.drain_finished()
-        extra = {"spans": spans} if spans else {}
-        if ring is not None and ring_min <= size <= ring_max:
-            try:
-                # Zero-copy: the serializer writes straight into the
-                # mapped ring memory (reserve → write → commit).
-                pushed = ring.push_serialized(
-                    meta, buffers, size, timeout=5.0
-                )
-            except (BrokenPipeError, ValueError):
-                pushed = False
-            if pushed:
-                conn.send(
-                    {
-                        "task_id": msg["task_id"],
-                        "status": "ok_ring",
-                        "nbytes": size,
-                        **extra,
-                    }
-                )
-                continue
-            # ring congested/unusable: fall through to segment/pipe
-        if size >= 256 * 1024:
-            from ray_tpu.core.object_store import Segment
-
-            shm = Segment(
-                create=True, size=size, name=f"rt_{msg['task_id'][:24]}"
-            )
-            ser.write_to_buffer(shm.buf, meta, buffers)
-            conn.send(
-                {
-                    "task_id": msg["task_id"],
-                    "status": "ok_shm",
-                    "shm_name": shm.name,
-                    **extra,
-                }
-            )
-            shm.close()  # driver now owns the segment (it will unlink)
-        else:
-            conn.send(
-                {
-                    "task_id": msg["task_id"],
-                    "status": "ok",
-                    "value_blob": ser.dumps(value),
-                    **extra,
-                }
-            )
-
+    for pool in actor_pools.values():
+        pool.shutdown(wait=False)
     if ring is not None:
         try:
             ring.mark_closed()
