@@ -78,14 +78,25 @@ class ObjectRef:
     else when the in-flight result lands). Explicit ``ray.free()``
     still force-frees."""
 
-    __slots__ = ("id", "_store", "_owned")
+    __slots__ = ("id", "_store", "_owned", "_worker_tracked")
 
     def __init__(self, id: Optional[str] = None, store=None):
         self.id = id or uuid.uuid4().hex
         self._store = store if store is not None else _ambient_store()
         self._owned = self._store is not None
+        self._worker_tracked = False
         if self._owned:
             self._store.incref(self.id)
+        else:
+            # worker context: the driver pins handed-out refs for us;
+            # account local instances so the pin releases when the
+            # last one is GC'd (worker_api release piggyback)
+            try:
+                from ray_tpu.core.worker_api import note_ref
+
+                self._worker_tracked = note_ref(self.id)
+            except Exception:
+                pass
 
     def __hash__(self):
         return hash(self.id)
@@ -110,6 +121,13 @@ class ObjectRef:
                 self._store.decref(self.id)
             except Exception:
                 pass  # interpreter/store teardown
+        elif getattr(self, "_worker_tracked", False):
+            try:
+                from ray_tpu.core.worker_api import note_ref_deleted
+
+                note_ref_deleted(self.id)
+            except Exception:
+                pass
 
 
 class _Entry:

@@ -42,7 +42,6 @@ class WorkerAPIServer:
 
     def __init__(self, runtime, host: str = "127.0.0.1"):
         self.runtime = runtime
-        self._worker_put_refs: List = []  # pins worker-put objects
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((host, 0))
@@ -69,6 +68,13 @@ class WorkerAPIServer:
 
     def _serve_conn(self, conn):
         lock = threading.Lock()
+        # refs handed to THIS worker stay pinned here (the worker's
+        # own ObjectRef instances are untracked): without the pin,
+        # the driver-side refcount would free a nested result the
+        # moment it lands, before the worker ever reads it. The
+        # worker piggybacks release notices for GC'd handles on its
+        # next request, and a dead connection drops every pin.
+        handed: Dict[str, Any] = {}
         while True:
             try:
                 msg = _recv_frame(conn)
@@ -79,19 +85,23 @@ class WorkerAPIServer:
                     conn.close()
                 except OSError:
                     pass
+                handed.clear()
                 return
+            for rid in msg.get("release") or ():
+                handed.pop(rid, None)
             try:
-                reply = self._handle(msg)
+                reply = self._handle(msg, handed)
             except BaseException as e:  # noqa: BLE001 - ship to caller
                 reply = {"ok": False, "error": ser.dumps(e)}
             try:
                 _send_frame(conn, lock, reply)
             except OSError:
+                handed.clear()
                 return
 
     # -- ops -------------------------------------------------------------
 
-    def _handle(self, msg: Dict) -> Dict:
+    def _handle(self, msg: Dict, handed: Dict) -> Dict:
         rt = self.runtime
         op = msg["op"]
         if op == "submit":
@@ -105,6 +115,8 @@ class WorkerAPIServer:
                 dict(kwargs),
                 dict(msg.get("options") or {}),
             )
+            for r in refs:
+                handed[r.id] = r
             return {"ok": True, "ref_ids": [r.id for r in refs]}
         if op == "get":
             released = self._release_caller_cpu(msg.get("worker_id"))
@@ -120,10 +132,7 @@ class WorkerAPIServer:
 
             ref = ObjectRef(store=rt.store)
             rt.store.put(ref.id, ser.loads(msg["value"]))
-            # the worker's handle is untracked, so hold this tracked
-            # one server-side: worker-created objects live until an
-            # explicit free() (pre-refcount semantics)
-            self._worker_put_refs.append(ref)
+            handed[ref.id] = ref
             return {"ok": True, "ref_id": ref.id}
         if op == "wait":
             from ray_tpu.core import api as api_mod
@@ -178,6 +187,8 @@ class WorkerAPIServer:
                 dict(kwargs),
                 num_returns=msg.get("num_returns", 1),
             )
+            for r in refs:
+                handed[r.id] = r
             return {"ok": True, "ref_ids": [r.id for r in refs]}
         return {"ok": False, "error": ser.dumps(
             ValueError(f"unknown op {op!r}")
@@ -223,6 +234,44 @@ class WorkerAPIServer:
 _client_lock = threading.Lock()
 _client: Optional["DriverAPIClient"] = None
 
+# Worker-local handle accounting: the driver pins every ref it hands
+# this worker; when the LAST local ObjectRef instance for an id is
+# GC'd, the id queues here and rides out on the next request as a
+# release notice (no extra roundtrips, and __del__ never touches the
+# connection). ObjectRef.__init__/__del__ call these in worker
+# processes (see object_store._ambient_store).
+_ref_lock = threading.Lock()
+_local_counts: Dict[str, int] = {}
+_pending_release: List[str] = []
+
+
+def note_ref(obj_id: str) -> bool:
+    """Track one worker-local ObjectRef instance; returns False when
+    not in a worker context (caller skips __del__ accounting)."""
+    if _client is None and not os.environ.get(ENV_ADDR):
+        return False
+    with _ref_lock:
+        _local_counts[obj_id] = _local_counts.get(obj_id, 0) + 1
+    return True
+
+
+def note_ref_deleted(obj_id: str) -> None:
+    with _ref_lock:
+        n = _local_counts.get(obj_id)
+        if n is None:
+            return
+        if n > 1:
+            _local_counts[obj_id] = n - 1
+            return
+        _local_counts.pop(obj_id, None)
+        _pending_release.append(obj_id)
+
+
+def _drain_releases() -> List[str]:
+    with _ref_lock:
+        out, _pending_release[:] = _pending_release[:], []
+    return out
+
 
 class DriverAPIClient:
     def __init__(self, address: str, worker_id: Optional[str] = None):
@@ -233,6 +282,9 @@ class DriverAPIClient:
         self.worker_id = worker_id
 
     def _roundtrip(self, msg: Dict) -> Dict:
+        released = _drain_releases()
+        if released:
+            msg = dict(msg, release=released)
         with self.lock:  # nested calls within a task are serial
             _send_frame(self.sock, threading.Lock(), msg)
             reply = _recv_frame(self.sock)

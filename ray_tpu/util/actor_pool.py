@@ -1,0 +1,103 @@
+"""ActorPool: round-robin work distribution over a fixed set of
+actors.
+
+Counterpart of the reference's ``ray/util/actor_pool.py`` — the same
+submit/get_next/get_next_unordered/map/map_unordered surface over a
+list of actor handles, tracking which actor is free and preserving
+submission order where asked.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterable, List
+
+import ray_tpu as ray
+
+
+class ActorPool:
+    def __init__(self, actors: List):
+        self._idle = list(actors)
+        self._future_to_actor = {}
+        self._index_to_future = {}
+        self._next_task_index = 0
+        self._next_return_index = 0
+        self._pending_submits: List = []
+
+    def submit(self, fn: Callable[[Any, Any], Any], value: Any) -> None:
+        """``fn(actor, value) -> ObjectRef``; queues if all actors are
+        busy (reference actor_pool.py submit)."""
+        if self._idle:
+            actor = self._idle.pop()
+            ref = fn(actor, value)
+            self._future_to_actor[ref] = (
+                self._next_task_index,
+                actor,
+                fn,
+            )
+            self._index_to_future[self._next_task_index] = ref
+            self._next_task_index += 1
+        else:
+            self._pending_submits.append((fn, value))
+
+    def _return_actor(self, actor) -> None:
+        self._idle.append(actor)
+        if self._pending_submits:
+            fn, value = self._pending_submits.pop(0)
+            self.submit(fn, value)
+
+    def has_next(self) -> bool:
+        return bool(self._future_to_actor) or bool(
+            self._pending_submits
+        )
+
+    def get_next(self, timeout: float = None):
+        """Next result in SUBMISSION order. Invariant: whenever work
+        is outstanding, the next-return index has a dispatched future
+        (queued submits imply busy actors imply dispatched futures
+        with lower indices) — same reasoning as the reference."""
+        if not self.has_next():
+            raise StopIteration("no more results")
+        if self._next_return_index not in self._index_to_future:
+            raise ValueError(
+                "ordered get_next() cannot follow "
+                "get_next_unordered() on the same pool"
+            )
+        ref = self._index_to_future.pop(self._next_return_index)
+        self._next_return_index += 1
+        _, actor, _ = self._future_to_actor.pop(ref)
+        value = ray.get(ref, timeout=timeout)
+        self._return_actor(actor)
+        return value
+
+    def get_next_unordered(self, timeout: float = None):
+        """Whichever outstanding result lands first."""
+        if not self._future_to_actor:
+            raise StopIteration("no pending results")
+        ready, _ = ray.wait(
+            list(self._future_to_actor),
+            num_returns=1,
+            timeout=timeout,
+        )
+        if not ready:
+            raise TimeoutError("no result within timeout")
+        ref = ready[0]
+        index, actor, _ = self._future_to_actor.pop(ref)
+        self._index_to_future.pop(index, None)
+        value = ray.get(ref, timeout=timeout)
+        self._return_actor(actor)
+        return value
+
+    def map(self, fn: Callable, values: Iterable):
+        for v in values:
+            self.submit(fn, v)
+        while self.has_next():
+            yield self.get_next()
+
+    def map_unordered(self, fn: Callable, values: Iterable):
+        for v in values:
+            self.submit(fn, v)
+        while self._future_to_actor or self._pending_submits:
+            yield self.get_next_unordered()
+
+    def has_free(self) -> bool:
+        return bool(self._idle)

@@ -1,0 +1,118 @@
+"""Distributed Queue: an actor-backed FIFO shared between tasks,
+actors, and the driver.
+
+Counterpart of the reference's ``ray/util/queue.py`` Queue — the same
+put/get/qsize/empty/full surface (with blocking and timeouts) backed
+by a dedicated queue actor, reachable from anywhere a handle can be
+pickled to (workers reach it through the worker-API channel).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, List, Optional
+
+import ray_tpu as ray
+
+
+class Empty(Exception):
+    pass
+
+
+class Full(Exception):
+    pass
+
+
+@ray.remote
+class _QueueActor:
+    def __init__(self, maxsize: int):
+        from collections import deque
+
+        self.maxsize = maxsize
+        self.items = deque()
+
+    def qsize(self) -> int:
+        return len(self.items)
+
+    def put(self, item) -> bool:
+        """False if full (the CALLER retries/blocks — the actor's
+        ordered queue must never park, or every other caller stalls
+        behind it)."""
+        if self.maxsize > 0 and len(self.items) >= self.maxsize:
+            return False
+        self.items.append(item)
+        return True
+
+    def get(self):
+        if not self.items:
+            return False, None
+        return True, self.items.popleft()
+
+    def get_batch(self, n: int) -> List:
+        out = []
+        while self.items and len(out) < n:
+            out.append(self.items.popleft())
+        return out
+
+
+class Queue:
+    def __init__(self, maxsize: int = 0, actor_options: Optional[dict] = None):
+        self.maxsize = maxsize
+        self.actor = _QueueActor.options(
+            **(actor_options or {})
+        ).remote(maxsize)
+
+    def qsize(self) -> int:
+        return ray.get(self.actor.qsize.remote())
+
+    def empty(self) -> bool:
+        return self.qsize() == 0
+
+    def full(self) -> bool:
+        return self.maxsize > 0 and self.qsize() >= self.maxsize
+
+    def put(
+        self,
+        item: Any,
+        block: bool = True,
+        timeout: Optional[float] = None,
+    ) -> None:
+        deadline = None if timeout is None else time.time() + timeout
+        while True:
+            if ray.get(self.actor.put.remote(item)):
+                return
+            if not block:
+                raise Full
+            if deadline is not None and time.time() >= deadline:
+                raise Full
+            time.sleep(0.01)
+
+    def get(
+        self, block: bool = True, timeout: Optional[float] = None
+    ) -> Any:
+        deadline = None if timeout is None else time.time() + timeout
+        while True:
+            ok, item = ray.get(self.actor.get.remote())
+            if ok:
+                return item
+            if not block:
+                raise Empty
+            if deadline is not None and time.time() >= deadline:
+                raise Empty
+            time.sleep(0.01)
+
+    def put_nowait(self, item: Any) -> None:
+        self.put(item, block=False)
+
+    def get_nowait(self) -> Any:
+        return self.get(block=False)
+
+    def get_batch(self, n: int) -> List:
+        """Up to n items in one round trip (drains what is there)."""
+        return ray.get(self.actor.get_batch.remote(n))
+
+    def shutdown(self) -> None:
+        try:
+            ray.kill(self.actor)
+        except Exception:
+            pass

@@ -1,0 +1,82 @@
+"""ray.util.ActorPool + ray.util.queue.Queue (reference
+``ray/util/actor_pool.py`` + ``ray/util/queue.py`` and their
+tests)."""
+
+import pytest
+
+import ray_tpu as ray
+from ray_tpu.util.actor_pool import ActorPool
+from ray_tpu.util.queue import Empty, Full, Queue
+
+
+@pytest.fixture(autouse=True)
+def _init():
+    ray.init(num_cpus=4, ignore_reinit_error=True)
+
+
+@ray.remote
+class Doubler:
+    def double(self, x):
+        return 2 * x
+
+
+def test_actor_pool_map_ordered():
+    pool = ActorPool([Doubler.remote() for _ in range(2)])
+    out = list(
+        pool.map(lambda a, v: a.double.remote(v), range(6))
+    )
+    assert out == [0, 2, 4, 6, 8, 10]  # submission order, 2 actors
+
+
+def test_actor_pool_map_unordered_and_queueing():
+    pool = ActorPool([Doubler.remote()])  # 1 actor, 5 jobs queue
+    out = sorted(
+        pool.map_unordered(lambda a, v: a.double.remote(v), range(5))
+    )
+    assert out == [0, 2, 4, 6, 8]
+
+
+def test_actor_pool_submit_get_next():
+    # ordered semantics: results come back in SUBMISSION order
+    pool = ActorPool([Doubler.remote() for _ in range(2)])
+    pool.submit(lambda a, v: a.double.remote(v), 1)
+    pool.submit(lambda a, v: a.double.remote(v), 2)
+    assert pool.get_next(timeout=60) == 2 * 1
+    assert pool.get_next(timeout=60) == 2 * 2
+    assert not pool.has_next()
+    with pytest.raises(StopIteration):
+        pool.get_next()
+
+
+def test_queue_fifo_across_workers():
+    q = Queue()
+    q.put("a")
+    q.put("b")
+
+    @ray.remote
+    def consume_and_produce(queue):
+        first = queue.get(timeout=30)
+        queue.put(first + "_seen")
+        return first
+
+    assert ray.get(consume_and_produce.remote(q), timeout=120) == "a"
+    assert q.get(timeout=30) == "b"
+    assert q.get(timeout=30) == "a_seen"
+    assert q.empty()
+
+
+def test_queue_maxsize_and_nowait():
+    q = Queue(maxsize=2)
+    q.put(1)
+    q.put(2)
+    assert q.full()
+    with pytest.raises(Full):
+        q.put(3, block=False)
+    with pytest.raises(Full):
+        q.put(3, timeout=0.2)
+    assert q.get_nowait() == 1
+    q.put(3)  # room again
+    assert q.get_batch(5) == [2, 3]
+    with pytest.raises(Empty):
+        q.get_nowait()
+    q.shutdown()
