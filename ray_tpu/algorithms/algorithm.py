@@ -327,11 +327,81 @@ class Algorithm(Trainable):
         self.workers.sync_weights()
 
     def save_checkpoint(self, checkpoint_dir: str) -> str:
-        """reference algorithm.py:1438."""
+        """reference algorithm.py:1438. Alongside the state, a
+        metadata file records the algorithm name and config so
+        :meth:`from_checkpoint` can rebuild without the caller
+        knowing either (reference checkpoint ``rllib_checkpoint.json``)."""
         path = os.path.join(checkpoint_dir, "algorithm_state.pkl")
         with open(path, "wb") as f:
             pickle.dump(self.__getstate__(), f)
+        import json
+
+        meta = {
+            "type": "Algorithm",
+            "algorithm_class": type(self).__name__,
+            "algorithm_name": getattr(
+                self, "_registry_name", None
+            ) or type(self).__name__,
+        }
+        with open(
+            os.path.join(checkpoint_dir, "rllib_checkpoint.json"), "w"
+        ) as f:
+            json.dump(meta, f)
+        from ray_tpu.core import serialization as _ser
+
+        with open(
+            os.path.join(checkpoint_dir, "algorithm_config.pkl"), "wb"
+        ) as f:
+            # cloudpickle (env creators etc.); runtime-injected keys
+            # ("_mesh", ...) hold live device objects and are
+            # rebuilt by setup(), so they stay out of the file
+            f.write(
+                _ser.dumps(
+                    {
+                        k: v
+                        for k, v in self.config.items()
+                        if not k.startswith("_")
+                    }
+                )
+            )
         return checkpoint_dir
+
+    @classmethod
+    def from_checkpoint(cls, checkpoint_path: str) -> "Algorithm":
+        """Rebuild a ready-to-run Algorithm from a checkpoint
+        directory alone (reference ``Algorithm.from_checkpoint``,
+        algorithm.py:315): the stored metadata names the algorithm,
+        the stored config reconstructs it, and the state restores
+        into it."""
+        import json
+
+        meta_path = os.path.join(
+            checkpoint_path, "rllib_checkpoint.json"
+        )
+        algo_cls = cls
+        if cls is Algorithm:
+            if not os.path.exists(meta_path):
+                raise ValueError(
+                    f"{checkpoint_path!r} has no rllib_checkpoint.json;"
+                    " call from_checkpoint on the concrete class or"
+                    " re-save with this version"
+                )
+            with open(meta_path) as f:
+                meta = json.load(f)
+            from ray_tpu.algorithms.registry import (
+                get_algorithm_class,
+            )
+
+            algo_cls = get_algorithm_class(meta["algorithm_name"])
+        from ray_tpu.core import serialization as _ser
+
+        with open(
+            os.path.join(checkpoint_path, "algorithm_config.pkl"), "rb"
+        ) as f:
+            config = _ser.loads(f.read())
+        algo = algo_cls(config=config)
+        algo.load_checkpoint(checkpoint_path)
+        return algo
 
     def load_checkpoint(self, checkpoint_path: str) -> None:
         if os.path.isdir(checkpoint_path):

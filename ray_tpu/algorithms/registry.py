@@ -52,11 +52,16 @@ def register_algorithm(name: str, loader: Callable) -> None:
 
 def get_algorithm_class(name: str):
     if name in _ALGORITHMS:
-        return _ALGORITHMS[name]()
-    if name in _BUILTINS:
+        cls = _ALGORITHMS[name]()
+    elif name in _BUILTINS:
         module, attr = _BUILTINS[name]
-        return getattr(importlib.import_module(module), attr)
-    raise ValueError(
-        f"Unknown algorithm {name!r}; known: "
-        f"{sorted(set(_ALGORITHMS) | set(_BUILTINS))}"
-    )
+        cls = getattr(importlib.import_module(module), attr)
+    else:
+        raise ValueError(
+            f"Unknown algorithm {name!r}; known: "
+            f"{sorted(set(_ALGORITHMS) | set(_BUILTINS))}"
+        )
+    # checkpoints record this so Algorithm.from_checkpoint can find
+    # the class again without the caller naming it
+    cls._registry_name = name
+    return cls

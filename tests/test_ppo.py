@@ -2,6 +2,7 @@
 and the CartPole learning regression
 ``tuned_examples/ppo/cartpole-ppo.yaml``)."""
 
+import jax
 import numpy as np
 import pytest
 
@@ -163,3 +164,42 @@ def test_evaluate_syncs_filters_and_uses_remote_eval_workers():
         # sampling may have pushed more into its own copy since)
         assert eval_filt[pid].rs.num >= f.rs.num > 0
     algo.cleanup()
+
+
+def test_from_checkpoint_rebuilds_without_class(tmp_path):
+    """Algorithm.from_checkpoint resolves the concrete class and
+    config from checkpoint metadata alone (reference
+    Algorithm.from_checkpoint, algorithm.py:315)."""
+    from ray_tpu.algorithms.algorithm import Algorithm
+    from ray_tpu.algorithms.registry import get_algorithm_class
+
+    PPO = get_algorithm_class("PPO")
+    algo = PPO(config={
+        "env": "CartPole-v1",
+        "train_batch_size": 256,
+        "sgd_minibatch_size": 128,
+        "num_workers": 0,
+    })
+    algo.train()
+    w0 = algo.get_policy().get_weights()
+    algo.save_checkpoint(str(tmp_path))
+    algo.cleanup()
+
+    algo2 = Algorithm.from_checkpoint(str(tmp_path))
+    try:
+        assert type(algo2).__name__ == "PPO"
+        assert algo2.config["train_batch_size"] == 256
+        import numpy as np
+
+        w1 = algo2.get_policy().get_weights()
+        trees_equal = all(
+            np.allclose(a, b)
+            for a, b in zip(
+                jax.tree_util.tree_leaves(w0),
+                jax.tree_util.tree_leaves(w1),
+            )
+        )
+        assert trees_equal
+        algo2.train()  # restored instance keeps training
+    finally:
+        algo2.cleanup()
