@@ -1,0 +1,128 @@
+"""``multiprocessing.Pool``-compatible API over remote tasks.
+
+Counterpart of the reference's ``ray/util/multiprocessing/pool.py`` —
+drop-in ``Pool`` with map/starmap/apply/async variants and chunking,
+so stdlib-Pool code ports without rewrites. Work runs as ray_tpu
+tasks (the "processes" count only caps in-flight chunks; actual
+parallelism is the runtime's CPU pool).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterable, List, Optional
+
+import ray_tpu as ray
+
+
+@ray.remote
+def _run_chunk(fn, chunk, star):
+    if star:
+        return [fn(*args) for args in chunk]
+    return [fn(x) for x in chunk]
+
+
+class AsyncResult:
+    """reference pool.py AsyncResult: .get/.wait/.ready over the
+    underlying chunk refs."""
+
+    def __init__(self, refs: List, flatten: bool = True):
+        self._refs = refs
+        self._flatten = flatten
+
+    def get(self, timeout: Optional[float] = None):
+        outs = ray.get(self._refs, timeout=timeout)
+        if not self._flatten:
+            return outs[0][0]
+        return [x for chunk in outs for x in chunk]
+
+    def wait(self, timeout: Optional[float] = None) -> None:
+        ray.wait(
+            self._refs,
+            num_returns=len(self._refs),
+            timeout=timeout,
+        )
+
+    def ready(self) -> bool:
+        ready, _ = ray.wait(
+            self._refs, num_returns=len(self._refs), timeout=0
+        )
+        return len(ready) == len(self._refs)
+
+    def successful(self) -> bool:
+        try:
+            self.get(timeout=0.001)
+            return True
+        except Exception:
+            return False
+
+
+class Pool:
+    def __init__(self, processes: Optional[int] = None):
+        ray.init(ignore_reinit_error=True)
+        self._processes = processes or 4
+        self._closed = False
+
+    # -- sync ------------------------------------------------------------
+
+    def map(self, fn: Callable, iterable: Iterable,
+            chunksize: Optional[int] = None) -> List:
+        return self.map_async(fn, iterable, chunksize).get()
+
+    def starmap(self, fn: Callable, iterable: Iterable,
+                chunksize: Optional[int] = None) -> List:
+        return self.starmap_async(fn, iterable, chunksize).get()
+
+    def apply(self, fn: Callable, args=(), kwargs=None) -> Any:
+        return self.apply_async(fn, args, kwargs).get()
+
+    def imap(self, fn: Callable, iterable: Iterable,
+             chunksize: Optional[int] = None):
+        for ref in self._submit(fn, iterable, chunksize, star=False):
+            yield from ray.get(ref)
+
+    # -- async -----------------------------------------------------------
+
+    def map_async(self, fn, iterable, chunksize=None) -> AsyncResult:
+        return AsyncResult(
+            self._submit(fn, iterable, chunksize, star=False)
+        )
+
+    def starmap_async(self, fn, iterable, chunksize=None) -> AsyncResult:
+        return AsyncResult(
+            self._submit(fn, iterable, chunksize, star=True)
+        )
+
+    def apply_async(self, fn, args=(), kwargs=None) -> AsyncResult:
+        kwargs = kwargs or {}
+        ref = _run_chunk.remote(
+            lambda *_a: fn(*args, **kwargs), [()], True
+        )
+        return AsyncResult([ref], flatten=False)
+
+    def _submit(self, fn, iterable, chunksize, star) -> List:
+        if self._closed:
+            raise ValueError("Pool not running")
+        items = list(iterable)
+        if chunksize is None:
+            chunksize = max(1, len(items) // (self._processes * 4) or 1)
+        return [
+            _run_chunk.remote(fn, items[i : i + chunksize], star)
+            for i in range(0, len(items), chunksize)
+        ]
+
+    # -- lifecycle -------------------------------------------------------
+
+    def close(self) -> None:
+        self._closed = True
+
+    def terminate(self) -> None:
+        self._closed = True
+
+    def join(self) -> None:
+        pass  # tasks are awaited via their results
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

@@ -1,0 +1,46 @@
+"""multiprocessing.Pool-compatible API (reference
+``ray/util/multiprocessing/pool.py`` + its tests)."""
+
+import pytest
+
+import ray_tpu as ray
+from ray_tpu.util.multiprocessing import Pool
+
+
+def _sq(x):
+    return x * x
+
+
+def _add(a, b):
+    return a + b
+
+
+@pytest.fixture(autouse=True)
+def _init():
+    ray.init(num_cpus=2, ignore_reinit_error=True)
+
+
+def test_map_and_chunking():
+    with Pool(processes=2) as p:
+        assert p.map(_sq, range(10)) == [x * x for x in range(10)]
+        assert p.map(_sq, range(3), chunksize=1) == [0, 1, 4]
+
+
+def test_starmap_apply_imap():
+    with Pool(2) as p:
+        assert p.starmap(_add, [(1, 2), (3, 4)]) == [3, 7]
+        assert p.apply(_add, (5, 6)) == 11
+        assert list(p.imap(_sq, range(4))) == [0, 1, 4, 9]
+
+
+def test_async_results():
+    p = Pool(2)
+    r = p.map_async(_sq, range(6))
+    r.wait(timeout=60)
+    assert r.ready()
+    assert r.get(timeout=60) == [0, 1, 4, 9, 16, 25]
+    a = p.apply_async(_add, (2, 3))
+    assert a.get(timeout=60) == 5
+    p.close()
+    with pytest.raises(ValueError):
+        p.map(_sq, [1])
