@@ -213,6 +213,10 @@ class Algorithm(Trainable):
             )
         except Exception:
             pass
+        if self.callbacks is not None:
+            self.callbacks.on_train_result(
+                algorithm=self, result=results
+            )
         return results
 
     def _collect_rollout_metrics(self) -> Dict:

@@ -1,0 +1,75 @@
+"""User callback hooks into the sampling/training loop.
+
+Counterpart of the reference's ``rllib/algorithms/callbacks.py``
+DefaultCallbacks: subclass, override the hooks you need, and pass the
+CLASS via ``config["callbacks_class"]`` (fluent:
+``.callbacks(MyCallbacks)``). The episode object exposes
+``user_data`` (scratch space across a whole episode) and
+``custom_metrics`` (scalars aggregated into the training result as
+``custom_metrics/<name>_mean|min|max``, exactly like the reference).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+
+class DefaultCallbacks:
+    """All hooks are no-ops; override freely. Signatures follow the
+    reference's keyword-only style so overrides stay source-portable
+    (extra kwargs are always passed, so accept ``**kwargs``)."""
+
+    def on_episode_start(
+        self, *, worker=None, base_env=None, policies=None,
+        episode=None, env_index: Optional[int] = None, **kwargs,
+    ) -> None:
+        pass
+
+    def on_episode_step(
+        self, *, worker=None, base_env=None, policies=None,
+        episode=None, env_index: Optional[int] = None, **kwargs,
+    ) -> None:
+        pass
+
+    def on_episode_end(
+        self, *, worker=None, base_env=None, policies=None,
+        episode=None, env_index: Optional[int] = None, **kwargs,
+    ) -> None:
+        pass
+
+    def on_sample_end(
+        self, *, worker=None, samples=None, **kwargs
+    ) -> None:
+        pass
+
+    def on_postprocess_trajectory(
+        self, *, worker=None, episode=None, agent_id=None,
+        policy_id=None, policies=None, postprocessed_batch=None,
+        original_batches=None, **kwargs,
+    ) -> None:
+        pass
+
+    def on_train_result(
+        self, *, algorithm=None, result: Optional[Dict] = None,
+        **kwargs,
+    ) -> None:
+        pass
+
+
+class MultiCallbacks(DefaultCallbacks):
+    """Fan one hook call out to several callback objects (reference
+    MultiCallbacks)."""
+
+    def __init__(self, callbacks_classes):
+        self._callbacks = [c() for c in callbacks_classes]
+
+    def __getattribute__(self, name: str) -> Any:
+        if name.startswith("on_"):
+            cbs = object.__getattribute__(self, "_callbacks")
+
+            def fan_out(**kwargs):
+                for cb in cbs:
+                    getattr(cb, name)(**kwargs)
+
+            return fan_out
+        return object.__getattribute__(self, name)

@@ -14,6 +14,12 @@ class EpisodeRecord:
         self.total_reward = 0.0
         self.length = 0
         self.agent_rewards: Dict = {}
+        # callback surface (reference Episode.user_data /
+        # .custom_metrics): user_data is per-episode scratch space;
+        # custom_metrics scalars aggregate into the training result
+        self.user_data: Dict = {}
+        self.custom_metrics: Dict[str, float] = {}
+        self.last_info: Dict = {}
 
     def add(self, reward: float, agent_id=None):
         self.total_reward += reward

@@ -13,10 +13,13 @@ import numpy as np
 
 class RolloutMetrics:
     def __init__(self, episode_length: int, episode_reward: float,
-                 agent_rewards: Dict | None = None):
+                 agent_rewards: Dict | None = None,
+                 custom_metrics: Dict | None = None):
         self.episode_length = episode_length
         self.episode_reward = episode_reward
         self.agent_rewards = agent_rewards or {}
+        # user scalars from Episode.custom_metrics (callbacks)
+        self.custom_metrics = custom_metrics or {}
 
 
 def summarize_episodes(episodes: List[RolloutMetrics]) -> Dict:
@@ -37,4 +40,16 @@ def summarize_episodes(episodes: List[RolloutMetrics]) -> Dict:
             pid: float(np.mean(rs)) for pid, rs in policy_rewards.items()
         },
     }
+    # user scalars recorded by callbacks: mean/min/max per key
+    # (reference metrics.py custom-metrics aggregation)
+    custom: Dict[str, List[float]] = {}
+    for e in episodes:
+        for k, v in getattr(e, "custom_metrics", {}).items():
+            custom.setdefault(k, []).append(float(v))
+    if custom:
+        out["custom_metrics"] = {}
+        for k, vals in custom.items():
+            out["custom_metrics"][f"{k}_mean"] = float(np.mean(vals))
+            out["custom_metrics"][f"{k}_min"] = float(np.min(vals))
+            out["custom_metrics"][f"{k}_max"] = float(np.max(vals))
     return out

@@ -159,9 +159,12 @@ class RolloutWorker:
 
             if self.config.get("sample_async"):
                 sampler_cls = AsyncSampler
+            cb_cls = self.config.get("callbacks_class")
+            self.callbacks = cb_cls() if cb_cls else None
             self.sampler = sampler_cls(
                 vector_env=self.vector_env,
                 policy=self.policy_map[pid],
+                callbacks=self.callbacks,
                 preprocessor=self.preprocessor,
                 obs_filter=self.filters.get(pid),
                 rollout_fragment_length=int(

@@ -118,6 +118,9 @@ class SyncSampler:
         n = self.env.num_envs
         self.collectors = [_EnvSlotCollector() for _ in range(n)]
         self.episodes = [EpisodeRecord() for _ in range(n)]
+        if self.callbacks is not None:
+            for i in range(n):
+                self._cb("on_episode_start", i)
         self.metrics_queue: List[RolloutMetrics] = []
         # AsyncSampler appends from its thread while the driver swaps
         import threading as _threading
@@ -150,6 +153,18 @@ class SyncSampler:
     def _transform(self, obs):
         return transform_obs(self.preprocessor, self.obs_filter, obs)
 
+    def _cb(self, hook: str, env_index: int) -> None:
+        """Invoke one user callback hook (reference DefaultCallbacks);
+        a raising callback fails sampling loudly, as in the
+        reference — silent swallowing would hide user bugs."""
+        getattr(self.callbacks, hook)(
+            worker=None,
+            base_env=self.env,
+            policies={"default_policy": self.policy},
+            episode=self.episodes[env_index],
+            env_index=env_index,
+        )
+
     # -- main loop -------------------------------------------------------
 
     def sample(self) -> SampleBatch:
@@ -173,9 +188,12 @@ class SyncSampler:
                 ):
                     break
         batches = [b for b in out if b.count > 0]
-        if not batches:
-            return SampleBatch()
-        return concat_samples(batches)
+        result = (
+            concat_samples(batches) if batches else SampleBatch()
+        )
+        if self.callbacks is not None:
+            self.callbacks.on_sample_end(worker=None, samples=result)
+        return result
 
     def _step_once(self, out: List[SampleBatch]) -> bool:
         n = self.env.num_envs
@@ -257,6 +275,9 @@ class SyncSampler:
                 self._views.annotate_row(i, row)
             self.collectors[i].add(row)
             self.episodes[i].add(float(rewards[i]))
+            if self.callbacks is not None:
+                self.episodes[i].last_info = infos[i] or {}
+                self._cb("on_episode_step", i)
 
             if self._has_state:
                 self.states[i] = [np.asarray(s[i]) for s in state_out]
@@ -274,6 +295,8 @@ class SyncSampler:
                 self._prev_rewards[i] = np.float32(0.0)
                 if self._views.active:
                     self._views.reset_env(i)
+                if self.callbacks is not None:
+                    self._cb("on_episode_end", i)
                 if self.flush_on_episode_end:
                     self._flush_slot(i, out)
                 with self._metrics_lock:
@@ -281,9 +304,14 @@ class SyncSampler:
                         RolloutMetrics(
                             self.episodes[i].length,
                             self.episodes[i].total_reward,
+                            custom_metrics=dict(
+                                self.episodes[i].custom_metrics
+                            ),
                         )
                     )
                 self.episodes[i] = EpisodeRecord()
+                if self.callbacks is not None:
+                    self._cb("on_episode_start", i)
                 raw, _ = self.env.reset_at(i)
                 self.cur_obs[i] = self._transform(raw)
                 if self._has_state:

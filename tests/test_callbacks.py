@@ -1,0 +1,74 @@
+"""User callback hooks (reference ``rllib/algorithms/callbacks.py``
+DefaultCallbacks + ``tests/test_callbacks.py``): episode lifecycle
+hooks fire in order with a live episode object, custom_metrics
+aggregate into training results, and on_train_result sees every
+iteration."""
+
+import numpy as np
+
+from ray_tpu.algorithms.callbacks import DefaultCallbacks, MultiCallbacks
+from ray_tpu.algorithms.ppo import PPO
+
+
+class _Recorder(DefaultCallbacks):
+    events = []  # class-level: worker runs in-process (num_workers=0)
+
+    def on_episode_start(self, *, episode=None, **kw):
+        _Recorder.events.append("start")
+        episode.user_data["rewards"] = []
+
+    def on_episode_step(self, *, episode=None, **kw):
+        episode.user_data["rewards"].append(1.0)
+
+    def on_episode_end(self, *, episode=None, **kw):
+        _Recorder.events.append("end")
+        episode.custom_metrics["my_steps"] = float(
+            len(episode.user_data["rewards"])
+        )
+        assert len(episode.user_data["rewards"]) == episode.length
+
+    def on_sample_end(self, *, samples=None, **kw):
+        _Recorder.events.append(f"sample:{samples.count}")
+
+    def on_train_result(self, *, algorithm=None, result=None, **kw):
+        _Recorder.events.append("train_result")
+        result["from_callback"] = True
+
+
+def test_episode_hooks_and_custom_metrics():
+    _Recorder.events = []
+    algo = PPO(config={
+        "env": "CartPole-v1",
+        "train_batch_size": 256,
+        "sgd_minibatch_size": 128,
+        "num_workers": 0,
+        "callbacks_class": _Recorder,
+    })
+    try:
+        result = algo.train()
+        assert result.get("from_callback") is True
+        events = _Recorder.events
+        assert "train_result" in events
+        assert events.count("start") >= events.count("end") >= 1
+        assert any(e.startswith("sample:") for e in events)
+        cm = result.get("custom_metrics", {})
+        assert "my_steps_mean" in cm and cm["my_steps_mean"] > 0
+        assert cm["my_steps_min"] <= cm["my_steps_mean"] <= cm["my_steps_max"]
+    finally:
+        algo.cleanup()
+
+
+def test_multi_callbacks_fan_out():
+    calls = []
+
+    class A(DefaultCallbacks):
+        def on_train_result(self, **kw):
+            calls.append("A")
+
+    class B(DefaultCallbacks):
+        def on_train_result(self, **kw):
+            calls.append("B")
+
+    mc = MultiCallbacks([A, B])
+    mc.on_train_result(algorithm=None, result={})
+    assert calls == ["A", "B"]
