@@ -20,17 +20,22 @@ import sys
 
 
 def main(argv=None) -> int:
-    parser = argparse.ArgumentParser(
-        prog="python -m ray_tpu.job", description="ray_tpu job CLI"
-    )
-    parser.add_argument(
+    # --address is accepted both before and after the subcommand
+    # (users type either)
+    common = argparse.ArgumentParser(add_help=False)
+    common.add_argument(
         "--address",
         default="http://127.0.0.1:8265",
         help="dashboard URL of the head",
     )
+    parser = argparse.ArgumentParser(
+        prog="python -m ray_tpu.job",
+        description="ray_tpu job CLI",
+        parents=[common],
+    )
     sub = parser.add_subparsers(dest="cmd", required=True)
 
-    p_submit = sub.add_parser("submit")
+    p_submit = sub.add_parser("submit", parents=[common])
     p_submit.add_argument("--working-dir", default=None)
     p_submit.add_argument(
         "--runtime-env-json", default=None,
@@ -44,9 +49,9 @@ def main(argv=None) -> int:
     p_submit.add_argument("entrypoint", nargs=argparse.REMAINDER)
 
     for name in ("status", "logs", "stop"):
-        p = sub.add_parser(name)
+        p = sub.add_parser(name, parents=[common])
         p.add_argument("submission_id")
-    sub.add_parser("list")
+    sub.add_parser("list", parents=[common])
 
     args = parser.parse_args(argv)
     from ray_tpu.job.client import JobSubmissionClient
