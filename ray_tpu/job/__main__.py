@@ -20,22 +20,25 @@ import sys
 
 
 def main(argv=None) -> int:
-    # --address is accepted both before and after the subcommand
-    # (users type either)
-    common = argparse.ArgumentParser(add_help=False)
-    common.add_argument(
-        "--address",
-        default="http://127.0.0.1:8265",
-        help="dashboard URL of the head",
-    )
+    argv = list(sys.argv[1:] if argv is None else argv)
+    # --address is accepted anywhere before the "--" entrypoint
+    # separator (argparse subparser defaults clobber a value given
+    # before the subcommand, so handle it by hand)
+    address = "http://127.0.0.1:8265"
+    limit = argv.index("--") if "--" in argv else len(argv)
+    if "--address" in argv[:limit]:
+        i = argv.index("--address")
+        if i + 1 >= limit:
+            print("error: --address needs a value", file=sys.stderr)
+            return 2
+        address = argv[i + 1]
+        del argv[i : i + 2]
     parser = argparse.ArgumentParser(
-        prog="python -m ray_tpu.job",
-        description="ray_tpu job CLI",
-        parents=[common],
+        prog="python -m ray_tpu.job", description="ray_tpu job CLI"
     )
     sub = parser.add_subparsers(dest="cmd", required=True)
 
-    p_submit = sub.add_parser("submit", parents=[common])
+    p_submit = sub.add_parser("submit")
     p_submit.add_argument("--working-dir", default=None)
     p_submit.add_argument(
         "--runtime-env-json", default=None,
@@ -49,14 +52,14 @@ def main(argv=None) -> int:
     p_submit.add_argument("entrypoint", nargs=argparse.REMAINDER)
 
     for name in ("status", "logs", "stop"):
-        p = sub.add_parser(name, parents=[common])
+        p = sub.add_parser(name)
         p.add_argument("submission_id")
-    sub.add_parser("list", parents=[common])
+    sub.add_parser("list")
 
     args = parser.parse_args(argv)
     from ray_tpu.job.client import JobSubmissionClient
 
-    client = JobSubmissionClient(args.address)
+    client = JobSubmissionClient(address)
 
     if args.cmd == "submit":
         entry = args.entrypoint

@@ -192,11 +192,27 @@ def test_cluster_node_events_ride_pubsub(kv):
             kv_address=f"127.0.0.1:{server.port}"
         )
         agent = NodeAgent(addr, num_cpus=1)
-        msgs, _ = client.poll("watch", timeout=5.0)
-        assert msgs[0][0] == "cluster.node_added"
-        assert msgs[0][1]["node_id"] == agent.node_id
+
+        def poll_until(pred, deadline_s=30.0):
+            # events publish from a background thread; under load one
+            # 5s poll can race it, so accumulate until seen
+            got = []
+            deadline = time.time() + deadline_s
+            while time.time() < deadline:
+                msgs, _ = client.poll("watch", timeout=2.0)
+                got.extend(msgs)
+                if any(pred(m) for m in got):
+                    return got
+            raise AssertionError(f"event not observed; got {got}")
+
+        got = poll_until(
+            lambda m: m[0] == "cluster.node_added"
+            and m[1]["node_id"] == agent.node_id
+        )
         agent.close()
-        msgs, _ = client.poll("watch", timeout=5.0)
-        assert ("cluster.node_removed", {"node_id": agent.node_id}) in msgs
+        poll_until(
+            lambda m: m
+            == ("cluster.node_removed", {"node_id": agent.node_id})
+        )
     finally:
         ray.shutdown()
