@@ -108,7 +108,14 @@ def _format_batch(block, batch_format: str):
                     block.column_names, block.columns
                 )
             }
-        return np.asarray(list(block))
+        rows = list(block)
+        if rows and isinstance(rows[0], dict):
+            # tabular list rows → dict of column arrays (the
+            # reference's numpy batch format for tabular data)
+            return {
+                k: np.asarray([r[k] for r in rows]) for k in rows[0]
+            }
+        return np.asarray(rows)
     return _block_rows(block)  # "rows" / default
 
 
@@ -429,6 +436,16 @@ class Dataset:
                 buf = buf[batch_size:]
         if buf:
             yield _maybe_format_rows(buf, batch_format)
+
+    def iter_torch_batches(self, batch_size: int = 256):
+        """Batches as dicts of torch CPU tensors (reference
+        dataset.iter_torch_batches; tabular rows only)."""
+        import torch
+
+        for batch in self.iter_batches(batch_size, "numpy"):
+            yield {
+                k: torch.as_tensor(v) for k, v in batch.items()
+            }
 
     def iter_rows(self):
         for ref in self._materialize_refs():

@@ -1,0 +1,124 @@
+"""State observability API: programmatic cluster introspection.
+
+Counterpart of the reference's ``ray.util.state`` (``list_actors``,
+``list_tasks``, ``list_objects``, ``list_nodes`` — the API behind
+``ray list ...``), read straight from the driver runtime the way the
+reference reads from the GCS. Each entry is a plain dict, filterable
+with ``filters=[(key, "=", value), ...]``.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+
+def _apply_filters(rows: List[Dict], filters) -> List[Dict]:
+    for key, op, value in filters or ():
+        if op == "=":
+            rows = [r for r in rows if r.get(key) == value]
+        elif op == "!=":
+            rows = [r for r in rows if r.get(key) != value]
+        else:
+            raise ValueError(f"unsupported filter op {op!r}")
+    return rows
+
+
+def _runtime():
+    from ray_tpu.core import api
+
+    return api._require_runtime()
+
+
+def list_actors(filters=None) -> List[Dict]:
+    """reference state.list_actors: one dict per actor."""
+    rt = _runtime()
+    with rt.lock:
+        rows = [
+            {
+                "actor_id": rec.actor_id,
+                "name": rec.name,
+                "state": "DEAD" if rec.dead else "ALIVE",
+                "restarts": rec.restarts,
+                "pid": (
+                    rec.worker.proc.pid if rec.worker.proc else None
+                ),
+            }
+            for rec in rt.actors.values()
+        ]
+    return _apply_filters(rows, filters)
+
+
+def list_tasks(filters=None) -> List[Dict]:
+    """Pending + in-flight tasks (the reference also lists finished
+    ones from the GCS; finished tasks here live in the timeline)."""
+    rt = _runtime()
+    with rt.lock:
+        rows = [
+            {
+                "task_id": t.task_id,
+                "name": t.name,
+                "state": "PENDING_SCHEDULING",
+                "num_cpus": t.num_cpus,
+            }
+            for t in rt.pending
+        ]
+        for w in rt.pool:
+            for t in w.inflight.values():
+                rows.append(
+                    {
+                        "task_id": t.task_id,
+                        "name": t.name,
+                        "state": "RUNNING",
+                        "num_cpus": t.num_cpus,
+                        "worker_id": w.worker_id,
+                    }
+                )
+    return _apply_filters(rows, filters)
+
+
+def list_objects(filters=None) -> List[Dict]:
+    """Entries in the driver object store."""
+    rt = _runtime()
+    store = rt.store
+    with store._lock:
+        rows = [
+            {
+                "object_id": oid,
+                "ready": e.event.is_set(),
+                "in_shm": e.shm is not None,
+                "spilled": e.spill_path is not None,
+                "ref_count": store._refcounts.get(oid, 0),
+            }
+            for oid, e in store._entries.items()
+        ]
+    return _apply_filters(rows, filters)
+
+
+def list_nodes(filters=None) -> List[Dict]:
+    """The head plus any joined agent nodes (core/cluster.py)."""
+    rt = _runtime()
+    rows = [
+        {
+            "node_id": "head",
+            "state": "ALIVE",
+            "num_cpus": rt.num_cpus,
+        }
+    ]
+    cluster = getattr(rt, "cluster", None)
+    if cluster is not None:
+        for nid, node in list(cluster.nodes.items()):
+            rows.append(
+                {
+                    "node_id": nid,
+                    "state": "DEAD" if node.dead else "ALIVE",
+                    "num_cpus": node.num_cpus,
+                }
+            )
+    return _apply_filters(rows, filters)
+
+
+def summarize_tasks() -> Dict[str, int]:
+    out: Dict[str, int] = {}
+    for t in list_tasks():
+        out[t["state"]] = out.get(t["state"], 0) + 1
+    return out

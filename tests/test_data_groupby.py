@@ -100,3 +100,16 @@ def test_groupby_single_block_local_path():
     ds = Dataset.from_items([{"k": 0, "v": 1.0}], parallelism=1)
     out = ds.groupby("k").sum("v").take_all()
     assert out == [{"k": 0, "sum(v)": 1.0}]
+
+
+def test_iter_torch_batches():
+    ds = Dataset.from_items(
+        [{"x": float(i), "y": i} for i in range(10)], parallelism=2
+    )
+    batches = list(ds.iter_torch_batches(batch_size=4))
+    import torch
+
+    assert len(batches) == 3
+    assert isinstance(batches[0]["x"], torch.Tensor)
+    assert batches[0]["x"].tolist() == [0.0, 1.0, 2.0, 3.0]
+    assert batches[-1]["y"].tolist() == [8, 9]

@@ -1,0 +1,73 @@
+"""State observability API (reference ``ray.util.state``
+list_actors/list_tasks/list_objects/list_nodes + its tests)."""
+
+import time
+
+import pytest
+
+import ray_tpu as ray
+from ray_tpu.util import state
+
+
+@pytest.fixture(autouse=True)
+def _init():
+    ray.shutdown()
+    ray.init(num_cpus=2)
+    yield
+    ray.shutdown()
+
+
+def test_list_actors_and_filters():
+    @ray.remote
+    class A:
+        def ping(self):
+            return 1
+
+    a = A.options(name="observed").remote()
+    ray.get(a.ping.remote(), timeout=60)
+    rows = state.list_actors()
+    assert any(r["name"] == "observed" for r in rows)
+    alive = state.list_actors(filters=[("state", "=", "ALIVE")])
+    assert all(r["state"] == "ALIVE" for r in alive)
+    ray.kill(a)
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        dead = state.list_actors(filters=[("state", "=", "DEAD")])
+        if any(r["name"] == "observed" for r in dead):
+            break
+        time.sleep(0.1)
+    assert any(r["name"] == "observed" for r in dead)
+
+
+def test_list_tasks_shows_running_and_pending():
+    @ray.remote
+    def slow():
+        time.sleep(5)
+
+    refs = [slow.remote() for _ in range(4)]  # 2 run, 2 queue
+    deadline = time.time() + 15
+    while time.time() < deadline:
+        rows = state.list_tasks()
+        states = [r["state"] for r in rows]
+        if (
+            states.count("RUNNING") >= 1
+            and states.count("PENDING_SCHEDULING") >= 1
+        ):
+            break
+        time.sleep(0.1)
+    assert states.count("RUNNING") >= 1
+    assert states.count("PENDING_SCHEDULING") >= 1
+    summary = state.summarize_tasks()
+    assert summary.get("RUNNING", 0) >= 1
+    for r in refs:
+        ray.cancel(r)
+
+
+def test_list_objects_and_nodes():
+    ref = ray.put("observable")
+    rows = state.list_objects()
+    mine = [r for r in rows if r["object_id"] == ref.id]
+    assert mine and mine[0]["ready"] and mine[0]["ref_count"] >= 1
+    nodes = state.list_nodes()
+    assert nodes[0]["node_id"] == "head"
+    assert nodes[0]["num_cpus"] == 2
