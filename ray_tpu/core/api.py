@@ -664,7 +664,17 @@ class _Runtime:
                 )
                 return ActorHandle(actor_id, cls.__name__)
         actor_id = uuid.uuid4().hex
+        # serialize BEFORE spawning: an unpicklable class or argument
+        # must not leak a freshly spawned (possibly non-daemon) worker
+        # process — an orphaned non-daemon child wedges interpreter
+        # exit in multiprocessing's atexit join
         cls_blob = ser.dumps(cls)
+        payload = ser.dumps(
+            (
+                [self._marshal_arg(a) for a in args],
+                {k: self._marshal_arg(v) for k, v in kwargs.items()},
+            )
+        )
         w = self._spawn_worker(
             dedicated=True,
             daemon=bool(options.get("daemon", True)),
@@ -678,12 +688,7 @@ class _Runtime:
                 options.get("max_concurrency", 1)
             ),
             "runtime_env": renv_packed,
-            "payload": ser.dumps(
-                (
-                    [self._marshal_arg(a) for a in args],
-                    {k: self._marshal_arg(v) for k, v in kwargs.items()},
-                )
-            ),
+            "payload": payload,
         }
         rec = _ActorRecord(
             actor_id, w, cls_blob, init_msg,

@@ -9,6 +9,11 @@ from ray_tpu.tune.schedulers import (
     MedianStoppingRule,
     PopulationBasedTraining,
 )
+from ray_tpu.tune.function_trainable import (
+    get_checkpoint,
+    report,
+    with_parameters,
+)
 from ray_tpu.tune.search import (
     grid_search,
     uniform,
@@ -35,4 +40,7 @@ __all__ = [
     "choice",
     "randint",
     "sample_from",
+    "report",
+    "get_checkpoint",
+    "with_parameters",
 ]

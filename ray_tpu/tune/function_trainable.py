@@ -1,0 +1,144 @@
+"""Function trainables: ``tune.run(train_fn)`` with ``tune.report``.
+
+Counterpart of the reference's ``tune/trainable/function_trainable.py``
+(FunctionTrainable + the ``tune.report``/``session.report`` seam): the
+user function runs on a background thread inside the Trainable; each
+``tune.report(**metrics)`` hands one result to ``step()`` and BLOCKS
+until the runner consumes it, so the function is paced by the trial
+loop exactly like a class trainable. ``tune.with_parameters`` binds
+large objects into the function ahead of time.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Callable, Dict, Optional
+
+from ray_tpu.tune.trainable import Trainable
+
+_session = threading.local()
+
+
+def report(_metrics: Optional[Dict] = None, **kwargs) -> None:
+    """Inside a function trainable: deliver one result row to the
+    trial loop (reference ``tune.report`` / ``session.report``)."""
+    sess = getattr(_session, "current", None)
+    if sess is None:
+        raise RuntimeError(
+            "tune.report() called outside a tune function trainable"
+        )
+    metrics = dict(_metrics or {})
+    metrics.update(kwargs)
+    sess.deliver(metrics)
+
+
+def get_checkpoint():
+    """Restored checkpoint dict for this trial, if any (reference
+    session.get_checkpoint for function trainables)."""
+    sess = getattr(_session, "current", None)
+    return sess.restored if sess is not None else None
+
+
+class _FnSession:
+    def __init__(self, restored=None):
+        # maxsize 1: report() blocks until step() consumes — the
+        # function cannot run ahead of the trial loop
+        self.results: "queue.Queue" = queue.Queue(maxsize=1)
+        self.restored = restored
+
+    def deliver(self, metrics: Dict) -> None:
+        self.results.put(("result", metrics))
+
+    def finish(self, error: Optional[BaseException]) -> None:
+        self.results.put(("done", error))
+
+
+def wrap_function(train_fn: Callable[[Dict], Any]) -> type:
+    """Build a Trainable class around ``train_fn(config)`` (reference
+    ``wrap_function``)."""
+
+    class FunctionTrainable(Trainable):
+        _function = staticmethod(train_fn)
+
+        def setup(self, config: Dict) -> None:
+            self._sess = _FnSession()
+            self._thread: Optional[threading.Thread] = None
+            self._final: Optional[Dict] = None
+            self._last: Dict = {}
+
+        def _start(self) -> None:
+            def runner():
+                # access the thread-local through the module: this
+                # class is pickled BY VALUE into trial actors, and a
+                # direct global reference would drag the unpicklable
+                # threading.local along
+                from ray_tpu.tune import function_trainable as _ft
+
+                _ft._session.current = self._sess
+                err: Optional[BaseException] = None
+                try:
+                    out = type(self)._function(dict(self.config))
+                    if isinstance(out, dict):
+                        self._final = out
+                except BaseException as e:  # noqa: BLE001
+                    err = e
+                finally:
+                    self._sess.finish(err)
+
+            self._thread = threading.Thread(
+                target=runner, daemon=True, name="tune_fn"
+            )
+            self._thread.start()
+
+        def step(self) -> Dict:
+            if self._thread is None:
+                self._start()
+            kind, payload = self._sess.results.get()
+            if kind == "result":
+                self._last = dict(payload)
+                return self._last
+            # function returned (or raised): surface the error, else
+            # emit a final done result (reference: RESULT_DUPLICATE)
+            if payload is not None:
+                raise payload
+            out = dict(self._final or self._last)
+            out["done"] = True
+            return out
+
+        def save_checkpoint(self, checkpoint_dir: str) -> str:
+            import os
+            import pickle
+
+            path = os.path.join(checkpoint_dir, "fn_state.pkl")
+            with open(path, "wb") as f:
+                pickle.dump(self._last, f)
+            return checkpoint_dir
+
+        def load_checkpoint(self, checkpoint_path: str) -> None:
+            import os
+            import pickle
+
+            if os.path.isdir(checkpoint_path):
+                checkpoint_path = os.path.join(
+                    checkpoint_path, "fn_state.pkl"
+                )
+            with open(checkpoint_path, "rb") as f:
+                self._sess.restored = pickle.load(f)
+
+    FunctionTrainable.__name__ = getattr(
+        train_fn, "__name__", "fn"
+    )
+    return FunctionTrainable
+
+
+def with_parameters(fn: Callable, **params) -> Callable:
+    """Bind constant (possibly large) objects into a function
+    trainable (reference ``tune.with_parameters``); the bound values
+    ride cloudpickle with the function."""
+
+    def bound(config: Dict):
+        return fn(config, **params)
+
+    bound.__name__ = getattr(fn, "__name__", "fn")
+    return bound

@@ -29,6 +29,9 @@ class Trial:
         self.error: Optional[str] = None
 
     def should_stop(self, result: Dict) -> bool:
+        if result.get("done"):
+            # function trainables mark their natural end
+            return True
         for k, v in self.stopping_criterion.items():
             if result.get(k, float("-inf")) >= v:
                 return True

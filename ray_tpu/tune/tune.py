@@ -32,6 +32,7 @@ from ray_tpu.tune.schedulers import (
     TrialScheduler,
 )
 from ray_tpu.tune.search import BasicVariantGenerator
+from ray_tpu.tune.trainable import Trainable
 from ray_tpu.tune.search import Domain as SearchDomain
 from ray_tpu.tune.trial import (
     ERROR,
@@ -558,6 +559,7 @@ def run(
     search_alg=None,
     resources_per_trial: Optional[Dict] = None,
     sync_config=None,
+    raise_on_failed_trial: bool = True,
 ) -> ExperimentAnalysis:
     """reference tune/tune.py:118.
 
@@ -586,6 +588,18 @@ def run(
 
         trainable_cls = get_algorithm_class(run_or_experiment)
         exp_name = name or run_or_experiment
+    elif isinstance(run_or_experiment, type) and issubclass(
+        run_or_experiment, Trainable
+    ):
+        trainable_cls = run_or_experiment
+        exp_name = name or trainable_cls.__name__
+    elif callable(run_or_experiment):
+        # plain function trainable: tune.run(train_fn) + tune.report
+        # (reference function_trainable.wrap_function)
+        from ray_tpu.tune.function_trainable import wrap_function
+
+        trainable_cls = wrap_function(run_or_experiment)
+        exp_name = name or trainable_cls.__name__
     else:
         trainable_cls = run_or_experiment
         exp_name = name or trainable_cls.__name__
@@ -670,4 +684,10 @@ def run(
         # Crash/interrupt path: without this, live non-daemon trial
         # actors (whole Trainables) outlive the experiment.
         runner.cleanup()
+    errored = [t for t in trials if t.status == ERROR]
+    if errored and raise_on_failed_trial:
+        raise RuntimeError(
+            f"{len(errored)} trial(s) errored; first: "
+            f"{errored[0].error}"
+        )
     return ExperimentAnalysis(trials, metric, mode)

@@ -1,0 +1,86 @@
+"""Function trainables (reference
+``tune/trainable/function_trainable.py`` + test_function_api.py):
+``tune.run(train_fn)`` with ``tune.report``, natural completion,
+grid search over functions, with_parameters binding, and checkpoint
+restore via ``tune.get_checkpoint``."""
+
+import numpy as np
+import pytest
+
+from ray_tpu import tune
+
+
+def test_function_reports_and_completes():
+    def train_fn(config):
+        for i in range(5):
+            tune.report(
+                episode_reward_mean=config["x"] * (i + 1),
+                training_iteration=i + 1,
+            )
+
+    analysis = tune.run(
+        train_fn, config={"x": 2.0}, verbose=0
+    )
+    t = analysis.trials[0]
+    assert t.last_result["done"] is True
+    # last real report seen before completion
+    assert t.results[-2]["episode_reward_mean"] == 10.0
+    assert len([r for r in t.results if "episode_reward_mean" in r]) >= 5
+
+
+def test_function_grid_search_picks_best():
+    def train_fn(config):
+        for i in range(3):
+            tune.report(episode_reward_mean=-abs(config["x"] - 3.0))
+
+    analysis = tune.run(
+        train_fn,
+        config={"x": tune.grid_search([0.0, 3.0, 10.0])},
+        verbose=0,
+    )
+    best = analysis.get_best_trial()
+    assert best.config["x"] == 3.0
+
+
+def test_function_stop_criteria_cut_early():
+    def train_fn(config):
+        for i in range(100):
+            tune.report(episode_reward_mean=float(i))
+
+    analysis = tune.run(
+        train_fn,
+        config={},
+        stop={"episode_reward_mean": 5.0},
+        verbose=0,
+    )
+    t = analysis.trials[0]
+    assert t.last_result["episode_reward_mean"] == 5.0
+
+
+def test_with_parameters_binds_large_objects():
+    data = np.arange(1000.0)
+
+    def train_fn(config, data=None):
+        tune.report(episode_reward_mean=float(data.sum()) * config["s"])
+
+    analysis = tune.run(
+        tune.with_parameters(train_fn, data=data),
+        config={"s": 2.0},
+        verbose=0,
+    )
+    t = analysis.trials[0]
+    reported = [
+        r for r in t.results if "episode_reward_mean" in r
+    ]
+    assert reported[0]["episode_reward_mean"] == data.sum() * 2.0
+
+
+def test_function_error_fails_trial():
+    def train_fn(config):
+        tune.report(episode_reward_mean=1.0)
+        raise RuntimeError("boom")
+
+    analysis = tune.run(
+        train_fn, config={}, raise_on_failed_trial=False, verbose=0
+    )
+    assert analysis.trials[0].status == "ERROR"
