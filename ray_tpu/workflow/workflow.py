@@ -20,6 +20,8 @@ driver restart.
 
 from __future__ import annotations
 
+import contextlib
+import fcntl
 import hashlib
 import json
 import os
@@ -144,13 +146,30 @@ class _Execution:
             self._check_canceled()
             try:
                 value = node.fn(*args, **kwargs)
-                return (value, None) if node.catch_exceptions else value
             except Exception as e:
                 if k + 1 >= attempts:
                     if node.catch_exceptions:
                         return (None, e)
                     raise
                 time.sleep(node.retry_delay_s * (2**k))
+                continue
+            # dynamic continuation (reference workflow.continuation): a
+            # step returning a StepNode hands control to a NEW sub-DAG.
+            # Resolve it BEFORE the catch_exceptions tuple wrap — a
+            # (StepNode, None) tuple would hide the continuation from
+            # resolve() and checkpoint it unexecuted. catch_exceptions
+            # covers the WHOLE continuation chain (reference semantics);
+            # the outer step already succeeded and is not retried.
+            try:
+                while isinstance(value, StepNode):
+                    value = self.resolve(value)
+            except _Canceled:
+                raise
+            except Exception as e:
+                if node.catch_exceptions:
+                    return (None, e)
+                raise
+            return (value, None) if node.catch_exceptions else value
 
     def resolve(self, node: Any):
         if isinstance(node, StepNode):
@@ -164,13 +183,9 @@ class _Execution:
                 self.steps_cached.append(step_id)
                 with open(path, "rb") as f:
                     return pickle.load(f)
+            # dynamic continuations are resolved inside _run_step (so
+            # catch_exceptions wrapping can't hide them)
             value = self._run_step(node, args, kwargs)
-            # dynamic continuation (reference workflow.continuation):
-            # a step returning a StepNode hands control to a NEW
-            # sub-DAG, resolved (and checkpointed) before this step's
-            # own result is recorded
-            while isinstance(value, StepNode):
-                value = self.resolve(value)
             tmp = path + f".tmp{os.getpid()}"
             with open(tmp, "wb") as f:
                 pickle.dump(value, f)
@@ -204,11 +219,25 @@ def _write_status(wf_dir: str, **fields) -> None:
     os.replace(tmp, os.path.join(wf_dir, "status.json"))
 
 
+@contextlib.contextmanager
+def _status_lock(wf_dir: str):
+    """flock serializing status transitions, so run()'s canceled-check
+    + RUNNING write is atomic against a concurrent cancel()."""
+    os.makedirs(wf_dir, exist_ok=True)
+    with open(os.path.join(wf_dir, ".status.lock"), "w") as f:
+        fcntl.flock(f, fcntl.LOCK_EX)
+        try:
+            yield
+        finally:
+            fcntl.flock(f, fcntl.LOCK_UN)
+
+
 def run(
     dag: StepNode,
     *,
     workflow_id: str,
     storage: Optional[str] = None,
+    _resuming: bool = False,
 ) -> Any:
     """Execute the DAG durably; resuming a workflow_id skips completed
     steps (reference workflow.run + resume)."""
@@ -226,9 +255,22 @@ def run(
             os.replace(dag_path + ".tmp", dag_path)
         except Exception:
             pass  # truly unpicklable DAG: resume-by-id unavailable
-    _write_status(
-        ex.dir, status=RUNNING, start_time=time.time(), end_time=None
-    )
+    # a cancel() issued before (or racing) this startup write must not
+    # be clobbered by the RUNNING transition — the flock makes
+    # check+write atomic against cancel(); a CANCELED id needs an
+    # explicit resume() to run again
+    with _status_lock(ex.dir):
+        if (
+            not _resuming
+            and _read_status(ex.dir).get("status") == CANCELED
+        ):
+            raise WorkflowCanceledError(workflow_id)
+        _write_status(
+            ex.dir,
+            status=RUNNING,
+            start_time=time.time(),
+            end_time=None,
+        )
     try:
         result = ex.resolve(dag)
     except _Canceled:
@@ -320,13 +362,23 @@ def resume(workflow_id: str, storage: Optional[str] = None) -> Any:
         raise ValueError(
             f"workflow {workflow_id!r} has no stored DAG to resume"
         ) from None
-    return run(dag, workflow_id=workflow_id, storage=storage)
+    return run(
+        dag, workflow_id=workflow_id, storage=storage, _resuming=True
+    )
 
 
 def cancel(workflow_id: str, storage: Optional[str] = None) -> None:
     """Mark a workflow canceled; its execution stops before the next
     step starts (reference workflow.cancel — cooperative, like the
-    reference's checkpoint-boundary cancellation)."""
+    reference's checkpoint-boundary cancellation). Only a KNOWN
+    workflow (one that has started, i.e. has stored state) can be
+    canceled — canceling an arbitrary never-run id would brick it:
+    run() refuses CANCELED ids and resume() has no DAG to load."""
     wf_dir = os.path.join(storage or _DEFAULT_STORAGE, workflow_id)
-    os.makedirs(wf_dir, exist_ok=True)
-    _write_status(wf_dir, status=CANCELED, end_time=time.time())
+    if not (
+        os.path.exists(os.path.join(wf_dir, "status.json"))
+        or os.path.exists(os.path.join(wf_dir, "dag.pkl"))
+    ):
+        raise ValueError(f"unknown workflow {workflow_id!r}")
+    with _status_lock(wf_dir):
+        _write_status(wf_dir, status=CANCELED, end_time=time.time())

@@ -138,3 +138,61 @@ def test_nested_refs_pass_between_tasks():
 
     inner_ref = ray.get(make.remote(), timeout=120)
     assert ray.get(read.remote([inner_ref]), timeout=120) == "payload"
+
+
+def test_zero_cpu_nested_get_does_not_leak_blocked_workers():
+    """A num_cpus=0 task holds no CPU slot; its nested blocking get
+    must not permanently inflate blocked_workers (which feeds the
+    worker-spawn cap)."""
+    from ray_tpu.core import api as core_api
+
+    @ray.remote
+    def leaf():
+        return 7
+
+    @ray.remote(num_cpus=0)
+    def zero_cpu_parent():
+        return ray.get(leaf.remote(), timeout=60) + 1
+
+    for _ in range(3):
+        assert ray.get(zero_cpu_parent.remote(), timeout=120) == 8
+    assert core_api._runtime.blocked_workers == 0
+
+
+def test_threaded_actor_concurrent_nested_gets():
+    """Threads of a max_concurrency actor get their own driver-API
+    connection: one thread blocked in a nested get must not serialize
+    (or deadlock) another thread's nested submit+get."""
+    import time
+
+    @ray.remote
+    def slow_leaf():
+        time.sleep(1.0)
+        return 1
+
+    @ray.remote
+    def fast_leaf():
+        return 2
+
+    @ray.remote(max_concurrency=2, num_cpus=0)
+    class Nester:
+        def slow(self):
+            return ray.get(slow_leaf.remote(), timeout=60)
+
+        def fast(self):
+            return ray.get(fast_leaf.remote(), timeout=60)
+
+    a = Nester.remote()
+    # warm: spawn both leaf workers and both actor threads before
+    # timing (worker spawn is ~3-4s on the 1-core host)
+    ray.get([fast_leaf.remote(), slow_leaf.remote()], timeout=120)
+    ray.get(a.fast.remote(), timeout=120)
+    slow_ref = a.slow.remote()
+    time.sleep(0.1)  # let slow enter its nested get first
+    t0 = time.monotonic()
+    assert ray.get(a.fast.remote(), timeout=60) == 2
+    fast_latency = time.monotonic() - t0
+    assert ray.get(slow_ref, timeout=60) == 1
+    # fast must not have waited for slow's 1s nested get (generous
+    # slack for the 1-core host)
+    assert fast_latency < 0.9, fast_latency

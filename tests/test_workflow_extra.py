@@ -122,3 +122,77 @@ def test_cancel_stops_before_next_step(tmp_path):
             storage=str(tmp_path),
         )
     assert workflow.get_status("wf_cancel", str(tmp_path)) == "CANCELED"
+
+
+def test_catch_exceptions_with_continuation(tmp_path):
+    """A step with catch_exceptions=True returning a continuation must
+    execute the continuation, not checkpoint the raw StepNode."""
+
+    @workflow.step
+    def tail(x):
+        return x + 1
+
+    @workflow.step
+    def head():
+        return tail.bind(10)  # dynamic continuation
+
+    value, err = workflow.run(
+        head.options(catch_exceptions=True).bind(),
+        workflow_id="wf_catch_cont",
+        storage=str(tmp_path),
+    )
+    assert err is None
+    assert value == 11
+
+
+def test_failing_continuation_under_catch_exceptions(tmp_path):
+    """catch_exceptions covers the whole continuation chain: a failing
+    continuation yields (None, err), it does not raise."""
+
+    @workflow.step
+    def bad_tail(x):
+        raise RuntimeError("tail broke")
+
+    @workflow.step
+    def head():
+        return bad_tail.bind(1)
+
+    value, err = workflow.run(
+        head.options(catch_exceptions=True).bind(),
+        workflow_id="wf_catch_bad_cont",
+        storage=str(tmp_path),
+    )
+    assert value is None
+    assert isinstance(err, RuntimeError)
+
+
+def test_cancel_unknown_workflow_raises(tmp_path):
+    # canceling a never-run id would brick it (run refuses CANCELED,
+    # resume has no DAG) — so cancel only accepts known workflows
+    with pytest.raises(ValueError):
+        workflow.cancel("wf_never_ran", str(tmp_path))
+
+
+def test_canceled_workflow_needs_explicit_resume(tmp_path):
+    calls = {"n": 0}
+
+    @workflow.step
+    def work():
+        calls["n"] += 1
+        return calls["n"]
+
+    assert (
+        workflow.run(
+            work.bind(), workflow_id="wf_recancel", storage=str(tmp_path)
+        )
+        == 1
+    )
+    workflow.cancel("wf_recancel", str(tmp_path))
+    # a fresh run() of a CANCELED id refuses...
+    with pytest.raises(workflow.WorkflowCanceledError):
+        workflow.run(
+            work.bind(), workflow_id="wf_recancel", storage=str(tmp_path)
+        )
+    # ...but an explicit resume() may proceed (cached steps reload)
+    assert workflow.resume("wf_recancel", str(tmp_path)) == 1
+    assert calls["n"] == 1
