@@ -190,10 +190,40 @@ def bench_jax(
         stats = policy.learn_on_device_batch(dev, bsize)
         stats["total_loss"]  # host sync already done by device_get
         times.append(time.perf_counter() - t0)
+
+    # pipelined phase: defer the stats fetch so consecutive nests queue
+    # on-device and the fixed per-dispatch latency (dominant on a
+    # tunneled backend) amortizes across the stream — the LearnerThread
+    # runs exactly this protocol (execution/learner_thread.py). Lag is
+    # bounded like there (STATS_LAG) so device memory stays bounded.
+    import collections
+
+    import jax
+
+    lazy = collections.deque()
+    K = timed_rounds
+    t0 = time.perf_counter()
+    for k in range(K):
+        dev, bsize = feeder.get()
+        feeder.put(*host_batches[k % 3])
+        lazy.append(
+            policy.learn_on_device_batch(dev, bsize, defer_stats=True)
+        )
+        while len(lazy) > 3:
+            jax.device_get(lazy.popleft())
+    while lazy:
+        jax.device_get(lazy.popleft())
+    pipelined_wall = (time.perf_counter() - t0) / K
+
     if ctx is not None:
         ctx.__exit__(None, None, None)
     feeder.stop()
-    return b / float(np.median(times)), times
+    return (
+        b / float(np.median(times)),
+        times,
+        b / pipelined_wall,
+        pipelined_wall,
+    )
 
 
 def bench_mfu(b=B, mb=MB, iters=ITERS, reps=4, h=H, w=W, c=C):
@@ -333,9 +363,44 @@ def main():
         profile_dir = (
             sys.argv[i + 1] if len(sys.argv) > i + 1 else "/tmp/ray_tpu_trace"
         )
-    jax_sps, times = bench_jax(profile_dir=profile_dir)
+    jax_sps, times, pipe_sps, pipe_wall = bench_jax(
+        profile_dir=profile_dir
+    )
     mfu = bench_mfu()
     torch_sps = bench_torch()
+    # Effective (wall-clock) MFU of the pipelined stream — the number
+    # that includes transfer and amortized dispatch, not just the
+    # epoch-isolated nest compute. Its physical ceiling on a tunneled
+    # backend is the H2D bandwidth: a fresh train batch must cross the
+    # wire every nest, so report the transfer bound alongside (bytes
+    # per batch over the nest-compute time = the bandwidth that would
+    # make compute the bottleneck).
+    flops_per_nest = B * ITERS * nature_cnn_train_flops_per_sample()
+    peak = mfu.get("peak_tflops") or chip_peak_tflops()[0]
+    effective_mfu_pct = round(
+        100.0 * flops_per_nest / pipe_wall / 1e12 / peak, 1
+    )
+    rng = np.random.default_rng(0)
+    # bytes that actually cross the wire per nest: the PREPARED tree
+    # (frame-pool format), not the materialized stacks
+    _p = _make_policy(B, MB, ITERS)
+    _tree, _ = _p.prepare_batch(make_batch(rng))
+    batch_bytes = sum(v.nbytes for v in _tree.values())
+    nest_s = mfu.get("nest_compute_s")
+    breakeven_mb_s = (
+        round(batch_bytes / nest_s / 1e6, 1) if nest_s else None
+    )
+    # measured H2D bandwidth: a fresh batch must cross the wire every
+    # nest, so min(measured/breakeven, 1) bounds achievable wall-clock
+    # MFU on this backend no matter how deep the pipeline
+    import jax
+
+    t0 = time.perf_counter()
+    devd = jax.device_put(
+        {"x": np.zeros(batch_bytes, np.uint8)}
+    )
+    jax.block_until_ready(devd["x"])
+    h2d_mb_s = round(batch_bytes / (time.perf_counter() - t0) / 1e6, 1)
     print(
         json.dumps(
             {
@@ -345,6 +410,22 @@ def main():
                 "vs_baseline": round(jax_sps / torch_sps, 2),
                 "baseline_torch_cpu": round(torch_sps, 1),
                 "round_times_s": [round(t, 3) for t in times],
+                "pipelined": {
+                    "env_steps_per_sec": round(pipe_sps, 1),
+                    "wall_s_per_nest": round(pipe_wall, 4),
+                    "effective_mfu_pct": effective_mfu_pct,
+                    "batch_bytes": int(batch_bytes),
+                    "h2d_mb_s_measured": h2d_mb_s,
+                    "h2d_mb_s_for_compute_bound": breakeven_mb_s,
+                    "note": (
+                        "wall-clock MFU is H2D-bandwidth-bound on the "
+                        "tunneled backend: a fresh (already 4x frame-"
+                        "deduplicated) batch crosses the wire each "
+                        "nest, so its ceiling is mfu_pct x measured/"
+                        "compute-bound bandwidth; on direct-attached "
+                        "TPU (GB/s DMA) the same program is nest-bound"
+                    ),
+                },
                 "mfu": mfu,
                 "config": {
                     "train_batch": B,

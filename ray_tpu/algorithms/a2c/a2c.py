@@ -76,6 +76,9 @@ class A2CConfig(AlgorithmConfig):
 class A2CJaxPolicy(JaxPolicy):
     """Vanilla actor-critic loss (reference a3c_torch_policy.py)."""
 
+    # loss never reads NEXT_OBS; don't ship a second obs column
+    _ship_next_obs = False
+
     def loss(self, params, batch, rng, coeffs):
         cfg = self.config
         dist_inputs, values, _ = self.model_forward_train(params, batch)

@@ -141,8 +141,24 @@ class ImpalaJaxPolicy(JaxPolicy):
             v = np.asarray(v)[:n]
             return v.reshape((num, T) + v.shape[1:])
 
+        from ray_tpu.ops.framestack import FRAME_IDX, FRAMES
+
+        if FRAMES in samples:
+            # worker-compressed fragments (compress_for_shipping):
+            # ship the pool through; the (B, T+1) index column carries
+            # obs AND the bootstrap stack (idx[-1]+1 by construction)
+            idx = np.asarray(samples[FRAME_IDX], np.int32)[
+                :n
+            ].reshape(num, T)
+            obs_cols = {
+                FRAMES: np.asarray(samples[FRAMES]),
+                FRAME_IDX: np.concatenate(
+                    [idx, idx[:, -1:] + 1], axis=1
+                ),
+            }
+        else:
+            obs_cols = None
         out = {
-            SampleBatch.OBS: shape_col(samples[SampleBatch.OBS]),
             SampleBatch.ACTIONS: shape_col(samples[SampleBatch.ACTIONS]),
             SampleBatch.REWARDS: shape_col(
                 samples[SampleBatch.REWARDS]
@@ -167,11 +183,71 @@ class ImpalaJaxPolicy(JaxPolicy):
             SampleBatch.ACTION_LOGP: shape_col(
                 samples[SampleBatch.ACTION_LOGP]
             ).astype(np.float32),
-            "bootstrap_obs": shape_col(samples[SampleBatch.NEXT_OBS])[
-                :, -1
-            ],
         }
+        if obs_cols is not None:
+            out.update(obs_cols)
+            return out
+        out[SampleBatch.OBS] = shape_col(samples[SampleBatch.OBS])
+        out["bootstrap_obs"] = shape_col(
+            samples[SampleBatch.NEXT_OBS]
+        )[:, -1]
+        return self._maybe_dedup_unroll_framestack(out)
+
+    def _maybe_dedup_unroll_framestack(self, out):
+        """Unroll-shaped variant of the base policy's framestack dedup:
+        each (T,)-unroll plus its bootstrap obs is a sliding window of
+        T + k frames (broken only at in-fragment episode resets, which
+        the ``dones`` column marks), so the device transfer drops from
+        (B, T+1) full k-stacks to ~(T + k) single frames per unroll.
+        The (B, T+1) index column rebuilds OBS and bootstrap_obs on
+        device (``_rebuild_obs_from_frames`` override)."""
+        obs = out[SampleBatch.OBS]
+        if (
+            not self.config.get("dedup_framestack", True)
+            or obs.ndim != 5
+            or not 2 <= obs.shape[-1] <= 8
+            or obs.nbytes
+            < self.config.get("dedup_framestack_min_bytes", 1 << 20)
+        ):
+            return out
+        from ray_tpu.ops.framestack import (
+            FRAME_IDX,
+            FRAMES,
+            decompose_segmented_obs,
+        )
+
+        B, T = obs.shape[:2]
+        ext = np.concatenate(
+            [obs, out["bootstrap_obs"][:, None]], axis=1
+        ).reshape((B * (T + 1),) + obs.shape[2:])
+        seg = np.zeros(B * (T + 1), bool)
+        seg[:: T + 1] = True  # each unroll starts a fresh window
+        # the obs AFTER a done row is a reset obs (new window); the
+        # bootstrap pseudo-row always slides (terminal next_obs does)
+        dones = out["dones"][:, : T - 1] > 0
+        seg.reshape(B, T + 1)[:, 1:T] |= dones
+        dec = decompose_segmented_obs(ext, seg)
+        if dec is None:
+            return out
+        stream, idx = dec
+        out = dict(out)
+        del out[SampleBatch.OBS]
+        del out["bootstrap_obs"]
+        out[FRAMES] = stream
+        out[FRAME_IDX] = idx.reshape(B, T + 1)
         return out
+
+    def _rebuild_obs_from_frames(self, frames, batch, stack_k):
+        from ray_tpu.ops.framestack import FRAME_IDX, build_stacks
+
+        batch = dict(batch)
+        idx = batch.pop(FRAME_IDX)
+        B, T1 = idx.shape
+        stacks = build_stacks(frames, idx.reshape(-1), stack_k)
+        stacks = stacks.reshape((B, T1) + stacks.shape[1:])
+        batch[SampleBatch.OBS] = stacks[:, :-1]
+        batch["bootstrap_obs"] = stacks[:, -1]
+        return batch
 
     def _forward_unrolls(self, params, batch):
         """Forward the (B, T) fragment batch and its bootstrap obs in
@@ -303,13 +379,31 @@ class IMPALA(Algorithm):
     def setup(self, config: Dict) -> None:
         config["_fixed_unrolls"] = True
         super().setup(config)
+        # The learner thread publishes host weights every
+        # broadcast_interval of ITS steps; the driver broadcasts the
+        # published blob without ever touching the device (a driver-side
+        # get_weights would both pull params through the TPU tunnel and
+        # serialize against the learner's on-device program queue).
         self._learner_thread = LearnerThread(
             self.get_policy(),
             inqueue_size=config.get("learner_queue_size", 16),
+            publish_weights_every=max(
+                1, int(config.get("broadcast_interval", 1))
+            ),
         )
         self._learner_thread.start()
         self._in_flight: Dict = {}  # ref -> worker
-        self._batches_since_broadcast: Dict = {}
+        # fragment accumulator: feed the learner whole train batches
+        # (reference impala.py:614 concatenates sample batches to
+        # train_batch_size before the learner queue), halving dispatch
+        # and prepare_batch counts vs per-fragment feeding
+        self._frag_buf: list = []
+        self._frag_steps = 0
+        self._train_ready: list = []  # concat batches awaiting queue room
+        # weight-broadcast bookkeeping: published version each worker has
+        self._worker_weight_ver: Dict = {}
+        self._weights_ref = None
+        self._weights_ref_ver = -1
         n_agg = int(config.get("num_aggregation_workers", 0))
         self._aggregators = [
             AggregatorWorker.remote(config.get("train_batch_size", 500))
@@ -341,24 +435,41 @@ class IMPALA(Algorithm):
             self._counters[NUM_ENV_STEPS_SAMPLED] += batch.env_steps()
             lt.add_batch(batch)
         else:
-            # keep each worker saturated with sample requests
+            # drain buffered train batches FIRST so backpressure
+            # clears as soon as the learner makes queue room
+            while self._train_ready:
+                if lt.add_batch(self._train_ready[0], block=False):
+                    self._train_ready.pop(0)
+                else:
+                    break
+            # keep each worker saturated with sample requests — unless
+            # the learner is backed up (backpressure: stop asking for
+            # fragments we'd only buffer on the driver)
             max_inflight = self.config.get(
                 "max_sample_requests_in_flight_per_worker", 2
             )
+            backlogged = len(self._train_ready) >= 4
             counts: Dict = {}
             for ref, w in self._in_flight.items():
                 counts[id(w)] = counts.get(id(w), 0) + 1
-            for w in workers:
-                while counts.get(id(w), 0) < max_inflight:
-                    self._in_flight[w.sample.remote()] = w
-                    counts[id(w)] = counts.get(id(w), 0) + 1
+            if not backlogged:
+                for w in workers:
+                    while counts.get(id(w), 0) < max_inflight:
+                        self._in_flight[w.sample.remote()] = w
+                        counts[id(w)] = counts.get(id(w), 0) + 1
 
-            ready, _ = ray.wait(
-                list(self._in_flight.keys()),
-                num_returns=1,
-                timeout=2.0,
-            )
-            weights_ref = None
+            if self._in_flight:
+                ready, _ = ray.wait(
+                    list(self._in_flight.keys()),
+                    num_returns=1,
+                    timeout=2.0,
+                )
+            else:
+                # fully backpressured: nothing in flight to wait on —
+                # give the learner a beat instead of spinning
+                time.sleep(0.05)
+                ready = []
+            target = int(self.config.get("train_batch_size", 500))
             for ref in ready:
                 w = self._in_flight.pop(ref)
                 if self._aggregators:
@@ -399,33 +510,34 @@ class IMPALA(Algorithm):
                     self._counters[NUM_ENV_STEPS_SAMPLED] += (
                         batch.env_steps()
                     )
-                    lt.add_batch(batch, block=False)
-                # broadcast current weights back to the producer
-                # (reference update_workers_if_necessary, impala.py:645)
-                k = id(w)
-                self._batches_since_broadcast[k] = (
-                    self._batches_since_broadcast.get(k, 0) + 1
-                )
-                if self._batches_since_broadcast[k] >= self.config.get(
-                    "broadcast_interval", 1
-                ):
-                    if weights_ref is None:
-                        weights_ref = ray.put(
-                            self.workers.local_worker().get_weights()
+                    # accumulate fragments into whole train batches
+                    # (reference impala.py:614 — the learner consumes
+                    # train_batch_size, not rollout fragments)
+                    self._frag_buf.append(batch)
+                    self._frag_steps += batch.env_steps()
+                    if self._frag_steps >= target:
+                        from ray_tpu.data.sample_batch import (
+                            concat_samples,
                         )
-                    w.set_weights.remote(
-                        weights_ref,
-                        {
-                            "timestep": self._counters[
-                                NUM_ENV_STEPS_SAMPLED
-                            ]
-                        },
-                    )
-                    self._batches_since_broadcast[k] = 0
-                self._in_flight[w.sample.remote()] = w
-            if weights_ref is not None:
-                # set_weights.remote marshalled the blob synchronously
-                ray.free([weights_ref])
+
+                        self._train_ready.append(
+                            concat_samples(self._frag_buf)
+                        )
+                        self._frag_buf = []
+                        self._frag_steps = 0
+                # broadcast the learner-published weights back to the
+                # producer (reference update_workers_if_necessary,
+                # impala.py:645) — cheap: no device access here
+                self._maybe_broadcast(w)
+                if not backlogged:
+                    self._in_flight[w.sample.remote()] = w
+
+            # feed complete train batches; keep what the queue won't take
+            while self._train_ready:
+                if lt.add_batch(self._train_ready[0], block=False):
+                    self._train_ready.pop(0)
+                else:
+                    break
 
         # collect aggregated train batches (tree-aggregation mode)
         if self._agg_in_flight:
@@ -463,9 +575,37 @@ class IMPALA(Algorithm):
             "learner_queue": lt.stats(),
         }
 
+    def _maybe_broadcast(self, w) -> None:
+        """Ship the learner thread's latest published weights to worker
+        ``w`` if it hasn't seen that version yet. One ``ray.put`` per
+        version; ``set_weights.remote`` marshals synchronously, so the
+        previous version's blob can be freed when superseded."""
+        pub = self._learner_thread.published_weights()
+        if pub is None:
+            return
+        ver, host_w = pub
+        if self._worker_weight_ver.get(id(w), 0) >= ver:
+            return
+        if self._weights_ref_ver != ver:
+            if self._weights_ref is not None:
+                ray.free([self._weights_ref])
+            self._weights_ref = ray.put(host_w)
+            self._weights_ref_ver = ver
+        w.set_weights.remote(
+            self._weights_ref,
+            {"timestep": self._counters[NUM_ENV_STEPS_SAMPLED]},
+        )
+        self._worker_weight_ver[id(w)] = ver
+
     def cleanup(self) -> None:
         if hasattr(self, "_learner_thread"):
             self._learner_thread.stop()
+        if getattr(self, "_weights_ref", None) is not None:
+            try:
+                ray.free([self._weights_ref])
+            except Exception:
+                pass
+            self._weights_ref = None
         for a in getattr(self, "_aggregators", []):
             try:
                 ray.kill(a)

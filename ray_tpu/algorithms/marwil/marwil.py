@@ -91,6 +91,9 @@ class BCConfig(MARWILConfig):
 class MARWILJaxPolicy(JaxPolicy):
     """reference marwil_torch_policy.py loss."""
 
+    # loss never reads NEXT_OBS; don't ship a second obs column
+    _ship_next_obs = False
+
     def _init_coeffs(self):
         self.coeff_values["ma_sqd_adv_norm"] = float(
             self.config.get("moving_average_sqd_adv_norm_start", 100.0)

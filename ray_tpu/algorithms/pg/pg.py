@@ -41,6 +41,9 @@ class PGConfig(AlgorithmConfig):
 class PGJaxPolicy(JaxPolicy):
     """reference pg_torch_policy.py pg_torch_loss."""
 
+    # loss never reads NEXT_OBS; don't ship a second obs column
+    _ship_next_obs = False
+
     def loss(self, params, batch, rng, coeffs):
         dist_inputs, _, _ = self.model_forward_train(params, batch)
         dist = self.dist_class(dist_inputs)

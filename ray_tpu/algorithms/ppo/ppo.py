@@ -103,6 +103,9 @@ class PPOJaxPolicy(JaxPolicy):
     """Clipped-surrogate PPO loss (reference ppo_torch_policy.py:69),
     with KL penalty adapted on host between train calls."""
 
+    # loss never reads NEXT_OBS; don't ship a second obs column
+    _ship_next_obs = False
+
     def _init_coeffs(self):
         self.coeff_values["kl_coeff"] = float(
             self.config.get("kl_coeff", 0.2)

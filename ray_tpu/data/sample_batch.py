@@ -55,10 +55,12 @@ def _is_array_col(key: str) -> bool:
 
 
 def _reject_frame_pool(batch, op: str) -> None:
-    """Row transforms cannot preserve pool/index consistency; the
-    frame-pool format is a learner-side TRANSFER format built right
-    before learn_on_batch, not a storage format. Fail loudly instead
-    of silently dropping the pool."""
+    """Row transforms (slice/shuffle/...) cannot preserve pool/index
+    consistency; the frame-pool format is a TRANSFER format (built
+    worker-side by ``compress_for_shipping`` or learner-side before
+    ``learn_on_batch``), not a storage format. ``concat_samples`` is
+    the one supported transform (pool merge + index offset). Fail
+    loudly instead of silently dropping the pool."""
     if _FRAME_POOL in batch:
         raise ValueError(
             f"SampleBatch.{op} does not support the deduplicated "
@@ -267,10 +269,53 @@ def concat_samples(
         return SampleBatch()
     if isinstance(batches[0], MultiAgentBatch):
         return MultiAgentBatch.concat_samples(list(batches))
-    for b in batches:
-        _reject_frame_pool(b, "concat_samples")
-    keys = batches[0].keys()
-    out = {}
+    from ray_tpu.ops.framestack import FRAME_IDX as _FRAME_IDX
+
+    pooled = [_FRAME_POOL in b for b in batches]
+    if any(pooled) and not all(pooled):
+        # compression is per-fragment and data-dependent (the sliding
+        # window verification can fail on one fragment and pass on its
+        # siblings), so mixed inputs must degrade to stacks — losing
+        # the dedup win, never correctness
+        from ray_tpu.ops.framestack import materialize_fragment
+
+        # stack depth comes from a stacked sibling's obs channel dim
+        # (the mixed case guarantees one exists)
+        stack_k = next(
+            int(np.asarray(b[OBS]).shape[-1])
+            for b in batches
+            if _FRAME_POOL not in b and OBS in b
+        )
+        batches = [
+            SampleBatch(materialize_fragment(dict(b), stack_k))
+            if _FRAME_POOL in b
+            else b
+            for b in batches
+        ]
+        pooled = [False] * len(batches)
+    if any(pooled):
+        # frame-pool batches concatenate by merging pools and
+        # offsetting each batch's first-frame indices — this keeps
+        # worker-side compressed fragments compressed through the
+        # driver concat (no re-materialization of stacks)
+        out = {}
+        pools = [np.asarray(b[_FRAME_POOL]) for b in batches]
+        offsets = np.cumsum([0] + [len(p) for p in pools[:-1]])
+        out[_FRAME_POOL] = np.concatenate(pools, axis=0)
+        out[_FRAME_IDX] = np.concatenate(
+            [
+                np.asarray(b[_FRAME_IDX], np.int32) + np.int32(off)
+                for b, off in zip(batches, offsets)
+            ]
+        )
+        keys = [
+            k
+            for k in batches[0].keys()
+            if k not in (_FRAME_POOL, _FRAME_IDX)
+        ]
+    else:
+        out = {}
+        keys = batches[0].keys()
     for k in keys:
         if not _is_array_col(k):
             continue

@@ -1,0 +1,67 @@
+"""SyntheticEnv: a near-zero-cost env for plumbing-bound benchmarks.
+
+The reference isolates framework overhead from env cost with trivially
+cheap envs (``rllib/env/tests``'s mock/random envs and the
+``RandomEnv`` used in scale tests); this is the same tool for the
+end-to-end benchmarks: ``env.step`` costs ~1 µs (index into a
+pre-generated observation pool), so an e2e run on it measures what the
+FRAMEWORK can move — sampler loop, action inference, object-store
+shipping, learner queue — with the environment effectively free.
+
+The observation is a small float vector and the reward a fixed function
+of (obs, action), so policies still have non-degenerate gradients, but
+nothing about the task is meant to be learned — throughput only.
+"""
+
+from __future__ import annotations
+
+import gymnasium as gym
+import numpy as np
+
+
+class SyntheticEnv(gym.Env):
+    metadata = {"render_modes": []}
+
+    def __init__(self, config=None):
+        config = config or {}
+        self.obs_dim = int(config.get("obs_dim", 16))
+        self.num_actions = int(config.get("num_actions", 4))
+        self.episode_len = int(config.get("episode_len", 200))
+        pool = int(config.get("pool", 256))
+        rng = np.random.default_rng(int(config.get("seed", 0)))
+        self._pool = rng.standard_normal(
+            (pool, self.obs_dim)
+        ).astype(np.float32)
+        self._rewards = rng.standard_normal(pool).astype(np.float32)
+        self.observation_space = gym.spaces.Box(
+            -np.inf, np.inf, (self.obs_dim,), np.float32
+        )
+        self.action_space = gym.spaces.Discrete(self.num_actions)
+        self._i = 0
+        self._t = 0
+
+    def reset(self, *, seed=None, options=None):
+        self._t = 0
+        self._i = (self._i + 1) % len(self._pool)
+        return self._pool[self._i], {}
+
+    def step(self, action):
+        self._t += 1
+        self._i = (self._i + int(action) + 1) % len(self._pool)
+        truncated = self._t >= self.episode_len
+        return (
+            self._pool[self._i],
+            float(self._rewards[self._i]),
+            False,
+            truncated,
+            {},
+        )
+
+
+def _register():
+    from ray_tpu.env.registry import register_env
+
+    register_env("SyntheticFast-v0", lambda cfg: SyntheticEnv(cfg))
+
+
+_register()

@@ -338,7 +338,13 @@ class SyncSampler:
             batch.last_state_out = [
                 np.asarray(s) for s in self.states[i]
             ]
-        out.append(postprocess_batch(self.policy, batch))
+        batch = postprocess_batch(self.policy, batch)
+        # shrink the fragment before it leaves the worker (framestack
+        # dedup — policies opt in via compress_for_shipping)
+        compress = getattr(self.policy, "compress_for_shipping", None)
+        if compress is not None:
+            batch = compress(batch)
+        out.append(batch)
 
     def get_metrics(self) -> List[RolloutMetrics]:
         with self._metrics_lock:

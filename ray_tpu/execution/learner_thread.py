@@ -7,20 +7,43 @@ the copy of batch k+1 overlaps the jitted SGD step of batch k (the
 reference's _MultiGPULoaderThread + tower-buffer protocol, collapsed to a
 double-buffered ``jax.device_put`` thread). Policies without the two-phase
 JaxPolicy learn API fall back to synchronous ``learn_on_batch``.
+
+Two further overlaps matter on a tunneled/remote TPU backend, where a
+single dispatch round trip can exceed the nest's compute:
+
+- **Deferred stats.** For policies without host-side
+  ``after_learn_on_batch`` hooks, ``learn_on_device_batch`` runs with
+  ``defer_stats=True``: the thread never blocks on the stats fetch, so
+  up to ``STATS_LAG`` SGD programs queue on-device and the dispatch
+  latency amortizes across them. Stats materialize ``STATS_LAG`` steps
+  later, when the program has already finished (a free fetch).
+- **Learner-side weight publishing.** The thread pulls host weights
+  every ``publish_weights_every`` steps right after a step completes and
+  parks them in a versioned slot. The driver broadcasts the published
+  blob to rollout workers without ever touching the device — the
+  reference's weight lock + ``get_weights`` on the driver thread would
+  serialize the driver against the learner's device queue here.
 """
 
 from __future__ import annotations
 
+import collections
 import queue
 import threading
 import time
-from typing import Dict, Optional
+from typing import Dict, Optional, Tuple
+
+import jax
 
 from ray_tpu.data.sample_batch import SampleBatch
 
 # Transfers in flight ahead of the compute step. 2 = classic double
 # buffering: one batch on device waiting, one being copied.
 PIPELINE_DEPTH = 2
+# SGD programs allowed in the device queue before the thread materializes
+# the oldest stats (which bounds queue depth AND device memory: each
+# queued program pins its input batch buffers).
+STATS_LAG = 3
 
 
 class LearnerThread(threading.Thread):
@@ -30,6 +53,7 @@ class LearnerThread(threading.Thread):
         *,
         inqueue_size: int = 16,
         outqueue_size: int = 64,
+        publish_weights_every: int = 0,
     ):
         super().__init__(daemon=True, name="learner_thread")
         self.policy = policy
@@ -40,6 +64,7 @@ class LearnerThread(threading.Thread):
         self.learner_info: Dict = {}
         self.queue_timer = 0.0
         self.grad_timer = 0.0
+        self.publish_timer = 0.0
         # Pipeline only policies using the JaxPolicy two-phase learn API
         # through the standard composition: a subclass that overrides
         # learn_on_batch itself has semantics the split would bypass.
@@ -48,8 +73,20 @@ class LearnerThread(threading.Thread):
         self._pipelined = isinstance(policy, JaxPolicy) and (
             type(policy).learn_on_batch is JaxPolicy.learn_on_batch
         )
+        # Stats can be deferred (and dispatches pipelined on-device) only
+        # when nothing host-side consumes them between steps.
+        self._defer = self._pipelined and (
+            type(policy).after_learn_on_batch
+            is JaxPolicy.after_learn_on_batch
+        )
         self._feeder = None
         self._in_flight = 0
+        self._lazy: "collections.deque" = collections.deque()
+        # Weight publishing: (version, host_weights) swapped atomically.
+        self._publish_every = int(publish_weights_every)
+        self._weights_lock = threading.Lock()
+        self._published: Optional[Tuple[int, Dict]] = None
+        self._steps_since_publish = 0
 
     def _get_feeder(self):
         # Lazy: build on the learner thread so jax initializes there.
@@ -65,7 +102,11 @@ class LearnerThread(threading.Thread):
                 try:
                     self.step()
                 except queue.Empty:
+                    # idle: everything queued on-device has finished by
+                    # now — flush any remaining deferred stats
+                    self._drain_lazy(all_of_them=True)
                     continue
+            self._drain_lazy(all_of_them=True)
         finally:
             # The learner thread owns the feeder: stopping it here (not in
             # stop(), which runs on another thread) avoids racing an
@@ -85,6 +126,41 @@ class LearnerThread(threading.Thread):
         self._get_feeder().put(tree, (bsize, batch.env_steps()))
         self._in_flight += 1
         return True
+
+    def _drain_lazy(self, all_of_them: bool = False) -> None:
+        """Materialize deferred stats older than STATS_LAG (their
+        programs have finished; the fetch is a cheap copy-out)."""
+        keep = 0 if all_of_them else STATS_LAG
+        while len(self._lazy) > keep:
+            env_steps, stats = self._lazy.popleft()
+            stats = jax.device_get(stats)
+            info = {k: float(v) for k, v in stats.items()}
+            info["cur_lr"] = self.policy.coeff_values.get("lr")
+            self.learner_info = info
+            try:
+                self.outqueue.put_nowait((env_steps, info))
+            except queue.Full:
+                pass
+
+    def _maybe_publish(self) -> None:
+        if not self._publish_every:
+            return
+        self._steps_since_publish += 1
+        if self._steps_since_publish < self._publish_every:
+            return
+        t0 = time.perf_counter()
+        host_w = self.policy.get_weights()
+        with self._weights_lock:
+            ver = (self._published[0] if self._published else 0) + 1
+            self._published = (ver, host_w)
+        self._steps_since_publish = 0
+        self.publish_timer += time.perf_counter() - t0
+
+    def published_weights(self) -> Optional[Tuple[int, Dict]]:
+        """Latest (version, host_weights) pulled by the learner thread,
+        or None before the first publish. Never touches the device."""
+        with self._weights_lock:
+            return self._published
 
     def step(self) -> None:
         if not self._pipelined:
@@ -108,10 +184,21 @@ class LearnerThread(threading.Thread):
             self._in_flight -= 1
         self.queue_timer += time.perf_counter() - t0
         t0 = time.perf_counter()
+        if self._defer:
+            stats = self.policy.learn_on_device_batch(
+                dev, bsize, defer_stats=True
+            )
+            self._lazy.append((env_steps, stats))
+            self.grad_timer += time.perf_counter() - t0
+            self.num_steps += 1
+            self._maybe_publish()
+            self._drain_lazy()
+            return
         info = self.policy.learn_on_device_batch(dev, bsize)
         self.grad_timer += time.perf_counter() - t0
         self.num_steps += 1
         self.learner_info = info
+        self._maybe_publish()
         try:
             self.outqueue.put_nowait((env_steps, info))
         except queue.Full:
@@ -129,6 +216,7 @@ class LearnerThread(threading.Thread):
         self.grad_timer += time.perf_counter() - t0
         self.num_steps += 1
         self.learner_info = info
+        self._maybe_publish()
         try:
             self.outqueue.put_nowait((batch.env_steps(), info))
         except queue.Full:
@@ -160,4 +248,5 @@ class LearnerThread(threading.Thread):
             "num_steps_trained_this_thread": self.num_steps,
             "queue_wait_time_s": self.queue_timer,
             "grad_time_s": self.grad_timer,
+            "weight_publish_time_s": self.publish_timer,
         }

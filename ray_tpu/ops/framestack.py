@@ -72,6 +72,118 @@ def decompose_stacked_obs(
     return stream, np.arange(n, dtype=np.int32)
 
 
+def decompose_segmented_obs(
+    obs: np.ndarray, new_segment: np.ndarray
+) -> Tuple[np.ndarray, np.ndarray] | None:
+    """Generalized :func:`decompose_stacked_obs` for a batch that
+    concatenates SEVERAL sliding windows (rollout fragments from
+    different envs/episodes back to back, as e2e train batches are).
+
+    ``new_segment``: (N,) bool — True where row i does NOT slide from
+    row i-1 (fragment start, episode reset). Row 0 is always a start.
+    Rows inside a segment are verified to really be a sliding window
+    (vectorized compare); any mismatch returns None so the caller falls
+    back to shipping materialized stacks — a wrong boundary mask can
+    cost the dedup win but never correctness. Returns ``(stream, idx)``
+    where each segment contributes k + (len-1) frames to the stream.
+    """
+    n, h, w, k = obs.shape
+    if k <= 1 or n == 0:
+        return None
+    new_segment = np.asarray(new_segment, bool).copy()
+    new_segment[0] = True
+    slide_rows = np.flatnonzero(~new_segment)
+    # verify in row chunks: fancy-indexing the whole batch at once
+    # would materialize ~2 extra copies of a multi-GB pixel batch on
+    # the host right before the transfer this dedup exists to shrink
+    for c in range(0, slide_rows.size, 64):
+        rows = slide_rows[c : c + 64]
+        if not np.array_equal(
+            obs[rows, :, :, : k - 1], obs[rows - 1, :, :, 1:]
+        ):
+            return None
+    starts = np.flatnonzero(new_segment)
+    bounds = np.append(starts, n)
+    idx = np.empty(n, np.int32)
+    pieces = []
+    off = 0
+    for s, e in zip(bounds[:-1], bounds[1:]):
+        seg_len = int(e - s)
+        # first row contributes its k frames, later rows 1 new frame
+        pieces.append(np.moveaxis(obs[s], -1, 0)[..., None])
+        if seg_len > 1:
+            pieces.append(obs[s + 1 : e, :, :, -1][..., None])
+        idx[s:e] = off + np.arange(seg_len, dtype=np.int32)
+        off += seg_len + k - 1
+    return np.concatenate(pieces, axis=0), idx
+
+
+def compress_fragment_obs(
+    obs: np.ndarray,
+    next_obs: np.ndarray,
+    dones: np.ndarray,
+) -> Tuple[np.ndarray, np.ndarray] | None:
+    """Worker-side compression of ONE rollout fragment's observation
+    columns into the frame-pool format, taken before the fragment
+    ships to the driver — this is where the dedup pays most: a stacked
+    (T, H, W, k) OBS plus NEXT_OBS is 2k single frames' worth of bytes
+    per step through pickle, the object ring, driver concat and the
+    TPU tunnel; the pool is ~1.
+
+    The pool covers NEXT_OBS implicitly: ``next_obs[t]`` is the stack
+    at ``idx[t] + 1`` (sliding), so only the fragment's final
+    bootstrap frame is appended (the pseudo-row). ``dones`` marks
+    in-fragment episode resets (fixed-unroll mode): the obs AFTER a
+    done row starts a fresh window. Returns ``(pool, idx)`` with
+    ``idx`` of length T (the bootstrap stack lives at ``idx[-1]+1``),
+    or None when the rows aren't sliding windows (caller ships stacks
+    unchanged)."""
+    T = obs.shape[0]
+    if T == 0:
+        return None
+    ext = np.concatenate([obs, next_obs[-1:]], axis=0)
+    seg = np.zeros(T + 1, bool)
+    seg[0] = True
+    if T > 1:
+        seg[1:T] = np.asarray(dones[: T - 1], bool)
+    dec = decompose_segmented_obs(ext, seg)
+    if dec is None:
+        return None
+    pool, idx = dec
+    return pool, idx[:T]
+
+
+def materialize_stacks_np(
+    pool: np.ndarray, idx: np.ndarray, k: int
+) -> np.ndarray:
+    """Host-side :func:`build_stacks`: (M, H, W, 1) pool + (N,) first-
+    frame indices → (N, H, W, k) stacked observations."""
+    gathered = pool[idx[:, None] + np.arange(k)[None, :]]
+    return np.moveaxis(gathered[..., 0], 1, -1)
+
+
+def materialize_fragment(batch_cols: Dict, k: int) -> Dict:
+    """Undo :func:`compress_fragment_obs` on a batch's columns: rebuild
+    OBS exactly, and NEXT_OBS as the ``idx+1`` stacks — exact
+    everywhere consumers read it (within segments and the final
+    bootstrap row); at interior episode-reset rows the true terminal
+    next_obs was not pooled, so those rows get the FOLLOWING row's
+    reset obs instead (no trainer reads next_obs at those rows: the
+    on-policy family drops the column entirely and the fixed-unroll
+    V-trace tree only reads the final bootstrap stack)."""
+    cols = dict(batch_cols)
+    pool = np.asarray(cols.pop(FRAMES))
+    idx = np.asarray(cols.pop(FRAME_IDX), np.int64)
+    from ray_tpu.data.sample_batch import SampleBatch
+
+    cols[SampleBatch.OBS] = materialize_stacks_np(pool, idx, k)
+    next_idx = np.minimum(idx + 1, len(pool) - k)
+    cols[SampleBatch.NEXT_OBS] = materialize_stacks_np(
+        pool, next_idx, k
+    )
+    return cols
+
+
 def build_stacks(frames: jnp.ndarray, idx: jnp.ndarray, k: int):
     """Device-side: (M, H, W, 1) frame pool + (N,) first-frame indices
     → (N, H, W, k) stacked observations (one gather, XLA-fusable)."""

@@ -455,22 +455,19 @@ class JaxPolicy(Policy):
         mesh = self.mesh
         loss_fn = self.loss_with_aux
 
+        rebuild_obs = self._rebuild_obs_from_frames
+
         def device_fn(params, opt_state, aux, batch, rng, coeffs):
             if with_frames:
                 # rebuild stacked observations from the replicated
                 # frame pool (ops/framestack): one gather, then the
-                # nest proceeds on ordinary row columns
-                from ray_tpu.ops.framestack import build_stacks
-
+                # nest proceeds on ordinary row columns (policies with
+                # non-flat obs layouts override the hook)
                 frames = aux["__frames__"]
                 aux = {
                     k: v for k, v in aux.items() if k != "__frames__"
                 }
-                batch = dict(batch)
-                obs = build_stacks(
-                    frames, batch.pop(_FRAME_IDX), stack_k
-                )
-                batch[SampleBatch.OBS] = obs
+                batch = rebuild_obs(frames, batch, stack_k)
             # Different shuffle stream per data shard.
             rng = jax.random.fold_in(rng, jax.lax.axis_index("data"))
 
@@ -657,8 +654,9 @@ class JaxPolicy(Policy):
         return fn
 
     def learn_on_device_batch(
-        self, dev_batch: Dict[str, Any], batch_size: int
-    ) -> Dict[str, float]:
+        self, dev_batch: Dict[str, Any], batch_size: int,
+        *, defer_stats: bool = False,
+    ) -> Dict[str, Any]:
         """Public phase 2 of learning: run the compiled SGD nest on an
         already-device-resident batch (e.g. transferred ahead of time by a
         DeviceFeeder so host→device copy overlapped the previous step).
@@ -667,7 +665,18 @@ class JaxPolicy(Policy):
         frame pool + ``obs_frame_idx`` rows — see ``ops/framestack``)
         rebuild their observations device-side: the pool rides the
         replicated aux slot (its sharding), so stacks gather locally on
-        every data shard."""
+        every data shard.
+
+        ``defer_stats=True`` skips the blocking ``device_get`` of the
+        stats tree and returns it as device arrays instead: dispatch
+        returns as soon as XLA enqueues the program, so consecutive
+        learner steps pipeline on-device and the per-dispatch latency
+        (dominant on a tunneled/remote TPU backend) amortizes across the
+        queue. The caller materializes stats later with
+        ``jax.device_get`` — by then the program has long finished and
+        the fetch is cheap. Deferring also skips
+        ``after_learn_on_batch`` (host-side coefficient updates need
+        host stats), so only defer for policies that don't override it."""
         aux = self.aux_state
         if _FRAMES in dev_batch:
             dev_batch = dict(dev_batch)
@@ -691,6 +700,8 @@ class JaxPolicy(Policy):
         self.num_grad_updates += self.num_sgd_iter * max(
             1, batch_size // max(1, self.minibatch_size)
         )
+        if defer_stats:
+            return stats
         # One device→host transfer for all stats (individual float()
         # conversions each pay a full device round trip).
         stats = jax.device_get(stats)
@@ -723,6 +734,129 @@ class JaxPolicy(Policy):
         """Hook for host-side coefficient updates (e.g. PPO kl coeff)."""
         return {}
 
+    def _rebuild_obs_from_frames(self, frames, batch, stack_k: int):
+        """Device-side hook (runs inside the jitted learn program):
+        turn the deduplicated frame pool + per-row first-frame indices
+        back into the OBS column. Policies whose obs column is not a
+        flat row layout (IMPALA's (B, T) unrolls) override this."""
+        from ray_tpu.ops.framestack import build_stacks
+
+        batch = dict(batch)
+        batch[SampleBatch.OBS] = build_stacks(
+            frames, batch.pop(_FRAME_IDX), stack_k
+        )
+        return batch
+
+    # Losses that never read NEXT_OBS (the on-policy family) set this
+    # False so the train tree doesn't ship a second full obs column to
+    # the device — for pixel envs that halves learner ingest bytes.
+    _ship_next_obs: bool = True
+
+    def compress_for_shipping(self, batch: SampleBatch) -> SampleBatch:
+        """Worker-side, after postprocessing, right before a fragment
+        ships to the driver: replace stacked framestack observations
+        with the deduplicated pool + index columns
+        (``ops/framestack.compress_fragment_obs``). A stacked pixel
+        fragment moves ~2k single frames' worth of bytes per step
+        through pickle → object ring → driver concat → TPU tunnel; the
+        pool moves ~1. Applies only when the loss can train from the
+        pool: on-policy flat rows (``_ship_next_obs`` False) or fixed
+        unrolls (IMPALA family, which only needs the bootstrap stack —
+        reconstructible at ``idx[-1]+1``). Offline output
+        (``config["output"]``) keeps materialized stacks so written
+        datasets stay self-describing."""
+        if not self.config.get("compress_obs_shipping", True):
+            return batch
+        if self.config.get("output"):
+            return batch
+        fixed = bool(self.config.get("_fixed_unrolls"))
+        if not fixed and self._ship_next_obs:
+            return batch  # replay families read full NEXT_OBS
+        model = getattr(self, "model", None)  # bespoke-net policies
+        if model is None or model.is_recurrent:
+            return batch
+        obs = batch.get(SampleBatch.OBS)
+        if (
+            isinstance(obs, np.ndarray)
+            and obs.ndim == 4
+            and 2 <= obs.shape[-1] <= 8
+            and SampleBatch.NEXT_OBS in batch
+        ):
+            from ray_tpu.ops.framestack import compress_fragment_obs
+
+            dones = np.asarray(
+                batch[SampleBatch.TERMINATEDS], bool
+            ) | np.asarray(
+                batch.get(
+                    SampleBatch.TRUNCATEDS,
+                    np.zeros(batch.count, bool),
+                ),
+                bool,
+            )
+            dec = compress_fragment_obs(
+                obs, np.asarray(batch[SampleBatch.NEXT_OBS]), dones
+            )
+            if dec is not None:
+                pool, idx = dec
+                cols = {
+                    k: v
+                    for k, v in batch.items()
+                    if k
+                    not in (SampleBatch.OBS, SampleBatch.NEXT_OBS)
+                }
+                cols[_FRAMES] = pool
+                cols[_FRAME_IDX] = idx
+                return SampleBatch(cols)
+        return batch
+
+    def _maybe_dedup_framestack(
+        self, tree: Dict[str, np.ndarray]
+    ) -> Dict[str, np.ndarray]:
+        """Replace a stacked (N, H, W, k) OBS column with the
+        deduplicated frame pool + index columns when rows really are
+        sliding windows (ops/framestack) — ~k× fewer obs bytes over the
+        host→device boundary, which is the e2e bottleneck on a remote/
+        tunneled TPU backend. Segment boundaries (fragment starts,
+        episode resets) come from the batch's bookkeeping columns; the
+        decomposition verifies the sliding-window property and falls
+        back to shipping stacks when it doesn't hold."""
+        obs = tree.get(SampleBatch.OBS)
+        if (
+            obs is None
+            or self.model.is_recurrent
+            or not self.config.get("dedup_framestack", True)
+            or obs.ndim != 4
+            or not 2 <= obs.shape[-1] <= 8
+            or obs.nbytes
+            < self.config.get("dedup_framestack_min_bytes", 1 << 20)
+        ):
+            return tree
+        from ray_tpu.ops.framestack import decompose_segmented_obs
+
+        n = obs.shape[0]
+        seg = np.zeros(n, bool)
+        seg[0] = True
+        for col in (
+            SampleBatch.UNROLL_ID,
+            SampleBatch.EPS_ID,
+            SampleBatch.AGENT_INDEX,
+        ):
+            v = tree.get(col)
+            if v is not None and len(v) == n:
+                seg[1:] |= v[1:] != v[:-1]
+        tcol = tree.get(SampleBatch.T)
+        if tcol is not None and len(tcol) == n:
+            seg[1:] |= tcol[1:] != tcol[:-1] + 1
+        out = decompose_segmented_obs(obs, seg)
+        if out is None:
+            return tree
+        stream, idx = out
+        tree = dict(tree)
+        del tree[SampleBatch.OBS]
+        tree[_FRAMES] = stream
+        tree[_FRAME_IDX] = idx
+        return tree
+
     def _batch_to_train_tree(self, samples: SampleBatch) -> Dict[str, np.ndarray]:
         """Select training columns as a flat dict of arrays. For
         recurrent models, derive the per-row ``resets`` column the
@@ -741,6 +875,8 @@ class JaxPolicy(Policy):
             self.model.is_recurrent
             and getattr(self.model, "supports_stored_train_state", False)
         )
+        if not self._ship_next_obs:
+            drop = drop | {SampleBatch.NEXT_OBS}
         tree = {
             k: np.asarray(v)
             for k, v in samples.items()
@@ -750,6 +886,7 @@ class JaxPolicy(Policy):
             and isinstance(v, np.ndarray)
             and v.dtype != object
         }
+        tree = self._maybe_dedup_framestack(tree)
         if self.model.is_recurrent and "resets" not in tree:
             n = len(next(iter(tree.values())))
             resets = np.zeros(n, np.float32)
