@@ -397,6 +397,7 @@ class _Runtime:
 
     def _dispatch_pending(self):
         while True:
+            spill = False
             with self.lock:
                 if not self.pending:
                     return
@@ -414,21 +415,75 @@ class _Runtime:
                     w = self._spawn_worker()
                     self.pool.append(w)
                 if w is None:
-                    return
-                # FIFO with skip: the first pending task whose resource
-                # demand fits (reference cluster_task_manager queueing)
-                trec = None
-                for i, cand_t in enumerate(self.pending):
-                    if self._fits(cand_t):
-                        trec = cand_t
+                    spill = True
+                else:
+                    # FIFO with skip: the first pending task whose
+                    # resource demand fits (reference
+                    # cluster_task_manager queueing)
+                    trec = None
+                    for i, cand_t in enumerate(self.pending):
+                        if self._fits(cand_t):
+                            trec = cand_t
+                            del self.pending[i]
+                            break
+                    if trec is None:
+                        spill = True
+                    else:
+                        self._acquire(trec)
+                        w.idle = False
+                        w.inflight[trec.task_id] = trec
+            if spill:
+                # local head is saturated: push queued work to fleet
+                # agents (the reference's lease spillback —
+                # cluster_resource_scheduler.h:45)
+                self._try_spill()
+                return
+            self._send_task(w, trec)
+
+    def _try_spill(self):
+        """Ship queued stateless tasks to fleet agents with free CPU
+        capacity. Only plain CPU tasks spill (placement groups and
+        custom resources stay head-local — agents register CPUs only).
+        Args marshal through the node's once-per-node object pool."""
+        cluster = getattr(self, "cluster", None)
+        if cluster is None:
+            return
+        while True:
+            nodes = [
+                n for n in cluster.nodes.values() if not n.dead
+            ]
+            if not nodes:
+                return
+            pick = None
+            with self.lock:
+                for i, t in enumerate(self.pending):
+                    if (
+                        t.placement_group is not None
+                        or t.resources
+                        or t.msg.get("type") != "task"
+                        or getattr(t, "orig_args", None) is None
+                    ):
+                        continue
+                    node = max(nodes, key=lambda n: n.free_cpus())
+                    if node.free_cpus() >= t.num_cpus:
+                        pick = (t, node)
                         del self.pending[i]
                         break
-                if trec is None:
+                if pick is None:
                     return
-                self._acquire(trec)
-                w.idle = False
-                w.inflight[trec.task_id] = trec
-            self._send_task(w, trec)
+            t, node = pick
+            try:
+                m_args, m_kwargs = node.marshal_args(
+                    t.orig_args, t.orig_kwargs
+                )
+                payload = ser.dumps((m_args, m_kwargs))
+                sent = node.submit_task(t, payload)
+            except BaseException:
+                sent = False
+            if not sent:
+                with self.lock:
+                    self.pending.appendleft(t)
+                return
 
     def _send_task(self, w: _WorkerHandle, trec: _TaskRecord):
         msg = trec.msg
@@ -517,8 +572,10 @@ class _Runtime:
                 "task_id": task_id,
                 "func_id": func_id,
                 "func_blob": func_blob,
-                "runtime_env": pack_runtime_env(
-                    options.get("runtime_env")
+                "runtime_env": (
+                    options["runtime_env_packed"]
+                    if "runtime_env_packed" in options
+                    else pack_runtime_env(options.get("runtime_env"))
                 ),
                 "trace_ctx": tracing.inject_context(),
                 "args": args,
@@ -572,6 +629,11 @@ class _Runtime:
                 + list(trec.msg["kwargs"].values())
                 if isinstance(a, ObjectRef)
             ]
+            # keep the unmarshalled args: spillover to a fleet agent
+            # must re-marshal for the remote object plane (shm names
+            # in the local payload mean nothing off-host)
+            trec.orig_args = list(trec.msg["args"])
+            trec.orig_kwargs = dict(trec.msg["kwargs"])
             m_args = [self._marshal_arg(a) for a in trec.msg["args"]]
             m_kwargs = {
                 k: self._marshal_arg(v) for k, v in trec.msg["kwargs"].items()
@@ -595,20 +657,22 @@ class _Runtime:
 
     # -- actors ----------------------------------------------------------
 
-    def _resolve_for_remote(self, args, kwargs):
-        """Top-level ObjectRef args become their values: remote hosts
-        share no shm plane with the head, so arguments ship inline
-        (driver-owned pull-on-submit — the scoped slice of the
-        reference's object_manager push/pull)."""
-
-        def res(v):
-            if isinstance(v, ObjectRef):
-                return self.store.get(v.id, timeout=60.0)
-            return v
-
-        return [res(a) for a in args], {
-            k: res(v) for k, v in kwargs.items()
-        }
+    def _local_actor_saturated(self, options) -> bool:
+        """Would placing one more dedicated-CPU actor locally
+        oversubscribe the head? (Actors run on dedicated workers
+        outside the task pool's CPU ledger, so they keep their own
+        count.)"""
+        req = options.get("num_cpus")
+        req = 1.0 if req is None else float(req)
+        if req <= 0:
+            return False
+        with self.lock:
+            used = sum(
+                getattr(rec, "num_cpus", 1.0)
+                for rec in self.actors.values()
+                if not rec.dead
+            )
+        return used + req > self.num_cpus
 
     def create_actor(self, cls, args, kwargs, options) -> "ActorHandle":
         from ray_tpu.core.runtime_env import pack_runtime_env
@@ -622,6 +686,16 @@ class _Runtime:
                 options.get("runtime_env")
             )
         node_name = options.get("placement_node")
+        if (
+            node_name is None
+            and self.cluster is not None
+            and self._local_actor_saturated(options)
+        ):
+            # automatic spillover: unpinned actors spread to fleet
+            # agents once the head's CPUs are spoken for (the hybrid
+            # local-first/spillback policy of the reference's
+            # cluster_resource_scheduler.h:45, scoped to actors+CPUs)
+            node_name = "any"
         if node_name is not None and self.cluster is not None:
             try:
                 node = self.cluster.pick_node(
@@ -647,7 +721,7 @@ class _Runtime:
                     options = dict(
                         options, runtime_env_packed=renv_packed
                     )
-                r_args, r_kwargs = self._resolve_for_remote(args, kwargs)
+                r_args, r_kwargs = node.marshal_args(args, kwargs)
                 with self.lock:
                     if name:
                         if name in self.named_actors:
@@ -695,6 +769,11 @@ class _Runtime:
             options.get("max_restarts", 0),
             daemon=bool(options.get("daemon", True)),
         )
+        rec.num_cpus = (
+            1.0
+            if options.get("num_cpus") is None
+            else float(options["num_cpus"])
+        )
         # constructor ref args stay pinned for the actor's LIFETIME:
         # a restart replays init_msg, which re-attaches their shm
         rec.arg_refs = [
@@ -727,7 +806,11 @@ class _Runtime:
                     ),
                 )
                 return [ref] * num_returns
-            r_args, r_kwargs = self._resolve_for_remote(args, kwargs)
+            # ObjectRef args ride the once-per-node pool: the value
+            # ships on first use per node, the id alone afterwards
+            # (cluster._PoolObj) — weight broadcast to K actors on one
+            # agent moves one copy, not K
+            r_args, r_kwargs = node.marshal_args(args, kwargs)
             return node.call(
                 actor_id, method, r_args, r_kwargs, num_returns
             )

@@ -24,16 +24,23 @@ with ``ray.init(address="head:port")`` (or
 ``python -m ray_tpu.core.node_agent``); the head enables the fleet
 with ``start_cluster_server()``.
 
-Framing: 4-byte big-endian length + pickled dict; binary payloads ride
-inside via ``core/serialization`` (pickle-5 out-of-band numpy). Trust
-model matches the KV service: cluster hosts only, bind loopback by
-default (``parallel/distributed.KVServer`` docstring).
+Framing: 4-byte big-endian length + a RESTRICTED-pickle control dict
+(``core/wire.py``: only builtins + numpy reconstruction resolve — a
+frame referencing any other global is rejected before anything runs).
+User payloads (args, classes, results) ride as opaque ``bytes`` fields
+inside the frame and deserialize via ``core/serialization`` (full
+pickle-5, out-of-band numpy) only after the connection authenticated.
+Trust model: cluster hosts only, bind loopback by default (the KV
+service's model, ``parallel/distributed.KVServer`` docstring), plus a
+shared-token HMAC on the registration handshake
+(``RAY_TPU_CLUSTER_TOKEN`` / ``RAY_TPU_KV_TOKEN``) as a second wall —
+an unauthenticated socket can no longer deliver a gadget pickle, and
+full-pickle payload fields are only read off registered connections.
 """
 
 from __future__ import annotations
 
 import os
-import pickle
 import socket
 import struct
 import threading
@@ -41,23 +48,39 @@ import uuid
 from typing import Any, Dict, List, Optional
 
 from ray_tpu.core import serialization as ser
+from ray_tpu.core import wire
 
 
 def _send_frame(sock: socket.socket, lock: threading.Lock, msg: Dict) -> None:
-    blob = pickle.dumps(msg, protocol=5)
+    blob = wire.control_dumps(msg)
     with lock:
         sock.sendall(struct.pack(">I", len(blob)) + blob)
 
 
-def _recv_frame(sock: socket.socket) -> Optional[Dict]:
+# Post-auth frames carry batch payloads (1 GiB ceiling); the pre-auth
+# handshake is <1 KB, so it gets a tight cap — an unauthenticated
+# socket must not be able to force a multi-GB buffered read.
+_MAX_FRAME = 1 << 30
+_MAX_HANDSHAKE_FRAME = 1 << 16
+
+
+def _recv_frame(
+    sock: socket.socket, max_len: int = _MAX_FRAME
+) -> Optional[Dict]:
     header = _recv_exact(sock, 4)
     if header is None:
         return None
     (n,) = struct.unpack(">I", header)
+    if n > max_len:
+        raise wire.ControlFrameError(
+            f"frame length {n} exceeds cap {max_len}"
+        )
     blob = _recv_exact(sock, n)
     if blob is None:
         return None
-    return pickle.loads(blob)
+    # restricted deserialization: a malicious frame raises HERE, in the
+    # caller's recv loop, without resolving any forbidden global
+    return wire.control_loads(blob)
 
 
 def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
@@ -73,6 +96,26 @@ def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
 # ---------------------------------------------------------------------------
 # Head side
 # ---------------------------------------------------------------------------
+
+
+class _PoolObj:
+    """Wire marker for an ObjectRef argument shipped through the
+    once-per-node object pool: the first call naming ``obj_id`` to a
+    node carries the value; later calls carry the id alone and the
+    agent resolves it from its cache (the reference's pull-once-per-
+    node plasma transfer, ``object_manager/object_manager.h:114``,
+    scoped to head-owned objects). Weight broadcast to K actors on one
+    agent therefore moves ONE copy over TCP, not K."""
+
+    __slots__ = ("obj_id", "value", "has_value")
+
+    def __init__(self, obj_id: str, value=None, has_value: bool = False):
+        self.obj_id = obj_id
+        self.value = value
+        self.has_value = has_value
+
+    def __reduce__(self):
+        return (_PoolObj, (self.obj_id, self.value, self.has_value))
 
 
 class RemoteNode:
@@ -92,6 +135,19 @@ class RemoteNode:
         # refs failed, never a forever-pending ray.get
         self.state_lock = threading.Lock()
         self.inflight: Dict[str, int] = {}  # task_id -> num_returns
+        # stateless tasks spilled here: task_id -> _TaskRecord, so a
+        # node death can retry them locally instead of erroring
+        self.task_recs: Dict[str, Any] = {}
+        self.inflight_cpus: float = 0.0
+        # CPUs of dedicated actors placed on this node (spillover
+        # capacity accounting shares one ledger with spilled tasks)
+        self.actor_cpus: Dict[str, float] = {}
+        # object-pool bookkeeping: ids whose value this node already
+        # holds (see _PoolObj). _ship_lock serializes the
+        # check-and-send so a concurrent marshal of the same ref can
+        # never emit an id-only marker ahead of the value frame.
+        self.shipped_objs: set = set()
+        self._ship_lock = threading.Lock()
         self.dead = False
         self._thread = threading.Thread(
             target=self._recv_loop, daemon=True,
@@ -103,7 +159,9 @@ class RemoteNode:
         while True:
             try:
                 msg = _recv_frame(self.sock)
-            except OSError:
+            except (OSError, wire.ControlFrameError):
+                # a forbidden frame on an established agent connection
+                # means the peer is compromised or not ours: drop it
                 msg = None
             if msg is None:
                 self._on_disconnect()
@@ -113,6 +171,18 @@ class RemoteNode:
                 task_id = msg["task_id"]
                 with self.state_lock:
                     self.inflight.pop(task_id, None)
+                    trec = self.task_recs.pop(task_id, None)
+                    if trec is not None:
+                        self.inflight_cpus -= trec.num_cpus
+                if trec is not None and self.runtime.pending:
+                    # capacity freed: queued tasks may spill now —
+                    # wake the cluster's single dispatcher thread (a
+                    # per-result thread would stampede runtime.lock at
+                    # high task rates, and dispatching inline here
+                    # would stall the recv loop on a slow marshal)
+                    cluster = getattr(self.runtime, "cluster", None)
+                    if cluster is not None:
+                        cluster.kick_dispatch()
                 if msg.get("ok"):
                     self.runtime.store.put(
                         task_id,
@@ -133,7 +203,9 @@ class RemoteNode:
     def _on_disconnect(self):
         """Agent died / network split: fail everything it owed us
         (the reference marks the node dead via GCS heartbeat timeout
-        and fails its leases)."""
+        and fails its leases). Spilled stateless tasks with retries
+        left go back into the head's queue instead — the reference's
+        lease-failure resubmission (direct_task_transport.h:57)."""
         from ray_tpu.core.api import RayActorError
 
         with self.state_lock:
@@ -142,7 +214,19 @@ class RemoteNode:
             self.dead = True
             pending = list(self.inflight)
             self.inflight.clear()
+            task_recs = dict(self.task_recs)
+            self.task_recs.clear()
+            self.inflight_cpus = 0.0
+            self.shipped_objs.clear()
         for task_id in pending:
+            trec = task_recs.get(task_id)
+            if trec is not None and trec.retries_left > 0:
+                trec.retries_left -= 1
+                try:
+                    self.runtime._enqueue(trec)
+                    continue
+                except Exception:
+                    pass
             self.runtime.store.put_error(
                 task_id,
                 RayActorError(
@@ -154,6 +238,99 @@ class RemoteNode:
             cluster.nodes.pop(self.node_id, None)
             cluster._publish_event(
                 "cluster.node_removed", {"node_id": self.node_id}
+            )
+
+    # -- argument marshalling (once-per-node object pool) ----------------
+
+    def marshal_args(self, args, kwargs):
+        """Top-level ObjectRef args become id-only :class:`_PoolObj`
+        markers; the value travels in its own ``cache_obj`` frame sent
+        (once per node) BEFORE this returns, under ``_ship_lock`` —
+        the connection's frame order then guarantees every call naming
+        the id lands after the value. Plain values pass through
+        (shipped inline per call, as before)."""
+        from ray_tpu.core.api import ObjectRef
+
+        def m(v):
+            if isinstance(v, ObjectRef):
+                with self._ship_lock:
+                    if v.id not in self.shipped_objs:
+                        value = self.runtime.store.get(
+                            v.id, timeout=60.0
+                        )
+                        _send_frame(
+                            self.sock,
+                            self.send_lock,
+                            {
+                                "op": "cache_obj",
+                                "obj_id": v.id,
+                                "payload": ser.dumps(value),
+                            },
+                        )
+                        self.shipped_objs.add(v.id)
+                return _PoolObj(v.id)
+            return v
+
+        return [m(a) for a in args], {k: m(v) for k, v in kwargs.items()}
+
+    def free_objs(self, ids) -> None:
+        """Head freed these object ids: drop them from the agent's
+        cache (and our bookkeeping) so the pool can't grow unbounded."""
+        with self.state_lock:
+            held = [i for i in ids if i in self.shipped_objs]
+            self.shipped_objs.difference_update(held)
+            if self.dead or not held:
+                return
+        try:
+            _send_frame(
+                self.sock,
+                self.send_lock,
+                {"op": "free_objs", "ids": held},
+            )
+        except OSError:
+            pass
+
+    # -- stateless tasks (spillover scheduling) --------------------------
+
+    def submit_task(self, trec, payload: bytes) -> bool:
+        """Ship a queued stateless task to this agent; False if the
+        node is dead (caller keeps it queued)."""
+        task_id = trec.task_id
+        with self.state_lock:
+            if self.dead:
+                return False
+            self.inflight[task_id] = 1
+            self.task_recs[task_id] = trec
+            self.inflight_cpus += trec.num_cpus
+        try:
+            _send_frame(
+                self.sock,
+                self.send_lock,
+                {
+                    "op": "task",
+                    "task_id": task_id,
+                    "func_id": trec.msg["func_id"],
+                    "func": trec.msg["func_blob"],
+                    "payload": payload,
+                    "name": trec.name,
+                    "num_cpus": trec.num_cpus,
+                    "runtime_env": trec.msg.get("runtime_env"),
+                },
+            )
+        except OSError:
+            with self.state_lock:
+                self.inflight.pop(task_id, None)
+                self.task_recs.pop(task_id, None)
+                self.inflight_cpus -= trec.num_cpus
+            return False
+        return True
+
+    def free_cpus(self) -> float:
+        with self.state_lock:
+            return (
+                self.num_cpus
+                - self.inflight_cpus
+                - sum(self.actor_cpus.values())
             )
 
     # -- actor ops -------------------------------------------------------
@@ -181,6 +358,11 @@ class RemoteNode:
             },
         )
         self.actor_ids.add(actor_id)
+        req = options.get("num_cpus")
+        with self.state_lock:
+            self.actor_cpus[actor_id] = (
+                1.0 if req is None else float(req)
+            )
 
     def call(self, actor_id, method, args, kwargs, num_returns):
         from ray_tpu.core.api import RayActorError
@@ -239,6 +421,8 @@ class RemoteNode:
         except OSError:
             pass
         self.actor_ids.discard(actor_id)
+        with self.state_lock:
+            self.actor_cpus.pop(actor_id, None)
 
 
 class ClusterServer:
@@ -254,6 +438,23 @@ class ClusterServer:
     ):
         self.runtime = runtime
         self.nodes: Dict[str, RemoteNode] = {}
+        # shared-token gate on agent registration (None → open, the
+        # loopback-only default; set RAY_TPU_CLUSTER_TOKEN for fleets)
+        self._token = wire.cluster_token()
+        # freed head objects invalidate per-node pool caches
+        store = getattr(runtime, "store", None)
+        if store is not None and hasattr(store, "add_free_listener"):
+            store.add_free_listener(self._on_objects_freed)
+        # one long-lived dispatcher services capacity-freed kicks from
+        # every node's recv loop (spill scans touch runtime.lock and
+        # can block on a marshal — never run them on a recv thread)
+        self._dispatch_event = threading.Event()
+        self._dispatch_thread = threading.Thread(
+            target=self._dispatch_loop,
+            daemon=True,
+            name="cluster_spill_dispatch",
+        )
+        self._dispatch_thread.start()
         # optional event publication: node lifecycle fans out to KV
         # pubsub subscribers (the reference's GCS node-change channel,
         # RAY_NODE_INFO_CHANNEL in gcs_node_manager.cc)
@@ -294,36 +495,78 @@ class ClusterServer:
                 conn, _ = self._sock.accept()
             except OSError:
                 return
-            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            # bounded handshake: a connection that never sends its
-            # register frame (port scanner, wedged agent) must not
-            # park the accept loop forever
-            conn.settimeout(10.0)
+            # per-connection handshake errors (malformed frames, bogus
+            # field types) must never kill the accept thread — that
+            # would be a one-packet DoS on the registration surface
             try:
-                msg = _recv_frame(conn)
-            except (OSError, socket.timeout):
-                msg = None
-            if not msg or msg.get("op") != "register":
-                conn.close()
-                continue
-            conn.settimeout(None)
-            node = RemoteNode(
-                self.runtime,
-                msg["node_id"],
-                int(msg.get("num_cpus", 1)),
-                conn,
-            )
-            self.nodes[msg["node_id"]] = node
-            _send_frame(
-                conn, node.send_lock, {"op": "registered", "ok": True}
-            )
-            self._publish_event(
-                "cluster.node_added",
-                {
-                    "node_id": msg["node_id"],
-                    "num_cpus": int(msg.get("num_cpus", 1)),
-                },
-            )
+                self._handshake(conn)
+            except Exception:
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+
+    def _handshake(self, conn) -> None:
+        conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        # bounded handshake: a connection that never sends its
+        # register frame (port scanner, wedged agent) must not
+        # park the accept loop forever
+        conn.settimeout(10.0)
+        # challenge-response: the MAC must cover a server nonce so a
+        # captured register frame cannot be replayed to enroll a
+        # rogue node (whose payload fields would then get full-pickle
+        # treatment)
+        nonce = uuid.uuid4().hex
+        _send_frame(
+            conn, threading.Lock(), {"op": "challenge", "nonce": nonce}
+        )
+        try:
+            msg = _recv_frame(conn, max_len=_MAX_HANDSHAKE_FRAME)
+        except (OSError, socket.timeout, wire.ControlFrameError):
+            msg = None
+        if (
+            not isinstance(msg, dict)
+            or msg.get("op") != "register"
+            or (self._token is not None and msg.get("nonce") != nonce)
+            or not wire.register_ok(self._token, msg)
+        ):
+            conn.close()
+            return
+        conn.settimeout(None)
+        node = RemoteNode(
+            self.runtime,
+            str(msg["node_id"]),
+            int(msg.get("num_cpus", 1)),
+            conn,
+        )
+        self.nodes[str(msg["node_id"])] = node
+        _send_frame(
+            conn, node.send_lock, {"op": "registered", "ok": True}
+        )
+        self._publish_event(
+            "cluster.node_added",
+            {
+                "node_id": str(msg["node_id"]),
+                "num_cpus": int(msg.get("num_cpus", 1)),
+            },
+        )
+
+    def _on_objects_freed(self, ids) -> None:
+        for node in list(self.nodes.values()):
+            node.free_objs(ids)
+
+    def kick_dispatch(self) -> None:
+        """Wake the dispatcher: remote capacity may have freed."""
+        self._dispatch_event.set()
+
+    def _dispatch_loop(self) -> None:
+        while True:
+            self._dispatch_event.wait()
+            self._dispatch_event.clear()
+            try:
+                self.runtime._dispatch_pending()
+            except Exception:
+                pass
 
     def _publish_event(self, channel: str, payload: Dict) -> None:
         """Enqueue onto the single publisher thread: a slow/blackholed
@@ -422,15 +665,27 @@ class NodeAgent:
         self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self.send_lock = threading.Lock()
         self.actors: Dict[str, str] = {}  # head actor_id -> local id
-        _send_frame(
-            self.sock,
-            self.send_lock,
-            {
-                "op": "register",
-                "node_id": self.node_id,
-                "num_cpus": self.num_cpus,
-            },
-        )
+        # once-per-node object pool: obj_id -> value (entries live
+        # until the head's free_objs — mirrored plasma pinning)
+        self._obj_cache: Dict[str, Any] = {}
+        self._obj_cache_lock = threading.Lock()
+        challenge = _recv_frame(self.sock)
+        if not isinstance(challenge, dict) or challenge.get("op") != (
+            "challenge"
+        ):
+            raise ConnectionError(
+                f"cluster head at {address} sent no challenge"
+            )
+        reg = {
+            "op": "register",
+            "node_id": self.node_id,
+            "num_cpus": self.num_cpus,
+            "nonce": challenge.get("nonce", ""),
+        }
+        token = wire.cluster_token()
+        if token is not None:
+            reg["hmac"] = wire.register_hmac(token, reg)
+        _send_frame(self.sock, self.send_lock, reg)
         resp = _recv_frame(self.sock)
         if not resp or not resp.get("ok"):
             raise ConnectionError(
@@ -445,7 +700,7 @@ class NodeAgent:
         while True:
             try:
                 msg = _recv_frame(self.sock)
-            except OSError:
+            except (OSError, wire.ControlFrameError):
                 msg = None
             if msg is None:
                 return
@@ -476,15 +731,116 @@ class NodeAgent:
             },
         )
 
+    def _send_value_result(self, task_id, value, name: str) -> None:
+        """Serialize + send a success result, downgrading failures:
+        an unserializable value becomes an error result, and a broken
+        head socket is swallowed — this runs inside the local object
+        store's on_ready callbacks, where an escaped exception would
+        kill the thread delivering every later local result."""
+        try:
+            payload = ser.dumps(value)
+        except BaseException:
+            import traceback
+
+            try:
+                self._send_result(
+                    task_id,
+                    ok=False,
+                    name=name,
+                    tb=traceback.format_exc(),
+                )
+            except OSError:
+                pass
+            return
+        try:
+            self._send_result(task_id, ok=True, payload=payload)
+        except OSError:
+            pass  # head gone; its recv loop handles the disconnect
+
+    def _resolve_pool_args(self, args, kwargs):
+        """Map :class:`_PoolObj` markers to values via the node cache
+        (top-level args only — the same scope the head marshals)."""
+
+        def r(v):
+            if isinstance(v, _PoolObj):
+                with self._obj_cache_lock:
+                    if v.has_value:
+                        self._obj_cache[v.obj_id] = v.value
+                        return v.value
+                    if v.obj_id in self._obj_cache:
+                        return self._obj_cache[v.obj_id]
+                raise KeyError(
+                    f"object {v.obj_id} not in node cache (freed at "
+                    "head while a call naming it was in flight?)"
+                )
+            return v
+
+        return [r(a) for a in args], {
+            k: r(v) for k, v in kwargs.items()
+        }
+
     def _handle(self, msg: Dict):
         op = msg["op"]
         if op == "create_actor":
             cls = ser.loads(msg["cls"])
             args, kwargs = ser.loads(msg["payload"])
+            args, kwargs = self._resolve_pool_args(args, kwargs)
             handle = self.runtime.create_actor(
                 cls, args, kwargs, dict(msg.get("options") or {})
             )
             self.actors[msg["actor_id"]] = handle._actor_id
+        elif op == "cache_obj":
+            value = ser.loads(msg["payload"])
+            with self._obj_cache_lock:
+                self._obj_cache[msg["obj_id"]] = value
+        elif op == "free_objs":
+            with self._obj_cache_lock:
+                for i in msg.get("ids", ()):
+                    self._obj_cache.pop(i, None)
+        elif op == "task":
+            task_id = msg["task_id"]
+            func_blob = msg["func"]
+            args, kwargs = ser.loads(msg["payload"])
+            args, kwargs = self._resolve_pool_args(args, kwargs)
+            refs = self.runtime.submit_task(
+                None,
+                msg["func_id"],
+                func_blob,
+                args,
+                kwargs,
+                {
+                    "name": msg.get("name") or "spilled_task",
+                    "num_cpus": msg.get("num_cpus", 1),
+                    "runtime_env_packed": msg.get("runtime_env"),
+                    # retries are the HEAD's job (it re-spills or runs
+                    # locally); a local retry here would double-run
+                    "max_retries": 0,
+                },
+            )
+            ref = refs[0]
+
+            def on_ready(task_id=task_id, ref=ref, name=msg.get("name")):
+                try:
+                    value = self.runtime.store.get(ref.id, timeout=0)
+                except Exception:
+                    import traceback
+
+                    try:
+                        self._send_result(
+                            task_id,
+                            ok=False,
+                            name=name or "spilled_task",
+                            tb=traceback.format_exc(),
+                        )
+                    except OSError:
+                        pass
+                    return
+                self._send_value_result(
+                    task_id, value, name or "spilled_task"
+                )
+                self.runtime.store.free([ref.id])
+
+            self.runtime.store.on_ready(ref.id, on_ready)
         elif op == "actor_call":
             task_id = msg["task_id"]
             local_id = self.actors.get(msg["actor_id"])
@@ -497,6 +853,7 @@ class NodeAgent:
                 )
                 return
             args, kwargs = ser.loads(msg["payload"])
+            args, kwargs = self._resolve_pool_args(args, kwargs)
             refs = self.runtime.call_actor(
                 local_id, msg["method"], args, kwargs, num_returns=1
             )
@@ -511,16 +868,17 @@ class NodeAgent:
                 except Exception:
                     import traceback
 
-                    self._send_result(
-                        task_id,
-                        ok=False,
-                        name=name,
-                        tb=traceback.format_exc(),
-                    )
+                    try:
+                        self._send_result(
+                            task_id,
+                            ok=False,
+                            name=name,
+                            tb=traceback.format_exc(),
+                        )
+                    except OSError:
+                        pass
                     return
-                self._send_result(
-                    task_id, ok=True, payload=ser.dumps(value)
-                )
+                self._send_value_result(task_id, value, name)
                 self.runtime.store.free([ref.id])
 
             self.runtime.store.on_ready(ref.id, on_ready)

@@ -363,7 +363,24 @@ class ObjectStore:
 
         self.on_ready(obj_id, _free_if_unreferenced)
 
+    def add_free_listener(self, fn) -> None:
+        """Register fn(list_of_ids) called after ids are freed — the
+        cluster plane uses this to invalidate per-node object caches
+        (cluster.RemoteNode.free_objs)."""
+        with self._lock:
+            self._free_listeners = getattr(
+                self, "_free_listeners", []
+            ) + [fn]
+
     def free(self, obj_ids) -> None:
+        obj_ids = list(obj_ids)  # may be a generator; iterated twice
+        listeners = getattr(self, "_free_listeners", None)
+        if listeners:
+            for fn in listeners:
+                try:
+                    fn(obj_ids)
+                except Exception:
+                    pass
         with self._lock:
             for oid in obj_ids:
                 # drop the handle count too: a later decref on an

@@ -1,0 +1,122 @@
+"""Restricted deserialization for cross-host CONTROL frames.
+
+The reference separates its control plane (typed protobuf messages —
+``src/ray/protobuf/core_worker.proto``, ``rpc/grpc_server.h:64``) from
+user payloads; a malformed control message fails schema validation
+before any user code runs. Our control frames are pickled dicts, and a
+blind ``pickle.loads`` on network bytes is arbitrary code execution —
+so control frames go through a restricted unpickler instead: only
+builtins containers/scalars and numpy array reconstruction resolve;
+any other global (``os.system``, ``subprocess.*``, ``__reduce__``
+gadgets generally) raises before anything executes.
+
+User payloads (task args, actor state) legitimately need full pickle —
+they stay on ``core.serialization`` but are only deserialized AFTER
+the connection authenticated (HMAC handshake, ``core/cluster.py``) and
+only in fields the control schema marks opaque (``payload``, ``cls``).
+
+Threat model: same as the KV service (``parallel/distributed.py``) —
+cluster hosts only, loopback by default; the token is a second wall,
+and the restricted unpickler closes the remaining pre-auth gap where
+bytes had to be parsed before the HMAC could be checked.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac as _hmac
+import io
+import json
+import os
+import pickle
+from typing import Any, Dict, Optional
+
+# Globals a control frame may resolve. Control frames are dicts of
+# primitives plus opaque bytes fields; numpy sneaks in via scalar
+# config values (num_cpus as np.int64 and the like).
+_ALLOWED_GLOBALS = {
+    ("builtins", "dict"),
+    ("builtins", "list"),
+    ("builtins", "tuple"),
+    ("builtins", "set"),
+    ("builtins", "frozenset"),
+    ("builtins", "bytes"),
+    ("builtins", "bytearray"),
+    ("builtins", "str"),
+    ("builtins", "int"),
+    ("builtins", "float"),
+    ("builtins", "bool"),
+    ("builtins", "complex"),
+    ("numpy", "ndarray"),
+    ("numpy", "dtype"),
+    ("numpy.core.multiarray", "_reconstruct"),
+    ("numpy._core.multiarray", "_reconstruct"),
+    ("numpy.core.multiarray", "scalar"),
+    ("numpy._core.multiarray", "scalar"),
+}
+
+
+class ControlFrameError(pickle.UnpicklingError):
+    """A control frame referenced a global outside the schema."""
+
+
+class _RestrictedUnpickler(pickle.Unpickler):
+    def find_class(self, module: str, name: str):
+        if (module, name) in _ALLOWED_GLOBALS:
+            return super().find_class(module, name)
+        raise ControlFrameError(
+            f"control frame references forbidden global "
+            f"{module}.{name}"
+        )
+
+    # reducer_override-style extensions ride find_class, but buffers
+    # and persistent ids are not part of the control schema at all
+    def persistent_load(self, pid):  # pragma: no cover - defense
+        raise ControlFrameError("persistent ids not allowed")
+
+
+def control_loads(blob: bytes) -> Any:
+    """Deserialize a network control frame; raises
+    :class:`ControlFrameError` on anything outside the schema."""
+    return _RestrictedUnpickler(io.BytesIO(blob)).load()
+
+
+def control_dumps(obj: Any) -> bytes:
+    return pickle.dumps(obj, protocol=5)
+
+
+# ---------------------------------------------------------------------------
+# Shared-token authentication for the cluster handshake
+# ---------------------------------------------------------------------------
+
+
+def cluster_token() -> Optional[str]:
+    """The fleet's shared secret: ``RAY_TPU_CLUSTER_TOKEN``, falling
+    back to the KV service's ``RAY_TPU_KV_TOKEN`` so one secret can
+    cover both planes."""
+    return os.environ.get("RAY_TPU_CLUSTER_TOKEN") or os.environ.get(
+        "RAY_TPU_KV_TOKEN"
+    )
+
+
+def register_hmac(token: str, frame: Dict) -> str:
+    """MAC over the registration frame's sorted-key JSON header
+    (everything except the mac itself)."""
+    msg = json.dumps(
+        {k: v for k, v in frame.items() if k != "hmac"},
+        sort_keys=True,
+        default=str,
+    ).encode()
+    return _hmac.new(token.encode(), msg, hashlib.sha256).hexdigest()
+
+
+def register_ok(token: Optional[str], frame: Dict) -> bool:
+    """The registration frame includes the server's challenge nonce,
+    so the MAC (which covers every non-mac field) is single-use — a
+    captured handshake cannot be replayed to enroll a rogue node."""
+    if token is None:
+        return True
+    mac = frame.get("hmac", "")
+    if not isinstance(mac, str):
+        return False
+    return _hmac.compare_digest(mac, register_hmac(token, frame))

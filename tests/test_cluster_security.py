@@ -1,0 +1,160 @@
+"""Cross-host control-plane hardening (core/wire.py + core/cluster.py).
+
+The reference's control plane is typed protobuf
+(``src/ray/protobuf/core_worker.proto``): malformed control messages
+fail schema validation before user code runs. Ours is restricted
+pickle — these tests pin the two walls: a gadget pickle in a control
+frame is rejected without executing, and registration requires the
+shared-token HMAC when one is configured.
+"""
+
+import os
+import pickle
+import socket
+import struct
+import tempfile
+import time
+
+import pytest
+
+from ray_tpu.core import wire
+from ray_tpu.core.cluster import ClusterServer
+
+
+class _DummyRuntime:
+    """Registration-path stand-in: the server only touches the runtime
+    when results arrive, which these tests never get to."""
+
+    cluster = None
+
+
+def _send_raw(sock, blob: bytes):
+    sock.sendall(struct.pack(">I", len(blob)) + blob)
+
+
+def _recv_reply(sock, timeout=5.0):
+    sock.settimeout(timeout)
+    try:
+        header = sock.recv(4)
+        if len(header) < 4:
+            return None
+        (n,) = struct.unpack(">I", header)
+        buf = b""
+        while len(buf) < n:
+            chunk = sock.recv(n - len(buf))
+            if not chunk:
+                return None
+            buf += chunk
+        return wire.control_loads(buf)
+    except (socket.timeout, OSError):
+        return None
+
+
+def test_restricted_unpickler_blocks_gadgets():
+    marker = os.path.join(
+        tempfile.gettempdir(), f"ray_tpu_pwned_{os.getpid()}"
+    )
+
+    class Evil:
+        def __reduce__(self):
+            return (os.system, (f"touch {marker}",))
+
+    blob = pickle.dumps(Evil())
+    with pytest.raises(wire.ControlFrameError):
+        wire.control_loads(blob)
+    assert not os.path.exists(marker)
+    # benign control frames (nested containers, bytes payloads) pass
+    frame = {"op": "actor_call", "payload": b"\x00" * 8, "n": [1, 2.5]}
+    assert wire.control_loads(wire.control_dumps(frame)) == frame
+
+
+def test_malicious_register_frame_rejected():
+    marker = os.path.join(
+        tempfile.gettempdir(), f"ray_tpu_pwned2_{os.getpid()}"
+    )
+
+    class Evil:
+        def __reduce__(self):
+            return (os.system, (f"touch {marker}",))
+
+    server = ClusterServer(_DummyRuntime(), "127.0.0.1", 0)
+    try:
+        # a raw gadget pickle instead of a register frame
+        s = socket.create_connection(("127.0.0.1", server.port))
+        assert _recv_reply(s)["op"] == "challenge"
+        _send_raw(s, pickle.dumps(Evil()))
+        assert _recv_reply(s) is None  # connection dropped, no reply
+        s.close()
+        # a well-formed register frame smuggling a gadget in a field
+        s = socket.create_connection(("127.0.0.1", server.port))
+        assert _recv_reply(s)["op"] == "challenge"
+        _send_raw(
+            s,
+            pickle.dumps(
+                {"op": "register", "node_id": Evil(), "num_cpus": 1}
+            ),
+        )
+        assert _recv_reply(s) is None
+        s.close()
+        # a non-dict frame must not kill the accept thread
+        s = socket.create_connection(("127.0.0.1", server.port))
+        assert _recv_reply(s)["op"] == "challenge"
+        _send_raw(s, pickle.dumps(5))
+        assert _recv_reply(s) is None
+        s.close()
+        time.sleep(0.2)
+        # accept loop still alive: a fresh connection gets a challenge
+        s = socket.create_connection(("127.0.0.1", server.port))
+        assert _recv_reply(s)["op"] == "challenge"
+        s.close()
+        assert not os.path.exists(marker)
+        assert not server.nodes
+    finally:
+        server.shutdown()
+
+
+def test_register_hmac_gate(monkeypatch):
+    monkeypatch.setenv("RAY_TPU_CLUSTER_TOKEN", "sekrit")
+    server = ClusterServer(_DummyRuntime(), "127.0.0.1", 0)
+    try:
+        # no hmac → rejected
+        s = socket.create_connection(("127.0.0.1", server.port))
+        nonce = _recv_reply(s)["nonce"]
+        _send_raw(
+            s,
+            wire.control_dumps(
+                {
+                    "op": "register",
+                    "node_id": "mallory",
+                    "num_cpus": 1,
+                    "nonce": nonce,
+                }
+            ),
+        )
+        assert _recv_reply(s) is None
+        s.close()
+        assert "mallory" not in server.nodes
+        # correct hmac over the server's nonce → registered
+        s = socket.create_connection(("127.0.0.1", server.port))
+        nonce = _recv_reply(s)["nonce"]
+        frame = {
+            "op": "register",
+            "node_id": "alice",
+            "num_cpus": 1,
+            "nonce": nonce,
+        }
+        frame["hmac"] = wire.register_hmac("sekrit", frame)
+        _send_raw(s, wire.control_dumps(frame))
+        reply = _recv_reply(s)
+        assert reply and reply.get("ok"), reply
+        assert "alice" in server.nodes
+        # replaying the captured frame against a NEW connection fails:
+        # the MAC covers the old nonce, not the fresh challenge
+        s2 = socket.create_connection(("127.0.0.1", server.port))
+        assert _recv_reply(s2)["op"] == "challenge"
+        _send_raw(s2, wire.control_dumps(frame))
+        assert _recv_reply(s2) is None
+        s2.close()
+        s.close()
+    finally:
+        server.shutdown()

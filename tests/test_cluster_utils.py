@@ -47,6 +47,64 @@ def test_two_nodes_host_actors_in_own_processes(cluster):
     assert os.getpid() not in (pid_a, pid_b)
 
 
+@ray.remote
+def _where(i):
+    import os
+    import time as _t
+
+    _t.sleep(0.8)
+    return (i, os.getpid(), os.getppid())
+
+
+def test_tasks_spill_to_agent_nodes(cluster):
+    """VERDICT r3 #2 'done' bar: 2x head-CPU worth of plain @remote
+    tasks completes using BOTH nodes, with placement left entirely to
+    the scheduler (no placement_node anywhere)."""
+    import os
+
+    cluster.add_node(num_cpus=2)
+    cluster.wait_for_nodes(1, timeout=60)
+    # warm the worker pools on both nodes first: process spawn + jax
+    # import costs seconds each on the 1-core CI host and would
+    # otherwise swamp the timing below
+    ray.get([_where.remote(i) for i in range(6)], timeout=180)
+    t0 = time.time()
+    out = ray.get([_where.remote(i) for i in range(6)], timeout=120)
+    wall = time.time() - t0
+    assert sorted(i for i, _, _ in out) == list(range(6))
+    # head workers are children of THIS process; agent workers are
+    # children of the agent subprocess — both must appear
+    ppids = {pp for _, _, pp in out}
+    assert os.getpid() in ppids, "head ran nothing"
+    assert ppids - {os.getpid()}, "nothing spilled to the agent"
+    # 6 x 0.8s tasks on 1 head CPU serial = 4.8s; head+agent (3 CPUs)
+    # ≈ 1.6s with warm pools — slack for the 1-core CI host
+    assert wall < 4.5, wall
+
+
+def test_spilled_task_retries_on_node_death(cluster):
+    """A node dying mid-task re-queues the spilled task (reference
+    lease-failure resubmission) instead of erroring the ref."""
+    import os
+
+    cluster.add_node(num_cpus=2)
+    cluster.wait_for_nodes(1, timeout=60)
+
+    @ray.remote
+    def slow(i):
+        import time as _t
+
+        _t.sleep(1.5)
+        return i
+
+    # saturate the head's single CPU so the rest spill
+    refs = [slow.remote(i) for i in range(4)]
+    time.sleep(0.5)  # let spillover happen
+    cluster.remove_node(cluster.alive_nodes[0])
+    out = ray.get(refs, timeout=120)
+    assert sorted(out) == list(range(4))
+
+
 def test_remove_node_fails_its_actor(cluster):
     cluster.add_node(num_cpus=1)
     fleet_ids = cluster.wait_for_nodes(1, timeout=60)
