@@ -1,0 +1,131 @@
+"""At-volume Dataset benchmark: ~1 GB through the block exchanges.
+
+VERDICT r3 #8 asked for evidence beyond test-sized data: this drives
+the two-stage groupby/shuffle exchanges and the block-wise reshapes
+over ~1 GB of arrow blocks with the object store capped LOW enough
+that LRU spilling engages, and records wall times plus driver RSS
+before/after each op — the claim under test is that row data moves
+worker<->worker through the object plane (spilling to disk under
+pressure) while the driver routes refs only, so its RSS stays flat.
+
+Usage:  python benchmarks/bench_data_volume.py [--gb 1.0]
+Writes: benchmarks/data_at_volume.json
+"""
+
+import json
+import pathlib
+import resource
+import sys
+import time
+
+import numpy as np
+
+BLOCK_MB = 16
+ROW_PAYLOAD = 1024  # bytes per row
+
+
+def rss_mb() -> float:
+    return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024.0
+
+
+def main():
+    gb = 1.0
+    if "--gb" in sys.argv:
+        gb = float(sys.argv[sys.argv.index("--gb") + 1])
+    n_blocks = max(4, int(gb * 1024 / BLOCK_MB))
+    rows_per_block = BLOCK_MB * 1024 * 1024 // (ROW_PAYLOAD + 64)
+
+    import ray_tpu as ray
+    from ray_tpu.data.dataset import Dataset
+
+    # cap the store so this workload cannot fit resident: the LRU
+    # spill path is part of what's being exercised
+    ray.init(
+        num_cpus=2,
+        object_store_memory=256 * 1024 * 1024,
+        ignore_reinit_error=True,
+    )
+
+    @ray.remote
+    def gen_block(i):
+        import pyarrow as pa
+
+        rng = np.random.default_rng(i)
+        n = rows_per_block
+        return pa.table(
+            {
+                "k": rng.integers(0, 100, n),
+                "v": rng.standard_normal(n),
+                "payload": [
+                    rng.integers(0, 255, ROW_PAYLOAD, np.uint8).tobytes()
+                    for _ in range(n)
+                ],
+            }
+        )
+
+    report = {
+        "note": (
+            "rss_mb is the driver's ru_maxrss HIGH-WATER mark (never "
+            "decreases); the store is driver-resident, so it includes "
+            "shm segments + spill writer buffers the store touches. "
+            "The signal is per-op deltas staying ~flat after the "
+            "first exchange: row data moves worker<->worker (or "
+            "worker<->spill-disk directly), not through driver "
+            "python."
+        ),
+        "target_gb": gb,
+        "n_blocks": n_blocks,
+        "rows_per_block": rows_per_block,
+        "block_mb": BLOCK_MB,
+        "object_store_cap_mb": 256,
+        "ops": {},
+    }
+
+    t0 = time.perf_counter()
+    refs = [gen_block.remote(i) for i in range(n_blocks)]
+    ds = Dataset(None, refs=refs)
+    total = ds.count()  # forces generation
+    gen_s = time.perf_counter() - t0
+    data_gb = n_blocks * BLOCK_MB / 1024
+    report["rows_total"] = total
+    report["data_gb"] = round(data_gb, 2)
+    report["ops"]["generate"] = {
+        "wall_s": round(gen_s, 1),
+        "rss_mb_after": round(rss_mb(), 1),
+    }
+    print(f"# generated {total} rows / ~{data_gb:.1f} GB in {gen_s:.1f}s",
+          file=sys.stderr)
+
+    def run(name, fn):
+        r0 = rss_mb()
+        t = time.perf_counter()
+        out = fn()
+        wall = time.perf_counter() - t
+        report["ops"][name] = {
+            "wall_s": round(wall, 1),
+            "rss_mb_before": round(r0, 1),
+            "rss_mb_after": round(rss_mb(), 1),
+            "result": out,
+        }
+        print(f"# {name}: {wall:.1f}s rss {r0:.0f}->{rss_mb():.0f}MB",
+              file=sys.stderr)
+
+    run(
+        "groupby_sum",
+        lambda: len(ds.groupby("k").sum("v").take_all()),
+    )
+    run("random_shuffle_count", lambda: ds.random_shuffle(seed=0).count())
+    run("repartition_count", lambda: ds.repartition(n_blocks // 2).count())
+    run("unique_keys", lambda: len(ds.unique("k")))
+    half = n_blocks // 2
+    a = Dataset(None, refs=refs[:half])
+    b = Dataset(None, refs=refs[half : 2 * half])
+    run("zip_halves_count", lambda: a.zip(b).count())
+
+    out_path = pathlib.Path(__file__).parent / "data_at_volume.json"
+    out_path.write_text(json.dumps(report, indent=1))
+    print(json.dumps({"metric": "data_at_volume", **report}))
+
+
+if __name__ == "__main__":
+    main()

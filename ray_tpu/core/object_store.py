@@ -325,9 +325,31 @@ class ObjectStore:
                 except ValueError:
                     pass
 
+    def spill_location(self, obj_id: str):
+        """(spill_uri, path) when the object currently lives in spill
+        storage — lets a worker read the bytes straight from the
+        backend (local file, s3, ...) instead of round-tripping the
+        value through the driver socket."""
+        e = self._entries.get(obj_id)
+        if e is None:
+            return None
+        with self._lock:
+            if e.spill_path is None:
+                return None
+            return (self._spill_uri, e.spill_path)
+
     def shm_name(self, obj_id: str) -> Optional[str]:
         e = self._entries.get(obj_id)
-        return e.shm.name if e and e.shm else None
+        if e is None or e.shm is None:
+            return None
+        with self._lock:
+            # marshalling is about to hand this name to a worker:
+            # refresh the LRU stamp so the spiller prefers colder
+            # entries (the worker-side load still has a driver-API
+            # fallback if a spill wins the race anyway)
+            if obj_id in self._lru:
+                self._lru[obj_id] = time.monotonic()
+            return e.shm.name if e.shm else None
 
     def incref(self, obj_id: str) -> None:
         with self._lock:

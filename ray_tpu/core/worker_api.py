@@ -171,6 +171,11 @@ class WorkerAPIServer:
             finally:
                 self._reacquire_cpu(released)
             return {"ok": True, "value": ser.dumps(value)}
+        if op == "spill_loc":
+            loc = rt.store.spill_location(msg["obj_id"])
+            if loc is None:
+                return {"ok": True, "loc": None}
+            return {"ok": True, "loc": list(loc)}
         if op == "put":
             from ray_tpu.core.object_store import ObjectRef
 
@@ -386,6 +391,16 @@ class DriverAPIClient:
             }
         )
         return reply["ref_ids"]
+
+    def spill_location(self, obj_id: str):
+        """(spill_uri, path) if the object is currently spilled, else
+        None — lets the worker read big spilled blocks straight from
+        the storage backend instead of through this socket."""
+        resp = self._roundtrip(
+            {"op": "spill_loc", "obj_id": obj_id}
+        )
+        loc = resp.get("loc")
+        return tuple(loc) if loc else None
 
     def get(self, obj_id: str, timeout: Optional[float]) -> Any:
         reply = self._roundtrip(

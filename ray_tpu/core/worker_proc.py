@@ -50,7 +50,36 @@ class _ObjArg:
             return self.inline
         from ray_tpu.core.object_store import Segment
 
-        shm = Segment(name=self.shm_name)
+        try:
+            shm = Segment(name=self.shm_name)
+        except FileNotFoundError:
+            # the driver's LRU spilled (and unlinked) this segment
+            # after the task marshalled its args — at-volume runs hit
+            # this when the working set exceeds the store cap. Read
+            # the spilled bytes straight from the storage backend when
+            # possible (no driver round trip for the data), falling
+            # back to a driver-API get (which restores transparently).
+            from ray_tpu.core.worker_api import worker_client
+
+            client = worker_client()
+            if client is None:
+                raise
+            value = None
+            try:
+                loc = client.spill_location(self.obj_id)
+                if loc is not None:
+                    from ray_tpu.core.external_storage import (
+                        storage_from_uri,
+                    )
+
+                    blob = storage_from_uri(loc[0]).get(loc[1])
+                    value = ser.read_from_buffer(memoryview(blob))
+            except Exception:
+                value = None
+            if value is None:
+                value = client.get(self.obj_id, timeout=120.0)
+            shm_cache[self.obj_id] = (None, value)
+            return value
         value = ser.read_from_buffer(shm.buf)
         # Keep the segment mapped as long as the value is cached: the
         # deserialized arrays are zero-copy views into it.

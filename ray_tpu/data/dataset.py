@@ -262,6 +262,41 @@ def _block_count(block) -> int:
     return _block_len(block)
 
 
+@ray.remote
+def _gather_spans(spans, *blocks):
+    """Concatenate row ranges of several blocks: ``spans[i]`` is the
+    (start, stop) slice of ``blocks[i]``. The workhorse of the
+    block-wise reshapes (repartition/split/zip) — row data moves
+    worker↔worker through the object plane, never the driver."""
+    rows: List = []
+    for (start, stop), b in builtins.zip(spans, blocks):
+        rows.extend(_block_rows(b)[start:stop])
+    return _rows_to_block(rows, blocks[0] if blocks else None)
+
+
+@ray.remote
+def _zip_blocks(a_block, spans, *b_blocks):
+    """Pair one left block with the right-hand row ranges covering the
+    same global positions (reference dataset.zip's block-aligned
+    implementation, dataset.py:1403 area)."""
+    b_rows: List = []
+    for (start, stop), b in builtins.zip(spans, b_blocks):
+        b_rows.extend(_block_rows(b)[start:stop])
+    return list(builtins.zip(_block_rows(a_block), b_rows))
+
+
+def _cover_spans(pos: int, n: int, offsets) -> List:
+    """Which (block_index, local_start, local_stop) ranges cover
+    global rows [pos, pos+n) given cumulative block offsets."""
+    out = []
+    for j in range(len(offsets) - 1):
+        s, e = int(offsets[j]), int(offsets[j + 1])
+        lo, hi = max(pos, s), min(pos + n, e)
+        if lo < hi:
+            out.append((j, lo - s, hi - s))
+    return out
+
+
 class Dataset:
     """reference data/dataset.py:114 (lazy per-block execution)."""
 
@@ -482,8 +517,29 @@ class Dataset:
     # -- reshaping (distributed exchanges) --------------------------------
 
     def repartition(self, num_blocks: int) -> "Dataset":
-        rows = self.take_all()
-        return Dataset(_chunk(rows, num_blocks))
+        """Rechunk into ``num_blocks`` blocks WITHOUT materializing on
+        the driver: each output block is a span-gather task over the
+        input refs (the driver routes counts and refs only)."""
+        refs = self._materialize_refs()
+        counts = ray.get([_block_count.remote(r) for r in refs])
+        total = sum(counts)
+        offsets = np.cumsum([0] + counts)
+        num_blocks = max(1, num_blocks)
+        size = -(-total // num_blocks) if total else 0
+        out_refs = []
+        for i in range(num_blocks):
+            pos = i * size
+            n = min(size, total - pos)
+            if n <= 0:
+                break
+            spans = _cover_spans(pos, n, offsets)
+            out_refs.append(
+                _gather_spans.remote(
+                    [(s, e) for _, s, e in spans],
+                    *[refs[j] for j, _, _ in spans],
+                )
+            )
+        return Dataset(None, refs=out_refs or [ray.put([])])
 
     def random_shuffle(self, seed: Optional[int] = None) -> "Dataset":
         """Two-stage distributed exchange (the push_based_shuffle
@@ -567,13 +623,32 @@ class Dataset:
         return Dataset(None, refs=merged)
 
     def split(self, n: int) -> List["Dataset"]:
-        """reference dataset.split: n equal-ish shards (Train wiring)."""
-        rows = self.take_all()
-        size = -(-len(rows) // n) if rows else 0
+        """reference dataset.split: n equal-ish shards (Train wiring),
+        block-wise — each shard is a span-gather ref, so rows move
+        worker-to-worker, not through the driver."""
+        refs = self._materialize_refs()
+        counts = ray.get([_block_count.remote(r) for r in refs])
+        total = sum(counts)
+        offsets = np.cumsum([0] + counts)
+        size = -(-total // n) if total else 0
         shards = []
         for i in range(n):
+            pos = i * size
+            m = max(0, min(size, total - pos))
+            if m <= 0:
+                shards.append(Dataset([[]]))
+                continue
+            spans = _cover_spans(pos, m, offsets)
             shards.append(
-                Dataset([list(rows[i * size : (i + 1) * size])])
+                Dataset(
+                    None,
+                    refs=[
+                        _gather_spans.remote(
+                            [(s, e) for _, s, e in spans],
+                            *[refs[j] for j, _, _ in spans],
+                        )
+                    ],
+                )
             )
         return shards
 
@@ -606,13 +681,34 @@ class Dataset:
 
     def zip(self, other: "Dataset") -> "Dataset":
         """Row-wise zip of two same-length datasets into (row_a,
-        row_b) tuples (reference dataset.zip, scoped to tuple rows)."""
-        a, b = self.take_all(), other.take_all()
-        if len(a) != len(b):
+        row_b) tuples (reference dataset.zip, scoped to tuple rows).
+        Block-wise: output blocks follow the left partitioning; each
+        is a remote task pairing a left block with the right-hand row
+        spans at the same global positions — no driver
+        materialization."""
+        a_refs = self._materialize_refs()
+        b_refs = other._materialize_refs()
+        a_counts = ray.get([_block_count.remote(r) for r in a_refs])
+        b_counts = ray.get([_block_count.remote(r) for r in b_refs])
+        if sum(a_counts) != sum(b_counts):
             raise ValueError(
-                f"zip needs equal lengths, got {len(a)} vs {len(b)}"
+                f"zip needs equal lengths, got {sum(a_counts)} vs "
+                f"{sum(b_counts)}"
             )
-        return Dataset(_chunk(list(builtins.zip(a, b)), self.num_blocks()))
+        b_offsets = np.cumsum([0] + b_counts)
+        out_refs = []
+        pos = 0
+        for aref, n in builtins.zip(a_refs, a_counts):
+            spans = _cover_spans(pos, n, b_offsets)
+            out_refs.append(
+                _zip_blocks.remote(
+                    aref,
+                    [(s, e) for _, s, e in spans],
+                    *[b_refs[j] for j, _, _ in spans],
+                )
+            )
+            pos += n
+        return Dataset(None, refs=out_refs or [ray.put([])])
 
     def num_blocks(self) -> int:
         if self._refs is not None:

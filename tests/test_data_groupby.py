@@ -96,6 +96,36 @@ def test_unique_and_union_and_zip():
         a.zip(Dataset.range(3))
 
 
+def test_reshapes_never_materialize_on_driver(monkeypatch):
+    """zip/repartition/split are block-wise exchanges: rows move
+    worker-to-worker; the driver routes refs and counts only (VERDICT
+    r3 #8). Pin it by making driver materialization raise."""
+    a = Dataset.range(40, parallelism=4)
+    b = Dataset.range(40, parallelism=3).map(lambda x: x * 2)
+
+    def boom(self):
+        raise AssertionError("driver materialized rows")
+
+    monkeypatch.setattr(Dataset, "take_all", boom)
+    monkeypatch.setattr(Dataset, "_materialize", boom)
+    z = a.zip(b)
+    rp = a.repartition(5)
+    shards = a.split(4)
+    monkeypatch.undo()
+    assert z.take_all() == [(i, 2 * i) for i in range(40)]
+    assert rp.count() == 40
+    assert rp.num_blocks() == 5
+    assert sorted(rp.take_all()) == list(range(40))
+    got = []
+    for s in shards:
+        got.extend(s.take_all())
+    assert sorted(got) == list(range(40))
+    # misaligned block boundaries still pair positionally
+    c = Dataset.from_items(list(range(7)), parallelism=2)
+    d = Dataset.from_items(list(range(7)), parallelism=5)
+    assert c.zip(d).take_all() == [(i, i) for i in range(7)]
+
+
 def test_groupby_single_block_local_path():
     ds = Dataset.from_items([{"k": 0, "v": 1.0}], parallelism=1)
     out = ds.groupby("k").sum("v").take_all()
