@@ -529,6 +529,13 @@ class _Runtime:
             shm = self.store.shm_name(v.id)
             if shm:
                 return _ObjArg(v.id, shm_name=shm)
+            # already spilled: ship the storage location, not the
+            # bytes — the worker reads the spill file directly instead
+            # of this path restoring the value into driver memory and
+            # inlining it over the pipe
+            loc = self.store.spill_location(v.id)
+            if loc is not None:
+                return _ObjArg(v.id, spill_loc=loc)
             return _ObjArg(
                 v.id, inline=self.store.get(v.id), has_inline=True
             )

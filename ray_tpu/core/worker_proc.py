@@ -32,13 +32,28 @@ def _resolve_args(args, kwargs, shm_cache):
 class _ObjArg:
     """Marker for an object-store argument passed to a worker."""
 
-    __slots__ = ("obj_id", "shm_name", "inline", "has_inline")
+    __slots__ = (
+        "obj_id", "shm_name", "inline", "has_inline", "spill_loc",
+    )
 
-    def __init__(self, obj_id, shm_name=None, inline=None, has_inline=False):
+    def __init__(
+        self, obj_id, shm_name=None, inline=None, has_inline=False,
+        spill_loc=None,
+    ):
         self.obj_id = obj_id
         self.shm_name = shm_name
         self.inline = inline
         self.has_inline = has_inline
+        # (spill_uri, path): the object lives in spill storage; the
+        # worker reads it from there directly
+        self.spill_loc = spill_loc
+
+    def _read_spill(self, loc):
+        from ray_tpu.core import serialization as ser
+        from ray_tpu.core.external_storage import storage_from_uri
+
+        blob = storage_from_uri(loc[0]).get(loc[1])
+        return ser.read_from_buffer(memoryview(blob))
 
     def load(self, shm_cache: Dict[str, Any]):
         from ray_tpu.core import serialization as ser
@@ -48,6 +63,20 @@ class _ObjArg:
         if self.has_inline:
             shm_cache[self.obj_id] = (None, self.inline)
             return self.inline
+        if self.spill_loc is not None:
+            try:
+                value = self._read_spill(self.spill_loc)
+            except Exception:
+                # spill file gone (freed / restored+evicted between
+                # marshal and here): fall back to a driver-API get
+                from ray_tpu.core.worker_api import worker_client
+
+                client = worker_client()
+                if client is None:
+                    raise
+                value = client.get(self.obj_id, timeout=120.0)
+            shm_cache[self.obj_id] = (None, value)
+            return value
         from ray_tpu.core.object_store import Segment
 
         try:
@@ -68,12 +97,7 @@ class _ObjArg:
             try:
                 loc = client.spill_location(self.obj_id)
                 if loc is not None:
-                    from ray_tpu.core.external_storage import (
-                        storage_from_uri,
-                    )
-
-                    blob = storage_from_uri(loc[0]).get(loc[1])
-                    value = ser.read_from_buffer(memoryview(blob))
+                    value = self._read_spill(loc)
             except Exception:
                 value = None
             if value is None:

@@ -268,10 +268,18 @@ def _gather_spans(spans, *blocks):
     (start, stop) slice of ``blocks[i]``. The workhorse of the
     block-wise reshapes (repartition/split/zip) — row data moves
     worker↔worker through the object plane, never the driver."""
+    return _rows_to_block(
+        _span_rows(spans, blocks), blocks[0] if blocks else None
+    )
+
+
+def _span_rows(spans, blocks) -> List:
+    """Rows of the (start, stop) ranges of several blocks, in order —
+    shared by the span-gather remote helpers."""
     rows: List = []
     for (start, stop), b in builtins.zip(spans, blocks):
         rows.extend(_block_rows(b)[start:stop])
-    return _rows_to_block(rows, blocks[0] if blocks else None)
+    return rows
 
 
 @ray.remote
@@ -279,22 +287,22 @@ def _zip_blocks(a_block, spans, *b_blocks):
     """Pair one left block with the right-hand row ranges covering the
     same global positions (reference dataset.zip's block-aligned
     implementation, dataset.py:1403 area)."""
-    b_rows: List = []
-    for (start, stop), b in builtins.zip(spans, b_blocks):
-        b_rows.extend(_block_rows(b)[start:stop])
-    return list(builtins.zip(_block_rows(a_block), b_rows))
+    return list(
+        builtins.zip(_block_rows(a_block), _span_rows(spans, b_blocks))
+    )
 
 
-def _cover_spans(pos: int, n: int, offsets) -> List:
-    """Which (block_index, local_start, local_stop) ranges cover
-    global rows [pos, pos+n) given cumulative block offsets."""
-    out = []
+def _cover_spans(pos: int, n: int, offsets, refs):
+    """The (start, stop) ranges + their block refs covering global
+    rows [pos, pos+n), ready to splat into a span-gather task."""
+    spans, span_refs = [], []
     for j in range(len(offsets) - 1):
         s, e = int(offsets[j]), int(offsets[j + 1])
         lo, hi = max(pos, s), min(pos + n, e)
         if lo < hi:
-            out.append((j, lo - s, hi - s))
-    return out
+            spans.append((lo - s, hi - s))
+            span_refs.append(refs[j])
+    return spans, span_refs
 
 
 class Dataset:
@@ -430,6 +438,17 @@ class Dataset:
         self._stages = []
         return refs
 
+    def _ref_counts(self):
+        """(refs, per-block row counts), counts cached per refs list
+        (reshapes re-count the same materialized refs otherwise)."""
+        refs = self._materialize_refs()
+        cached = getattr(self, "_block_counts", None)
+        if cached is not None and cached[0] is refs:
+            return refs, cached[1]
+        counts = ray.get([_block_count.remote(r) for r in refs])
+        self._block_counts = (refs, counts)
+        return refs, counts
+
     def _materialize(self) -> List:
         """Blocks as in-memory values (driver-side consumption)."""
         blocks = ray.get(self._materialize_refs())
@@ -520,8 +539,7 @@ class Dataset:
         """Rechunk into ``num_blocks`` blocks WITHOUT materializing on
         the driver: each output block is a span-gather task over the
         input refs (the driver routes counts and refs only)."""
-        refs = self._materialize_refs()
-        counts = ray.get([_block_count.remote(r) for r in refs])
+        refs, counts = self._ref_counts()
         total = sum(counts)
         offsets = np.cumsum([0] + counts)
         num_blocks = max(1, num_blocks)
@@ -532,13 +550,8 @@ class Dataset:
             n = min(size, total - pos)
             if n <= 0:
                 break
-            spans = _cover_spans(pos, n, offsets)
-            out_refs.append(
-                _gather_spans.remote(
-                    [(s, e) for _, s, e in spans],
-                    *[refs[j] for j, _, _ in spans],
-                )
-            )
+            spans, span_refs = _cover_spans(pos, n, offsets, refs)
+            out_refs.append(_gather_spans.remote(spans, *span_refs))
         return Dataset(None, refs=out_refs or [ray.put([])])
 
     def random_shuffle(self, seed: Optional[int] = None) -> "Dataset":
@@ -626,8 +639,7 @@ class Dataset:
         """reference dataset.split: n equal-ish shards (Train wiring),
         block-wise — each shard is a span-gather ref, so rows move
         worker-to-worker, not through the driver."""
-        refs = self._materialize_refs()
-        counts = ray.get([_block_count.remote(r) for r in refs])
+        refs, counts = self._ref_counts()
         total = sum(counts)
         offsets = np.cumsum([0] + counts)
         size = -(-total // n) if total else 0
@@ -638,16 +650,11 @@ class Dataset:
             if m <= 0:
                 shards.append(Dataset([[]]))
                 continue
-            spans = _cover_spans(pos, m, offsets)
+            spans, span_refs = _cover_spans(pos, m, offsets, refs)
             shards.append(
                 Dataset(
                     None,
-                    refs=[
-                        _gather_spans.remote(
-                            [(s, e) for _, s, e in spans],
-                            *[refs[j] for j, _, _ in spans],
-                        )
-                    ],
+                    refs=[_gather_spans.remote(spans, *span_refs)],
                 )
             )
         return shards
@@ -686,10 +693,8 @@ class Dataset:
         is a remote task pairing a left block with the right-hand row
         spans at the same global positions — no driver
         materialization."""
-        a_refs = self._materialize_refs()
-        b_refs = other._materialize_refs()
-        a_counts = ray.get([_block_count.remote(r) for r in a_refs])
-        b_counts = ray.get([_block_count.remote(r) for r in b_refs])
+        a_refs, a_counts = self._ref_counts()
+        b_refs, b_counts = other._ref_counts()
         if sum(a_counts) != sum(b_counts):
             raise ValueError(
                 f"zip needs equal lengths, got {sum(a_counts)} vs "
@@ -699,13 +704,9 @@ class Dataset:
         out_refs = []
         pos = 0
         for aref, n in builtins.zip(a_refs, a_counts):
-            spans = _cover_spans(pos, n, b_offsets)
+            spans, span_refs = _cover_spans(pos, n, b_offsets, b_refs)
             out_refs.append(
-                _zip_blocks.remote(
-                    aref,
-                    [(s, e) for _, s, e in spans],
-                    *[b_refs[j] for j, _, _ in spans],
-                )
+                _zip_blocks.remote(aref, spans, *span_refs)
             )
             pos += n
         return Dataset(None, refs=out_refs or [ray.put([])])
