@@ -975,7 +975,28 @@ def init(
     (reference: ray start --address joining a raylet to the GCS). The
     head enables its listener with
     ``ray_tpu.core.cluster.start_cluster_server()``."""
-    global _runtime
+    global _runtime, _client_mode
+    if address and address.startswith("ray://"):
+        # LIVE remote-driver client (reference ray.util.client,
+        # python/ray/util/client/__init__.py:214): this process keeps
+        # NO runtime — every ray.* verb routes over the driver-API
+        # wire to the head (the same channel nested worker calls use;
+        # core/worker_api.py). The head exposes it with
+        # ``start_client_server()``. Trust model: the channel carries
+        # pickled payloads — loopback/SSH-tunnel or trusted-network
+        # use, like the reference's client server.
+        if _runtime is not None:
+            raise RuntimeError(
+                "ray://: this process already runs a local runtime"
+            )
+        from ray_tpu.core import worker_api
+
+        os.environ[worker_api.ENV_ADDR] = address[len("ray://"):]
+        _client_mode = True
+        client = worker_api.worker_client()
+        if client is None:  # pragma: no cover - env just set
+            raise ConnectionError(f"cannot reach {address}")
+        return {"address": address, "mode": "client"}
     if _runtime is not None:
         if ignore_reinit_error:
             return {"address": "local"}
@@ -1027,6 +1048,20 @@ def init(
     return {"address": "local", "num_cpus": n}
 
 
+def start_client_server(host: str = "127.0.0.1", port: int = 0) -> str:
+    """Expose this head's driver API for ``ray://`` remote drivers
+    (reference ``ray.util.client.server``): returns "host:port" for
+    ``ray_tpu.init(address="ray://host:port")`` in another process or
+    host. Loopback by default; front with an SSH tunnel / trusted
+    network for remote use (pickled payloads ride this channel)."""
+    from ray_tpu.core.worker_api import WorkerAPIServer
+
+    rt = _require_runtime()
+    if getattr(rt, "client_server", None) is None:
+        rt.client_server = WorkerAPIServer(rt, host=host, port=port)
+    return rt.client_server.address
+
+
 def list_jobs(state_path: Optional[str] = None) -> List[Dict]:
     """Jobs recorded in the durable state store — including those of
     PREVIOUS (dead) drivers, which is the point (reference
@@ -1059,12 +1094,20 @@ def list_jobs(state_path: Optional[str] = None) -> List[Dict]:
             store.close()
 
 
+_client_mode = False
+
+
 def is_initialized() -> bool:
-    return _runtime is not None
+    return _runtime is not None or _client_mode
 
 
 def shutdown():
-    global _runtime
+    global _runtime, _client_mode
+    if _client_mode:
+        from ray_tpu.core import worker_api
+
+        os.environ.pop(worker_api.ENV_ADDR, None)
+        _client_mode = False
     if _runtime is not None:
         _runtime.shutdown()
         _runtime = None
@@ -1075,6 +1118,11 @@ atexit.register(shutdown)
 
 def _require_runtime() -> _Runtime:
     if _runtime is None:
+        if _client_mode:
+            raise RuntimeError(
+                "this operation needs the head's runtime and is not "
+                "proxied over the ray:// client channel"
+            )
         init()
     return _runtime
 
@@ -1335,6 +1383,10 @@ def method(num_returns: int = 1, **kwargs):
 
 
 def kill(actor: ActorHandle, *, no_restart: bool = True):
+    client = _ambient_client()
+    if client is not None:
+        client.kill_actor(actor._actor_id, no_restart)
+        return
     rt = _require_runtime()
     rt.kill_actor(actor._actor_id, no_restart)
 
@@ -1419,5 +1471,9 @@ def timeline() -> List[Dict]:
 
 
 def free(refs: Sequence[ObjectRef]):
+    client = _ambient_client()
+    if client is not None:
+        client.free([r.id for r in refs])
+        return
     rt = _require_runtime()
     rt.store.free([r.id for r in refs])

@@ -40,11 +40,11 @@ class WorkerAPIServer:
     """Driver-side listener; one handler thread per worker
     connection."""
 
-    def __init__(self, runtime, host: str = "127.0.0.1"):
+    def __init__(self, runtime, host: str = "127.0.0.1", port: int = 0):
         self.runtime = runtime
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        self._sock.bind((host, 0))
+        self._sock.bind((host, port))
         self._sock.listen()
         self.port = self._sock.getsockname()[1]
         self.address = f"{host}:{self.port}"
@@ -171,6 +171,14 @@ class WorkerAPIServer:
             finally:
                 self._reacquire_cpu(released)
             return {"ok": True, "value": ser.dumps(value)}
+        if op == "kill_actor":
+            rt.kill_actor(
+                msg["actor_id"], bool(msg.get("no_restart", True))
+            )
+            return {"ok": True}
+        if op == "free":
+            rt.store.free(list(msg.get("ids") or ()))
+            return {"ok": True}
         if op == "spill_loc":
             loc = rt.store.spill_location(msg["obj_id"])
             if loc is None:
@@ -391,6 +399,18 @@ class DriverAPIClient:
             }
         )
         return reply["ref_ids"]
+
+    def kill_actor(self, actor_id: str, no_restart: bool = True):
+        self._roundtrip(
+            {
+                "op": "kill_actor",
+                "actor_id": actor_id,
+                "no_restart": no_restart,
+            }
+        )
+
+    def free(self, ids) -> None:
+        self._roundtrip({"op": "free", "ids": list(ids)})
 
     def spill_location(self, obj_id: str):
         """(spill_uri, path) if the object is currently spilled, else

@@ -1,0 +1,74 @@
+"""Live remote-driver client (``ray://`` — reference
+``python/ray/util/client/__init__.py:214``): an interactive driver in
+ANOTHER process connects to the head's client server and drives
+tasks, actors, put/get/wait/kill over the wire, keeping no local
+runtime of its own."""
+
+import os
+import pathlib
+import subprocess
+import sys
+
+import ray_tpu.core.api as ray
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+
+_CLIENT = """
+import sys
+import ray_tpu.core.api as ray
+
+if __name__ == "__main__":
+    info = ray.init(address=sys.argv[1])
+    assert info["mode"] == "client", info
+    assert ray.is_initialized()
+
+    @ray.remote
+    def add(a, b):
+        return a + b
+
+    @ray.remote
+    class Counter:
+        def __init__(self, start):
+            self.x = start
+
+        def bump(self, n):
+            self.x += n
+            return self.x
+
+    # tasks + ref args through the wire
+    r1 = add.remote(1, 2)
+    r2 = add.remote(r1, ray.put(10))
+    assert ray.get(r2) == 13, ray.get(r2)
+    ready, pending = ray.wait([r1, r2], num_returns=2, timeout=30)
+    assert len(ready) == 2 and not pending
+    # stateful actor over the wire
+    c = Counter.remote(5)
+    assert ray.get(c.bump.remote(3)) == 8
+    assert ray.get(c.bump.remote(1)) == 9  # ordered
+    ray.kill(c)
+    ray.free([r1, r2])
+    print("CLIENT_OK", flush=True)
+    ray.shutdown()
+    assert not ray.is_initialized()
+"""
+
+
+def test_remote_driver_over_ray_client(tmp_path):
+    addr = ray.start_client_server()
+    script = tmp_path / "client_driver.py"
+    script.write_text(_CLIENT)
+    env = {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        "PYTHONPATH": f"{REPO}:{os.environ.get('PYTHONPATH', '')}",
+    }
+    out = subprocess.run(
+        [sys.executable, str(script), f"ray://{addr}"],
+        cwd=REPO,
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=180,
+    )
+    assert out.returncode == 0, (out.stdout, out.stderr)
+    assert "CLIENT_OK" in out.stdout
