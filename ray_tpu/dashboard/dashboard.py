@@ -64,29 +64,151 @@ def _cluster_state() -> Dict:
             for rec in rt.actors.values()
         ]
         pending = len(rt.pending)
+    cluster = getattr(rt, "cluster", None)
+    nodes = []
+    if cluster is not None:
+        for node in list(cluster.nodes.values()):
+            nodes.append(
+                {
+                    "node_id": node.node_id,
+                    "num_cpus": node.num_cpus,
+                    "free_cpus": node.free_cpus(),
+                    "actors": len(node.actor_ids),
+                    "dead": node.dead,
+                }
+            )
     return {
         "initialized": True,
         "num_cpus": rt.num_cpus,
         "workers": workers,
         "actors": actors,
         "pending_tasks": pending,
+        "nodes": nodes,
     }
 
 
 _INDEX_HTML = """<!doctype html>
-<html><head><title>ray_tpu dashboard</title></head>
-<body style="font-family: monospace">
-<h2>ray_tpu dashboard-lite</h2>
-<ul>
-<li><a href="/api/cluster">/api/cluster</a> — workers, actors, queue</li>
-<li><a href="/api/results">/api/results</a> — latest training results</li>
-<li><a href="/api/timeline">/api/timeline</a> — chrome-trace events
- (load in chrome://tracing)</li>
-<li><a href="/metrics">/metrics</a> — Prometheus metrics</li>
-<li><a href="/api/jobs">/api/jobs</a> — submitted jobs (POST to
- submit; /api/jobs/&lt;id&gt;, /&lt;id&gt;/logs, POST /&lt;id&gt;/stop)</li>
-</ul>
-</body></html>"""
+<html><head><meta charset="utf-8"><title>ray_tpu dashboard</title>
+<style>
+:root{--surface:#fcfcfb;--panel:#ffffff;--ink:#0b0b0b;--ink2:#52514e;
+      --line:#e4e3df;--series1:#2a78d6}
+@media (prefers-color-scheme: dark){
+:root{--surface:#1a1a19;--panel:#222221;--ink:#ffffff;--ink2:#c3c2b7;
+      --line:#3a3a38;--series1:#3987e5}}
+body{font:13px/1.5 system-ui,sans-serif;background:var(--surface);
+     color:var(--ink);margin:0;padding:20px;max-width:1100px}
+h1{font-size:17px;margin:0 0 4px}
+h2{font-size:13px;color:var(--ink2);font-weight:600;margin:0 0 8px;
+   text-transform:uppercase;letter-spacing:.04em}
+.panel{background:var(--panel);border:1px solid var(--line);
+       border-radius:8px;padding:14px 16px;margin:14px 0}
+.tiles{display:flex;gap:14px;flex-wrap:wrap}
+.tile{flex:1;min-width:120px}
+.tile .v{font-size:24px;font-weight:650;font-variant-numeric:tabular-nums}
+.tile .k{color:var(--ink2);font-size:12px}
+table{border-collapse:collapse;width:100%;font-variant-numeric:tabular-nums}
+th{color:var(--ink2);font-weight:600;text-align:left;font-size:12px}
+th,td{padding:4px 10px 4px 0;border-bottom:1px solid var(--line)}
+tr:last-child td{border-bottom:none}
+a{color:var(--series1);text-decoration:none}
+svg text{fill:var(--ink2);font:11px system-ui,sans-serif}
+.muted{color:var(--ink2)}
+.links{font-size:12px;color:var(--ink2)}
+</style></head><body data-palette="#2a78d6">
+<h1>ray_tpu</h1>
+<div class="links">raw: <a href="/api/cluster">cluster</a> ·
+<a href="/api/results">results</a> · <a href="/api/jobs">jobs</a> ·
+<a href="/api/timeline">timeline</a> (chrome://tracing) ·
+<a href="/metrics">metrics</a></div>
+<div class="panel"><h2>Cluster</h2><div class="tiles" id="tiles"></div></div>
+<div class="panel"><h2>Episode reward — latest run</h2>
+<div id="chart" class="muted">waiting for results…</div></div>
+<div class="panel"><h2>Recent results</h2>
+<div id="results" class="muted">none yet</div></div>
+<div class="panel"><h2>Jobs</h2><div id="jobs" class="muted">none</div></div>
+<script>
+const esc = s => String(s).replace(/[&<>"]/g,
+  c => ({"&":"&amp;","<":"&lt;",">":"&gt;",'"':"&quot;"}[c]));
+function tile(k, v){
+  return `<div class="tile"><div class="v">${esc(v)}</div>` +
+         `<div class="k">${esc(k)}</div></div>`;}
+function sparkline(pts){
+  // single series: no legend (the panel title names it), 2px line,
+  // recessive grid, direct label on the last value, hover via
+  // native <title> per sample point
+  if (pts.length < 2) return "";
+  const W=760, H=150, P=34;
+  const xs = pts.map(p=>p[0]), ys = pts.map(p=>p[1]);
+  const x0=Math.min(...xs), x1=Math.max(...xs);
+  let y0=Math.min(...ys), y1=Math.max(...ys);
+  if (y0===y1){y0-=1;y1+=1;}
+  const X=v=>P+(W-2*P)*(v-x0)/(x1-x0||1);
+  const Y=v=>H-P+(2*P-H)*(v-y0)/(y1-y0);
+  const d = pts.map((p,i)=>(i?"L":"M")+X(p[0]).toFixed(1)+","
+                    +Y(p[1]).toFixed(1)).join(" ");
+  const dots = pts.map(p=>
+    `<circle cx="${X(p[0]).toFixed(1)}" cy="${Y(p[1]).toFixed(1)}"`+
+    ` r="7" fill="transparent"><title>iter ${p[0]}: `+
+    `${p[1].toFixed(2)}</title></circle>`).join("");
+  const last = pts[pts.length-1];
+  return `<svg viewBox="0 0 ${W} ${H}" width="100%" role="img"
+    aria-label="episode reward by iteration">
+    <line x1="${P}" y1="${H-P}" x2="${W-P}" y2="${H-P}"
+      stroke="var(--line)"/>
+    <text x="${P}" y="${H-6}">${x0}</text>
+    <text x="${W-P}" y="${H-6}" text-anchor="end">${x1} iters</text>
+    <text x="4" y="${Y(y1)+4}">${y1.toFixed(1)}</text>
+    <text x="4" y="${Y(y0)+4}">${y0.toFixed(1)}</text>
+    <path d="${d}" fill="none" stroke="var(--series1)"
+      stroke-width="2" stroke-linejoin="round"/>
+    <circle cx="${X(last[0]).toFixed(1)}" cy="${Y(last[1]).toFixed(1)}"
+      r="3.5" fill="var(--series1)"/>
+    <text x="${Math.min(X(last[0])+6, W-2)}" y="${Y(last[1])+4}"
+      >${last[1].toFixed(1)}</text>
+    ${dots}</svg>`;}
+async function refresh(){
+  try{
+    const c = await (await fetch("/api/cluster")).json();
+    document.getElementById("tiles").innerHTML =
+      tile("CPUs", c.num_cpus ?? 0) +
+      tile("workers", (c.workers||[]).filter(w=>!w.dead).length) +
+      tile("actors", (c.actors||[]).filter(a=>!a.dead).length) +
+      tile("pending tasks", c.pending_tasks ?? 0) +
+      tile("fleet nodes", (c.nodes||[]).filter(n=>!n.dead).length);
+  }catch(e){}
+  try{
+    const rs = await (await fetch("/api/results")).json();
+    if (rs.length){
+      const cols = ["training_iteration","episode_reward_mean",
+                    "num_env_steps_sampled","time_total_s"];
+      const rows = rs.slice(-12).reverse().map(r =>
+        "<tr>"+cols.map(k=>{
+          let v = r[k]; if (typeof v === "number") v = v.toFixed(2);
+          return `<td>${esc(v ?? "—")}</td>`;}).join("")+"</tr>");
+      document.getElementById("results").innerHTML =
+        `<table><tr>${cols.map(c=>`<th>${c}</th>`).join("")}</tr>`+
+        rows.join("")+"</table>";
+      const pts = rs.filter(r=>typeof r.episode_reward_mean==="number")
+        .map(r=>[r.training_iteration??0, r.episode_reward_mean]);
+      if (pts.length>1)
+        document.getElementById("chart").innerHTML = sparkline(pts);
+    }
+  }catch(e){}
+  try{
+    const js = await (await fetch("/api/jobs")).json();
+    if (js.length){
+      document.getElementById("jobs").innerHTML =
+        "<table><tr><th>id</th><th>status</th><th>entrypoint</th>"+
+        "<th>logs</th></tr>"+js.map(j=>
+        `<tr><td>${esc(j.submission_id||j.job_id)}</td>`+
+        `<td>${esc(j.status)}</td><td>${esc(j.entrypoint||"")}</td>`+
+        `<td><a href="/api/jobs/${esc(j.submission_id||j.job_id)}`+
+        `/logs">logs</a></td></tr>`).join("")+"</table>";
+    }
+  }catch(e){}
+}
+refresh(); setInterval(refresh, 2000);
+</script></body></html>"""
 
 
 class DashboardLite:
