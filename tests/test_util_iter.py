@@ -1,0 +1,81 @@
+"""ParallelIterator / LocalIterator (reference python/ray/util/iter.py
+and its tests in python/ray/util/tests/test_iter.py)."""
+
+import ray_tpu as ray
+from ray_tpu.util.iter import (
+    LocalIterator,
+    ParallelIterator,
+    from_actors,
+    from_items,
+    from_range,
+)
+
+
+def test_from_items_gather_sync_round_robin():
+    it = from_items(list(range(10)), num_shards=2)
+    assert it.num_shards() == 2
+    got = it.gather_sync().take(10)
+    assert sorted(got) == list(range(10))
+    # round-robin alternates shards: items 0,1 come from different shards
+    assert {got[0], got[1]} == {0, 1}
+
+
+def test_transforms_run_in_shards():
+    it = (
+        from_range(12, num_shards=3)
+        .for_each(lambda x: x * 10)
+        .filter(lambda x: x % 20 == 0)
+    )
+    got = sorted(it.gather_sync().take(12))
+    assert got == [0, 20, 40, 60, 80, 100]
+
+
+def test_batch_and_flatten():
+    it = from_items(list(range(8)), num_shards=2).batch(2)
+    batches = it.gather_sync().take(4)
+    assert all(len(b) == 2 for b in batches)
+    flat = sorted(
+        from_items(list(range(8)), num_shards=2)
+        .batch(2)
+        .flatten()
+        .gather_sync()
+        .take(8)
+    )
+    assert flat == list(range(8))
+
+
+def test_gather_async_completion_order():
+    it = from_range(20, num_shards=4)
+    got = sorted(it.gather_async(num_async=2).take(20))
+    assert got == list(range(20))
+
+
+def test_union_and_local_transforms():
+    a = from_items([1, 2, 3], num_shards=1)
+    b = from_items([10, 20, 30], num_shards=1)
+    got = sorted(a.union(b).gather_sync().take(6))
+    assert got == [1, 2, 3, 10, 20, 30]
+    loc = from_range(6, num_shards=2).gather_sync()
+    got = loc.for_each(lambda x: x + 1).filter(lambda x: x % 2 == 0).take(6)
+    assert sorted(got) == [2, 4, 6]
+
+
+def test_from_actors():
+    @ray.remote
+    class Producer:
+        def __init__(self, base):
+            self.base = base
+            self.i = 0
+
+        def par_iter_next(self):
+            if self.i >= 3:
+                return "__parallel_iterator_stop__"
+            self.i += 1
+            return self.base + self.i
+
+    actors = [Producer.remote(0), Producer.remote(100)]
+    it = from_actors(actors)
+    got = sorted(it.gather_async().take(6))
+    assert got == [1, 2, 3, 101, 102, 103]
+    for a in actors:
+        ray.kill(a)
