@@ -496,6 +496,97 @@ class DQN(Algorithm):
         )
         self._last_target_update = 0
 
+    def _single_update(self, prioritized: bool, kwargs: Dict) -> Dict:
+        """One replay sample + learn round (the classic path), with
+        per-sample PER priority refresh."""
+        config = self.config
+        train_info: Dict = {}
+        train_batch = self.local_replay_buffer.sample(
+            config["train_batch_size"], **kwargs
+        )
+        for pid, b in train_batch.policy_batches.items():
+            policy = self.get_policy(pid)
+            info = policy.learn_on_batch(b)
+            train_info[pid] = info
+            if prioritized:
+                buf = self.local_replay_buffer.buffers[pid]
+                if isinstance(buf, PrioritizedReplayBuffer):
+                    # Per-sample |TD error| refresh (reference
+                    # dqn.py training_step → update_priorities):
+                    # a batch-mean scalar would cancel +/- errors
+                    # and collapse PER to uniform sampling.
+                    # Policies without per-sample errors (e.g.
+                    # continuous-action subclasses) fall back to
+                    # the batch-mean scalar.
+                    if hasattr(policy, "compute_td_error"):
+                        td = policy.compute_td_error(b)
+                    else:
+                        td = np.full(
+                            len(b["batch_indexes"]),
+                            abs(info.get("mean_td_error", 0.0)),
+                        )
+                    buf.update_priorities(
+                        b["batch_indexes"], td + 1e-6
+                    )
+            self._counters[NUM_ENV_STEPS_TRAINED] += b.count
+        return train_info
+
+    def _chained_updates(self, updates: int) -> Dict:
+        """``updates`` uniform-replay SGD rounds back to back. For
+        two-phase JaxPolicy policies the stats fetches defer, so the
+        programs queue on-device and the per-dispatch tunnel latency
+        amortizes across the chain (the training_intensity analog of
+        the async learner thread's pipelining); bounded lag keeps
+        device memory in check. Others loop learn_on_batch."""
+        import jax
+
+        from ray_tpu.policy.jax_policy import JaxPolicy
+
+        config = self.config
+        train_info: Dict = {}
+        for _ in range(updates):
+            train_batch = self.local_replay_buffer.sample(
+                config["train_batch_size"]
+            )
+            for pid, b in train_batch.policy_batches.items():
+                policy = self.get_policy(pid)
+                deferable = isinstance(policy, JaxPolicy) and (
+                    type(policy).learn_on_batch
+                    is JaxPolicy.learn_on_batch
+                ) and (
+                    type(policy).after_learn_on_batch
+                    is JaxPolicy.after_learn_on_batch
+                )
+                if deferable:
+                    tree, bsize = policy.prepare_batch(b)
+                    dev = jax.device_put(
+                        tree, policy.batch_shardings(tree)
+                    )
+                    lazy = policy.learn_on_device_batch(
+                        dev, bsize, defer_stats=True
+                    )
+                    pend = self._pending_stats = getattr(
+                        self, "_pending_stats", []
+                    )
+                    pend.append((pid, lazy))
+                    while len(pend) > 3:  # bounded on-device queue
+                        old_pid, old = pend.pop(0)
+                        stats = jax.device_get(old)
+                        train_info[old_pid] = {
+                            k: float(v) for k, v in stats.items()
+                        }
+                else:
+                    train_info[pid] = policy.learn_on_batch(b)
+                self._counters[NUM_ENV_STEPS_TRAINED] += b.count
+        pend = getattr(self, "_pending_stats", None)
+        while pend:
+            pid, lazy = pend.pop(0)
+            stats = jax.device_get(lazy)
+            train_info[pid] = {
+                k: float(v) for k, v in stats.items()
+            }
+        return train_info
+
     def training_step(self) -> Dict:
         """reference dqn.py:336 (shared off-policy training_step)."""
         config = self.config
@@ -529,34 +620,33 @@ class DQN(Algorithm):
                 if prioritized
                 else {}
             )
-            train_batch = self.local_replay_buffer.sample(
-                config["train_batch_size"], **kwargs
-            )
-            for pid, b in train_batch.policy_batches.items():
-                policy = self.get_policy(pid)
-                info = policy.learn_on_batch(b)
-                train_info[pid] = info
-                if prioritized:
-                    buf = self.local_replay_buffer.buffers[pid]
-                    if isinstance(buf, PrioritizedReplayBuffer):
-                        # Per-sample |TD error| refresh (reference
-                        # dqn.py training_step → update_priorities):
-                        # a batch-mean scalar would cancel +/- errors
-                        # and collapse PER to uniform sampling.
-                        # Policies without per-sample errors (e.g.
-                        # continuous-action subclasses) fall back to
-                        # the batch-mean scalar.
-                        if hasattr(policy, "compute_td_error"):
-                            td = policy.compute_td_error(b)
-                        else:
-                            td = np.full(
-                                len(b["batch_indexes"]),
-                                abs(info.get("mean_td_error", 0.0)),
-                            )
-                        buf.update_priorities(
-                            b["batch_indexes"], td + 1e-6
-                        )
-                self._counters[NUM_ENV_STEPS_TRAINED] += b.count
+            # training_intensity (reference dqn.py calculate_rr_weights
+            # role): desired trained-steps : sampled-steps ratio. The
+            # natural ratio of one update per round is
+            # train_batch/rollout; a higher intensity runs MULTIPLE
+            # replay updates per round — chained with deferred stats so
+            # consecutive SGD programs pipeline on-device and the
+            # per-dispatch latency (dominant on a tunneled TPU)
+            # amortizes across the chain. PER keeps the one-update
+            # path: priorities must refresh between samples.
+            updates = 1
+            ti = config.get("training_intensity")
+            if ti and not prioritized:
+                self._training_debt = (
+                    getattr(self, "_training_debt", 0.0)
+                    + batch.env_steps() * float(ti)
+                )
+                updates = int(
+                    self._training_debt // config["train_batch_size"]
+                )
+                self._training_debt -= (
+                    updates * config["train_batch_size"]
+                )
+            if updates > 1:
+                train_info = self._chained_updates(updates)
+            elif updates == 1:
+                train_info = self._single_update(prioritized, kwargs)
+            # updates == 0: debt still accruing — sample-only round
             # target network sync
             if (
                 self._counters[NUM_ENV_STEPS_TRAINED]

@@ -491,10 +491,15 @@ class SACJaxPolicy(JaxPolicy):
         td = self._td_error_fn(self.params, self.aux_state, batch, rng)
         return np.abs(np.asarray(td))
 
-    def learn_on_device_batch(self, dev_batch, batch_size: int) -> Dict:
+    def learn_on_device_batch(
+        self, dev_batch, batch_size: int, *, defer_stats: bool = False
+    ) -> Dict:
         """SAC's compiled fn threads aux_state (target critic) through the
         update, so phase 2 is overridden; phase 1 (prepare_batch) and
-        learn_on_batch's composition are inherited from JaxPolicy."""
+        learn_on_batch's composition are inherited from JaxPolicy.
+        ``defer_stats`` matches the base contract: skip the blocking
+        stats fetch so chained updates (training_intensity, learner
+        threads) pipeline on-device."""
         fn = self.learn_fn(batch_size)
         self._rng, rng = jax.random.split(self._rng)
         self.params, self.opt_state, self.aux_state, stats = fn(
@@ -502,6 +507,8 @@ class SACJaxPolicy(JaxPolicy):
             rng, {},
         )
         self.num_grad_updates += 1
+        if defer_stats:
+            return stats
         stats = jax.device_get(stats)
         return {k: float(v) for k, v in stats.items()}
 
