@@ -112,19 +112,25 @@ def _sac_halfcheetah():
     return (
         SACConfig()
         .environment("HalfCheetah-v4")
-        # fragment 32 amortizes the per-iteration learner dispatch
-        # (remote-TPU tunnel) while keeping a strong update:env-step
-        # ratio (256-sample batch per 32 steps)
+        # fragment 32 amortizes the rollout round trip; the reference's
+        # 1-update-per-env-step ratio (halfcheetah-sac.yaml fragment 1,
+        # batch 256) is restored via training_intensity=256 — the 32
+        # updates per round fuse into ONE lax.scan dispatch
+        # (sac.py learn_on_stacked_batch), and sample_async overlaps
+        # the next fragment with the update chain
         .rollouts(num_rollout_workers=1, rollout_fragment_length=32)
         .training(
             train_batch_size=256,
             gamma=0.99, tau=0.005,
-            optimization_config={
+            training_intensity=256,
+            num_steps_sampled_before_learning_starts=10000,
+            sample_async=True,
+            optimization={
                 "actor_learning_rate": 3e-4,
                 "critic_learning_rate": 3e-4,
                 "entropy_learning_rate": 3e-4,
             },
-            replay_buffer_config={"capacity": 200000},
+            replay_buffer_config={"capacity": 400000},
         )
         .debugging(seed=0)
     )

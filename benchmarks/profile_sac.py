@@ -1,0 +1,81 @@
+"""Phase-level profile of the SAC e2e path: where does a training_step
+round spend its time? (sampling, replay add/sample, learner dispatch,
+weight sync). Run: python benchmarks/profile_sac.py [--rounds N]
+"""
+
+import sys
+import time
+
+import numpy as np
+
+
+def main():
+    rounds = 20
+    if "--rounds" in sys.argv:
+        rounds = int(sys.argv[sys.argv.index("--rounds") + 1])
+
+    from ray_tpu.algorithms.sac import SACConfig
+
+    config = (
+        SACConfig()
+        .environment("HalfCheetah-v4")
+        .rollouts(num_rollout_workers=1, rollout_fragment_length=32)
+        .training(
+            train_batch_size=256,
+            gamma=0.99, tau=0.005,
+            replay_buffer_config={"capacity": 200000},
+        )
+        .debugging(seed=0)
+    )
+    algo = config.build()
+
+    from ray_tpu.execution.rollout_ops import synchronous_parallel_sample
+
+    cfg = algo.config
+    phases = {"sample": [], "replay_add": [], "replay_sample": [],
+              "learn": [], "sync": []}
+
+    # warm up: fill buffer past learning_starts + compile learn fn
+    print("warmup: filling buffer...", file=sys.stderr)
+    while len(algo.local_replay_buffer) < 2000:
+        b = synchronous_parallel_sample(
+            worker_set=algo.workers, max_env_steps=32)
+        algo.local_replay_buffer.add(b)
+    tb = algo.local_replay_buffer.sample(256)
+    for pid, bb in tb.policy_batches.items():
+        algo.get_policy(pid).learn_on_batch(bb)  # compile
+    print("profiling...", file=sys.stderr)
+
+    for _ in range(rounds):
+        t0 = time.perf_counter()
+        batch = synchronous_parallel_sample(
+            worker_set=algo.workers, max_env_steps=32)
+        t1 = time.perf_counter()
+        algo.local_replay_buffer.add(batch)
+        t2 = time.perf_counter()
+        tb = algo.local_replay_buffer.sample(256)
+        t3 = time.perf_counter()
+        for pid, bb in tb.policy_batches.items():
+            algo.get_policy(pid).learn_on_batch(bb)
+        t4 = time.perf_counter()
+        algo.workers.sync_weights()
+        t5 = time.perf_counter()
+        phases["sample"].append(t1 - t0)
+        phases["replay_add"].append(t2 - t1)
+        phases["replay_sample"].append(t3 - t2)
+        phases["learn"].append(t4 - t3)
+        phases["sync"].append(t5 - t4)
+
+    total = sum(sum(v) for v in phases.values())
+    for k, v in phases.items():
+        ms = 1e3 * np.mean(v)
+        print(f"{k:14s} {ms:8.1f} ms/round  "
+              f"({100*sum(v)/total:5.1f}%)")
+    per_round = total / rounds
+    print(f"total {per_round*1e3:.1f} ms/round -> "
+          f"{32/per_round:.1f} env-steps/s at 1 update per 32 steps")
+    algo.cleanup()
+
+
+if __name__ == "__main__":
+    main()

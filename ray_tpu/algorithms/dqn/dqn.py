@@ -544,6 +544,55 @@ class DQN(Algorithm):
 
         config = self.config
         train_info: Dict = {}
+
+        # Fused path: policies that chain updates device-side
+        # (learn_on_stacked_batch: lax.scan over k updates in ONE
+        # program) get all k batches in a single vectorized replay
+        # gather and a single dispatch — on a tunneled TPU this turns
+        # k round trips into one.
+        pols = {
+            pid: self.get_policy(pid)
+            for pid in self.workers.local_worker().policy_map
+        }
+        bs = int(config["train_batch_size"])
+        if updates > 1 and all(
+            getattr(p, "supports_stacked_learn", False)
+            # stacked dispatch skips prepare_batch's trim/tile, so the
+            # per-update batch must already divide the data shards
+            and bs % max(1, getattr(p, "n_shards", 1)) == 0
+            for p in pols.values()
+        ):
+            left = updates
+            while left > 0:
+                # 32 bounds per-dispatch batch memory; the buffer-size
+                # clamp keeps the k*bs gather inside what the buffer
+                # holds early in training; rounding k down to a power
+                # of two caps the distinct (bs, k) scan compilations
+                # at 6 while the buffer warms up (each is a full XLA
+                # compile — seconds on a tunneled TPU)
+                k = min(
+                    left,
+                    32,
+                    max(1, len(self.local_replay_buffer) // bs),
+                )
+                k = 1 << (k.bit_length() - 1)
+                left -= k
+                train_batch = self.local_replay_buffer.sample(k * bs)
+                for pid, b in train_batch.policy_batches.items():
+                    policy = pols[pid]
+                    tree = policy._batch_to_train_tree(b)
+                    stacked = {
+                        c: v.reshape((k, bs) + v.shape[1:])
+                        for c, v in tree.items()
+                    }
+                    stats = policy.learn_on_stacked_batch(
+                        stacked, k, bs, defer_stats=(left > 0)
+                    )
+                    if left == 0:
+                        train_info[pid] = stats
+                    self._counters[NUM_ENV_STEPS_TRAINED] += b.count
+            return train_info
+
         for _ in range(updates):
             train_batch = self.local_replay_buffer.sample(
                 config["train_batch_size"]
@@ -590,11 +639,35 @@ class DQN(Algorithm):
     def training_step(self) -> Dict:
         """reference dqn.py:336 (shared off-policy training_step)."""
         config = self.config
-        batch = synchronous_parallel_sample(
-            worker_set=self.workers,
-            max_env_steps=config.get("rollout_fragment_length", 4)
-            * max(1, config.get("num_envs_per_worker", 1)),
-        )
+        if config.get("sample_async") and self.workers.remote_workers():
+            # Overlap rollout with learning (reference's sample_async /
+            # Ape-X decoupling): collect the fragment requested LAST
+            # round, then immediately kick off the next one so the
+            # workers sample while the driver replays + updates below.
+            # Behavior weights lag the learner by exactly one round —
+            # standard off-policy staleness.
+            import ray_tpu as _ray
+
+            refs = getattr(self, "_pending_sample_refs", None)
+            if refs is None:
+                refs = [
+                    w.sample.remote()
+                    for w in self.workers.remote_workers()
+                ]
+            batches = _ray.get(refs)
+            self._pending_sample_refs = [
+                w.sample.remote()
+                for w in self.workers.remote_workers()
+            ]
+            from ray_tpu.data.sample_batch import concat_samples
+
+            batch = concat_samples(batches)
+        else:
+            batch = synchronous_parallel_sample(
+                worker_set=self.workers,
+                max_env_steps=config.get("rollout_fragment_length", 4)
+                * max(1, config.get("num_envs_per_worker", 1)),
+            )
         n_step = config.get("n_step", 1)
         if n_step > 1:
             from ray_tpu.data.sample_batch import MultiAgentBatch
@@ -663,7 +736,11 @@ class DQN(Algorithm):
         self.workers.sync_weights(
             global_vars={
                 "timestep": self._counters[NUM_ENV_STEPS_SAMPLED]
-            }
+            },
+            # workers only act: ship the acting subset (SAC: actor
+            # net alone — the full tree pull off a tunneled TPU
+            # otherwise dominates the round)
+            inference_only=True,
         )
         return train_info
 

@@ -200,6 +200,10 @@ class RNNSACJaxPolicy(SACJaxPolicy):
     """Sequence-shaped fused actor/critic/alpha update. Train batches
     are stacked fixed-length sequences (leading dim = sequence)."""
 
+    # sequence batches carry per-chunk recurrent state; keep the
+    # one-update-per-dispatch path
+    supports_stacked_learn = False
+
     def _make_nets(self, pm_cfg, qm_cfg):
         actor = _RNNActorNet(
             self.action_dim,

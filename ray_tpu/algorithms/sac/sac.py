@@ -129,6 +129,26 @@ class SACJaxPolicy(JaxPolicy):
     """Actor/critic/alpha losses fused into one jitted update
     (reference sac_torch_policy.py actor_critic_loss + three optimizers)."""
 
+    # rollout workers act with the actor net alone — don't pull the
+    # critic/target towers off-device every weight sync
+    inference_weight_keys = ("actor",)
+
+    @property
+    def supports_stacked_learn(self) -> bool:
+        """Whether k replay updates may fuse into one lax.scan dispatch
+        (learn_on_stacked_batch). Only safe when the subclass kept
+        THIS class's update body: the fused scan is built from
+        SACJaxPolicy._device_update_fn, so a subclass that replaces
+        _build_learn_fn with its own loss (CQL's min-Q penalty, CRR's
+        weighted regression) must not be chained through it. The
+        recurrent subclass opts out explicitly (sequence state columns
+        need per-chunk handling)."""
+        return (
+            type(self)._build_learn_fn is SACJaxPolicy._build_learn_fn
+            and type(self)._device_update_fn
+            is SACJaxPolicy._device_update_fn
+        )
+
     def __init__(self, observation_space, action_space, config):
         # Bypass JaxPolicy model construction: SAC has its own nets.
         from ray_tpu.policy.policy import Policy
@@ -196,6 +216,7 @@ class SACJaxPolicy(JaxPolicy):
 
         self.coeff_values = {}
         self._learn_fns = {}
+        self._multi_learn_fns = {}
         self._action_fn = None
         self.num_grad_updates = 0
 
@@ -297,7 +318,9 @@ class SACJaxPolicy(JaxPolicy):
         """Per-element validity mask for the losses (None = all)."""
         return None
 
-    def _build_learn_fn(self, batch_size: int):
+    def _device_update_fn(self):
+        """The single-update body shared by the per-batch program and
+        the fused multi-update scan (runs inside shard_map)."""
         actor, critic = self.actor, self.critic
         tx_a, tx_c, tx_al = (
             self._tx_actor,
@@ -307,7 +330,6 @@ class SACJaxPolicy(JaxPolicy):
         gamma, tau = self.gamma**self.n_step, self.tau
         target_entropy = self.target_entropy
         low, high = self.low, self.high
-        mesh = self.mesh
 
         def device_fn(params, opt_state, aux, batch, rng, coeffs):
             obs = batch[SampleBatch.OBS].astype(jnp.float32)
@@ -443,13 +465,82 @@ class SACJaxPolicy(JaxPolicy):
             )
             return new_params, new_opt, new_aux, stats
 
+        return device_fn
+
+    def _build_learn_fn(self, batch_size: int):
+        device_fn = self._device_update_fn()
         sharded = jax.shard_map(
             device_fn,
-            mesh=mesh,
+            mesh=self.mesh,
             in_specs=(P(), P(), P(), P("data"), P(), P()),
             out_specs=(P(), P(), P(), P()),
         )
         return jax.jit(sharded, donate_argnums=(1,))
+
+    def _build_multi_learn_fn(self, batch_size: int, k: int):
+        """K replay updates fused into ONE program: ``lax.scan`` threads
+        (params, opt_state, target) through k sequential updates over a
+        stacked (k, batch, ...) replay sample, so one dispatch (one
+        tunnel round trip, one H2D transfer) buys k SGD steps. This is
+        the TPU-shaped counterpart of the reference's training_intensity
+        update loop (``dqn.py:336`` sample-and-learn rounds), which
+        pays a full dispatch per update."""
+        device_fn = self._device_update_fn()
+
+        def multi_fn(params, opt_state, aux, stacked, rng, coeffs):
+            def body(carry, batch_k):
+                params, opt_state, aux, rng = carry
+                rng, sub = jax.random.split(rng)
+                p, o, a, stats = device_fn(
+                    params, opt_state, aux, batch_k, sub, coeffs
+                )
+                return (p, o, a, rng), stats
+
+            (params, opt_state, aux, _), stats = jax.lax.scan(
+                body, (params, opt_state, aux, rng), stacked
+            )
+            # report the final update's stats (a mean over the chain
+            # would smear k distinct optimization states together)
+            stats = jax.tree_util.tree_map(lambda x: x[-1], stats)
+            return params, opt_state, aux, stats
+
+        sharded = jax.shard_map(
+            multi_fn,
+            mesh=self.mesh,
+            in_specs=(P(), P(), P(), P(None, "data"), P(), P()),
+            out_specs=(P(), P(), P(), P()),
+        )
+        return jax.jit(sharded, donate_argnums=(1,))
+
+    def learn_on_stacked_batch(
+        self,
+        stacked: Dict[str, np.ndarray],
+        k: int,
+        batch_size: int,
+        *,
+        defer_stats: bool = False,
+    ) -> Dict:
+        """Run k fused updates on a host tree of (k, batch, ...) arrays
+        (one vectorized replay gather, reshaped). See
+        :meth:`_build_multi_learn_fn`."""
+        import jax.sharding as jshard
+
+        key = (batch_size, k)
+        fn = self._multi_learn_fns.get(key)
+        if fn is None:
+            fn = self._build_multi_learn_fn(batch_size, k)
+            self._multi_learn_fns[key] = fn
+        sharding = jshard.NamedSharding(self.mesh, P(None, "data"))
+        dev = jax.device_put(stacked, sharding)
+        self._rng, rng = jax.random.split(self._rng)
+        self.params, self.opt_state, self.aux_state, stats = fn(
+            self.params, self.opt_state, self.aux_state, dev, rng, {}
+        )
+        self.num_grad_updates += k
+        if defer_stats:
+            return stats
+        stats = jax.device_get(stats)
+        return {k2: float(v) for k2, v in stats.items()}
 
     def compute_td_error(self, samples) -> np.ndarray:
         """Per-sample |TD error| of the min-twin critic vs the soft TD

@@ -88,7 +88,8 @@ def _cluster_state() -> Dict:
 
 
 _INDEX_HTML = """<!doctype html>
-<html><head><meta charset="utf-8"><title>ray_tpu dashboard</title>
+<html><head><meta charset="utf-8"><meta name="generator" content="dashboard-lite">
+<title>ray_tpu dashboard</title>
 <style>
 :root{--surface:#fcfcfb;--panel:#ffffff;--ink:#0b0b0b;--ink2:#52514e;
       --line:#e4e3df;--series1:#2a78d6}

@@ -352,9 +352,17 @@ class RolloutWorker:
 
     # -- weights & filters ----------------------------------------------
 
-    def get_weights(self, policies: Optional[List[str]] = None) -> Dict:
+    def get_weights(
+        self,
+        policies: Optional[List[str]] = None,
+        inference_only: bool = False,
+    ) -> Dict:
         return {
-            pid: p.get_weights()
+            pid: (
+                p.get_inference_weights()
+                if inference_only
+                else p.get_weights()
+            )
             for pid, p in self.policy_map.items()
             if policies is None or pid in policies
         }

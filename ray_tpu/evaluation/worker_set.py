@@ -96,11 +96,17 @@ class WorkerSet:
         policies: Optional[List[str]] = None,
         global_vars: Optional[Dict] = None,
         to_worker_indices: Optional[List[int]] = None,
+        inference_only: bool = False,
     ) -> None:
-        """reference worker_set.py:192."""
+        """reference worker_set.py:192. ``inference_only`` ships each
+        policy's acting subset (``get_inference_weights``) — on a
+        tunneled TPU the device→host pull of full off-policy towers
+        (critic + target) otherwise dominates the sync."""
         if self._local_worker is None:
             return
-        weights = self._local_worker.get_weights(policies)
+        weights = self._local_worker.get_weights(
+            policies, inference_only=inference_only
+        )
         if self._remote_workers:
             ref = ray.put(weights)
             targets = self._remote_workers

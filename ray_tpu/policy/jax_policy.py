@@ -1096,11 +1096,37 @@ class JaxPolicy(Policy):
         self._init_exploration()
         self._action_fn = None
 
+    # When set (a tuple of top-level param keys), only those subtrees
+    # ship to sampling-only workers on sync_weights(inference_only=True)
+    # — e.g. SAC's actor without its critic/target towers. None = full.
+    inference_weight_keys: Optional[Tuple[str, ...]] = None
+
     def get_weights(self):
         return jax.device_get(self.params)
 
+    def get_inference_weights(self):
+        keys = self.inference_weight_keys
+        if keys is None or not isinstance(self.params, dict):
+            return self.get_weights()
+        return jax.device_get(
+            {k: self.params[k] for k in keys if k in self.params}
+        )
+
     def set_weights(self, weights) -> None:
-        self.params = _tree_to_device(weights, self._param_sharding)
+        if (
+            isinstance(weights, dict)
+            and isinstance(self.params, dict)
+            and set(weights) < set(self.params)
+        ):
+            # partial tree (inference-only sync): merge over the
+            # existing params instead of dropping the absent subtrees
+            merged = dict(self.params)
+            merged.update(
+                _tree_to_device(weights, self._param_sharding)
+            )
+            self.params = merged
+        else:
+            self.params = _tree_to_device(weights, self._param_sharding)
         self.exploration.on_weights_updated(self)
 
     def get_state(self) -> Dict[str, Any]:

@@ -150,6 +150,13 @@ class Policy:
     def get_weights(self) -> Dict[str, np.ndarray]:
         raise NotImplementedError
 
+    def get_inference_weights(self) -> Dict[str, np.ndarray]:
+        """The subset of weights a sampling-only worker needs to act
+        (e.g. SAC ships just the actor net, not critic/target towers).
+        Defaults to the full tree; ``set_weights`` implementations merge
+        partial trees so syncing this subset is always safe."""
+        return self.get_weights()
+
     def set_weights(self, weights) -> None:
         raise NotImplementedError
 

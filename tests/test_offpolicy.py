@@ -296,3 +296,84 @@ def test_training_intensity_multiplies_updates():
         assert np.isfinite(pid_info.get("critic_loss", np.nan)), pid_info
     finally:
         algo.cleanup()
+
+
+def test_sac_fused_multi_update_chain():
+    """SAC chains k replay updates into ONE lax.scan dispatch
+    (learn_on_stacked_batch): k advances num_grad_updates by k, moves
+    the params, and matches the per-update path's semantics (same
+    nets, same losses — only the dispatch granularity differs)."""
+    import gymnasium as gym
+    import jax
+
+    from ray_tpu.algorithms.sac.sac import SACJaxPolicy
+
+    obs_sp = gym.spaces.Box(-1.0, 1.0, (6,), np.float64)
+    act_sp = gym.spaces.Box(-1.0, 1.0, (2,), np.float32)
+    pol = SACJaxPolicy(
+        obs_sp, act_sp, {"seed": 0, "gamma": 0.99, "tau": 0.005}
+    )
+    rng = np.random.default_rng(0)
+    k, bs = 3, 16
+    from ray_tpu.data.sample_batch import SampleBatch as SB
+
+    stacked = {
+        SB.OBS: rng.standard_normal((k, bs, 6)).astype(np.float32),
+        SB.NEXT_OBS: rng.standard_normal((k, bs, 6)).astype(
+            np.float32
+        ),
+        SB.ACTIONS: rng.uniform(-1, 1, (k, bs, 2)).astype(np.float32),
+        SB.REWARDS: rng.standard_normal((k, bs)).astype(np.float32),
+        SB.TERMINATEDS: np.zeros((k, bs), np.float32),
+    }
+    before = jax.device_get(
+        jax.tree_util.tree_leaves(pol.params["critic"])[0]
+    )
+    stats = pol.learn_on_stacked_batch(stacked, k, bs)
+    after = jax.device_get(
+        jax.tree_util.tree_leaves(pol.params["critic"])[0]
+    )
+    assert pol.num_grad_updates == k
+    assert np.isfinite(stats["critic_loss"])
+    assert not np.allclose(before, after)
+
+
+def test_sac_inference_weights_partial_sync():
+    """Sampling-only workers get the actor subtree alone
+    (get_inference_weights) and merge it over their full params —
+    critic/target towers never cross the wire on per-round syncs."""
+    import gymnasium as gym
+    import jax
+
+    from ray_tpu.algorithms.sac.sac import SACJaxPolicy
+
+    obs_sp = gym.spaces.Box(-1.0, 1.0, (4,), np.float64)
+    act_sp = gym.spaces.Box(-1.0, 1.0, (1,), np.float32)
+    learner = SACJaxPolicy(obs_sp, act_sp, {"seed": 0})
+    worker = SACJaxPolicy(obs_sp, act_sp, {"seed": 1})
+
+    w = learner.get_inference_weights()
+    assert set(w) == {"actor"}
+
+    crit_before = jax.device_get(
+        jax.tree_util.tree_leaves(worker.params["critic"])[0]
+    )
+    worker.set_weights(w)
+    crit_after = jax.device_get(
+        jax.tree_util.tree_leaves(worker.params["critic"])[0]
+    )
+    # critic untouched by the partial sync...
+    assert np.allclose(crit_before, crit_after)
+    # ...actor now matches the learner's
+    la = jax.device_get(
+        jax.tree_util.tree_leaves(learner.params["actor"])[0]
+    )
+    wa = jax.device_get(
+        jax.tree_util.tree_leaves(worker.params["actor"])[0]
+    )
+    assert np.allclose(la, wa)
+    # and the worker can still act
+    acts, _, _ = worker.compute_actions(
+        np.zeros((2, 4), np.float32), explore=True
+    )
+    assert acts.shape == (2, 1)
