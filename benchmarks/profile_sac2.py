@@ -1,0 +1,111 @@
+"""Phase-level profile of the FUSED SAC path (training_intensity=256,
+sample_async, actor-only sync): times each phase of a steady-state
+training_step round on the real chip.
+
+Run: python benchmarks/profile_sac2.py
+"""
+
+import sys
+import time
+
+import numpy as np
+
+
+def main():
+    from ray_tpu.algorithms.sac import SACConfig
+
+    algo = (
+        SACConfig()
+        .environment("HalfCheetah-v4")
+        .rollouts(num_rollout_workers=1, rollout_fragment_length=32)
+        .training(
+            train_batch_size=256,
+            training_intensity=256,
+            num_steps_sampled_before_learning_starts=2048,
+            sample_async=True,
+            replay_buffer_config={"capacity": 400000},
+        )
+        .debugging(seed=0)
+        .build()
+    )
+    import ray_tpu as ray
+    from ray_tpu.data.sample_batch import concat_samples
+
+    # warm: fill buffer + compile the fused program
+    print("warm up...", file=sys.stderr)
+    t0 = time.perf_counter()
+    while (
+        len(algo.local_replay_buffer) < 9000
+        or algo._counters.get("num_env_steps_trained", 0) < 4096
+    ):
+        algo.training_step()
+    print(
+        f"warm done in {time.perf_counter()-t0:.0f}s", file=sys.stderr
+    )
+
+    import jax
+
+    pol = algo.get_policy("default_policy")
+    bs = 256
+    k = 32
+    rounds = 15
+    ph = {
+        "collect_prev_sample": [],
+        "replay_add": [],
+        "replay_gather": [],
+        "put+issue (defer)": [],
+        "drain old stats": [],
+        "sync_weights": [],
+    }
+    pend = []
+    for _ in range(rounds):
+        t0 = time.perf_counter()
+        refs = algo._pending_sample_refs
+        batches = ray.get(refs)
+        algo._pending_sample_refs = [
+            w.sample.remote() for w in algo.workers.remote_workers()
+        ]
+        batch = concat_samples(batches)
+        t1 = time.perf_counter()
+        algo.local_replay_buffer.add(batch)
+        t2 = time.perf_counter()
+        tb = algo.local_replay_buffer.sample(k * bs)
+        b = tb.policy_batches["default_policy"]
+        tree = pol._batch_to_train_tree(b)
+        stacked = {
+            c: v.reshape((k, bs) + v.shape[1:])
+            for c, v in tree.items()
+        }
+        t3 = time.perf_counter()
+        lazy = pol.learn_on_stacked_batch(
+            stacked, k, bs, defer_stats=True
+        )
+        pend.append(lazy)
+        t4 = time.perf_counter()
+        while len(pend) > 2:
+            jax.device_get(pend.pop(0))
+        t5 = time.perf_counter()
+        algo.workers.sync_weights(inference_only=True)
+        t6 = time.perf_counter()
+        ph["collect_prev_sample"].append(t1 - t0)
+        ph["replay_add"].append(t2 - t1)
+        ph["replay_gather"].append(t3 - t2)
+        ph["put+issue (defer)"].append(t4 - t3)
+        ph["drain old stats"].append(t5 - t4)
+        ph["sync_weights"].append(t6 - t5)
+
+    total = sum(float(np.median(v)) for v in ph.values())
+    for kk, v in ph.items():
+        med = float(np.median(v))
+        print(
+            f"{kk:22s} {med*1e3:8.1f} ms/round ({100*med/total:5.1f}%)"
+        )
+    print(
+        f"total {total*1e3:.1f} ms/round -> {32/total:.1f} env-steps/s"
+        f" at 1 update/step"
+    )
+    algo.cleanup()
+
+
+if __name__ == "__main__":
+    main()

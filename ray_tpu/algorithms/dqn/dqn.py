@@ -585,11 +585,23 @@ class DQN(Algorithm):
                         c: v.reshape((k, bs) + v.shape[1:])
                         for c, v in tree.items()
                     }
-                    stats = policy.learn_on_stacked_batch(
-                        stacked, k, bs, defer_stats=(left > 0)
+                    # stats defer ACROSS rounds (bounded lag): the
+                    # host never blocks on the chain it just issued,
+                    # so replay gather + rollout collect of round
+                    # r+1 overlap the device compute of round r
+                    lazy = policy.learn_on_stacked_batch(
+                        stacked, k, bs, defer_stats=True
                     )
-                    if left == 0:
-                        train_info[pid] = stats
+                    pend = self._pending_stats = getattr(
+                        self, "_pending_stats", []
+                    )
+                    pend.append((pid, lazy))
+                    while len(pend) > 2:
+                        old_pid, old = pend.pop(0)
+                        st = jax.device_get(old)
+                        train_info[old_pid] = {
+                            kk: float(v) for kk, v in st.items()
+                        }
                     self._counters[NUM_ENV_STEPS_TRAINED] += b.count
             return train_info
 

@@ -219,6 +219,10 @@ class SACJaxPolicy(JaxPolicy):
         self._multi_learn_fns = {}
         self._action_fn = None
         self.num_grad_updates = 0
+        # device-side flattened actor snapshots maintained by the
+        # fused multi-update path for round-trip-free weight sync
+        self._flat_actor_dev = None
+        self._flat_actor_ready = None
 
         # SAC's squashed-Gaussian sampling IS its exploration (the
         # reference uses StochasticSampling for SAC too); the strategy
@@ -502,13 +506,25 @@ class SACJaxPolicy(JaxPolicy):
             # report the final update's stats (a mean over the chain
             # would smear k distinct optimization states together)
             stats = jax.tree_util.tree_map(lambda x: x[-1], stats)
-            return params, opt_state, aux, stats
+            # flattened post-chain actor, computed on device for free:
+            # weight sync reads THIS single vector instead of pulling
+            # the param tree leaf by leaf (each device interaction
+            # pays the full tunnel round trip)
+            flat_actor = jnp.concatenate(
+                [
+                    x.reshape(-1).astype(jnp.float32)
+                    for x in jax.tree_util.tree_leaves(
+                        params["actor"]
+                    )
+                ]
+            )
+            return params, opt_state, aux, stats, flat_actor
 
         sharded = jax.shard_map(
             multi_fn,
             mesh=self.mesh,
             in_specs=(P(), P(), P(), P(None, "data"), P(), P()),
-            out_specs=(P(), P(), P(), P()),
+            out_specs=(P(), P(), P(), P(), P()),
         )
         return jax.jit(sharded, donate_argnums=(1,))
 
@@ -533,14 +549,57 @@ class SACJaxPolicy(JaxPolicy):
         sharding = jshard.NamedSharding(self.mesh, P(None, "data"))
         dev = jax.device_put(stacked, sharding)
         self._rng, rng = jax.random.split(self._rng)
-        self.params, self.opt_state, self.aux_state, stats = fn(
+        (
+            self.params,
+            self.opt_state,
+            self.aux_state,
+            stats,
+            flat_actor,
+        ) = fn(
             self.params, self.opt_state, self.aux_state, dev, rng, {}
         )
+        # rotate the sync source: the PREVIOUS chain's actor (surely
+        # computed by now) serves the next weight sync without waiting
+        # on this chain — one round of staleness, same as sample_async
+        self._flat_actor_ready = getattr(
+            self, "_flat_actor_dev", None
+        )
+        self._flat_actor_dev = flat_actor
         self.num_grad_updates += k
         if defer_stats:
             return stats
         stats = jax.device_get(stats)
         return {k2: float(v) for k2, v in stats.items()}
+
+    def _actor_unflatten(self, vec: np.ndarray):
+        """Host-side inverse of the device-side actor flatten."""
+        leaves, treedef = jax.tree_util.tree_flatten(
+            self.params["actor"]
+        )
+        sizes = [int(np.prod(x.shape)) for x in leaves]
+        parts = np.split(np.asarray(vec), np.cumsum(sizes)[:-1])
+        return jax.tree_util.tree_unflatten(
+            treedef,
+            [
+                p.reshape(x.shape).astype(np.float32)
+                for p, x in zip(parts, leaves)
+            ],
+        )
+
+    def get_inference_weights(self):
+        flat = getattr(self, "_flat_actor_ready", None)
+        if flat is None:
+            flat = getattr(self, "_flat_actor_dev", None)
+        if flat is not None:
+            return {"actor": self._actor_unflatten(jax.device_get(flat))}
+        return super().get_inference_weights()
+
+    def set_weights(self, weights) -> None:
+        # any externally-set params invalidate the device-side flat
+        # actor snapshots the fused path maintains
+        self._flat_actor_dev = None
+        self._flat_actor_ready = None
+        super().set_weights(weights)
 
     def compute_td_error(self, samples) -> np.ndarray:
         """Per-sample |TD error| of the min-twin critic vs the soft TD
@@ -597,6 +656,11 @@ class SACJaxPolicy(JaxPolicy):
             self.params, self.opt_state, self.aux_state, dev_batch,
             rng, {},
         )
+        # single-update path moves the actor without refreshing the
+        # fused path's flat snapshots — drop them so sync can't ship
+        # stale weights
+        self._flat_actor_dev = None
+        self._flat_actor_ready = None
         self.num_grad_updates += 1
         if defer_stats:
             return stats
@@ -614,9 +678,18 @@ class SACJaxPolicy(JaxPolicy):
             SampleBatch.REWARDS,
             SampleBatch.TERMINATEDS,
         ]
-        return {
-            k: np.asarray(samples[k]) for k in keys if k in samples
-        }
+        out = {}
+        for k in keys:
+            if k not in samples:
+                continue
+            v = np.asarray(samples[k])
+            if v.dtype == np.float64:
+                # MuJoCo obs arrive f64; the loss casts to f32 on
+                # device anyway — cast host-side and halve the H2D
+                # bytes
+                v = v.astype(np.float32)
+            out[k] = v
+        return out
 
 
 class SAC(DQN):

@@ -123,6 +123,9 @@ class _Runtime:
         # the map of actors placed on remote agents
         self.cluster = None
         self.remote_actors: Dict[str, Any] = {}
+        # actor_id -> (pg, num_cpus, bundle_index) for actors charged
+        # against a placement-group bundle (released at kill)
+        self._actor_pg_charges: Dict[str, Any] = {}
         # Durable job/actor metadata tables (the gcs_job_manager /
         # gcs_actor_manager storage role, reference
         # gcs/gcs_table_storage.cc): enabled via ray.init(state_path=)
@@ -363,7 +366,11 @@ class _Runtime:
         """Lock held: does the task's resource demand fit right now?"""
         pg = trec.placement_group
         if pg is not None:
-            return pg._fits(trec.num_cpus, trec.bundle_index)
+            # head dispatch only admits against HEAD-hosted bundles;
+            # bundles reserved on fleet agents admit via _try_spill
+            return pg._fits(
+                trec.num_cpus, trec.bundle_index, node_id=None
+            )
         if trec.num_cpus > self.available_cpus + 1e-9:
             return False
         for k, v in trec.resources.items():
@@ -442,9 +449,12 @@ class _Runtime:
 
     def _try_spill(self):
         """Ship queued stateless tasks to fleet agents with free CPU
-        capacity. Only plain CPU tasks spill (placement groups and
-        custom resources stay head-local — agents register CPUs only).
-        Args marshal through the node's once-per-node object pool."""
+        capacity. Plain CPU tasks spill to the freest node;
+        placement-group tasks spill to THE node hosting a fitting
+        bundle (cross-node gang scheduling,
+        ``raylet/placement_group_resource_manager.h`` commit side).
+        Custom-resource tasks stay head-local — agents register CPUs
+        only. Args marshal through the node's once-per-node pool."""
         cluster = getattr(self, "cluster", None)
         if cluster is None:
             return
@@ -458,11 +468,34 @@ class _Runtime:
             with self.lock:
                 for i, t in enumerate(self.pending):
                     if (
-                        t.placement_group is not None
-                        or t.resources
+                        t.resources
                         or t.msg.get("type") != "task"
                         or getattr(t, "orig_args", None) is None
                     ):
+                        continue
+                    pg = t.placement_group
+                    if pg is not None:
+                        # the bundle's node is fixed at reservation:
+                        # admit against it, run on it (CPUs already
+                        # reserved there — no node-ledger charge)
+                        for node in nodes:
+                            if pg._fits(
+                                t.num_cpus,
+                                t.bundle_index,
+                                node_id=node.node_id,
+                            ):
+                                t.acquired_bundle = pg._acquire(
+                                    t.num_cpus,
+                                    t.bundle_index,
+                                    node_id=node.node_id,
+                                )
+                                if t.acquired_bundle >= 0:
+                                    t.pg_spilled = True
+                                    pick = (t, node)
+                                    del self.pending[i]
+                                break
+                        if pick is not None:
+                            break
                         continue
                     node = max(nodes, key=lambda n: n.free_cpus())
                     if node.free_cpus() >= t.num_cpus:
@@ -481,6 +514,14 @@ class _Runtime:
             except BaseException:
                 sent = False
             if not sent:
+                # un-charge before requeue — the retry re-acquires,
+                # and a leaked charge would shrink the bundle forever
+                if getattr(t, "pg_spilled", False):
+                    t.placement_group._release(
+                        t.num_cpus, t.acquired_bundle
+                    )
+                    t.pg_spilled = False
+                    t.acquired_bundle = -1
                 with self.lock:
                     self.pending.appendleft(t)
                 return
@@ -692,9 +733,70 @@ class _Runtime:
             renv_packed = pack_runtime_env(
                 options.get("runtime_env")
             )
+        # placement-group actors: charge a bundle and run ON the
+        # bundle's node (the reference's pg-aware actor scheduling —
+        # gcs_actor_scheduler honoring the bundle's node commit)
+        pg_strategy = options.get("scheduling_strategy")
+        pg = getattr(pg_strategy, "placement_group", None)
+        pg_charge = None
+        if pg is not None:
+            if not pg.ready(timeout=30.0):
+                raise TimeoutError(
+                    f"placement group {pg.id} not ready"
+                )
+            ncpus = (
+                1.0
+                if options.get("num_cpus") is None
+                else float(options["num_cpus"])
+            )
+            bidx = getattr(
+                pg_strategy, "placement_group_bundle_index", -1
+            )
+            bundle, pg_node = pg._acquire_any(ncpus, bidx)
+            if bundle < 0:
+                raise ValueError(
+                    f"placement group {pg.id} cannot admit actor "
+                    f"(num_cpus={ncpus}, bundle_index={bidx})"
+                )
+            pg_charge = (pg, ncpus, bundle)
+            options = dict(options)
+            if pg_node is not None:
+                # agent bundle: pin there; CPUs are paid by the pg
+                # ledger, not the node's actor ledger
+                options["placement_node"] = pg_node
+                options["pg_charged"] = True
+        if pg_charge is not None:
+            # any failure between the charge and a registered actor
+            # (duplicate name, node send error, unpicklable class)
+            # must give the bundle back or the group bleeds capacity
+            try:
+                return self._create_actor_placed(
+                    cls, args, kwargs, options, renv_packed,
+                    pg_charge,
+                )
+            except BaseException:
+                pgx, ncpusx, bundlex = pg_charge
+                for aid, ch in list(
+                    self._actor_pg_charges.items()
+                ):
+                    if ch is pg_charge:
+                        self._actor_pg_charges.pop(aid, None)
+                pgx._release(ncpusx, bundlex)
+                raise
+        return self._create_actor_placed(
+            cls, args, kwargs, options, renv_packed, None
+        )
+
+    def _create_actor_placed(
+        self, cls, args, kwargs, options, renv_packed, pg_charge
+    ) -> "ActorHandle":
         node_name = options.get("placement_node")
+        pg = (
+            pg_charge[0] if pg_charge is not None else None
+        )
         if (
             node_name is None
+            and pg is None  # pg decides placement, not saturation
             and self.cluster is not None
             and self._local_actor_saturated(options)
         ):
@@ -740,6 +842,8 @@ class _Runtime:
                             name, actor_id, cls.__name__
                         )
                     self.remote_actors[actor_id] = node
+                    if pg_charge is not None:
+                        self._actor_pg_charges[actor_id] = pg_charge
                 node.create_actor(
                     actor_id, cls, r_args, r_kwargs, options
                 )
@@ -776,6 +880,8 @@ class _Runtime:
             options.get("max_restarts", 0),
             daemon=bool(options.get("daemon", True)),
         )
+        if pg_charge is not None:
+            self._actor_pg_charges[actor_id] = pg_charge
         rec.num_cpus = (
             1.0
             if options.get("num_cpus") is None
@@ -878,6 +984,10 @@ class _Runtime:
         return refs
 
     def kill_actor(self, actor_id: str, no_restart: bool = True):
+        charge = self._actor_pg_charges.pop(actor_id, None)
+        if charge is not None:
+            pg, ncpus, bundle = charge
+            pg._release(ncpus, bundle)
         node = self.remote_actors.pop(actor_id, None)
         if node is not None:
             node.kill(actor_id)

@@ -94,6 +94,116 @@ def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
 
 
 # ---------------------------------------------------------------------------
+# Peer-to-peer object pulls (per-node data plane)
+# ---------------------------------------------------------------------------
+
+# pooled authenticated connections to peer data servers:
+# (host, port) -> (socket, request_lock)
+_peer_conns: Dict = {}
+_peer_conns_lock = threading.Lock()
+
+
+def _open_peer_conn(host: str, port: int):
+    """Connect + authenticate against a node data server (same
+    challenge/HMAC handshake as head registration — a pull response
+    is full-pickle on the consumer, so only authenticated cluster
+    members may serve one)."""
+    sock = socket.create_connection((host, int(port)), timeout=30.0)
+    sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    challenge = _recv_frame(sock, max_len=_MAX_HANDSHAKE_FRAME)
+    if (
+        not isinstance(challenge, dict)
+        or challenge.get("op") != "challenge"
+    ):
+        sock.close()
+        raise ConnectionError("data server sent no challenge")
+    auth = {"op": "pull_auth", "nonce": challenge.get("nonce", "")}
+    token = wire.cluster_token()
+    if token is not None:
+        auth["hmac"] = wire.register_hmac(token, auth)
+    lock = threading.Lock()
+    _send_frame(sock, lock, auth)
+    resp = _recv_frame(sock, max_len=_MAX_HANDSHAKE_FRAME)
+    if not isinstance(resp, dict) or not resp.get("ok"):
+        sock.close()
+        raise ConnectionError("data server rejected pull auth")
+    sock.settimeout(None)
+    return sock, lock
+
+
+def fetch_remote_object(host: str, port: int, obj_id: str) -> bytes:
+    """Pull one object's serialized bytes from a node data server.
+    Connections are pooled per (host, port); one transient failure
+    gets a fresh-connection retry, then the object is reported lost
+    (the caller maps that to an object-lost error)."""
+    key = (str(host), int(port))
+    last_err: Optional[Exception] = None
+    for attempt in range(2):
+        with _peer_conns_lock:
+            entry = _peer_conns.get(key)
+        try:
+            if entry is None:
+                entry = _open_peer_conn(*key)
+                with _peer_conns_lock:
+                    _peer_conns[key] = entry
+            sock, lock = entry
+            with lock:  # request/response pairs must not interleave
+                _send_frame(
+                    sock,
+                    threading.Lock(),
+                    {"op": "pull", "obj_id": obj_id},
+                )
+                resp = _recv_frame(sock)
+        except (OSError, wire.ControlFrameError) as err:
+            last_err = err
+            with _peer_conns_lock:
+                if _peer_conns.get(key) is entry:
+                    _peer_conns.pop(key, None)
+            if entry is not None:
+                try:
+                    entry[0].close()
+                except OSError:
+                    pass
+            continue
+        if resp is None:
+            last_err = ConnectionError("data server closed mid-pull")
+            with _peer_conns_lock:
+                if _peer_conns.get(key) is entry:
+                    _peer_conns.pop(key, None)
+            continue
+        if not resp.get("ok"):
+            raise KeyError(
+                f"object {obj_id} not held by {host}:{port}: "
+                f"{resp.get('error', 'unknown')}"
+            )
+        return resp["payload"]
+    raise ConnectionError(
+        f"pull of {obj_id} from {host}:{port} failed: {last_err}"
+    )
+
+
+def _node_obj_id(obj_id: str) -> str:
+    """Key under which a node-resident object's serialized bytes live
+    in the producing agent's LOCAL store (so the agent's LRU/spill
+    machinery manages them like any local object)."""
+    return f"nodeobj_{obj_id}"
+
+
+def node_obj_min_bytes() -> int:
+    """Result-size threshold (bytes) above which fleet task/actor
+    results stay node-resident (metadata to the head, bytes served
+    peer-to-peer). <=0 disables the node data plane."""
+    try:
+        return int(
+            os.environ.get(
+                "RAY_TPU_NODE_OBJ_MIN_BYTES", 4 * 1024 * 1024
+            )
+        )
+    except ValueError:
+        return 4 * 1024 * 1024
+
+
+# ---------------------------------------------------------------------------
 # Head side
 # ---------------------------------------------------------------------------
 
@@ -105,17 +215,33 @@ class _PoolObj:
     agent resolves it from its cache (the reference's pull-once-per-
     node plasma transfer, ``object_manager/object_manager.h:114``,
     scoped to head-owned objects). Weight broadcast to K actors on one
-    agent therefore moves ONE copy over TCP, not K."""
+    agent therefore moves ONE copy over TCP, not K.
 
-    __slots__ = ("obj_id", "value", "has_value")
+    ``location=(host, port)`` marks a NODE-RESIDENT object: the value
+    never passed through the head — the consuming agent pulls it
+    straight from the producing node's data server (the reference's
+    peer-to-peer chunked pull, ``object_manager/pull_manager.h:47``)
+    and caches it like a pooled value."""
 
-    def __init__(self, obj_id: str, value=None, has_value: bool = False):
+    __slots__ = ("obj_id", "value", "has_value", "location")
+
+    def __init__(
+        self,
+        obj_id: str,
+        value=None,
+        has_value: bool = False,
+        location=None,
+    ):
         self.obj_id = obj_id
         self.value = value
         self.has_value = has_value
+        self.location = location
 
     def __reduce__(self):
-        return (_PoolObj, (self.obj_id, self.value, self.has_value))
+        return (
+            _PoolObj,
+            (self.obj_id, self.value, self.has_value, self.location),
+        )
 
 
 class RemoteNode:
@@ -123,11 +249,24 @@ class RemoteNode:
     role). Owns the connection; a recv thread routes results into the
     head's object store."""
 
-    def __init__(self, runtime, node_id: str, num_cpus: int, sock):
+    def __init__(
+        self,
+        runtime,
+        node_id: str,
+        num_cpus: int,
+        sock,
+        data_host: Optional[str] = None,
+        data_port: Optional[int] = None,
+    ):
         self.runtime = runtime
         self.node_id = node_id
         self.num_cpus = num_cpus
         self.sock = sock
+        # the agent's data-server endpoint (None = agent predates the
+        # node data plane / disabled): node-resident results resolve
+        # against this address
+        self.data_host = data_host
+        self.data_port = data_port
         self.send_lock = threading.Lock()
         self.actor_ids: set = set()
         # guards inflight + dead against the call()/_on_disconnect()
@@ -142,11 +281,17 @@ class RemoteNode:
         # CPUs of dedicated actors placed on this node (spillover
         # capacity accounting shares one ledger with spilled tasks)
         self.actor_cpus: Dict[str, float] = {}
+        # placement-group bundle reservations on this node
+        # (util/placement_group 2PC prepare): pg_id -> CPUs
+        self.pg_cpus: Dict[str, float] = {}
         # object-pool bookkeeping: ids whose value this node already
         # holds (see _PoolObj). _ship_lock serializes the
         # check-and-send so a concurrent marshal of the same ref can
         # never emit an id-only marker ahead of the value frame.
         self.shipped_objs: set = set()
+        # ids whose PRIMARY copy lives on this node (node-resident
+        # results): freed ids in either set are forwarded to the agent
+        self.owned_objs: set = set()
         self._ship_lock = threading.Lock()
         self.dead = False
         self._thread = threading.Thread(
@@ -172,8 +317,16 @@ class RemoteNode:
                 with self.state_lock:
                     self.inflight.pop(task_id, None)
                     trec = self.task_recs.pop(task_id, None)
-                    if trec is not None:
+                    if trec is not None and not getattr(
+                        trec, "pg_spilled", False
+                    ):
                         self.inflight_cpus -= trec.num_cpus
+                if trec is not None and getattr(
+                    trec, "pg_spilled", False
+                ):
+                    trec.placement_group._release(
+                        trec.num_cpus, trec.acquired_bundle
+                    )
                 if trec is not None and self.runtime.pending:
                     # capacity freed: queued tasks may spill now —
                     # wake the cluster's single dispatcher thread (a
@@ -184,11 +337,28 @@ class RemoteNode:
                     if cluster is not None:
                         cluster.kick_dispatch()
                 if msg.get("ok"):
-                    self.runtime.store.put(
-                        task_id,
-                        ser.loads(msg["payload"]),
-                        use_shm=False,
-                    )
+                    node_obj = msg.get("node_obj")
+                    if node_obj is not None and self.data_port:
+                        # bytes stayed on the agent: record the
+                        # location only (per-node data plane) — the
+                        # head pulls iff something here reads the ref
+                        with self.state_lock:
+                            self.owned_objs.add(task_id)
+                        self.runtime.store.put_remote(
+                            task_id,
+                            {
+                                "node_id": self.node_id,
+                                "host": self.data_host,
+                                "port": self.data_port,
+                                "size": int(node_obj.get("size", 0)),
+                            },
+                        )
+                    else:
+                        self.runtime.store.put(
+                            task_id,
+                            ser.loads(msg["payload"]),
+                            use_shm=False,
+                        )
                 else:
                     from ray_tpu.core.api import RayTaskError
 
@@ -218,8 +388,67 @@ class RemoteNode:
             self.task_recs.clear()
             self.inflight_cpus = 0.0
             self.shipped_objs.clear()
+            # node-resident objects die with the node: their entries
+            # keep the stale location and a later read surfaces an
+            # object-lost error from the failed pull
+            self.owned_objs.clear()
+        # mark placement-group bundles hosted here as lost BEFORE
+        # re-queueing anything: a task whose bundle died must error,
+        # not park in the queue forever (nothing can ever admit it)
+        try:
+            from ray_tpu.util.placement_group import _GROUPS
+
+            affected = [
+                pg
+                for pg in list(_GROUPS.values())
+                if pg.node_lost(self.node_id)
+            ]
+        except Exception:
+            affected = []
+        if affected:
+            doomed = []
+            with self.runtime.lock:
+                for t in list(self.runtime.pending):
+                    if t.placement_group in affected and (
+                        not t.placement_group.has_live_bundle(
+                            t.num_cpus, t.bundle_index
+                        )
+                    ):
+                        self.runtime.pending.remove(t)
+                        doomed.append(t)
+            for t in doomed:
+                self.runtime.store.put_error(
+                    t.task_id,
+                    RayActorError(
+                        f"placement group {t.placement_group.id} "
+                        f"bundle host {self.node_id} died"
+                    ),
+                )
         for task_id in pending:
             trec = task_recs.get(task_id)
+            if trec is not None and getattr(
+                trec, "pg_spilled", False
+            ):
+                # give the bundle back; if no live bundle can ever
+                # re-admit this task, fail it now instead of letting
+                # the retry path park it forever
+                trec.placement_group._release(
+                    trec.num_cpus, trec.acquired_bundle
+                )
+                trec.pg_spilled = False
+                trec.acquired_bundle = -1
+                if not trec.placement_group.has_live_bundle(
+                    trec.num_cpus, trec.bundle_index
+                ):
+                    self.runtime.store.put_error(
+                        task_id,
+                        RayActorError(
+                            "placement group "
+                            f"{trec.placement_group.id} bundle host "
+                            f"{self.node_id} died mid-task"
+                        ),
+                    )
+                    continue
             if trec is not None and trec.retries_left > 0:
                 trec.retries_left -= 1
                 try:
@@ -253,6 +482,26 @@ class RemoteNode:
 
         def m(v):
             if isinstance(v, ObjectRef):
+                # node-resident object: never route its bytes through
+                # the head — the consuming agent reads it locally (if
+                # it produced it) or pulls peer-to-peer (the
+                # reference's object_manager pull, pull_manager.h:47)
+                loc = self.runtime.store.remote_loc(v.id)
+                if loc is not None:
+                    if loc.get("node_id") == self.node_id:
+                        return _PoolObj(v.id)
+                    # the consumer caches the pulled value like a
+                    # pooled one — track it so free_objs reaches its
+                    # cache too (location rides every marker: pulls
+                    # are idempotent and this dodges the cross-thread
+                    # marshal/send ordering race an id-only marker
+                    # would reintroduce)
+                    with self._ship_lock:
+                        self.shipped_objs.add(v.id)
+                    return _PoolObj(
+                        v.id,
+                        location=(loc["host"], loc["port"]),
+                    )
                 with self._ship_lock:
                     if v.id not in self.shipped_objs:
                         value = self.runtime.store.get(
@@ -277,8 +526,13 @@ class RemoteNode:
         """Head freed these object ids: drop them from the agent's
         cache (and our bookkeeping) so the pool can't grow unbounded."""
         with self.state_lock:
-            held = [i for i in ids if i in self.shipped_objs]
+            held = [
+                i
+                for i in ids
+                if i in self.shipped_objs or i in self.owned_objs
+            ]
             self.shipped_objs.difference_update(held)
+            self.owned_objs.difference_update(held)
             if self.dead or not held:
                 return
         try:
@@ -296,12 +550,16 @@ class RemoteNode:
         """Ship a queued stateless task to this agent; False if the
         node is dead (caller keeps it queued)."""
         task_id = trec.task_id
+        pg_spilled = getattr(trec, "pg_spilled", False)
         with self.state_lock:
             if self.dead:
                 return False
             self.inflight[task_id] = 1
             self.task_recs[task_id] = trec
-            self.inflight_cpus += trec.num_cpus
+            # placement-group tasks are already paid for by the
+            # bundle's pg_cpus reservation on this node
+            if not pg_spilled:
+                self.inflight_cpus += trec.num_cpus
         try:
             _send_frame(
                 self.sock,
@@ -321,7 +579,10 @@ class RemoteNode:
             with self.state_lock:
                 self.inflight.pop(task_id, None)
                 self.task_recs.pop(task_id, None)
-                self.inflight_cpus -= trec.num_cpus
+                if not pg_spilled:
+                    self.inflight_cpus -= trec.num_cpus
+            # bundle release happens in _try_spill's not-sent path
+            # (single owner for the un-charge, whatever failed)
             return False
         return True
 
@@ -331,7 +592,32 @@ class RemoteNode:
                 self.num_cpus
                 - self.inflight_cpus
                 - sum(self.actor_cpus.values())
+                - sum(self.pg_cpus.values())
             )
+
+    def pg_reserve(self, pg_id: str, cpus: float) -> bool:
+        """Prepare phase of a placement-group bundle reservation:
+        atomically claim ``cpus`` out of this node's spillover
+        capacity (False = insufficient — the group rolls back)."""
+        with self.state_lock:
+            if self.dead:
+                return False
+            free = (
+                self.num_cpus
+                - self.inflight_cpus
+                - sum(self.actor_cpus.values())
+                - sum(self.pg_cpus.values())
+            )
+            if free + 1e-9 < cpus:
+                return False
+            self.pg_cpus[pg_id] = (
+                self.pg_cpus.get(pg_id, 0.0) + cpus
+            )
+            return True
+
+    def pg_release(self, pg_id: str) -> None:
+        with self.state_lock:
+            self.pg_cpus.pop(pg_id, None)
 
     # -- actor ops -------------------------------------------------------
 
@@ -360,8 +646,13 @@ class RemoteNode:
         self.actor_ids.add(actor_id)
         req = options.get("num_cpus")
         with self.state_lock:
+            # pg-charged actors are paid by the bundle's pg_cpus
+            # reservation; charging the actor ledger too would count
+            # the same CPUs twice
             self.actor_cpus[actor_id] = (
-                1.0 if req is None else float(req)
+                0.0
+                if options.get("pg_charged")
+                else (1.0 if req is None else float(req))
             )
 
     def call(self, actor_id, method, args, kwargs, num_returns):
@@ -533,11 +824,18 @@ class ClusterServer:
             conn.close()
             return
         conn.settimeout(None)
+        data_port = msg.get("data_port") or None
         node = RemoteNode(
             self.runtime,
             str(msg["node_id"]),
             int(msg.get("num_cpus", 1)),
             conn,
+            # the agent's data server listens on the same interface it
+            # reached us from
+            data_host=(
+                conn.getpeername()[0] if data_port else None
+            ),
+            data_port=int(data_port) if data_port else None,
         )
         self.nodes[str(msg["node_id"])] = node
         _send_frame(
@@ -669,6 +967,18 @@ class NodeAgent:
         # until the head's free_objs — mirrored plasma pinning)
         self._obj_cache: Dict[str, Any] = {}
         self._obj_cache_lock = threading.Lock()
+        # per-node data plane: results >= this many serialized bytes
+        # stay HERE (in this runtime's store, under its LRU/spill
+        # budget) and only a location frame goes to the head;
+        # consumers pull from the data server below
+        self._node_obj_min = node_obj_min_bytes()
+        self._data_sock: Optional[socket.socket] = None
+        self._data_port: Optional[int] = None
+        if self._node_obj_min > 0:
+            try:
+                self._start_data_server()
+            except OSError:
+                self._data_port = None  # plane off, results inline
         challenge = _recv_frame(self.sock)
         if not isinstance(challenge, dict) or challenge.get("op") != (
             "challenge"
@@ -682,6 +992,8 @@ class NodeAgent:
             "num_cpus": self.num_cpus,
             "nonce": challenge.get("nonce", ""),
         }
+        if self._data_port:
+            reg["data_port"] = self._data_port
         token = wire.cluster_token()
         if token is not None:
             reg["hmac"] = wire.register_hmac(token, reg)
@@ -695,6 +1007,82 @@ class NodeAgent:
             target=self._serve_loop, daemon=True, name="node_agent"
         )
         self._thread.start()
+
+    # -- node data plane --------------------------------------------------
+
+    def _start_data_server(self) -> None:
+        """Bind the per-node object data server (the reference's
+        object-manager listen endpoint, ``object_manager.h:114``):
+        peers and the head pull node-resident objects here, straight
+        from this runtime's store — the head never proxies the bytes."""
+        srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        srv.bind(("0.0.0.0", 0))
+        srv.listen(16)
+        self._data_sock = srv
+        self._data_port = srv.getsockname()[1]
+        threading.Thread(
+            target=self._data_accept_loop,
+            daemon=True,
+            name="node_data_server",
+        ).start()
+
+    def _data_accept_loop(self) -> None:
+        while True:
+            try:
+                conn, _ = self._data_sock.accept()
+            except OSError:
+                return
+            threading.Thread(
+                target=self._data_conn_loop,
+                args=(conn,),
+                daemon=True,
+                name="node_data_conn",
+            ).start()
+
+    def _data_conn_loop(self, conn: socket.socket) -> None:
+        """One peer connection: challenge/HMAC auth (same trust wall
+        as head registration — pulls deserialize as full pickle on the
+        consumer), then serve pull requests until the peer leaves."""
+        lock = threading.Lock()
+        try:
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            conn.settimeout(10.0)
+            nonce = uuid.uuid4().hex
+            _send_frame(
+                conn, lock, {"op": "challenge", "nonce": nonce}
+            )
+            msg = _recv_frame(conn, max_len=_MAX_HANDSHAKE_FRAME)
+            if (
+                not isinstance(msg, dict)
+                or msg.get("op") != "pull_auth"
+                or msg.get("nonce") != nonce
+                or not wire.register_ok(wire.cluster_token(), msg)
+            ):
+                conn.close()
+                return
+            _send_frame(conn, lock, {"ok": True})
+            conn.settimeout(None)
+            while True:
+                req = _recv_frame(conn, max_len=_MAX_HANDSHAKE_FRAME)
+                if not isinstance(req, dict) or req.get("op") != "pull":
+                    return
+                obj_id = str(req.get("obj_id", ""))
+                try:
+                    payload = self.runtime.store.get(
+                        _node_obj_id(obj_id), timeout=0
+                    )
+                    resp = {"ok": True, "payload": payload}
+                except Exception as err:
+                    resp = {"ok": False, "error": repr(err)}
+                _send_frame(conn, lock, resp)
+        except (OSError, wire.ControlFrameError):
+            pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
 
     def _serve_loop(self):
         while True:
@@ -717,19 +1105,20 @@ class NodeAgent:
                         tb=traceback.format_exc(),
                     )
 
-    def _send_result(self, task_id, *, ok, payload=b"", name="", tb=""):
-        _send_frame(
-            self.sock,
-            self.send_lock,
-            {
-                "op": "result",
-                "task_id": task_id,
-                "ok": ok,
-                "payload": payload,
-                "name": name,
-                "traceback": tb,
-            },
-        )
+    def _send_result(
+        self, task_id, *, ok, payload=b"", name="", tb="", node_obj=None
+    ):
+        frame = {
+            "op": "result",
+            "task_id": task_id,
+            "ok": ok,
+            "payload": payload,
+            "name": name,
+            "traceback": tb,
+        }
+        if node_obj is not None:
+            frame["node_obj"] = node_obj
+        _send_frame(self.sock, self.send_lock, frame)
 
     def _send_value_result(self, task_id, value, name: str) -> None:
         """Serialize + send a success result, downgrading failures:
@@ -753,13 +1142,31 @@ class NodeAgent:
                 pass
             return
         try:
-            self._send_result(task_id, ok=True, payload=payload)
+            if (
+                self._data_port
+                and len(payload) >= self._node_obj_min
+            ):
+                # big result: keep the bytes in THIS node's store
+                # (LRU/spill managed) and send the head metadata only
+                # — whoever reads the ref pulls from our data server
+                self.runtime.store.put(
+                    _node_obj_id(task_id), payload
+                )
+                self._send_result(
+                    task_id,
+                    ok=True,
+                    node_obj={"size": len(payload)},
+                )
+            else:
+                self._send_result(task_id, ok=True, payload=payload)
         except OSError:
             pass  # head gone; its recv loop handles the disconnect
 
     def _resolve_pool_args(self, args, kwargs):
         """Map :class:`_PoolObj` markers to values via the node cache
-        (top-level args only — the same scope the head marshals)."""
+        (top-level args only — the same scope the head marshals).
+        Resolution order: inline value > node cache > this node's own
+        data plane (we produced it) > peer pull (``location``)."""
 
         def r(v):
             if isinstance(v, _PoolObj):
@@ -769,6 +1176,22 @@ class NodeAgent:
                         return v.value
                     if v.obj_id in self._obj_cache:
                         return self._obj_cache[v.obj_id]
+                blob = None
+                try:
+                    blob = self.runtime.store.get(
+                        _node_obj_id(v.obj_id), timeout=0
+                    )
+                except Exception:
+                    blob = None
+                if blob is None and v.location is not None:
+                    blob = fetch_remote_object(
+                        v.location[0], v.location[1], v.obj_id
+                    )
+                if blob is not None:
+                    value = ser.loads(blob)
+                    with self._obj_cache_lock:
+                        self._obj_cache[v.obj_id] = value
+                    return value
                 raise KeyError(
                     f"object {v.obj_id} not in node cache (freed at "
                     "head while a call naming it was in flight?)"
@@ -794,9 +1217,14 @@ class NodeAgent:
             with self._obj_cache_lock:
                 self._obj_cache[msg["obj_id"]] = value
         elif op == "free_objs":
+            ids = list(msg.get("ids", ()))
             with self._obj_cache_lock:
-                for i in msg.get("ids", ()):
+                for i in ids:
                     self._obj_cache.pop(i, None)
+            # node-resident primaries we produced die with the ref
+            self.runtime.store.free(
+                [_node_obj_id(i) for i in ids]
+            )
         elif op == "task":
             task_id = msg["task_id"]
             func_blob = msg["func"]
@@ -900,6 +1328,11 @@ class NodeAgent:
             self.sock.close()
         except OSError:
             pass
+        if self._data_sock is not None:
+            try:
+                self._data_sock.close()
+            except OSError:
+                pass
 
 
 def main():  # pragma: no cover - thin CLI

@@ -139,6 +139,7 @@ class _Entry:
         "callbacks",
         "spill_path",
         "_restore_buf",
+        "remote_loc",
     )
 
     def __init__(self):
@@ -149,6 +150,10 @@ class _Entry:
         self.callbacks = []
         self.spill_path: Optional[str] = None
         self._restore_buf = None
+        # primary copy lives on a fleet node: {"node_id", "host",
+        # "port", "size"} — the value is pulled from the node's data
+        # server only if THIS process actually reads it
+        self.remote_loc: Optional[Dict] = None
 
     def fire(self):
         self.event.set()
@@ -272,6 +277,51 @@ class ObjectStore:
         e.error = err
         e.fire()
 
+    def put_remote(self, obj_id: str, loc: Dict) -> None:
+        """Mark the object ready with its primary copy NODE-RESIDENT
+        (reference: per-node plasma + object directory,
+        ``object_manager/object_manager.h:114`` — the owner records a
+        location, not bytes). Waiters wake immediately; the bytes only
+        cross to this process if ``get`` is actually called, via a
+        direct pull from the node's data server."""
+        e = self._entry(obj_id)
+        e.remote_loc = dict(loc)
+        e.fire()
+
+    def remote_loc(self, obj_id: str) -> Optional[Dict]:
+        """Location descriptor when the primary copy is node-resident
+        (None once materialized locally or for head-resident objects).
+        The cluster plane uses this to marshal args as pull-from-peer
+        markers instead of routing bytes through the driver."""
+        with self._lock:
+            e = self._entries.get(obj_id)
+            if e is None or e.value is not None or e.shm is not None:
+                return None
+            return e.remote_loc
+
+    def _materialize_remote(self, obj_id: str, e: _Entry) -> None:
+        """Pull a node-resident object's bytes from its data server
+        (outside the store lock — network). Concurrent callers may
+        both fetch; last write wins, both see a correct value."""
+        from ray_tpu.core.cluster import fetch_remote_object
+
+        loc = e.remote_loc
+        try:
+            blob = fetch_remote_object(
+                loc["host"], loc["port"], obj_id
+            )
+        except Exception as err:
+            raise RayActorError(
+                f"object {obj_id} lost: node {loc.get('node_id')} "
+                f"({loc.get('host')}:{loc.get('port')}) unreachable: "
+                f"{err}"
+            ) from err
+        value = ser.loads(blob)
+        with self._lock:
+            if e.value is None and e.spill_path is None:
+                e.value = value
+                e._restore_buf = blob
+
     def attach_shm(self, obj_id: str, shm_name: str) -> None:
         """Register a worker-created shm segment as this object's value."""
         e = self._entry(obj_id)
@@ -294,6 +344,12 @@ class ObjectStore:
             raise GetTimeoutError(f"Timed out getting object {obj_id}")
         if e.error is not None:
             raise e.error
+        if (
+            e.remote_loc is not None
+            and e.value is None
+            and e.spill_path is None
+        ):
+            self._materialize_remote(obj_id, e)
         with self._lock:
             if e.spill_path is not None and e.value is None:
                 self._maybe_restore(e)

@@ -471,17 +471,44 @@ class JaxPolicy(Policy):
             # Different shuffle stream per data shard.
             rng = jax.random.fold_in(rng, jax.lax.axis_index("data"))
 
+            # uint8 row columns (pixel obs) gather 3-4x faster viewed
+            # as uint32 lanes (measured: 127 -> 420 GB/s effective on
+            # v5e — narrow-element gathers are element-width-bound),
+            # so pack them once per nest and unpack per minibatch
+            packed_shapes = {}
+            batch = dict(batch)
+            for k, v in list(batch.items()):
+                if (
+                    v.dtype == jnp.uint8
+                    and v.ndim >= 2
+                    and int(np.prod(v.shape[1:])) % 4 == 0
+                ):
+                    packed_shapes[k] = v.shape
+                    batch[k] = jax.lax.bitcast_convert_type(
+                        v.reshape(v.shape[0], -1, 4), jnp.uint32
+                    )
+
+            def _unpack(k, v):
+                shp = packed_shapes.get(k)
+                if shp is None:
+                    return v
+                u8 = jax.lax.bitcast_convert_type(v, jnp.uint8)
+                return u8.reshape((v.shape[0],) + shp[1:])
+
             def mb_step(carry, mb_rng_idx):
                 params, opt_state = carry
-                idx, mb_rng = mb_rng_idx
+                idx, mb_rng, is_last = mb_rng_idx
                 # __chunk__ columns hold one row per T-row unroll
                 # (chunk-start recurrent states); gather them by the
                 # unroll indices the row permutation selected
                 mb = {
-                    k: (
-                        v[idx.reshape(-1, T_seq)[:, 0] // T_seq]
-                        if k.startswith("__chunk__")
-                        else v[idx]
+                    k: _unpack(
+                        k,
+                        (
+                            v[idx.reshape(-1, T_seq)[:, 0] // T_seq]
+                            if k.startswith("__chunk__")
+                            else v[idx]
+                        ),
                     )
                     for k, v in batch.items()
                 }
@@ -495,11 +522,22 @@ class JaxPolicy(Policy):
                     lambda u: -lr * u.astype(jnp.float32), updates
                 )
                 params = optax.apply_updates(params, updates)
-                gnorm = optax.global_norm(grads)
+                # grad_gnorm: FINAL minibatch only. The 12-leaf
+                # reduce+sqrt chain measures ~2x the model's own
+                # fwd+bwd per step on this backend (profile_nest2),
+                # so running it every step nearly halves nest MFU;
+                # the reference's torch learner likewise reports the
+                # last batch's extra_grad_info per update.
+                gnorm = jax.lax.cond(
+                    is_last,
+                    lambda: optax.global_norm(grads),
+                    lambda: jnp.float32(0.0),
+                )
                 stats = dict(stats, total_loss=loss, grad_gnorm=gnorm)
                 return (params, opt_state), stats
 
-            def epoch(carry, rng_e):
+            def epoch(carry, rng_e_i):
+                rng_e, ep_i = rng_e_i
                 perm_rng, scan_rng = jax.random.split(rng_e)
                 if T_seq > 1:
                     seq_perm = jax.random.permutation(
@@ -513,19 +551,31 @@ class JaxPolicy(Policy):
                     perm = jax.random.permutation(perm_rng, b_loc)
                 idx = perm[: num_mb * mb_loc].reshape(num_mb, mb_loc)
                 mb_rngs = jax.random.split(scan_rng, num_mb)
+                is_last = (ep_i == num_iters - 1) & (
+                    jnp.arange(num_mb) == num_mb - 1
+                )
                 carry, stats = jax.lax.scan(
-                    mb_step, carry, (idx, mb_rngs)
+                    mb_step, carry, (idx, mb_rngs, is_last)
                 )
                 return carry, stats
 
             rngs = jax.random.split(rng, num_iters)
             (params, opt_state), stats = jax.lax.scan(
-                epoch, (params, opt_state), rngs
+                epoch,
+                (params, opt_state),
+                (rngs, jnp.arange(num_iters)),
             )
-            # mean over epochs × minibatches, then over shards
-            stats = jax.tree_util.tree_map(
-                lambda x: jax.lax.pmean(x.mean(), "data"), stats
-            )
+
+            # mean over epochs × minibatches, then over shards —
+            # except grad_gnorm, which only the final step computed
+            # (every other entry is 0, so the sum IS that value)
+            def reduce_stat(name, x):
+                agg = x.sum() if name == "grad_gnorm" else x.mean()
+                return jax.lax.pmean(agg, "data")
+
+            stats = {
+                k: reduce_stat(k, v) for k, v in stats.items()
+            }
             return params, opt_state, stats
 
         sharded = jax.shard_map(

@@ -1,12 +1,16 @@
-"""Placement groups: gang resource reservation.
+"""Placement groups: gang resource reservation, cluster-wide.
 
 Counterpart of the reference's ``python/ray/util/placement_group.py:32``
 (PlacementGroup, ``placement_group() :126``) and the raylet-side 2PC
-bundle reservation (``raylet/placement_group_resource_manager.h``),
-scoped to the single-host runtime: a group atomically reserves its
-bundles' CPUs out of the scheduler pool; tasks/actors submitted with
-``PlacementGroupSchedulingStrategy`` draw admission from the group's
-reservation instead of the global pool. On a TPU pod the accelerator
+bundle reservation (``raylet/placement_group_resource_manager.h`` +
+``gcs/gcs_server/gcs_placement_group_manager.cc``): bundles are
+assigned to nodes per strategy (PACK / SPREAD / STRICT_PACK /
+STRICT_SPREAD) across the head AND registered fleet agents, then
+reserved atomically — head CPUs out of the scheduler pool, agent CPUs
+out of each node's spillover ledger — with full rollback if any node's
+prepare fails. Tasks/actors submitted with
+``PlacementGroupSchedulingStrategy`` draw admission from their bundle's
+reservation and run ON the bundle's node. On a TPU pod the accelerator
 side of gang placement is the jax mesh itself (devices are co-scheduled
 by construction); this covers the CPU-fleet side."""
 
@@ -16,6 +20,8 @@ import threading
 import time
 import uuid
 from typing import Dict, List, Optional
+
+_HEAD = "__head__"
 
 
 class PlacementGroup:
@@ -33,6 +39,10 @@ class PlacementGroup:
         self._ready_event = threading.Event()
         # per-bundle used CPUs (admission control inside the group)
         self._bundle_used = [0.0] * len(bundles)
+        # per-bundle host: None = head, else the agent node_id
+        self.bundle_nodes: List[Optional[str]] = [None] * len(bundles)
+        self._head_reserved = 0.0
+        self._reserved_node_ids: List[str] = []
 
     @property
     def bundle_count(self) -> int:
@@ -43,14 +53,113 @@ class PlacementGroup:
 
     # -- reservation against the runtime ----------------------------------
 
+    def _assign_bundles(self, offers) -> Optional[List[str]]:
+        """Map each bundle to a node key given ``offers`` =
+        [(node_key, free_cpus)] with the head first. Returns None when
+        the strategy cannot be satisfied right now."""
+        needs = [b.get("CPU", 0.0) for b in self.bundles]
+        free = {k: f for k, f in offers}
+        keys = [k for k, _ in offers]
+        if self.strategy in ("PACK", "STRICT_PACK"):
+            total = sum(needs)
+            for k in keys:  # head first, then agents
+                if free[k] + 1e-9 >= total:
+                    return [k] * len(needs)
+            if self.strategy == "STRICT_PACK":
+                return None
+            # PACK fallback: fewest nodes greedily (first-fit in
+            # descending-capacity order after the head)
+            order = [keys[0]] + sorted(
+                keys[1:], key=lambda k: -free[k]
+            )
+            assign = []
+            for need in needs:
+                for k in order:
+                    if free[k] + 1e-9 >= need:
+                        free[k] -= need
+                        assign.append(k)
+                        break
+                else:
+                    return None
+            return assign
+        # SPREAD / STRICT_SPREAD: one bundle per distinct node while
+        # nodes remain; plain SPREAD reuses nodes best-effort after
+        assign: List[Optional[str]] = [None] * len(needs)
+        used = set()
+        for i, need in enumerate(needs):
+            cand = None
+            for k in keys:
+                if k not in used and free[k] + 1e-9 >= need:
+                    cand = k
+                    break
+            if cand is None:
+                if self.strategy == "STRICT_SPREAD":
+                    return None
+                cand = max(free, key=lambda k: free[k])
+                if free[cand] + 1e-9 < need:
+                    return None
+            used.add(cand)
+            free[cand] -= need
+            assign[i] = cand
+        return assign
+
     def _try_reserve(self, rt) -> bool:
+        """Two-phase reserve across head + agents: assign bundles
+        against a capacity snapshot, commit head share under the
+        runtime lock, then prepare each agent's ledger — rolling back
+        everything if any node refuses (the raylet 2PC's
+        PREPARE/COMMIT, in-process)."""
+        cluster = getattr(rt, "cluster", None)
+        nodes = []
+        if cluster is not None:
+            nodes = [
+                n for n in cluster.nodes.values() if not n.dead
+            ]
         with rt.lock:
-            need = self.total_cpus()
-            if need > rt.available_cpus + 1e-9:
+            head_free = rt.available_cpus
+        offers = [(_HEAD, head_free)] + [
+            (n.node_id, n.free_cpus()) for n in nodes
+        ]
+        assign = self._assign_bundles(offers)
+        if assign is None:
+            return False
+        need_head = sum(
+            b.get("CPU", 0.0)
+            for b, a in zip(self.bundles, assign)
+            if a == _HEAD
+        )
+        with rt.lock:
+            if need_head > rt.available_cpus + 1e-9:
                 return False
-            rt.available_cpus -= need
+            rt.available_cpus -= need_head
+        reserved = []
+        ok = True
+        for n in nodes:
+            need = sum(
+                b.get("CPU", 0.0)
+                for b, a in zip(self.bundles, assign)
+                if a == n.node_id
+            )
+            if need <= 0:
+                continue
+            if n.pg_reserve(self.id, need):
+                reserved.append(n)
+            else:
+                ok = False
+                break
+        if not ok:  # rollback (a node filled up between offer+prepare)
+            with rt.lock:
+                rt.available_cpus += need_head
+            for n in reserved:
+                n.pg_release(self.id)
+            return False
         with self._lock:
             self._reserved = True
+            self._head_reserved = need_head
+            self._reserved_node_ids = [n.node_id for n in reserved]
+            self.bundle_nodes = [
+                None if a == _HEAD else a for a in assign
+            ]
         self._ready_event.set()
         # tasks queued against this group may now be admissible
         rt._dispatch_pending()
@@ -83,38 +192,113 @@ class PlacementGroup:
 
     # -- admission for member tasks (runtime lock held) -------------------
 
-    def _fits(self, num_cpus: float, bundle_index: int = -1) -> bool:
+    def _bundle_on(self, i: int, node_id: Optional[str]) -> bool:
+        """Is bundle i hosted on ``node_id`` (None = the head)?"""
+        return self.bundle_nodes[i] == node_id
+
+    def _fits(
+        self,
+        num_cpus: float,
+        bundle_index: int = -1,
+        node_id: Optional[str] = None,
+    ) -> bool:
+        """Capacity check scoped to bundles living on ``node_id`` —
+        the head dispatcher passes None; the spillover path asks per
+        agent (a bundle reserved on node X only admits work ON X)."""
         if not self._reserved or self._removed:
             return False
         with self._lock:
             if bundle_index >= 0:
+                if not self._bundle_on(bundle_index, node_id):
+                    return False
                 cap = self.bundles[bundle_index].get("CPU", 0.0)
                 return (
                     self._bundle_used[bundle_index] + num_cpus
                     <= cap + 1e-9
                 )
             for i, b in enumerate(self.bundles):
-                if (
+                if self._bundle_on(i, node_id) and (
                     self._bundle_used[i] + num_cpus
                     <= b.get("CPU", 0.0) + 1e-9
                 ):
                     return True
             return False
 
-    def _acquire(self, num_cpus: float, bundle_index: int = -1) -> int:
+    def _acquire(
+        self,
+        num_cpus: float,
+        bundle_index: int = -1,
+        node_id: Optional[str] = None,
+    ) -> int:
         """→ the bundle index actually charged (the admission record
-        releases exactly this bundle later)."""
+        releases exactly this bundle later). -1 if nothing fits."""
         with self._lock:
             if bundle_index < 0:
                 for i, b in enumerate(self.bundles):
-                    if (
+                    if self._bundle_on(i, node_id) and (
                         self._bundle_used[i] + num_cpus
                         <= b.get("CPU", 0.0) + 1e-9
                     ):
                         bundle_index = i
                         break
+                else:
+                    return -1
             self._bundle_used[bundle_index] += num_cpus
             return bundle_index
+
+    def _acquire_any(self, num_cpus: float, bundle_index: int = -1):
+        """Atomically find-and-charge a fitting bundle on ANY node
+        (actor placement: the actor goes wherever its bundle lives).
+        → (bundle_index, node_id) or (-1, None)."""
+        with self._lock:
+            if self._removed or not self._reserved:
+                return -1, None
+            cands = (
+                [bundle_index]
+                if bundle_index >= 0
+                else range(len(self.bundles))
+            )
+            for i in cands:
+                if (
+                    self._bundle_used[i] + num_cpus
+                    <= self.bundles[i].get("CPU", 0.0) + 1e-9
+                ):
+                    self._bundle_used[i] += num_cpus
+                    return i, self.bundle_nodes[i]
+            return -1, None
+
+    def node_lost(self, node_id: str) -> bool:
+        """The host of some bundles died: mark them LOST (they admit
+        nothing — "__lost__" matches neither the head's None nor any
+        live agent id) so work targeting them fails fast instead of
+        queueing forever. → True if this group was affected."""
+        with self._lock:
+            hit = False
+            for i, nid in enumerate(self.bundle_nodes):
+                if nid == node_id:
+                    self.bundle_nodes[i] = "__lost__"
+                    hit = True
+            if node_id in self._reserved_node_ids:
+                self._reserved_node_ids.remove(node_id)
+            return hit
+
+    def has_live_bundle(
+        self, num_cpus: float, bundle_index: int = -1
+    ) -> bool:
+        """Could ``num_cpus`` EVER be admitted given lost bundles
+        (ignoring current usage)? False → submitting is a dead end."""
+        with self._lock:
+            cands = (
+                [bundle_index]
+                if bundle_index >= 0
+                else range(len(self.bundles))
+            )
+            return any(
+                self.bundle_nodes[i] != "__lost__"
+                and self.bundles[i].get("CPU", 0.0) + 1e-9
+                >= num_cpus
+                for i in cands
+            )
 
     def _release(self, num_cpus: float, bundle_index: int) -> None:
         with self._lock:
@@ -132,7 +316,13 @@ class PlacementGroup:
         if self._reserved:
             rt = _require_runtime()
             with rt.lock:
-                rt.available_cpus += self.total_cpus()
+                rt.available_cpus += self._head_reserved
+            cluster = getattr(rt, "cluster", None)
+            if cluster is not None:
+                for nid in self._reserved_node_ids:
+                    node = cluster.nodes.get(nid)
+                    if node is not None:
+                        node.pg_release(self.id)
             self._reserved = False
         _GROUPS.pop(self.id, None)
 

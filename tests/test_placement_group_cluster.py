@@ -1,0 +1,154 @@
+"""Cross-node placement groups: bundles reserve CPUs on fleet agents
+(2PC prepare/rollback across head + nodes), actors gang-place on their
+bundle's node, and pg tasks spill to the bundle's agent (reference
+``raylet/placement_group_resource_manager.h`` +
+``gcs/gcs_server/gcs_placement_group_manager.cc``)."""
+
+import os
+import pathlib
+import subprocess
+import sys
+
+import pytest
+
+import ray_tpu.core.api as ray
+from ray_tpu.core.cluster import start_cluster_server
+from ray_tpu.util.placement_group import (
+    PlacementGroupSchedulingStrategy,
+    placement_group,
+    remove_placement_group,
+)
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+
+_AGENT = """
+import sys, time
+import ray_tpu.core.api as ray
+
+if __name__ == "__main__":
+    ray.init(
+        num_cpus=32,
+        worker_env={"PG_NODE_MARK": sys.argv[2]},
+        address=sys.argv[1],
+        node_id=sys.argv[2],
+    )
+    print("JOINED", flush=True)
+    while True:
+        time.sleep(60)
+"""
+
+
+@pytest.fixture(scope="module")
+def pg_fleet():
+    addr = start_cluster_server()
+    script = "/tmp/ray_tpu_pg_agent.py"
+    with open(script, "w") as f:
+        f.write(_AGENT)
+    env = {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        "PYTHONPATH": f"{REPO}:{os.environ.get('PYTHONPATH', '')}",
+    }
+    procs = [
+        subprocess.Popen(
+            [sys.executable, script, addr, name],
+            cwd=REPO,
+            env=env,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE,
+        )
+        for name in ("pg_a", "pg_b")
+    ]
+    rt = ray._require_runtime()
+    try:
+        rt.cluster.wait_for_nodes(2, timeout=60)
+        yield rt
+    finally:
+        for p in procs:
+            p.terminate()
+        for p in procs:
+            p.wait(timeout=15)
+
+
+@ray.remote
+class WhereActor:
+    def where(self):
+        import os
+
+        return os.environ.get("PG_NODE_MARK", "head")
+
+
+def test_strict_spread_spans_agents_and_gang_places(pg_fleet):
+    rt = pg_fleet
+    # bundles sized past the head's whole pool: STRICT_SPREAD must
+    # land the two bundles on the two 32-CPU agents
+    need = float(int(rt.num_cpus) + 1)
+    pg = placement_group(
+        [{"CPU": need}, {"CPU": need}], strategy="STRICT_SPREAD"
+    )
+    assert pg.ready(timeout=30)
+    assert sorted(pg.bundle_nodes) == ["pg_a", "pg_b"]
+    # agent ledgers hold the reservation
+    for nid in ("pg_a", "pg_b"):
+        assert rt.cluster.nodes[nid].free_cpus() == 32.0 - need
+
+    actors = [
+        WhereActor.options(
+            num_cpus=1,
+            scheduling_strategy=PlacementGroupSchedulingStrategy(
+                pg, placement_group_bundle_index=i
+            ),
+        ).remote()
+        for i in range(2)
+    ]
+    where = sorted(ray.get([a.where.remote() for a in actors]))
+    assert where == ["pg_a", "pg_b"], where
+    for a in actors:
+        ray.kill(a)
+    remove_placement_group(pg)
+    for nid in ("pg_a", "pg_b"):
+        assert rt.cluster.nodes[nid].free_cpus() == 32.0
+
+
+def test_reserve_rollback_when_infeasible(pg_fleet):
+    rt = pg_fleet
+    before = {
+        nid: rt.cluster.nodes[nid].free_cpus()
+        for nid in ("pg_a", "pg_b")
+    }
+    pg = placement_group([{"CPU": 640}], strategy="STRICT_PACK")
+    assert not pg.ready(timeout=0.3)
+    after = {
+        nid: rt.cluster.nodes[nid].free_cpus()
+        for nid in ("pg_a", "pg_b")
+    }
+    assert after == before
+    remove_placement_group(pg)
+
+
+def test_pg_task_spills_to_bundle_node(pg_fleet):
+    rt = pg_fleet
+    need = float(int(rt.num_cpus) + 1)
+    pg = placement_group([{"CPU": need}], strategy="STRICT_PACK")
+    assert pg.ready(timeout=30)
+    # the bundle exceeds the head's whole pool -> it lives on an
+    # agent, and the task must run THERE
+    bundle_node = pg.bundle_nodes[0]
+    assert bundle_node in ("pg_a", "pg_b")
+
+    @ray.remote
+    def where():
+        import os
+
+        return os.environ.get("PG_NODE_MARK", "head")
+
+    out = ray.get(
+        where.options(
+            num_cpus=1,
+            scheduling_strategy=PlacementGroupSchedulingStrategy(
+                pg
+            ),
+        ).remote()
+    )
+    assert out == bundle_node
+    remove_placement_group(pg)
