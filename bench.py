@@ -224,12 +224,11 @@ def bench_jax(
     # multi_gpu_learner_thread.py:20-140 keeps its GPUs fed the same
     # way — loader threads hide transfer, so the accelerator only
     # ever waits on compute).
+    from ray_tpu.policy.jax_policy import _FRAMES as _F
+
     dev_batches = []
     for hb, bs_ in host_batches:
-        frames = None
         hb2 = dict(hb)
-        from ray_tpu.policy.jax_policy import _FRAMES as _F
-
         fr = hb2.pop(_F, None)
         dev_b = jax.device_put(hb2, policy.batch_shardings(hb2))
         if fr is not None:

@@ -13,6 +13,7 @@ Writes: benchmarks/data_at_volume.json
 """
 
 import json
+import os
 import pathlib
 import resource
 import sys
@@ -32,19 +33,51 @@ def main():
     gb = 1.0
     if "--gb" in sys.argv:
         gb = float(sys.argv[sys.argv.index("--gb") + 1])
+    fleet = 0
+    if "--fleet" in sys.argv:
+        fleet = int(sys.argv[sys.argv.index("--fleet") + 1])
     n_blocks = max(4, int(gb * 1024 / BLOCK_MB))
     rows_per_block = BLOCK_MB * 1024 * 1024 // (ROW_PAYLOAD + 64)
 
     import ray_tpu as ray
     from ray_tpu.data.dataset import Dataset
 
-    # cap the store so this workload cannot fit resident: the LRU
-    # spill path is part of what's being exercised
-    ray.init(
-        num_cpus=2,
-        object_store_memory=256 * 1024 * 1024,
-        ignore_reinit_error=True,
-    )
+    if fleet:
+        # per-node data plane mode: head has ZERO task CPUs, so every
+        # gen/exchange task spills to the fleet agents; block bytes
+        # stay node-resident (core/cluster data servers) and move
+        # agent<->agent — the driver holds refs + locations only, so
+        # its RSS stays flat at ANY data volume
+        os.environ.setdefault(
+            "RAY_TPU_NODE_OBJ_MIN_BYTES", str(256 * 1024)
+        )
+        ray.init(
+            num_cpus=0,
+            object_store_memory=256 * 1024 * 1024,
+            ignore_reinit_error=True,
+        )
+        from ray_tpu.autoscaler.node_provider import (
+            LocalSubprocessProvider,
+        )
+        from ray_tpu.core.cluster import start_cluster_server
+
+        from ray_tpu.core.api import _require_runtime
+
+        addr = start_cluster_server()
+        rt = _require_runtime()
+        provider = LocalSubprocessProvider(addr, num_cpus=2)
+        for _ in range(fleet):
+            provider.create_node({"num_cpus": 2})
+        rt.cluster.wait_for_nodes(fleet, timeout=90)
+        print(f"# fleet: {fleet} agent nodes joined", file=sys.stderr)
+    else:
+        # cap the store so this workload cannot fit resident: the LRU
+        # spill path is part of what's being exercised
+        ray.init(
+            num_cpus=2,
+            object_store_memory=256 * 1024 * 1024,
+            ignore_reinit_error=True,
+        )
 
     @ray.remote
     def gen_block(i):
@@ -73,6 +106,7 @@ def main():
             "worker<->spill-disk directly), not through driver "
             "python."
         ),
+        "fleet_nodes": fleet,
         "target_gb": gb,
         "n_blocks": n_blocks,
         "rows_per_block": rows_per_block,

@@ -562,6 +562,9 @@ class DQN(Algorithm):
             and bs % max(1, getattr(p, "n_shards", 1)) == 0
             for p in pols.values()
         ):
+            pend = self._pending_stats = getattr(
+                self, "_pending_stats", []
+            )
             left = updates
             while left > 0:
                 # 32 bounds per-dispatch batch memory; the buffer-size
@@ -592,9 +595,6 @@ class DQN(Algorithm):
                     lazy = policy.learn_on_stacked_batch(
                         stacked, k, bs, defer_stats=True
                     )
-                    pend = self._pending_stats = getattr(
-                        self, "_pending_stats", []
-                    )
                     pend.append((pid, lazy))
                     while len(pend) > 2:
                         old_pid, old = pend.pop(0)
@@ -603,6 +603,16 @@ class DQN(Algorithm):
                             kk: float(v) for kk, v in st.items()
                         }
                     self._counters[NUM_ENV_STEPS_TRAINED] += b.count
+            if not train_info and pend:
+                # first rounds of the pipeline: block on the oldest
+                # chain so train() never reports an empty learner dict
+                # (the remaining 1-2 stay deferred — the cross-round
+                # overlap survives)
+                old_pid, old = pend.pop(0)
+                st = jax.device_get(old)
+                train_info[old_pid] = {
+                    kk: float(v) for kk, v in st.items()
+                }
             return train_info
 
         for _ in range(updates):

@@ -134,6 +134,106 @@ class LocalSubprocessProvider(NodeProvider):
         ]
 
 
+class SSHNodeProvider(NodeProvider):
+    """Remote-machine provider: starts a ``NodeAgent`` on another
+    reachable host over ssh, so the autoscaler manages MACHINES, not
+    just child processes (reference
+    ``autoscaler/_private/aws/node_provider.py`` shape — "create a
+    node" here means "start an agent on a host from the inventory",
+    since the fleet's hosts pre-exist rather than being provisioned
+    from a cloud API).
+
+    ``hosts`` is the inventory to draw from, one agent per host. The
+    transport is injectable (``ssh_cmd``) so tests can swap in a
+    local-exec shim where no sshd runs; production uses the default
+    ``["ssh", "-o", "BatchMode=yes"]``. The remote command ``exec``s
+    the agent as the ssh session child, so terminating the local ssh
+    client hangs up the session and takes the remote agent with it.
+    """
+
+    def __init__(
+        self,
+        head_address: str,
+        hosts: List[str],
+        *,
+        ssh_cmd: Optional[List[str]] = None,
+        remote_python: str = sys.executable,
+        remote_repo: Optional[str] = None,
+        num_cpus: int = 2,
+    ):
+        import os
+        import shlex
+
+        self._shlex = shlex
+        self.head_address = head_address
+        self.hosts = list(hosts)
+        self.ssh_cmd = (
+            list(ssh_cmd)
+            if ssh_cmd is not None
+            else ["ssh", "-o", "BatchMode=yes"]
+        )
+        self.remote_python = remote_python
+        self.remote_repo = remote_repo or os.path.dirname(
+            os.path.dirname(os.path.dirname(__file__))
+        )
+        self.num_cpus = num_cpus
+        self.nodes: Dict[str, Dict] = {}  # node_id -> {host, proc}
+
+    def _free_host(self) -> Optional[str]:
+        used = {
+            rec["host"]
+            for rec in self.nodes.values()
+            if rec["proc"].poll() is None
+        }
+        for h in self.hosts:
+            if h not in used:
+                return h
+        return None
+
+    def create_node(self, node_config: Dict) -> str:
+        host = self._free_host()
+        if host is None:
+            raise RuntimeError(
+                f"ssh inventory exhausted ({len(self.hosts)} hosts)"
+            )
+        node_id = f"sshnode_{uuid.uuid4().hex[:6]}"
+        q = self._shlex.quote
+        ncpus = int(node_config.get("num_cpus", self.num_cpus))
+        remote = (
+            f"cd {q(self.remote_repo)} && "
+            f"JAX_PLATFORMS=cpu "
+            f"PYTHONPATH={q(self.remote_repo)}:$PYTHONPATH "
+            f"exec {q(self.remote_python)} -m ray_tpu.core.node_agent"
+            f" --address {q(self.head_address)}"
+            f" --node-id {q(node_id)} --num-cpus {ncpus}"
+        )
+        proc = subprocess.Popen(
+            self.ssh_cmd + [host, remote],
+            stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL,
+        )
+        self.nodes[node_id] = {"host": host, "proc": proc}
+        return node_id
+
+    def terminate_node(self, node_id: str) -> None:
+        rec = self.nodes.pop(node_id, None)
+        if rec is None:
+            return
+        proc = rec["proc"]
+        proc.terminate()  # hangs up the ssh session -> remote agent
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+
+    def non_terminated_nodes(self) -> List[str]:
+        return [
+            nid
+            for nid, rec in self.nodes.items()
+            if rec["proc"].poll() is None
+        ]
+
+
 class NodeAutoscaler:
     """reference StandardAutoscaler (autoscaler.py:145), node-level."""
 

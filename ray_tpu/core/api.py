@@ -378,18 +378,22 @@ class _Runtime:
                 return False
         return True
 
-    def _acquire(self, trec) -> None:
+    def _acquire(self, trec) -> bool:
+        """→ False when a placement-group charge lost the race between
+        _fits and here (an actor creation filled the bundle): the
+        caller requeues instead of dispatching an uncharged task."""
         pg = trec.placement_group
         if pg is not None:
             trec.acquired_bundle = pg._acquire(
                 trec.num_cpus, trec.bundle_index
             )
-            return
+            return trec.acquired_bundle >= 0
         self.available_cpus -= trec.num_cpus
         for k, v in trec.resources.items():
             self.available_resources[k] = (
                 self.available_resources.get(k, 0.0) - v
             )
+        return True
 
     def _release(self, trec) -> None:
         pg = trec.placement_group
@@ -435,8 +439,13 @@ class _Runtime:
                             break
                     if trec is None:
                         spill = True
+                    elif not self._acquire(trec):
+                        # pg bundle filled between _fits and the
+                        # charge: requeue, try the spill path
+                        self.pending.appendleft(trec)
+                        trec = None
+                        spill = True
                     else:
-                        self._acquire(trec)
                         w.idle = False
                         w.inflight[trec.task_id] = trec
             if spill:
@@ -752,7 +761,11 @@ class _Runtime:
             bidx = getattr(
                 pg_strategy, "placement_group_bundle_index", -1
             )
-            bundle, pg_node = pg._acquire_any(ncpus, bidx)
+            # under the runtime lock: task dispatch does its
+            # _fits/_acquire pair there, so actor charges must not
+            # interleave between them
+            with self.lock:
+                bundle, pg_node = pg._acquire_any(ncpus, bidx)
             if bundle < 0:
                 raise ValueError(
                     f"placement group {pg.id} cannot admit actor "

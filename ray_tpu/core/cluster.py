@@ -127,15 +127,31 @@ def _open_peer_conn(host: str, port: int):
     if not isinstance(resp, dict) or not resp.get("ok"):
         sock.close()
         raise ConnectionError("data server rejected pull auth")
-    sock.settimeout(None)
     return sock, lock
 
 
-def fetch_remote_object(host: str, port: int, obj_id: str) -> bytes:
+def _drop_peer_conn(key, entry) -> None:
+    """Remove + close one pooled connection (leak-free on every
+    failure path)."""
+    with _peer_conns_lock:
+        if _peer_conns.get(key) is entry:
+            _peer_conns.pop(key, None)
+    if entry is not None:
+        try:
+            entry[0].close()
+        except OSError:
+            pass
+
+
+def fetch_remote_object(
+    host: str, port: int, obj_id: str, timeout: Optional[float] = 60.0
+) -> bytes:
     """Pull one object's serialized bytes from a node data server.
     Connections are pooled per (host, port); one transient failure
     gets a fresh-connection retry, then the object is reported lost
-    (the caller maps that to an object-lost error)."""
+    (the caller maps that to an object-lost error). ``timeout``
+    bounds each socket operation, so a black-holed peer surfaces
+    ``socket.timeout`` instead of hanging the caller."""
     key = (str(host), int(port))
     last_err: Optional[Exception] = None
     for attempt in range(2):
@@ -145,31 +161,37 @@ def fetch_remote_object(host: str, port: int, obj_id: str) -> bytes:
             if entry is None:
                 entry = _open_peer_conn(*key)
                 with _peer_conns_lock:
-                    _peer_conns[key] = entry
+                    cur = _peer_conns.get(key)
+                    if cur is None:
+                        _peer_conns[key] = entry
+                    else:
+                        # lost the first-connection race: use the
+                        # winner's, close ours
+                        loser = entry
+                        entry = cur
+                        try:
+                            loser[0].close()
+                        except OSError:
+                            pass
             sock, lock = entry
             with lock:  # request/response pairs must not interleave
+                sock.settimeout(timeout)
                 _send_frame(
                     sock,
                     threading.Lock(),
                     {"op": "pull", "obj_id": obj_id},
                 )
                 resp = _recv_frame(sock)
+        except socket.timeout:
+            _drop_peer_conn(key, entry)
+            raise  # slow/hung peer: the caller's timeout semantics
         except (OSError, wire.ControlFrameError) as err:
             last_err = err
-            with _peer_conns_lock:
-                if _peer_conns.get(key) is entry:
-                    _peer_conns.pop(key, None)
-            if entry is not None:
-                try:
-                    entry[0].close()
-                except OSError:
-                    pass
+            _drop_peer_conn(key, entry)
             continue
         if resp is None:
             last_err = ConnectionError("data server closed mid-pull")
-            with _peer_conns_lock:
-                if _peer_conns.get(key) is entry:
-                    _peer_conns.pop(key, None)
+            _drop_peer_conn(key, entry)
             continue
         if not resp.get("ok"):
             raise KeyError(

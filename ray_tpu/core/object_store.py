@@ -299,17 +299,34 @@ class ObjectStore:
                 return None
             return e.remote_loc
 
-    def _materialize_remote(self, obj_id: str, e: _Entry) -> None:
+    def _materialize_remote(
+        self,
+        obj_id: str,
+        e: _Entry,
+        timeout: Optional[float] = None,
+    ) -> None:
         """Pull a node-resident object's bytes from its data server
         (outside the store lock — network). Concurrent callers may
-        both fetch; last write wins, both see a correct value."""
+        both fetch; last write wins, both see a correct value.
+        ``timeout`` bounds the pull — a slow peer raises
+        GetTimeoutError like any other slow get."""
+        import socket as _socket
+
         from ray_tpu.core.cluster import fetch_remote_object
 
         loc = e.remote_loc
         try:
             blob = fetch_remote_object(
-                loc["host"], loc["port"], obj_id
+                loc["host"],
+                loc["port"],
+                obj_id,
+                timeout=timeout if timeout is not None else 60.0,
             )
+        except (_socket.timeout, TimeoutError) as err:
+            raise GetTimeoutError(
+                f"Timed out pulling node-resident object {obj_id} "
+                f"from {loc.get('host')}:{loc.get('port')}"
+            ) from err
         except Exception as err:
             raise RayActorError(
                 f"object {obj_id} lost: node {loc.get('node_id')} "
@@ -339,6 +356,9 @@ class ObjectStore:
         return self._entry(obj_id).event.wait(timeout)
 
     def get(self, obj_id: str, timeout: Optional[float] = None) -> Any:
+        deadline = (
+            None if timeout is None else time.monotonic() + timeout
+        )
         e = self._entry(obj_id)
         if not e.event.wait(timeout):
             raise GetTimeoutError(f"Timed out getting object {obj_id}")
@@ -349,7 +369,12 @@ class ObjectStore:
             and e.value is None
             and e.spill_path is None
         ):
-            self._materialize_remote(obj_id, e)
+            remaining = (
+                None
+                if deadline is None
+                else max(deadline - time.monotonic(), 0.05)
+            )
+            self._materialize_remote(obj_id, e, timeout=remaining)
         with self._lock:
             if e.spill_path is not None and e.value is None:
                 self._maybe_restore(e)

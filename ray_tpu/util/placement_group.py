@@ -231,7 +231,10 @@ class PlacementGroup:
         node_id: Optional[str] = None,
     ) -> int:
         """→ the bundle index actually charged (the admission record
-        releases exactly this bundle later). -1 if nothing fits."""
+        releases exactly this bundle later). -1 if nothing fits —
+        including an explicit bundle_index whose capacity was taken
+        between the caller's _fits and this charge (actor creations
+        race the dispatcher on the group's own lock)."""
         with self._lock:
             if bundle_index < 0:
                 for i, b in enumerate(self.bundles):
@@ -242,6 +245,13 @@ class PlacementGroup:
                         bundle_index = i
                         break
                 else:
+                    return -1
+            else:
+                if not self._bundle_on(bundle_index, node_id) or (
+                    self._bundle_used[bundle_index] + num_cpus
+                    > self.bundles[bundle_index].get("CPU", 0.0)
+                    + 1e-9
+                ):
                     return -1
             self._bundle_used[bundle_index] += num_cpus
             return bundle_index

@@ -15,8 +15,16 @@ pytestmark = pytest.mark.smoke
 
 
 def test_bench_jax_path_runs():
-    sps, times = bench.bench_jax(b=64, mb=32, iters=2, timed_rounds=1)
+    (
+        sps,
+        times,
+        pipe_sps,
+        pipe_wall,
+        res_sps,
+        res_wall,
+    ) = bench.bench_jax(b=64, mb=32, iters=2, timed_rounds=1)
     assert sps > 0 and len(times) == 1
+    assert pipe_sps > 0 and res_sps > 0
 
 
 def test_bench_batch_schema_matches_policy():

@@ -1,0 +1,82 @@
+"""SSHNodeProvider: an agent started on a "remote" host over an ssh
+transport joins the fleet and hosts actors; terminating the provider
+node hangs up the session and removes the node (reference
+``autoscaler/_private/aws/node_provider.py`` lifecycle, with hosts as
+the inventory). The transport is the injectable ssh_cmd — here a
+local-exec shim, since the test image runs no sshd; real ssh follows
+the identical code path."""
+
+import os
+import pathlib
+import sys
+import time
+
+import pytest
+
+import ray_tpu.core.api as ray
+from ray_tpu.autoscaler.node_provider import SSHNodeProvider
+from ray_tpu.core.cluster import start_cluster_server
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+
+# fake ssh: drop the host argument, run the command locally, and
+# forward SIGTERM to it (a real ssh client's hangup does the same to
+# the remote session)
+_SHIM = """
+import signal, subprocess, sys
+
+# argv: [shim, host, command] — a real ssh client gets the same two
+p = subprocess.Popen(["sh", "-c", sys.argv[2]])
+signal.signal(signal.SIGTERM, lambda s, f: p.terminate())
+sys.exit(p.wait())
+"""
+
+
+@pytest.fixture(scope="module")
+def shim_path(tmp_path_factory):
+    p = tmp_path_factory.mktemp("sshshim") / "fake_ssh.py"
+    p.write_text(_SHIM)
+    return str(p)
+
+
+def test_ssh_provider_node_lifecycle(shim_path):
+    addr = start_cluster_server()
+    rt = ray._require_runtime()
+    before = set(rt.cluster.nodes)
+    provider = SSHNodeProvider(
+        addr,
+        hosts=["hostA"],
+        ssh_cmd=[sys.executable, shim_path],
+        remote_repo=str(REPO),
+        num_cpus=2,
+    )
+    node_id = provider.create_node({"num_cpus": 2})
+    assert provider.non_terminated_nodes() == [node_id]
+
+    deadline = time.time() + 60
+    while node_id not in rt.cluster.nodes:
+        assert time.time() < deadline, "agent never registered"
+        time.sleep(0.2)
+
+    @ray.remote
+    class Probe:
+        def pid(self):
+            import os
+
+            return os.getpid()
+
+    a = Probe.options(placement_node=node_id).remote()
+    assert ray.get(a.pid.remote()) != os.getpid()
+    ray.kill(a)
+
+    # inventory exhaustion: one host -> a second node must refuse
+    with pytest.raises(RuntimeError):
+        provider.create_node({})
+
+    provider.terminate_node(node_id)
+    assert provider.non_terminated_nodes() == []
+    deadline = time.time() + 30
+    while node_id in rt.cluster.nodes:
+        assert time.time() < deadline, "node never deregistered"
+        time.sleep(0.2)
+    assert set(rt.cluster.nodes) == before
