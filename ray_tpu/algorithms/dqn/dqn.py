@@ -565,6 +565,14 @@ class DQN(Algorithm):
             pend = self._pending_stats = getattr(
                 self, "_pending_stats", []
             )
+
+            def drain_oldest():
+                old_pid, old = pend.pop(0)
+                st = jax.device_get(old)
+                train_info[old_pid] = {
+                    kk: float(v) for kk, v in st.items()
+                }
+
             left = updates
             while left > 0:
                 # 32 bounds per-dispatch batch memory; the buffer-size
@@ -597,22 +605,14 @@ class DQN(Algorithm):
                     )
                     pend.append((pid, lazy))
                     while len(pend) > 2:
-                        old_pid, old = pend.pop(0)
-                        st = jax.device_get(old)
-                        train_info[old_pid] = {
-                            kk: float(v) for kk, v in st.items()
-                        }
+                        drain_oldest()
                     self._counters[NUM_ENV_STEPS_TRAINED] += b.count
             if not train_info and pend:
                 # first rounds of the pipeline: block on the oldest
                 # chain so train() never reports an empty learner dict
                 # (the remaining 1-2 stay deferred — the cross-round
                 # overlap survives)
-                old_pid, old = pend.pop(0)
-                st = jax.device_get(old)
-                train_info[old_pid] = {
-                    kk: float(v) for kk, v in st.items()
-                }
+                drain_oldest()
             return train_info
 
         for _ in range(updates):

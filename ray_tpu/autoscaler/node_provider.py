@@ -196,12 +196,23 @@ class SSHNodeProvider(NodeProvider):
             raise RuntimeError(
                 f"ssh inventory exhausted ({len(self.hosts)} hosts)"
             )
+        import os
+
         node_id = f"sshnode_{uuid.uuid4().hex[:6]}"
         q = self._shlex.quote
         ncpus = int(node_config.get("num_cpus", self.num_cpus))
+        # the fleet's shared secret must reach the remote agent or a
+        # token-secured head (the normal setup for non-loopback
+        # fleets — exactly this provider's use case) rejects its
+        # registration and data-plane pulls
+        secrets = ""
+        for var in ("RAY_TPU_CLUSTER_TOKEN", "RAY_TPU_KV_TOKEN"):
+            val = os.environ.get(var)
+            if val:
+                secrets += f"{var}={q(val)} "
         remote = (
             f"cd {q(self.remote_repo)} && "
-            f"JAX_PLATFORMS=cpu "
+            f"JAX_PLATFORMS=cpu {secrets}"
             f"PYTHONPATH={q(self.remote_repo)}:$PYTHONPATH "
             f"exec {q(self.remote_python)} -m ray_tpu.core.node_agent"
             f" --address {q(self.head_address)}"

@@ -52,6 +52,8 @@ from ray_tpu.core import wire
 
 
 def _send_frame(sock: socket.socket, lock: threading.Lock, msg: Dict) -> None:
+    if "v" not in msg:
+        msg = {**msg, "v": wire.FRAME_VERSION}
     blob = wire.control_dumps(msg)
     with lock:
         sock.sendall(struct.pack(">I", len(blob)) + blob)
@@ -103,12 +105,16 @@ _peer_conns: Dict = {}
 _peer_conns_lock = threading.Lock()
 
 
-def _open_peer_conn(host: str, port: int):
+def _open_peer_conn(host: str, port: int, timeout: float = 30.0):
     """Connect + authenticate against a node data server (same
     challenge/HMAC handshake as head registration — a pull response
     is full-pickle on the consumer, so only authenticated cluster
-    members may serve one)."""
-    sock = socket.create_connection((host, int(port)), timeout=30.0)
+    members may serve one). ``timeout`` bounds the connect AND each
+    handshake read, so a peer that accepts but never speaks cannot
+    stall the caller past its deadline."""
+    sock = socket.create_connection(
+        (host, int(port)), timeout=timeout
+    )
     sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
     challenge = _recv_frame(sock, max_len=_MAX_HANDSHAKE_FRAME)
     if (
@@ -117,7 +123,14 @@ def _open_peer_conn(host: str, port: int):
     ):
         sock.close()
         raise ConnectionError("data server sent no challenge")
-    auth = {"op": "pull_auth", "nonce": challenge.get("nonce", "")}
+    auth = {
+        "op": "pull_auth",
+        "nonce": challenge.get("nonce", ""),
+        # version must be IN the frame before the MAC: _send_frame
+        # stamps it on unversioned frames, and the MAC covers every
+        # non-mac field
+        "v": wire.FRAME_VERSION,
+    }
     token = wire.cluster_token()
     if token is not None:
         auth["hmac"] = wire.register_hmac(token, auth)
@@ -144,22 +157,33 @@ def _drop_peer_conn(key, entry) -> None:
 
 
 def fetch_remote_object(
-    host: str, port: int, obj_id: str, timeout: Optional[float] = 60.0
+    host: str,
+    port: int,
+    obj_id: str,
+    timeout: Optional[float] = None,
 ) -> bytes:
     """Pull one object's serialized bytes from a node data server.
     Connections are pooled per (host, port); one transient failure
     gets a fresh-connection retry, then the object is reported lost
-    (the caller maps that to an object-lost error). ``timeout``
-    bounds each socket operation, so a black-holed peer surfaces
-    ``socket.timeout`` instead of hanging the caller."""
+    (the caller maps that to an object-lost error).
+
+    ``timeout`` is the CALLER's deadline: when set, it bounds every
+    socket phase (connect, handshake, request) and a slow peer
+    re-raises ``socket.timeout`` immediately. When None ("block until
+    available"), socket ops still carry a 60 s liveness bound, but a
+    trip of it counts as a transient failure (retry, then
+    object-lost) — never a timeout error the caller didn't opt into."""
     key = (str(host), int(port))
+    sock_timeout = timeout if timeout is not None else 60.0
     last_err: Optional[Exception] = None
     for attempt in range(2):
         with _peer_conns_lock:
             entry = _peer_conns.get(key)
         try:
             if entry is None:
-                entry = _open_peer_conn(*key)
+                entry = _open_peer_conn(
+                    *key, timeout=sock_timeout
+                )
                 with _peer_conns_lock:
                     cur = _peer_conns.get(key)
                     if cur is None:
@@ -175,16 +199,19 @@ def fetch_remote_object(
                             pass
             sock, lock = entry
             with lock:  # request/response pairs must not interleave
-                sock.settimeout(timeout)
+                sock.settimeout(sock_timeout)
                 _send_frame(
                     sock,
                     threading.Lock(),
                     {"op": "pull", "obj_id": obj_id},
                 )
                 resp = _recv_frame(sock)
-        except socket.timeout:
+        except socket.timeout as err:
             _drop_peer_conn(key, entry)
-            raise  # slow/hung peer: the caller's timeout semantics
+            if timeout is not None:
+                raise  # slow/hung peer: caller's timeout semantics
+            last_err = err  # liveness bound tripped: transient
+            continue
         except (OSError, wire.ControlFrameError) as err:
             last_err = err
             _drop_peer_conn(key, entry)
@@ -322,10 +349,17 @@ class RemoteNode:
         )
         self._thread.start()
 
+    # frames an agent may send the head on an established connection
+    _AGENT_OPS = frozenset({"result"})
+
     def _recv_loop(self):
         while True:
             try:
                 msg = _recv_frame(self.sock)
+                if msg is not None:
+                    # typed schema check (the protobuf role): known
+                    # op for THIS direction, declared fields typed
+                    wire.validate_frame(msg, self._AGENT_OPS)
             except (OSError, wire.ControlFrameError):
                 # a forbidden frame on an established agent connection
                 # means the peer is compromised or not ours: drop it
@@ -835,11 +869,12 @@ class ClusterServer:
         )
         try:
             msg = _recv_frame(conn, max_len=_MAX_HANDSHAKE_FRAME)
+            if msg is not None:
+                wire.validate_frame(msg, ("register",))
         except (OSError, socket.timeout, wire.ControlFrameError):
             msg = None
         if (
             not isinstance(msg, dict)
-            or msg.get("op") != "register"
             or (self._token is not None and msg.get("nonce") != nonce)
             or not wire.register_ok(self._token, msg)
         ):
@@ -1013,6 +1048,9 @@ class NodeAgent:
             "node_id": self.node_id,
             "num_cpus": self.num_cpus,
             "nonce": challenge.get("nonce", ""),
+            # in the frame before the MAC (the MAC covers every
+            # non-mac field; _send_frame stamps unversioned frames)
+            "v": wire.FRAME_VERSION,
         }
         if self._data_port:
             reg["data_port"] = self._data_port
@@ -1075,9 +1113,13 @@ class NodeAgent:
                 conn, lock, {"op": "challenge", "nonce": nonce}
             )
             msg = _recv_frame(conn, max_len=_MAX_HANDSHAKE_FRAME)
+            try:
+                if msg is not None:
+                    wire.validate_frame(msg, ("pull_auth",))
+            except wire.ControlFrameError:
+                msg = None
             if (
                 not isinstance(msg, dict)
-                or msg.get("op") != "pull_auth"
                 or msg.get("nonce") != nonce
                 or not wire.register_ok(wire.cluster_token(), msg)
             ):
@@ -1087,8 +1129,9 @@ class NodeAgent:
             conn.settimeout(None)
             while True:
                 req = _recv_frame(conn, max_len=_MAX_HANDSHAKE_FRAME)
-                if not isinstance(req, dict) or req.get("op") != "pull":
+                if req is None:
                     return
+                wire.validate_frame(req, ("pull",))
                 obj_id = str(req.get("obj_id", ""))
                 try:
                     payload = self.runtime.store.get(
@@ -1106,10 +1149,24 @@ class NodeAgent:
             except OSError:
                 pass
 
+    # frames the head may send an agent on the established connection
+    _HEAD_OPS = frozenset(
+        {
+            "cache_obj",
+            "free_objs",
+            "task",
+            "create_actor",
+            "actor_call",
+            "kill_actor",
+        }
+    )
+
     def _serve_loop(self):
         while True:
             try:
                 msg = _recv_frame(self.sock)
+                if msg is not None:
+                    wire.validate_frame(msg, self._HEAD_OPS)
             except (OSError, wire.ControlFrameError):
                 msg = None
             if msg is None:

@@ -317,10 +317,7 @@ class ObjectStore:
         loc = e.remote_loc
         try:
             blob = fetch_remote_object(
-                loc["host"],
-                loc["port"],
-                obj_id,
-                timeout=timeout if timeout is not None else 60.0,
+                loc["host"], loc["port"], obj_id, timeout=timeout
             )
         except (_socket.timeout, TimeoutError) as err:
             raise GetTimeoutError(

@@ -86,6 +86,108 @@ def control_dumps(obj: Any) -> bytes:
 
 
 # ---------------------------------------------------------------------------
+# Typed frame schemas (the reference's protobuf role)
+# ---------------------------------------------------------------------------
+
+# Control frames now carry a version ("v"); receivers tolerate its
+# absence (v0 peers) and unknown EXTRA fields (forward compatibility),
+# but every DECLARED field must be present with its declared type —
+# the validation role of the reference's typed messages
+# (``src/ray/protobuf/core_worker.proto``, ``node_manager.proto``).
+FRAME_VERSION = 1
+
+_BYTESY = (bytes, bytearray)
+_NUM = (int, float)
+
+# op -> {field: (types | object-for-opaque, required)}
+_SCHEMAS: Dict[str, Dict[str, tuple]] = {
+    "challenge": {"nonce": (str, True)},
+    "register": {
+        "node_id": (str, True),
+        "num_cpus": (_NUM, True),
+        "nonce": (str, False),
+        "hmac": (str, False),
+        "data_port": (int, False),
+    },
+    "registered": {"ok": (bool, True)},
+    "cache_obj": {
+        "obj_id": (str, True),
+        "payload": (_BYTESY, True),
+    },
+    "free_objs": {"ids": ((list, tuple), True)},
+    "task": {
+        "task_id": (str, True),
+        "func_id": (str, True),
+        "func": (_BYTESY, True),
+        "payload": (_BYTESY, True),
+        "name": ((str, type(None)), False),
+        "num_cpus": (_NUM, False),
+        "runtime_env": (object, False),  # opaque, post-auth
+    },
+    "create_actor": {
+        "actor_id": (str, True),
+        "cls": (_BYTESY, True),
+        "payload": (_BYTESY, True),
+        "options": (dict, False),
+    },
+    "actor_call": {
+        "task_id": (str, True),
+        "actor_id": (str, True),
+        "method": (str, True),
+        "payload": (_BYTESY, True),
+    },
+    "kill_actor": {"actor_id": (str, True)},
+    "result": {
+        "task_id": (str, True),
+        "ok": (bool, True),
+        "payload": (_BYTESY, False),
+        "name": (str, False),
+        "traceback": (str, False),
+        "node_obj": (dict, False),
+    },
+    "pull_auth": {"nonce": (str, True), "hmac": (str, False)},
+    "pull": {"obj_id": (str, True)},
+}
+
+
+def validate_frame(msg: Any, allowed_ops) -> Dict:
+    """Schema-check one control frame against the op's declared
+    fields AND the receiving context's allowed op set (an agent must
+    not accept head-only ops and vice versa). Raises
+    :class:`ControlFrameError`; returns the frame for chaining."""
+    if not isinstance(msg, dict):
+        raise ControlFrameError(
+            f"control frame is {type(msg).__name__}, not dict"
+        )
+    op = msg.get("op")
+    if op not in allowed_ops:
+        raise ControlFrameError(
+            f"op {op!r} not allowed in this context"
+        )
+    schema = _SCHEMAS.get(op)
+    if schema is None:
+        raise ControlFrameError(f"unknown op {op!r}")
+    for field, (types, required) in schema.items():
+        if field not in msg:
+            if required:
+                raise ControlFrameError(
+                    f"{op}: missing required field {field!r}"
+                )
+            continue
+        if types is object:
+            continue
+        if not isinstance(msg[field], types):
+            raise ControlFrameError(
+                f"{op}: field {field!r} has type "
+                f"{type(msg[field]).__name__}"
+            )
+    v = msg.get("v", 0)
+    if not isinstance(v, int):
+        raise ControlFrameError(f"{op}: version field not int")
+    return msg
+
+
+# ---------------------------------------------------------------------------
 # Shared-token authentication for the cluster handshake
 # ---------------------------------------------------------------------------
 

@@ -158,3 +158,47 @@ def test_register_hmac_gate(monkeypatch):
         s.close()
     finally:
         server.shutdown()
+
+def test_frame_schema_validation():
+    """Typed frame schemas (wire.validate_frame — the reference's
+    protobuf role, core_worker.proto): unknown ops, ops outside the
+    receiving context, missing required fields, and mistyped fields
+    all raise before any handler runs; extra fields and the version
+    stamp pass (forward compatibility)."""
+    import pytest
+
+    from ray_tpu.core import wire
+
+    ok = {
+        "op": "result",
+        "task_id": "t1",
+        "ok": True,
+        "payload": b"x",
+        "v": wire.FRAME_VERSION,
+        "future_field": 123,  # unknown extras tolerated
+    }
+    assert wire.validate_frame(ok, ("result",)) is ok
+
+    with pytest.raises(wire.ControlFrameError):  # unknown op
+        wire.validate_frame({"op": "nope"}, ("nope",))
+    with pytest.raises(wire.ControlFrameError):  # wrong context
+        wire.validate_frame(ok, ("task",))
+    with pytest.raises(wire.ControlFrameError):  # missing required
+        wire.validate_frame({"op": "result", "ok": True}, ("result",))
+    with pytest.raises(wire.ControlFrameError):  # mistyped field
+        wire.validate_frame(
+            {"op": "result", "task_id": 7, "ok": True}, ("result",)
+        )
+    with pytest.raises(wire.ControlFrameError):  # not a dict
+        wire.validate_frame([1, 2], ("result",))
+    with pytest.raises(wire.ControlFrameError):  # payload not bytes
+        wire.validate_frame(
+            {
+                "op": "actor_call",
+                "task_id": "t",
+                "actor_id": "a",
+                "method": "m",
+                "payload": "not-bytes",
+            },
+            ("actor_call",),
+        )
