@@ -215,7 +215,13 @@ CONFIGS = {
     # name -> (builder, default_budget_s, reward_target_note)
     "ppo_cartpole": (_ppo_cartpole, 150, "reward 150 (ref: @<=100k steps)"),
     "ppo_pong": (_ppo_pong, 420, "reward rising from ~-12 (ref: Pong max)"),
-    "impala_pong": (_impala_pong, 420, "reward rising (ref: Breakout async)"),
+    "impala_pong": (
+        _impala_pong,
+        420,
+        "throughput-focused async config; flat at <=1.8M-step "
+        "budgets (ref IMPALA-Pong consumes >20M frames across "
+        "32-128 workers)",
+    ),
     "sac_halfcheetah": (_sac_halfcheetah, 300, "reward rising (ref: 9k@400k)"),
     "ma_cartpole": (_ma_cartpole, 150, "shared-policy reward 150"),
 }

@@ -1,0 +1,89 @@
+"""Diagnostic: does IMPALA's policy MOVE on PongLite pixels?
+
+The 3600 s capture flatlined at ~-12 while PPO solved the task from
+the same model/obs pipeline. Two very different failure modes look
+identical in a reward curve:
+  (a) the policy never changes (broadcast/learner wiring) — entropy
+      stays at ln(6)=1.79 forever and vf_loss stays at its init;
+  (b) learning is real but slow at this sample scale (the reference's
+      own IMPALA-Pong budget is >20 M frames) — entropy declines,
+      vf explained variance rises, rewards crawl.
+This runs the e2e IMPALA Pong config for --budget seconds and logs
+the LEARNER stats trend (entropy / vf_loss / policy_loss / grad norm)
+next to the reward, which the e2e artifact does not record.
+
+Run: python benchmarks/diag_impala_pong.py [--budget 600]
+Writes benchmarks/diag_impala_pong.json
+"""
+
+import json
+import pathlib
+import sys
+import time
+
+
+def main():
+    budget = 600.0
+    if "--budget" in sys.argv:
+        budget = float(sys.argv[sys.argv.index("--budget") + 1])
+    sgd_iter = 1
+    if "--sgd-iter" in sys.argv:
+        sgd_iter = int(sys.argv[sys.argv.index("--sgd-iter") + 1])
+
+    import ray_tpu.env.pong_lite  # noqa: F401
+    from ray_tpu.algorithms.impala import IMPALAConfig
+
+    algo = (
+        IMPALAConfig()
+        .environment("PongLite-v0")
+        .rollouts(
+            num_rollout_workers=2,
+            num_envs_per_worker=8,
+            rollout_fragment_length=64,
+        )
+        .training(
+            train_batch_size=1024,
+            lr=4e-4,
+            entropy_coeff=0.01,
+            vf_loss_coeff=0.5,
+            grad_clip=40.0,
+            num_sgd_iter=sgd_iter,
+        )
+        .debugging(seed=0)
+        .build()
+    )
+    trace = []
+    t0 = time.perf_counter()
+    try:
+        while time.perf_counter() - t0 < budget:
+            r = algo.train()
+            info = r["info"]["learner"].get("default_policy", {})
+            row = {
+                "wall_s": round(time.perf_counter() - t0, 1),
+                "steps": int(r.get("num_env_steps_sampled", 0)),
+                "trained": int(r.get("num_env_steps_trained", 0)),
+                "reward": r.get("episode_reward_mean"),
+            }
+            for k in (
+                "entropy",
+                "vf_loss",
+                "policy_loss",
+                "total_loss",
+                "grad_gnorm",
+                "cur_lr",
+            ):
+                if k in info:
+                    row[k] = round(float(info[k]), 4)
+            trace.append(row)
+    finally:
+        algo.cleanup()
+    out = pathlib.Path(__file__).parent / "diag_impala_pong.json"
+    out.write_text(json.dumps({"sgd_iter": sgd_iter, "trace": trace[-400:]}, indent=1))
+    keep = [t for t in trace if "entropy" in t]
+    for t in keep[:: max(1, len(keep) // 12)]:
+        print(t)
+    print(f"wrote {out}")
+
+
+if __name__ == "__main__":
+    main()

@@ -22,6 +22,24 @@ def get_env_creator(env_spec) -> Callable[[EnvContext], Any]:
         return env_spec
     if env_spec in _env_registry:
         return _env_registry[env_spec]
+    if isinstance(env_spec, str) and (
+        env_spec.startswith(("PongLite", "Synthetic"))
+    ):
+        # in-repo envs register on import; pull them in so yaml/CLI
+        # runs can name them without a registration preamble
+        # (reference tuned-example UX)
+        import ray_tpu.env.pong_lite  # noqa: F401
+        import ray_tpu.env.synthetic_env  # noqa: F401
+
+        if env_spec in _env_registry:
+            return _env_registry[env_spec]
+        # recognized in-repo prefix but no such registration: fail
+        # fast at config time with the real names, instead of a
+        # confusing gymnasium NameNotFound inside every worker
+        raise ValueError(
+            f"unknown in-repo env {env_spec!r}; registered: "
+            f"{sorted(n for n in _env_registry)}"
+        )
 
     def gym_creator(cfg: EnvContext):
         import gymnasium as gym
