@@ -189,6 +189,11 @@ class Algorithm(Trainable):
             **{k: v for k, v in self._counters.items()},
         }
         results.update(self._collect_rollout_metrics())
+        from ray_tpu.execution.train_ops import (
+            NUM_ENV_STEPS_TRAINED as _TRAINED,
+        )
+
+        results[_TRAINED] = self._counters[_TRAINED]
         results["num_env_steps_sampled"] = self._counters[
             NUM_ENV_STEPS_SAMPLED
         ]
