@@ -36,6 +36,11 @@ def main():
     fleet = 0
     if "--fleet" in sys.argv:
         fleet = int(sys.argv[sys.argv.index("--fleet") + 1])
+    only_ops = None
+    if "--ops" in sys.argv:
+        only_ops = set(
+            sys.argv[sys.argv.index("--ops") + 1].split(",")
+        )
     n_blocks = max(4, int(gb * 1024 / BLOCK_MB))
     rows_per_block = BLOCK_MB * 1024 * 1024 // (ROW_PAYLOAD + 64)
 
@@ -48,8 +53,11 @@ def main():
         # stay node-resident (core/cluster data servers) and move
         # agent<->agent — the driver holds refs + locations only, so
         # its RSS stays flat at ANY data volume
+        # 64 KB: groupby/shuffle INTERMEDIATES (per-key partition
+        # blocks, ~data/blocks^2 bytes) must stay node-resident too,
+        # or the exchange routes them through the head
         os.environ.setdefault(
-            "RAY_TPU_NODE_OBJ_MIN_BYTES", str(256 * 1024)
+            "RAY_TPU_NODE_OBJ_MIN_BYTES", str(64 * 1024)
         )
         ray.init(
             num_cpus=0,
@@ -128,9 +136,19 @@ def main():
         "rss_mb_after": round(rss_mb(), 1),
     }
     print(f"# generated {total} rows / ~{data_gb:.1f} GB in {gen_s:.1f}s",
-          file=sys.stderr)
+          file=sys.stderr, flush=True)
+    out_path = pathlib.Path(__file__).parent / "data_at_volume.json"
+
+    def flush():
+        # write after EVERY op: a wall-clock-killed run still leaves
+        # the evidence gathered so far
+        out_path.write_text(json.dumps(report, indent=1))
+
+    flush()
 
     def run(name, fn):
+        if only_ops is not None and name not in only_ops:
+            return
         r0 = rss_mb()
         t = time.perf_counter()
         out = fn()
@@ -142,7 +160,8 @@ def main():
             "result": out,
         }
         print(f"# {name}: {wall:.1f}s rss {r0:.0f}->{rss_mb():.0f}MB",
-              file=sys.stderr)
+              file=sys.stderr, flush=True)
+        flush()
 
     run(
         "groupby_sum",
@@ -156,8 +175,6 @@ def main():
     b = Dataset(None, refs=refs[half : 2 * half])
     run("zip_halves_count", lambda: a.zip(b).count())
 
-    out_path = pathlib.Path(__file__).parent / "data_at_volume.json"
-    out_path.write_text(json.dumps(report, indent=1))
     print(json.dumps({"metric": "data_at_volume", **report}))
 
 

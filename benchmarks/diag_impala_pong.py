@@ -29,6 +29,7 @@ Writes benchmarks/diag_impala_pong.json
 """
 
 import json
+import math
 import pathlib
 import sys
 import time
@@ -99,7 +100,11 @@ def main():
     out = pathlib.Path(__file__).parent / "diag_impala_pong.json"
     sanitized = [
         {
-            k: (None if isinstance(v, float) and v != v else v)
+            k: (
+                None
+                if isinstance(v, float) and not math.isfinite(v)
+                else v
+            )
             for k, v in row.items()
         }
         for row in trace[-400:]

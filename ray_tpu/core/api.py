@@ -586,6 +586,17 @@ class _Runtime:
             loc = self.store.spill_location(v.id)
             if loc is not None:
                 return _ObjArg(v.id, spill_loc=loc)
+            # node-resident (fleet data plane): ship the node's data
+            # server address — a local worker pulls peer-style, and
+            # the driver never materializes the bytes (pulling here
+            # would defeat the per-node store for every head-executed
+            # task naming a fleet-produced ref)
+            rloc = self.store.remote_loc(v.id)
+            if rloc is not None:
+                return _ObjArg(
+                    v.id,
+                    remote_loc=(rloc["host"], rloc["port"]),
+                )
             return _ObjArg(
                 v.id, inline=self.store.get(v.id), has_inline=True
             )
@@ -648,6 +659,10 @@ class _Runtime:
             placement_group=pg,
             bundle_index=bundle_index,
         )
+        # spillover needs this: an agent executing a multi-return task
+        # splits the tuple NODE-SIDE (one node-resident object per
+        # return) so the parts never transit the head
+        trec.num_returns = num_returns
         self._submit_when_ready(trec, args, kwargs)
         return refs
 

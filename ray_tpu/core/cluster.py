@@ -234,8 +234,14 @@ def fetch_remote_object(
 def _node_obj_id(obj_id: str) -> str:
     """Key under which a node-resident object's serialized bytes live
     in the producing agent's LOCAL store (so the agent's LRU/spill
-    machinery manages them like any local object)."""
-    return f"nodeobj_{obj_id}"
+    machinery manages them like any local object). HASHED: the store
+    truncates shm segment names to the key's first 24 chars, so the
+    distinguishing part of the id must land early — split-return ids
+    (``{task_id}_{i}``) differ only at the tail and would collide."""
+    import hashlib
+
+    h = hashlib.sha1(obj_id.encode()).hexdigest()[:20]
+    return f"nodeobj_{h}"
 
 
 def node_obj_min_bytes() -> int:
@@ -395,6 +401,31 @@ class RemoteNode:
                 if msg.get("ok"):
                     node_obj = msg.get("node_obj")
                     if node_obj is not None and self.data_port:
+                        split = node_obj.get("split_sizes")
+                        if split is not None:
+                            # agent split the multi-return tuple
+                            # node-side: register each element as its
+                            # own remote object under the
+                            # pre-registered split ref ids; drop the
+                            # base entry (its pending split callback
+                            # dies with it)
+                            with self.state_lock:
+                                for i in range(len(split)):
+                                    self.owned_objs.add(
+                                        f"{task_id}_{i}"
+                                    )
+                            for i, sz in enumerate(split):
+                                self.runtime.store.put_remote(
+                                    f"{task_id}_{i}",
+                                    {
+                                        "node_id": self.node_id,
+                                        "host": self.data_host,
+                                        "port": self.data_port,
+                                        "size": int(sz),
+                                    },
+                                )
+                            self.runtime.store.free([task_id])
+                            continue
                         # bytes stayed on the agent: record the
                         # location only (per-node data plane) — the
                         # head pulls iff something here reads the ref
@@ -628,6 +659,9 @@ class RemoteNode:
                     "payload": payload,
                     "name": trec.name,
                     "num_cpus": trec.num_cpus,
+                    "num_returns": int(
+                        getattr(trec, "num_returns", 1)
+                    ),
                     "runtime_env": trec.msg.get("runtime_env"),
                 },
             )
@@ -809,18 +843,7 @@ class ClusterServer:
         self._event_thread = None
         kv_address = kv_address or os.environ.get("RAY_TPU_KV_ADDRESS")
         if kv_address:
-            import queue
-
-            from ray_tpu.parallel.distributed import KVClient
-
-            self._kv = KVClient(kv_address)
-            self._event_queue = queue.SimpleQueue()
-            self._event_thread = threading.Thread(
-                target=self._event_loop,
-                daemon=True,
-                name="cluster_event_pub",
-            )
-            self._event_thread.start()
+            self.attach_kv(kv_address)
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((host, port))
@@ -835,6 +858,37 @@ class ClusterServer:
     @property
     def address(self) -> str:
         return f"{self.host}:{self.port}"
+
+    def attach_kv(self, kv_address: str) -> None:
+        """(Re)bind the node-lifecycle event publisher to a KV pubsub
+        service. Also used when start_cluster_server is called on an
+        ALREADY-running server with a kv_address — the request must
+        take effect, not be silently dropped by idempotency."""
+        import queue
+
+        from ray_tpu.parallel.distributed import KVClient
+
+        if kv_address == getattr(self, "_kv_address", None):
+            return  # same service already bound — don't leak clients
+        # queue + thread must exist BEFORE _kv becomes non-None: a
+        # concurrent _publish_event gates on _kv and would otherwise
+        # hit a missing _event_queue mid-construction
+        if self._event_thread is None:
+            self._event_queue = queue.SimpleQueue()
+            self._event_thread = threading.Thread(
+                target=self._event_loop,
+                daemon=True,
+                name="cluster_event_pub",
+            )
+            self._event_thread.start()
+        old = self._kv
+        self._kv = KVClient(kv_address)
+        self._kv_address = kv_address
+        if old is not None and hasattr(old, "close"):
+            try:
+                old.close()
+            except Exception:
+                pass
 
     def _accept_loop(self):
         while True:
@@ -991,6 +1045,11 @@ def start_cluster_server(
     rt = api._require_runtime()
     if getattr(rt, "cluster", None) is None:
         rt.cluster = ClusterServer(rt, host, port, kv_address=kv_address)
+    elif kv_address is not None:
+        # idempotent server, but a NEW kv_address must still bind:
+        # callers asking for event publication on an already-running
+        # head would otherwise silently get none
+        rt.cluster.attach_kv(kv_address)
     return rt.cluster.address
 
 
@@ -1199,12 +1258,76 @@ class NodeAgent:
             frame["node_obj"] = node_obj
         _send_frame(self.sock, self.send_lock, frame)
 
-    def _send_value_result(self, task_id, value, name: str) -> None:
+    def _send_value_result(
+        self, task_id, value, name: str, num_returns: int = 1
+    ) -> None:
         """Serialize + send a success result, downgrading failures:
         an unserializable value becomes an error result, and a broken
         head socket is swallowed — this runs inside the local object
         store's on_ready callbacks, where an escaped exception would
-        kill the thread delivering every later local result."""
+        kill the thread delivering every later local result.
+
+        Multi-return tuples split NODE-SIDE when big: each element
+        becomes its own node-resident object (``{task_id}_{i}`` —
+        matching the head's pre-registered split ref ids), so exchange
+        partitions (Data groupby/shuffle) never transit the head."""
+        # multi-return on the data plane: serialize ELEMENTS once and
+        # decide residency by their total — serializing the whole
+        # tuple first would double the CPU and transient memory on
+        # exactly the exchange hot path this exists for
+        if (
+            self._data_port
+            and num_returns > 1
+            and isinstance(value, (tuple, list))
+            and len(value) == num_returns
+        ):
+            try:
+                blobs = [ser.dumps(v) for v in value]
+            except BaseException:
+                import traceback
+
+                try:
+                    self._send_result(
+                        task_id,
+                        ok=False,
+                        name=name,
+                        tb=traceback.format_exc(),
+                    )
+                except OSError:
+                    pass
+                return
+            if sum(len(b) for b in blobs) >= self._node_obj_min:
+                try:
+                    for i, blob in enumerate(blobs):
+                        self.runtime.store.put(
+                            _node_obj_id(f"{task_id}_{i}"), blob
+                        )
+                    self._send_result(
+                        task_id,
+                        ok=True,
+                        node_obj={
+                            "split_sizes": [len(b) for b in blobs]
+                        },
+                    )
+                except OSError:
+                    pass  # head gone
+                except BaseException:
+                    # a failed element store must become an error
+                    # result, not a dead callback thread (this runs
+                    # in the store's on_ready delivery)
+                    import traceback
+
+                    try:
+                        self._send_result(
+                            task_id,
+                            ok=False,
+                            name=name,
+                            tb=traceback.format_exc(),
+                        )
+                    except OSError:
+                        pass
+                return
+            # small tuple: fall through to the inline path below
         try:
             payload = ser.dumps(value)
         except BaseException:
@@ -1325,8 +1448,14 @@ class NodeAgent:
                 },
             )
             ref = refs[0]
+            n_ret = int(msg.get("num_returns", 1))
 
-            def on_ready(task_id=task_id, ref=ref, name=msg.get("name")):
+            def on_ready(
+                task_id=task_id,
+                ref=ref,
+                name=msg.get("name"),
+                n_ret=n_ret,
+            ):
                 try:
                     value = self.runtime.store.get(ref.id, timeout=0)
                 except Exception:
@@ -1343,7 +1472,10 @@ class NodeAgent:
                         pass
                     return
                 self._send_value_result(
-                    task_id, value, name or "spilled_task"
+                    task_id,
+                    value,
+                    name or "spilled_task",
+                    num_returns=n_ret,
                 )
                 self.runtime.store.free([ref.id])
 

@@ -122,6 +122,7 @@ _SCHEMAS: Dict[str, Dict[str, tuple]] = {
         "payload": (_BYTESY, True),
         "name": ((str, type(None)), False),
         "num_cpus": (_NUM, False),
+        "num_returns": (int, False),
         "runtime_env": (object, False),  # opaque, post-auth
     },
     "create_actor": {

@@ -34,11 +34,12 @@ class _ObjArg:
 
     __slots__ = (
         "obj_id", "shm_name", "inline", "has_inline", "spill_loc",
+        "remote_loc",
     )
 
     def __init__(
         self, obj_id, shm_name=None, inline=None, has_inline=False,
-        spill_loc=None,
+        spill_loc=None, remote_loc=None,
     ):
         self.obj_id = obj_id
         self.shm_name = shm_name
@@ -47,6 +48,10 @@ class _ObjArg:
         # (spill_uri, path): the object lives in spill storage; the
         # worker reads it from there directly
         self.spill_loc = spill_loc
+        # (host, port): the object's primary copy is NODE-RESIDENT on
+        # a fleet agent; the worker pulls from its data server
+        # directly — the driver never materializes the bytes
+        self.remote_loc = remote_loc
 
     def _read_spill(self, loc):
         from ray_tpu.core import serialization as ser
@@ -69,6 +74,28 @@ class _ObjArg:
             except Exception:
                 # spill file gone (freed / restored+evicted between
                 # marshal and here): fall back to a driver-API get
+                from ray_tpu.core.worker_api import worker_client
+
+                client = worker_client()
+                if client is None:
+                    raise
+                value = client.get(self.obj_id, timeout=120.0)
+            shm_cache[self.obj_id] = (None, value)
+            return value
+        if self.remote_loc is not None:
+            try:
+                from ray_tpu.core.cluster import fetch_remote_object
+
+                blob = fetch_remote_object(
+                    self.remote_loc[0],
+                    self.remote_loc[1],
+                    self.obj_id,
+                )
+                value = ser.loads(blob)
+            except Exception:
+                # node died / object freed between marshal and here:
+                # the driver get surfaces the canonical error (or the
+                # value, if it was re-homed)
                 from ray_tpu.core.worker_api import worker_client
 
                 client = worker_client()
