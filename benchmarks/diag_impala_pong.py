@@ -16,12 +16,17 @@ FINDINGS (r5, both regimes instrumented, 600 s each on the chip):
   - entropy_coeff=0.001, lr 6e-4, 2 epochs: the policy MOVES hard
     (entropy 1.10 -> 0.15 within 300 s) but collapses prematurely to
     a determinized bad policy (~-12.5) before reward signal arrives.
+  - entropy SCHEDULE 0.01 -> 0.002 with lr decay and 2 epochs
+    (benchmarks/impala_sched_pong.py, 3900 s / 1.85 M steps): the
+    policy settles at entropy ~0.4, the critic converges
+    (vf_loss ~0.005), reward stays -13 — committed, but to a
+    strategy the +-1-sparse reward never corrects at this scale.
   => gradients, broadcast, and V-trace wiring are all healthy; the
-  flat hour-budget curve is sparse-reward PG coefficient sensitivity
-  at a sample scale ~10x below the reference's own IMPALA-Pong
-  budget (>20 M frames across 32-128 workers). PPO escapes via
-  per-batch advantage normalization + clipped multi-epoch updates,
-  and solves the task on this host (+20.3).
+  flat curves are sparse-reward PG conditioning at a sample scale
+  ~10x below the reference's own IMPALA-Pong budget (>20 M frames
+  across 32-128 workers). PPO escapes via per-batch advantage
+  normalization + clipped multi-epoch updates, and solves the task
+  on this host (+20.3).
 
 Run: python benchmarks/diag_impala_pong.py [--budget 600]
       [--entropy C] [--lr LR] [--sgd-iter N]

@@ -1,0 +1,84 @@
+"""IMPALA PongLite with an entropy-coefficient schedule: hold 0.01 for
+exploration, anneal to 0.002 by 600k steps so the policy can commit
+once the critic is informative (the diag showed 0.01 pins the policy
+at uniform and 0.001 collapses it immediately; this ramps between the
+regimes). lr 6e-4 with decay, 2 SGD epochs per batch for reuse."""
+
+import json
+import pathlib
+import sys
+import time
+
+
+def main():
+    budget = float(sys.argv[1]) if len(sys.argv) > 1 else 3600.0
+    import ray_tpu.env.pong_lite  # noqa: F401
+    from ray_tpu.algorithms.impala import IMPALAConfig
+
+    algo = (
+        IMPALAConfig()
+        .environment("PongLite-v0")
+        .rollouts(
+            num_rollout_workers=2,
+            num_envs_per_worker=8,
+            rollout_fragment_length=64,
+        )
+        .training(
+            train_batch_size=1024,
+            lr=6e-4,
+            lr_schedule=[[0, 6e-4], [1500000, 2e-4]],
+            entropy_coeff=0.01,
+            entropy_coeff_schedule=[
+                [0, 0.01],
+                [150000, 0.008],
+                [600000, 0.002],
+                [1500000, 0.001],
+            ],
+            vf_loss_coeff=0.5,
+            grad_clip=40.0,
+            num_sgd_iter=2,
+        )
+        .debugging(seed=0)
+        .build()
+    )
+    trace = []
+    t0 = time.perf_counter()
+    try:
+        while time.perf_counter() - t0 < budget:
+            r = algo.train()
+            info = r["info"]["learner"].get("default_policy", {})
+            row = {
+                "wall_s": round(time.perf_counter() - t0, 1),
+                "steps": int(r.get("num_env_steps_sampled", 0)),
+                "reward": r.get("episode_reward_mean"),
+            }
+            for k in ("entropy", "vf_loss", "cur_lr"):
+                if k in info:
+                    row[k] = round(float(info[k]), 4)
+            trace.append(row)
+    finally:
+        algo.cleanup()
+    import math
+
+    clean = [
+        {
+            k: (
+                None
+                if isinstance(v, float) and not math.isfinite(v)
+                else v
+            )
+            for k, v in row.items()
+        }
+        for row in trace
+    ]
+    out = pathlib.Path("/root/repo/benchmarks/impala_sched_pong.json")
+    out.write_text(
+        json.dumps({"trace": clean[-500:]}, indent=1, allow_nan=False)
+    )
+    keep = [t for t in trace if t.get("reward") is not None]
+    for t in keep[:: max(1, len(keep) // 15)]:
+        print(t, flush=True)
+
+
+if __name__ == "__main__":
+    main()
