@@ -5,6 +5,7 @@ at uniform and 0.001 collapses it immediately; this ramps between the
 regimes). lr 6e-4 with decay, 2 SGD epochs per batch for reuse."""
 
 import json
+import math
 import pathlib
 import sys
 import time
@@ -58,8 +59,6 @@ def main():
             trace.append(row)
     finally:
         algo.cleanup()
-    import math
-
     clean = [
         {
             k: (
@@ -71,7 +70,7 @@ def main():
         }
         for row in trace
     ]
-    out = pathlib.Path("/root/repo/benchmarks/impala_sched_pong.json")
+    out = pathlib.Path(__file__).parent / "impala_sched_pong.json"
     out.write_text(
         json.dumps({"trace": clean[-500:]}, indent=1, allow_nan=False)
     )

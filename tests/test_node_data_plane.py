@@ -24,7 +24,7 @@ import ray_tpu.core.api as ray
 
 if __name__ == "__main__":
     ray.init(
-        num_cpus=2,
+        num_cpus=32,
         address=sys.argv[1],
         node_id=sys.argv[2],
     )
@@ -113,6 +113,69 @@ def test_peer_to_peer_consumption_no_head_bytes(two_agents):
     # the head never materialized the array: still location-only
     assert rt.store.remote_loc(ref.id) is not None
     assert rt.store._entries[ref.id].value is None
+
+
+def test_multi_return_splits_node_side(two_agents):
+    """A spilled multi-return task's tuple splits ON the producing
+    agent: each element registers as its own node-resident object
+    under the pre-registered split ref ids (the Data exchange's
+    partition pattern — groupby/shuffle map tasks), and a consumer
+    on another node pulls one element peer-to-peer with the head
+    never materializing any of them."""
+    rt = two_agents
+    from ray_tpu.util.placement_group import (
+        PlacementGroupSchedulingStrategy,
+        placement_group,
+        remove_placement_group,
+    )
+
+    # a bundle larger than the head's whole pool pins the task to an
+    # agent (pg tasks spill to their bundle's node); clamp to agent
+    # capacity so many-core hosts can't make the bundle unsatisfiable
+    need = min(float(int(rt.num_cpus) + 1), 32.0)
+    pg = placement_group(
+        [{"CPU": need}], strategy="STRICT_PACK"
+    )
+    assert pg.ready(timeout=30)
+    assert pg.bundle_nodes[0] in ("plane_a", "plane_b")
+
+    @ray.remote
+    def three_parts(n):
+        x = np.arange(3 * n, dtype=np.float64)
+        return x[:n], x[n : 2 * n], x[2 * n :]
+
+    parts = three_parts.options(
+        num_returns=3,
+        num_cpus=1,
+        scheduling_strategy=PlacementGroupSchedulingStrategy(pg),
+    ).remote(20_000)
+    try:
+        _run_split_asserts(rt, parts)
+    finally:
+        remove_placement_group(pg)
+
+
+def _run_split_asserts(rt, parts):
+    for p in parts:
+        assert rt.store.wait(p.id, timeout=30)
+    locs = [rt.store.remote_loc(p.id) for p in parts]
+    assert all(loc is not None for loc in locs), locs
+    assert all(
+        rt.store._entries[p.id].value is None for p in parts
+    )
+
+    cons = Consumer.options(placement_node="plane_b").remote()
+    total = ray.get(cons.total.remote(parts[1]))
+    assert total == float(
+        np.sum(np.arange(20_000, 40_000, dtype=np.float64))
+    )
+    # still never materialized at the head
+    assert all(
+        rt.store._entries[p.id].value is None for p in parts
+    )
+    # driver read pulls one element on demand
+    first = ray.get(parts[0])
+    assert first.shape == (20_000,) and first[-1] == 19_999
 
 
 def test_free_propagates_to_node_store(two_agents):
