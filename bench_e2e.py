@@ -49,6 +49,7 @@ def _ppo_cartpole():
             num_rollout_workers=1,
             num_envs_per_worker=4,
             rollout_fragment_length=256,
+            sample_prefetch=1,
         )
         .training(
             gamma=0.99, lr=3e-4, lambda_=0.95,
@@ -73,6 +74,9 @@ def _ppo_pong():
             num_rollout_workers=2,
             num_envs_per_worker=8,
             rollout_fragment_length=128,
+            # pipelined sampling (docs/pipeline.md): batch k+1 collects,
+            # concats and transfers while the SGD nest runs batch k
+            sample_prefetch=1,
         )
         .training(
             gamma=0.99, lr=2.5e-4, lambda_=0.95,
@@ -181,6 +185,7 @@ def _plumbing_ppo():
             num_rollout_workers=2,
             num_envs_per_worker=16,
             rollout_fragment_length=256,
+            sample_prefetch=1,
         )
         .training(
             train_batch_size=8192, sgd_minibatch_size=1024,
@@ -270,12 +275,15 @@ def run_plumbing(budget_s=None):
     return out
 
 
-def run_config(name, budget_s=None):
+def run_config(name, budget_s=None, overrides=None, artifact_suffix=""):
     builder, default_budget, note = CONFIGS.get(name) or (
         PLUMBING_CONFIGS[name]
     )
     budget = float(budget_s or default_budget)
-    algo = builder().build()
+    cfg = builder()
+    for k, v in (overrides or {}).items():
+        setattr(cfg, k, v)
+    algo = cfg.build()
     curve = []
     t0 = time.perf_counter()
     steps = 0
@@ -311,7 +319,7 @@ def run_config(name, budget_s=None):
         )
         curve = [curve[i] for i in idx]
     out = {
-        "name": name,
+        "name": name + artifact_suffix,
         "note": note,
         "env_steps": steps,
         "wall_clock_s": round(wall, 1),
@@ -323,7 +331,9 @@ def run_config(name, budget_s=None):
         "hardware": "1 TPU v5e chip (axon tunnel) + 1 host CPU core",
     }
     ARTIFACT_DIR.mkdir(parents=True, exist_ok=True)
-    (ARTIFACT_DIR / f"{name}.json").write_text(json.dumps(out, indent=1))
+    (ARTIFACT_DIR / f"{name}{artifact_suffix}.json").write_text(
+        json.dumps(out, indent=1)
+    )
     return out
 
 
@@ -335,13 +345,22 @@ def main():
     budget = None
     if "--budget" in args:
         budget = float(args[args.index("--budget") + 1])
+    # --prefetch N overrides config.sample_prefetch for A/B runs of the
+    # pipelined vs synchronous sampling path (0 = force synchronous);
+    # artifacts get a _prefetchN suffix so both sides persist
+    overrides = None
+    suffix = ""
+    if "--prefetch" in args:
+        n = int(args[args.index("--prefetch") + 1])
+        overrides = {"sample_prefetch": n}
+        suffix = f"_prefetch{n}"
     if "--plumbing" in args:
         run_plumbing(budget)
         return
     names = [only] if only else list(CONFIGS)
     summary = {}
     for name in names:
-        r = run_config(name, budget)
+        r = run_config(name, budget, overrides, suffix)
         summary[name] = {
             "env_steps_per_sec": r["env_steps_per_sec"],
             "best_reward": r["best_reward"],

@@ -38,6 +38,15 @@ class AlgorithmConfig:
         self.compress_observations = False
         self.ignore_worker_failures = False
         self.recreate_failed_workers = False
+        # Pipelined sampling (docs/pipeline.md): >0 overlaps rollout
+        # collection + host concat + device transfer of batch k+1 with
+        # the SGD nest of batch k, at a bounded staleness of
+        # `sample_prefetch` updates. 0 (default) keeps the fully
+        # synchronous loop — bit-identical to the classic path.
+        self.sample_prefetch = 0
+        # Outstanding sample requests per rollout worker for the async
+        # paths (reference max_requests_in_flight_per_rollout_worker).
+        self.max_requests_in_flight_per_rollout_worker = 2
 
         # training (reference :717)
         self.gamma = 0.99
@@ -131,6 +140,8 @@ class AlgorithmConfig:
         observation_filter: Optional[str] = None,
         ignore_worker_failures: Optional[bool] = None,
         recreate_failed_workers: Optional[bool] = None,
+        sample_prefetch: Optional[int] = None,
+        max_requests_in_flight_per_rollout_worker: Optional[int] = None,
         **kwargs,
     ) -> "AlgorithmConfig":
         if num_rollout_workers is not None:
@@ -147,6 +158,12 @@ class AlgorithmConfig:
             self.ignore_worker_failures = ignore_worker_failures
         if recreate_failed_workers is not None:
             self.recreate_failed_workers = recreate_failed_workers
+        if sample_prefetch is not None:
+            self.sample_prefetch = sample_prefetch
+        if max_requests_in_flight_per_rollout_worker is not None:
+            self.max_requests_in_flight_per_rollout_worker = (
+                max_requests_in_flight_per_rollout_worker
+            )
         return self
 
     def training(

@@ -4,7 +4,10 @@ Counterpart of the reference's ``rllib/algorithms/appo/appo.py``
 (APPOConfig extends ImpalaConfig; ``after_train_step`` updates the
 target net + adapts the KL coeff) and ``appo_torch_policy.py`` (V-trace
 weighted PPO-clip surrogate against a periodically-frozen "old policy"
-target network).
+target network). Worker polling rides IMPALA's shared
+``AsyncRequestsManager`` (execution/parallel_requests.py): per-worker
+in-flight caps, ``ray.wait`` harvest, dead workers dropped and reported
+(recreated when ``recreate_failed_workers`` is set).
 
 Loss semantics (appo_torch_policy.py:160-270): V-trace advantages are
 computed against the TARGET policy's logits; the surrogate ratio is

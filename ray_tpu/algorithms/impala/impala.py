@@ -12,9 +12,12 @@ TPU-first design:
   - the learner thread consumes whole unroll batches and runs ONE jitted
     program: model forward over (B·T), V-trace associative scan, loss,
     gradient, optimizer;
-  - sampling and learning overlap: async ``sample.remote`` polls feed the
-    thread's queue while weights broadcast back to the workers that
-    produced each batch (reference impala.py:645).
+  - sampling and learning overlap: the shared
+    ``execution.parallel_requests.AsyncRequestsManager`` keeps every
+    worker saturated with ``sample.remote`` calls and harvests them
+    with ``ray.wait`` to feed the thread's queue, while weights
+    broadcast back to the workers that produced each batch (reference
+    impala.py:645 + parallel_requests.py).
 """
 
 from __future__ import annotations
@@ -35,6 +38,7 @@ from ray_tpu.algorithms.algorithm import (
 from ray_tpu.algorithms.algorithm_config import AlgorithmConfig
 from ray_tpu.data.sample_batch import DEFAULT_POLICY_ID, SampleBatch
 from ray_tpu.execution.learner_thread import LearnerThread
+from ray_tpu.execution.parallel_requests import AsyncRequestsManager
 from ray_tpu.execution.train_ops import (
     NUM_AGENT_STEPS_TRAINED,
     NUM_ENV_STEPS_TRAINED,
@@ -79,6 +83,7 @@ class IMPALAConfig(AlgorithmConfig):
         entropy_coeff_schedule=None,
         broadcast_interval: Optional[int] = None,
         learner_queue_size: Optional[int] = None,
+        max_sample_requests_in_flight_per_worker: Optional[int] = None,
         **kwargs,
     ) -> "IMPALAConfig":
         super().training(**kwargs)
@@ -100,6 +105,10 @@ class IMPALAConfig(AlgorithmConfig):
             self.broadcast_interval = broadcast_interval
         if learner_queue_size is not None:
             self.learner_queue_size = learner_queue_size
+        if max_sample_requests_in_flight_per_worker is not None:
+            self.max_sample_requests_in_flight_per_worker = (
+                max_sample_requests_in_flight_per_worker
+            )
         return self
 
     def aggregation(
@@ -392,7 +401,6 @@ class IMPALA(Algorithm):
             ),
         )
         self._learner_thread.start()
-        self._in_flight: Dict = {}  # ref -> worker
         # fragment accumulator: feed the learner whole train batches
         # (reference impala.py:614 concatenates sample batches to
         # train_batch_size before the learner queue), halving dispatch
@@ -411,6 +419,19 @@ class IMPALA(Algorithm):
         ]
         self._agg_rr = 0
         self._agg_in_flight: list = []
+        # worker polling rides the shared AsyncRequestsManager
+        # (reference parallel_requests.py feeding impala.py:614): refs
+        # mode when aggregation actors consume the fragment refs
+        # directly, values mode otherwise
+        self._sample_manager = AsyncRequestsManager(
+            self.workers.remote_workers(),
+            max_remote_requests_in_flight_per_worker=int(
+                config.get(
+                    "max_sample_requests_in_flight_per_worker", 2
+                )
+            ),
+            return_object_refs=bool(self._aggregators),
+        )
 
     def training_step(self) -> Dict:
         """reference impala.py:614."""
@@ -445,92 +466,79 @@ class IMPALA(Algorithm):
             # keep each worker saturated with sample requests — unless
             # the learner is backed up (backpressure: stop asking for
             # fragments we'd only buffer on the driver)
-            max_inflight = self.config.get(
-                "max_sample_requests_in_flight_per_worker", 2
-            )
+            mgr = self._sample_manager
+            # heal drift: workers recreated by Algorithm.step's generic
+            # failure path join the rotation here (no-op for known ones)
+            mgr.add_workers(workers)
             backlogged = len(self._train_ready) >= 4
-            counts: Dict = {}
-            for ref, w in self._in_flight.items():
-                counts[id(w)] = counts.get(id(w), 0) + 1
             if not backlogged:
-                for w in workers:
-                    while counts.get(id(w), 0) < max_inflight:
-                        self._in_flight[w.sample.remote()] = w
-                        counts[id(w)] = counts.get(id(w), 0) + 1
+                mgr.submit_available()
 
-            if self._in_flight:
-                ready, _ = ray.wait(
-                    list(self._in_flight.keys()),
-                    num_returns=1,
-                    timeout=2.0,
-                )
+            if mgr.in_flight():
+                ready = mgr.get_ready(timeout=2.0)
             else:
                 # fully backpressured: nothing in flight to wait on —
                 # give the learner a beat instead of spinning
                 time.sleep(0.05)
-                ready = []
+                ready = {}
             target = int(self.config.get("train_batch_size", 500))
-            for ref in ready:
-                w = self._in_flight.pop(ref)
-                if self._aggregators:
-                    # tree aggregation: hand the fragment ref to an
-                    # aggregation actor; the concat to a full train
-                    # batch happens in ITS process, not the driver's.
-                    # Marshalling happens synchronously at .remote(),
-                    # so the fragment ref can be freed right after —
-                    # and a crashed worker's errored ref re-raises
-                    # here, which must skip the fragment like the
-                    # direct path does.
-                    agg = self._aggregators[
-                        self._agg_rr % len(self._aggregators)
-                    ]
-                    self._agg_rr += 1
-                    try:
-                        self._agg_in_flight.append(
-                            agg.aggregate.remote(ref)
+            for w, items in ready.items():
+                for item in items:
+                    if self._aggregators:
+                        # tree aggregation (refs mode): hand the
+                        # fragment ref to an aggregation actor; the
+                        # concat to a full train batch happens in ITS
+                        # process, not the driver's. Marshalling
+                        # happens synchronously at .remote(), so the
+                        # fragment ref can be freed right after — and
+                        # a crashed worker's errored ref re-raises
+                        # here, which drops the worker like the value
+                        # mode harvest does.
+                        agg = self._aggregators[
+                            self._agg_rr % len(self._aggregators)
+                        ]
+                        self._agg_rr += 1
+                        try:
+                            self._agg_in_flight.append(
+                                agg.aggregate.remote(item)
+                            )
+                        except (
+                            ray.core.object_store.RayActorError,
+                            ray.core.object_store.WorkerCrashedError,
+                            ray.core.object_store.RayTaskError,
+                        ):
+                            mgr.report_dead(w)
+                            continue
+                        finally:
+                            ray.free([item])
+                    else:
+                        batch = item
+                        self._counters[NUM_ENV_STEPS_SAMPLED] += (
+                            batch.env_steps()
                         )
-                    except (
-                        ray.core.object_store.RayActorError,
-                        ray.core.object_store.WorkerCrashedError,
-                        ray.core.object_store.RayTaskError,
-                    ):
-                        continue
-                    finally:
-                        ray.free([ref])
-                else:
-                    try:
-                        batch = ray.get(ref)
-                    except (
-                        ray.core.object_store.RayActorError,
-                        ray.core.object_store.WorkerCrashedError,
-                    ):
-                        continue
-                    finally:
-                        ray.free([ref])
-                    self._counters[NUM_ENV_STEPS_SAMPLED] += (
-                        batch.env_steps()
-                    )
-                    # accumulate fragments into whole train batches
-                    # (reference impala.py:614 — the learner consumes
-                    # train_batch_size, not rollout fragments)
-                    self._frag_buf.append(batch)
-                    self._frag_steps += batch.env_steps()
-                    if self._frag_steps >= target:
-                        from ray_tpu.data.sample_batch import (
-                            concat_samples,
-                        )
+                        # accumulate fragments into whole train batches
+                        # (reference impala.py:614 — the learner
+                        # consumes train_batch_size, not fragments)
+                        self._frag_buf.append(batch)
+                        self._frag_steps += batch.env_steps()
+                        if self._frag_steps >= target:
+                            from ray_tpu.data.sample_batch import (
+                                concat_samples,
+                            )
 
-                        self._train_ready.append(
-                            concat_samples(self._frag_buf)
-                        )
-                        self._frag_buf = []
-                        self._frag_steps = 0
-                # broadcast the learner-published weights back to the
-                # producer (reference update_workers_if_necessary,
-                # impala.py:645) — cheap: no device access here
-                self._maybe_broadcast(w)
-                if not backlogged:
-                    self._in_flight[w.sample.remote()] = w
+                            self._train_ready.append(
+                                concat_samples(self._frag_buf)
+                            )
+                            self._frag_buf = []
+                            self._frag_steps = 0
+                    # broadcast the learner-published weights back to
+                    # the producer (reference
+                    # update_workers_if_necessary, impala.py:645) —
+                    # cheap: no device access here
+                    self._maybe_broadcast(w)
+                    if not backlogged:
+                        mgr.submit(worker=w)
+            self._handle_dead_workers(mgr)
 
             # feed complete train batches; keep what the queue won't take
             while self._train_ready:
@@ -573,7 +581,23 @@ class IMPALA(Algorithm):
         return {
             DEFAULT_POLICY_ID: learner_info,
             "learner_queue": lt.stats(),
+            "sample_manager": self._sample_manager.stats(),
         }
+
+    def _handle_dead_workers(self, mgr: AsyncRequestsManager) -> None:
+        """Drop-and-report protocol for the async loop: a dead worker
+        leaves the sampling rotation (the manager already stopped
+        submitting to it); recreate replacements when configured, never
+        abort the actor-learner loop."""
+        dead = mgr.take_dead_workers()
+        if not dead:
+            return
+        self._counters["num_dead_rollout_workers"] += len(dead)
+        if self.config.get("recreate_failed_workers"):
+            new = self.workers.replace_failed_workers(dead)
+            mgr.add_workers(new)
+        else:
+            self.workers.remove_workers(dead)
 
     def _maybe_broadcast(self, w) -> None:
         """Ship the learner thread's latest published weights to worker

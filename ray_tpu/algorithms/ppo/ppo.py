@@ -6,22 +6,31 @@ torch loss ``rllib/algorithms/ppo/ppo_torch_policy.py:69``. The learner side
 — advantage standardization, the clipped surrogate/vf/entropy loss, and the
 ``num_sgd_iter × minibatches`` SGD nest — runs as one jitted shard_map
 program on the TPU mesh (see JaxPolicy).
+
+``config.sample_prefetch > 0`` switches ``training_step`` to the
+pipelined loop (docs/pipeline.md): a SamplePrefetcher thread collects,
+concatenates and ``prepare_batch``-es batch k+1 and a DeviceFeeder
+transfers it while the TPU runs the SGD nest for batch k. Off by
+default: the synchronous path below stays bit-identical to the classic
+loop on a fixed seed.
 """
 
 from __future__ import annotations
 
+import queue
 from typing import Dict, Optional
 
 import jax.numpy as jnp
 import numpy as np
 
+import ray_tpu as ray
 from ray_tpu.algorithms.algorithm import (
     Algorithm,
     NUM_AGENT_STEPS_SAMPLED,
     NUM_ENV_STEPS_SAMPLED,
 )
 from ray_tpu.algorithms.algorithm_config import AlgorithmConfig
-from ray_tpu.data.sample_batch import SampleBatch
+from ray_tpu.data.sample_batch import DEFAULT_POLICY_ID, SampleBatch
 from ray_tpu.evaluation.postprocessing import compute_gae_for_sample_batch
 from ray_tpu.execution.rollout_ops import synchronous_parallel_sample
 from ray_tpu.execution.train_ops import train_one_step
@@ -182,6 +191,14 @@ def _explained_variance(y, pred):
     return jnp.maximum(-1.0, 1.0 - diff_var / (y_var + 1e-8))
 
 
+def _standardize_advantages(b) -> None:
+    """reference ppo.py:415 standardize_fields."""
+    adv = np.asarray(b[SampleBatch.ADVANTAGES], np.float32)
+    b[SampleBatch.ADVANTAGES] = (
+        (adv - adv.mean()) / max(1e-4, adv.std())
+    ).astype(np.float32)
+
+
 class PPO(Algorithm):
     _default_policy_class = PPOJaxPolicy
 
@@ -189,8 +206,15 @@ class PPO(Algorithm):
     def get_default_config(cls) -> PPOConfig:
         return PPOConfig(cls)
 
+    def setup(self, config: Dict) -> None:
+        super().setup(config)
+        self._sample_pipeline = None
+        self._prefetch_feeder = None
+
     def training_step(self) -> Dict:
         """reference ppo.py:400."""
+        if self._use_sample_prefetch():
+            return self._training_step_prefetch()
         train_batch = synchronous_parallel_sample(
             worker_set=self.workers,
             max_env_steps=self.config["train_batch_size"],
@@ -204,17 +228,11 @@ class PPO(Algorithm):
         # (reference ppo.py:415 standardize_fields)
         from ray_tpu.data.sample_batch import MultiAgentBatch
 
-        def _standardize(b):
-            adv = np.asarray(b[SampleBatch.ADVANTAGES], np.float32)
-            b[SampleBatch.ADVANTAGES] = (
-                (adv - adv.mean()) / max(1e-4, adv.std())
-            ).astype(np.float32)
-
         if isinstance(train_batch, MultiAgentBatch):
             for b in train_batch.policy_batches.values():
-                _standardize(b)
+                _standardize_advantages(b)
         else:
-            _standardize(train_batch)
+            _standardize_advantages(train_batch)
 
         train_info = train_one_step(self, train_batch)
 
@@ -230,3 +248,120 @@ class PPO(Algorithm):
         ):
             self.workers.sync_filters()
         return train_info
+
+    # -- pipelined sampling (config.sample_prefetch) ---------------------
+
+    def _use_sample_prefetch(self) -> bool:
+        return (
+            int(self.config.get("sample_prefetch") or 0) > 0
+            and self.workers.num_remote_workers() > 0
+            # multi-policy batches need per-policy prepare/learn
+            # plumbing; they stay on the synchronous path
+            and not self.config.get("policies")
+        )
+
+    def _build_sample_pipeline(self) -> None:
+        from ray_tpu.execution.device_feed import DeviceFeeder
+        from ray_tpu.execution.rollout_ops import SamplePrefetcher
+
+        policy = self.get_policy()
+        depth = max(1, int(self.config.get("sample_prefetch") or 1))
+        feeder = DeviceFeeder(policy.batch_shardings, capacity=depth)
+
+        def deliver(batch):
+            # runs on the prefetch thread, overlapping the SGD nest:
+            # standardize + host-tree assembly here, device transfer on
+            # the feeder thread, learn on the driver thread
+            _standardize_advantages(batch)
+            tree, bsize = policy.prepare_batch(batch)
+            feeder.put(tree, (bsize, batch.env_steps(), batch.count))
+
+        self._prefetch_feeder = feeder
+        self._sample_pipeline = SamplePrefetcher(
+            self.workers,
+            target_steps=int(self.config["train_batch_size"]),
+            deliver=deliver,
+            max_in_flight=int(
+                self.config.get(
+                    "max_requests_in_flight_per_rollout_worker", 2
+                )
+            ),
+        )
+
+    def _training_step_prefetch(self) -> Dict:
+        from ray_tpu.execution.train_ops import (
+            NUM_AGENT_STEPS_TRAINED,
+            NUM_ENV_STEPS_TRAINED,
+        )
+
+        if self._sample_pipeline is None:
+            self._build_sample_pipeline()
+        pipe = self._sample_pipeline
+        while True:
+            if not pipe.healthy():
+                raise pipe.error or RuntimeError(
+                    "sample pipeline thread died"
+                )
+            self._recover_pipeline_workers(pipe)
+            try:
+                dev, (bsize, env_steps, rows) = (
+                    self._prefetch_feeder.get(timeout=1.0)
+                )
+                break
+            except queue.Empty:
+                continue
+        self._counters[NUM_ENV_STEPS_SAMPLED] += env_steps
+        self._counters[NUM_AGENT_STEPS_SAMPLED] += env_steps
+
+        policy = self.get_policy()
+        info = policy.learn_on_device_batch(dev, bsize)
+        self._counters[NUM_ENV_STEPS_TRAINED] += env_steps
+        self._counters[NUM_AGENT_STEPS_TRAINED] += rows
+
+        self.workers.sync_weights(
+            global_vars={
+                "timestep": self._counters[NUM_ENV_STEPS_SAMPLED]
+            }
+        )
+        if self.config.get("observation_filter") not in (
+            None,
+            "NoFilter",
+        ):
+            self.workers.sync_filters()
+        self._recover_pipeline_workers(pipe)
+        return {
+            DEFAULT_POLICY_ID: info,
+            "sample_pipeline": pipe.stats(),
+        }
+
+    def _recover_pipeline_workers(self, pipe) -> None:
+        """Dead workers reported by the prefetcher's request manager:
+        recreate (no 30 s ping probe — the manager already observed the
+        death), ignore, or surface per the failure config."""
+        dead = pipe.take_dead_workers()
+        if not dead:
+            return
+        self._counters["num_dead_rollout_workers"] += len(dead)
+        if self.config.get("recreate_failed_workers"):
+            new = self.workers.replace_failed_workers(dead)
+            pipe.add_workers(new)
+        elif not self.config.get("ignore_worker_failures"):
+            raise ray.core.object_store.RayActorError(
+                f"{len(dead)} rollout worker(s) died in the sample "
+                "pipeline"
+            )
+
+    def cleanup(self) -> None:
+        pipe = getattr(self, "_sample_pipeline", None)
+        feeder = getattr(self, "_prefetch_feeder", None)
+        if pipe is not None:
+            # flag first: a deliver blocked on feeder backpressure only
+            # wakes when the feeder stops (its put raises)
+            pipe.request_stop()
+        if feeder is not None:
+            feeder.stop()
+            self._prefetch_feeder = None
+        if pipe is not None:
+            pipe.stop()
+            self._sample_pipeline = None
+        super().cleanup()

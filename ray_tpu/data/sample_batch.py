@@ -261,6 +261,35 @@ class SampleBatch(dict):
 DEFAULT_POLICY_ID = "default_policy"
 
 
+def _concat_arrays(vals: List[np.ndarray]) -> np.ndarray:
+    """Row-concat with a preallocated output for uniform-dtype columns.
+
+    This concat sits on the sampling pipeline's critical path (the
+    prefetch thread assembles train batches from rollout fragments while
+    the SGD nest runs), so it avoids the generic ``np.concatenate``
+    dtype-promotion machinery: one ``np.empty`` of the final column and
+    a single-copy assemble. Mixed dtypes/shapes fall through to numpy's
+    promotion rules unchanged."""
+    if len(vals) == 1:
+        # still a copy: fragments can be read-only views of the shm
+        # object plane, and concat output has always been writable
+        return vals[0].copy()
+    first = vals[0]
+    dtype, trail = first.dtype, first.shape[1:]
+    if any(
+        v.dtype != dtype or v.shape[1:] != trail for v in vals[1:]
+    ):
+        return np.concatenate(vals, axis=0)
+    total = sum(v.shape[0] for v in vals)
+    out = np.empty((total,) + trail, dtype)
+    pos = 0
+    for v in vals:
+        n = v.shape[0]
+        out[pos : pos + n] = v
+        pos += n
+    return out
+
+
 def concat_samples(
     batches: Sequence[Union[SampleBatch, "MultiAgentBatch"]]
 ) -> Union[SampleBatch, "MultiAgentBatch"]:
@@ -301,13 +330,16 @@ def concat_samples(
         out = {}
         pools = [np.asarray(b[_FRAME_POOL]) for b in batches]
         offsets = np.cumsum([0] + [len(p) for p in pools[:-1]])
-        out[_FRAME_POOL] = np.concatenate(pools, axis=0)
-        out[_FRAME_IDX] = np.concatenate(
-            [
-                np.asarray(b[_FRAME_IDX], np.int32) + np.int32(off)
-                for b, off in zip(batches, offsets)
-            ]
-        )
+        out[_FRAME_POOL] = _concat_arrays(pools)
+        # offset-add straight into the preallocated index column (the
+        # per-batch `idx + off` temporaries were a copy each)
+        idxs = [np.asarray(b[_FRAME_IDX], np.int32) for b in batches]
+        idx_out = np.empty(sum(len(i) for i in idxs), np.int32)
+        pos = 0
+        for v, off in zip(idxs, offsets):
+            np.add(v, np.int32(off), out=idx_out[pos : pos + len(v)])
+            pos += len(v)
+        out[_FRAME_IDX] = idx_out
         keys = [
             k
             for k in batches[0].keys()
@@ -321,7 +353,7 @@ def concat_samples(
             continue
         vals = [b[k] for b in batches if k in b]
         if vals and isinstance(vals[0], np.ndarray):
-            out[k] = np.concatenate(vals, axis=0)
+            out[k] = _concat_arrays(vals)
         else:
             out[k] = list(itertools.chain.from_iterable(vals))
     return SampleBatch(out)

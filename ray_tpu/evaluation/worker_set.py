@@ -188,6 +188,28 @@ class WorkerSet:
                 bad.append(i + 1)
         return bad
 
+    def remove_workers(self, workers: List) -> None:
+        """Drop specific worker handles from the set (no ping probe).
+        Used when an AsyncRequestsManager already OBSERVED the workers
+        dead — probe_unhealthy_workers would spend a 30 s get-timeout
+        per corpse rediscovering the fact."""
+        drop = {id(w) for w in workers}
+        self._remote_workers = [
+            w for w in self._remote_workers if id(w) not in drop
+        ]
+
+    def replace_failed_workers(self, dead: List) -> List:
+        """Remove observed-dead workers and spawn replacements; returns
+        the new handles (already weight-synced)."""
+        if not dead:
+            return []
+        self.remove_workers(dead)
+        before = len(self._remote_workers)
+        self.add_workers(len(dead))
+        new = self._remote_workers[before:]
+        self.sync_weights()
+        return new
+
     def recreate_failed_workers(self) -> None:
         bad = self.probe_unhealthy_workers()
         if not bad:

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from typing import Any, Optional
 
 import jax
@@ -23,6 +24,10 @@ class DeviceFeeder:
         self._in: "queue.Queue" = queue.Queue(maxsize=capacity)
         self._out: "queue.Queue" = queue.Queue(maxsize=capacity)
         self._stopped = False
+        # guards the stopped flag vs. concurrent put(): without it a
+        # producer racing stop() could block forever on a full inqueue
+        # whose consumer thread has already exited
+        self._lock = threading.Lock()
         self._thread = threading.Thread(
             target=self._run, daemon=True, name="device_feeder"
         )
@@ -48,16 +53,41 @@ class DeviceFeeder:
                 else:
                     dev = jax.device_put(host_batch)
                 jax.block_until_ready(dev)
-                self._out.put((dev, meta))
+                out = (dev, meta)
             except Exception as e:  # surface to consumer, meta intact
-                self._out.put((e, meta))
+                out = (e, meta)
+            # bounded put that stays responsive to stop(): a consumer
+            # that vanished must not wedge this thread on a full
+            # outqueue and with it the whole interpreter shutdown
+            while True:
+                with self._lock:
+                    if self._stopped:
+                        return
+                try:
+                    self._out.put(out, timeout=0.1)
+                    break
+                except queue.Full:
+                    continue
 
     def put(self, host_batch: Any, meta: Any = None) -> None:
         """Enqueue a host batch for transfer; ``meta`` rides along
-        untransferred (batch size, env-step count, ...)."""
-        if self._stopped:
-            raise RuntimeError("feeder stopped")
-        self._in.put((host_batch, meta))
+        untransferred (batch size, env-step count, ...). Blocks while
+        the pipeline is full (backpressure); raises once the feeder is
+        stopped — including when stop() lands mid-block."""
+        while True:
+            # check-and-insert under one lock acquisition: once stop()
+            # flips the flag (same lock), no item can slip in behind
+            # the drain/sentinel — a producer blocked on backpressure
+            # deterministically raises instead
+            with self._lock:
+                if self._stopped:
+                    raise RuntimeError("feeder stopped")
+                try:
+                    self._in.put_nowait((host_batch, meta))
+                    return
+                except queue.Full:
+                    pass
+            time.sleep(0.01)
 
     def get(self, timeout: Optional[float] = None):
         """Dequeue the next ``(device_batch, meta)`` pair (blocking).
@@ -70,6 +100,35 @@ class DeviceFeeder:
     def qsize(self) -> int:
         return self._out.qsize()
 
-    def stop(self) -> None:
-        self._stopped = True
-        self._in.put(None)
+    @staticmethod
+    def _drain(q: "queue.Queue") -> None:
+        while True:
+            try:
+                q.get_nowait()
+            except queue.Empty:
+                return
+
+    def stop(self, join_timeout: float = 10.0) -> None:
+        """Idempotent shutdown: reject new puts, deliver the sentinel
+        even through a full inqueue, keep both queues draining so a
+        blocked ``_run`` can reach it, and join the thread with a
+        timeout (a daemon thread killed inside a jitted XLA call aborts
+        the interpreter instead of exiting cleanly)."""
+        with self._lock:
+            self._stopped = True
+        # make room for the sentinel: pending host batches are dead
+        # weight once stopped
+        while True:
+            try:
+                self._in.put_nowait(None)
+                break
+            except queue.Full:
+                self._drain(self._in)
+        deadline = time.monotonic() + join_timeout
+        while self._thread.is_alive() and time.monotonic() < deadline:
+            # _run may be blocked on a full outqueue between its stop
+            # checks; keep it moving
+            self._drain(self._out)
+            self._thread.join(timeout=0.1)
+        self._drain(self._in)
+        self._drain(self._out)

@@ -1,0 +1,254 @@
+"""Async request management for rollout-worker fleets.
+
+Counterpart of the reference's ``rllib/execution/parallel_requests.py``
+(``AsyncRequestsManager`` / ``asynchronous_parallel_requests``), the host
+half of the sampling pipeline: keep up to
+``max_remote_requests_in_flight_per_worker`` requests outstanding per
+actor, harvest completions with ``ray.wait`` (stragglers stop gating the
+round — fast workers' results flow as they land), and tolerate dead
+actors by dropping them from the rotation and reporting, never raising.
+
+The device half of the pipeline already exists (``DeviceFeeder`` /
+``LearnerThread``); ``rollout_ops.SamplePrefetcher`` joins the two so
+batch k+1 is collected, concatenated and transferred while the SGD nest
+runs batch k.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import ray_tpu as ray
+
+# Actor-fatal errors: the worker is gone, its pending results with it.
+_ACTOR_DEAD_ERRORS = (
+    ray.core.object_store.RayActorError,
+    ray.core.object_store.WorkerCrashedError,
+)
+
+
+def _default_remote_fn(worker):
+    return worker.sample.remote()
+
+
+class AsyncRequestsManager:
+    """Tracks in-flight remote requests across a set of actors
+    (reference parallel_requests.py:24).
+
+    - ``submit`` / ``submit_available`` enforce the per-worker in-flight
+      cap, so a slow worker never accumulates an unbounded request queue.
+    - ``get_ready`` harvests with ``ray.wait``: it blocks (up to
+      ``timeout``) only until ``min_results`` requests complete, then
+      sweeps everything else already done — completion order, not
+      submission order.
+    - A worker whose harvested ref raises an actor-fatal error is moved
+      to the dead list (``take_dead_workers``) and drops out of the
+      submission rotation; the caller decides whether to recreate it.
+      Application errors (``RayTaskError``) still raise — a bug in
+      ``sample()`` must not be silently eaten.
+    """
+
+    def __init__(
+        self,
+        workers: Optional[List] = None,
+        *,
+        max_remote_requests_in_flight_per_worker: int = 2,
+        return_object_refs: bool = False,
+    ):
+        self._max_in_flight = int(max_remote_requests_in_flight_per_worker)
+        self._return_refs = bool(return_object_refs)
+        self._workers: List = []
+        self._in_flight: Dict = {}  # ref -> worker
+        self._counts: Dict[int, int] = {}  # id(worker) -> outstanding
+        self._dead: List = []  # observed-dead, not yet reported
+        self._dead_ids: set = set()  # id() of every worker ever seen dead
+        self.num_completed = 0
+        self.num_dropped = 0  # results lost to dead workers
+        for w in workers or []:
+            self.add_workers([w])
+
+    # -- fleet membership ------------------------------------------------
+
+    def add_workers(self, workers: List) -> None:
+        for w in workers:
+            if w not in self._workers:
+                self._workers.append(w)
+                self._counts.setdefault(id(w), 0)
+
+    def remove_workers(self, workers: List) -> None:
+        """Stop submitting to ``workers``; their in-flight refs stay
+        tracked so completions (or errors) still drain."""
+        drop = {id(w) for w in workers}
+        self._workers = [w for w in self._workers if id(w) not in drop]
+
+    def workers(self) -> List:
+        return list(self._workers)
+
+    def take_dead_workers(self) -> List:
+        """Workers observed dead since the last call (report-once)."""
+        dead, self._dead = self._dead, []
+        return dead
+
+    # -- submission ------------------------------------------------------
+
+    def in_flight(self, worker=None) -> int:
+        if worker is not None:
+            return self._counts.get(id(worker), 0)
+        return len(self._in_flight)
+
+    def submit(
+        self,
+        remote_fn: Optional[Callable] = None,
+        *,
+        worker=None,
+    ) -> bool:
+        """Launch ``remote_fn(worker)`` (default ``sample.remote()``) if
+        the worker is live and under its in-flight cap. With no
+        ``worker``, picks the least-loaded live worker with a free slot.
+        Returns False when nothing could be submitted."""
+        remote_fn = remote_fn or _default_remote_fn
+        if worker is None:
+            candidates = [
+                w
+                for w in self._workers
+                if self._counts.get(id(w), 0) < self._max_in_flight
+            ]
+            if not candidates:
+                return False
+            worker = min(
+                candidates, key=lambda w: self._counts.get(id(w), 0)
+            )
+        elif (
+            worker not in self._workers
+            or self._counts.get(id(worker), 0) >= self._max_in_flight
+        ):
+            return False
+        try:
+            ref = remote_fn(worker)
+        except _ACTOR_DEAD_ERRORS:
+            # the runtime can reject submission to an actor it already
+            # knows is dead — same drop-and-report path as a harvested
+            # death
+            self._mark_dead(worker)
+            return False
+        self._in_flight[ref] = worker
+        self._counts[id(worker)] = self._counts.get(id(worker), 0) + 1
+        return True
+
+    def submit_available(
+        self, remote_fn: Optional[Callable] = None
+    ) -> int:
+        """Saturate every live worker up to the in-flight cap."""
+        n = 0
+        for w in list(self._workers):
+            while self.submit(remote_fn, worker=w):
+                n += 1
+        return n
+
+    # -- harvest ---------------------------------------------------------
+
+    def get_ready(
+        self,
+        *,
+        timeout: Optional[float] = None,
+        min_results: int = 1,
+    ) -> Dict[Any, List]:
+        """Harvest completed requests → ``{worker: [result, ...]}``.
+
+        Blocks up to ``timeout`` (None = indefinitely) for the first
+        ``min_results`` completions, then sweeps everything else already
+        ready without blocking. Dead workers are dropped and recorded;
+        in value mode the harvested refs are freed."""
+        refs = list(self._in_flight.keys())
+        if not refs:
+            return {}
+        if timeout is None or timeout > 0:
+            ray.wait(
+                refs,
+                num_returns=min(max(1, min_results), len(refs)),
+                timeout=timeout,
+            )
+        # sweep: one non-blocking scan picks up every completion
+        ready, _ = ray.wait(refs, num_returns=len(refs), timeout=0)
+        out: Dict[Any, List] = {}
+        for ref in ready:
+            worker = self._in_flight.pop(ref)
+            wid = id(worker)
+            self._counts[wid] = max(0, self._counts.get(wid, 1) - 1)
+            if self._return_refs:
+                out.setdefault(worker, []).append(ref)
+                self.num_completed += 1
+                continue
+            try:
+                result = ray.get(ref)
+            except _ACTOR_DEAD_ERRORS:
+                self._mark_dead(worker)
+                continue
+            finally:
+                ray.free([ref])
+            out.setdefault(worker, []).append(result)
+            self.num_completed += 1
+        return out
+
+    def report_dead(self, worker) -> None:
+        """Caller-observed death (refs mode surfaces actor errors at the
+        caller's ``ray.get``/marshal, not inside the manager): drop the
+        worker from rotation and queue it for ``take_dead_workers``."""
+        self._mark_dead(worker)
+
+    def _mark_dead(self, worker) -> None:
+        self.num_dropped += 1
+        self.remove_workers([worker])
+        if id(worker) not in self._dead_ids:
+            self._dead_ids.add(id(worker))
+            self._dead.append(worker)
+
+    def stats(self) -> Dict[str, int]:
+        return {
+            "num_requests_in_flight": len(self._in_flight),
+            "num_completed": self.num_completed,
+            "num_dropped_dead_worker": self.num_dropped,
+            "num_live_workers": len(self._workers),
+        }
+
+
+def asynchronous_parallel_requests(
+    manager: AsyncRequestsManager,
+    *,
+    remote_fn: Optional[Callable] = None,
+    timeout: Optional[float] = 0.1,
+    min_results: int = 1,
+) -> Dict[Any, List]:
+    """One poll round of the async sampling loop (reference
+    ``asynchronous_parallel_requests``): top every live worker up to its
+    in-flight cap, then harvest whatever has completed. IMPALA/APPO's
+    worker polling and the PPO prefetch thread both run on this."""
+    manager.submit_available(remote_fn)
+    return manager.get_ready(timeout=timeout, min_results=min_results)
+
+
+def wait_asynchronous_requests(
+    manager: AsyncRequestsManager,
+    *,
+    deadline_s: float,
+    min_results: int = 1,
+) -> Dict[Any, List]:
+    """``get_ready`` with an absolute patience budget: re-polls until at
+    least ``min_results`` arrive or ``deadline_s`` elapses (dead workers
+    can make a single ``ray.wait`` return early with nothing)."""
+    t0 = time.monotonic()
+    out: Dict[Any, List] = {}
+    got = 0
+    while True:
+        remaining = deadline_s - (time.monotonic() - t0)
+        ready = manager.get_ready(
+            timeout=max(0.0, remaining), min_results=min_results - got
+        )
+        for w, results in ready.items():
+            out.setdefault(w, []).extend(results)
+            got += len(results)
+        if got >= min_results or remaining <= 0:
+            return out
+        if not manager.in_flight():
+            return out

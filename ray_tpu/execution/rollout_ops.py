@@ -1,12 +1,23 @@
 """Rollout collection primitives.
 
 Counterpart of the reference's ``rllib/execution/rollout_ops.py:35``
-(synchronous_parallel_sample).
+(synchronous_parallel_sample), rebuilt on the shared
+:class:`~ray_tpu.execution.parallel_requests.AsyncRequestsManager` so the
+synchronous and pipelined paths drive workers through one mechanism.
+
+``SamplePrefetcher`` is the host half of the PPO pipeline
+(``config.sample_prefetch``): a thread keeps every rollout worker
+saturated with ``sample.remote`` calls, harvests fragments in completion
+order, concatenates them into train batches and hands the prepared host
+tree to a ``DeviceFeeder`` — so batch k+1's collection, concat AND
+host→device transfer all overlap the jitted SGD nest of batch k.
 """
 
 from __future__ import annotations
 
-from typing import List, Optional, Union
+import queue
+import threading
+from typing import Callable, List, Optional, Union
 
 import ray_tpu as ray
 from ray_tpu.data.sample_batch import (
@@ -14,6 +25,7 @@ from ray_tpu.data.sample_batch import (
     SampleBatch,
     concat_samples,
 )
+from ray_tpu.execution.parallel_requests import AsyncRequestsManager
 
 
 def synchronous_parallel_sample(
@@ -24,30 +36,171 @@ def synchronous_parallel_sample(
     concat: bool = True,
 ) -> Union[SampleBatch, MultiAgentBatch, List]:
     """Sample from all workers in parallel until the step target is met
-    (reference rollout_ops.py:35)."""
+    (reference rollout_ops.py:35).
+
+    Round semantics are unchanged from the bare-``ray.get`` loop — one
+    request per worker per round, batches ordered by worker index — so
+    fixed-seed results are bit-identical; but the round is harvested
+    with ``ray.wait`` through the request manager, so completions are
+    accounted as they land and an actor-death error surfaces only after
+    the healthy workers' results arrived (it still raises: the
+    synchronous algorithms' recreate/ignore protocol relies on it)."""
     agent_or_env_steps = 0
     max_steps = max_agent_steps or max_env_steps
     all_batches = []
-    while True:
-        if worker_set.num_remote_workers() <= 0:
+    if worker_set.num_remote_workers() <= 0:
+        while True:
             batches = [worker_set.local_worker().sample()]
-        else:
-            refs = [
-                w.sample.remote() for w in worker_set.remote_workers()
-            ]
-            batches = ray.get(refs)
-        for b in batches:
-            if max_agent_steps:
-                agent_or_env_steps += (
-                    b.agent_steps()
-                    if isinstance(b, MultiAgentBatch)
-                    else b.count
-                )
-            else:
-                agent_or_env_steps += b.env_steps()
+            agent_or_env_steps += _count_steps(batches, max_agent_steps)
+            all_batches.extend(batches)
+            if max_steps is None or agent_or_env_steps >= max_steps:
+                break
+        return concat_samples(all_batches) if concat else all_batches
+
+    workers = worker_set.remote_workers()
+    order = {id(w): i for i, w in enumerate(workers)}
+    manager = AsyncRequestsManager(
+        workers, max_remote_requests_in_flight_per_worker=1
+    )
+    while True:
+        manager.submit_available()
+        round_results = []  # (worker_index, batch)
+        while manager.in_flight():
+            for w, results in manager.get_ready(timeout=5.0).items():
+                for b in results:
+                    round_results.append((order[id(w)], b))
+        if manager.take_dead_workers():
+            # preserve the seed protocol: a dead worker aborts the
+            # sample and raises, so Algorithm.step can recreate/ignore
+            raise ray.core.object_store.RayActorError(
+                "rollout worker died during synchronous_parallel_sample"
+            )
+        batches = [b for _, b in sorted(round_results, key=lambda x: x[0])]
+        agent_or_env_steps += _count_steps(batches, max_agent_steps)
         all_batches.extend(batches)
         if max_steps is None or agent_or_env_steps >= max_steps:
             break
     if concat:
         return concat_samples(all_batches)
     return all_batches
+
+
+def _count_steps(batches, by_agent_steps) -> int:
+    n = 0
+    for b in batches:
+        if by_agent_steps:
+            n += (
+                b.agent_steps()
+                if isinstance(b, MultiAgentBatch)
+                else b.count
+            )
+        else:
+            n += b.env_steps()
+    return n
+
+
+class SamplePrefetcher:
+    """Background sampling pipeline for on-policy prefetch
+    (``config.sample_prefetch``).
+
+    A daemon thread runs the async poll loop: saturate every rollout
+    worker (``max_in_flight`` outstanding requests each), harvest
+    fragments in completion order, accumulate to ``target_steps``, then
+    ``concat_samples`` and hand the batch to ``deliver`` — typically
+    standardize + ``policy.prepare_batch`` + ``DeviceFeeder.put``, whose
+    bounded queues provide the backpressure that bounds staleness (see
+    docs/pipeline.md). Dead workers are dropped and reported via
+    :meth:`take_dead_workers`; the pipeline keeps running on the
+    survivors. A pipeline-thread exception parks in :attr:`error` and
+    stops the thread instead of vanishing."""
+
+    def __init__(
+        self,
+        worker_set,
+        *,
+        target_steps: int,
+        deliver: Callable[[SampleBatch], None],
+        max_in_flight: int = 2,
+        poll_timeout_s: float = 0.2,
+    ):
+        self._manager = AsyncRequestsManager(
+            worker_set.remote_workers(),
+            max_remote_requests_in_flight_per_worker=max_in_flight,
+        )
+        self._target = int(target_steps)
+        self._deliver = deliver
+        self._poll_timeout = float(poll_timeout_s)
+        self._stop = threading.Event()
+        self.error: Optional[BaseException] = None
+        self.num_batches = 0
+        self.num_fragments = 0
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="sample_prefetcher"
+        )
+        self._thread.start()
+
+    @property
+    def manager(self) -> AsyncRequestsManager:
+        return self._manager
+
+    def _run(self) -> None:
+        frag_buf: list = []
+        frag_steps = 0
+        try:
+            while not self._stop.is_set():
+                self._manager.submit_available()
+                if not self._manager.in_flight():
+                    # every worker dead or removed: spin politely so
+                    # the driver can notice and recreate
+                    self._stop.wait(self._poll_timeout)
+                    continue
+                ready = self._manager.get_ready(
+                    timeout=self._poll_timeout
+                )
+                for _, results in ready.items():
+                    for b in results:
+                        frag_buf.append(b)
+                        frag_steps += b.env_steps()
+                        self.num_fragments += 1
+                        if frag_steps < self._target:
+                            continue
+                        # target checked per fragment, not per harvest:
+                        # batch composition stays deterministic for
+                        # uniform fragments (ceil(target/frag) each)
+                        # instead of depending on harvest timing
+                        batch = concat_samples(frag_buf)
+                        frag_buf, frag_steps = [], 0
+                        # blocks on feeder backpressure — that bound IS
+                        # the prefetch depth / staleness bound
+                        self._deliver(batch)
+                        self.num_batches += 1
+        except BaseException as e:  # surfaced via healthy()/error
+            self.error = e
+
+    def healthy(self) -> bool:
+        return self.error is None and self._thread.is_alive()
+
+    def take_dead_workers(self) -> List:
+        return self._manager.take_dead_workers()
+
+    def add_workers(self, workers: List) -> None:
+        self._manager.add_workers(workers)
+
+    def stats(self) -> dict:
+        return {
+            "num_train_batches": self.num_batches,
+            "num_fragments": self.num_fragments,
+            **self._manager.stats(),
+        }
+
+    def request_stop(self) -> None:
+        """Signal the thread without joining. Call this BEFORE stopping
+        the downstream feeder: a ``deliver`` blocked on feeder
+        backpressure only unblocks when the feeder shuts down (its
+        ``put`` raises), and the raise must find the stop flag set."""
+        self._stop.set()
+
+    def stop(self, join_timeout: float = 10.0) -> None:
+        self._stop.set()
+        if self._thread.is_alive():
+            self._thread.join(timeout=join_timeout)

@@ -27,6 +27,7 @@ from __future__ import annotations
 
 from typing import Dict, Tuple
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -186,12 +187,28 @@ def materialize_fragment(batch_cols: Dict, k: int) -> Dict:
 
 def build_stacks(frames: jnp.ndarray, idx: jnp.ndarray, k: int):
     """Device-side: (M, H, W, 1) frame pool + (N,) first-frame indices
-    → (N, H, W, k) stacked observations (one gather, XLA-fusable)."""
+    → (N, H, W, k) stacked observations (one gather, XLA-fusable).
+
+    uint8 pools gather through a uint32-lane bitcast view: narrow-
+    element gathers are element-width-bound on TPU (~127 GB/s effective
+    for uint8 vs ~420 GB/s through uint32 lanes on v5e, measured for
+    the minibatch row gather — MFU.md), and the pool gather is the same
+    access pattern at 4× fewer, 4× wider elements. Pure data movement:
+    the reconstructed stacks are byte-identical."""
     assert frames.shape[-1] == 1, (
         "frame pools are single-channel (stack depth k comes from the "
         f"index expansion); got channel dim {frames.shape[-1]} — "
         "multi-channel frames would silently train on one channel"
     )
+    inner = int(np.prod(frames.shape[1:]))
+    if frames.dtype == jnp.uint8 and inner % 4 == 0:
+        packed = jax.lax.bitcast_convert_type(
+            frames.reshape(frames.shape[0], inner // 4, 4), jnp.uint32
+        )
+        gathered = packed[idx[:, None] + jnp.arange(k)[None, :]]
+        u8 = jax.lax.bitcast_convert_type(gathered, jnp.uint8)
+        u8 = u8.reshape((u8.shape[0], k) + frames.shape[1:])
+        return jnp.moveaxis(u8[..., 0], 1, -1)
     gathered = frames[idx[:, None] + jnp.arange(k)[None, :]]
     # (N, k, H, W, 1) → (N, H, W, k)
     return jnp.moveaxis(gathered[..., 0], 1, -1)

@@ -788,7 +788,10 @@ class JaxPolicy(Policy):
         """Device-side hook (runs inside the jitted learn program):
         turn the deduplicated frame pool + per-row first-frame indices
         back into the OBS column. Policies whose obs column is not a
-        flat row layout (IMPALA's (B, T) unrolls) override this."""
+        flat row layout (IMPALA's (B, T) unrolls) override this.
+        ``build_stacks`` routes uint8 pools through the same uint32-lane
+        gather trick as the per-minibatch row gather below (MFU.md
+        "what would move it further" item 1)."""
         from ray_tpu.ops.framestack import build_stacks
 
         batch = dict(batch)

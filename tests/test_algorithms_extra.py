@@ -158,6 +158,7 @@ def test_appo_step_and_target_refresh():
     algo.cleanup()
 
 
+@pytest.mark.slow  # ~30 s on the tier-1 host: APPO learning regression
 def test_appo_cartpole_learns():
     algo = (
         APPOConfig()

@@ -8,6 +8,7 @@ import time
 import urllib.request
 
 import gymnasium as gym
+import pytest
 import numpy as np
 
 import ray_tpu as ray
@@ -202,6 +203,7 @@ def test_dashboard_lite_endpoints():
     dash.shutdown()
 
 
+@pytest.mark.slow  # >30 s on the tier-1 host: trains through aggregation actors
 def test_impala_tree_aggregation():
     from ray_tpu.algorithms.impala import IMPALAConfig
 

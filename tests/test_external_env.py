@@ -3,6 +3,7 @@ rllib/evaluation/sampler.py:320, rllib/env/policy_client.py:59,
 rllib/tests/test_external_env.py)."""
 
 import socket
+import pytest
 import threading
 import time
 
@@ -62,6 +63,7 @@ def _drive_external_env(address, n_episodes, stop_event):
             raise
 
 
+@pytest.mark.slow  # >30 s on the tier-1 host: full learning loop over HTTP
 def test_external_env_cartpole_learns_through_server():
     """VERDICT r1 'done' criterion: an external-env CartPole run learns
     through the server path."""

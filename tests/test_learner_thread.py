@@ -75,6 +75,56 @@ def test_learner_thread_queue_wait_below_grad_time(rng):
     assert lt.grad_timer > lt.queue_timer
 
 
+def test_device_feeder_stop_is_race_free_and_idempotent():
+    """ISSUE 1 satellite: stop() must drain both queues, join the
+    thread with a timeout, and make put()-after-stop deterministic —
+    even when producers race the shutdown on full queues."""
+    import pytest
+
+    from ray_tpu.execution.device_feed import DeviceFeeder
+
+    feeder = DeviceFeeder(capacity=1)
+    # fill the pipeline so stop() has to clear a full inqueue: one item
+    # transferring/parked in _out, one waiting in _in
+    feeder.put({"x": np.zeros(4, np.float32)}, 0)
+    feeder.put({"x": np.zeros(4, np.float32)}, 1)
+    feeder.stop(join_timeout=10.0)
+    assert not feeder._thread.is_alive()
+    with pytest.raises(RuntimeError):
+        feeder.put({"x": np.zeros(4, np.float32)}, 2)
+    # queues drained, second stop is a no-op
+    assert feeder._in.qsize() == 0 and feeder._out.qsize() == 0
+    feeder.stop(join_timeout=1.0)
+
+
+def test_device_feeder_stop_unblocks_pending_producer():
+    """A producer blocked on backpressure must come unstuck (with the
+    stopped error) when stop() lands mid-block, not hang forever."""
+    import threading
+
+    from ray_tpu.execution.device_feed import DeviceFeeder
+
+    feeder = DeviceFeeder(capacity=1)
+    for i in range(3):  # fill _out + thread-held + _in
+        feeder.put({"x": np.zeros(4, np.float32)}, i)
+    time.sleep(0.3)  # let the thread park on the full outqueue
+    errs = []
+
+    def producer():
+        try:
+            feeder.put({"x": np.zeros(4, np.float32)}, 99)
+        except RuntimeError as e:
+            errs.append(e)
+
+    t = threading.Thread(target=producer, daemon=True)
+    t.start()
+    time.sleep(0.3)
+    feeder.stop(join_timeout=10.0)
+    t.join(timeout=10.0)
+    assert not t.is_alive()
+    assert len(errs) == 1
+
+
 def test_learner_thread_stats_keys(rng):
     policy = _make_policy()
     lt = LearnerThread(policy)

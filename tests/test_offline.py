@@ -180,6 +180,7 @@ def test_output_config_writes_shards(tmp_path):
     assert SampleBatch.ACTION_LOGP in full
 
 
+@pytest.mark.slow  # >30 s on the tier-1 host: PPO run + BC run
 def test_bc_learns_cartpole_from_ppo_data(tmp_path):
     """VERDICT r1 'done' criterion: train PPO, dump samples, train BC
     from them to CartPole >= 120."""
