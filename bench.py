@@ -398,11 +398,128 @@ def bench_torch(b=B, mb=MB, iters=ITERS) -> float:
     return b / dt
 
 
+def bench_sharding_ab(
+    b=1024, mb=256, iters=2, rounds=20, out_path=None
+):
+    """Mesh-vs-pmap sharding-backend A/B on the SAME fixed-seed PPO
+    learn step (ISSUE 2): median per-step latency, compile time, and
+    recompile counts per backend, plus a bitwise parity check of the
+    resulting params. Small MLP geometry — the A/B isolates the
+    *backend* cost (placement, dispatch, donation), not model compute.
+    Writes one JSON to ``benchmarks/sharding_ab.json``."""
+    import gymnasium as gym
+    import jax
+
+    from ray_tpu import sharding as sl
+    from ray_tpu.algorithms.ppo.ppo import PPOJaxPolicy
+    from ray_tpu.data.sample_batch import SampleBatch
+    from ray_tpu.parallel import mesh as legacy
+
+    rng = np.random.default_rng(0)
+    cols = {
+        SampleBatch.OBS: rng.standard_normal((b, 16)).astype(
+            np.float32
+        ),
+        SampleBatch.ACTIONS: rng.integers(0, 6, b).astype(np.int64),
+        SampleBatch.ACTION_LOGP: np.full(b, -1.79, np.float32),
+        SampleBatch.ACTION_DIST_INPUTS: rng.standard_normal(
+            (b, 6)
+        ).astype(np.float32),
+        SampleBatch.ADVANTAGES: rng.standard_normal(b).astype(
+            np.float32
+        ),
+        SampleBatch.VALUE_TARGETS: rng.standard_normal(b).astype(
+            np.float32
+        ),
+    }
+    report = {
+        "metric": "sharding_backend_ab_learn_step",
+        "devices": len(jax.devices()),
+        "config": {
+            "train_batch": b,
+            "minibatch": mb,
+            "num_sgd_iter": iters,
+        },
+        "backends": {},
+    }
+    weights = {}
+    for backend in ("mesh", "pmap"):
+        mesh = (
+            sl.get_mesh()
+            if backend == "mesh"
+            else legacy.make_mesh()
+        )
+        policy = PPOJaxPolicy(
+            gym.spaces.Box(-10.0, 10.0, (16,), np.float32),
+            gym.spaces.Discrete(6),
+            {
+                "_mesh": mesh,
+                "sharding_backend": backend,
+                "model": {"fcnet_hiddens": [64, 64]},
+                "train_batch_size": b,
+                "sgd_minibatch_size": mb,
+                "num_sgd_iter": iters,
+                "lr": 1e-4,
+                "seed": 0,
+            },
+        )
+        policy.learn_on_batch(SampleBatch(cols))  # compile
+        times = []
+        for _ in range(rounds):
+            t0 = time.perf_counter()
+            policy.learn_on_batch(SampleBatch(cols))
+            times.append(time.perf_counter() - t0)
+        fn = policy.learn_fn(b)
+        report["backends"][backend] = {
+            "step_ms_median": round(
+                1e3 * float(np.median(times)), 3
+            ),
+            "step_ms_p90": round(
+                1e3 * float(np.quantile(times, 0.9)), 3
+            ),
+            "compile_s": round(
+                getattr(fn, "compile_time_s", 0.0), 3
+            ),
+            "recompiles": getattr(fn, "recompiles", None),
+            "transfer_s_last": round(
+                policy.last_learn_timers.get(
+                    "learn_transfer_s", 0.0
+                ),
+                5,
+            ),
+        }
+        weights[backend] = jax.device_get(policy.params)
+    import jax.tree_util as jtu
+
+    report["parity_bitwise"] = all(
+        np.array_equal(x, y)
+        for x, y in zip(
+            jtu.tree_leaves(weights["mesh"]),
+            jtu.tree_leaves(weights["pmap"]),
+        )
+    )
+    m = report["backends"]["mesh"]["step_ms_median"]
+    p = report["backends"]["pmap"]["step_ms_median"]
+    report["mesh_vs_pmap"] = round(p / m, 3) if m else None
+    if out_path is None:
+        import os
+
+        os.makedirs("benchmarks", exist_ok=True)
+        out_path = "benchmarks/sharding_ab.json"
+    with open(out_path, "w") as f:
+        json.dump(report, f, indent=1)
+    print(json.dumps(report))
+    return report
+
+
 def main():
     if "--e2e" in sys.argv:
         from bench_e2e import main as e2e_main
 
         e2e_main()
+        return
+    if "--sharding-ab" in sys.argv:
+        bench_sharding_ab()
         return
     profile_dir = None
     if "--profile" in sys.argv:

@@ -74,14 +74,22 @@ class Algorithm(Trainable):
 
         dist_lib.initialize()
 
-        # learner mesh (driver-side policies)
+        # learner mesh (driver-side policies): built through the
+        # backend the config selects — the sharding runtime's
+        # ("batch",) mesh by default, the legacy ("data",) mesh for
+        # the pmap fallback (docs/sharding.md)
         n_learner = config.get("learner_devices")
         import jax
 
         devices = jax.devices()
         if n_learner:
             devices = devices[:n_learner]
-        config["_mesh"] = mesh_lib.make_mesh(devices=devices)
+        if config.get("sharding_backend", "mesh") == "pmap":
+            config["_mesh"] = mesh_lib.make_mesh(devices=devices)
+        else:
+            from ray_tpu import sharding as sharding_lib
+
+            config["_mesh"] = sharding_lib.get_mesh(devices=devices)
 
         policy_specs = None
         policy_mapping_fn = config.get("policy_mapping_fn")
@@ -188,6 +196,17 @@ class Algorithm(Trainable):
             "learner": train_info,
             **{k: v for k, v in self._counters.items()},
         }
+        # per-stage learner timers (device transfer / compile / step,
+        # Policy.last_learn_timers) — sharding-backend A/Bs read these
+        # straight from train() results instead of a profiler
+        learn_timers: Dict[str, Dict[str, float]] = {}
+        lw = self.workers.local_worker()
+        for pid, pol in (getattr(lw, "policy_map", None) or {}).items():
+            t = getattr(pol, "last_learn_timers", None)
+            if t:
+                learn_timers[pid] = dict(t)
+        if learn_timers:
+            results["info"]["timers"] = learn_timers
         results.update(self._collect_rollout_metrics())
         from ray_tpu.execution.train_ops import (
             NUM_ENV_STEPS_TRAINED as _TRAINED,
@@ -335,16 +354,62 @@ class Algorithm(Trainable):
         # push restored weights to rollout workers
         self.workers.sync_weights()
 
+    @staticmethod
+    def _atomic_write(path: str, write_fn) -> None:
+        """Write through a same-directory temp file + ``os.replace`` so
+        a crash mid-save leaves either the old complete file or the new
+        complete file — never a truncated one. fsync before the rename:
+        the replace must not be reordered ahead of the data blocks."""
+        import tempfile
+
+        fd, tmp = tempfile.mkstemp(
+            dir=os.path.dirname(path) or ".",
+            prefix=os.path.basename(path) + ".tmp.",
+        )
+        try:
+            with os.fdopen(fd, "wb") as f:
+                write_fn(f)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+
     def save_checkpoint(self, checkpoint_dir: str) -> str:
         """reference algorithm.py:1438. Alongside the state, a
         metadata file records the algorithm name and config so
         :meth:`from_checkpoint` can rebuild without the caller
-        knowing either (reference checkpoint ``rllib_checkpoint.json``)."""
-        path = os.path.join(checkpoint_dir, "algorithm_state.pkl")
-        with open(path, "wb") as f:
-            pickle.dump(self.__getstate__(), f)
+        knowing either (reference checkpoint ``rllib_checkpoint.json``).
+        Every file lands atomically (temp + ``os.replace``): a crash
+        mid-save cannot corrupt an existing checkpoint, and the
+        metadata file — written LAST — marks the checkpoint complete."""
         import json
 
+        state = self.__getstate__()
+        self._atomic_write(
+            os.path.join(checkpoint_dir, "algorithm_state.pkl"),
+            lambda f: pickle.dump(state, f),
+        )
+        from ray_tpu.core import serialization as _ser
+
+        # cloudpickle (env creators etc.); runtime-injected keys
+        # ("_mesh", ...) hold live device objects and are
+        # rebuilt by setup(), so they stay out of the file
+        config_blob = _ser.dumps(
+            {
+                k: v
+                for k, v in self.config.items()
+                if not k.startswith("_")
+            }
+        )
+        self._atomic_write(
+            os.path.join(checkpoint_dir, "algorithm_config.pkl"),
+            lambda f: f.write(config_blob),
+        )
         meta = {
             "type": "Algorithm",
             "algorithm_class": type(self).__name__,
@@ -352,27 +417,10 @@ class Algorithm(Trainable):
                 self, "_registry_name", None
             ) or type(self).__name__,
         }
-        with open(
-            os.path.join(checkpoint_dir, "rllib_checkpoint.json"), "w"
-        ) as f:
-            json.dump(meta, f)
-        from ray_tpu.core import serialization as _ser
-
-        with open(
-            os.path.join(checkpoint_dir, "algorithm_config.pkl"), "wb"
-        ) as f:
-            # cloudpickle (env creators etc.); runtime-injected keys
-            # ("_mesh", ...) hold live device objects and are
-            # rebuilt by setup(), so they stay out of the file
-            f.write(
-                _ser.dumps(
-                    {
-                        k: v
-                        for k, v in self.config.items()
-                        if not k.startswith("_")
-                    }
-                )
-            )
+        self._atomic_write(
+            os.path.join(checkpoint_dir, "rllib_checkpoint.json"),
+            lambda f: f.write(json.dumps(meta).encode()),
+        )
         return checkpoint_dir
 
     @classmethod

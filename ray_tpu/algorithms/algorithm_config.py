@@ -60,6 +60,12 @@ class AlgorithmConfig:
 
         # learner placement (TPU-specific)
         self.learner_devices = None  # None → all visible devices
+        # learner sharding runtime (docs/sharding.md): "mesh" lowers
+        # the learn program through ray_tpu.sharding's sharded_jit with
+        # explicit NamedShardings on a ("batch",) mesh; "pmap" keeps
+        # the legacy ("data",)-mesh path with implicit placement.
+        # Fixed-seed results are bit-identical between the two.
+        self.sharding_backend = "mesh"
 
         # exploration
         self.explore = True
@@ -202,6 +208,7 @@ class AlgorithmConfig:
         num_gpus: Optional[int] = None,
         num_cpus_per_worker: Optional[int] = None,
         learner_devices: Optional[int] = None,
+        sharding_backend: Optional[str] = None,
         **kwargs,
     ) -> "AlgorithmConfig":
         if num_gpus is not None:
@@ -210,6 +217,13 @@ class AlgorithmConfig:
             self.num_cpus_per_worker = num_cpus_per_worker
         if learner_devices is not None:
             self.learner_devices = learner_devices
+        if sharding_backend is not None:
+            if sharding_backend not in ("mesh", "pmap"):
+                raise ValueError(
+                    "sharding_backend must be 'mesh' or 'pmap', got "
+                    f"{sharding_backend!r}"
+                )
+            self.sharding_backend = sharding_backend
         return self
 
     def offline_data(

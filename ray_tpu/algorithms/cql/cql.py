@@ -21,6 +21,8 @@ import numpy as np
 import optax
 from jax.sharding import PartitionSpec as P
 
+from ray_tpu import sharding as sharding_lib
+
 from ray_tpu.algorithms.marwil.marwil import MARWIL
 from ray_tpu.algorithms.sac.sac import SAC, SACConfig, SACJaxPolicy
 from ray_tpu.data.sample_batch import DEFAULT_POLICY_ID, SampleBatch
@@ -86,6 +88,7 @@ class CQLJaxPolicy(SACJaxPolicy):
         target_entropy = self.target_entropy
         low, high = self.low, self.high
         mesh = self.mesh
+        axis = sharding_lib.data_axis(mesh)
         cfg = self.config
         bc_iters = int(cfg.get("bc_iters", 20000))
         cql_temp = float(cfg.get("temperature", 1.0))
@@ -110,7 +113,7 @@ class CQLJaxPolicy(SACJaxPolicy):
             )
             actions = batch[SampleBatch.ACTIONS].astype(jnp.float32)
             B = obs.shape[0]
-            rng = jax.random.fold_in(rng, jax.lax.axis_index("data"))
+            rng = jax.random.fold_in(rng, jax.lax.axis_index(axis))
             rng_t, rng_a, rng_r, rng_c, rng_n = jax.random.split(rng, 5)
             alpha = jnp.exp(params["log_alpha"])
 
@@ -197,7 +200,7 @@ class CQLJaxPolicy(SACJaxPolicy):
                     params["critic"]
                 )
             )
-            c_grads = jax.lax.pmean(c_grads, "data")
+            c_grads = jax.lax.pmean(c_grads, axis)
             c_upd, c_opt = tx_c.update(
                 c_grads, opt_state["critic"], params["critic"]
             )
@@ -225,7 +228,7 @@ class CQLJaxPolicy(SACJaxPolicy):
             (a_loss, logp_pi), a_grads = jax.value_and_grad(
                 actor_loss, has_aux=True
             )(params["actor"])
-            a_grads = jax.lax.pmean(a_grads, "data")
+            a_grads = jax.lax.pmean(a_grads, axis)
             a_upd, a_opt = tx_a.update(
                 a_grads, opt_state["actor"], params["actor"]
             )
@@ -241,7 +244,7 @@ class CQLJaxPolicy(SACJaxPolicy):
             al_loss, al_grad = jax.value_and_grad(alpha_loss)(
                 params["log_alpha"]
             )
-            al_grad = jax.lax.pmean(al_grad, "data")
+            al_grad = jax.lax.pmean(al_grad, axis)
             al_upd, al_opt = tx_al.update(
                 al_grad, opt_state["log_alpha"], params["log_alpha"]
             )
@@ -279,17 +282,30 @@ class CQLJaxPolicy(SACJaxPolicy):
                 "total_loss": a_loss + c_loss + al_loss,
             }
             stats = jax.tree_util.tree_map(
-                lambda x: jax.lax.pmean(x, "data"), stats
+                lambda x: jax.lax.pmean(x, axis), stats
             )
             return new_params, new_opt, new_aux, stats
 
         sharded = jax.shard_map(
             device_fn,
             mesh=mesh,
-            in_specs=(P(), P(), P(), P("data"), P(), P()),
+            in_specs=(P(), P(), P(), P(axis), P(), P()),
             out_specs=(P(), P(), P(), P()),
         )
-        return jax.jit(sharded, donate_argnums=(1,))
+        label = f"learn[{type(self).__name__}:{batch_size}]"
+        if self.sharding_backend == "mesh":
+            rep = self._param_sharding
+            dat = self._data_sharding
+            return sharding_lib.sharded_jit(
+                sharded,
+                in_specs=(rep, rep, rep, dat, rep, rep),
+                out_specs=(rep, rep, rep, rep),
+                donate_argnums=(1,),
+                label=label,
+            )
+        return sharding_lib.sharded_jit(
+            sharded, donate_argnums=(1,), label=label
+        )
 
 
 class CQL(SAC):

@@ -21,6 +21,8 @@ import numpy as np
 import optax
 from jax.sharding import PartitionSpec as P
 
+from ray_tpu import sharding as sharding_lib
+
 from ray_tpu.algorithms.sac.sac import SAC, SACConfig, SACJaxPolicy
 from ray_tpu.data.sample_batch import DEFAULT_POLICY_ID, SampleBatch
 from ray_tpu.models.distributions import SquashedGaussian
@@ -93,6 +95,7 @@ class CRRJaxPolicy(SACJaxPolicy):
         gamma = self.gamma**self.n_step
         low, high = self.low, self.high
         mesh = self.mesh
+        axis = sharding_lib.data_axis(mesh)
         cfg = self.config
         weight_type = cfg.get("weight_type", "bin")
         temperature = float(cfg.get("temperature", 1.0))
@@ -127,7 +130,7 @@ class CRRJaxPolicy(SACJaxPolicy):
                 jnp.float32
             )
             actions = batch[SampleBatch.ACTIONS].astype(jnp.float32)
-            rng = jax.random.fold_in(rng, jax.lax.axis_index("data"))
+            rng = jax.random.fold_in(rng, jax.lax.axis_index(axis))
             rng_t, rng_adv = jax.random.split(rng)
 
             # ---- critic TD step: next action from the TARGET actor ----
@@ -154,7 +157,7 @@ class CRRJaxPolicy(SACJaxPolicy):
             (c_loss, q1), c_grads = jax.value_and_grad(
                 critic_loss, has_aux=True
             )(params["critic"])
-            c_grads = jax.lax.pmean(c_grads, "data")
+            c_grads = jax.lax.pmean(c_grads, axis)
             c_upd, c_opt = tx_c.update(
                 c_grads, opt_state["critic"], params["critic"]
             )
@@ -184,7 +187,7 @@ class CRRJaxPolicy(SACJaxPolicy):
             a_loss, a_grads = jax.value_and_grad(actor_loss)(
                 params["actor"]
             )
-            a_grads = jax.lax.pmean(a_grads, "data")
+            a_grads = jax.lax.pmean(a_grads, axis)
             a_upd, a_opt = tx_a.update(
                 a_grads, opt_state["actor"], params["actor"]
             )
@@ -222,17 +225,30 @@ class CRRJaxPolicy(SACJaxPolicy):
                 "total_loss": a_loss + c_loss,
             }
             stats = jax.tree_util.tree_map(
-                lambda x: jax.lax.pmean(x, "data"), stats
+                lambda x: jax.lax.pmean(x, axis), stats
             )
             return new_params, new_opt, new_aux, stats
 
         sharded = jax.shard_map(
             device_fn,
             mesh=mesh,
-            in_specs=(P(), P(), P(), P("data"), P(), P()),
+            in_specs=(P(), P(), P(), P(axis), P(), P()),
             out_specs=(P(), P(), P(), P()),
         )
-        return jax.jit(sharded, donate_argnums=(1,))
+        label = f"learn[{type(self).__name__}:{batch_size}]"
+        if self.sharding_backend == "mesh":
+            rep = self._param_sharding
+            dat = self._data_sharding
+            return sharding_lib.sharded_jit(
+                sharded,
+                in_specs=(rep, rep, rep, dat, rep, rep),
+                out_specs=(rep, rep, rep, rep),
+                donate_argnums=(1,),
+                label=label,
+            )
+        return sharding_lib.sharded_jit(
+            sharded, donate_argnums=(1,), label=label
+        )
 
 
 class CRR(SAC):

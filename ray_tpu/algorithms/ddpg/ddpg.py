@@ -22,6 +22,8 @@ import numpy as np
 import optax
 from jax.sharding import PartitionSpec as P
 
+from ray_tpu import sharding as sharding_lib
+
 from ray_tpu.algorithms.algorithm_config import AlgorithmConfig  # noqa: F401
 from ray_tpu.algorithms.dqn.dqn import DQN, DQNConfig
 from ray_tpu.algorithms.sac.sac import _TwinQNet
@@ -169,7 +171,6 @@ class DDPGJaxPolicy(JaxPolicy):
     default_exploration = "OrnsteinUhlenbeckNoise"
 
     def __init__(self, observation_space, action_space, config):
-        from ray_tpu.parallel import mesh as mesh_lib
         from ray_tpu.policy.policy import Policy
 
         Policy.__init__(self, observation_space, action_space, config)
@@ -177,10 +178,11 @@ class DDPGJaxPolicy(JaxPolicy):
         self.low = float(np.min(action_space.low))
         self.high = float(np.max(action_space.high))
 
-        self.mesh = config.get("_mesh") or mesh_lib.make_mesh()
-        self.n_shards = mesh_lib.num_data_shards(self.mesh)
-        self._param_sharding = mesh_lib.replicated(self.mesh)
-        self._data_sharding = mesh_lib.data_sharding(self.mesh)
+        self.sharding_backend = config.get("sharding_backend", "mesh")
+        self.mesh = sharding_lib.resolve_mesh(config)
+        self.n_shards = sharding_lib.num_shards(self.mesh)
+        self._param_sharding = sharding_lib.replicated(self.mesh)
+        self._data_sharding = sharding_lib.batch_sharded(self.mesh)
 
         self.actor = _DetActorNet(
             self.action_dim,
@@ -326,11 +328,12 @@ class DDPGJaxPolicy(JaxPolicy):
         huber_d = float(self.config.get("huber_threshold", 1.0))
         l2_reg = float(self.config.get("l2_reg", 0.0) or 0.0)
         mesh = self.mesh
+        axis = sharding_lib.data_axis(mesh)
 
         def device_fn(params, opt_state, aux, batch, rng, coeffs):
             obs = batch[SampleBatch.OBS].astype(jnp.float32)
             actions = batch[SampleBatch.ACTIONS].astype(jnp.float32)
-            rng = jax.random.fold_in(rng, jax.lax.axis_index("data"))
+            rng = jax.random.fold_in(rng, jax.lax.axis_index(axis))
             td_target = self._td_targets(params, aux, batch, rng)
 
             # ---- critic step ----
@@ -367,7 +370,7 @@ class DDPGJaxPolicy(JaxPolicy):
             (c_loss, (q1, td_err)), c_grads = jax.value_and_grad(
                 critic_loss, has_aux=True
             )(params["critic"])
-            c_grads = jax.lax.pmean(c_grads, "data")
+            c_grads = jax.lax.pmean(c_grads, axis)
             c_upd, c_opt = tx_c.update(
                 c_grads, opt_state["critic"], params["critic"]
             )
@@ -385,7 +388,7 @@ class DDPGJaxPolicy(JaxPolicy):
             a_loss, a_grads = jax.value_and_grad(actor_loss)(
                 params["actor"]
             )
-            a_grads = jax.lax.pmean(a_grads, "data")
+            a_grads = jax.lax.pmean(a_grads, axis)
             a_upd, a_opt = tx_a.update(
                 a_grads, opt_state["actor"], params["actor"]
             )
@@ -433,17 +436,30 @@ class DDPGJaxPolicy(JaxPolicy):
                 "total_loss": a_loss + c_loss,
             }
             stats = jax.tree_util.tree_map(
-                lambda x: jax.lax.pmean(x, "data"), stats
+                lambda x: jax.lax.pmean(x, axis), stats
             )
             return new_params, new_opt, new_aux, stats
 
         sharded = jax.shard_map(
             device_fn,
             mesh=mesh,
-            in_specs=(P(), P(), P(), P("data"), P(), P()),
+            in_specs=(P(), P(), P(), P(axis), P(), P()),
             out_specs=(P(), P(), P(), P()),
         )
-        return jax.jit(sharded, donate_argnums=(1,))
+        label = f"learn[{type(self).__name__}:{batch_size}]"
+        if self.sharding_backend == "mesh":
+            rep = self._param_sharding
+            dat = self._data_sharding
+            return sharding_lib.sharded_jit(
+                sharded,
+                in_specs=(rep, rep, rep, dat, rep, rep),
+                out_specs=(rep, rep, rep, rep),
+                donate_argnums=(1,),
+                label=label,
+            )
+        return sharding_lib.sharded_jit(
+            sharded, donate_argnums=(1,), label=label
+        )
 
     def learn_on_device_batch(self, dev_batch, batch_size: int) -> Dict:
         fn = self.learn_fn(batch_size)

@@ -92,6 +92,36 @@ class PointGoalEnv(gym.Env):
         return self.pos.copy(), reward, False, truncated, {}
 
 
+def linear_feature_baseline(obs_l, ret_l):
+    """Per-task linear value baseline (the rllab/reference MAML
+    ``LinearFeatureBaseline``): least-squares fit of the discounted
+    returns on ``[obs, obs², t, t², t³, 1]`` across the task's
+    episodes, subtracted from the returns to form advantages.
+
+    Raw discounted returns are dominated by the timestep (early steps
+    have more remaining horizon than late ones regardless of the
+    actions taken), which buries the policy-gradient signal of the
+    tiny per-task batches MAML adapts on; the fitted baseline removes
+    that component and meta-training converges in a fraction of the
+    iterations."""
+    rets = np.concatenate(ret_l)
+
+    def feats(obs):
+        t = np.arange(len(obs), dtype=np.float32)[:, None] / 100.0
+        o = obs.reshape(len(obs), -1)
+        return np.concatenate(
+            [o, o**2, t, t**2, t**3, np.ones_like(t)], axis=1
+        )
+
+    f = np.concatenate([feats(o) for o in obs_l])
+    reg = 1e-5 * np.eye(f.shape[1], dtype=np.float32)
+    try:
+        w = np.linalg.solve(f.T @ f + reg, f.T @ rets)
+        return rets - f @ w
+    except np.linalg.LinAlgError:
+        return rets - rets.mean()
+
+
 def build_act_fn(model, dist_cls):
     """Jitted (params, obs, rng) → (sampled action, logp) for host-side
     rollout loops. Shared by MAML and MBMPO."""
@@ -229,49 +259,92 @@ class MAML(Algorithm):
 
     # -- rollouts ---------------------------------------------------------
 
+    def _rollout_envs(self, num: int) -> List:
+        """``num`` env instances on the CURRENT task for lockstep
+        batched rollouts: index 0 is ``self.env`` itself; the rest are
+        deep copies with their RNG re-seeded (a straight copy would
+        replay identical stochasticity in every parallel episode)."""
+        import copy
+
+        envs = [self.env]
+        for _ in range(num - 1):
+            e = copy.deepcopy(self.env)
+            for attr in ("_rng", "np_random"):
+                if hasattr(e, attr):
+                    try:
+                        setattr(
+                            e,
+                            attr,
+                            np.random.default_rng(
+                                int(self._np_rng.integers(2**31))
+                            ),
+                        )
+                    except Exception:
+                        pass
+            envs.append(e)
+        return envs
+
     def _policy_rollouts(self, params, num: int) -> Dict[str, np.ndarray]:
         """Collect `num` episodes on the env's CURRENT task with the
-        given params; returns stacked (N*T,) columns with discounted
-        returns as advantages (vanilla PG baseline-free, like the
-        reference's inner adaptation)."""
+        given params; returns stacked (N*T,) columns with
+        baseline-corrected discounted returns as advantages
+        (``linear_feature_baseline``, the reference's
+        LinearFeatureBaseline role).
+
+        The `num` episodes run in LOCKSTEP over env copies, so each
+        step is ONE batched jitted act call instead of `num` — the
+        rollout loop is dispatch-bound on a fast host, and this cuts
+        the per-meta-iteration wall clock ~4x at rollouts_per_task=4."""
         if self._act_fn is None:
             self._act_fn = build_act_fn(self.model, self.dist_cls)
         gamma = float(self.config.get("gamma", 0.99))
+        envs = self._rollout_envs(num)
+        obs = [e.reset()[0] for e in envs]
+        ep_obs = [[] for _ in envs]
+        ep_act = [[] for _ in envs]
+        ep_logp = [[] for _ in envs]
+        ep_rew = [[] for _ in envs]
+        alive = list(range(num))
+        self._rng, ep_rng = jax.random.split(self._rng)
+        step_t = 0
+        while alive:
+            sub = jax.random.fold_in(ep_rng, step_t)
+            step_t += 1
+            obs_b = np.stack(
+                [np.asarray(obs[i], np.float32) for i in alive]
+            )
+            a_b, logp_b = self._act_fn(params, jnp.asarray(obs_b), sub)
+            a_b = np.asarray(a_b)
+            logp_b = np.asarray(logp_b)
+            still = []
+            for j, i in enumerate(alive):
+                ep_obs[i].append(obs_b[j])
+                ep_act[i].append(a_b[j])
+                ep_logp[i].append(float(logp_b[j]))
+                o, r, term, trunc, _ = envs[i].step(a_b[j])
+                ep_rew[i].append(float(r))
+                obs[i] = o
+                if not (term or trunc):
+                    still.append(i)
+            alive = still
+        from ray_tpu.evaluation.postprocessing import discount_cumsum
+
         obs_l, act_l, logp_l, ret_l = [], [], [], []
         total_steps = 0
         ep_rewards = []
-        for _ in range(num):
-            obs, _ = self.env.reset()
-            ep_obs, ep_act, ep_logp, ep_rew = [], [], [], []
-            done = False
-            while not done:
-                self._rng, sub = jax.random.split(self._rng)
-                a, logp = self._act_fn(
-                    params, jnp.asarray(obs, jnp.float32)[None], sub
-                )
-                a = np.asarray(a[0])
-                ep_obs.append(np.asarray(obs, np.float32))
-                ep_act.append(a)
-                ep_logp.append(float(logp[0]))
-                obs, r, term, trunc, _ = self.env.step(a)
-                ep_rew.append(float(r))
-                done = term or trunc
-            from ray_tpu.evaluation.postprocessing import (
-                discount_cumsum,
-            )
-
+        for i in range(num):
             ret = discount_cumsum(
-                np.asarray(ep_rew, np.float32), gamma
+                np.asarray(ep_rew[i], np.float32), gamma
             ).astype(np.float32)
-            obs_l.append(np.stack(ep_obs))
-            act_l.append(np.stack(ep_act))
-            logp_l.append(np.asarray(ep_logp, np.float32))
+            obs_l.append(np.stack(ep_obs[i]))
+            act_l.append(np.stack(ep_act[i]))
+            logp_l.append(np.asarray(ep_logp[i], np.float32))
             ret_l.append(ret)
-            total_steps += len(ep_rew)
-            ep_rewards.append(float(np.sum(ep_rew)))
+            total_steps += len(ep_rew[i])
+            ep_rewards.append(float(np.sum(ep_rew[i])))
         self._counters[NUM_ENV_STEPS_SAMPLED] += total_steps
         self._counters[NUM_AGENT_STEPS_SAMPLED] += total_steps
-        adv = np.concatenate(ret_l)
+        adv = linear_feature_baseline(obs_l, ret_l)
         adv = (adv - adv.mean()) / max(1e-4, adv.std())
         batch = {
             "obs": np.concatenate(obs_l),

@@ -19,6 +19,7 @@ import numpy as np
 import optax
 from jax.sharding import PartitionSpec as P
 
+from ray_tpu import sharding as sharding_lib
 from ray_tpu.algorithms.algorithm_config import AlgorithmConfig
 from ray_tpu.algorithms.dqn.dqn import DQN, DQNConfig
 from ray_tpu.data.sample_batch import SampleBatch
@@ -152,17 +153,17 @@ class SACJaxPolicy(JaxPolicy):
     def __init__(self, observation_space, action_space, config):
         # Bypass JaxPolicy model construction: SAC has its own nets.
         from ray_tpu.policy.policy import Policy
-        from ray_tpu.parallel import mesh as mesh_lib
 
         Policy.__init__(self, observation_space, action_space, config)
         self.action_dim = int(np.prod(action_space.shape))
         self.low = float(np.min(action_space.low))
         self.high = float(np.max(action_space.high))
 
-        self.mesh = config.get("_mesh") or mesh_lib.make_mesh()
-        self.n_shards = mesh_lib.num_data_shards(self.mesh)
-        self._param_sharding = mesh_lib.replicated(self.mesh)
-        self._data_sharding = mesh_lib.data_sharding(self.mesh)
+        self.sharding_backend = config.get("sharding_backend", "mesh")
+        self.mesh = sharding_lib.resolve_mesh(config)
+        self.n_shards = sharding_lib.num_shards(self.mesh)
+        self._param_sharding = sharding_lib.replicated(self.mesh)
+        self._data_sharding = sharding_lib.batch_sharded(self.mesh)
 
         pm_cfg = config.get("policy_model_config") or {}
         qm_cfg = config.get("q_model_config") or {}
@@ -334,6 +335,7 @@ class SACJaxPolicy(JaxPolicy):
         gamma, tau = self.gamma**self.n_step, self.tau
         target_entropy = self.target_entropy
         low, high = self.low, self.high
+        axis = sharding_lib.data_axis(self.mesh)
 
         def device_fn(params, opt_state, aux, batch, rng, coeffs):
             obs = batch[SampleBatch.OBS].astype(jnp.float32)
@@ -354,7 +356,7 @@ class SACJaxPolicy(JaxPolicy):
                     return jnp.sum(x * mask) / denom
 
             rng = jax.random.fold_in(
-                rng, jax.lax.axis_index("data")
+                rng, jax.lax.axis_index(axis)
             )
             rng_t, rng_a = jax.random.split(rng)
             alpha = jnp.exp(params["log_alpha"])
@@ -389,7 +391,7 @@ class SACJaxPolicy(JaxPolicy):
             (c_loss, (q1, q2)), c_grads = jax.value_and_grad(
                 critic_loss, has_aux=True
             )(params["critic"])
-            c_grads = jax.lax.pmean(c_grads, "data")
+            c_grads = jax.lax.pmean(c_grads, axis)
             c_upd, c_opt = tx_c.update(
                 c_grads, opt_state["critic"], params["critic"]
             )
@@ -414,7 +416,7 @@ class SACJaxPolicy(JaxPolicy):
             (a_loss, logp_pi), a_grads = jax.value_and_grad(
                 actor_loss, has_aux=True
             )(params["actor"])
-            a_grads = jax.lax.pmean(a_grads, "data")
+            a_grads = jax.lax.pmean(a_grads, axis)
             a_upd, a_opt = tx_a.update(
                 a_grads, opt_state["actor"], params["actor"]
             )
@@ -430,7 +432,7 @@ class SACJaxPolicy(JaxPolicy):
             al_loss, al_grad = jax.value_and_grad(alpha_loss)(
                 params["log_alpha"]
             )
-            al_grad = jax.lax.pmean(al_grad, "data")
+            al_grad = jax.lax.pmean(al_grad, axis)
             al_upd, al_opt = tx_al.update(
                 al_grad, opt_state["log_alpha"], params["log_alpha"]
             )
@@ -465,7 +467,7 @@ class SACJaxPolicy(JaxPolicy):
                 "total_loss": a_loss + c_loss + al_loss,
             }
             stats = jax.tree_util.tree_map(
-                lambda x: jax.lax.pmean(x, "data"), stats
+                lambda x: jax.lax.pmean(x, axis), stats
             )
             return new_params, new_opt, new_aux, stats
 
@@ -473,13 +475,27 @@ class SACJaxPolicy(JaxPolicy):
 
     def _build_learn_fn(self, batch_size: int):
         device_fn = self._device_update_fn()
+        axis = sharding_lib.data_axis(self.mesh)
         sharded = jax.shard_map(
             device_fn,
             mesh=self.mesh,
-            in_specs=(P(), P(), P(), P("data"), P(), P()),
+            in_specs=(P(), P(), P(), P(axis), P(), P()),
             out_specs=(P(), P(), P(), P()),
         )
-        return jax.jit(sharded, donate_argnums=(1,))
+        label = f"learn[{type(self).__name__}:{batch_size}]"
+        if self.sharding_backend == "mesh":
+            rep = self._param_sharding
+            dat = self._data_sharding
+            return sharding_lib.sharded_jit(
+                sharded,
+                in_specs=(rep, rep, rep, dat, rep, rep),
+                out_specs=(rep, rep, rep, rep),
+                donate_argnums=(1,),
+                label=label,
+            )
+        return sharding_lib.sharded_jit(
+            sharded, donate_argnums=(1,), label=label
+        )
 
     def _build_multi_learn_fn(self, batch_size: int, k: int):
         """K replay updates fused into ONE program: ``lax.scan`` threads
@@ -490,6 +506,7 @@ class SACJaxPolicy(JaxPolicy):
         update loop (``dqn.py:336`` sample-and-learn rounds), which
         pays a full dispatch per update."""
         device_fn = self._device_update_fn()
+        axis = sharding_lib.data_axis(self.mesh)
 
         def multi_fn(params, opt_state, aux, stacked, rng, coeffs):
             def body(carry, batch_k):
@@ -523,10 +540,23 @@ class SACJaxPolicy(JaxPolicy):
         sharded = jax.shard_map(
             multi_fn,
             mesh=self.mesh,
-            in_specs=(P(), P(), P(), P(None, "data"), P(), P()),
+            in_specs=(P(), P(), P(), P(None, axis), P(), P()),
             out_specs=(P(), P(), P(), P(), P()),
         )
-        return jax.jit(sharded, donate_argnums=(1,))
+        label = f"multi_learn[{type(self).__name__}:{batch_size}x{k}]"
+        if self.sharding_backend == "mesh":
+            rep = self._param_sharding
+            dat = sharding_lib.batch_sharded(self.mesh, ndim_prefix=2)
+            return sharding_lib.sharded_jit(
+                sharded,
+                in_specs=(rep, rep, rep, dat, rep, rep),
+                out_specs=(rep, rep, rep, rep, rep),
+                donate_argnums=(1,),
+                label=label,
+            )
+        return sharding_lib.sharded_jit(
+            sharded, donate_argnums=(1,), label=label
+        )
 
     def learn_on_stacked_batch(
         self,
@@ -539,14 +569,12 @@ class SACJaxPolicy(JaxPolicy):
         """Run k fused updates on a host tree of (k, batch, ...) arrays
         (one vectorized replay gather, reshaped). See
         :meth:`_build_multi_learn_fn`."""
-        import jax.sharding as jshard
-
         key = (batch_size, k)
         fn = self._multi_learn_fns.get(key)
         if fn is None:
             fn = self._build_multi_learn_fn(batch_size, k)
             self._multi_learn_fns[key] = fn
-        sharding = jshard.NamedSharding(self.mesh, P(None, "data"))
+        sharding = sharding_lib.batch_sharded(self.mesh, ndim_prefix=2)
         dev = jax.device_put(stacked, sharding)
         self._rng, rng = jax.random.split(self._rng)
         (

@@ -39,6 +39,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
+from ray_tpu import sharding as sharding_lib
 from ray_tpu.algorithms.algorithm_config import AlgorithmConfig  # noqa: F401
 from ray_tpu.algorithms.dqn.dqn import DQN, DQNConfig
 from ray_tpu.data.sample_batch import SampleBatch
@@ -196,8 +197,14 @@ class _ChoiceModel(nn.Module):
 
     @nn.compact
     def __call__(self, user, docs):
+        # beta starts at 0 (uniform choice, matching the reference's
+        # UserChoiceModel init): the model must LEARN the affinity
+        # scale from observed clicks, so its NLL has genuine headroom
+        # below the untrained value — a beta=1 init happens to sit
+        # near this env's optimum (exp(s) ≈ 1+s on unit-normalized
+        # docs) and leaves the fit nothing to do
         beta = self.param(
-            "beta", lambda k: jnp.asarray(1.0, jnp.float32)
+            "beta", lambda k: jnp.asarray(0.0, jnp.float32)
         )
         score_no_click = self.param(
             "score_no_click", lambda k: jnp.asarray(0.0, jnp.float32)
@@ -225,7 +232,6 @@ class SlateQJaxPolicy(JaxPolicy):
         from ray_tpu.algorithms.dqn.dqn import (
             _epsilon_exploration_config,
         )
-        from ray_tpu.parallel import mesh as mesh_lib
 
         config = dict(config)
         config["exploration_config"] = _epsilon_exploration_config(
@@ -241,10 +247,11 @@ class SlateQJaxPolicy(JaxPolicy):
             np.int32,
         )  # (A, S)
 
-        self.mesh = config.get("_mesh") or mesh_lib.make_mesh()
-        self.n_shards = mesh_lib.num_data_shards(self.mesh)
-        self._param_sharding = mesh_lib.replicated(self.mesh)
-        self._data_sharding = mesh_lib.data_sharding(self.mesh)
+        self.sharding_backend = config.get("sharding_backend", "mesh")
+        self.mesh = sharding_lib.resolve_mesh(config)
+        self.n_shards = sharding_lib.num_shards(self.mesh)
+        self._param_sharding = sharding_lib.replicated(self.mesh)
+        self._data_sharding = sharding_lib.batch_sharded(self.mesh)
 
         self.qnet = _ItemQNet(tuple(config.get("hiddens", (64, 64))))
         self.choice_model = _ChoiceModel()
@@ -378,6 +385,7 @@ class SlateQJaxPolicy(JaxPolicy):
 
         gamma = self.gamma
         tx = self._tx
+        axis = sharding_lib.data_axis(self.mesh)
 
         def device_fn(params, opt_state, aux, batch, rng, coeffs):
             obs = batch[SampleBatch.OBS]
@@ -433,9 +441,9 @@ class SlateQJaxPolicy(JaxPolicy):
                 # weight per sample doesn't depend on how clicks land
                 # across shards (pmean of grads follows)
                 n = jnp.maximum(
-                    jax.lax.psum(clicked.sum(), "data"), 1.0
+                    jax.lax.psum(clicked.sum(), axis), 1.0
                 )
-                shards = jax.lax.psum(1.0, "data")
+                shards = jax.lax.psum(1.0, axis)
                 td_loss = (
                     shards * jnp.sum(is_weights * jnp.square(td)) / n
                 )
@@ -471,7 +479,7 @@ class SlateQJaxPolicy(JaxPolicy):
                 (loss, (clicked_q, td, n, choice_loss)),
                 grads,
             ) = jax.value_and_grad(loss_fn, has_aux=True)(params)
-            grads = jax.lax.pmean(grads, "data")
+            grads = jax.lax.pmean(grads, axis)
             updates, opt_state = tx.update(grads, opt_state, params)
             params = optax.apply_updates(params, updates)
             stats = {
@@ -483,17 +491,30 @@ class SlateQJaxPolicy(JaxPolicy):
                 "click_fraction": jnp.mean(click.sum(axis=1)),
             }
             stats = jax.tree_util.tree_map(
-                lambda x: jax.lax.pmean(x, "data"), stats
+                lambda x: jax.lax.pmean(x, axis), stats
             )
             return params, opt_state, stats
 
         sharded = jax.shard_map(
             device_fn,
             mesh=self.mesh,
-            in_specs=(P(), P(), P(), P("data"), P(), P()),
+            in_specs=(P(), P(), P(), P(axis), P(), P()),
             out_specs=(P(), P(), P()),
         )
-        return jax.jit(sharded, donate_argnums=(1,))
+        label = f"learn[{type(self).__name__}:{batch_size}]"
+        if self.sharding_backend == "mesh":
+            rep = self._param_sharding
+            dat = self._data_sharding
+            return sharding_lib.sharded_jit(
+                sharded,
+                in_specs=(rep, rep, rep, dat, rep, rep),
+                out_specs=(rep, rep, rep),
+                donate_argnums=(1,),
+                label=label,
+            )
+        return sharding_lib.sharded_jit(
+            sharded, donate_argnums=(1,), label=label
+        )
 
     def _refold_exploration_config(self, new_config):
         from ray_tpu.algorithms.dqn.dqn import (

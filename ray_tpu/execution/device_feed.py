@@ -40,6 +40,8 @@ class DeviceFeeder:
                 return
             host_batch, meta = item
             try:
+                import time as _time
+
                 sharding = self._sharding
                 if callable(sharding) and not hasattr(
                     sharding, "devices"
@@ -48,11 +50,20 @@ class DeviceFeeder:
                     # JaxPolicy.batch_shardings: frame pools ride
                     # replicated while row columns shard over data)
                     sharding = sharding(host_batch)
+                t0 = _time.perf_counter()
                 if sharding is not None:
                     dev = jax.device_put(host_batch, sharding)
                 else:
                     dev = jax.device_put(host_batch)
                 jax.block_until_ready(dev)
+                # same series as the sync-path transfer timer in
+                # JaxPolicy.learn_on_batch, so backend A/Bs compare
+                # transfer cost regardless of which path fed the batch
+                from ray_tpu.utils.metrics import timer_histogram
+
+                timer_histogram(
+                    "ray_tpu_learner_transfer_seconds"
+                ).observe(_time.perf_counter() - t0)
                 out = (dev, meta)
             except Exception as e:  # surface to consumer, meta intact
                 out = (e, meta)

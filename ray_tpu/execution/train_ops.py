@@ -3,9 +3,14 @@
 Counterpart of the reference's ``rllib/execution/train_ops.py``
 (``train_one_step :42``, ``multi_gpu_train_one_step :92``). The reference's
 multi-GPU path — load_batch_into_buffer per device, threaded tower grads,
-CPU averaging — is replaced by the JaxPolicy learner: one device_put of the
-batch onto the mesh and one jitted multi-epoch SGD call, so both entry
-points below collapse to the same code.
+CPU averaging — is replaced by the JaxPolicy learner on the
+``ray_tpu.sharding`` runtime: one device_put of the batch onto the mesh
+(row-sharded columns, replicated params) and one ``sharded_jit``
+multi-epoch SGD call, so both entry points below collapse to the same
+code. Per-stage timers (transfer / compile / step) land in the policy's
+``last_learn_timers`` and in the ``ray_tpu_learner_*_seconds``
+histograms (utils/metrics.py); Algorithm.step copies them into
+``results["info"]["timers"]``.
 """
 
 from __future__ import annotations
@@ -17,6 +22,7 @@ from ray_tpu.data.sample_batch import (
     MultiAgentBatch,
     SampleBatch,
 )
+from ray_tpu.utils.metrics import timer_histogram
 
 NUM_ENV_STEPS_TRAINED = "num_env_steps_trained"
 NUM_AGENT_STEPS_TRAINED = "num_agent_steps_trained"
@@ -24,8 +30,15 @@ NUM_AGENT_STEPS_TRAINED = "num_agent_steps_trained"
 
 def train_one_step(algorithm, train_batch) -> Dict:
     """reference train_ops.py:42."""
+    import time as _time
+
     local_worker = algorithm.workers.local_worker()
+    t0 = _time.perf_counter()
     info = local_worker.learn_on_batch(train_batch)
+    algorithm._timers["learn_on_batch_s"] = _time.perf_counter() - t0
+    timer_histogram("ray_tpu_learner_total_seconds").observe(
+        algorithm._timers["learn_on_batch_s"]
+    )
     algorithm._counters[NUM_ENV_STEPS_TRAINED] += train_batch.env_steps()
     algorithm._counters[NUM_AGENT_STEPS_TRAINED] += (
         train_batch.agent_steps()

@@ -1,3 +1,8 @@
+"""Legacy parallel namespace — jax version shims plus an adapter over
+the sharding runtime (``ray_tpu.sharding``). The mesh helpers re-
+exported here keep the historical ``("data",)`` axis naming for the
+pmap-backend learn programs; new code targets ``ray_tpu.sharding``."""
+
 import functools
 
 import jax

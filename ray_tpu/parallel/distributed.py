@@ -527,6 +527,24 @@ def initialize(
     )
     if coordinator_address is None:
         return  # single-host: nothing to do
+    # CPU backend: XLA's default CPU client cannot run cross-process
+    # computations ("Multiprocess computations aren't implemented on
+    # the CPU backend") — switch its collectives to gloo BEFORE the
+    # backend initializes, so the simulated multi-host tests (and any
+    # CPU-only DCN bring-up) get working psum/broadcast. TPU ignores
+    # this path entirely.
+    platforms = str(
+        getattr(jax.config, "jax_platforms", None)
+        or os.environ.get("JAX_PLATFORMS", "")
+    ).lower()
+    if "cpu" in platforms:
+        try:
+            jax.config.update(
+                "jax_cpu_collectives_implementation", "gloo"
+            )
+        except Exception:
+            pass  # older/newer jax without the option (or gloo-less
+            # jaxlib): keep the default and let init surface errors
     num_processes = int(
         num_processes
         if num_processes is not None

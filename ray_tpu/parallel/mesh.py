@@ -1,13 +1,11 @@
-"""Device mesh helpers for the learner plane.
+"""Legacy device-mesh helpers — now an adapter over ``ray_tpu.sharding``.
 
-This is where the reference's multi-GPU tower machinery
-(``rllib/policy/torch_policy.py:498-624``: per-device replicas, loader
-threads, CPU grad averaging) collapses into JAX sharding: one mesh, one
-jitted update, XLA collectives over ICI.
-
-Axis conventions used across ray_tpu:
-  - "data": batch data parallelism (the parity axis with the reference)
-  - "model": tensor parallelism for large learner models (TPU extension)
+This module predates the sharding runtime (``ray_tpu/sharding/``): its
+``("data",)`` axis naming is kept for the pmap-backend learn programs
+and the multi-host worker scripts that still build meshes here. New
+code should use ``ray_tpu.sharding`` directly (axis ``"batch"``); the
+helpers below all derive the axis from the mesh object, so they accept
+meshes from either namespace.
 """
 
 from __future__ import annotations
@@ -15,42 +13,35 @@ from __future__ import annotations
 from typing import Optional, Sequence, Tuple
 
 import jax
-import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ray_tpu.sharding import mesh as _mesh_rt
 
 DATA_AXIS = "data"
 MODEL_AXIS = "model"
 
 
 def get_devices(platform: Optional[str] = None):
-    devs = jax.devices()
-    if platform:
-        devs = [d for d in devs if d.platform == platform]
-    return devs
+    return _mesh_rt.available_devices(platform)
 
 
 def make_mesh(
     axis_shapes: Optional[Sequence[Tuple[str, int]]] = None,
     devices=None,
 ) -> Mesh:
-    """Build a mesh; default is a 1-D data mesh over all devices."""
-    devices = devices if devices is not None else jax.devices()
+    """Build a mesh; default is a 1-D ("data",) mesh over all devices
+    (the legacy axis name — the sharding runtime's default is
+    ("batch",))."""
+    if devices is None:
+        devices = jax.devices()
     if axis_shapes is None:
         axis_shapes = [(DATA_AXIS, len(devices))]
-    names = tuple(n for n, _ in axis_shapes)
-    shape = tuple(s for _, s in axis_shapes)
-    n = int(np.prod(shape))
-    if n > len(devices):
-        raise ValueError(
-            f"mesh shape {shape} needs {n} devices, have {len(devices)}"
-        )
-    arr = np.asarray(devices[:n]).reshape(shape)
-    return Mesh(arr, names)
+    return _mesh_rt.get_mesh(devices=devices, axis_shapes=axis_shapes)
 
 
 def data_sharding(mesh: Mesh) -> NamedSharding:
-    """Leading-dim batch sharding."""
-    return NamedSharding(mesh, P(DATA_AXIS))
+    """Leading-dim batch sharding (axis name taken from the mesh)."""
+    return NamedSharding(mesh, P(_mesh_rt.data_axis(mesh)))
 
 
 def replicated(mesh: Mesh) -> NamedSharding:
@@ -58,4 +49,4 @@ def replicated(mesh: Mesh) -> NamedSharding:
 
 
 def num_data_shards(mesh: Mesh) -> int:
-    return mesh.shape[DATA_AXIS]
+    return _mesh_rt.num_shards(mesh)

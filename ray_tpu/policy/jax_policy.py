@@ -10,7 +10,11 @@ with a single jitted update:
 
   - the entire SGD nest — ``num_sgd_iter`` epochs × minibatches, per-device
     shuffling, loss/grad, ICI gradient pmean, optimizer — compiles to ONE
-    XLA program via ``jax.shard_map`` over a ("data",) mesh;
+    XLA program via ``jax.shard_map`` over the learner mesh, lowered
+    through the ``ray_tpu.sharding`` runtime (``sharded_jit`` with
+    replicated-param / row-sharded-batch NamedShardings and opt-state
+    donation when ``config.sharding_backend == "mesh"``, the default;
+    ``"pmap"`` keeps legacy implicit placement);
   - no loader threads, no per-device towers, no CPU gradient averaging;
   - schedule-driven scalars (lr, entropy coeff, kl coeff) enter as traced
     scalar args so schedules never trigger recompilation.
@@ -30,12 +34,13 @@ import numpy as np
 import optax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from ray_tpu import sharding as sharding_lib
 from ray_tpu.data.sample_batch import SampleBatch
 from ray_tpu.models.catalog import ModelCatalog
 from ray_tpu.ops.framestack import FRAME_IDX as _FRAME_IDX
 from ray_tpu.ops.framestack import FRAMES as _FRAMES
-from ray_tpu.parallel import mesh as mesh_lib
 from ray_tpu.policy.policy import Policy
+from ray_tpu.utils.metrics import timer_histogram
 
 
 def _tree_to_device(tree, sharding=None):
@@ -60,6 +65,20 @@ class JaxPolicy(Policy):
     # bypass JaxPolicy.__init__ (SAC/DDPG families) stay feedforward.
     _unroll_T: int = 1
 
+    # Backend default for policies that bypass __init__ (their own
+    # constructors overwrite it from config via resolve_mesh).
+    sharding_backend: str = "mesh"
+
+    @property
+    def last_learn_timers(self) -> Dict[str, float]:
+        """Per-stage timers of the most recent learn call (device
+        transfer / compile / step), lazily created so bespoke-net
+        policies that bypass __init__ report them too."""
+        t = self.__dict__.get("_last_learn_timers")
+        if t is None:
+            t = self.__dict__["_last_learn_timers"] = {}
+        return t
+
     def __init__(self, observation_space, action_space, config: Dict):
         super().__init__(observation_space, action_space, config)
         self.model_config = dict(config.get("model") or {})
@@ -81,11 +100,12 @@ class JaxPolicy(Policy):
             else 1
         )
 
-        # ---- mesh / shardings ----
-        self.mesh = config.get("_mesh") or mesh_lib.make_mesh()
-        self.n_shards = mesh_lib.num_data_shards(self.mesh)
-        self._param_sharding = mesh_lib.replicated(self.mesh)
-        self._data_sharding = mesh_lib.data_sharding(self.mesh)
+        # ---- mesh / shardings (ray_tpu.sharding runtime) ----
+        self.sharding_backend = config.get("sharding_backend", "mesh")
+        self.mesh = sharding_lib.resolve_mesh(config)
+        self.n_shards = sharding_lib.num_shards(self.mesh)
+        self._param_sharding = sharding_lib.replicated(self.mesh)
+        self._data_sharding = sharding_lib.batch_sharded(self.mesh)
 
         # ---- params / optimizer ----
         seed = int(config.get("seed") or 0)
@@ -453,6 +473,10 @@ class JaxPolicy(Policy):
         num_iters = self.num_sgd_iter
         tx = self._tx
         mesh = self.mesh
+        # data axis name comes from the mesh: "batch" on the sharding
+        # runtime's meshes, "data" on legacy/pmap ones — the program
+        # must not hard-code either
+        axis = sharding_lib.data_axis(mesh)
         loss_fn = self.loss_with_aux
 
         rebuild_obs = self._rebuild_obs_from_frames
@@ -469,7 +493,7 @@ class JaxPolicy(Policy):
                 }
                 batch = rebuild_obs(frames, batch, stack_k)
             # Different shuffle stream per data shard.
-            rng = jax.random.fold_in(rng, jax.lax.axis_index("data"))
+            rng = jax.random.fold_in(rng, jax.lax.axis_index(axis))
 
             # uint8 row columns (pixel obs) gather 3-4x faster viewed
             # as uint32 lanes (measured: 127 -> 420 GB/s effective on
@@ -515,7 +539,7 @@ class JaxPolicy(Policy):
                 (loss, stats), grads = jax.value_and_grad(
                     loss_fn, has_aux=True
                 )(params, aux, mb, mb_rng, coeffs)
-                grads = jax.lax.pmean(grads, "data")
+                grads = jax.lax.pmean(grads, axis)
                 updates, opt_state = tx.update(grads, opt_state, params)
                 lr = coeffs["lr"]
                 updates = jax.tree_util.tree_map(
@@ -571,7 +595,7 @@ class JaxPolicy(Policy):
             # (every other entry is 0, so the sum IS that value)
             def reduce_stat(name, x):
                 agg = x.sum() if name == "grad_gnorm" else x.mean()
-                return jax.lax.pmean(agg, "data")
+                return jax.lax.pmean(agg, axis)
 
             stats = {
                 k: reduce_stat(k, v) for k, v in stats.items()
@@ -581,13 +605,31 @@ class JaxPolicy(Policy):
         sharded = jax.shard_map(
             device_fn,
             mesh=mesh,
-            in_specs=(P(), P(), P(), P("data"), P(), P()),
+            in_specs=(P(), P(), P(), P(axis), P(), P()),
             out_specs=(P(), P(), P()),
         )
         # Donate only opt_state: params buffers must stay valid because an
         # async sampler thread may be running compute_actions with them
         # concurrently (IMPALA sync mode shares the policy object).
-        return jax.jit(sharded, donate_argnums=(1,))
+        label = f"learn[{type(self).__name__}:{batch_size}]"
+        if self.sharding_backend == "mesh":
+            # explicit placement: params/opt/aux/rng/coeffs replicated,
+            # batch row-sharded — jit broadcasts one sharding over each
+            # argument's pytree leaves, and the compile layer tracks
+            # retraces (compile-cache stats)
+            rep = self._param_sharding
+            dat = self._data_sharding
+            return sharding_lib.sharded_jit(
+                sharded,
+                in_specs=(rep, rep, rep, dat, rep, rep),
+                out_specs=(rep, rep, rep),
+                donate_argnums=(1,),
+                label=label,
+            )
+        # pmap-era fallback: placement left to device_put, same program
+        return sharding_lib.sharded_jit(
+            sharded, donate_argnums=(1,), label=label
+        )
 
     def prepare_batch(self, samples) -> Tuple[Dict[str, np.ndarray], int]:
         """Public phase 1 of learning: turn a SampleBatch (or plain dict of
@@ -664,16 +706,14 @@ class JaxPolicy(Policy):
         shard over the data axis; the deduplicated frame pool
         (``obs_frames``) replicates so every shard can gather stacks
         locally. Pass this method itself as a DeviceFeeder's
-        ``sharding`` to get per-batch resolution."""
-        if isinstance(host_tree, dict) and _FRAMES in host_tree:
-            return {
-                k: (
-                    self._param_sharding
-                    if k == _FRAMES
-                    else self._data_sharding
-                )
-                for k in host_tree
-            }
+        ``sharding`` to get per-batch resolution. Columns whose
+        leading dim doesn't divide the shard count (only possible for
+        trees that bypassed ``prepare_batch``) fall back to
+        replication instead of erroring (specs.leaf_sharding)."""
+        if isinstance(host_tree, dict):
+            return sharding_lib.sharding_tree(
+                host_tree, self.mesh, replicate_keys=(_FRAMES,)
+            )
         return self._data_sharding
 
     def learn_fn(self, batch_size: int, *, with_frames: bool = False):
@@ -727,6 +767,8 @@ class JaxPolicy(Policy):
         the fetch is cheap. Deferring also skips
         ``after_learn_on_batch`` (host-side coefficient updates need
         host stats), so only defer for policies that don't override it."""
+        import time as _time
+
         aux = self.aux_state
         if _FRAMES in dev_batch:
             dev_batch = dict(dev_batch)
@@ -739,6 +781,9 @@ class JaxPolicy(Policy):
             fn = self.learn_fn(batch_size)
         self._update_scheduled_coeffs()
         self._rng, rng = jax.random.split(self._rng)
+        compiles_before = getattr(fn, "traces", 0)
+        compile_s_before = getattr(fn, "compile_time_s", 0.0)
+        t0 = _time.perf_counter()
         self.params, self.opt_state, stats = fn(
             self.params,
             self.opt_state,
@@ -755,6 +800,28 @@ class JaxPolicy(Policy):
         # One device→host transfer for all stats (individual float()
         # conversions each pay a full device round trip).
         stats = jax.device_get(stats)
+        # per-stage timers: a call that traced pays compile; the rest
+        # of this call's wall time is the step (device compute + stats
+        # fetch). Exposed both as metrics series (utils.metrics) and on
+        # the policy for train()-result reporting.
+        total_s = _time.perf_counter() - t0
+        compile_s = (
+            getattr(fn, "compile_time_s", 0.0) - compile_s_before
+        )
+        self.last_learn_timers["learn_compile_s"] = compile_s
+        self.last_learn_timers["learn_step_s"] = max(
+            0.0, total_s - compile_s
+        )
+        self.last_learn_timers["learn_recompiles"] = float(
+            getattr(fn, "traces", 0) - compiles_before
+        )
+        timer_histogram("ray_tpu_learner_step_seconds").observe(
+            self.last_learn_timers["learn_step_s"]
+        )
+        if compile_s:
+            timer_histogram(
+                "ray_tpu_learner_compile_seconds"
+            ).observe(compile_s)
         out = {k: float(v) for k, v in stats.items()}
         out.update(self.after_learn_on_batch(out))
         out["cur_lr"] = self.coeff_values["lr"]
@@ -765,9 +832,12 @@ class JaxPolicy(Policy):
         TorchPolicy.learn_on_batch :467 + the whole train_ops stack).
         ``jax.device_put`` dispatch is asynchronous, so the transfer
         overlaps this host code until the program consumes the buffers."""
+        import time as _time
+
         batch, bsize = self.prepare_batch(samples)
         # the frame pool is replicated, not row-sharded
         frames = batch.pop(_FRAMES, None)
+        t0 = _time.perf_counter()
         dev = _tree_to_device(batch, self._data_sharding)
         if frames is not None:
             dev = dict(
@@ -778,6 +848,16 @@ class JaxPolicy(Policy):
                     )
                 },
             )
+        # block so the transfer timer is honest (the learn program
+        # would wait on these buffers anyway; only the sliver of host
+        # code between here and dispatch loses overlap — the async
+        # path is the DeviceFeeder, which times its own transfers)
+        jax.block_until_ready(dev)
+        transfer_s = _time.perf_counter() - t0
+        self.last_learn_timers["learn_transfer_s"] = transfer_s
+        timer_histogram(
+            "ray_tpu_learner_transfer_seconds"
+        ).observe(transfer_s)
         return self.learn_on_device_batch(dev, bsize)
 
     def after_learn_on_batch(self, stats: Dict[str, float]) -> Dict[str, float]:
@@ -1056,7 +1136,9 @@ class JaxPolicy(Policy):
                 )(params, aux, batch, rng, coeffs)
                 return grads, dict(stats, total_loss=loss)
 
-            self._grad_fn = jax.jit(gfn)
+            self._grad_fn = sharding_lib.sharded_jit(
+                gfn, label=f"grads[{type(self).__name__}]"
+            )
         batch = self._batch_to_train_tree(samples)
         if self._unroll_T > 1:
             # async-gradient batches bypass prepare_batch: trim to
@@ -1087,7 +1169,11 @@ class JaxPolicy(Policy):
                 )
                 return optax.apply_updates(params, updates), opt_state
 
-            self._apply_fn = jax.jit(afn, donate_argnums=(0, 1))
+            self._apply_fn = sharding_lib.sharded_jit(
+                afn,
+                donate_argnums=(0, 1),
+                label=f"apply_grads[{type(self).__name__}]",
+            )
         self.params, self.opt_state = self._apply_fn(
             self.params,
             self.opt_state,

@@ -1,0 +1,112 @@
+"""Mesh construction for the sharding runtime.
+
+One mesh per process (cached), built from whatever devices the backend
+exposes: real TPU cores, a CPU fallback, or simulated host devices via
+``XLA_FLAGS=--xla_force_host_platform_device_count=N`` (the standard
+way to test multi-device layouts without hardware — tests/conftest.py
+forces 8).
+
+Axis conventions:
+  - ``"batch"``: data parallelism over the train-batch leading dim —
+    the only axis the learner uses today.
+  - ``"model"``: reserved for tensor parallelism of large learner
+    models (multi-chip PRs add shapes here; the name is fixed now so
+    specs written against it won't churn).
+
+The legacy ``ray_tpu.parallel.mesh`` module is an adapter over this one
+and keeps its historical ``"data"`` axis name for the pmap-path
+programs; everything here derives the axis from the mesh object, so
+both namings interoperate.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+BATCH_AXIS = "batch"
+MODEL_AXIS = "model"
+
+# (device ids, axis names, axis sizes) -> Mesh. Mesh construction is
+# cheap but identity matters: jit caches key on sharding objects, and
+# two equal-but-distinct meshes would recompile every learn program.
+_MESH_CACHE: dict = {}
+
+
+def available_devices(platform: Optional[str] = None):
+    """Devices to build meshes from. ``platform`` filters ("tpu",
+    "cpu"); when the requested platform has no devices the CPU host
+    devices are the fallback, so a learner configured for TPU still
+    comes up (slowly) on a dev box."""
+    devs = jax.devices()
+    if platform:
+        matched = [d for d in devs if d.platform == platform]
+        if matched:
+            return matched
+        devs = [d for d in jax.devices() if d.platform == "cpu"] or devs
+    return devs
+
+
+def get_mesh(
+    devices=None,
+    axis_shapes: Optional[Sequence[Tuple[str, int]]] = None,
+    platform: Optional[str] = None,
+) -> Mesh:
+    """Build (or fetch the cached) mesh.
+
+    Default shape is a 1-D ``("batch",)`` data mesh over all available
+    devices — simulated host devices from
+    ``--xla_force_host_platform_device_count`` count like real ones.
+    ``axis_shapes`` opts into richer layouts, e.g.
+    ``[("batch", 4), ("model", 2)]``.
+    """
+    if devices is None:
+        devices = available_devices(platform)
+    devices = list(devices)
+    if axis_shapes is None:
+        axis_shapes = [(BATCH_AXIS, len(devices))]
+    names = tuple(n for n, _ in axis_shapes)
+    shape = tuple(int(s) for _, s in axis_shapes)
+    n = int(np.prod(shape))
+    if n > len(devices):
+        raise ValueError(
+            f"mesh shape {dict(axis_shapes)} needs {n} devices, "
+            f"have {len(devices)}"
+        )
+    key = (tuple(id(d) for d in devices[:n]), names, shape)
+    mesh = _MESH_CACHE.get(key)
+    if mesh is None:
+        mesh = Mesh(np.asarray(devices[:n]).reshape(shape), names)
+        _MESH_CACHE[key] = mesh
+    return mesh
+
+
+def clear_mesh_cache() -> None:
+    _MESH_CACHE.clear()
+
+
+def data_axis(mesh: Mesh) -> str:
+    """The data-parallel axis of a mesh: its first axis. Works for
+    both the new ``("batch",)`` and the legacy ``("data",)`` naming —
+    learn programs must use this instead of a string literal."""
+    return mesh.axis_names[0]
+
+
+def num_shards(mesh: Mesh) -> int:
+    return int(mesh.shape[data_axis(mesh)])
+
+
+def simulated_device_env(n: int) -> dict:
+    """Env-var dict that makes a fresh process expose ``n`` simulated
+    CPU devices (must be set before jax initializes its backend; use
+    for subprocess tests and docs examples)."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        flags = (
+            flags + f" --xla_force_host_platform_device_count={n}"
+        ).strip()
+    return {"JAX_PLATFORMS": "cpu", "XLA_FLAGS": flags}

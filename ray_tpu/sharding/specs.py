@@ -1,0 +1,88 @@
+"""NamedSharding / PartitionSpec builders.
+
+The placement vocabulary of the learner plane, as first-class
+functions instead of per-call-site constructions:
+
+  - params / optimizer state / aux (target nets, frame pools):
+    replicated — every shard holds the full tree;
+  - SampleBatch columns: sharded over the leading (row) dim on the
+    mesh's data axis;
+  - ragged leading dims (a column whose row count doesn't divide the
+    shard count) fall back to replication rather than erroring — the
+    ``get_naive_sharding`` pattern from the retrieved references.
+
+Everything derives the axis name from the mesh object, so specs work
+on both the ``("batch",)`` meshes this package builds and the legacy
+``("data",)`` meshes of ``ray_tpu.parallel``.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, Optional
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ray_tpu.sharding.mesh import data_axis, num_shards
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    """Full copy on every device (params, opt state, scalars)."""
+    return NamedSharding(mesh, P())
+
+
+def batch_sharded(mesh: Mesh, ndim_prefix: int = 1) -> NamedSharding:
+    """Leading-dim row sharding over the data axis. ``ndim_prefix``
+    places the axis deeper, e.g. 2 -> P(None, axis) for (T, B, ...)
+    layouts."""
+    spec = (None,) * (ndim_prefix - 1) + (data_axis(mesh),)
+    return NamedSharding(mesh, P(*spec))
+
+
+def leaf_sharding(x, mesh: Mesh) -> NamedSharding:
+    """Per-array placement: shard rows when the leading dim divides
+    the shard count, otherwise replicate (uneven-dim fallback)."""
+    shape = getattr(x, "shape", ())
+    if len(shape) >= 1 and shape[0] % num_shards(mesh) == 0 and shape[0] > 0:
+        return batch_sharded(mesh)
+    return replicated(mesh)
+
+
+def sharding_tree(tree, mesh: Mesh, replicate_keys: Iterable[str] = ()):
+    """Per-leaf sharding tree for a (possibly nested) batch tree.
+    Top-level dict keys in ``replicate_keys`` pin to replication no
+    matter their shape — e.g. the deduplicated frame pool, which every
+    shard gathers from locally."""
+    replicate_keys = set(replicate_keys)
+    if isinstance(tree, dict) and replicate_keys:
+        return {
+            k: (
+                jax.tree_util.tree_map(
+                    lambda x: replicated(mesh), v
+                )
+                if k in replicate_keys
+                else jax.tree_util.tree_map(
+                    lambda x: leaf_sharding(x, mesh), v
+                )
+            )
+            for k, v in tree.items()
+        }
+    return jax.tree_util.tree_map(lambda x: leaf_sharding(x, mesh), tree)
+
+
+def shard_batch(
+    tree,
+    mesh: Mesh,
+    replicate_keys: Iterable[str] = (),
+    *,
+    block: bool = False,
+):
+    """``jax.device_put`` a host tree onto the mesh with per-leaf
+    shardings. ``block=True`` waits for the transfer (honest timing;
+    otherwise dispatch is async and overlaps the caller)."""
+    dev = jax.device_put(
+        tree, sharding_tree(tree, mesh, replicate_keys)
+    )
+    if block:
+        jax.block_until_ready(dev)
+    return dev

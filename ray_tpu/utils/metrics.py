@@ -112,6 +112,30 @@ class Histogram(Metric):
             ]
 
 
+def get_metric(name: str) -> Optional[Metric]:
+    with _REGISTRY_LOCK:
+        return _REGISTRY.get(name)
+
+
+# sub-ms..minutes buckets: device transfers sit in the low
+# milliseconds, XLA compiles in the seconds-to-minutes range
+_TIMER_BOUNDARIES = (
+    0.0005, 0.002, 0.01, 0.05, 0.2, 1.0, 5.0, 30.0, 120.0,
+)
+
+
+def timer_histogram(name: str, description: str = "") -> Histogram:
+    """Get-or-create a latency Histogram (idempotent accessor for the
+    per-stage learner timers: transfer / compile / step — see
+    Policy.last_learn_timers and docs/sharding.md)."""
+    m = get_metric(name)
+    if isinstance(m, Histogram):
+        return m
+    return Histogram(
+        name, description, boundaries=_TIMER_BOUNDARIES
+    )
+
+
 def all_metrics() -> List[Metric]:
     with _REGISTRY_LOCK:
         return list(_REGISTRY.values())

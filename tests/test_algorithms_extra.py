@@ -239,6 +239,7 @@ def test_ddppo_requires_workers():
         )
 
 
+@pytest.mark.slow  # ~38 s on the tier-1 host: full DD-PPO learning run
 def test_ddppo_cartpole_learns():
     from ray_tpu.algorithms.ddppo import DDPPOConfig
 

@@ -105,4 +105,6 @@ def test_choice_model_learns_click_behavior():
     algo.cleanup()
     assert len(losses) >= 12, "choice model never trained"
     assert np.mean(losses[-3:]) < losses[0], (losses[0], losses[-3:])
-    assert abs(betas[-1] - 1.0) > 1e-3  # beta moved off its init
+    # beta moved off its 0.0 (uniform-choice) init, toward the env's
+    # positive affinity scale
+    assert betas[-1] > 1e-3

@@ -206,6 +206,7 @@ class _Sleeper(Trainable):
         pass
 
 
+@pytest.mark.slow  # ~34 s on the tier-1 host: wall-clock A/B of two full runs
 def test_parallel_trials_beat_serial_wall_clock():
     """VERDICT r1: N trials must progress concurrently — wall-clock
     below the serial sum (both modes pay the same actor startup)."""
