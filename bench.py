@@ -27,7 +27,12 @@ framework under test.
 Observations are structured (block-textured) frames, matching real Atari
 content rather than incompressible noise. Prints ONE JSON line.
 
-Flags:  --profile DIR   capture a jax.profiler trace of the timed rounds
+Flags:  --profile       run ONE telemetry-instrumented PPO iteration
+                        (docs/observability.md): writes the chrome
+                        trace to benchmarks/e2e/ppo_iteration_trace.json
+                        plus a telemetry-overhead A/B entry
+                        (benchmarks/e2e/telemetry_overhead.json)
+        --xprof DIR     capture a jax.profiler trace of the timed rounds
         --e2e           run the five BASELINE.md end-to-end configs
                         (rollout+learner; see bench_e2e.py) instead
 """
@@ -512,6 +517,143 @@ def bench_sharding_ab(
     return report
 
 
+def bench_telemetry_overhead(b=1024, mb=256, iters=2, rounds=20):
+    """Disabled-vs-enabled tracing A/B on the SAME fixed-seed PPO
+    learn step (small MLP geometry — isolates per-call instrumentation
+    cost, not model compute). The ``tracing_off`` median is the
+    regression sentinel for the default path: telemetry off must stay
+    within noise of an uninstrumented build."""
+    import gymnasium as gym
+
+    from ray_tpu.algorithms.ppo.ppo import PPOJaxPolicy
+    from ray_tpu.data.sample_batch import SampleBatch
+    from ray_tpu.util import tracing
+
+    rng = np.random.default_rng(0)
+    cols = {
+        SampleBatch.OBS: rng.standard_normal((b, 16)).astype(
+            np.float32
+        ),
+        SampleBatch.ACTIONS: rng.integers(0, 6, b).astype(np.int64),
+        SampleBatch.ACTION_LOGP: np.full(b, -1.79, np.float32),
+        SampleBatch.ACTION_DIST_INPUTS: rng.standard_normal(
+            (b, 6)
+        ).astype(np.float32),
+        SampleBatch.ADVANTAGES: rng.standard_normal(b).astype(
+            np.float32
+        ),
+        SampleBatch.VALUE_TARGETS: rng.standard_normal(b).astype(
+            np.float32
+        ),
+    }
+    policy = PPOJaxPolicy(
+        gym.spaces.Box(-10.0, 10.0, (16,), np.float32),
+        gym.spaces.Discrete(6),
+        {
+            "model": {"fcnet_hiddens": [64, 64]},
+            "train_batch_size": b,
+            "sgd_minibatch_size": mb,
+            "num_sgd_iter": iters,
+            "lr": 1e-4,
+            "seed": 0,
+        },
+    )
+    policy.learn_on_batch(SampleBatch(cols))  # compile
+    out = {}
+    was_enabled = tracing.is_enabled()
+    for mode in ("tracing_off", "tracing_on"):
+        (tracing.enable if mode == "tracing_on" else tracing.disable)()
+        times = []
+        for _ in range(rounds):
+            t0 = time.perf_counter()
+            policy.learn_on_batch(SampleBatch(cols))
+            times.append(time.perf_counter() - t0)
+        out[mode] = {
+            "learn_step_ms_median": round(
+                1e3 * float(np.median(times)), 3
+            ),
+            "learn_step_ms_p90": round(
+                1e3 * float(np.quantile(times, 0.9)), 3
+            ),
+        }
+    (tracing.enable if was_enabled else tracing.disable)()
+    tracing.clear()
+    off = out["tracing_off"]["learn_step_ms_median"]
+    on = out["tracing_on"]["learn_step_ms_median"]
+    out["on_vs_off"] = round(on / off, 3) if off else None
+    return out
+
+
+def bench_profile(trace_path=None, overhead_path=None):
+    """One telemetry-instrumented PPO run (plumbing geometry, pipelined
+    sampling): writes the chrome trace of the last iterations and a
+    telemetry-overhead A/B entry; prints ONE summary JSON line with the
+    ``info/telemetry`` roll-up (stage wall-times + overlap fraction)."""
+    import os
+    import urllib.request
+
+    import ray_tpu.env.synthetic_env  # noqa: F401 registers SyntheticFast-v0
+    from ray_tpu.algorithms.ppo import PPOConfig
+
+    os.makedirs("benchmarks/e2e", exist_ok=True)
+    trace_path = trace_path or "benchmarks/e2e/ppo_iteration_trace.json"
+    overhead_path = (
+        overhead_path or "benchmarks/e2e/telemetry_overhead.json"
+    )
+    cfg = (
+        PPOConfig()
+        .environment("SyntheticFast-v0")
+        .rollouts(
+            num_rollout_workers=2,
+            num_envs_per_worker=8,
+            rollout_fragment_length=128,
+            sample_prefetch=1,
+        )
+        .training(
+            train_batch_size=2048,
+            sgd_minibatch_size=512,
+            num_sgd_iter=2,
+            lr=3e-4,
+            model={"fcnet_hiddens": [64, 64]},
+        )
+        .debugging(seed=0)
+        .telemetry(metrics_port=0, trace=True)
+    )
+    algo = cfg.build()
+    try:
+        tel = {}
+        for _ in range(4):  # iter 1 compiles; spans settle by 3-4
+            result = algo.train()
+            tel = result["info"].get("telemetry", tel)
+        algo.export_timeline(trace_path, last_n=2)
+        port = algo._telemetry.metrics_port
+        scrape = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=10
+        ).read().decode()
+        series = sorted(
+            {
+                ln.split("{")[0].split(" ")[0]
+                for ln in scrape.splitlines()
+                if ln.startswith("ray_tpu_")
+            }
+        )
+    finally:
+        algo.cleanup()
+    overhead = bench_telemetry_overhead()
+    with open(overhead_path, "w") as f:
+        json.dump(overhead, f, indent=1)
+    report = {
+        "metric": "ppo_iteration_profile",
+        "telemetry": tel,
+        "trace": trace_path,
+        "metrics_series": series,
+        "telemetry_overhead": overhead,
+        "artifacts": [trace_path, overhead_path],
+    }
+    print(json.dumps(report))
+    return report
+
+
 def main():
     if "--e2e" in sys.argv:
         from bench_e2e import main as e2e_main
@@ -521,9 +663,12 @@ def main():
     if "--sharding-ab" in sys.argv:
         bench_sharding_ab()
         return
-    profile_dir = None
     if "--profile" in sys.argv:
-        i = sys.argv.index("--profile")
+        bench_profile()
+        return
+    profile_dir = None
+    if "--xprof" in sys.argv:
+        i = sys.argv.index("--xprof")
         profile_dir = (
             sys.argv[i + 1] if len(sys.argv) > i + 1 else "/tmp/ray_tpu_trace"
         )

@@ -59,6 +59,16 @@ class Algorithm(Trainable):
         self._counters: Dict[str, int] = collections.defaultdict(int)
         self._timers: Dict[str, float] = collections.defaultdict(float)
         self._episode_history: List = []
+        # run telemetry (docs/observability.md): activate BEFORE the
+        # WorkerSet exists so the very first remote submission already
+        # carries trace context; None when the config leaves it off
+        from ray_tpu import telemetry as telemetry_lib
+
+        self._telemetry = telemetry_lib.init_from_config(config)
+        # iteration start stamps, for export_timeline(last_n=...)
+        self._iteration_marks: collections.deque = collections.deque(
+            maxlen=1024
+        )
 
         env_spec = config.get("env")
         env_creator = get_env_creator(env_spec) if env_spec else None
@@ -160,37 +170,48 @@ class Algorithm(Trainable):
 
     def step(self) -> Dict:
         """reference algorithm.py:547 (incl. worker-failure handling)."""
+        from ray_tpu import telemetry as telemetry_lib
+        from ray_tpu.util import tracing
+
         config = self.config
         t0 = time.time()
+        self._iteration_marks.append(t0)
+        learn_before = telemetry_lib.metrics.learn_steps_total()
         results: Dict[str, Any] = {}
         train_info: Dict[str, Any] = {}
         min_t = config.get("min_time_s_per_iteration")
         min_ts = config.get("min_sample_timesteps_per_iteration") or 0
         ts_before = self._counters[NUM_ENV_STEPS_SAMPLED]
-        while True:
-            try:
-                info = self.training_step()
-                if info:
-                    train_info = info
-            except (
-                ray.core.object_store.RayActorError,
-                ray.core.object_store.WorkerCrashedError,
-            ):
-                if config.get("recreate_failed_workers"):
-                    self.workers.recreate_failed_workers()
-                    continue
-                elif config.get("ignore_worker_failures"):
-                    continue
-                raise
-            done_t = (
-                min_t is None or (time.time() - t0) >= min_t
-            )
-            done_ts = (
-                self._counters[NUM_ENV_STEPS_SAMPLED] - ts_before
-                >= min_ts
-            )
-            if done_t and done_ts:
-                break
+        # the iteration span is the driver-side root every remote
+        # submission in this iteration parents under
+        with tracing.start_span(
+            "train:iteration", iteration=self._iteration + 1
+        ):
+            while True:
+                try:
+                    info = self.training_step()
+                    if info:
+                        train_info = info
+                except (
+                    ray.core.object_store.RayActorError,
+                    ray.core.object_store.WorkerCrashedError,
+                ):
+                    if config.get("recreate_failed_workers"):
+                        self.workers.recreate_failed_workers()
+                        continue
+                    elif config.get("ignore_worker_failures"):
+                        continue
+                    raise
+                done_t = (
+                    min_t is None or (time.time() - t0) >= min_t
+                )
+                done_ts = (
+                    self._counters[NUM_ENV_STEPS_SAMPLED] - ts_before
+                    >= min_ts
+                )
+                if done_t and done_ts:
+                    break
+        t_train_end = time.time()
 
         results["info"] = {
             "learner": train_info,
@@ -207,6 +228,39 @@ class Algorithm(Trainable):
                 learn_timers[pid] = dict(t)
         if learn_timers:
             results["info"]["timers"] = learn_timers
+        # per-iteration telemetry roll-up: throughput gauges always
+        # (they're process-local and near-free), the span-derived
+        # stage times + overlap fraction only when tracing runs
+        throughput = telemetry_lib.metrics.record_iteration_throughput(
+            env_steps=float(
+                self._counters[NUM_ENV_STEPS_SAMPLED] - ts_before
+            ),
+            learn_steps=(
+                telemetry_lib.metrics.learn_steps_total()
+                - learn_before
+            ),
+            wall_s=t_train_end - t0,
+        )
+        runtime_vals = telemetry_lib.metrics.sample_runtime_gauges()
+        if tracing.is_enabled():
+            # span roll-up lags one iteration: worker-side rollout
+            # spans only reach the driver when their fragments are
+            # harvested, so sampling that overlapped iteration k is
+            # fully visible only during k+1. The previous window is
+            # complete; the current one would under-count sample_s
+            # (and report overlap 0) on the pipelined path.
+            prev = getattr(self, "_prev_iter_window", None)
+            window = prev or (t0, t_train_end)
+            rollup = telemetry_lib.iteration_rollup(
+                tracing.get_spans(), *window
+            )
+            rollup["window_iterations_ago"] = 1 if prev else 0
+            results["info"]["telemetry"] = {
+                **rollup,
+                **throughput,
+                **runtime_vals,
+            }
+        self._prev_iter_window = (t0, t_train_end)
         results.update(self._collect_rollout_metrics())
         from ray_tpu.execution.train_ops import (
             NUM_ENV_STEPS_TRAINED as _TRAINED,
@@ -306,6 +360,23 @@ class Algorithm(Trainable):
                 lw.sample()
                 episodes.extend(lw.get_metrics())
         return summarize_episodes(episodes)
+
+    def export_timeline(
+        self, path: str, last_n: Optional[int] = None
+    ) -> str:
+        """Write the chrome://tracing JSON of the run's recorded spans
+        (telemetry must be on with ``trace=True`` — or
+        ``RAY_TPU_TRACE=1`` — or the file holds whatever little was
+        recorded). ``last_n`` keeps only the last N train iterations,
+        bounded by the span buffer (``RAY_TPU_TRACE_BUFFER``). Load at
+        chrome://tracing or https://ui.perfetto.dev."""
+        from ray_tpu.util import tracing
+
+        since = None
+        marks = getattr(self, "_iteration_marks", None)
+        if last_n and marks:
+            since = marks[-min(int(last_n), len(marks))]
+        return tracing.export_chrome_trace(path, since=since)
 
     def compute_single_action(
         self, observation, state=None, policy_id=DEFAULT_POLICY_ID,

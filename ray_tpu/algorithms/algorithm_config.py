@@ -94,6 +94,12 @@ class AlgorithmConfig:
         self.min_sample_timesteps_per_iteration = 0
         self.metrics_num_episodes_for_smoothing = 100
 
+        # telemetry (docs/observability.md): empty dict = off (the
+        # default hot path sees only null-spans). Keys: metrics_port
+        # (int, 0 = ephemeral → Prometheus /metrics scrape target),
+        # trace (bool → span tracing + per-iteration overlap rollup).
+        self.telemetry_config: Dict = {}
+
         # debugging / resources
         self.log_level = "WARN"
         self.num_gpus = 0
@@ -323,6 +329,32 @@ class AlgorithmConfig:
 
     def callbacks(self, callbacks_class) -> "AlgorithmConfig":
         self.callbacks_class = callbacks_class
+        return self
+
+    def telemetry(
+        self,
+        *,
+        metrics_port: Optional[int] = None,
+        trace: Optional[bool] = None,
+        **kwargs,
+    ) -> "AlgorithmConfig":
+        """Run-telemetry activation (docs/observability.md).
+
+        ``metrics_port``: start a Prometheus ``MetricsServer`` on this
+        port at ``Algorithm.setup`` (0 = pick an ephemeral port; read
+        it back from ``algo._telemetry.metrics_port``).
+        ``trace``: enable span tracing end to end — remote submissions
+        carry trace context, every ``train()`` result gains
+        ``info/telemetry`` (stage wall-times + rollout/learn overlap
+        fraction), and ``Algorithm.export_timeline(path)`` writes the
+        chrome trace."""
+        tc = dict(self.telemetry_config)
+        if metrics_port is not None:
+            tc["metrics_port"] = int(metrics_port)
+        if trace is not None:
+            tc["trace"] = bool(trace)
+        tc.update(kwargs)
+        self.telemetry_config = tc
         return self
 
     # -- conversion ------------------------------------------------------

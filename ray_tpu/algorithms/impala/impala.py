@@ -431,6 +431,7 @@ class IMPALA(Algorithm):
                 )
             ),
             return_object_refs=bool(self._aggregators),
+            name="impala_sampler",
         )
 
     def training_step(self) -> Dict:

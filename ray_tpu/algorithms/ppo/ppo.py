@@ -297,6 +297,11 @@ class PPO(Algorithm):
         if self._sample_pipeline is None:
             self._build_sample_pipeline()
         pipe = self._sample_pipeline
+        import time as _time
+
+        from ray_tpu.util import tracing
+
+        t_wait0 = _time.time()
         while True:
             if not pipe.healthy():
                 raise pipe.error or RuntimeError(
@@ -310,6 +315,11 @@ class PPO(Algorithm):
                 break
             except queue.Empty:
                 continue
+        # how long the learner sat starved waiting on the pipeline —
+        # ~0 when the prefetch overlap is doing its job
+        tracing.record_span(
+            "learner:queue_wait", t_wait0, _time.time()
+        )
         self._counters[NUM_ENV_STEPS_SAMPLED] += env_steps
         self._counters[NUM_AGENT_STEPS_SAMPLED] += env_steps
 

@@ -25,6 +25,7 @@ from ray_tpu.env.registry import get_env_creator
 from ray_tpu.env.vector_env import VectorEnv
 from ray_tpu.evaluation.sampler import SyncSampler
 from ray_tpu.models.catalog import ModelCatalog
+from ray_tpu.util import tracing
 from ray_tpu.utils.filter import get_filter
 
 
@@ -212,11 +213,15 @@ class RolloutWorker:
         """reference rollout_worker.py:824 (+ the output-writer wiring
         of reference offline/output_writer.py: every sampled batch is
         mirrored to the configured offline store)."""
-        if self.input_reader is not None:
-            batch = self.input_reader.next()
-        else:
-            assert self.sampler is not None, "worker has no env"
-            batch = self.sampler.sample()
+        with tracing.start_span(
+            "rollout:sample", worker_index=self.worker_index
+        ) as span:
+            if self.input_reader is not None:
+                batch = self.input_reader.next()
+            else:
+                assert self.sampler is not None, "worker has no env"
+                batch = self.sampler.sample()
+            span.set_attribute("env_steps", int(batch.env_steps()))
         out = self.config.get("output")
         if out:
             if not hasattr(self, "_output_writer"):

@@ -18,6 +18,7 @@ import numpy as np
 from ray_tpu.data.sample_batch import SampleBatch, concat_samples
 from ray_tpu.evaluation.episode import EpisodeRecord
 from ray_tpu.evaluation.metrics import RolloutMetrics
+from ray_tpu.util import tracing
 
 try:
     from gymnasium import spaces
@@ -168,6 +169,15 @@ class SyncSampler:
     # -- main loop -------------------------------------------------------
 
     def sample(self) -> SampleBatch:
+        # per-rollout span: on a remote worker this parents under the
+        # "actor:RolloutWorker.sample" execution span the submitted
+        # trace context opened (core/worker_proc.py), so fragments
+        # line up against the driver's iteration in the chrome trace
+        with tracing.start_span("sampler:collect") as span:
+            result = self._sample(span)
+        return result
+
+    def _sample(self, span) -> SampleBatch:
         n = self.env.num_envs
         out: List[SampleBatch] = []
         if self.batch_mode == "truncate_episodes":
@@ -191,6 +201,8 @@ class SyncSampler:
         result = (
             concat_samples(batches) if batches else SampleBatch()
         )
+        span.set_attribute("env_steps", int(result.env_steps()))
+        span.set_attribute("fragments", len(batches))
         if self.callbacks is not None:
             self.callbacks.on_sample_end(worker=None, samples=result)
         return result
@@ -338,7 +350,10 @@ class SyncSampler:
             batch.last_state_out = [
                 np.asarray(s) for s in self.states[i]
             ]
-        batch = postprocess_batch(self.policy, batch)
+        with tracing.start_span(
+            "sampler:postprocess", env_index=i, rows=batch.count
+        ):
+            batch = postprocess_batch(self.policy, batch)
         # shrink the fragment before it leaves the worker (framestack
         # dedup — policies opt in via compress_for_shipping)
         compress = getattr(self.policy, "compress_for_shipping", None)

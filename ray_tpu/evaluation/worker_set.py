@@ -13,6 +13,7 @@ from typing import Callable, Dict, List, Optional
 
 import ray_tpu as ray
 from ray_tpu.evaluation.rollout_worker import RolloutWorker
+from ray_tpu.telemetry import metrics as telemetry_metrics
 from ray_tpu.utils.filter import MeanStdFilter
 
 
@@ -79,6 +80,13 @@ class WorkerSet:
                     num_workers=num_workers,
                 )
             )
+        self._update_fleet_gauge()
+
+    def _update_fleet_gauge(self) -> None:
+        telemetry_metrics.gauge(
+            telemetry_metrics.ROLLOUT_WORKERS,
+            "live remote rollout workers in this WorkerSet",
+        ).set(float(len(self._remote_workers)))
 
     def local_worker(self) -> Optional[RolloutWorker]:
         return self._local_worker
@@ -197,6 +205,7 @@ class WorkerSet:
         self._remote_workers = [
             w for w in self._remote_workers if id(w) not in drop
         ]
+        self._update_fleet_gauge()
 
     def replace_failed_workers(self, dead: List) -> List:
         """Remove observed-dead workers and spawn replacements; returns

@@ -17,6 +17,9 @@ from typing import Any, Optional
 
 import jax
 
+from ray_tpu.telemetry import metrics as telemetry_metrics
+from ray_tpu.util import tracing
+
 
 class DeviceFeeder:
     def __init__(self, sharding=None, capacity: int = 2):
@@ -35,10 +38,20 @@ class DeviceFeeder:
 
     def _run(self):
         while True:
+            # queue-wait vs transfer: two spans on this thread's lane,
+            # so the chrome trace shows whether the feeder was starved
+            # (waiting on the prefetcher) or busy moving bytes
+            t_wait0 = time.time()
             item = self._in.get()
+            tracing.record_span(
+                "feeder:queue_wait", t_wait0, time.time()
+            )
             if item is None:
                 return
             host_batch, meta = item
+            telemetry_metrics.set_queue_depth(
+                "feeder_in", self._in.qsize()
+            )
             try:
                 import time as _time
 
@@ -51,11 +64,12 @@ class DeviceFeeder:
                     # replicated while row columns shard over data)
                     sharding = sharding(host_batch)
                 t0 = _time.perf_counter()
-                if sharding is not None:
-                    dev = jax.device_put(host_batch, sharding)
-                else:
-                    dev = jax.device_put(host_batch)
-                jax.block_until_ready(dev)
+                with tracing.start_span("feeder:transfer"):
+                    if sharding is not None:
+                        dev = jax.device_put(host_batch, sharding)
+                    else:
+                        dev = jax.device_put(host_batch)
+                    jax.block_until_ready(dev)
                 # same series as the sync-path transfer timer in
                 # JaxPolicy.learn_on_batch, so backend A/Bs compare
                 # transfer cost regardless of which path fed the batch
@@ -104,6 +118,9 @@ class DeviceFeeder:
         """Dequeue the next ``(device_batch, meta)`` pair (blocking).
         Raises the transfer error if that batch's device_put failed."""
         out = self._out.get(timeout=timeout)
+        telemetry_metrics.set_queue_depth(
+            "feeder_out", self._out.qsize()
+        )
         if isinstance(out[0], Exception):
             raise out[0]
         return out

@@ -36,6 +36,8 @@ from typing import Dict, Optional, Tuple
 import jax
 
 from ray_tpu.data.sample_batch import SampleBatch
+from ray_tpu.telemetry import metrics as telemetry_metrics
+from ray_tpu.util import tracing
 
 # Transfers in flight ahead of the compute step. 2 = classic double
 # buffering: one batch on device waiting, one being copied.
@@ -166,6 +168,7 @@ class LearnerThread(threading.Thread):
         if not self._pipelined:
             return self._step_sync()
         t0 = time.perf_counter()
+        t_wait0 = time.time()
         # Top up the transfer pipeline; block only when nothing is in
         # flight (otherwise learn on what we have).
         if self._in_flight == 0:
@@ -183,6 +186,12 @@ class LearnerThread(threading.Thread):
             # A failed transfer still consumed an in-flight slot.
             self._in_flight -= 1
         self.queue_timer += time.perf_counter() - t0
+        tracing.record_span(
+            "learner:queue_wait", t_wait0, time.time()
+        )
+        telemetry_metrics.set_queue_depth(
+            "learner_in", self.inqueue.qsize()
+        )
         t0 = time.perf_counter()
         if self._defer:
             stats = self.policy.learn_on_device_batch(
@@ -206,8 +215,12 @@ class LearnerThread(threading.Thread):
 
     def _step_sync(self) -> None:
         t0 = time.perf_counter()
+        t_wait0 = time.time()
         batch = self.inqueue.get(timeout=0.5)
         self.queue_timer += time.perf_counter() - t0
+        tracing.record_span(
+            "learner:queue_wait", t_wait0, time.time()
+        )
         if batch is None:
             self.stopped = True
             return
@@ -226,6 +239,9 @@ class LearnerThread(threading.Thread):
         """Feed a rollout batch; returns False if dropped (queue full)."""
         try:
             self.inqueue.put(batch, block=block, timeout=5.0)
+            telemetry_metrics.set_queue_depth(
+                "learner_in", self.inqueue.qsize()
+            )
             return True
         except queue.Full:
             return False
@@ -243,6 +259,9 @@ class LearnerThread(threading.Thread):
             self.join(timeout=join_timeout)
 
     def stats(self) -> Dict:
+        telemetry_metrics.set_queue_depth(
+            "learner_out", self.outqueue.qsize()
+        )
         return {
             "learner_queue_size": self.inqueue.qsize(),
             "num_steps_trained_this_thread": self.num_steps,

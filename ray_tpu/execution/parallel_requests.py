@@ -20,6 +20,8 @@ import time
 from typing import Any, Callable, Dict, List, Optional
 
 import ray_tpu as ray
+from ray_tpu.telemetry import metrics as telemetry_metrics
+from ray_tpu.util import tracing
 
 # Actor-fatal errors: the worker is gone, its pending results with it.
 _ACTOR_DEAD_ERRORS = (
@@ -55,9 +57,14 @@ class AsyncRequestsManager:
         *,
         max_remote_requests_in_flight_per_worker: int = 2,
         return_object_refs: bool = False,
+        name: str = "default",
     ):
         self._max_in_flight = int(max_remote_requests_in_flight_per_worker)
         self._return_refs = bool(return_object_refs)
+        # telemetry tag: several managers coexist per process (sync
+        # sampler rounds, PPO prefetcher, IMPALA polling) — the name
+        # keeps their in-flight / dead-worker series apart
+        self.name = name
         self._workers: List = []
         self._in_flight: Dict = {}  # ref -> worker
         self._counts: Dict[int, int] = {}  # id(worker) -> outstanding
@@ -140,10 +147,23 @@ class AsyncRequestsManager:
         self, remote_fn: Optional[Callable] = None
     ) -> int:
         """Saturate every live worker up to the in-flight cap."""
+        t0 = time.time()
         n = 0
         for w in list(self._workers):
             while self.submit(remote_fn, worker=w):
                 n += 1
+        if n:
+            telemetry_metrics.set_requests_in_flight(
+                self.name, len(self._in_flight)
+            )
+            tracing.record_span(
+                "requests:submit",
+                t0,
+                time.time(),
+                manager=self.name,
+                submitted=n,
+                in_flight=len(self._in_flight),
+            )
         return n
 
     # -- harvest ---------------------------------------------------------
@@ -160,6 +180,7 @@ class AsyncRequestsManager:
         ``min_results`` completions, then sweeps everything else already
         ready without blocking. Dead workers are dropped and recorded;
         in value mode the harvested refs are freed."""
+        t_harvest0 = time.time()
         refs = list(self._in_flight.keys())
         if not refs:
             return {}
@@ -189,6 +210,19 @@ class AsyncRequestsManager:
                 ray.free([ref])
             out.setdefault(worker, []).append(result)
             self.num_completed += 1
+        if ready:
+            telemetry_metrics.set_requests_in_flight(
+                self.name, len(self._in_flight)
+            )
+            tracing.record_span(
+                "requests:harvest",
+                t_harvest0,
+                time.time(),
+                manager=self.name,
+                harvested=len(ready),
+                workers=len(out),
+                in_flight=len(self._in_flight),
+            )
         return out
 
     def report_dead(self, worker) -> None:
@@ -203,6 +237,12 @@ class AsyncRequestsManager:
         if id(worker) not in self._dead_ids:
             self._dead_ids.add(id(worker))
             self._dead.append(worker)
+            telemetry_metrics.inc_dead_workers(self.name)
+            tracing.event(
+                "worker:dead",
+                manager=self.name,
+                live_workers=len(self._workers),
+            )
 
     def stats(self) -> Dict[str, int]:
         return {

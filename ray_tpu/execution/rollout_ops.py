@@ -26,6 +26,7 @@ from ray_tpu.data.sample_batch import (
     concat_samples,
 )
 from ray_tpu.execution.parallel_requests import AsyncRequestsManager
+from ray_tpu.util import tracing
 
 
 def synchronous_parallel_sample(
@@ -48,38 +49,60 @@ def synchronous_parallel_sample(
     agent_or_env_steps = 0
     max_steps = max_agent_steps or max_env_steps
     all_batches = []
-    if worker_set.num_remote_workers() <= 0:
+    with tracing.start_span("sample:round") as span:
+        if worker_set.num_remote_workers() <= 0:
+            while True:
+                batches = [worker_set.local_worker().sample()]
+                agent_or_env_steps += _count_steps(
+                    batches, max_agent_steps
+                )
+                all_batches.extend(batches)
+                if (
+                    max_steps is None
+                    or agent_or_env_steps >= max_steps
+                ):
+                    break
+            span.set_attribute("steps", agent_or_env_steps)
+            return (
+                concat_samples(all_batches) if concat else all_batches
+            )
+
+        workers = worker_set.remote_workers()
+        order = {id(w): i for i, w in enumerate(workers)}
+        manager = AsyncRequestsManager(
+            workers,
+            max_remote_requests_in_flight_per_worker=1,
+            name="sync_sample",
+        )
         while True:
-            batches = [worker_set.local_worker().sample()]
-            agent_or_env_steps += _count_steps(batches, max_agent_steps)
+            manager.submit_available()
+            round_results = []  # (worker_index, batch)
+            while manager.in_flight():
+                for w, results in manager.get_ready(
+                    timeout=5.0
+                ).items():
+                    for b in results:
+                        round_results.append((order[id(w)], b))
+            if manager.take_dead_workers():
+                # preserve the seed protocol: a dead worker aborts the
+                # sample and raises, so Algorithm.step can
+                # recreate/ignore
+                raise ray.core.object_store.RayActorError(
+                    "rollout worker died during "
+                    "synchronous_parallel_sample"
+                )
+            batches = [
+                b
+                for _, b in sorted(round_results, key=lambda x: x[0])
+            ]
+            agent_or_env_steps += _count_steps(
+                batches, max_agent_steps
+            )
             all_batches.extend(batches)
             if max_steps is None or agent_or_env_steps >= max_steps:
                 break
-        return concat_samples(all_batches) if concat else all_batches
-
-    workers = worker_set.remote_workers()
-    order = {id(w): i for i, w in enumerate(workers)}
-    manager = AsyncRequestsManager(
-        workers, max_remote_requests_in_flight_per_worker=1
-    )
-    while True:
-        manager.submit_available()
-        round_results = []  # (worker_index, batch)
-        while manager.in_flight():
-            for w, results in manager.get_ready(timeout=5.0).items():
-                for b in results:
-                    round_results.append((order[id(w)], b))
-        if manager.take_dead_workers():
-            # preserve the seed protocol: a dead worker aborts the
-            # sample and raises, so Algorithm.step can recreate/ignore
-            raise ray.core.object_store.RayActorError(
-                "rollout worker died during synchronous_parallel_sample"
-            )
-        batches = [b for _, b in sorted(round_results, key=lambda x: x[0])]
-        agent_or_env_steps += _count_steps(batches, max_agent_steps)
-        all_batches.extend(batches)
-        if max_steps is None or agent_or_env_steps >= max_steps:
-            break
+        span.set_attribute("steps", agent_or_env_steps)
+        span.set_attribute("workers", len(workers))
     if concat:
         return concat_samples(all_batches)
     return all_batches
@@ -126,6 +149,7 @@ class SamplePrefetcher:
         self._manager = AsyncRequestsManager(
             worker_set.remote_workers(),
             max_remote_requests_in_flight_per_worker=max_in_flight,
+            name="sample_prefetcher",
         )
         self._target = int(target_steps)
         self._deliver = deliver
@@ -168,11 +192,19 @@ class SamplePrefetcher:
                         # batch composition stays deterministic for
                         # uniform fragments (ceil(target/frag) each)
                         # instead of depending on harvest timing
-                        batch = concat_samples(frag_buf)
+                        with tracing.start_span(
+                            "prefetch:assemble",
+                            fragments=len(frag_buf),
+                            steps=frag_steps,
+                        ):
+                            batch = concat_samples(frag_buf)
                         frag_buf, frag_steps = [], 0
                         # blocks on feeder backpressure — that bound IS
                         # the prefetch depth / staleness bound
-                        self._deliver(batch)
+                        with tracing.start_span(
+                            "prefetch:deliver"
+                        ):
+                            self._deliver(batch)
                         self.num_batches += 1
         except BaseException as e:  # surfaced via healthy()/error
             self.error = e

@@ -32,9 +32,15 @@ def train_one_step(algorithm, train_batch) -> Dict:
     """reference train_ops.py:42."""
     import time as _time
 
+    from ray_tpu.util import tracing
+
     local_worker = algorithm.workers.local_worker()
     t0 = _time.perf_counter()
-    info = local_worker.learn_on_batch(train_batch)
+    with tracing.start_span(
+        "train:learn_on_batch",
+        env_steps=int(train_batch.env_steps()),
+    ):
+        info = local_worker.learn_on_batch(train_batch)
     algorithm._timers["learn_on_batch_s"] = _time.perf_counter() - t0
     timer_histogram("ray_tpu_learner_total_seconds").observe(
         algorithm._timers["learn_on_batch_s"]

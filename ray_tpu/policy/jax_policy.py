@@ -40,6 +40,8 @@ from ray_tpu.models.catalog import ModelCatalog
 from ray_tpu.ops.framestack import FRAME_IDX as _FRAME_IDX
 from ray_tpu.ops.framestack import FRAMES as _FRAMES
 from ray_tpu.policy.policy import Policy
+from ray_tpu.telemetry import metrics as telemetry_metrics
+from ray_tpu.util import tracing
 from ray_tpu.utils.metrics import timer_histogram
 
 
@@ -784,22 +786,34 @@ class JaxPolicy(Policy):
         compiles_before = getattr(fn, "traces", 0)
         compile_s_before = getattr(fn, "compile_time_s", 0.0)
         t0 = _time.perf_counter()
-        self.params, self.opt_state, stats = fn(
-            self.params,
-            self.opt_state,
-            aux,
-            dev_batch,
-            rng,
-            self._coeff_array(),
-        )
-        self.num_grad_updates += self.num_sgd_iter * max(
-            1, batch_size // max(1, self.minibatch_size)
-        )
-        if defer_stats:
-            return stats
-        # One device→host transfer for all stats (individual float()
-        # conversions each pay a full device round trip).
-        stats = jax.device_get(stats)
+        with tracing.start_span(
+            "learn:nest", batch_size=batch_size
+        ) as _sp:
+            self.params, self.opt_state, stats = fn(
+                self.params,
+                self.opt_state,
+                aux,
+                dev_batch,
+                rng,
+                self._coeff_array(),
+            )
+            self.num_grad_updates += self.num_sgd_iter * max(
+                1, batch_size // max(1, self.minibatch_size)
+            )
+            _sp.set_attribute("deferred", bool(defer_stats))
+            _sp.set_attribute(
+                "recompiles",
+                getattr(fn, "traces", 0) - compiles_before,
+            )
+            telemetry_metrics.counter(
+                telemetry_metrics.LEARN_STEPS_TOTAL,
+                "SGD-nest programs dispatched",
+            ).inc()
+            if defer_stats:
+                return stats
+            # One device→host transfer for all stats (individual
+            # float() conversions each pay a full device round trip).
+            stats = jax.device_get(stats)
         # per-stage timers: a call that traced pays compile; the rest
         # of this call's wall time is the step (device compute + stats
         # fetch). Exposed both as metrics series (utils.metrics) and on
@@ -838,21 +852,23 @@ class JaxPolicy(Policy):
         # the frame pool is replicated, not row-sharded
         frames = batch.pop(_FRAMES, None)
         t0 = _time.perf_counter()
-        dev = _tree_to_device(batch, self._data_sharding)
-        if frames is not None:
-            dev = dict(
-                dev,
-                **{
-                    _FRAMES: jax.device_put(
-                        frames, self._param_sharding
-                    )
-                },
-            )
-        # block so the transfer timer is honest (the learn program
-        # would wait on these buffers anyway; only the sliver of host
-        # code between here and dispatch loses overlap — the async
-        # path is the DeviceFeeder, which times its own transfers)
-        jax.block_until_ready(dev)
+        with tracing.start_span("learn:transfer", batch_size=bsize):
+            dev = _tree_to_device(batch, self._data_sharding)
+            if frames is not None:
+                dev = dict(
+                    dev,
+                    **{
+                        _FRAMES: jax.device_put(
+                            frames, self._param_sharding
+                        )
+                    },
+                )
+            # block so the transfer timer is honest (the learn program
+            # would wait on these buffers anyway; only the sliver of
+            # host code between here and dispatch loses overlap — the
+            # async path is the DeviceFeeder, which times its own
+            # transfers)
+            jax.block_until_ready(dev)
         transfer_s = _time.perf_counter() - t0
         self.last_learn_timers["learn_transfer_s"] = transfer_s
         timer_histogram(

@@ -20,6 +20,8 @@ from typing import Any, Dict, Optional, Sequence, Tuple
 
 import jax
 
+from ray_tpu.util import tracing
+
 _LOCK = threading.Lock()
 # live ShardedFunctions, for process-wide stats aggregation
 _REGISTRY: "weakref.WeakSet" = weakref.WeakSet()
@@ -76,7 +78,20 @@ class ShardedFunction:
     def __call__(self, *args, **kwargs):
         before = self.traces
         t0 = time.perf_counter()
-        out = self._jitted(*args, **kwargs)
+        if tracing.is_enabled():
+            # trace-vs-cached-execute span: "did this step recompile?"
+            # shows up as a lane in the chrome trace, and a retrace
+            # after warmup additionally records a recompile event
+            with tracing.start_span("jit:" + self.label) as sp:
+                out = self._jitted(*args, **kwargs)
+                traced = self.traces != before
+                sp.set_attribute("traced", traced)
+                if traced and before > 0:
+                    tracing.event(
+                        "jit:recompile", label=self.label
+                    )
+        else:
+            out = self._jitted(*args, **kwargs)
         dt = time.perf_counter() - t0
         with self._lock:
             self.calls += 1

@@ -1,0 +1,46 @@
+"""ray_tpu.telemetry — end-to-end run observability for the training
+loop (docs/observability.md).
+
+Stitches the two existing primitives — span tracing
+(:mod:`ray_tpu.util.tracing`, the counterpart of the reference's
+``tracing_helper.py:324,449`` span propagation) and process metrics
+(:mod:`ray_tpu.utils.metrics` + the Prometheus ``MetricsServer``,
+the counterpart of ``_private/metrics_agent.py:63``) — into one layer:
+
+- :func:`init_from_config` / :func:`init` — config-driven activation
+  (``AlgorithmConfig.telemetry(metrics_port=..., trace=...)``);
+- :mod:`~ray_tpu.telemetry.metrics` — the aggregate metric catalog
+  (throughput, queue depths, in-flight requests, compile cache, jax
+  memory) the instrumented hot path feeds;
+- :func:`iteration_rollup` — per-iteration stage wall-times and the
+  rollout/learn **overlap fraction**, computed from spans and
+  reported under ``info/telemetry`` in every ``train()`` result.
+"""
+
+from ray_tpu.telemetry import metrics  # noqa: F401
+from ray_tpu.telemetry.rollup import (  # noqa: F401
+    STAGE_PREFIXES,
+    intersect,
+    iteration_rollup,
+    merge_intervals,
+)
+from ray_tpu.telemetry.runtime import (  # noqa: F401
+    TelemetryRuntime,
+    enabled,
+    init,
+    init_from_config,
+    runtime,
+)
+
+__all__ = [
+    "TelemetryRuntime",
+    "STAGE_PREFIXES",
+    "enabled",
+    "init",
+    "init_from_config",
+    "intersect",
+    "iteration_rollup",
+    "merge_intervals",
+    "metrics",
+    "runtime",
+]

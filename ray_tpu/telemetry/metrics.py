@@ -1,0 +1,193 @@
+"""Run-telemetry metric catalog: the aggregate series the training
+loop exports (docs/observability.md lists them all).
+
+Counterpart of the reference's component metric defs
+(``_private/metrics_agent.py:63`` aggregates per-component OpenCensus
+views; ``rllib``'s equivalents live scattered in learner/sampler
+stats dicts). Here every series is a process-local
+:mod:`ray_tpu.utils.metrics` instrument, scraped through the
+``MetricsServer`` the telemetry runtime starts.
+
+All accessors are get-or-create and therefore safe to call from hot
+paths without holding module state; instruments live in the global
+metric registry (``utils.metrics._REGISTRY``).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from ray_tpu.utils.metrics import (
+    Counter,
+    Gauge,
+    Histogram,
+    get_metric,
+    timer_histogram,
+)
+
+# -- metric names (one place, so docs/tests/dashboards can't drift) ----
+
+ENV_STEPS_PER_S = "ray_tpu_env_steps_per_s"
+LEARN_STEPS_PER_S = "ray_tpu_learn_steps_per_s"
+ENV_STEPS_TOTAL = "ray_tpu_env_steps_sampled_total"
+LEARN_STEPS_TOTAL = "ray_tpu_learn_steps_total"
+QUEUE_DEPTH = "ray_tpu_queue_depth"
+REQUESTS_IN_FLIGHT = "ray_tpu_requests_in_flight"
+DEAD_WORKERS_TOTAL = "ray_tpu_dead_workers_total"
+ROLLOUT_WORKERS = "ray_tpu_rollout_workers"
+COMPILE_TRACES = "ray_tpu_compile_traces_total"
+COMPILE_RECOMPILES = "ray_tpu_compile_recompiles_total"
+COMPILE_TIME_S = "ray_tpu_compile_time_seconds_total"
+JAX_LIVE_BUFFERS = "ray_tpu_jax_live_buffers"
+JAX_DEVICE_MEMORY = "ray_tpu_jax_device_memory_bytes"
+OVERLAP_FRACTION = "ray_tpu_iteration_overlap_fraction"
+ITERATION_SECONDS = "ray_tpu_iteration_seconds"
+
+
+def gauge(
+    name: str, description: str = "", tag_keys=()
+) -> Gauge:
+    """Get-or-create a Gauge (idempotent, like timer_histogram)."""
+    m = get_metric(name)
+    if isinstance(m, Gauge):
+        return m
+    return Gauge(name, description, tag_keys=tag_keys)
+
+
+def counter(
+    name: str, description: str = "", tag_keys=()
+) -> Counter:
+    m = get_metric(name)
+    if isinstance(m, Counter):
+        return m
+    return Counter(name, description, tag_keys=tag_keys)
+
+
+def histogram(name: str, description: str = "") -> Histogram:
+    return timer_histogram(name, description)
+
+
+# -- pipeline gauges (called from the execution layer) -----------------
+
+
+def set_queue_depth(queue_name: str, depth: int) -> None:
+    """Depth of one bounded pipeline queue (feeder in/out, learner
+    in/out, prefetch) — the saturation signal of docs/pipeline.md."""
+    gauge(
+        QUEUE_DEPTH,
+        "bounded pipeline queue depth",
+        ("queue",),
+    ).set(float(depth), {"queue": queue_name})
+
+
+def set_requests_in_flight(manager: str, n: int) -> None:
+    gauge(
+        REQUESTS_IN_FLIGHT,
+        "outstanding sample requests per AsyncRequestsManager",
+        ("manager",),
+    ).set(float(n), {"manager": manager})
+
+
+def inc_dead_workers(manager: str, n: int = 1) -> None:
+    counter(
+        DEAD_WORKERS_TOTAL,
+        "rollout workers observed dead",
+        ("manager",),
+    ).inc(float(n), {"manager": manager})
+
+
+def learn_steps_total() -> float:
+    """Cumulative SGD programs dispatched in this process (fed by
+    JaxPolicy.learn_on_device_batch); Algorithm.step diffs it across
+    an iteration for the learn-steps/s gauge."""
+    m = get_metric(LEARN_STEPS_TOTAL)
+    if m is None:
+        return 0.0
+    return sum(v for _, v in m.series())
+
+
+# -- per-iteration runtime sampling (called by Algorithm.step) ---------
+
+
+def sample_runtime_gauges() -> Dict[str, float]:
+    """Refresh the process-level gauges that must be polled: the
+    sharded_jit compile cache and jax's live-buffer/device-memory
+    state. Returns the sampled values (reported under
+    ``info/telemetry`` too). Cheap enough for once-per-iteration."""
+    out: Dict[str, float] = {}
+    try:
+        from ray_tpu.sharding.compile import compile_stats
+
+        cs = compile_stats()
+        gauge(
+            COMPILE_TRACES, "sharded_jit traces (process-wide)"
+        ).set(float(cs["traces"]))
+        gauge(
+            COMPILE_RECOMPILES,
+            "sharded_jit recompiles beyond first trace",
+        ).set(float(cs["recompiles"]))
+        gauge(
+            COMPILE_TIME_S, "cumulative sharded_jit compile seconds"
+        ).set(float(cs["compile_time_s"]))
+        out["compile_traces"] = float(cs["traces"])
+        out["compile_recompiles"] = float(cs["recompiles"])
+        out["compile_time_s"] = float(cs["compile_time_s"])
+    except Exception:
+        pass
+    try:
+        import jax
+
+        n_live = len(jax.live_arrays())
+        gauge(
+            JAX_LIVE_BUFFERS, "live jax arrays in this process"
+        ).set(float(n_live))
+        out["jax_live_buffers"] = float(n_live)
+        mem: Optional[dict] = None
+        try:
+            mem = jax.local_devices()[0].memory_stats()
+        except Exception:
+            mem = None
+        if mem and "bytes_in_use" in mem:
+            # per-device resident bytes (TPU/GPU backends; the CPU
+            # client reports no memory_stats — gauge simply absent)
+            g = gauge(
+                JAX_DEVICE_MEMORY,
+                "bytes in use on the learner devices",
+                ("device",),
+            )
+            total = 0.0
+            for i, d in enumerate(jax.local_devices()):
+                stats = d.memory_stats() or {}
+                b = float(stats.get("bytes_in_use", 0.0))
+                g.set(b, {"device": str(i)})
+                total += b
+            out["device_memory_bytes"] = total
+    except Exception:
+        pass
+    return out
+
+
+def record_iteration_throughput(
+    env_steps: float, learn_steps: float, wall_s: float
+) -> Dict[str, float]:
+    """Set the per-iteration throughput gauges; returns the values for
+    the ``info/telemetry`` roll-up."""
+    wall_s = max(wall_s, 1e-9)
+    env_rate = env_steps / wall_s
+    learn_rate = learn_steps / wall_s
+    gauge(
+        ENV_STEPS_PER_S, "env steps sampled per second (last iter)"
+    ).set(env_rate)
+    gauge(
+        LEARN_STEPS_PER_S, "learner SGD programs per second (last iter)"
+    ).set(learn_rate)
+    counter(ENV_STEPS_TOTAL, "env steps sampled").inc(
+        max(0.0, float(env_steps))
+    )
+    histogram(
+        ITERATION_SECONDS, "train-iteration wall seconds"
+    ).observe(wall_s)
+    return {
+        "env_steps_per_s": env_rate,
+        "learn_steps_per_s": learn_rate,
+    }

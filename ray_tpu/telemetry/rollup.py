@@ -1,0 +1,117 @@
+"""Per-iteration span roll-up: stage wall-times + overlap fraction.
+
+The spans recorded by the instrumented hot path (see
+docs/observability.md for the span map) are point measurements; this
+module turns one iteration's window of them into the summary that
+lands in ``train()`` results under ``info/telemetry``:
+
+- per-stage *busy* time (union of that stage's span intervals clamped
+  to the window — concurrent spans of one stage don't double-count);
+- the **overlap fraction**: of the time the learn nest ran, how much
+  of it sampling was also running. 1.0 = fully pipelined (the
+  ``sample_prefetch`` promise), 0.0 = strictly serial — this is the
+  number docs/pipeline.md previously said needed a profiler.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Sequence, Tuple
+
+Interval = Tuple[float, float]
+
+# span-name prefixes -> stage buckets. Worker-side spans arrive with
+# their own names via the result-message piggyback (core/api.py).
+STAGE_PREFIXES: Dict[str, Tuple[str, ...]] = {
+    "sample": ("rollout:", "sampler:", "sample:round"),
+    "assemble": ("prefetch:assemble", "prefetch:deliver"),
+    "transfer": ("feeder:transfer", "learn:transfer"),
+    "learn": ("learn:nest",),
+}
+
+# stages whose spans count as "sampling is running" for the overlap
+# computation: the worker-side rollout execution only (driver-side
+# harvest bookkeeping isn't the work we want to overlap with)
+_SAMPLING_FOR_OVERLAP = ("rollout:", "sampler:")
+
+
+def merge_intervals(
+    intervals: Iterable[Interval],
+) -> List[Interval]:
+    """Union of possibly-overlapping [start, end) intervals."""
+    ivs = sorted(
+        (s, e) for s, e in intervals if e > s
+    )
+    out: List[Interval] = []
+    for s, e in ivs:
+        if out and s <= out[-1][1]:
+            if e > out[-1][1]:
+                out[-1] = (out[-1][0], e)
+        else:
+            out.append((s, e))
+    return out
+
+
+def total(intervals: Sequence[Interval]) -> float:
+    return sum(e - s for s, e in intervals)
+
+
+def intersect(
+    a: Sequence[Interval], b: Sequence[Interval]
+) -> List[Interval]:
+    """Intersection of two MERGED interval lists."""
+    out: List[Interval] = []
+    i = j = 0
+    while i < len(a) and j < len(b):
+        s = max(a[i][0], b[j][0])
+        e = min(a[i][1], b[j][1])
+        if e > s:
+            out.append((s, e))
+        if a[i][1] <= b[j][1]:
+            i += 1
+        else:
+            j += 1
+    return out
+
+
+def _clamped(
+    spans: Iterable[dict], t0: float, t1: float, prefixes
+) -> List[Interval]:
+    out = []
+    for s in spans:
+        name = s.get("name", "")
+        if not any(name.startswith(p) for p in prefixes):
+            continue
+        start = s.get("start")
+        end = s.get("end") or start
+        if start is None or end <= t0 or start >= t1:
+            continue
+        out.append((max(start, t0), min(end, t1)))
+    return merge_intervals(out)
+
+
+def iteration_rollup(
+    spans: Iterable[dict], t0: float, t1: float
+) -> Dict[str, float]:
+    """Summarize one iteration window ``[t0, t1]`` of finished spans.
+
+    Returns ``{stage}_s`` busy times for each stage of
+    :data:`STAGE_PREFIXES`, ``iteration_s``, and
+    ``overlap_fraction`` = |learn ∩ sampling| / |learn| (0.0 when no
+    learn span landed in the window)."""
+    spans = list(spans)
+    out: Dict[str, float] = {
+        "iteration_s": max(0.0, t1 - t0)
+    }
+    merged: Dict[str, List[Interval]] = {}
+    for stage, prefixes in STAGE_PREFIXES.items():
+        merged[stage] = _clamped(spans, t0, t1, prefixes)
+        out[f"{stage}_s"] = total(merged[stage])
+    sampling = _clamped(spans, t0, t1, _SAMPLING_FOR_OVERLAP)
+    learn = merged["learn"]
+    learn_total = total(learn)
+    out["overlap_fraction"] = (
+        total(intersect(learn, sampling)) / learn_total
+        if learn_total > 0
+        else 0.0
+    )
+    return out

@@ -1,0 +1,127 @@
+"""Config-driven telemetry activation.
+
+``AlgorithmConfig.telemetry(metrics_port=..., trace=...)`` lands in
+``config["telemetry_config"]``; :func:`init_from_config` (called from
+``Algorithm.setup``) turns it into a live runtime: a
+:class:`~ray_tpu.utils.metrics_exporter.MetricsServer` scrape target
+and/or span tracing via :mod:`ray_tpu.util.tracing`. The counterpart
+of the reference's ``RAY_TRACING_ENABLED`` + per-node metrics agent
+autostart (``_private/metrics_agent.py:63``).
+
+One runtime per process: a second Algorithm in the same process
+reuses the running server (ports are process-wide); tracing enable is
+idempotent. ``RAY_TPU_TRACE=1`` remains the env-var override that
+needs no config at all.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Optional
+
+_LOCK = threading.Lock()
+_RUNTIME: Optional["TelemetryRuntime"] = None
+
+
+class TelemetryRuntime:
+    """Live telemetry state for this process."""
+
+    def __init__(
+        self,
+        *,
+        metrics_port: Optional[int] = None,
+        trace: bool = False,
+        metrics_host: str = "127.0.0.1",
+    ):
+        self.trace = bool(trace)
+        self.metrics_server = None
+        self.metrics_port: Optional[int] = None
+        if metrics_port is not None:
+            from ray_tpu.utils.metrics_exporter import MetricsServer
+
+            self.metrics_server = MetricsServer(
+                host=metrics_host, port=int(metrics_port)
+            )
+            self.metrics_port = self.metrics_server.port
+        if self.trace:
+            from ray_tpu.util import tracing
+
+            tracing.enable()
+
+    def shutdown(self) -> None:
+        global _RUNTIME
+        if self.metrics_server is not None:
+            self.metrics_server.shutdown()
+            self.metrics_server = None
+        if self.trace:
+            from ray_tpu.util import tracing
+
+            tracing.disable()
+        with _LOCK:
+            if _RUNTIME is self:
+                _RUNTIME = None
+
+
+def runtime() -> Optional[TelemetryRuntime]:
+    """The process's active runtime (None when telemetry is off)."""
+    return _RUNTIME
+
+
+def enabled() -> bool:
+    return _RUNTIME is not None
+
+
+def init(
+    *,
+    metrics_port: Optional[int] = None,
+    trace: bool = False,
+    metrics_host: str = "127.0.0.1",
+) -> TelemetryRuntime:
+    """Start (or return the already-running) telemetry runtime."""
+    global _RUNTIME
+    with _LOCK:
+        if _RUNTIME is not None:
+            # upgrade in place: a later config may add tracing or a
+            # scrape port the first runtime didn't ask for (and a
+            # tracing.disable() elsewhere must not leave a trace=True
+            # runtime silently dark — re-enable unconditionally)
+            if trace:
+                from ray_tpu.util import tracing
+
+                tracing.enable()
+                _RUNTIME.trace = True
+            if (
+                metrics_port is not None
+                and _RUNTIME.metrics_server is None
+            ):
+                from ray_tpu.utils.metrics_exporter import (
+                    MetricsServer,
+                )
+
+                _RUNTIME.metrics_server = MetricsServer(
+                    host=metrics_host, port=int(metrics_port)
+                )
+                _RUNTIME.metrics_port = (
+                    _RUNTIME.metrics_server.port
+                )
+            return _RUNTIME
+        _RUNTIME = TelemetryRuntime(
+            metrics_port=metrics_port,
+            trace=trace,
+            metrics_host=metrics_host,
+        )
+        return _RUNTIME
+
+
+def init_from_config(
+    config: Dict[str, Any],
+) -> Optional[TelemetryRuntime]:
+    """Activate telemetry when ``config["telemetry_config"]`` asks for
+    it. Returns the runtime, or None when the config leaves telemetry
+    off (the default — zero threads, zero spans, null-span hot path)."""
+    tc = (config or {}).get("telemetry_config") or {}
+    metrics_port = tc.get("metrics_port")
+    trace = bool(tc.get("trace", False))
+    if metrics_port is None and not trace:
+        return None
+    return init(metrics_port=metrics_port, trace=trace)

@@ -78,6 +78,8 @@ class Span:
         "end",
         "attributes",
         "process",
+        "thread",
+        "thread_name",
     )
 
     def __init__(self, name: str, trace_id=None, parent_id=None):
@@ -89,12 +91,17 @@ class Span:
         self.end: Optional[float] = None
         self.attributes: Dict[str, Any] = {}
         self.process = os.getpid()
+        # thread identity so prefetcher/feeder/learner threads render
+        # as separate chrome-trace lanes instead of one flat tid 0
+        t = threading.current_thread()
+        self.thread = t.ident or 0
+        self.thread_name = t.name
 
     def set_attribute(self, key: str, value: Any) -> None:
         self.attributes[key] = value
 
-    def finish(self) -> Dict:
-        self.end = time.time()
+    def finish(self, end: Optional[float] = None) -> Dict:
+        self.end = time.time() if end is None else end
         record = {
             "trace_id": self.trace_id,
             "span_id": self.span_id,
@@ -104,15 +111,37 @@ class Span:
             "end": self.end,
             "attributes": dict(self.attributes),
             "pid": self.process,
+            "tid": self.thread,
+            "thread_name": self.thread_name,
         }
         if _enabled:  # disabled tracing records nothing
             _append_bounded([record])
         return record
 
 
+class _NullSpan:
+    """Returned by start_span when tracing is off: every operation is a
+    no-op, so the disabled hot path costs one flag check (no uuid, no
+    clock reads, no allocation)."""
+
+    __slots__ = ()
+    trace_id = None
+    span_id = None
+    parent_id = None
+
+    def set_attribute(self, key: str, value: Any) -> None:
+        pass
+
+
+_NULL_SPAN = _NullSpan()
+
+
 @contextlib.contextmanager
 def start_span(name: str, **attributes):
     """Open a span under the current one (driver or worker side)."""
+    if not _enabled:
+        yield _NULL_SPAN
+        return
     parent = _current.get()
     span = Span(
         name,
@@ -127,6 +156,40 @@ def start_span(name: str, **attributes):
     finally:
         _current.reset(token)
         span.finish()
+
+
+def event(name: str, **attributes) -> None:
+    """Record a zero-duration span (dead worker, recompile, ...)
+    parented under the current span. No-op when tracing is off."""
+    if not _enabled:
+        return
+    parent = _current.get()
+    span = Span(
+        name,
+        trace_id=parent.trace_id if parent else None,
+        parent_id=parent.span_id if parent else None,
+    )
+    span.attributes.update(attributes)
+    span.finish(end=span.start)
+
+
+def record_span(
+    name: str, start: float, end: float, **attributes
+) -> None:
+    """Record a span whose interval was measured out-of-band (e.g. a
+    queue wait that ended when ``get()`` returned). ``start``/``end``
+    are ``time.time()`` stamps. No-op when tracing is off."""
+    if not _enabled:
+        return
+    parent = _current.get()
+    span = Span(
+        name,
+        trace_id=parent.trace_id if parent else None,
+        parent_id=parent.span_id if parent else None,
+    )
+    span.start = start
+    span.attributes.update(attributes)
+    span.finish(end=end)
 
 
 def get_current_span() -> Optional[Span]:
@@ -202,11 +265,21 @@ def clear() -> None:
         _finished.clear()
 
 
-def export_chrome_trace(path: str) -> str:
+def export_chrome_trace(
+    path: str, since: Optional[float] = None
+) -> str:
     """chrome://tracing JSON (the reference's ray.timeline format,
-    _private/state.py:435, with span parent/trace ids attached)."""
+    _private/state.py:435, with span parent/trace ids attached).
+    ``since`` keeps only spans that END at or after that
+    ``time.time()`` stamp (Algorithm.export_timeline's last-N-iteration
+    window). Each (pid, tid) lane carries a thread_name metadata event
+    so prefetcher/feeder/learner threads are labeled in the viewer."""
     with _lock:
         spans = list(_finished)
+    if since is not None:
+        spans = [
+            s for s in spans if (s["end"] or s["start"]) >= since
+        ]
     events = [
         {
             "name": s["name"],
@@ -215,7 +288,7 @@ def export_chrome_trace(path: str) -> str:
             "ts": s["start"] * 1e6,
             "dur": ((s["end"] or s["start"]) - s["start"]) * 1e6,
             "pid": s["pid"],
-            "tid": 0,
+            "tid": s.get("tid", 0),
             "args": {
                 "trace_id": s["trace_id"],
                 "span_id": s["span_id"],
@@ -225,6 +298,22 @@ def export_chrome_trace(path: str) -> str:
         }
         for s in spans
     ]
+    lanes = {}
+    for s in spans:
+        lanes.setdefault(
+            (s["pid"], s.get("tid", 0)), s.get("thread_name")
+        )
+    for (pid, tid), tname in sorted(lanes.items()):
+        if tname:
+            events.append(
+                {
+                    "name": "thread_name",
+                    "ph": "M",
+                    "pid": pid,
+                    "tid": tid,
+                    "args": {"name": tname},
+                }
+            )
     with open(path, "w") as f:
         json.dump({"traceEvents": events}, f)
     return path

@@ -9,6 +9,7 @@ Tag-based metric series are supported via tag dicts."""
 
 from __future__ import annotations
 
+import bisect
 import threading
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -91,8 +92,6 @@ class Histogram(Metric):
             counts = self._buckets.setdefault(
                 k, [0.0] * (len(self.boundaries) + 1)
             )
-            import bisect
-
             counts[bisect.bisect_left(self.boundaries, value)] += 1
             self._sums[k] = self._sums.get(k, 0.0) + float(value)
             self._counts[k] = self._counts.get(k, 0) + 1

@@ -57,15 +57,21 @@ def format_prometheus() -> str:
                     f"{name}_bucket{_fmt_tags(sorted(t.items()))}"
                     f" {total}"
                 )
+                # sorted like the _bucket lines above: series keys must
+                # be byte-stable across scrapes or Prometheus sees a
+                # new series every time tag insertion order shifts
                 lines.append(
-                    f"{name}_sum{_fmt_tags(tags)} {data['sum']}"
+                    f"{name}_sum{_fmt_tags(sorted(tags))} {data['sum']}"
                 )
                 lines.append(
-                    f"{name}_count{_fmt_tags(tags)} {data['count']}"
+                    f"{name}_count{_fmt_tags(sorted(tags))}"
+                    f" {data['count']}"
                 )
         else:
             for tags, value in m.series():
-                lines.append(f"{name}{_fmt_tags(tags)} {value}")
+                lines.append(
+                    f"{name}{_fmt_tags(sorted(tags))} {value}"
+                )
     return "\n".join(lines) + "\n"
 
 

@@ -81,4 +81,6 @@ def test_chrome_trace_export(tmp_path):
     names = {e["name"] for e in events}
     assert {"phase", "task:work"} <= names
     for e in events:
+        if e["ph"] == "M":  # thread_name lane metadata
+            continue
         assert e["ph"] == "X" and "trace_id" in e["args"]
