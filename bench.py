@@ -35,6 +35,9 @@ Flags:  --profile       run ONE telemetry-instrumented PPO iteration
         --xprof DIR     capture a jax.profiler trace of the timed rounds
         --e2e           run the five BASELINE.md end-to-end configs
                         (rollout+learner; see bench_e2e.py) instead
+        --chaos         fault-injection A/B (docs/resilience.md):
+                        steady-state vs worker-kill + NaN-batch run,
+                        writes benchmarks/e2e/chaos_recovery.json
 """
 
 import json
@@ -654,6 +657,118 @@ def bench_profile(trace_path=None, overhead_path=None):
     return report
 
 
+def bench_chaos(out_path=None, iters=6):
+    """Chaos A/B (docs/resilience.md): steady-state PPO iteration time
+    vs the same run with a rollout-worker kill and one NaN learn batch
+    injected mid-run. Measures what a failure actually costs — the
+    recovery time (probe + recreate + resync) and its
+    iterations-lost equivalent — and proves the run completes with the
+    fleet restored. Writes benchmarks/e2e/chaos_recovery.json."""
+    import os
+
+    import ray_tpu.env.synthetic_env  # noqa: F401 registers SyntheticFast-v0
+    from ray_tpu.algorithms.ppo import PPOConfig
+    from ray_tpu.telemetry import metrics as telemetry_metrics
+
+    os.makedirs("benchmarks/e2e", exist_ok=True)
+    out_path = out_path or "benchmarks/e2e/chaos_recovery.json"
+
+    def build(fault_injection):
+        return (
+            PPOConfig()
+            .environment("SyntheticFast-v0")
+            .rollouts(
+                num_rollout_workers=4,
+                num_envs_per_worker=4,
+                rollout_fragment_length=64,
+            )
+            .training(
+                train_batch_size=1024,
+                sgd_minibatch_size=256,
+                num_sgd_iter=2,
+                lr=3e-4,
+                model={"fcnet_hiddens": [32, 32]},
+            )
+            .fault_tolerance(
+                recreate_failed_workers=True,
+                nan_guard=True,
+                worker_health_probe_timeout_s=10.0,
+                fault_injection=fault_injection,
+            )
+            .debugging(seed=0)
+            .build()
+        )
+
+    def timed_run(algo, n):
+        times, last = [], {}
+        for _ in range(n):
+            t0 = time.perf_counter()
+            last = algo.train()
+            times.append(time.perf_counter() - t0)
+        return times, last
+
+    # A: steady state (injector inert, same guard/recreate config)
+    algo = build({})
+    try:
+        timed_run(algo, 1)  # compile + fleet spin-up
+        steady_times, _ = timed_run(algo, iters)
+    finally:
+        algo.cleanup()
+    steady_median = float(np.median(steady_times))
+
+    # B: kill one worker on its 2nd sample call, poison one learn batch
+    restarts0 = telemetry_metrics.counter_total(
+        telemetry_metrics.WORKER_RESTARTS_TOTAL
+    )
+    skipped0 = telemetry_metrics.counter_total(
+        telemetry_metrics.SKIPPED_BATCHES_TOTAL
+    )
+    algo = build(
+        {
+            "kill_worker": [{"worker_index": 2, "on_call": 2}],
+            "nan_batch": {"on_learn_call": 3},
+        }
+    )
+    try:
+        timed_run(algo, 1)
+        chaos_times, last = timed_run(algo, iters)
+        fleet_after = algo.workers.num_remote_workers()
+        recovery = last["info"]["recovery"]
+    finally:
+        algo.cleanup()
+
+    lost_s = max(0.0, sum(chaos_times) - iters * steady_median)
+    report = {
+        "metric": "chaos_recovery",
+        "steady_state_s_per_iter_median": round(steady_median, 4),
+        "chaos_iter_times_s": [round(t, 4) for t in chaos_times],
+        "recovery_time_s": round(recovery["time_lost_s"], 4),
+        "excess_wall_clock_s": round(lost_s, 4),
+        "iterations_lost_equiv": round(lost_s / steady_median, 2)
+        if steady_median
+        else None,
+        "worker_restarts": telemetry_metrics.counter_total(
+            telemetry_metrics.WORKER_RESTARTS_TOTAL
+        )
+        - restarts0,
+        "skipped_nan_batches": telemetry_metrics.counter_total(
+            telemetry_metrics.SKIPPED_BATCHES_TOTAL
+        )
+        - skipped0,
+        "fleet_restored_to": fleet_after,
+        "config": {
+            "num_rollout_workers": 4,
+            "train_batch_size": 1024,
+            "faults": "kill worker 2 @ sample call 2; "
+            "NaN batch @ learn call 3",
+        },
+    }
+    with open(out_path, "w") as f:
+        json.dump(report, f, indent=1)
+    print(json.dumps(report))
+    return report
+
+
 def main():
     if "--e2e" in sys.argv:
         from bench_e2e import main as e2e_main
@@ -665,6 +780,9 @@ def main():
         return
     if "--profile" in sys.argv:
         bench_profile()
+        return
+    if "--chaos" in sys.argv:
+        bench_chaos()
         return
     profile_dir = None
     if "--xprof" in sys.argv:

@@ -69,6 +69,15 @@ class Algorithm(Trainable):
         self._iteration_marks: collections.deque = collections.deque(
             maxlen=1024
         )
+        # resilience layer (docs/resilience.md): the driver-side chaos
+        # injector (None when inert) and the recovery manager step()
+        # consults on failure — always present, inert until the config
+        # arms it via AlgorithmConfig.fault_tolerance(...)
+        from ray_tpu.resilience import faults as faults_lib
+        from ray_tpu.resilience.recovery import RecoveryManager
+
+        self._fault_injector = faults_lib.from_config(config)
+        self._recovery = RecoveryManager(self)
 
         env_spec = config.get("env")
         env_creator = get_env_creator(env_spec) if env_spec else None
@@ -182,6 +191,7 @@ class Algorithm(Trainable):
         min_t = config.get("min_time_s_per_iteration")
         min_ts = config.get("min_sample_timesteps_per_iteration") or 0
         ts_before = self._counters[NUM_ENV_STEPS_SAMPLED]
+        self._recovery.begin_iteration()
         # the iteration span is the driver-side root every remote
         # submission in this iteration parents under
         with tracing.start_span(
@@ -192,16 +202,16 @@ class Algorithm(Trainable):
                     info = self.training_step()
                     if info:
                         train_info = info
-                except (
-                    ray.core.object_store.RayActorError,
-                    ray.core.object_store.WorkerCrashedError,
-                ):
-                    if config.get("recreate_failed_workers"):
-                        self.workers.recreate_failed_workers()
-                        continue
-                    elif config.get("ignore_worker_failures"):
-                        continue
-                    raise
+                except Exception as e:
+                    # resilience protocol (docs/resilience.md): worker
+                    # death → bounded probe + recreate + degraded
+                    # continue (per the recreate/ignore flags);
+                    # restartable driver failure → restore the latest
+                    # periodic checkpoint; anything unhandled — or
+                    # beyond the max_failures budget — propagates
+                    if not self._recovery.handle_failure(e):
+                        raise
+                    continue
                 done_t = (
                     min_t is None or (time.time() - t0) >= min_t
                 )
@@ -211,6 +221,10 @@ class Algorithm(Trainable):
                 )
                 if done_t and done_ts:
                     break
+            # periodic checkpoint cadence (inside the iteration span,
+            # so its recovery:checkpoint span lands in this
+            # iteration's telemetry window)
+            self._recovery.maybe_checkpoint()
         t_train_end = time.time()
 
         results["info"] = {
@@ -228,12 +242,21 @@ class Algorithm(Trainable):
                 learn_timers[pid] = dict(t)
         if learn_timers:
             results["info"]["timers"] = learn_timers
+        # resilience roll-up: restart/recovery/skip counts + time lost
+        # to recovery this iteration (span-derived recovery_s appears
+        # in info/telemetry too when tracing runs)
+        results["info"]["recovery"] = self._recovery.stats()
         # per-iteration telemetry roll-up: throughput gauges always
         # (they're process-local and near-free), the span-derived
         # stage times + overlap fraction only when tracing runs
         throughput = telemetry_lib.metrics.record_iteration_throughput(
+            # max(0): a mid-iteration checkpoint restore can rewind
+            # the sampled-steps counter below its iteration-start value
             env_steps=float(
-                self._counters[NUM_ENV_STEPS_SAMPLED] - ts_before
+                max(
+                    0,
+                    self._counters[NUM_ENV_STEPS_SAMPLED] - ts_before,
+                )
             ),
             learn_steps=(
                 telemetry_lib.metrics.learn_steps_total()
@@ -296,6 +319,12 @@ class Algorithm(Trainable):
                 algorithm=self, result=results
             )
         return results
+
+    def on_recovery(self, kind: str) -> None:
+        """Hook: the RecoveryManager just absorbed a failure of
+        ``kind`` (``"workers"`` or ``"restore"``). Subclasses rebuild
+        whatever driver-side machinery the failure invalidated (PPO:
+        the sample pipeline; IMPALA: the learner thread)."""
 
     def _collect_rollout_metrics(self) -> Dict:
         episodes = []
@@ -492,7 +521,54 @@ class Algorithm(Trainable):
             os.path.join(checkpoint_dir, "rllib_checkpoint.json"),
             lambda f: f.write(json.dumps(meta).encode()),
         )
+        # fsync the DIRECTORY: the per-file fsync+replace above makes
+        # each file's content durable, but the renames themselves live
+        # in the directory inode — without this a host crash can leave
+        # a directory whose entries still point at the old (or no)
+        # files even though the data blocks hit disk
+        self._fsync_dir(checkpoint_dir)
+        self._prune_old_checkpoints(checkpoint_dir)
         return checkpoint_dir
+
+    @staticmethod
+    def _fsync_dir(path: str) -> None:
+        try:
+            fd = os.open(path, os.O_RDONLY)
+        except OSError:
+            return  # platform without directory fds: best effort
+        try:
+            os.fsync(fd)
+        finally:
+            os.close(fd)
+
+    def _prune_old_checkpoints(self, checkpoint_dir: str) -> None:
+        """Prune sibling ``checkpoint_*`` directories down to the
+        newest ``keep_checkpoints_num`` (the reference knob). The one
+        just written always survives; None/0 keeps everything."""
+        keep = self.config.get("keep_checkpoints_num")
+        if not keep or keep < 1:
+            return
+        import shutil
+
+        current = os.path.abspath(checkpoint_dir)
+        parent = os.path.dirname(current)
+        try:
+            siblings = sorted(
+                os.path.join(parent, d)
+                for d in os.listdir(parent)
+                if d.startswith("checkpoint_")
+                and os.path.isdir(os.path.join(parent, d))
+            )
+        except OSError:
+            return
+        # zero-padded names sort chronologically; newest last
+        victims = [d for d in siblings if d != current][
+            : max(0, len(siblings) - int(keep))
+        ]
+        for d in victims:
+            shutil.rmtree(d, ignore_errors=True)
+        if victims:
+            self._fsync_dir(parent)
 
     @classmethod
     def from_checkpoint(cls, checkpoint_path: str) -> "Algorithm":

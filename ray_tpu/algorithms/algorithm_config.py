@@ -48,6 +48,38 @@ class AlgorithmConfig:
         # paths (reference max_requests_in_flight_per_rollout_worker).
         self.max_requests_in_flight_per_rollout_worker = 2
 
+        # fault tolerance (docs/resilience.md)
+        # recovery-action budget for Algorithm.train(): worker
+        # recreations + checkpoint restores. < 0 = unlimited (the
+        # pre-existing semantics of the two rollout flags above).
+        self.max_failures = -1
+        # every N iterations, save into checkpoint_root (default
+        # <logdir>/resilience) and keep it as the auto-restore target;
+        # 0 = off
+        self.checkpoint_frequency = 0
+        self.checkpoint_root = None
+        # prune periodic checkpoints down to the newest N (None = keep
+        # everything)
+        self.keep_checkpoints_num = None
+        # restartable driver-side failure (learner crash, anything
+        # non-actor-death) → restore the latest checkpoint + continue
+        self.restore_on_failure = False
+        # skip non-finite learn batches instead of corrupting params
+        self.nan_guard = False
+        # single wall-clock budget for the parallel health sweep
+        self.worker_health_probe_timeout_s = 10.0
+        # the uniform RetryPolicy (resilience/retry.py) every
+        # driver-side remote interaction draws from
+        self.retry_max_attempts = 3
+        self.retry_timeout_s = 60.0
+        self.retry_backoff_s = 0.05
+        self.retry_backoff_mult = 2.0
+        self.retry_max_backoff_s = 2.0
+        self.retry_jitter = 0.1
+        # deterministic chaos spec (resilience/faults.py); {} = inert,
+        # None additionally allows the RAY_TPU_FAULTS env fallback
+        self.fault_injection: Optional[Dict] = None
+
         # training (reference :717)
         self.gamma = 0.99
         self.lr = 0.001
@@ -329,6 +361,78 @@ class AlgorithmConfig:
 
     def callbacks(self, callbacks_class) -> "AlgorithmConfig":
         self.callbacks_class = callbacks_class
+        return self
+
+    def fault_tolerance(
+        self,
+        *,
+        ignore_worker_failures: Optional[bool] = None,
+        recreate_failed_workers: Optional[bool] = None,
+        max_failures: Optional[int] = None,
+        checkpoint_frequency: Optional[int] = None,
+        checkpoint_root: Optional[str] = None,
+        keep_checkpoints_num: Optional[int] = None,
+        restore_on_failure: Optional[bool] = None,
+        nan_guard: Optional[bool] = None,
+        worker_health_probe_timeout_s: Optional[float] = None,
+        retry_max_attempts: Optional[int] = None,
+        retry_timeout_s: Optional[float] = None,
+        retry_backoff_s: Optional[float] = None,
+        retry_backoff_mult: Optional[float] = None,
+        retry_max_backoff_s: Optional[float] = None,
+        retry_jitter: Optional[float] = None,
+        fault_injection: Optional[Dict] = None,
+        **kwargs,
+    ) -> "AlgorithmConfig":
+        """Fault-tolerance knobs (docs/resilience.md).
+
+        ``recreate_failed_workers``: on an observed rollout-worker
+        death, probe the fleet (bounded by
+        ``worker_health_probe_timeout_s``), spawn weight-synced
+        replacements, and continue in degraded mode meanwhile.
+        ``checkpoint_frequency`` + ``restore_on_failure``: periodic
+        checkpoints become the auto-restore target for restartable
+        driver-side failures; prune to ``keep_checkpoints_num``.
+        ``nan_guard``: skip non-finite learn batches instead of
+        corrupting params. ``max_failures`` caps total recovery
+        actions (< 0 = unlimited). ``retry_*``: the uniform
+        RetryPolicy behind every driver-side remote interaction.
+        ``fault_injection``: deterministic chaos spec for tests and
+        ``bench.py --chaos`` (resilience/faults.py)."""
+        if ignore_worker_failures is not None:
+            self.ignore_worker_failures = ignore_worker_failures
+        if recreate_failed_workers is not None:
+            self.recreate_failed_workers = recreate_failed_workers
+        if max_failures is not None:
+            self.max_failures = int(max_failures)
+        if checkpoint_frequency is not None:
+            self.checkpoint_frequency = int(checkpoint_frequency)
+        if checkpoint_root is not None:
+            self.checkpoint_root = checkpoint_root
+        if keep_checkpoints_num is not None:
+            self.keep_checkpoints_num = int(keep_checkpoints_num)
+        if restore_on_failure is not None:
+            self.restore_on_failure = bool(restore_on_failure)
+        if nan_guard is not None:
+            self.nan_guard = bool(nan_guard)
+        if worker_health_probe_timeout_s is not None:
+            self.worker_health_probe_timeout_s = float(
+                worker_health_probe_timeout_s
+            )
+        if retry_max_attempts is not None:
+            self.retry_max_attempts = int(retry_max_attempts)
+        if retry_timeout_s is not None:
+            self.retry_timeout_s = retry_timeout_s
+        if retry_backoff_s is not None:
+            self.retry_backoff_s = float(retry_backoff_s)
+        if retry_backoff_mult is not None:
+            self.retry_backoff_mult = float(retry_backoff_mult)
+        if retry_max_backoff_s is not None:
+            self.retry_max_backoff_s = float(retry_max_backoff_s)
+        if retry_jitter is not None:
+            self.retry_jitter = float(retry_jitter)
+        if fault_injection is not None:
+            self.fault_injection = fault_injection
         return self
 
     def telemetry(

@@ -434,12 +434,35 @@ class IMPALA(Algorithm):
             name="impala_sampler",
         )
 
+    def on_recovery(self, kind: str) -> None:
+        """After a checkpoint restore the old learner thread is dead
+        (that is usually WHY the restore ran): rebuild it around the
+        restored policy so the actor-learner loop can continue."""
+        super().on_recovery(kind)
+        if kind != "restore":
+            return
+        lt = getattr(self, "_learner_thread", None)
+        if lt is not None and lt.is_alive():
+            lt.stop()
+        self._learner_thread = LearnerThread(
+            self.get_policy(),
+            inqueue_size=self.config.get("learner_queue_size", 16),
+            publish_weights_every=max(
+                1, int(self.config.get("broadcast_interval", 1))
+            ),
+        )
+        self._learner_thread.start()
+
     def training_step(self) -> Dict:
         """reference impala.py:614."""
         workers = self.workers.remote_workers()
         lt = self._learner_thread
         if not lt.is_alive():
-            raise RuntimeError("learner thread died")
+            # surface the thread's parked exception (an injected crash
+            # or a real learner bug) — with restore_on_failure set,
+            # Algorithm.step's recovery path restores the latest
+            # checkpoint and on_recovery rebuilds the thread
+            raise lt.error or RuntimeError("learner thread died")
 
         if not workers:
             # degenerate synchronous mode (num_workers=0, tests):

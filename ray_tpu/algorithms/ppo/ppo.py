@@ -273,6 +273,19 @@ class PPO(Algorithm):
             # standardize + host-tree assembly here, device transfer on
             # the feeder thread, learn on the driver thread
             _standardize_advantages(batch)
+            # resilience choke point for the pipelined path, mirroring
+            # train_one_step's: chaos injection counts learn batches
+            # here, and the nan guard skips a poisoned batch BEFORE it
+            # crosses to the device (docs/resilience.md)
+            if self._fault_injector is not None:
+                self._fault_injector.on_learn(batch)
+            if self.config.get("nan_guard"):
+                from ray_tpu.resilience.recovery import batch_is_finite
+
+                if not batch_is_finite(batch):
+                    self._counters["num_nan_batches_skipped"] += 1
+                    self._recovery.note_skipped_batch()
+                    return
             tree, bsize = policy.prepare_batch(batch)
             feeder.put(tree, (bsize, batch.env_steps(), batch.count))
 
@@ -361,12 +374,21 @@ class PPO(Algorithm):
                 "pipeline"
             )
 
-    def cleanup(self) -> None:
+    def on_recovery(self, kind: str) -> None:
+        """A checkpoint restore invalidates the prefetch pipeline (its
+        thread may be dead — an injected crash in ``deliver`` is how
+        the restore got triggered — and its queued batches belong to
+        the pre-restore policy): tear it down; the next
+        ``training_step`` rebuilds it lazily."""
+        super().on_recovery(kind)
+        if kind != "restore":
+            return
+        self._teardown_pipeline()
+
+    def _teardown_pipeline(self) -> None:
         pipe = getattr(self, "_sample_pipeline", None)
         feeder = getattr(self, "_prefetch_feeder", None)
         if pipe is not None:
-            # flag first: a deliver blocked on feeder backpressure only
-            # wakes when the feeder stops (its put raises)
             pipe.request_stop()
         if feeder is not None:
             feeder.stop()
@@ -374,4 +396,11 @@ class PPO(Algorithm):
         if pipe is not None:
             pipe.stop()
             self._sample_pipeline = None
+
+    def cleanup(self) -> None:
+        # flag-first ordering lives in _teardown_pipeline: a deliver
+        # blocked on feeder backpressure only wakes when the feeder
+        # stops (its put raises), and the raise must find the stop
+        # flag set
+        self._teardown_pipeline()
         super().cleanup()

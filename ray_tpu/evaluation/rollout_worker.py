@@ -46,6 +46,13 @@ class RolloutWorker:
         self.worker_index = worker_index
         self.num_workers = num_workers
         self.global_vars: Dict[str, Any] = {"timestep": 0}
+        # chaos harness (docs/resilience.md): None unless the config /
+        # RAY_TPU_FAULTS arms faults for this process — zero cost when
+        # inert
+        from ray_tpu.resilience import faults as faults_lib
+
+        self._fault_injector = faults_lib.from_config(self.config)
+        self._num_sample_calls = 0
 
         env_config = EnvContext(
             self.config.get("env_config") or {},
@@ -213,6 +220,14 @@ class RolloutWorker:
         """reference rollout_worker.py:824 (+ the output-writer wiring
         of reference offline/output_writer.py: every sampled batch is
         mirrored to the configured offline store)."""
+        self._num_sample_calls += 1
+        if self._fault_injector is not None:
+            # deterministic chaos: may delay this call, or hard-exit
+            # the process (exactly like a preemption — no exception,
+            # no cleanup, the driver sees an actor-death error)
+            self._fault_injector.on_sample(
+                self.worker_index, self._num_sample_calls
+            )
         with tracing.start_span(
             "rollout:sample", worker_index=self.worker_index
         ) as span:

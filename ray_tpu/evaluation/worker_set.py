@@ -13,8 +13,14 @@ from typing import Callable, Dict, List, Optional
 
 import ray_tpu as ray
 from ray_tpu.evaluation.rollout_worker import RolloutWorker
+from ray_tpu.resilience.retry import RetryPolicy, probe_actors
 from ray_tpu.telemetry import metrics as telemetry_metrics
 from ray_tpu.utils.filter import MeanStdFilter
+
+_ACTOR_DEAD_ERRORS = (
+    ray.core.object_store.RayActorError,
+    ray.core.object_store.WorkerCrashedError,
+)
 
 
 class WorkerSet:
@@ -35,6 +41,9 @@ class WorkerSet:
         self._policy_mapping_fn = policy_mapping_fn
         self._config = config
         self._remote_workers: List = []
+        # the uniform retry/timeout/backoff schedule every driver-side
+        # remote interaction below draws from (docs/resilience.md)
+        self._retry = RetryPolicy.from_config(config)
 
         self._local_worker = None
         if local_worker:
@@ -50,8 +59,13 @@ class WorkerSet:
         if num_workers > 0:
             self.add_workers(num_workers)
 
-    def add_workers(self, num_workers: int) -> None:
-        """reference worker_set.py:234."""
+    def add_workers(
+        self, num_workers: int, *, config_overrides: Optional[Dict] = None
+    ) -> None:
+        """reference worker_set.py:234. ``config_overrides`` lets the
+        recovery path hand replacements a modified config (e.g. an
+        empty ``fault_injection`` spec so a recreated worker doesn't
+        re-run its predecessor's death sentence)."""
         if not ray.is_initialized():
             ray.init()
         RemoteWorker = ray.remote(RolloutWorker)
@@ -60,13 +74,30 @@ class WorkerSet:
         # cluster nodes ("any" = least-loaded); without the config key
         # all actors stay on the head host (core/cluster.py)
         nodes = self._config.get("worker_nodes") or []
-        for i in range(num_workers):
-            opts = dict(
-                max_restarts=int(
-                    self._config.get("recreate_failed_workers", False)
-                )
-                and 3
+        worker_config = {
+            **self._config,
+            "_mesh": None,
+            **(config_overrides or {}),
+        }
+        # an injected kill models a preemption: the host is GONE, so
+        # the runtime's in-place actor restart must not resurrect it
+        # (a restarted process re-arms the injector's death sentence —
+        # fresh call counts — and the chaos run never converges); the
+        # recovery layer replaces the worker with a disarmed config
+        # instead
+        kill_armed = bool(
+            (worker_config.get("fault_injection") or {}).get(
+                "kill_worker"
             )
+        )
+        restarts = (
+            3
+            if self._config.get("recreate_failed_workers", False)
+            and not kill_armed
+            else 0
+        )
+        for i in range(num_workers):
+            opts = dict(max_restarts=restarts)
             if nodes:
                 opts["placement_node"] = nodes[(start + i) % len(nodes)]
             self._remote_workers.append(
@@ -75,7 +106,7 @@ class WorkerSet:
                     policy_cls=self._policy_cls,
                     policy_specs=self._policy_specs,
                     policy_mapping_fn=self._policy_mapping_fn,
-                    config={**self._config, "_mesh": None},
+                    config=worker_config,
                     worker_index=start + i + 1,
                     num_workers=num_workers,
                 )
@@ -125,7 +156,12 @@ class WorkerSet:
                     if i + 1 in to_worker_indices
                 ]
             for w in targets:
-                w.set_weights.remote(ref, global_vars)
+                try:
+                    w.set_weights.remote(ref, global_vars)
+                except _ACTOR_DEAD_ERRORS:
+                    # a corpse must not abort the broadcast to the
+                    # rest of the fleet (recovery replaces it later)
+                    continue
         if global_vars:
             self._local_worker.set_global_vars(global_vars)
 
@@ -135,9 +171,21 @@ class WorkerSet:
         ``rllib/utils/filter_manager.py`` FilterManager.synchronize)."""
         if self._local_worker is None or not self._remote_workers:
             return
-        remote_filters = ray.get(
-            [w.get_filters.remote(True) for w in self._remote_workers]
-        )
+        remote_filters = []
+        for w in self._remote_workers:
+            try:
+                remote_filters.append(
+                    self._retry.call(
+                        lambda w=w: ray.get(
+                            w.get_filters.remote(True),
+                            timeout=self._retry.timeout_s,
+                        )
+                    )
+                )
+            except _ACTOR_DEAD_ERRORS:
+                continue  # dead worker contributes no filter delta
+            except ray.core.object_store.GetTimeoutError:
+                continue  # wedged worker: bounded skip, not a hang
         local = self._local_worker.filters
         for rf in remote_filters:
             for pid, f in rf.items():
@@ -148,9 +196,25 @@ class WorkerSet:
         }
         ref = ray.put(merged)
         for w in self._remote_workers:
-            w.sync_filters.remote(ref)
+            try:
+                w.sync_filters.remote(ref)
+            except _ACTOR_DEAD_ERRORS:
+                continue
 
     # -- mapping ---------------------------------------------------------
+
+    def _get_bounded(self, refs: List):
+        """``ray.get`` under the retry policy: each attempt is bounded
+        by the per-attempt timeout and timeouts re-wait on the backoff
+        schedule (the refs keep computing across attempts — a retry
+        never resubmits work), so a wedged actor costs
+        ``max_attempts × timeout_s`` instead of an indefinite hang.
+        Actor-death errors propagate immediately: callers of
+        ``foreach_worker`` rely on them for the recreate protocol."""
+        return self._retry.call(
+            lambda: ray.get(refs, timeout=self._retry.timeout_s),
+            retry_on=(ray.core.object_store.GetTimeoutError,),
+        )
 
     def foreach_worker(self, fn: Callable) -> List:
         """reference worker_set.py:367."""
@@ -158,7 +222,9 @@ class WorkerSet:
         if self._local_worker is not None:
             out.append(fn(self._local_worker))
         out.extend(
-            ray.get([w.apply.remote(fn) for w in self._remote_workers])
+            self._get_bounded(
+                [w.apply.remote(fn) for w in self._remote_workers]
+            )
         )
         return out
 
@@ -170,7 +236,7 @@ class WorkerSet:
             w.apply.remote(fn, i + 1)
             for i, w in enumerate(self._remote_workers)
         ]
-        out.extend(ray.get(refs))
+        out.extend(self._get_bounded(refs))
         return out
 
     def foreach_policy(self, fn: Callable) -> List:
@@ -181,20 +247,25 @@ class WorkerSet:
             out.extend(res)
         return out
 
-    def probe_unhealthy_workers(self) -> List[int]:
-        """→ indices of workers that fail a ping (reference fault
-        tolerance in worker_set / algorithm.try_recover)."""
-        bad = []
-        refs = [
-            (i, w.ping.remote())
-            for i, w in enumerate(self._remote_workers)
+    def probe_unhealthy_workers(
+        self, timeout_s: Optional[float] = None
+    ) -> List[int]:
+        """→ 1-based indices of workers that fail a ping (reference
+        fault tolerance in worker_set / algorithm.try_recover). All
+        pings fly in parallel under ONE wall-clock budget
+        (``worker_health_probe_timeout_s``, default 10 s), so a single
+        wedged actor delays the sweep by at most the budget instead of
+        stalling the whole health check."""
+        if timeout_s is None:
+            timeout_s = float(
+                self._config.get("worker_health_probe_timeout_s", 10.0)
+            )
+        return [
+            i + 1
+            for i in probe_actors(
+                self._remote_workers, timeout_s=timeout_s
+            )
         ]
-        for i, ref in refs:
-            try:
-                ray.get(ref, timeout=30)
-            except Exception:
-                bad.append(i + 1)
-        return bad
 
     def remove_workers(self, workers: List) -> None:
         """Drop specific worker handles from the set (no ping probe).
@@ -207,6 +278,11 @@ class WorkerSet:
         ]
         self._update_fleet_gauge()
 
+    # replacements spin up with fault injection disarmed: an empty
+    # spec also disables the RAY_TPU_FAULTS env fallback, so a
+    # recreated worker doesn't re-run its predecessor's death sentence
+    _REPLACEMENT_OVERRIDES = {"fault_injection": {}}
+
     def replace_failed_workers(self, dead: List) -> List:
         """Remove observed-dead workers and spawn replacements; returns
         the new handles (already weight-synced)."""
@@ -214,24 +290,36 @@ class WorkerSet:
             return []
         self.remove_workers(dead)
         before = len(self._remote_workers)
-        self.add_workers(len(dead))
+        self.add_workers(
+            len(dead), config_overrides=self._REPLACEMENT_OVERRIDES
+        )
         new = self._remote_workers[before:]
+        telemetry_metrics.inc_worker_restarts(len(new))
         self.sync_weights()
         return new
 
-    def recreate_failed_workers(self) -> None:
+    def recreate_failed_workers(self) -> int:
+        """Probe the fleet (bounded), replace the unhealthy; returns
+        the number of workers recreated."""
         bad = self.probe_unhealthy_workers()
         if not bad:
-            return
-        num = len(self._remote_workers)
+            return 0
         keep = [
             w
             for i, w in enumerate(self._remote_workers)
             if i + 1 not in bad
         ]
         self._remote_workers = keep
-        self.add_workers(len(bad))
+        self.add_workers(
+            len(bad), config_overrides=self._REPLACEMENT_OVERRIDES
+        )
+        telemetry_metrics.inc_worker_restarts(len(bad))
         self.sync_weights()
+        return len(bad)
+
+    @property
+    def retry_policy(self) -> RetryPolicy:
+        return self._retry
 
     def stop(self) -> None:
         if self._local_worker is not None:

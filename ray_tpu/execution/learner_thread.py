@@ -89,6 +89,15 @@ class LearnerThread(threading.Thread):
         self._weights_lock = threading.Lock()
         self._published: Optional[Tuple[int, Dict]] = None
         self._steps_since_publish = 0
+        # resilience: a thread exception parks here (healthy() flips
+        # False) instead of vanishing into a dead daemon thread; the
+        # chaos harness can also crash the thread deterministically
+        from ray_tpu.resilience import faults as faults_lib
+
+        self.error: Optional[BaseException] = None
+        self._fault_injector = faults_lib.from_config(
+            getattr(policy, "config", None) or {}
+        )
 
     def _get_feeder(self):
         # Lazy: build on the learner thread so jax initializes there.
@@ -109,6 +118,8 @@ class LearnerThread(threading.Thread):
                     self._drain_lazy(all_of_them=True)
                     continue
             self._drain_lazy(all_of_them=True)
+        except BaseException as e:  # surfaced via healthy()/error
+            self.error = e
         finally:
             # The learner thread owns the feeder: stopping it here (not in
             # stop(), which runs on another thread) avoids racing an
@@ -164,7 +175,14 @@ class LearnerThread(threading.Thread):
         with self._weights_lock:
             return self._published
 
+    def healthy(self) -> bool:
+        """False once the thread died (injected crash or real bug);
+        the parked exception is in :attr:`error`."""
+        return self.error is None and self.is_alive()
+
     def step(self) -> None:
+        if self._fault_injector is not None:
+            self._fault_injector.on_learner_thread_step()
         if not self._pipelined:
             return self._step_sync()
         t0 = time.perf_counter()

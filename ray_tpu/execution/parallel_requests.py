@@ -58,9 +58,14 @@ class AsyncRequestsManager:
         max_remote_requests_in_flight_per_worker: int = 2,
         return_object_refs: bool = False,
         name: str = "default",
+        retry_policy=None,
     ):
         self._max_in_flight = int(max_remote_requests_in_flight_per_worker)
         self._return_refs = bool(return_object_refs)
+        # uniform timeout/backoff schedule (docs/resilience.md): bounds
+        # the blocking harvest wait when the caller didn't pass one and
+        # retries transient submission faults
+        self._retry = retry_policy
         # telemetry tag: several managers coexist per process (sync
         # sampler rounds, PPO prefetcher, IMPALA polling) — the name
         # keeps their in-flight / dead-worker series apart
@@ -81,7 +86,18 @@ class AsyncRequestsManager:
         for w in workers:
             if w not in self._workers:
                 self._workers.append(w)
-                self._counts.setdefault(id(w), 0)
+                # RESET, not setdefault: a recreated actor handle can
+                # reuse a freed id(), and the corpse's leftover
+                # in-flight count would cap the new worker at zero
+                # submission slots forever
+                self._counts[id(w)] = 0
+                # ...and its stale dead-mark would suppress the
+                # report-once protocol, so a death of the NEW worker
+                # would never reach take_dead_workers (the dead-workers
+                # metric stays honest: one increment per death, counted
+                # again if the re-added worker dies again)
+                self._dead_ids.discard(id(w))
+                self._dead = [d for d in self._dead if d is not w]
 
     def remove_workers(self, workers: List) -> None:
         """Stop submitting to ``workers``; their in-flight refs stay
@@ -132,7 +148,13 @@ class AsyncRequestsManager:
         ):
             return False
         try:
-            ref = remote_fn(worker)
+            if self._retry is not None:
+                # transient submission faults (timeouts, transport
+                # hiccups) retry on the uniform backoff schedule;
+                # actor-death is NOT retryable and falls through
+                ref = self._retry.call(lambda: remote_fn(worker))
+            else:
+                ref = remote_fn(worker)
         except _ACTOR_DEAD_ERRORS:
             # the runtime can reject submission to an actor it already
             # knows is dead — same drop-and-report path as a harvested
@@ -184,6 +206,12 @@ class AsyncRequestsManager:
         refs = list(self._in_flight.keys())
         if not refs:
             return {}
+        if timeout is None and self._retry is not None:
+            # an indefinite wait against a wedged actor is the hang
+            # the resilience layer exists to prevent: bound it by the
+            # policy's per-attempt timeout (callers see an empty
+            # harvest and re-poll, exactly like an explicit timeout)
+            timeout = self._retry.timeout_s
         if timeout is None or timeout > 0:
             ray.wait(
                 refs,

@@ -73,6 +73,7 @@ def synchronous_parallel_sample(
             workers,
             max_remote_requests_in_flight_per_worker=1,
             name="sync_sample",
+            retry_policy=getattr(worker_set, "retry_policy", None),
         )
         while True:
             manager.submit_available()
@@ -150,6 +151,7 @@ class SamplePrefetcher:
             worker_set.remote_workers(),
             max_remote_requests_in_flight_per_worker=max_in_flight,
             name="sample_prefetcher",
+            retry_policy=getattr(worker_set, "retry_policy", None),
         )
         self._target = int(target_steps)
         self._deliver = deliver

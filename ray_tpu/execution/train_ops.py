@@ -29,10 +29,31 @@ NUM_AGENT_STEPS_TRAINED = "num_agent_steps_trained"
 
 
 def train_one_step(algorithm, train_batch) -> Dict:
-    """reference train_ops.py:42."""
+    """reference train_ops.py:42.
+
+    This is the driver-side learn choke point, so the resilience layer
+    hooks in here (docs/resilience.md): the FaultInjector counts learn
+    calls (NaN/Inf poisoning, injected crashes), and with
+    ``config["nan_guard"]`` a non-finite batch is SKIPPED — counted in
+    ``ray_tpu_skipped_batches_total`` and ``info/recovery`` — instead
+    of being fed to the optimizer, where a single NaN would corrupt
+    the params beyond repair."""
     import time as _time
 
     from ray_tpu.util import tracing
+
+    injector = getattr(algorithm, "_fault_injector", None)
+    if injector is not None:
+        injector.on_learn(train_batch)
+    if algorithm.config.get("nan_guard"):
+        from ray_tpu.resilience.recovery import batch_is_finite
+
+        if not batch_is_finite(train_batch):
+            algorithm._counters["num_nan_batches_skipped"] += 1
+            recovery = getattr(algorithm, "_recovery", None)
+            if recovery is not None:
+                recovery.note_skipped_batch()
+            return {}
 
     local_worker = algorithm.workers.local_worker()
     t0 = _time.perf_counter()

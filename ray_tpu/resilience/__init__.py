@@ -1,0 +1,51 @@
+"""ray_tpu.resilience — fault tolerance for the training loop
+(docs/resilience.md).
+
+Three pieces, wired through the whole hot path:
+
+- :mod:`~ray_tpu.resilience.faults` — a deterministic, config/env-driven
+  :class:`FaultInjector` (kill worker N on sample call K, delay
+  samples, poison a learn batch with NaN/Inf, crash the learner)
+  usable from tests and ``bench.py --chaos``;
+- :mod:`~ray_tpu.resilience.retry` — the single :class:`RetryPolicy`
+  (per-attempt timeout + exponential backoff + jitter + max attempts)
+  behind request-manager submission/harvest, WorkerSet sync /
+  ``foreach_worker`` marshalling, and the bounded
+  :func:`probe_actors` health sweep;
+- :mod:`~ray_tpu.resilience.recovery` — the :class:`RecoveryManager`
+  ``Algorithm.step`` consults on failure: recreate dead rollout
+  workers and continue degraded, auto-restore from the latest periodic
+  checkpoint on a restartable driver failure, and skip non-finite
+  learn batches (``nan_guard``). Configure with
+  ``AlgorithmConfig.fault_tolerance(...)``.
+"""
+
+from ray_tpu.resilience import faults  # noqa: F401
+from ray_tpu.resilience.faults import (  # noqa: F401
+    FaultInjector,
+    InjectedCrash,
+)
+from ray_tpu.resilience.recovery import (  # noqa: F401
+    ACTOR_DEAD_ERRORS,
+    RecoveryManager,
+    batch_is_finite,
+)
+from ray_tpu.resilience.retry import (  # noqa: F401
+    DEFAULT_RETRYABLE,
+    RetryPolicy,
+    probe_actors,
+    ray_get_retrying,
+)
+
+__all__ = [
+    "ACTOR_DEAD_ERRORS",
+    "DEFAULT_RETRYABLE",
+    "FaultInjector",
+    "InjectedCrash",
+    "RecoveryManager",
+    "RetryPolicy",
+    "batch_is_finite",
+    "faults",
+    "probe_actors",
+    "ray_get_retrying",
+]

@@ -1,0 +1,227 @@
+"""Deterministic fault injection for chaos testing the training loop.
+
+Counterpart of the reference's chaos utilities
+(``ray._private.test_utils.kill_actor_and_wait_for_failure``, the
+``testing/chaos`` NodeKiller actor): a config/env-driven injector that
+the hot path consults at four choke points —
+
+- **rollout worker sample** (``RolloutWorker.sample``): kill this
+  worker process on its K-th sample call, or delay the call;
+- **driver learn** (``train_ops.train_one_step`` / the PPO prefetch
+  ``deliver``): inject NaN/Inf into the K-th learn batch, or raise an
+  :class:`InjectedCrash` (a restartable driver-side failure);
+- **learner thread** (``LearnerThread.step``): crash the thread on its
+  K-th step.
+
+Faults are specified either as a dict under
+``config["fault_injection"]`` (ships to rollout actors with the rest
+of the worker config) or as the ``RAY_TPU_FAULTS`` env var, e.g.::
+
+    RAY_TPU_FAULTS="kill_worker:2@3;kill_worker:4@1;nan_batch:@2"
+
+Spec forms (dict keys / env tokens):
+
+- ``kill_worker``: ``[{"worker_index": W, "on_call": K}, ...]`` or
+  ``"W@K,W@K"`` — worker W ``os._exit``\\ s on its K-th sample call.
+- ``delay_sample``: ``[{"worker_index": W, "on_call": K,
+  "delay_s": S}]`` or ``"W@KxS"`` — worker W's K-th sample sleeps S
+  seconds (exercises probe/harvest timeouts without killing anyone).
+- ``nan_batch``: ``{"on_learn_call": K, "value": "nan"|"inf"}`` or
+  ``"@K"`` — corrupt the K-th learn batch's float columns.
+- ``crash_learner``: ``{"on_learn_call": K}`` or ``"@K"`` — raise
+  :class:`InjectedCrash` on the K-th driver learn call.
+- ``crash_learner_thread``: ``{"on_step": K}`` — raise inside
+  ``LearnerThread.step`` K.
+
+Every trigger fires **once** (deterministic: counts are per-process
+call numbers, not timers), and workers recreated by the recovery layer
+get an empty spec so a replacement doesn't re-run its predecessor's
+death sentence.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+
+class InjectedCrash(RuntimeError):
+    """A deliberately injected, restartable driver-side failure."""
+
+
+def _parse_env_spec(text: str) -> Dict[str, Any]:
+    """``kill_worker:2@3;nan_batch:@2;delay_sample:1@2x0.5`` → dict."""
+    spec: Dict[str, Any] = {}
+    for token in filter(None, (t.strip() for t in text.split(";"))):
+        kind, _, arg = token.partition(":")
+        kind = kind.strip()
+        if kind == "kill_worker":
+            lst = spec.setdefault("kill_worker", [])
+            for item in filter(None, arg.split(",")):
+                w, _, k = item.partition("@")
+                lst.append(
+                    {"worker_index": int(w), "on_call": int(k or 1)}
+                )
+        elif kind == "delay_sample":
+            lst = spec.setdefault("delay_sample", [])
+            for item in filter(None, arg.split(",")):
+                w, _, rest = item.partition("@")
+                k, _, s = rest.partition("x")
+                lst.append(
+                    {
+                        "worker_index": int(w),
+                        "on_call": int(k or 1),
+                        "delay_s": float(s or 1.0),
+                    }
+                )
+        elif kind == "nan_batch":
+            _, _, k = arg.partition("@")
+            spec["nan_batch"] = {"on_learn_call": int(k or 1)}
+        elif kind == "crash_learner":
+            _, _, k = arg.partition("@")
+            spec["crash_learner"] = {"on_learn_call": int(k or 1)}
+        elif kind == "crash_learner_thread":
+            _, _, k = arg.partition("@")
+            spec["crash_learner_thread"] = {"on_step": int(k or 1)}
+    return spec
+
+
+class FaultInjector:
+    """Holds one parsed fault spec plus the per-process call counters
+    that make every trigger deterministic."""
+
+    def __init__(self, spec: Dict[str, Any]):
+        self.spec = dict(spec or {})
+        self._learn_calls = 0
+        self._thread_steps = 0
+        self._fired: set = set()
+
+    # -- spec normalization ----------------------------------------------
+
+    @staticmethod
+    def _as_list(v) -> List[Dict]:
+        if v is None:
+            return []
+        if isinstance(v, dict):
+            return [v]
+        return list(v)
+
+    def _match_once(self, key: str, entry: Dict) -> bool:
+        """True exactly once per (key, entry identity)."""
+        tag = (key, tuple(sorted(entry.items())))
+        if tag in self._fired:
+            return False
+        self._fired.add(tag)
+        return True
+
+    # -- rollout-worker side ---------------------------------------------
+
+    def on_sample(self, worker_index: int, call_n: int) -> None:
+        """Consulted by ``RolloutWorker.sample`` with the worker's own
+        1-based call count. May sleep (delay fault) or never return
+        (kill fault: the actor process exits hard, exactly like an OOM
+        kill or preemption — no exception, no cleanup)."""
+        for entry in self._as_list(self.spec.get("delay_sample")):
+            if (
+                int(entry.get("worker_index", -1)) == worker_index
+                and int(entry.get("on_call", 1)) == call_n
+                and self._match_once("delay_sample", entry)
+            ):
+                time.sleep(float(entry.get("delay_s", 1.0)))
+        for entry in self._as_list(self.spec.get("kill_worker")):
+            if (
+                int(entry.get("worker_index", -1)) == worker_index
+                and int(entry.get("on_call", 1)) == call_n
+            ):
+                os._exit(1)
+
+    # -- driver learn side -----------------------------------------------
+
+    def on_learn(self, batch=None) -> None:
+        """Consulted once per driver-side learn call, BEFORE the
+        batch reaches the policy. Counts the call, then either raises
+        :class:`InjectedCrash` or corrupts the batch in place."""
+        self._learn_calls += 1
+        crash = self.spec.get("crash_learner")
+        if crash and int(
+            crash.get("on_learn_call", 1)
+        ) == self._learn_calls and self._match_once(
+            "crash_learner", crash
+        ):
+            raise InjectedCrash(
+                f"injected learner crash on learn call "
+                f"{self._learn_calls}"
+            )
+        nan = self.spec.get("nan_batch")
+        if (
+            nan is not None
+            and batch is not None
+            and int(nan.get("on_learn_call", 1)) == self._learn_calls
+            and self._match_once("nan_batch", nan)
+        ):
+            self._corrupt(batch, nan.get("value", "nan"))
+
+    @staticmethod
+    def _corrupt(batch, value: str = "nan") -> None:
+        """Poison the first writable float column of ``batch`` (a
+        SampleBatch / dict of arrays / MultiAgentBatch)."""
+        bad = np.inf if value == "inf" else np.nan
+        policy_batches = getattr(batch, "policy_batches", None)
+        targets = (
+            list(policy_batches.values())
+            if policy_batches is not None
+            else [batch]
+        )
+        for b in targets:
+            keys = list(b.keys()) if hasattr(b, "keys") else []
+            for k in keys:
+                v = b[k]
+                if (
+                    isinstance(v, np.ndarray)
+                    and np.issubdtype(v.dtype, np.floating)
+                    and v.size
+                ):
+                    v = v.copy()
+                    v.flat[0] = bad
+                    b[k] = v
+                    break
+
+    # -- learner thread side ---------------------------------------------
+
+    def on_learner_thread_step(self) -> None:
+        """Consulted by ``LearnerThread.step``; raises on the matching
+        step so the thread dies the way a real learner bug would."""
+        self._thread_steps += 1
+        crash = self.spec.get("crash_learner_thread")
+        if crash and int(
+            crash.get("on_step", 1)
+        ) == self._thread_steps and self._match_once(
+            "crash_learner_thread", crash
+        ):
+            raise InjectedCrash(
+                f"injected learner-thread crash on step "
+                f"{self._thread_steps}"
+            )
+
+
+def from_config(config: Optional[Dict]) -> Optional[FaultInjector]:
+    """Build an injector from ``config["fault_injection"]``, falling
+    back to the ``RAY_TPU_FAULTS`` env var when the config carries no
+    spec at all. Returns None (zero hot-path cost) when no faults are
+    configured. An explicitly EMPTY config spec (``{}``) disarms the
+    env fallback too — the recovery layer hands recreated workers an
+    empty spec so replacements spin up clean."""
+    cfg = config or {}
+    spec = cfg.get("fault_injection")
+    if spec is None:
+        text = os.environ.get("RAY_TPU_FAULTS", "").strip()
+        if text:
+            spec = _parse_env_spec(text)
+    if not spec:
+        return None
+    if isinstance(spec, str):
+        spec = _parse_env_spec(spec)
+    return FaultInjector(spec)

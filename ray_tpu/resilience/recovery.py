@@ -1,0 +1,222 @@
+"""Checkpoint-based auto-recovery for the training loop.
+
+Counterpart of the reference's failure handling in
+``rllib/algorithms/algorithm.py`` (``try_recover_from_step_attempt``,
+``ignore_worker_failures`` / ``recreate_failed_workers``) plus the tune
+trial-level ``max_failures`` restart budget — folded into one driver-side
+:class:`RecoveryManager` that ``Algorithm.step`` consults whenever a
+training step raises:
+
+- **worker death** (``RayActorError``/``WorkerCrashedError``): probe the
+  fleet with a bounded timeout, drop the corpses, spawn replacements
+  (weight-synced, fault-injection disarmed), and continue in degraded
+  mode while they come up;
+- **restartable driver-side failure** (anything else, when
+  ``restore_on_failure`` is set and a checkpoint exists): restore the
+  latest periodic checkpoint and continue from it;
+- **non-finite learn batch** (``nan_guard``): skip the batch instead of
+  corrupting params — the guard lives at the learn choke points
+  (``train_ops.train_one_step``, the PPO prefetch ``deliver``) and
+  reports here.
+
+Every action burns one unit of the ``max_failures`` budget (negative =
+unlimited), emits a ``recovery:*`` span and the Prometheus counters
+``ray_tpu_worker_restarts_total`` / ``ray_tpu_recoveries_total{kind=}``
+/ ``ray_tpu_skipped_batches_total``, and accumulates into the
+per-iteration time-lost-to-recovery reported under
+``info/recovery`` (and, with tracing on, the span-derived
+``recovery_s`` in ``info/telemetry``).
+"""
+
+from __future__ import annotations
+
+import collections
+import os
+import time
+from typing import Dict, Optional
+
+import numpy as np
+
+import ray_tpu as ray
+from ray_tpu.telemetry import metrics as telemetry_metrics
+from ray_tpu.util import tracing
+
+ACTOR_DEAD_ERRORS = (
+    ray.core.object_store.RayActorError,
+    ray.core.object_store.WorkerCrashedError,
+)
+
+
+def batch_is_finite(batch) -> bool:
+    """True when every float column of a SampleBatch / MultiAgentBatch
+    / plain dict-of-arrays is free of NaN/Inf. The nan-guard predicate:
+    cheap relative to a learn call, and only evaluated when
+    ``config["nan_guard"]`` is on."""
+    policy_batches = getattr(batch, "policy_batches", None)
+    targets = (
+        list(policy_batches.values())
+        if policy_batches is not None
+        else [batch]
+    )
+    for b in targets:
+        keys = list(b.keys()) if hasattr(b, "keys") else []
+        for k in keys:
+            v = b[k]
+            if (
+                isinstance(v, np.ndarray)
+                and np.issubdtype(v.dtype, np.floating)
+                and not np.isfinite(v).all()
+            ):
+                return False
+    return True
+
+
+class RecoveryManager:
+    """Owns the failure budget, the periodic-checkpoint cadence, and
+    the restore path for one Algorithm. Inert (but always present)
+    when the config enables none of it."""
+
+    def __init__(self, algorithm):
+        self.algo = algorithm
+        cfg = algorithm.config
+        # < 0 = unlimited (the seed behavior of recreate/ignore flags)
+        self.max_failures = int(
+            cfg.get("max_failures", -1)
+            if cfg.get("max_failures") is not None
+            else -1
+        )
+        self.checkpoint_frequency = int(
+            cfg.get("checkpoint_frequency") or 0
+        )
+        self.restore_on_failure = bool(cfg.get("restore_on_failure"))
+        self.checkpoint_root = cfg.get("checkpoint_root")
+        self.failures = 0
+        self.num_worker_restarts = 0
+        self.num_recoveries: collections.Counter = collections.Counter()
+        self.num_skipped_batches = 0
+        self.time_lost_s = 0.0
+        self.iter_time_lost_s = 0.0
+        self.latest_checkpoint: Optional[str] = None
+        # a restarted driver pointed at the same checkpoint_root picks
+        # up where the dead one left off
+        if self.checkpoint_root and os.path.isdir(self.checkpoint_root):
+            ckpts = sorted(
+                d
+                for d in os.listdir(self.checkpoint_root)
+                if d.startswith("checkpoint_")
+            )
+            if ckpts:
+                self.latest_checkpoint = os.path.join(
+                    self.checkpoint_root, ckpts[-1]
+                )
+
+    # -- iteration bookkeeping -------------------------------------------
+
+    def begin_iteration(self) -> None:
+        self.iter_time_lost_s = 0.0
+
+    def _budget_ok(self) -> bool:
+        self.failures += 1
+        return self.max_failures < 0 or self.failures <= self.max_failures
+
+    def _note(self, kind: str, t0: float) -> None:
+        dt = time.time() - t0
+        self.time_lost_s += dt
+        self.iter_time_lost_s += dt
+        self.num_recoveries[kind] += 1
+        telemetry_metrics.inc_recoveries(kind)
+
+    # -- the failure protocol --------------------------------------------
+
+    def handle_failure(self, exc: BaseException) -> bool:
+        """Called by ``Algorithm.step`` when ``training_step`` raises.
+        Returns True when the loop may continue (the failure was
+        absorbed), False when the exception must propagate."""
+        if isinstance(exc, ACTOR_DEAD_ERRORS):
+            return self._recover_workers(exc)
+        if (
+            isinstance(exc, Exception)
+            and self.restore_on_failure
+            and self.latest_checkpoint
+        ):
+            return self._restore_from_checkpoint(exc)
+        return False
+
+    def _recover_workers(self, exc: BaseException) -> bool:
+        cfg = self.algo.config
+        recreate = bool(cfg.get("recreate_failed_workers"))
+        if not recreate and not cfg.get("ignore_worker_failures"):
+            return False
+        if not self._budget_ok():
+            return False
+        t0 = time.time()
+        with tracing.start_span(
+            "recovery:workers", error=type(exc).__name__
+        ) as span:
+            restarted = 0
+            if recreate:
+                restarted = self.algo.workers.recreate_failed_workers()
+            span.set_attribute("restarted", restarted)
+        self.num_worker_restarts += restarted
+        self._note("workers", t0)
+        self.algo.on_recovery("workers")
+        return True
+
+    def _restore_from_checkpoint(self, exc: BaseException) -> bool:
+        if not self._budget_ok():
+            return False
+        t0 = time.time()
+        with tracing.start_span(
+            "recovery:restore",
+            error=type(exc).__name__,
+            checkpoint=self.latest_checkpoint,
+        ):
+            self.algo.restore(self.latest_checkpoint)
+        self._note("restore", t0)
+        self.algo.on_recovery("restore")
+        return True
+
+    def note_skipped_batch(self) -> None:
+        """A learn choke point skipped a non-finite batch."""
+        self.num_skipped_batches += 1
+        telemetry_metrics.inc_skipped_batches()
+        tracing.event("recovery:skip_nan_batch")
+
+    # -- periodic checkpoints --------------------------------------------
+
+    def maybe_checkpoint(self) -> Optional[str]:
+        """End-of-iteration hook: every ``checkpoint_frequency``
+        iterations, save into ``checkpoint_root`` (default
+        ``<logdir>/resilience``) and remember it as the restore
+        target. Pruning to ``keep_checkpoints_num`` happens inside
+        ``Algorithm.save_checkpoint``."""
+        if self.checkpoint_frequency <= 0:
+            return None
+        it = self.algo.iteration + 1  # the iteration just completed
+        if it % self.checkpoint_frequency:
+            return None
+        root = self.checkpoint_root or os.path.join(
+            self.algo.logdir, "resilience"
+        )
+        os.makedirs(root, exist_ok=True)
+        t0 = time.time()
+        with tracing.start_span("recovery:checkpoint", iteration=it):
+            path = self.algo.save(
+                os.path.join(root, f"checkpoint_{it:06d}")
+            )
+        self.iter_time_lost_s += time.time() - t0
+        self.latest_checkpoint = path
+        return path
+
+    # -- reporting -------------------------------------------------------
+
+    def stats(self) -> Dict:
+        return {
+            "failures": self.failures,
+            "worker_restarts": self.num_worker_restarts,
+            "recoveries": dict(self.num_recoveries),
+            "skipped_batches": self.num_skipped_batches,
+            "time_lost_s": round(self.time_lost_s, 4),
+            "time_lost_s_this_iter": round(self.iter_time_lost_s, 4),
+            "latest_checkpoint": self.latest_checkpoint,
+        }

@@ -1,0 +1,171 @@
+"""RetryPolicy: the single timeout / exponential-backoff / jitter /
+max-attempts schedule applied uniformly across the control plane.
+
+Counterpart of the reference's scattered retry knobs
+(``ray.remote(max_restarts=..., max_task_retries=...)``,
+``RAY_gcs_rpc_server_reconnect_timeout_s``, rllib's hardcoded
+``ray.get(..., timeout=...)`` calls): here every driver-side remote
+interaction — request-manager submission, weight/filter sync,
+``foreach_worker`` marshalling, health probes — draws its bound from
+one :class:`RetryPolicy` built from the algorithm config
+(``AlgorithmConfig.fault_tolerance(retry_...)``), so a wedged actor
+costs a bounded, configured amount of time instead of an indefinite
+hang, and transient faults are retried on the same schedule
+everywhere.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from ray_tpu.core.object_store import GetTimeoutError
+
+# Errors worth retrying by default: timeouts and transient transport
+# faults. Actor-death errors are NOT retryable — retrying against a
+# corpse wastes the whole backoff schedule; the recovery layer replaces
+# the actor instead.
+DEFAULT_RETRYABLE = (GetTimeoutError, TimeoutError, ConnectionError, OSError)
+
+
+@dataclasses.dataclass(frozen=True)
+class RetryPolicy:
+    """One retry/timeout/backoff schedule.
+
+    ``max_attempts`` counts total tries (1 = no retry). ``timeout_s``
+    is the per-attempt bound handed to ``ray.get``/``ray.wait`` style
+    calls (None = caller's default). Backoff between attempt *k* and
+    *k+1* is ``backoff_s * backoff_mult**k`` capped at
+    ``max_backoff_s``, plus up to ``jitter`` fraction of itself
+    (decorrelates a fleet of retriers hammering one recovering
+    endpoint). ``seed`` makes the jitter deterministic for tests."""
+
+    max_attempts: int = 3
+    timeout_s: Optional[float] = 60.0
+    backoff_s: float = 0.05
+    backoff_mult: float = 2.0
+    max_backoff_s: float = 2.0
+    jitter: float = 0.1
+    seed: Optional[int] = None
+
+    @classmethod
+    def from_config(cls, config: Dict) -> "RetryPolicy":
+        """Build from the flat config keys
+        ``AlgorithmConfig.fault_tolerance`` writes."""
+        cfg = config or {}
+        return cls(
+            max_attempts=int(cfg.get("retry_max_attempts", 3)),
+            timeout_s=cfg.get("retry_timeout_s", 60.0),
+            backoff_s=float(cfg.get("retry_backoff_s", 0.05)),
+            backoff_mult=float(cfg.get("retry_backoff_mult", 2.0)),
+            max_backoff_s=float(cfg.get("retry_max_backoff_s", 2.0)),
+            jitter=float(cfg.get("retry_jitter", 0.1)),
+            seed=cfg.get("seed"),
+        )
+
+    def delay(self, attempt: int, rng: Optional[random.Random] = None) -> float:
+        """Backoff before retry number ``attempt`` (0-based)."""
+        base = min(
+            self.backoff_s * (self.backoff_mult ** attempt),
+            self.max_backoff_s,
+        )
+        if self.jitter <= 0.0:
+            return base
+        rng = rng or (
+            random.Random(self.seed + attempt)
+            if self.seed is not None
+            else random
+        )
+        return base * (1.0 + self.jitter * rng.random())
+
+    def schedule(self) -> List[float]:
+        """The full backoff schedule (len = retries = attempts - 1)."""
+        return [
+            self.delay(a) for a in range(max(0, self.max_attempts - 1))
+        ]
+
+    def call(
+        self,
+        fn: Callable[[], Any],
+        *,
+        retry_on: Optional[Tuple] = None,
+        on_retry: Optional[Callable[[int, BaseException], None]] = None,
+    ) -> Any:
+        """Run ``fn()`` under this schedule: retryable errors sleep the
+        backoff and try again; the final attempt's error propagates.
+        Non-retryable errors propagate immediately."""
+        retry_on = retry_on or DEFAULT_RETRYABLE
+        last: Optional[BaseException] = None
+        for attempt in range(max(1, self.max_attempts)):
+            try:
+                return fn()
+            except retry_on as e:  # noqa: PERF203 — retry loop
+                last = e
+                if attempt >= self.max_attempts - 1:
+                    raise
+                if on_retry is not None:
+                    on_retry(attempt, e)
+                time.sleep(self.delay(attempt))
+        raise last  # pragma: no cover — loop always returns or raises
+
+
+def ray_get_retrying(
+    ref,
+    policy: RetryPolicy,
+    *,
+    timeout_s: Optional[float] = None,
+):
+    """``ray.get`` bounded by the policy: each attempt waits at most
+    ``timeout_s`` (default ``policy.timeout_s``); timeouts retry on the
+    backoff schedule, actor errors propagate immediately."""
+    import ray_tpu as ray
+
+    t = policy.timeout_s if timeout_s is None else timeout_s
+    return policy.call(
+        lambda: ray.get(ref, timeout=t),
+        retry_on=(GetTimeoutError,),
+    )
+
+
+def probe_actors(
+    actors: Sequence,
+    *,
+    timeout_s: float = 10.0,
+    ping: Callable = None,
+) -> List[int]:
+    """Bounded parallel health sweep → 0-based indices of unhealthy
+    actors. All pings launch concurrently and share ONE wall-clock
+    budget (``timeout_s``), so a single wedged actor delays the sweep
+    by at most the budget — never ``N × budget`` like a sequential
+    per-corpse ``ray.get`` would. An actor is unhealthy when its ping
+    errors (dead) or fails to answer inside the budget (wedged)."""
+    import ray_tpu as ray
+
+    if not actors:
+        return []
+    ping = ping or (lambda a: a.ping.remote())
+    refs = []
+    bad: List[int] = []
+    for i, a in enumerate(actors):
+        try:
+            refs.append((i, ping(a)))
+        except Exception:
+            # submission to a known-dead actor can raise synchronously
+            bad.append(i)
+    pending = [r for _, r in refs]
+    ray.wait(
+        pending, num_returns=len(pending), timeout=max(0.0, timeout_s)
+    )
+    ready_now, _ = ray.wait(pending, num_returns=len(pending), timeout=0)
+    ready_ids = {r.id for r in ready_now}
+    for i, r in refs:
+        if r.id not in ready_ids:
+            bad.append(i)  # wedged: no answer inside the budget
+            continue
+        try:
+            ray.get(r, timeout=0.1)
+        except Exception:
+            bad.append(i)  # dead: ping completed with an error
+    return sorted(bad)

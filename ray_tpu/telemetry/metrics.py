@@ -42,6 +42,10 @@ JAX_LIVE_BUFFERS = "ray_tpu_jax_live_buffers"
 JAX_DEVICE_MEMORY = "ray_tpu_jax_device_memory_bytes"
 OVERLAP_FRACTION = "ray_tpu_iteration_overlap_fraction"
 ITERATION_SECONDS = "ray_tpu_iteration_seconds"
+# resilience layer (docs/resilience.md)
+WORKER_RESTARTS_TOTAL = "ray_tpu_worker_restarts_total"
+RECOVERIES_TOTAL = "ray_tpu_recoveries_total"
+SKIPPED_BATCHES_TOTAL = "ray_tpu_skipped_batches_total"
 
 
 def gauge(
@@ -94,6 +98,43 @@ def inc_dead_workers(manager: str, n: int = 1) -> None:
         "rollout workers observed dead",
         ("manager",),
     ).inc(float(n), {"manager": manager})
+
+
+def inc_worker_restarts(n: int = 1) -> None:
+    """Rollout workers recreated after observed death (fed by
+    WorkerSet.replace_failed_workers / recreate_failed_workers)."""
+    counter(
+        WORKER_RESTARTS_TOTAL,
+        "rollout workers recreated after failure",
+    ).inc(float(n))
+
+
+def inc_recoveries(kind: str, n: int = 1) -> None:
+    """Recovery actions taken by the RecoveryManager, by kind
+    (``workers`` = fleet probe+recreate, ``restore`` =
+    checkpoint auto-restore)."""
+    counter(
+        RECOVERIES_TOTAL,
+        "training-loop recovery actions",
+        ("kind",),
+    ).inc(float(n), {"kind": kind})
+
+
+def inc_skipped_batches(n: int = 1) -> None:
+    """Learn batches skipped by the non-finite guard (nan_guard)."""
+    counter(
+        SKIPPED_BATCHES_TOTAL,
+        "learn batches skipped by the non-finite guard",
+    ).inc(float(n))
+
+
+def counter_total(name: str) -> float:
+    """Sum of a counter's series across all tag values (0.0 when the
+    counter was never touched)."""
+    m = get_metric(name)
+    if m is None:
+        return 0.0
+    return sum(v for _, v in m.series())
 
 
 def learn_steps_total() -> float:

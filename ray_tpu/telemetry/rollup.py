@@ -26,6 +26,9 @@ STAGE_PREFIXES: Dict[str, Tuple[str, ...]] = {
     "assemble": ("prefetch:assemble", "prefetch:deliver"),
     "transfer": ("feeder:transfer", "learn:transfer"),
     "learn": ("learn:nest",),
+    # time lost to the resilience layer: fleet probe+recreate,
+    # checkpoint restore, periodic checkpoint writes (recovery:* spans)
+    "recovery": ("recovery:",),
 }
 
 # stages whose spans count as "sampling is running" for the overlap
