@@ -186,6 +186,7 @@ class Algorithm(Trainable):
         t0 = time.time()
         self._iteration_marks.append(t0)
         learn_before = telemetry_lib.metrics.learn_steps_total()
+        h2d_before = telemetry_lib.metrics.h2d_bytes_by_path()
         results: Dict[str, Any] = {}
         train_info: Dict[str, Any] = {}
         min_t = config.get("min_time_s_per_iteration")
@@ -278,10 +279,20 @@ class Algorithm(Trainable):
                 tracing.get_spans(), *window
             )
             rollup["window_iterations_ago"] = 1 if prev else 0
+            # per-iteration H2D bytes by path (docs/data_plane.md):
+            # feeder/learn/replay_insert deltas next to the stage busy
+            # times — the byte diet of device-resident replay is read
+            # directly off `learn` (≈0) vs `replay_insert` here
+            h2d_after = telemetry_lib.metrics.h2d_bytes_by_path()
+            h2d = {
+                p: h2d_after.get(p, 0.0) - h2d_before.get(p, 0.0)
+                for p in set(h2d_after) | set(h2d_before)
+            }
             results["info"]["telemetry"] = {
                 **rollup,
                 **throughput,
                 **runtime_vals,
+                "h2d_bytes": {**h2d, "total": sum(h2d.values())},
             }
         self._prev_iter_window = (t0, t_train_end)
         results.update(self._collect_rollout_metrics())

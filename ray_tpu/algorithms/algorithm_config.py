@@ -90,6 +90,29 @@ class AlgorithmConfig:
         self.grad_clip = None
         self.seed = None
 
+        # device-resident data plane (docs/data_plane.md)
+        # "auto" (default): off-policy replay rows live as device
+        # arrays on the learner mesh — each transition crosses H2D
+        # once at insert, never per learn step — spilling back to the
+        # host ring when the projected buffer exceeds
+        # replay_memory_cap_bytes (default 60% of the device's
+        # reported budget). Auto engages only behind a real
+        # accelerator boundary (on the CPU client "device" arrays
+        # share host RAM — nothing to diet); True forces device
+        # placement anywhere (still spills on the memory projection),
+        # False keeps the host ring. Fixed-seed results are
+        # bit-identical either way.
+        self.replay_device_resident = "auto"
+        self.replay_memory_cap_bytes = None
+        # Defer the learner's stats readback by one call: learn
+        # returns right after the SGD nest is dispatched and fetches
+        # the PREVIOUS call's stats (long finished) instead of
+        # blocking on this one — amortizes per-dispatch latency
+        # (dominant on a tunneled/remote TPU). train() results lag
+        # one learn step; host-side stat hooks (PPO kl adaptation)
+        # see the lagged values.
+        self.deferred_stats = False
+
         # learner placement (TPU-specific)
         self.learner_devices = None  # None → all visible devices
         # learner sharding runtime (docs/sharding.md): "mesh" lowers
@@ -220,8 +243,15 @@ class AlgorithmConfig:
         model: Optional[Dict] = None,
         optimizer: Optional[Dict] = None,
         grad_clip: Optional[float] = None,
+        replay_device_resident=None,
+        replay_memory_cap_bytes: Optional[int] = None,
+        deferred_stats: Optional[bool] = None,
         **kwargs,
     ) -> "AlgorithmConfig":
+        """``replay_device_resident`` / ``replay_memory_cap_bytes`` /
+        ``deferred_stats``: the device-resident data-plane knobs
+        (docs/data_plane.md) — see the attribute comments in
+        ``__init__``."""
         if gamma is not None:
             self.gamma = gamma
         if lr is not None:
@@ -236,6 +266,12 @@ class AlgorithmConfig:
             self.optimizer = optimizer
         if grad_clip is not None:
             self.grad_clip = grad_clip
+        if replay_device_resident is not None:
+            self.replay_device_resident = replay_device_resident
+        if replay_memory_cap_bytes is not None:
+            self.replay_memory_cap_bytes = int(replay_memory_cap_bytes)
+        if deferred_stats is not None:
+            self.deferred_stats = bool(deferred_stats)
         for k, v in kwargs.items():
             setattr(self, k, v)
         return self

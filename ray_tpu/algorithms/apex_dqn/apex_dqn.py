@@ -146,6 +146,17 @@ class ApexDQN(DQN):
         an extra jitted forward per fragment on the learning critical
         path, so it is opt-in."""
         config = self.config
+        from ray_tpu.ops.framestack import (
+            FRAMES as _FRAMES,
+            materialize_fragment,
+        )
+
+        if _FRAMES in batch:
+            # worker-compressed framestack fragment (byte-exact
+            # replay-pool format): rebuild OBS/NEXT_OBS before the
+            # n-step fold reads them and rows enter the replay shard
+            k = int(self.get_policy().observation_space.shape[-1])
+            batch = SampleBatch(materialize_fragment(dict(batch), k))
         n_step = config.get("n_step", 1)
         if n_step > 1:
             adjust_nstep(n_step, config["gamma"], batch)

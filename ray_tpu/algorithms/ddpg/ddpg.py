@@ -461,7 +461,9 @@ class DDPGJaxPolicy(JaxPolicy):
             sharded, donate_argnums=(1,), label=label
         )
 
-    def learn_on_device_batch(self, dev_batch, batch_size: int) -> Dict:
+    def learn_on_device_batch(
+        self, dev_batch, batch_size: int, *, defer_stats: bool = False
+    ) -> Dict:
         fn = self.learn_fn(batch_size)
         self._rng, rng = jax.random.split(self._rng)
         self.params, self.opt_state, self.aux_state, stats = fn(
@@ -469,7 +471,18 @@ class DDPGJaxPolicy(JaxPolicy):
             rng, {},
         )
         self.num_grad_updates += 1
-        stats = jax.device_get(stats)
+        if defer_stats:
+            return stats
+        if self.config.get("deferred_stats"):
+            # one-call lag, same contract as the JaxPolicy base
+            # (docs/data_plane.md)
+            prev = self.__dict__.get("_lagged_stats")
+            self.__dict__["_lagged_stats"] = stats
+            if prev is None:
+                return {}
+            stats = jax.device_get(prev)
+        else:
+            stats = jax.device_get(stats)
         return {k: float(v) for k, v in stats.items()}
 
     def compute_td_error(self, samples) -> np.ndarray:
@@ -486,7 +499,7 @@ class DDPGJaxPolicy(JaxPolicy):
                 return q1 - td_target
 
             self._td_error_fn = jax.jit(fn)
-        batch = self._batch_to_train_tree(samples)
+        batch = self._td_input_tree(samples)
         self._rng, rng = jax.random.split(self._rng)
         td = self._td_error_fn(self.params, self.aux_state, batch, rng)
         return np.abs(np.asarray(td))

@@ -24,8 +24,10 @@ from ray_tpu.algorithms.algorithm import (
 from ray_tpu.algorithms.algorithm_config import AlgorithmConfig
 from ray_tpu.data.sample_batch import DEFAULT_POLICY_ID, SampleBatch
 from ray_tpu.execution.replay_buffer import (
+    DevicePrioritizedReplayBuffer,
     MultiAgentReplayBuffer,
     PrioritizedReplayBuffer,
+    resolve_device_resident,
 )
 from ray_tpu.execution.rollout_ops import synchronous_parallel_sample
 from ray_tpu.execution.train_ops import (
@@ -462,7 +464,7 @@ class DQNJaxPolicy(JaxPolicy):
                 return td
 
             self._td_error_fn = jax.jit(fn)
-        batch = self._batch_to_train_tree(samples)
+        batch = self._td_input_tree(samples)
         # NoisyNet: sample weight noise for the priority pass too, so
         # priorities are computed under the same training-mode network
         # family the loss minimizes (mean weights would decorrelate PER
@@ -493,6 +495,16 @@ class DQN(Algorithm):
             prioritized=rb_cfg.get("prioritized_replay", False),
             alpha=rb_cfg.get("prioritized_replay_alpha", 0.6),
             seed=config.get("seed"),
+            device_resident=resolve_device_resident(
+                config, config.get("_mesh")
+            ),
+            mesh=config.get("_mesh"),
+            memory_cap_bytes=config.get("replay_memory_cap_bytes"),
+            # columns convert to the policy's train tree ONCE, at
+            # insert — the single H2D crossing of the device plane
+            replay_columns_fn=lambda pid, sb: self.get_policy(
+                pid
+            ).replay_columns(sb),
         )
         self._last_target_update = 0
 
@@ -506,11 +518,24 @@ class DQN(Algorithm):
         )
         for pid, b in train_batch.policy_batches.items():
             policy = self.get_policy(pid)
-            info = policy.learn_on_batch(b)
+            if getattr(b, "is_device_resident", False):
+                # device plane: rows are already resident on the
+                # learner mesh — learn without any H2D transfer
+                info = policy.learn_on_device_batch(
+                    dict(b.tree), b.count
+                )
+            else:
+                info = policy.learn_on_batch(b)
             train_info[pid] = info
             if prioritized:
                 buf = self.local_replay_buffer.buffers[pid]
-                if isinstance(buf, PrioritizedReplayBuffer):
+                if isinstance(
+                    buf,
+                    (
+                        PrioritizedReplayBuffer,
+                        DevicePrioritizedReplayBuffer,
+                    ),
+                ):
                     # Per-sample |TD error| refresh (reference
                     # dqn.py training_step → update_priorities):
                     # a batch-mean scalar would cancel +/- errors
@@ -518,16 +543,19 @@ class DQN(Algorithm):
                     # Policies without per-sample errors (e.g.
                     # continuous-action subclasses) fall back to
                     # the batch-mean scalar.
+                    idx = (
+                        b.indices
+                        if getattr(b, "is_device_resident", False)
+                        else b["batch_indexes"]
+                    )
                     if hasattr(policy, "compute_td_error"):
                         td = policy.compute_td_error(b)
                     else:
                         td = np.full(
-                            len(b["batch_indexes"]),
+                            len(idx),
                             abs(info.get("mean_td_error", 0.0)),
                         )
-                    buf.update_priorities(
-                        b["batch_indexes"], td + 1e-6
-                    )
+                    buf.update_priorities(idx, td + 1e-6)
             self._counters[NUM_ENV_STEPS_TRAINED] += b.count
         return train_info
 
@@ -540,7 +568,9 @@ class DQN(Algorithm):
         device memory in check. Others loop learn_on_batch."""
         import jax
 
+        from ray_tpu import sharding as sharding_lib
         from ray_tpu.policy.jax_policy import JaxPolicy
+        from ray_tpu.telemetry import metrics as telemetry_metrics
 
         config = self.config
         train_info: Dict = {}
@@ -591,7 +621,13 @@ class DQN(Algorithm):
                 train_batch = self.local_replay_buffer.sample(k * bs)
                 for pid, b in train_batch.policy_batches.items():
                     policy = pols[pid]
-                    tree = policy._batch_to_train_tree(b)
+                    # device-resident samples ARE the train tree
+                    # (reshape is a device-side view; no transfer)
+                    tree = (
+                        b.tree
+                        if getattr(b, "is_device_resident", False)
+                        else policy._batch_to_train_tree(b)
+                    )
                     stacked = {
                         c: v.reshape((k, bs) + v.shape[1:])
                         for c, v in tree.items()
@@ -621,18 +657,29 @@ class DQN(Algorithm):
             )
             for pid, b in train_batch.policy_batches.items():
                 policy = self.get_policy(pid)
-                deferable = isinstance(policy, JaxPolicy) and (
-                    type(policy).learn_on_batch
-                    is JaxPolicy.learn_on_batch
-                ) and (
-                    type(policy).after_learn_on_batch
-                    is JaxPolicy.after_learn_on_batch
+                device_res = getattr(b, "is_device_resident", False)
+                deferable = device_res or (
+                    isinstance(policy, JaxPolicy)
+                    and (
+                        type(policy).learn_on_batch
+                        is JaxPolicy.learn_on_batch
+                    )
+                    and (
+                        type(policy).after_learn_on_batch
+                        is JaxPolicy.after_learn_on_batch
+                    )
                 )
                 if deferable:
-                    tree, bsize = policy.prepare_batch(b)
-                    dev = jax.device_put(
-                        tree, policy.batch_shardings(tree)
-                    )
+                    if device_res:
+                        dev, bsize = dict(b.tree), b.count
+                    else:
+                        tree, bsize = policy.prepare_batch(b)
+                        telemetry_metrics.add_h2d_bytes(
+                            "learn", sharding_lib.tree_nbytes(tree)
+                        )
+                        dev = jax.device_put(
+                            tree, policy.batch_shardings(tree)
+                        )
                     lazy = policy.learn_on_device_batch(
                         dev, bsize, defer_stats=True
                     )
@@ -657,6 +704,50 @@ class DQN(Algorithm):
                 k: float(v) for k, v in stats.items()
             }
         return train_info
+
+    def _materialize_compressed(self, batch):
+        """Rebuild stacked observation columns from worker-compressed
+        frame pools (``ops/framestack.compress_replay_obs`` format:
+        the pool covers OBS and NEXT_OBS exactly, terminal stacks
+        included, so ``materialize_fragment`` is byte-exact here)."""
+        from ray_tpu.data.sample_batch import MultiAgentBatch
+        from ray_tpu.ops.framestack import (
+            FRAMES as _FRAMES,
+            materialize_fragment,
+        )
+
+        def mat(pid, sb):
+            if _FRAMES not in sb:
+                return sb
+            k = int(
+                self.get_policy(pid).observation_space.shape[-1]
+            )
+            return SampleBatch(materialize_fragment(dict(sb), k))
+
+        if isinstance(batch, MultiAgentBatch):
+            batch.policy_batches = {
+                pid: mat(pid, sb)
+                for pid, sb in batch.policy_batches.items()
+            }
+            return batch
+        return mat(DEFAULT_POLICY_ID, batch)
+
+    def __getstate__(self) -> Dict:
+        """Checkpoint the replay buffer alongside the policy state
+        (device rings pull back to host numpy; restore re-uploads) —
+        an off-policy restore without its buffer replays the warmup
+        from scratch."""
+        state = super().__getstate__()
+        buf = getattr(self, "local_replay_buffer", None)
+        if buf is not None:
+            state["replay_buffer"] = buf.get_state()
+        return state
+
+    def __setstate__(self, state: Dict) -> None:
+        super().__setstate__(state)
+        buf = getattr(self, "local_replay_buffer", None)
+        if buf is not None and "replay_buffer" in state:
+            buf.set_state(state["replay_buffer"])
 
     def training_step(self) -> Dict:
         """reference dqn.py:336 (shared off-policy training_step)."""
@@ -690,6 +781,10 @@ class DQN(Algorithm):
                 max_env_steps=config.get("rollout_fragment_length", 4)
                 * max(1, config.get("num_envs_per_worker", 1)),
             )
+        # worker-compressed framestack fragments (compress_replay_obs
+        # pools) rebuild OBS/NEXT_OBS byte-identically here, before
+        # n-step folding reads NEXT_OBS and rows enter the replay ring
+        batch = self._materialize_compressed(batch)
         n_step = config.get("n_step", 1)
         if n_step > 1:
             from ray_tpu.data.sample_batch import MultiAgentBatch

@@ -575,6 +575,16 @@ class SACJaxPolicy(JaxPolicy):
             fn = self._build_multi_learn_fn(batch_size, k)
             self._multi_learn_fns[key] = fn
         sharding = sharding_lib.batch_sharded(self.mesh, ndim_prefix=2)
+        if not any(
+            isinstance(v, jax.Array) for v in stacked.values()
+        ):
+            # host-gathered chains cross H2D here; device-resident
+            # replay hands jax arrays through (already resident)
+            from ray_tpu.telemetry import metrics as telemetry_metrics
+
+            telemetry_metrics.add_h2d_bytes(
+                "learn", sharding_lib.tree_nbytes(stacked)
+            )
         dev = jax.device_put(stacked, sharding)
         self._rng, rng = jax.random.split(self._rng)
         (
@@ -664,7 +674,7 @@ class SACJaxPolicy(JaxPolicy):
                 return jnp.minimum(q1, q2) - td_target
 
             self._td_error_fn = jax.jit(fn)
-        batch = self._batch_to_train_tree(samples)
+        batch = self._td_input_tree(samples)
         self._rng, rng = jax.random.split(self._rng)
         td = self._td_error_fn(self.params, self.aux_state, batch, rng)
         return np.abs(np.asarray(td))
@@ -692,7 +702,17 @@ class SACJaxPolicy(JaxPolicy):
         self.num_grad_updates += 1
         if defer_stats:
             return stats
-        stats = jax.device_get(stats)
+        if self.config.get("deferred_stats"):
+            # same one-call lag as the JaxPolicy base
+            # (docs/data_plane.md): return the previous update's
+            # stats so this dispatch never blocks on its own program
+            prev = self.__dict__.get("_lagged_stats")
+            self.__dict__["_lagged_stats"] = stats
+            if prev is None:
+                return {}
+            stats = jax.device_get(prev)
+        else:
+            stats = jax.device_get(stats)
         return {k: float(v) for k, v in stats.items()}
 
     def update_target(self) -> None:

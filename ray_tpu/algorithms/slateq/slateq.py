@@ -582,7 +582,7 @@ class SlateQJaxPolicy(JaxPolicy):
                 return (clicked_q - y) * click.sum(axis=1)
 
             self._td_error_fn = jax.jit(fn)
-        batch = self._batch_to_train_tree(samples)
+        batch = self._td_input_tree(samples)
         td = self._td_error_fn(self.params, self.aux_state, batch)
         return np.abs(np.asarray(td))
 

@@ -63,6 +63,11 @@ class DeviceFeeder:
                     # JaxPolicy.batch_shardings: frame pools ride
                     # replicated while row columns shard over data)
                     sharding = sharding(host_batch)
+                from ray_tpu.sharding import tree_nbytes
+
+                telemetry_metrics.add_h2d_bytes(
+                    "feeder", tree_nbytes(host_batch)
+                )
                 t0 = _time.perf_counter()
                 with tracing.start_span("feeder:transfer"):
                     if sharding is not None:

@@ -1,17 +1,36 @@
-"""Replay buffers (uniform + prioritized), host-RAM resident.
+"""Replay buffers: host-RAM ring + the device-resident data plane.
 
 Counterpart of the reference's
 ``rllib/utils/replay_buffers/{replay_buffer,prioritized_replay_buffer}.py``
 (PrioritizedReplayBuffer ``:19``) and the segment trees
 (``rllib/execution/segment_tree.py``). TPU-first: storage is columnar
-(pre-allocated numpy ring arrays per column) instead of a deque of
-per-timestep dicts, so sampling a training batch is a single fancy-index
-gather producing learner-ready arrays with zero python-loop work.
+(pre-allocated ring arrays per column) instead of a deque of
+per-timestep dicts, so sampling a training batch is a single
+fancy-index gather producing learner-ready arrays with zero
+python-loop work.
+
+Two storage planes (docs/data_plane.md):
+
+- :class:`ReplayBuffer` / :class:`PrioritizedReplayBuffer` — numpy
+  rings on the host. Every learn step re-transfers its sampled rows
+  host→device; at SAC-style replay ratios each frame crosses the wire
+  dozens of times.
+- :class:`DeviceReplayBuffer` / :class:`DevicePrioritizedReplayBuffer`
+  — column rings living as device arrays on the learner mesh
+  (``ray_tpu.sharding``): inserts are one donated jit'd scatter (each
+  transition crosses H2D exactly once), samples are one jit'd gather
+  whose output feeds ``JaxPolicy.learn_on_device_batch`` directly.
+  The index draw stays HOST-seeded (same generator, same call order
+  as the host ring), so a fixed seed produces bit-identical learn
+  results on either plane. Priorities stay host-side (the numpy sum
+  tree — a device sum tree is an open ROADMAP item); only rows live
+  on device. A capacity/memory projection at first insert spills to
+  the host ring when the buffer wouldn't fit.
 """
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional
 
 import numpy as np
 
@@ -90,7 +109,71 @@ class ReplayBuffer:
             self._cols[k][: self._size] = v
 
 
-class PrioritizedReplayBuffer(ReplayBuffer):
+class _PrioritySampling:
+    """Host-side proportional-priority machinery shared by the host
+    and device prioritized buffers: numpy sum/min segment trees, the
+    stratified index draw, IS-weight computation, and priority
+    updates. One implementation on purpose — the device buffer keeps
+    bit-identical sampling to the host ring because it runs exactly
+    this code; only WHERE the rows live differs."""
+
+    def _init_priority_trees(self, capacity: int, alpha: float) -> None:
+        assert alpha >= 0
+        self._alpha = alpha
+        cap2 = 1
+        while cap2 < capacity:
+            cap2 *= 2
+        self._sum_tree = SumSegmentTree(cap2)
+        self._min_tree = MinSegmentTree(cap2)
+        self._max_priority = 1.0
+
+    def _draw_prioritized(self, num_items: int, beta: float):
+        """→ (row indices, IS weights float32) for one stratified
+        proportional draw over the current ``self._size`` rows."""
+        total = self._sum_tree.sum(0, self._size)
+        mass = (
+            self._rng.random(num_items) + np.arange(num_items)
+        ) / num_items * total
+        idx = self._sum_tree.find_prefixsum_idx(mass)
+        idx = np.clip(idx, 0, self._size - 1)
+
+        p_min = self._min_tree.min(0, self._size) / total
+        max_weight = (p_min * self._size) ** (-beta)
+        p_sample = self._sum_tree[idx] / total
+        weights = (p_sample * self._size) ** (-beta) / max_weight
+        return idx, weights.astype(np.float32)
+
+    def update_priorities(
+        self, idx: np.ndarray, priorities: np.ndarray
+    ) -> None:
+        priorities = np.maximum(np.asarray(priorities, np.float64), 1e-6)
+        self._sum_tree.set_items(idx, priorities**self._alpha)
+        self._min_tree.set_items(idx, priorities**self._alpha)
+        self._max_priority = max(
+            self._max_priority, float(priorities.max())
+        )
+
+    def _priority_state(self) -> Dict:
+        """Raw (already alpha-powered) leaf values of the stored range
+        + max priority — enough to rebuild both trees exactly."""
+        idx = np.arange(self._size)
+        return {
+            "leaf_values": np.asarray(self._sum_tree[idx], np.float64)
+            if self._size
+            else np.zeros(0, np.float64),
+            "max_priority": self._max_priority,
+        }
+
+    def _set_priority_state(self, state: Dict) -> None:
+        vals = np.asarray(state["leaf_values"], np.float64)
+        if len(vals):
+            idx = np.arange(len(vals))
+            self._sum_tree.set_items(idx, vals)
+            self._min_tree.set_items(idx, vals)
+        self._max_priority = float(state.get("max_priority", 1.0))
+
+
+class PrioritizedReplayBuffer(_PrioritySampling, ReplayBuffer):
     """Proportional prioritized replay (reference
     prioritized_replay_buffer.py:19), vectorized over the whole sample
     batch via the numpy segment trees."""
@@ -102,14 +185,7 @@ class PrioritizedReplayBuffer(ReplayBuffer):
         seed: Optional[int] = None,
     ):
         super().__init__(capacity, seed)
-        assert alpha >= 0
-        self._alpha = alpha
-        cap2 = 1
-        while cap2 < capacity:
-            cap2 *= 2
-        self._sum_tree = SumSegmentTree(cap2)
-        self._min_tree = MinSegmentTree(cap2)
-        self._max_priority = 1.0
+        self._init_priority_trees(capacity, alpha)
 
     def add(self, batch: SampleBatch) -> None:
         # new samples enter at max priority so they are trained on at
@@ -132,36 +208,599 @@ class PrioritizedReplayBuffer(ReplayBuffer):
         self.update_priorities(idx, np.asarray(priorities, np.float64))
 
     def sample(self, num_items: int, beta: float = 0.4) -> SampleBatch:
-        total = self._sum_tree.sum(0, self._size)
-        mass = (
-            self._rng.random(num_items) + np.arange(num_items)
-        ) / num_items * total
-        idx = self._sum_tree.find_prefixsum_idx(mass)
-        idx = np.clip(idx, 0, self._size - 1)
-
-        p_min = self._min_tree.min(0, self._size) / total
-        max_weight = (p_min * self._size) ** (-beta)
-        p_sample = self._sum_tree[idx] / total
-        weights = (p_sample * self._size) ** (-beta) / max_weight
-
+        idx, weights = self._draw_prioritized(num_items, beta)
         batch = self._make_batch(idx)
-        batch["weights"] = weights.astype(np.float32)
+        batch["weights"] = weights
         batch["batch_indexes"] = idx.astype(np.int64)
         return batch
 
-    def update_priorities(
-        self, idx: np.ndarray, priorities: np.ndarray
-    ) -> None:
-        priorities = np.maximum(np.asarray(priorities, np.float64), 1e-6)
-        self._sum_tree.set_items(idx, priorities**self._alpha)
-        self._min_tree.set_items(idx, priorities**self._alpha)
-        self._max_priority = max(
-            self._max_priority, float(priorities.max())
+    def get_state(self) -> Dict:
+        state = super().get_state()
+        state["priorities"] = self._priority_state()
+        return state
+
+    def set_state(self, state: Dict) -> None:
+        super().set_state(state)
+        if "priorities" in state:
+            self._set_priority_state(state["priorities"])
+
+
+def resolve_device_resident(config: Dict, mesh=None) -> bool:
+    """Resolve the ``replay_device_resident`` knob
+    (docs/data_plane.md). ``True`` forces device placement (the
+    memory projection at first insert can still spill). ``"auto"``
+    (the default) turns it on exactly where it pays: a real
+    accelerator behind a transfer boundary. On the CPU client
+    "device" arrays live in the same host RAM — there is no wire to
+    diet, and the extra insert/sample programs are pure overhead —
+    so auto resolves off there. Auto also resolves off when
+    ``train_batch_size`` doesn't divide the data shards (the host
+    path's prepare_batch trims ragged batches; the device path keeps
+    static shapes end to end)."""
+    mode = config.get("replay_device_resident", "auto")
+    if not mode:
+        return False
+    if mode == "auto":
+        try:
+            import jax
+
+            devices = mesh.devices.flatten() if mesh is not None else (
+                jax.devices()
+            )
+            if all(d.platform == "cpu" for d in devices):
+                return False
+        except Exception:
+            return False
+        shards = 1
+        if mesh is not None:
+            try:
+                from ray_tpu.sharding import num_shards
+
+                shards = num_shards(mesh)
+            except Exception:
+                shards = 1
+        if int(config.get("train_batch_size", 0)) % max(1, shards):
+            return False
+    return True
+
+
+class DeviceTrainBatch:
+    """A sampled batch whose columns are device arrays, ready for
+    ``JaxPolicy.learn_on_device_batch`` — the device plane's stand-in
+    for a host :class:`SampleBatch` in the off-policy training loops.
+    ``indices`` (host numpy) are the drawn ring positions, kept for
+    prioritized-priority refresh without a device round trip."""
+
+    is_device_resident = True
+
+    def __init__(
+        self,
+        tree: Dict[str, Any],
+        count: int,
+        indices: Optional[np.ndarray] = None,
+    ):
+        self.tree = tree
+        self.count = int(count)
+        self.indices = indices
+
+    def __len__(self) -> int:
+        return self.count
+
+    def env_steps(self) -> int:
+        return self.count
+
+    def __contains__(self, key) -> bool:
+        return key in self.tree
+
+    def __getitem__(self, key):
+        return self.tree[key]
+
+    def get(self, key, default=None):
+        return self.tree.get(key, default)
+
+
+class DeviceReplayBuffer:
+    """Uniform ring buffer whose column storage lives on the learner
+    mesh (docs/data_plane.md).
+
+    - **Insert** is one donated jit'd circular scatter per fragment:
+      the host rows cross H2D exactly once, here, and never again.
+      uint8 columns (pixel obs) are stored packed as uint32 lanes —
+      the same element-width trick as ``_build_learn_fn``'s minibatch
+      gather (MFU.md) — so the sample gather moves 4× wider elements.
+    - **Sample** draws indices on the HOST from the same seeded
+      generator (same call order) as the host :class:`ReplayBuffer`,
+      then gathers rows in one jit'd program; a fixed seed therefore
+      yields bit-identical learn results on either plane.
+    - **Spill**: the first insert projects total storage bytes
+      (``capacity ×`` row bytes); past ``memory_cap_bytes`` (default:
+      60% of the device's reported ``bytes_limit``, unlimited when the
+      backend reports none — e.g. the CPU client) everything delegates
+      to a host ring built with the SAME generator object, so the
+      spill changes placement, never sampling.
+    """
+
+    is_device_resident = True
+
+    def __init__(
+        self,
+        capacity: int = 10000,
+        seed: Optional[int] = None,
+        mesh=None,
+        memory_cap_bytes: Optional[int] = None,
+        label: str = "default_policy",
+    ):
+        from ray_tpu import sharding as sharding_lib
+
+        self.capacity = int(capacity)
+        self._rng = np.random.default_rng(seed)
+        self.mesh = mesh if mesh is not None else sharding_lib.get_mesh()
+        self.memory_cap_bytes = memory_cap_bytes
+        self.label = label
+        self._store: Dict[str, Any] = {}  # name -> device ring array
+        # name -> (row_shape, dtype, packed_as_uint32)
+        self._meta: Dict[str, tuple] = {}
+        self._idx = 0
+        self._size = 0
+        self._num_added = 0
+        self._insert_fn = None
+        self._sample_fn = None
+        self._host: Optional[ReplayBuffer] = None  # spill fallback
+        self.storage_bytes = 0
+
+    # -- spill ----------------------------------------------------------
+
+    @property
+    def spilled(self) -> bool:
+        return self._host is not None
+
+    def _make_host_fallback(self) -> ReplayBuffer:
+        buf = ReplayBuffer(self.capacity)
+        # same generator OBJECT: the spill changes row placement, not
+        # the index stream — fixed-seed runs stay bit-identical
+        buf._rng = self._rng
+        return buf
+
+    def _resolve_memory_cap(self) -> Optional[int]:
+        if self.memory_cap_bytes is not None:
+            return int(self.memory_cap_bytes)
+        try:
+            import jax
+
+            stats = jax.local_devices()[0].memory_stats() or {}
+            limit = stats.get("bytes_limit")
+            if limit:
+                return int(0.6 * float(limit))
+        except Exception:
+            pass
+        return None  # backend reports no budget: no projection check
+
+    # -- storage --------------------------------------------------------
+
+    @staticmethod
+    def _canonical(v: np.ndarray) -> np.ndarray:
+        """Match jax's dtype canonicalization BEFORE the transfer:
+        with x64 disabled a ``device_put`` of f64/i64 lands as
+        f32/i32 anyway (that's what the host ring's learn path
+        ships), so cast host-side — same values, half the wire
+        bytes."""
+        import jax
+
+        if not jax.config.jax_enable_x64:
+            if v.dtype == np.float64:
+                return v.astype(np.float32)
+            if v.dtype == np.int64:
+                return v.astype(np.int32)
+            if v.dtype == np.uint64:
+                return v.astype(np.uint32)
+        return v
+
+    @staticmethod
+    def _packable(shape: tuple, dtype) -> bool:
+        inner = int(np.prod(shape)) if shape else 1
+        return (
+            np.dtype(dtype) == np.uint8
+            and len(shape) >= 1
+            and inner % 4 == 0
         )
+
+    def _ensure_storage(self, tree: Dict[str, np.ndarray]) -> bool:
+        """Allocate device rings for any new columns; returns False
+        when the projection spilled this buffer to the host ring."""
+        if self._host is not None:
+            return False
+        import jax
+        import jax.numpy as jnp
+
+        from ray_tpu import sharding as sharding_lib
+
+        new_cols = {
+            k: v for k, v in tree.items() if k not in self._store
+        }
+        if not new_cols:
+            return True
+        projected = self.storage_bytes + sum(
+            self.capacity
+            * int(np.prod(v.shape[1:]) if v.ndim > 1 else 1)
+            * v.dtype.itemsize
+            for v in new_cols.values()
+        )
+        cap = self._resolve_memory_cap()
+        if cap is not None and projected > cap:
+            # snapshot BEFORE arming the host fallback (get_state
+            # delegates once _host is set)
+            prior = self.get_state() if self._store else None
+            self._host = self._make_host_fallback()
+            if prior is not None:
+                # columns arrived incrementally and the projection
+                # only now tipped over: replay the resident rows into
+                # the host ring so nothing is lost
+                self._store, self._meta = {}, {}
+                self._host.set_state(
+                    {
+                        "cols": prior["cols"],
+                        "idx": prior["idx"],
+                        "size": prior["size"],
+                        "num_added": prior["num_added"],
+                    }
+                )
+            self.storage_bytes = 0
+            return False
+        for k, v in new_cols.items():
+            row_shape = tuple(v.shape[1:])
+            packed = self._packable(row_shape, v.dtype)
+            if packed:
+                inner = int(np.prod(row_shape))
+                ring = jnp.zeros(
+                    (self.capacity, inner // 4), jnp.uint32
+                )
+            else:
+                ring = jnp.zeros(
+                    (self.capacity,) + row_shape, v.dtype
+                )
+            # rows shard over the data axis when capacity divides the
+            # shard count, else replicate (specs.leaf_sharding rule)
+            self._store[k] = jax.device_put(
+                ring, sharding_lib.leaf_sharding(ring, self.mesh)
+            )
+            self._meta[k] = (row_shape, v.dtype, packed)
+            self.storage_bytes += self.capacity * int(
+                np.prod(row_shape) if row_shape else 1
+            ) * v.dtype.itemsize
+        self._insert_fn = None
+        self._sample_fn = None
+        return True
+
+    def _build_insert_fn(self):
+        import jax
+        import jax.numpy as jnp
+
+        from ray_tpu import sharding as sharding_lib
+
+        meta = dict(self._meta)
+
+        def fn(store, rows, pos):
+            out = dict(store)
+            for k, v in rows.items():
+                _, _, packed = meta[k]
+                if packed:
+                    v = jax.lax.bitcast_convert_type(
+                        v.reshape(v.shape[0], -1, 4), jnp.uint32
+                    )
+                out[k] = store[k].at[pos].set(v)
+            return out
+
+        return sharding_lib.sharded_jit(
+            fn,
+            donate_argnums=(0,),
+            label=f"replay_insert[{self.label}]",
+        )
+
+    def _build_sample_fn(self, row_sharded: bool):
+        import jax
+        import jax.numpy as jnp
+
+        from ray_tpu import sharding as sharding_lib
+
+        meta = dict(self._meta)
+
+        def fn(store, idx):
+            out = {}
+            for k, v in store.items():
+                row_shape, dtype, packed = meta[k]
+                g = v[idx]
+                if packed:
+                    u8 = jax.lax.bitcast_convert_type(g, jnp.uint8)
+                    g = u8.reshape((g.shape[0],) + row_shape)
+                out[k] = g
+            return out
+
+        # explicit output placement: the learn programs declare
+        # row-sharded batch inputs, and jit rejects (rather than
+        # reshards) a committed mismatch — so the gather emits rows
+        # already laid out for the nest; draws whose length doesn't
+        # divide the shards (state snapshots) replicate instead
+        out_spec = (
+            sharding_lib.batch_sharded(self.mesh)
+            if row_sharded
+            else sharding_lib.replicated(self.mesh)
+        )
+        return sharding_lib.sharded_jit(
+            fn,
+            out_specs=out_spec,
+            label=f"replay_sample[{self.label}]",
+        )
+
+    # -- ring bookkeeping (mirrors ReplayBuffer exactly) ----------------
+
+    def __len__(self) -> int:
+        if self._host is not None:
+            return len(self._host)
+        return self._size
+
+    @property
+    def num_added(self) -> int:
+        if self._host is not None:
+            return self._host.num_added
+        return self._num_added
+
+    def add(self, batch: SampleBatch) -> None:
+        self.add_tree(
+            {
+                k: np.asarray(v)
+                for k, v in batch.items()
+                if isinstance(v, np.ndarray) and v.dtype != object
+            }
+        )
+
+    def add_tree(self, tree: Dict[str, np.ndarray]) -> None:
+        """Insert a host column tree (equal leading dims). This is the
+        ONE host→device crossing of these rows."""
+        tree = {
+            k: self._canonical(np.ascontiguousarray(v))
+            for k, v in tree.items()
+        }
+        if not tree:
+            return
+        n = int(next(iter(tree.values())).shape[0])
+        if n == 0:
+            return
+        if not self._ensure_storage(tree):
+            self._host.add(SampleBatch(tree))
+            self._report_occupancy()
+            return
+        from ray_tpu import sharding as sharding_lib
+        from ray_tpu.telemetry import metrics as telemetry_metrics
+
+        telemetry_metrics.add_h2d_bytes(
+            "replay_insert", sharding_lib.tree_nbytes(tree)
+        )
+        if self._insert_fn is None:
+            self._insert_fn = self._build_insert_fn()
+        pos = (self._idx + np.arange(n)) % self.capacity
+        self._store = self._insert_fn(
+            self._store, tree, pos.astype(np.int32)
+        )
+        self._idx = int((self._idx + n) % self.capacity)
+        self._size = int(min(self._size + n, self.capacity))
+        self._num_added += n
+        self._report_occupancy()
+
+    def _report_occupancy(self) -> None:
+        from ray_tpu.telemetry import metrics as telemetry_metrics
+
+        telemetry_metrics.set_replay_occupancy(
+            self.label,
+            len(self),
+            self.capacity,
+            self.storage_bytes,
+            device=self._host is None,
+        )
+
+    # -- sampling --------------------------------------------------------
+
+    def sample(self, num_items: int):
+        if self._host is not None:
+            return self._host.sample(num_items)
+        idx = self._rng.integers(0, self._size, num_items)
+        return self.gather(idx)
+
+    def _num_shards(self) -> int:
+        from ray_tpu import sharding as sharding_lib
+
+        return max(1, sharding_lib.num_shards(self.mesh))
+
+    def gather(self, idx: np.ndarray) -> DeviceTrainBatch:
+        """Rows at caller-chosen ring positions as one jit'd device
+        gather (QMIX draws its own indices; ``sample`` feeds the
+        host-seeded uniform draw through here)."""
+        idx = np.asarray(idx)
+        row_sharded = len(idx) % self._num_shards() == 0 and len(idx) > 0
+        if self._sample_fn is None:
+            self._sample_fn = {}
+        fn = self._sample_fn.get(row_sharded)
+        if fn is None:
+            fn = self._sample_fn[row_sharded] = self._build_sample_fn(
+                row_sharded
+            )
+        tree = fn(self._store, idx.astype(np.int32))
+        return DeviceTrainBatch(dict(tree), len(idx), indices=idx)
+
+    def stats(self) -> Dict:
+        return {
+            "size": len(self),
+            "num_added": self.num_added,
+            "device_resident": self._host is None,
+            "storage_bytes": self.storage_bytes,
+        }
+
+    # -- checkpoint state ------------------------------------------------
+
+    def get_state(self) -> Dict:
+        if self._host is not None:
+            state = self._host.get_state()
+            state["spilled"] = True
+            return state
+        import jax
+
+        host_store = jax.device_get(self._store)
+        cols = {}
+        for k, ring in host_store.items():
+            row_shape, dtype, packed = self._meta[k]
+            if packed:
+                ring = (
+                    ring.view(np.uint8)
+                    .reshape((self.capacity,) + row_shape)
+                )
+            cols[k] = ring[: self._size].copy()
+        return {
+            "cols": cols,
+            "idx": self._idx,
+            "size": self._size,
+            "num_added": self._num_added,
+            "spilled": False,
+        }
+
+    def set_state(self, state: Dict) -> None:
+        if state.get("spilled"):
+            self._host = self._make_host_fallback()
+            self._host.set_state(state)
+            return
+        cols = state["cols"]
+        size = int(state["size"])
+        full = {}
+        for k, v in cols.items():
+            ring = np.zeros(
+                (self.capacity,) + v.shape[1:], v.dtype
+            )
+            ring[:size] = v
+            full[k] = ring
+        self._store, self._meta = {}, {}
+        self.storage_bytes = 0
+        if full and not self._ensure_storage(full):
+            # restoring on a smaller-memory host: land in the spill
+            # ring instead
+            self._host.set_state(
+                {k: state[k] for k in ("cols", "idx", "size", "num_added")}
+            )
+            return
+        if full:
+            if self._insert_fn is None:
+                self._insert_fn = self._build_insert_fn()
+            self._store = self._insert_fn(
+                self._store,
+                full,
+                np.arange(self.capacity, dtype=np.int32),
+            )
+        self._idx = int(state["idx"])
+        self._size = size
+        self._num_added = int(state["num_added"])
+
+
+class DevicePrioritizedReplayBuffer(_PrioritySampling, DeviceReplayBuffer):
+    """Prioritized replay with device-resident rows: the sum/min trees
+    (and every priority update) stay host-side — exactly the host
+    :class:`PrioritizedReplayBuffer` code via ``_PrioritySampling`` —
+    while the drawn rows gather on device. IS weights ride into the
+    batch tree as a device column; ``batch_indexes`` stay host-side on
+    the returned :class:`DeviceTrainBatch` for the priority refresh."""
+
+    def __init__(
+        self,
+        capacity: int = 10000,
+        alpha: float = 0.6,
+        seed: Optional[int] = None,
+        mesh=None,
+        memory_cap_bytes: Optional[int] = None,
+        label: str = "default_policy",
+    ):
+        super().__init__(
+            capacity,
+            seed,
+            mesh=mesh,
+            memory_cap_bytes=memory_cap_bytes,
+            label=label,
+        )
+        self._init_priority_trees(capacity, alpha)
+
+    def _make_host_fallback(self) -> ReplayBuffer:
+        buf = PrioritizedReplayBuffer(self.capacity, self._alpha)
+        buf._rng = self._rng
+        # spill happens at first insert, before any priority write:
+        # handing over the (still pristine) trees keeps one source of
+        # truth if callers pre-seeded priorities
+        buf._sum_tree = self._sum_tree
+        buf._min_tree = self._min_tree
+        buf._max_priority = self._max_priority
+        return buf
+
+    def add_tree(
+        self,
+        tree: Dict[str, np.ndarray],
+        priorities: Optional[np.ndarray] = None,
+    ) -> None:
+        if not tree:
+            return
+        n = int(next(iter(tree.values())).shape[0])
+        if n == 0:
+            return
+        if priorities is None:
+            priorities = np.full(n, self._max_priority)
+        if self._host is not None:
+            self._host.add_with_priorities(
+                SampleBatch(tree), priorities
+            )
+            self._report_occupancy()
+            return
+        idx = (self._idx + np.arange(n)) % self.capacity
+        DeviceReplayBuffer.add_tree(self, tree)
+        if self._host is not None:  # this insert triggered the spill
+            self._host.update_priorities(
+                idx, np.asarray(priorities, np.float64)
+            )
+            return
+        self.update_priorities(idx, np.asarray(priorities, np.float64))
+
+    def sample(self, num_items: int, beta: float = 0.4):
+        if self._host is not None:
+            return self._host.sample(num_items, beta=beta)
+        import jax
+
+        from ray_tpu import sharding as sharding_lib
+
+        idx, weights = self._draw_prioritized(num_items, beta)
+        batch = self.gather(idx)
+        # same layout as the gathered rows, so the learn program's
+        # committed-input check sees one consistent batch tree
+        spec = (
+            sharding_lib.batch_sharded(self.mesh)
+            if num_items % self._num_shards() == 0
+            else sharding_lib.replicated(self.mesh)
+        )
+        batch.tree["weights"] = jax.device_put(weights, spec)
+        return batch
+
+    def get_state(self) -> Dict:
+        state = super().get_state()
+        if self._host is None:
+            state["priorities"] = self._priority_state()
+        return state
+
+    def set_state(self, state: Dict) -> None:
+        super().set_state(state)
+        if "priorities" in state and self._host is None:
+            self._set_priority_state(state["priorities"])
 
 
 class MultiAgentReplayBuffer:
-    """Per-policy buffers (reference multi_agent_replay_buffer.py)."""
+    """Per-policy buffers (reference multi_agent_replay_buffer.py).
+
+    ``device_resident=True`` stores each policy's rows on the learner
+    mesh (:class:`DeviceReplayBuffer`); ``replay_columns_fn(pid,
+    SampleBatch) -> dict`` converts fragments to the column tree the
+    policy's learn program consumes (``JaxPolicy.replay_columns``) —
+    applied ONCE at insert, so sampled batches feed
+    ``learn_on_device_batch`` with zero further host work."""
 
     def __init__(
         self,
@@ -169,16 +808,43 @@ class MultiAgentReplayBuffer:
         prioritized: bool = False,
         alpha: float = 0.6,
         seed: Optional[int] = None,
+        device_resident: bool = False,
+        mesh=None,
+        memory_cap_bytes: Optional[int] = None,
+        replay_columns_fn: Optional[Callable] = None,
     ):
         self.capacity = capacity
         self.prioritized = prioritized
         self.alpha = alpha
         self.seed = seed
+        self.device_resident = device_resident
+        self.mesh = mesh
+        self.memory_cap_bytes = memory_cap_bytes
+        self.replay_columns_fn = replay_columns_fn
         self.buffers: Dict[str, ReplayBuffer] = {}
 
     def _buffer(self, pid: str) -> ReplayBuffer:
         if pid not in self.buffers:
-            if self.prioritized:
+            if self.device_resident:
+                cls = (
+                    DevicePrioritizedReplayBuffer
+                    if self.prioritized
+                    else DeviceReplayBuffer
+                )
+                kwargs = dict(
+                    mesh=self.mesh,
+                    memory_cap_bytes=self.memory_cap_bytes,
+                    label=pid,
+                )
+                if self.prioritized:
+                    self.buffers[pid] = cls(
+                        self.capacity, self.alpha, self.seed, **kwargs
+                    )
+                else:
+                    self.buffers[pid] = cls(
+                        self.capacity, self.seed, **kwargs
+                    )
+            elif self.prioritized:
                 self.buffers[pid] = PrioritizedReplayBuffer(
                     self.capacity, self.alpha, self.seed
                 )
@@ -195,7 +861,20 @@ class MultiAgentReplayBuffer:
         if isinstance(batch, SampleBatch):
             batch = batch.as_multi_agent()
         for pid, sb in batch.policy_batches.items():
-            self._buffer(pid).add(sb)
+            buf = self._buffer(pid)
+            if isinstance(buf, DeviceReplayBuffer):
+                if self.replay_columns_fn is not None:
+                    tree = self.replay_columns_fn(pid, sb)
+                else:
+                    tree = {
+                        k: np.asarray(v)
+                        for k, v in sb.items()
+                        if isinstance(v, np.ndarray)
+                        and v.dtype != object
+                    }
+                buf.add_tree(tree)
+            else:
+                buf.add(sb)
 
     def sample(self, num_items: int, **kwargs):
         from ray_tpu.data.sample_batch import MultiAgentBatch
@@ -205,10 +884,26 @@ class MultiAgentReplayBuffer:
             if len(buf) >= num_items:
                 out[pid] = (
                     buf.sample(num_items, **kwargs)
-                    if isinstance(buf, PrioritizedReplayBuffer)
+                    if isinstance(
+                        buf,
+                        (
+                            PrioritizedReplayBuffer,
+                            DevicePrioritizedReplayBuffer,
+                        ),
+                    )
                     else buf.sample(num_items)
                 )
         return MultiAgentBatch(out, num_items)
 
     def __len__(self) -> int:
         return max((len(b) for b in self.buffers.values()), default=0)
+
+    def get_state(self) -> Dict:
+        """Per-policy buffer states, checkpointable through
+        ``Algorithm.save_checkpoint`` (all arrays host numpy — device
+        rings are pulled back and re-uploaded on restore)."""
+        return {pid: b.get_state() for pid, b in self.buffers.items()}
+
+    def set_state(self, state: Dict) -> None:
+        for pid, s in state.items():
+            self._buffer(pid).set_state(s)

@@ -154,6 +154,53 @@ def compress_fragment_obs(
     return pool, idx[:T]
 
 
+def compress_replay_obs(
+    obs: np.ndarray,
+    next_obs: np.ndarray,
+    dones: np.ndarray,
+) -> Tuple[np.ndarray, np.ndarray] | None:
+    """Replay-family variant of :func:`compress_fragment_obs`: the
+    pool covers OBS **and** NEXT_OBS exactly, including each episode's
+    terminal stack. TD losses read ``next_obs`` at every row (the
+    bootstrap term is masked at dones, but the bytes still ship and
+    replay buffers store them), so unlike the on-policy path the
+    terminal observation of every in-fragment episode must survive
+    compression: each episode segment contributes one pseudo-row —
+    its final ``next_obs`` — to the pooled stream.
+
+    Invariants of the returned ``(pool, idx)`` (idx length T):
+    ``obs[t] == stack(idx[t])`` and ``next_obs[t] == stack(idx[t]+1)``
+    for ALL t — :func:`materialize_fragment` rebuilds both columns
+    byte-identically (its idx+1 clamp is a no-op here because every
+    segment ends with the pseudo-row). Returns None when the rows
+    aren't sliding windows (caller ships stacks unchanged)."""
+    T = obs.shape[0]
+    if T == 0:
+        return None
+    dones = np.asarray(dones[:T], bool)
+    # every done row ends a segment; the final row always does
+    seg_end = dones.copy()
+    seg_end[T - 1] = True
+    end_rows = np.flatnonzero(seg_end)
+    # ext: obs rows with each segment's terminal next_obs inserted
+    # right after its end row (np.insert indices refer to pre-insert
+    # positions, hence end_rows + 1)
+    ext = np.insert(obs, end_rows + 1, next_obs[end_rows], axis=0)
+    n_seg = len(end_rows)
+    starts = np.concatenate(([0], end_rows[:-1] + 1))
+    new_segment = np.zeros(T + n_seg, bool)
+    new_segment[starts + np.arange(n_seg)] = True
+    dec = decompose_segmented_obs(ext, new_segment)
+    if dec is None:
+        return None
+    pool, ext_idx = dec
+    # obs row t sits at ext position t + (#pseudo-rows inserted
+    # before its segment)
+    seg_id = np.zeros(T, np.int64)
+    seg_id[1:] = np.cumsum(dones[:-1])
+    return pool, ext_idx[np.arange(T) + seg_id]
+
+
 def materialize_stacks_np(
     pool: np.ndarray, idx: np.ndarray, k: int
 ) -> np.ndarray:

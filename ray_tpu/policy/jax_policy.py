@@ -811,9 +811,23 @@ class JaxPolicy(Policy):
             ).inc()
             if defer_stats:
                 return stats
-            # One device→host transfer for all stats (individual
-            # float() conversions each pay a full device round trip).
-            stats = jax.device_get(stats)
+            if self.config.get("deferred_stats"):
+                # flag-gated one-call lag (docs/data_plane.md): hand
+                # back the PREVIOUS nest's stats — that program has
+                # long finished, so the fetch doesn't serialize on
+                # THIS dispatch and the per-call device round trip
+                # overlaps compute. The very first call has nothing
+                # lagged and returns only cur_lr.
+                prev = self.__dict__.get("_lagged_stats")
+                self.__dict__["_lagged_stats"] = stats
+                stats = (
+                    jax.device_get(prev) if prev is not None else None
+                )
+            else:
+                # One device→host transfer for all stats (individual
+                # float() conversions each pay a full device round
+                # trip).
+                stats = jax.device_get(stats)
         # per-stage timers: a call that traced pays compile; the rest
         # of this call's wall time is the step (device compute + stats
         # fetch). Exposed both as metrics series (utils.metrics) and on
@@ -836,10 +850,22 @@ class JaxPolicy(Policy):
             timer_histogram(
                 "ray_tpu_learner_compile_seconds"
             ).observe(compile_s)
+        if stats is None:  # deferred first call: nothing lagged yet
+            return {"cur_lr": self.coeff_values["lr"]}
         out = {k: float(v) for k, v in stats.items()}
         out.update(self.after_learn_on_batch(out))
         out["cur_lr"] = self.coeff_values["lr"]
         return out
+
+    def flush_deferred_stats(self) -> Dict[str, float]:
+        """Fetch (and clear) the stats handle a ``deferred_stats``
+        policy is still holding — call after the last learn step when
+        the final update's numbers matter."""
+        prev = self.__dict__.pop("_lagged_stats", None)
+        if prev is None:
+            return {}
+        stats = jax.device_get(prev)
+        return {k: float(v) for k, v in stats.items()}
 
     def learn_on_batch(self, samples: SampleBatch) -> Dict[str, Any]:
         """One full multi-epoch SGD update (reference
@@ -851,6 +877,11 @@ class JaxPolicy(Policy):
         batch, bsize = self.prepare_batch(samples)
         # the frame pool is replicated, not row-sharded
         frames = batch.pop(_FRAMES, None)
+        telemetry_metrics.add_h2d_bytes(
+            "learn",
+            sharding_lib.tree_nbytes(batch)
+            + (frames.nbytes if frames is not None else 0),
+        )
         t0 = _time.perf_counter()
         with tracing.start_span("learn:transfer", batch_size=bsize):
             dev = _tree_to_device(batch, self._data_sharding)
@@ -901,6 +932,34 @@ class JaxPolicy(Policy):
     # the device — for pixel envs that halves learner ingest bytes.
     _ship_next_obs: bool = True
 
+    def _td_input_tree(self, samples):
+        """Batch tree for the per-sample TD-error programs: a
+        device-resident replay sample is already the train tree (use
+        it in place — no D2H round trip); host SampleBatches convert
+        through ``_batch_to_train_tree``."""
+        if getattr(samples, "is_device_resident", False):
+            return samples.tree
+        return self._batch_to_train_tree(samples)
+
+    def replay_columns(self, samples: SampleBatch) -> Dict[str, np.ndarray]:
+        """Host column tree a device-resident replay buffer stores for
+        this policy (docs/data_plane.md): the learn program's
+        train-tree columns — same key selection and dtype casts as
+        ``learn_on_batch`` — WITHOUT the framestack transfer-format
+        dedup. A replay buffer stores rows, and randomly sampled rows
+        are not sliding windows; the pool format would be rejected by
+        the ring anyway (unequal column lengths)."""
+        missing = object()
+        prev = self.config.get("dedup_framestack", missing)
+        self.config["dedup_framestack"] = False
+        try:
+            return self._batch_to_train_tree(samples)
+        finally:
+            if prev is missing:
+                self.config.pop("dedup_framestack", None)
+            else:
+                self.config["dedup_framestack"] = prev
+
     def compress_for_shipping(self, batch: SampleBatch) -> SampleBatch:
         """Worker-side, after postprocessing, right before a fragment
         ships to the driver: replace stacked framestack observations
@@ -920,7 +979,11 @@ class JaxPolicy(Policy):
             return batch
         fixed = bool(self.config.get("_fixed_unrolls"))
         if not fixed and self._ship_next_obs:
-            return batch  # replay families read full NEXT_OBS
+            # replay families read full NEXT_OBS — pool it too
+            # (terminal stacks included) so the fragment still ships
+            # ~k× smaller and the driver rebuilds both columns
+            # byte-identically before replay insert
+            return self._compress_replay_shipping(batch)
         model = getattr(self, "model", None)  # bespoke-net policies
         if model is None or model.is_recurrent:
             return batch
@@ -957,6 +1020,51 @@ class JaxPolicy(Policy):
                 cols[_FRAME_IDX] = idx
                 return SampleBatch(cols)
         return batch
+
+    def _compress_replay_shipping(self, batch: SampleBatch) -> SampleBatch:
+        """Worker-side framestack dedup for the off-policy (replay)
+        path: OBS and NEXT_OBS pool together via
+        ``ops/framestack.compress_replay_obs`` — per-episode terminal
+        stacks ride as pseudo-rows, so ``materialize_fragment`` on the
+        driver rebuilds BOTH columns byte-identically (``obs[t] =
+        stack(idx[t])``, ``next_obs[t] = stack(idx[t]+1)``) before
+        rows enter the replay buffer."""
+        model = getattr(self, "model", None)  # bespoke-net policies
+        if model is None or model.is_recurrent:
+            return batch
+        obs = batch.get(SampleBatch.OBS)
+        if not (
+            isinstance(obs, np.ndarray)
+            and obs.ndim == 4
+            and 2 <= obs.shape[-1] <= 8
+            and SampleBatch.NEXT_OBS in batch
+        ):
+            return batch
+        from ray_tpu.ops.framestack import compress_replay_obs
+
+        dones = np.asarray(
+            batch[SampleBatch.TERMINATEDS], bool
+        ) | np.asarray(
+            batch.get(
+                SampleBatch.TRUNCATEDS,
+                np.zeros(batch.count, bool),
+            ),
+            bool,
+        )
+        dec = compress_replay_obs(
+            obs, np.asarray(batch[SampleBatch.NEXT_OBS]), dones
+        )
+        if dec is None:
+            return batch
+        pool, idx = dec
+        cols = {
+            k: v
+            for k, v in batch.items()
+            if k not in (SampleBatch.OBS, SampleBatch.NEXT_OBS)
+        }
+        cols[_FRAMES] = pool
+        cols[_FRAME_IDX] = idx
+        return SampleBatch(cols)
 
     def _maybe_dedup_framestack(
         self, tree: Dict[str, np.ndarray]

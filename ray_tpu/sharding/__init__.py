@@ -41,6 +41,7 @@ from ray_tpu.sharding.specs import (
     replicated,
     shard_batch,
     sharding_tree,
+    tree_nbytes,
 )
 
 
@@ -78,4 +79,5 @@ __all__ = [
     "sharded_jit",
     "sharding_tree",
     "simulated_device_env",
+    "tree_nbytes",
 ]

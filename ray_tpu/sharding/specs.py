@@ -70,6 +70,20 @@ def sharding_tree(tree, mesh: Mesh, replicate_keys: Iterable[str] = ()):
     return jax.tree_util.tree_map(lambda x: leaf_sharding(x, mesh), tree)
 
 
+def tree_nbytes(tree) -> int:
+    """Total array bytes of a (host or device) pytree — the H2D
+    payload accounting unit behind ``ray_tpu_h2d_bytes_total``
+    (telemetry/metrics.py): callers count a tree right before its
+    ``device_put`` so the counter reflects what actually crosses the
+    wire."""
+    return int(
+        sum(
+            int(getattr(x, "nbytes", 0))
+            for x in jax.tree_util.tree_leaves(tree)
+        )
+    )
+
+
 def shard_batch(
     tree,
     mesh: Mesh,

@@ -46,6 +46,14 @@ ITERATION_SECONDS = "ray_tpu_iteration_seconds"
 WORKER_RESTARTS_TOTAL = "ray_tpu_worker_restarts_total"
 RECOVERIES_TOTAL = "ray_tpu_recoveries_total"
 SKIPPED_BATCHES_TOTAL = "ray_tpu_skipped_batches_total"
+# device-resident data plane (docs/data_plane.md): host→device bytes
+# by path — feeder (pipelined transfer), learn (sync learn_on_batch /
+# stacked-chain transfer), replay_insert (each transition's ONE
+# crossing into a device-resident replay buffer)
+H2D_BYTES_TOTAL = "ray_tpu_h2d_bytes_total"
+REPLAY_ROWS = "ray_tpu_replay_buffer_rows"
+REPLAY_CAPACITY = "ray_tpu_replay_buffer_capacity"
+REPLAY_BYTES = "ray_tpu_replay_buffer_bytes"
 
 
 def gauge(
@@ -126,6 +134,61 @@ def inc_skipped_batches(n: int = 1) -> None:
         SKIPPED_BATCHES_TOTAL,
         "learn batches skipped by the non-finite guard",
     ).inc(float(n))
+
+
+def add_h2d_bytes(path: str, n: int) -> None:
+    """Host→device payload bytes about to cross the wire on ``path``
+    (``feeder`` | ``learn`` | ``replay_insert``). The byte diet of
+    docs/data_plane.md is read off this counter: a device-resident
+    replay run moves each transition once (``replay_insert``) instead
+    of once per learn step (``learn``)."""
+    if n <= 0:
+        return
+    counter(
+        H2D_BYTES_TOTAL,
+        "host to device payload bytes by transfer path",
+        ("path",),
+    ).inc(float(n), {"path": path})
+
+
+def set_replay_occupancy(
+    policy_id: str, rows: int, capacity: int, nbytes: int,
+    device: bool,
+) -> None:
+    """Occupancy of one replay buffer (device-resident or the host
+    spill fallback): stored rows, row capacity, and resident storage
+    bytes (for device buffers this is HBM/accelerator memory)."""
+    tags = {
+        "policy": policy_id,
+        "storage": "device" if device else "host",
+    }
+    gauge(
+        REPLAY_ROWS, "replay buffer stored rows", ("policy", "storage")
+    ).set(float(rows), tags)
+    gauge(
+        REPLAY_CAPACITY,
+        "replay buffer row capacity",
+        ("policy", "storage"),
+    ).set(float(capacity), tags)
+    gauge(
+        REPLAY_BYTES,
+        "replay buffer resident storage bytes",
+        ("policy", "storage"),
+    ).set(float(nbytes), tags)
+
+
+def h2d_bytes_by_path() -> Dict[str, float]:
+    """Current per-path totals of the H2D byte counter ({} before any
+    transfer). Algorithm.step diffs this across an iteration for the
+    ``info/telemetry`` byte roll-up."""
+    m = get_metric(H2D_BYTES_TOTAL)
+    if m is None:
+        return {}
+    out: Dict[str, float] = {}
+    for tags, v in m.series():
+        path = dict(tags).get("path", "")
+        out[path] = out.get(path, 0.0) + v
+    return out
 
 
 def counter_total(name: str) -> float:

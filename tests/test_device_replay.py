@@ -1,0 +1,416 @@
+"""Device-resident data plane tests (docs/data_plane.md): ring
+semantics, host/device bit-parity, prioritized replay with device
+rows, memory-cap spill, deferred-stats lag, checkpointing, and the
+off-policy framestack shipping compression."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from ray_tpu.data.sample_batch import SampleBatch
+from ray_tpu.execution.replay_buffer import (
+    DevicePrioritizedReplayBuffer,
+    DeviceReplayBuffer,
+    PrioritizedReplayBuffer,
+    ReplayBuffer,
+)
+
+
+def _tree(n, base, rng):
+    """Mixed-dtype column tree: float rows, packed uint8 pixels,
+    scalar column."""
+    return {
+        "obs": base + np.arange(n * 6, dtype=np.float32).reshape(n, 6),
+        "pix": rng.integers(0, 255, (n, 4, 4, 4), dtype=np.uint8),
+        "rewards": np.arange(n, dtype=np.float32) + base,
+    }
+
+
+def test_wraparound_insert_matches_host_ring():
+    """Inserts past capacity overwrite oldest rows, with the packed
+    uint8 lanes round-tripping exactly (capacity 10, 4 inserts of 4 =
+    16 rows → 6 wrapped)."""
+    rng = np.random.default_rng(0)
+    host = ReplayBuffer(capacity=10, seed=5)
+    dev = DeviceReplayBuffer(capacity=10, seed=5)
+    for i in range(4):
+        t = _tree(4, float(100 * i), rng)
+        host.add(SampleBatch(t))
+        dev.add_tree(t)
+    assert len(dev) == len(host) == 10
+    assert dev._idx == host._idx
+    assert dev.num_added == host.num_added == 16
+    full = jax.device_get(dev.gather(np.arange(10)).tree)
+    for k, col in host._cols.items():
+        assert np.array_equal(full[k], col), k
+
+
+def test_uniform_sample_bit_parity():
+    """Same seed → same index draws → bitwise-equal sampled rows on
+    both planes, across several interleaved add/sample rounds."""
+    rng = np.random.default_rng(1)
+    host = ReplayBuffer(capacity=32, seed=9)
+    dev = DeviceReplayBuffer(capacity=32, seed=9)
+    for i in range(6):
+        t = _tree(7, float(i), rng)
+        host.add(SampleBatch(t))
+        dev.add_tree(t)
+        if len(host) >= 8:
+            hs = host.sample(8)
+            ds = jax.device_get(dev.sample(8).tree)
+            for k in hs:
+                assert np.array_equal(np.asarray(hs[k]), ds[k]), k
+
+
+def test_prioritized_device_rows_and_priority_updates():
+    """The device PER draws the same indices/weights as the host ring
+    (shared sum-tree code), and priority updates through device rows
+    steer subsequent draws identically."""
+    rng = np.random.default_rng(2)
+    host = PrioritizedReplayBuffer(capacity=16, alpha=0.6, seed=4)
+    dev = DevicePrioritizedReplayBuffer(capacity=16, alpha=0.6, seed=4)
+    for i in range(3):
+        t = _tree(5, float(i), rng)
+        host.add(SampleBatch(t))
+        dev.add_tree(t)
+    hs = host.sample(8, beta=0.4)
+    ds = dev.sample(8, beta=0.4)
+    assert np.array_equal(hs["batch_indexes"], ds.indices)
+    dt = jax.device_get(ds.tree)
+    assert np.array_equal(hs["weights"], dt["weights"])
+    for k in ("obs", "pix", "rewards"):
+        assert np.array_equal(np.asarray(hs[k]), dt[k]), k
+    # skew priorities and confirm both planes shift identically
+    pri = np.linspace(0.1, 5.0, 8)
+    host.update_priorities(hs["batch_indexes"], pri)
+    dev.update_priorities(ds.indices, pri)
+    hs2 = host.sample(6, beta=0.4)
+    ds2 = dev.sample(6, beta=0.4)
+    assert np.array_equal(hs2["batch_indexes"], ds2.indices)
+    assert np.array_equal(
+        hs2["weights"], jax.device_get(ds2.tree)["weights"]
+    )
+
+
+def test_spill_fallback_on_memory_cap():
+    """A capacity × row-bytes projection over the cap lands in the
+    host ring: sampling returns host SampleBatches, the index stream
+    is unchanged (same generator object), and nothing errors."""
+    rng = np.random.default_rng(3)
+    ref = DeviceReplayBuffer(capacity=64, seed=11)  # fits
+    sp = DeviceReplayBuffer(
+        capacity=64, seed=11, memory_cap_bytes=1000
+    )
+    t = _tree(8, 0.0, rng)
+    ref.add_tree(dict(t))
+    sp.add_tree(dict(t))
+    assert not ref.spilled and sp.spilled
+    assert len(sp) == 8 and sp.num_added == 8
+    out = sp.sample(4)
+    assert isinstance(out, SampleBatch)
+    # identical draw to the non-spilled buffer (placement changed,
+    # sampling didn't)
+    dev_out = jax.device_get(ref.sample(4).tree)
+    for k in out:
+        assert np.array_equal(np.asarray(out[k]), dev_out[k]), k
+    # spilled state survives a checkpoint roundtrip
+    sp2 = DeviceReplayBuffer(
+        capacity=64, seed=11, memory_cap_bytes=1000
+    )
+    sp2.set_state(sp.get_state())
+    assert sp2.spilled and len(sp2) == 8
+
+
+def test_device_state_roundtrip_preserves_ring_layout():
+    rng = np.random.default_rng(4)
+    dev = DeviceReplayBuffer(capacity=12, seed=2)
+    for i in range(3):
+        dev.add_tree(_tree(5, float(i), rng))  # 15 rows → wrapped
+    state = dev.get_state()
+    dev2 = DeviceReplayBuffer(capacity=12, seed=2)
+    dev2.set_state(state)
+    assert (len(dev2), dev2._idx, dev2.num_added) == (
+        len(dev),
+        dev._idx,
+        dev.num_added,
+    )
+    a = jax.device_get(dev.gather(np.arange(12)).tree)
+    b = jax.device_get(dev2.gather(np.arange(12)).tree)
+    for k in a:
+        assert np.array_equal(a[k], b[k]), k
+
+
+def test_sac_device_vs_host_params_bit_identical():
+    """Acceptance: fixed-seed SAC learn results are bit-identical
+    between replay_device_resident on and off after several train
+    iterations (same rollouts, same index draws, same programs)."""
+    from ray_tpu.algorithms.sac import SACConfig
+
+    def run(device):
+        algo = (
+            SACConfig()
+            .environment("Pendulum-v1")
+            .rollouts(
+                num_rollout_workers=0, rollout_fragment_length=16
+            )
+            .training(
+                train_batch_size=32,
+                num_steps_sampled_before_learning_starts=32,
+                replay_device_resident=device,
+            )
+            .debugging(seed=0)
+            .build()
+        )
+        try:
+            for _ in range(3):
+                algo.train()
+            buf = algo.local_replay_buffer.buffers["default_policy"]
+            assert (
+                bool(getattr(buf, "is_device_resident", False))
+                is device
+            )
+            return jax.device_get(algo.get_policy().params)
+        finally:
+            algo.cleanup()
+
+    w_dev = run(True)
+    w_host = run(False)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(w_dev),
+        jax.tree_util.tree_leaves(w_host),
+    ):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_deferred_stats_lag_semantics():
+    """config["deferred_stats"]: call k returns the stats of call k-1
+    (the first call only cur_lr), flush drains the tail — and the
+    values match a blocking same-seed policy shifted by one call."""
+    import gymnasium as gym
+
+    from ray_tpu.algorithms.ppo.ppo import PPOJaxPolicy
+
+    def make(deferred):
+        return PPOJaxPolicy(
+            gym.spaces.Box(-10.0, 10.0, (8,), np.float32),
+            gym.spaces.Discrete(4),
+            {
+                "model": {"fcnet_hiddens": [16, 16]},
+                "train_batch_size": 32,
+                "sgd_minibatch_size": 32,
+                "num_sgd_iter": 1,
+                "lr": 1e-3,
+                "seed": 0,
+                "deferred_stats": deferred,
+                # neutralize PPO's adaptive kl coefficient: its host-
+                # side update runs one call late under the lag (the
+                # documented deferred-stats semantics), which would
+                # make the nests diverge from the blocking reference
+                # after the first call
+                "kl_coeff": 0.0,
+            },
+        )
+
+    rng = np.random.default_rng(0)
+    cols = {
+        SampleBatch.OBS: rng.standard_normal((32, 8)).astype(
+            np.float32
+        ),
+        SampleBatch.ACTIONS: rng.integers(0, 4, 32).astype(np.int64),
+        SampleBatch.ACTION_LOGP: np.full(32, -1.38, np.float32),
+        SampleBatch.ACTION_DIST_INPUTS: rng.standard_normal(
+            (32, 4)
+        ).astype(np.float32),
+        SampleBatch.ADVANTAGES: rng.standard_normal(32).astype(
+            np.float32
+        ),
+        SampleBatch.VALUE_TARGETS: rng.standard_normal(32).astype(
+            np.float32
+        ),
+    }
+    blocking = make(False)
+    lagged = make(True)
+    ref1 = blocking.learn_on_batch(SampleBatch(dict(cols)))
+    ref2 = blocking.learn_on_batch(SampleBatch(dict(cols)))
+
+    out1 = lagged.learn_on_batch(SampleBatch(dict(cols)))
+    assert "total_loss" not in out1  # nothing lagged yet
+    assert "cur_lr" in out1
+    out2 = lagged.learn_on_batch(SampleBatch(dict(cols)))
+    # call 2 reports call 1's nest — which equals the blocking
+    # policy's call 1 (identical seeds and batches)
+    assert out2["total_loss"] == ref1["total_loss"]
+    tail = lagged.flush_deferred_stats()
+    assert tail["total_loss"] == ref2["total_loss"]
+    assert lagged.flush_deferred_stats() == {}
+
+
+def test_dqn_checkpoint_roundtrip_with_device_buffer(tmp_path):
+    """Acceptance satellite: a device-resident replay buffer survives
+    Algorithm.save_checkpoint → restore — contents, ring position, and
+    counters intact on the restored device rings."""
+    from ray_tpu.algorithms.dqn import DQNConfig
+    from ray_tpu.execution.replay_buffer import DeviceReplayBuffer
+
+    cfg = (
+        DQNConfig()
+        .environment("CartPole-v1")
+        .rollouts(num_rollout_workers=0, rollout_fragment_length=16)
+        .training(
+            train_batch_size=32,
+            num_steps_sampled_before_learning_starts=32,
+            replay_device_resident=True,
+        )
+        .debugging(seed=0)
+    )
+    algo = cfg.build()
+    algo2 = None
+    try:
+        for _ in range(3):
+            algo.train()
+        buf = algo.local_replay_buffer.buffers["default_policy"]
+        assert isinstance(buf, DeviceReplayBuffer) and not buf.spilled
+        ckpt = algo.save(str(tmp_path / "dqn"))
+        algo2 = cfg.build()
+        algo2.restore(ckpt)
+        buf2 = algo2.local_replay_buffer.buffers["default_policy"]
+        assert isinstance(buf2, DeviceReplayBuffer) and not buf2.spilled
+        assert (len(buf2), buf2._idx, buf2.num_added) == (
+            len(buf),
+            buf._idx,
+            buf.num_added,
+        )
+        a = jax.device_get(buf.gather(np.arange(len(buf))).tree)
+        b = jax.device_get(buf2.gather(np.arange(len(buf2))).tree)
+        assert set(a) == set(b)
+        for k in a:
+            assert np.array_equal(a[k], b[k]), k
+        # the restored algorithm keeps training without re-warmup
+        result = algo2.train()
+        assert algo2._counters["num_env_steps_trained"] > 0
+    finally:
+        algo.cleanup()
+        if algo2 is not None:
+            algo2.cleanup()
+
+
+def _sliding_fragment(rng, k=4, H=8, W=8, segments=((5, True), (4, False))):
+    """Concatenated episode fragments of sliding-window stacks with
+    per-row next_obs (terminal stacks included)."""
+    obs_l, nxt_l, dones_l = [], [], []
+    for T, done in segments:
+        frames = rng.integers(0, 255, (T + k, H, W, 1), np.uint8)
+        obs_l.append(
+            np.stack(
+                [
+                    np.concatenate(
+                        [frames[t + j] for j in range(k)], -1
+                    )
+                    for t in range(T)
+                ]
+            )
+        )
+        nxt_l.append(
+            np.stack(
+                [
+                    np.concatenate(
+                        [frames[t + 1 + j] for j in range(k)], -1
+                    )
+                    for t in range(T)
+                ]
+            )
+        )
+        d = np.zeros(T, bool)
+        d[-1] = done
+        dones_l.append(d)
+    return (
+        np.concatenate(obs_l),
+        np.concatenate(nxt_l),
+        np.concatenate(dones_l),
+    )
+
+
+def test_offpolicy_compress_shipping_byte_identical():
+    """The off-policy worker-side framestack compression
+    (compress_for_shipping → compress_replay_obs) decompresses
+    byte-identically — OBS and NEXT_OBS, including each interior
+    episode's terminal stack."""
+    import gymnasium as gym
+
+    from ray_tpu.algorithms.dqn.dqn import DQNJaxPolicy
+    from ray_tpu.ops.framestack import (
+        FRAMES,
+        FRAME_IDX,
+        materialize_fragment,
+    )
+
+    rng = np.random.default_rng(7)
+    obs, nxt, dones = _sliding_fragment(rng)
+    n = obs.shape[0]
+    policy = DQNJaxPolicy(
+        gym.spaces.Box(0, 255, (8, 8, 4), np.uint8),
+        gym.spaces.Discrete(3),
+        {
+            "model": {
+                "conv_filters": [[8, [4, 4], [2, 2]]],
+                "post_fcnet_hiddens": [16],
+            },
+            "seed": 0,
+        },
+    )
+    batch = SampleBatch(
+        {
+            SampleBatch.OBS: obs,
+            SampleBatch.NEXT_OBS: nxt,
+            SampleBatch.ACTIONS: rng.integers(0, 3, n).astype(
+                np.int64
+            ),
+            SampleBatch.REWARDS: rng.standard_normal(n).astype(
+                np.float32
+            ),
+            SampleBatch.TERMINATEDS: dones,
+        }
+    )
+    shipped = policy.compress_for_shipping(batch)
+    assert FRAMES in shipped and FRAME_IDX in shipped
+    assert SampleBatch.OBS not in shipped
+    # pool is smaller than ONE of the two stacked columns it replaces
+    assert shipped[FRAMES].nbytes < obs.nbytes
+    cols = materialize_fragment(dict(shipped), k=4)
+    assert np.array_equal(cols[SampleBatch.OBS], obs)
+    assert np.array_equal(cols[SampleBatch.NEXT_OBS], nxt)
+    # non-obs columns ride through untouched
+    assert np.array_equal(
+        cols[SampleBatch.REWARDS], batch[SampleBatch.REWARDS]
+    )
+
+
+def test_h2d_byte_counters():
+    """ray_tpu_h2d_bytes_total{path=replay_insert} counts exactly the
+    canonicalized host bytes of each insert; the replay occupancy
+    gauges track rows/capacity/bytes."""
+    from ray_tpu.telemetry import metrics as telemetry_metrics
+    from ray_tpu.utils.metrics import get_metric
+
+    def path_total(path):
+        return telemetry_metrics.h2d_bytes_by_path().get(path, 0.0)
+
+    rng = np.random.default_rng(8)
+    before = path_total("replay_insert")
+    dev = DeviceReplayBuffer(capacity=16, seed=0, label="h2d_test")
+    t = _tree(4, 0.0, rng)
+    dev.add_tree(t)
+    expect = sum(v.nbytes for v in t.values())
+    assert path_total("replay_insert") - before == expect
+    rows = get_metric(telemetry_metrics.REPLAY_ROWS)
+    assert any(
+        dict(k).get("policy") == "h2d_test" and v == 4.0
+        for k, v in rows.series()
+    )
+    nbytes = get_metric(telemetry_metrics.REPLAY_BYTES)
+    assert any(
+        dict(k).get("policy") == "h2d_test"
+        and v == dev.storage_bytes
+        for k, v in nbytes.series()
+    )
