@@ -186,6 +186,9 @@ class Algorithm(Trainable):
         t0 = time.time()
         self._iteration_marks.append(t0)
         learn_before = telemetry_lib.metrics.learn_steps_total()
+        superstep_before = telemetry_lib.metrics.counter_total(
+            telemetry_lib.metrics.SUPERSTEP_UPDATES_TOTAL
+        )
         h2d_before = telemetry_lib.metrics.h2d_bytes_by_path()
         results: Dict[str, Any] = {}
         train_info: Dict[str, Any] = {}
@@ -288,11 +291,33 @@ class Algorithm(Trainable):
                 p: h2d_after.get(p, 0.0) - h2d_before.get(p, 0.0)
                 for p in set(h2d_after) | set(h2d_before)
             }
+            learn_delta = (
+                telemetry_lib.metrics.learn_steps_total()
+                - learn_before
+            )
+            superstep_delta = (
+                telemetry_lib.metrics.counter_total(
+                    telemetry_lib.metrics.SUPERSTEP_UPDATES_TOTAL
+                )
+                - superstep_before
+            )
             results["info"]["telemetry"] = {
                 **rollup,
                 **throughput,
                 **runtime_vals,
                 "h2d_bytes": {**h2d, "total": sum(h2d.values())},
+                # superstep contract (docs/data_plane.md): how many of
+                # this iteration's learner updates rode a fused
+                # K-per-dispatch program
+                "superstep": {
+                    "updates": superstep_delta,
+                    "learn_steps": learn_delta,
+                    "fused_fraction": (
+                        superstep_delta / learn_delta
+                        if learn_delta
+                        else 0.0
+                    ),
+                },
             }
         self._prev_iter_window = (t0, t_train_end)
         results.update(self._collect_rollout_metrics())

@@ -104,6 +104,21 @@ class AlgorithmConfig:
         # bit-identical either way.
         self.replay_device_resident = "auto"
         self.replay_memory_cap_bytes = None
+        # On-device training superstep (docs/data_plane.md): one
+        # driver dispatch = K learner updates, uniformly across the
+        # learner path (DQN-family chained updates incl. prioritized
+        # replay, PPO's prefetch loop, IMPALA's learner thread). The
+        # whole K-update chain — weights threaded through a lax.scan
+        # carry, device-replay batches gathered in place, stats (and
+        # PER priorities) drained as one stacked readback — runs as
+        # ONE compiled program, so per-dispatch overhead amortizes
+        # 1/K. "auto" (default) resolves to K=8 behind a real
+        # accelerator boundary and off on the CPU client (mirroring
+        # replay_device_resident); an int forces that K anywhere.
+        # Fixed-seed results are bit-identical to K individual learn
+        # calls (host-side stat reactions lag the chain — staleness
+        # semantics in docs/data_plane.md).
+        self.superstep = "auto"
         # Defer the learner's stats readback by one call: learn
         # returns right after the SGD nest is dispatched and fetches
         # the PREVIOUS call's stats (long finished) instead of
@@ -246,12 +261,13 @@ class AlgorithmConfig:
         replay_device_resident=None,
         replay_memory_cap_bytes: Optional[int] = None,
         deferred_stats: Optional[bool] = None,
+        superstep=None,
         **kwargs,
     ) -> "AlgorithmConfig":
         """``replay_device_resident`` / ``replay_memory_cap_bytes`` /
-        ``deferred_stats``: the device-resident data-plane knobs
-        (docs/data_plane.md) — see the attribute comments in
-        ``__init__``."""
+        ``deferred_stats`` / ``superstep``: the device-resident
+        data-plane knobs (docs/data_plane.md) — see the attribute
+        comments in ``__init__``."""
         if gamma is not None:
             self.gamma = gamma
         if lr is not None:
@@ -272,6 +288,8 @@ class AlgorithmConfig:
             self.replay_memory_cap_bytes = int(replay_memory_cap_bytes)
         if deferred_stats is not None:
             self.deferred_stats = bool(deferred_stats)
+        if superstep is not None:
+            self.superstep = superstep
         for k, v in kwargs.items():
             setattr(self, k, v)
         return self

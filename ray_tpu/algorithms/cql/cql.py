@@ -77,7 +77,10 @@ class CQLJaxPolicy(SACJaxPolicy):
             self.aux_state, self._param_sharding
         )
 
-    def _build_learn_fn(self, batch_size: int):
+    def _device_update_fn(self, batch_size=None, with_frames=False):
+        """CQL's own single-update body: the generic superstep scans
+        THIS (min-Q penalty included), so chained CQL updates fuse
+        correctly — the legacy SAC stacked path never could."""
         actor, critic = self.actor, self.critic
         tx_a, tx_c, tx_al = (
             self._tx_actor,
@@ -286,26 +289,7 @@ class CQLJaxPolicy(SACJaxPolicy):
             )
             return new_params, new_opt, new_aux, stats
 
-        sharded = jax.shard_map(
-            device_fn,
-            mesh=mesh,
-            in_specs=(P(), P(), P(), P(axis), P(), P()),
-            out_specs=(P(), P(), P(), P()),
-        )
-        label = f"learn[{type(self).__name__}:{batch_size}]"
-        if self.sharding_backend == "mesh":
-            rep = self._param_sharding
-            dat = self._data_sharding
-            return sharding_lib.sharded_jit(
-                sharded,
-                in_specs=(rep, rep, rep, dat, rep, rep),
-                out_specs=(rep, rep, rep, rep),
-                donate_argnums=(1,),
-                label=label,
-            )
-        return sharding_lib.sharded_jit(
-            sharded, donate_argnums=(1,), label=label
-        )
+        return device_fn
 
 
 class CQL(SAC):

@@ -89,7 +89,10 @@ class CRRJaxPolicy(SACJaxPolicy):
             self._param_sharding,
         )
 
-    def _build_learn_fn(self, batch_size: int):
+    def _device_update_fn(self, batch_size=None, with_frames=False):
+        """CRR's own single-update body: the generic superstep scans
+        THIS (weighted-regression actor loss included), so chained CRR
+        updates fuse correctly."""
         actor, critic = self.actor, self.critic
         tx_a, tx_c = self._tx_actor, self._tx_critic
         gamma = self.gamma**self.n_step
@@ -229,26 +232,7 @@ class CRRJaxPolicy(SACJaxPolicy):
             )
             return new_params, new_opt, new_aux, stats
 
-        sharded = jax.shard_map(
-            device_fn,
-            mesh=mesh,
-            in_specs=(P(), P(), P(), P(axis), P(), P()),
-            out_specs=(P(), P(), P(), P()),
-        )
-        label = f"learn[{type(self).__name__}:{batch_size}]"
-        if self.sharding_backend == "mesh":
-            rep = self._param_sharding
-            dat = self._data_sharding
-            return sharding_lib.sharded_jit(
-                sharded,
-                in_specs=(rep, rep, rep, dat, rep, rep),
-                out_specs=(rep, rep, rep, rep),
-                donate_argnums=(1,),
-                label=label,
-            )
-        return sharding_lib.sharded_jit(
-            sharded, donate_argnums=(1,), label=label
-        )
+        return device_fn
 
 
 class CRR(SAC):

@@ -318,7 +318,10 @@ class DDPGJaxPolicy(JaxPolicy):
             rewards + gamma_n * not_done * target_q
         )
 
-    def _build_learn_fn(self, batch_size: int):
+    def _device_update_fn(self, batch_size=None, with_frames=False):
+        """Single-update device body (shard_map), shared by the
+        per-call learn program and the generic superstep scan
+        (``JaxPolicy.learn_superstep``)."""
         actor, critic = self.actor, self.critic
         tx_a, tx_c = self._tx_actor, self._tx_critic
         tau = self.tau
@@ -440,26 +443,33 @@ class DDPGJaxPolicy(JaxPolicy):
             )
             return new_params, new_opt, new_aux, stats
 
-        sharded = jax.shard_map(
-            device_fn,
-            mesh=mesh,
-            in_specs=(P(), P(), P(), P(axis), P(), P()),
-            out_specs=(P(), P(), P(), P()),
+        return device_fn
+
+    def _build_learn_fn(self, batch_size: int):
+        return self._wrap_update_program(
+            self._device_update_fn(batch_size), batch_size
         )
-        label = f"learn[{type(self).__name__}:{batch_size}]"
-        if self.sharding_backend == "mesh":
-            rep = self._param_sharding
-            dat = self._data_sharding
-            return sharding_lib.sharded_jit(
-                sharded,
-                in_specs=(rep, rep, rep, dat, rep, rep),
-                out_specs=(rep, rep, rep, rep),
-                donate_argnums=(1,),
-                label=label,
-            )
-        return sharding_lib.sharded_jit(
-            sharded, donate_argnums=(1,), label=label
+
+    # -- superstep contract (JaxPolicy.learn_superstep) ------------------
+
+    @property
+    def supports_superstep(self) -> bool:
+        return (
+            not self._superstep_opt_out
+            and self.sharding_backend == "mesh"
+            and type(self)._build_learn_fn
+            is DDPGJaxPolicy._build_learn_fn
         )
+
+    def _learn_coeffs(self):
+        return {}
+
+    def _updates_per_learn_call(self, batch_size: int) -> int:
+        return 1
+
+    @property
+    def _td_refresh_uses_rng(self) -> bool:
+        return True  # target-policy smoothing noise
 
     def learn_on_device_batch(
         self, dev_batch, batch_size: int, *, defer_stats: bool = False
@@ -485,20 +495,25 @@ class DDPGJaxPolicy(JaxPolicy):
             stats = jax.device_get(stats)
         return {k: float(v) for k, v in stats.items()}
 
+    def _td_error_device_fn(self):
+        """Signed per-sample TD error — shared by ``compute_td_error``
+        and the superstep's in-scan prioritized refresh."""
+
+        def fn(params, aux, batch, rng):
+            td_target = self._td_targets(params, aux, batch, rng)
+            q1, _ = self.critic.apply(
+                params["critic"],
+                batch[SampleBatch.OBS].astype(jnp.float32),
+                batch[SampleBatch.ACTIONS].astype(jnp.float32),
+            )
+            return q1 - td_target
+
+        return fn
+
     def compute_td_error(self, samples) -> np.ndarray:
         """Per-sample |TD error| for prioritized replay."""
         if not hasattr(self, "_td_error_fn"):
-
-            def fn(params, aux, batch, rng):
-                td_target = self._td_targets(params, aux, batch, rng)
-                q1, _ = self.critic.apply(
-                    params["critic"],
-                    batch[SampleBatch.OBS].astype(jnp.float32),
-                    batch[SampleBatch.ACTIONS].astype(jnp.float32),
-                )
-                return q1 - td_target
-
-            self._td_error_fn = jax.jit(fn)
+            self._td_error_fn = jax.jit(self._td_error_device_fn())
         batch = self._td_input_tree(samples)
         self._rng, rng = jax.random.split(self._rng)
         td = self._td_error_fn(self.params, self.aux_state, batch, rng)

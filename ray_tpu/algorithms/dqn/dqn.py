@@ -454,16 +454,33 @@ class DQNJaxPolicy(JaxPolicy):
         }
         return loss, stats
 
+    @property
+    def _td_refresh_uses_rng(self) -> bool:
+        # the priority pass consumes a host rng split only under
+        # NoisyNet (compute_td_error's split discipline)
+        return bool(self.config.get("noisy"))
+
+    def _td_error_device_fn(self):
+        """Signed per-sample TD error — shared by ``compute_td_error``
+        and the superstep's in-scan prioritized refresh. Non-noisy
+        configs ignore the rng argument (the per-update path passes
+        None there; the in-scan caller a dummy key)."""
+        noisy = bool(self.config.get("noisy"))
+
+        def fn(params, aux, batch, rng):
+            td, _, _ = self._td_error(
+                params, aux, batch, rng if noisy else None
+            )
+            return td
+
+        return fn
+
     def compute_td_error(self, samples) -> np.ndarray:
         """Per-sample |TD error| for prioritized-replay updates, aligned
         with the rows of ``samples`` (pre-tiling/trim: uses a plain jit
         forward, not the sharded nest)."""
         if not hasattr(self, "_td_error_fn"):
-            def fn(params, aux, batch, rng):
-                td, _, _ = self._td_error(params, aux, batch, rng)
-                return td
-
-            self._td_error_fn = jax.jit(fn)
+            self._td_error_fn = jax.jit(self._td_error_device_fn())
         batch = self._td_input_tree(samples)
         # NoisyNet: sample weight noise for the priority pass too, so
         # priorities are computed under the same training-mode network
@@ -559,13 +576,40 @@ class DQN(Algorithm):
             self._counters[NUM_ENV_STEPS_TRAINED] += b.count
         return train_info
 
-    def _chained_updates(self, updates: int) -> Dict:
-        """``updates`` uniform-replay SGD rounds back to back. For
-        two-phase JaxPolicy policies the stats fetches defer, so the
-        programs queue on-device and the per-dispatch tunnel latency
-        amortizes across the chain (the training_intensity analog of
-        the async learner thread's pipelining); bounded lag keeps
-        device memory in check. Others loop learn_on_batch."""
+    def _resolve_superstep_k(self) -> int:
+        """K of the fused superstep contract for this run
+        (sharding.superstep.resolve_superstep, cached)."""
+        k = self.__dict__.get("_superstep_k")
+        if k is None:
+            from ray_tpu.sharding.superstep import resolve_superstep
+
+            k = self._superstep_k = resolve_superstep(
+                self.config, self.config.get("_mesh")
+            )
+        return k
+
+    def _chained_updates(
+        self,
+        updates: int,
+        prioritized: bool = False,
+        beta: float = 0.4,
+    ) -> Dict:
+        """``updates`` replay SGD rounds back to back.
+
+        With the superstep contract resolved on (``config.superstep``,
+        docs/data_plane.md), full windows of K updates run as ONE
+        compiled program per policy: one dispatch, one stats readback,
+        device-replay rows gathered in place by the scan — the uniform
+        generalization of what used to be a SAC-only stacked path.
+        Prioritized replay chains here too (per-update ``|td|``
+        refresh ships back as one stacked D2H, applied in update
+        order; draws within a window see priorities as of window
+        start — the documented staleness). The remainder (and policies
+        whose programs can't ride the scan) falls back to per-update
+        dispatch with deferred stats, so the programs still queue
+        on-device and the per-dispatch latency amortizes across the
+        chain; bounded lag keeps device memory in check. Others loop
+        learn_on_batch."""
         import jax
 
         from ray_tpu import sharding as sharding_lib
@@ -575,83 +619,64 @@ class DQN(Algorithm):
         config = self.config
         train_info: Dict = {}
 
-        # Fused path: policies that chain updates device-side
-        # (learn_on_stacked_batch: lax.scan over k updates in ONE
-        # program) get all k batches in a single vectorized replay
-        # gather and a single dispatch — on a tunneled TPU this turns
-        # k round trips into one.
         pols = {
             pid: self.get_policy(pid)
             for pid in self.workers.local_worker().policy_map
         }
         bs = int(config["train_batch_size"])
-        if updates > 1 and all(
-            getattr(p, "supports_stacked_learn", False)
-            # stacked dispatch skips prepare_batch's trim/tile, so the
+        K = self._resolve_superstep_k()
+        left = updates
+        if K > 1 and all(
+            getattr(p, "supports_superstep", False)
+            # the superstep skips prepare_batch's trim/tile, so the
             # per-update batch must already divide the data shards
             and bs % max(1, getattr(p, "n_shards", 1)) == 0
             for p in pols.values()
         ):
-            pend = self._pending_stats = getattr(
-                self, "_pending_stats", []
+            from ray_tpu.execution.train_ops import (
+                superstep_train_replay,
             )
 
-            def drain_oldest():
-                old_pid, old = pend.pop(0)
-                st = jax.device_get(old)
-                train_info[old_pid] = {
-                    kk: float(v) for kk, v in st.items()
-                }
-
-            left = updates
-            while left > 0:
-                # 32 bounds per-dispatch batch memory; the buffer-size
-                # clamp keeps the k*bs gather inside what the buffer
-                # holds early in training; rounding k down to a power
-                # of two caps the distinct (bs, k) scan compilations
-                # at 6 while the buffer warms up (each is a full XLA
-                # compile — seconds on a tunneled TPU)
-                k = min(
-                    left,
-                    32,
-                    max(1, len(self.local_replay_buffer) // bs),
-                )
-                k = 1 << (k.bit_length() - 1)
-                left -= k
-                train_batch = self.local_replay_buffer.sample(k * bs)
-                for pid, b in train_batch.policy_batches.items():
-                    policy = pols[pid]
-                    # device-resident samples ARE the train tree
-                    # (reshape is a device-side view; no transfer)
-                    tree = (
-                        b.tree
-                        if getattr(b, "is_device_resident", False)
-                        else policy._batch_to_train_tree(b)
+            while left >= K:
+                fused = False
+                for pid, policy in pols.items():
+                    buf = self.local_replay_buffer.buffers.get(pid)
+                    if buf is None or len(buf) < bs:
+                        continue
+                    info = superstep_train_replay(
+                        self,
+                        policy,
+                        buf,
+                        K,
+                        K,
+                        bs,
+                        prioritized=prioritized,
+                        beta=beta,
                     )
-                    stacked = {
-                        c: v.reshape((k, bs) + v.shape[1:])
-                        for c, v in tree.items()
-                    }
-                    # stats defer ACROSS rounds (bounded lag): the
-                    # host never blocks on the chain it just issued,
-                    # so replay gather + rollout collect of round
-                    # r+1 overlap the device compute of round r
-                    lazy = policy.learn_on_stacked_batch(
-                        stacked, k, bs, defer_stats=True
-                    )
-                    pend.append((pid, lazy))
-                    while len(pend) > 2:
-                        drain_oldest()
-                    self._counters[NUM_ENV_STEPS_TRAINED] += b.count
-            if not train_info and pend:
-                # first rounds of the pipeline: block on the oldest
-                # chain so train() never reports an empty learner dict
-                # (the remaining 1-2 stay deferred — the cross-round
-                # overlap survives)
-                drain_oldest()
+                    if info is None:
+                        # frame-pool/ragged batches: this run can't
+                        # ride the scan — per-update path from here on
+                        self._superstep_k = 1
+                        break
+                    fused = True
+                    train_info[pid] = info
+                    self._counters[NUM_ENV_STEPS_TRAINED] += K * bs
+                if not fused or self._superstep_k == 1:
+                    if fused:
+                        left -= K
+                    break
+                left -= K
+        if left <= 0:
+            return train_info
+        if prioritized:
+            # leftover prioritized updates keep the classic
+            # sample → learn → refresh cadence
+            for _ in range(left):
+                info = self._single_update(True, {"beta": beta})
+                train_info.update(info)
             return train_info
 
-        for _ in range(updates):
+        for _ in range(left):
             train_batch = self.local_replay_buffer.sample(
                 config["train_batch_size"]
             )
@@ -814,14 +839,20 @@ class DQN(Algorithm):
             # role): desired trained-steps : sampled-steps ratio. The
             # natural ratio of one update per round is
             # train_batch/rollout; a higher intensity runs MULTIPLE
-            # replay updates per round — chained with deferred stats so
-            # consecutive SGD programs pipeline on-device and the
-            # per-dispatch latency (dominant on a tunneled TPU)
-            # amortizes across the chain. PER keeps the one-update
-            # path: priorities must refresh between samples.
+            # replay updates per round — fused K-per-dispatch under
+            # the superstep contract, per-update with deferred stats
+            # otherwise, so either way consecutive SGD programs
+            # pipeline on-device and the per-dispatch latency
+            # (dominant on a tunneled TPU) amortizes. PER joins the
+            # chain only under a superstep (its stacked priority
+            # refresh keeps the update-order tree writes); without
+            # one, priorities must refresh between samples, so PER
+            # keeps the one-update path.
             updates = 1
             ti = config.get("training_intensity")
-            if ti and not prioritized:
+            if ti and (
+                not prioritized or self._resolve_superstep_k() > 1
+            ):
                 self._training_debt = (
                     getattr(self, "_training_debt", 0.0)
                     + batch.env_steps() * float(ti)
@@ -833,7 +864,11 @@ class DQN(Algorithm):
                     updates * config["train_batch_size"]
                 )
             if updates > 1:
-                train_info = self._chained_updates(updates)
+                train_info = self._chained_updates(
+                    updates,
+                    prioritized=prioritized,
+                    beta=kwargs.get("beta", 0.4),
+                )
             elif updates == 1:
                 train_info = self._single_update(prioritized, kwargs)
             # updates == 0: debt still accruing — sample-only round

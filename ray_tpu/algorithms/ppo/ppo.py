@@ -260,13 +260,48 @@ class PPO(Algorithm):
             and not self.config.get("policies")
         )
 
+    def _resolve_superstep_k(self) -> int:
+        """K of the fused superstep contract for the prefetch loop
+        (docs/data_plane.md): one training_step consumes K prefetched
+        device batches as ONE compiled K-update program. Resolved once
+        (sharding.superstep.resolve_superstep) and demoted to 1 when
+        the policy can't ride the scan."""
+        k = self.__dict__.get("_superstep_k")
+        if k is None:
+            from ray_tpu.sharding.superstep import resolve_superstep
+
+            k = resolve_superstep(
+                self.config, self.config.get("_mesh")
+            )
+            if k > 1 and not getattr(
+                self.get_policy(), "supports_superstep", False
+            ):
+                k = 1
+            self._superstep_k = k
+        return k
+
     def _build_sample_pipeline(self) -> None:
         from ray_tpu.execution.device_feed import DeviceFeeder
         from ray_tpu.execution.rollout_ops import SamplePrefetcher
 
         policy = self.get_policy()
-        depth = max(1, int(self.config.get("sample_prefetch") or 1))
+        depth = max(
+            1,
+            int(self.config.get("sample_prefetch") or 1),
+            self._resolve_superstep_k(),
+        )
         feeder = DeviceFeeder(policy.batch_shardings, capacity=depth)
+        # fixed-row contract for stacking: a superstep scans K batches
+        # of identical shape, so prefetched trees trim to the largest
+        # div-multiple at or under train_batch_size (prepare_batch
+        # already guarantees ≥ that many rows — the prefetcher
+        # collects at least train_batch_size steps)
+        div = max(1, policy.n_shards) * max(
+            1, getattr(policy, "_unroll_T", 1)
+        )
+        fixed_rows = (
+            int(self.config["train_batch_size"]) // div
+        ) * div
 
         def deliver(batch):
             # runs on the prefetch thread, overlapping the SGD nest:
@@ -287,6 +322,24 @@ class PPO(Algorithm):
                     self._recovery.note_skipped_batch()
                     return
             tree, bsize = policy.prepare_batch(batch)
+            if self._superstep_k > 1 and fixed_rows > 0:
+                from ray_tpu.ops.framestack import FRAMES as _FRAMES
+
+                if _FRAMES in tree:
+                    # frame-pool batches have per-batch pool sizes and
+                    # can't stack — this run falls back to per-update
+                    self._superstep_k = 1
+                elif bsize > fixed_rows:
+                    T = max(1, getattr(policy, "_unroll_T", 1))
+                    tree = {
+                        c: (
+                            v[: fixed_rows // T]
+                            if c.startswith("__chunk__")
+                            else v[:fixed_rows]
+                        )
+                        for c, v in tree.items()
+                    }
+                    bsize = fixed_rows
             feeder.put(tree, (bsize, batch.env_steps(), batch.count))
 
         self._prefetch_feeder = feeder
@@ -301,6 +354,33 @@ class PPO(Algorithm):
             ),
         )
 
+    def _next_prefetched(self):
+        """Block for the next prefetched device batch, keeping the
+        pipeline healthy (dead-worker recovery) while waiting."""
+        import time as _time
+
+        from ray_tpu.util import tracing
+
+        pipe = self._sample_pipeline
+        t_wait0 = _time.time()
+        while True:
+            if not pipe.healthy():
+                raise pipe.error or RuntimeError(
+                    "sample pipeline thread died"
+                )
+            self._recover_pipeline_workers(pipe)
+            try:
+                item = self._prefetch_feeder.get(timeout=1.0)
+                break
+            except queue.Empty:
+                continue
+        # how long the learner sat starved waiting on the pipeline —
+        # ~0 when the prefetch overlap is doing its job
+        tracing.record_span(
+            "learner:queue_wait", t_wait0, _time.time()
+        )
+        return item
+
     def _training_step_prefetch(self) -> Dict:
         from ray_tpu.execution.train_ops import (
             NUM_AGENT_STEPS_TRAINED,
@@ -310,33 +390,87 @@ class PPO(Algorithm):
         if self._sample_pipeline is None:
             self._build_sample_pipeline()
         pipe = self._sample_pipeline
-        import time as _time
 
-        from ray_tpu.util import tracing
+        dev, (bsize, env_steps, rows) = self._next_prefetched()
+        policy = self.get_policy()
 
-        t_wait0 = _time.time()
-        while True:
-            if not pipe.healthy():
-                raise pipe.error or RuntimeError(
-                    "sample pipeline thread died"
+        K = self._resolve_superstep_k()
+        if K > 1:
+            # superstep over prefetched device batches: one
+            # training_step = one dispatch = K updates, zero H2D here
+            # (the feeder already moved each batch; the stacker is a
+            # device-side reshuffle). Host-side KL adaptation applies
+            # to the drained per-update stats in order — one chain of
+            # staleness, documented in docs/data_plane.md.
+            batches = [(dev, bsize, env_steps, rows)]
+            while len(batches) < K:
+                d2, (b2, e2, r2) = self._next_prefetched()
+                batches.append((d2, b2, e2, r2))
+            sizes = {b[1] for b in batches}
+            if len(sizes) == 1:
+                from ray_tpu import sharding as sharding_lib
+
+                stack_fn = self.__dict__.get("_superstep_stack_fn")
+                if stack_fn is None:
+                    stack_fn = self._superstep_stack_fn = (
+                        sharding_lib.build_stack_fn(
+                            policy.mesh,
+                            K,
+                            label=f"superstep_stack[{K}]",
+                        )
+                    )
+                stacked = stack_fn(*[b[0] for b in batches])
+                infos, _, skipped = policy.learn_superstep(
+                    K, bsize, stacked=dict(stacked), k_max=K
                 )
-            self._recover_pipeline_workers(pipe)
-            try:
-                dev, (bsize, env_steps, rows) = (
-                    self._prefetch_feeder.get(timeout=1.0)
+                for i, info_i in enumerate(infos):
+                    info_i.update(
+                        policy.after_learn_on_batch(info_i)
+                    )
+                info = infos[-1]
+                info["cur_lr"] = policy.coeff_values.get("lr")
+                for s in skipped:
+                    if s:
+                        self._counters[
+                            "num_nan_batches_skipped"
+                        ] += 1
+                        self._recovery.note_skipped_batch()
+                for _, b2, e2, r2 in batches:
+                    self._counters[NUM_ENV_STEPS_SAMPLED] += e2
+                    self._counters[NUM_AGENT_STEPS_SAMPLED] += e2
+                    self._counters[NUM_ENV_STEPS_TRAINED] += e2
+                    self._counters[NUM_AGENT_STEPS_TRAINED] += r2
+                self.workers.sync_weights(
+                    global_vars={
+                        "timestep": self._counters[
+                            NUM_ENV_STEPS_SAMPLED
+                        ]
+                    }
                 )
-                break
-            except queue.Empty:
-                continue
-        # how long the learner sat starved waiting on the pipeline —
-        # ~0 when the prefetch overlap is doing its job
-        tracing.record_span(
-            "learner:queue_wait", t_wait0, _time.time()
-        )
+                if self.config.get("observation_filter") not in (
+                    None,
+                    "NoFilter",
+                ):
+                    self.workers.sync_filters()
+                self._recover_pipeline_workers(pipe)
+                return {
+                    DEFAULT_POLICY_ID: info,
+                    "sample_pipeline": pipe.stats(),
+                }
+            # ragged sizes (shouldn't happen under the fixed-row
+            # contract): learn the collected batches per-update, in
+            # arrival order; the last falls through to the common path
+            for d2, b2, e2, r2 in batches[:-1]:
+                self._counters[NUM_ENV_STEPS_SAMPLED] += e2
+                self._counters[NUM_AGENT_STEPS_SAMPLED] += e2
+                policy.learn_on_device_batch(d2, b2)
+                self._counters[NUM_ENV_STEPS_TRAINED] += e2
+                self._counters[NUM_AGENT_STEPS_TRAINED] += r2
+            dev, bsize, env_steps, rows = batches[-1]
+
         self._counters[NUM_ENV_STEPS_SAMPLED] += env_steps
         self._counters[NUM_AGENT_STEPS_SAMPLED] += env_steps
 
-        policy = self.get_policy()
         info = policy.learn_on_device_batch(dev, bsize)
         self._counters[NUM_ENV_STEPS_TRAINED] += env_steps
         self._counters[NUM_AGENT_STEPS_TRAINED] += rows

@@ -201,8 +201,10 @@ class RNNSACJaxPolicy(SACJaxPolicy):
     are stacked fixed-length sequences (leading dim = sequence)."""
 
     # sequence batches carry per-chunk recurrent state; keep the
-    # one-update-per-dispatch path
+    # one-update-per-dispatch path (legacy stacked chain AND the
+    # generic superstep)
     supports_stacked_learn = False
+    _superstep_opt_out = True
 
     def _make_nets(self, pm_cfg, qm_cfg):
         actor = _RNNActorNet(

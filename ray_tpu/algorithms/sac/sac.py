@@ -323,9 +323,12 @@ class SACJaxPolicy(JaxPolicy):
         """Per-element validity mask for the losses (None = all)."""
         return None
 
-    def _device_update_fn(self):
-        """The single-update body shared by the per-batch program and
-        the fused multi-update scan (runs inside shard_map)."""
+    def _device_update_fn(self, batch_size=None, with_frames=False):
+        """The single-update body shared by the per-batch program, the
+        legacy fused multi-update scan, and the generic superstep
+        (``JaxPolicy.learn_superstep``) — all run inside shard_map.
+        ``batch_size``/``with_frames`` are part of the uniform
+        signature; SAC's bespoke nets ignore both (flat obs only)."""
         actor, critic = self.actor, self.critic
         tx_a, tx_c, tx_al = (
             self._tx_actor,
@@ -474,28 +477,42 @@ class SACJaxPolicy(JaxPolicy):
         return device_fn
 
     def _build_learn_fn(self, batch_size: int):
-        device_fn = self._device_update_fn()
-        axis = sharding_lib.data_axis(self.mesh)
-        sharded = jax.shard_map(
-            device_fn,
-            mesh=self.mesh,
-            in_specs=(P(), P(), P(), P(axis), P(), P()),
-            out_specs=(P(), P(), P(), P()),
+        return self._wrap_update_program(
+            self._device_update_fn(batch_size), batch_size
         )
-        label = f"learn[{type(self).__name__}:{batch_size}]"
-        if self.sharding_backend == "mesh":
-            rep = self._param_sharding
-            dat = self._data_sharding
-            return sharding_lib.sharded_jit(
-                sharded,
-                in_specs=(rep, rep, rep, dat, rep, rep),
-                out_specs=(rep, rep, rep, rep),
-                donate_argnums=(1,),
-                label=label,
-            )
-        return sharding_lib.sharded_jit(
-            sharded, donate_argnums=(1,), label=label
+
+    # -- superstep contract (JaxPolicy.learn_superstep) ------------------
+
+    @property
+    def supports_superstep(self) -> bool:
+        """The generic superstep scans THIS policy's own
+        ``_device_update_fn`` — so unlike the legacy stacked path
+        (``supports_stacked_learn``, which fuses the SAC body
+        specifically), subclasses with their own update bodies
+        (CQL's min-Q penalty, CRR's weighted regression) chain safely
+        too. Only wholesale learn-program replacements and explicit
+        opt-outs (RNNSAC's sequence state handling) are excluded."""
+        return (
+            not self._superstep_opt_out
+            and self.sharding_backend == "mesh"
+            and type(self)._build_learn_fn is SACJaxPolicy._build_learn_fn
         )
+
+    def _learn_coeffs(self):
+        return {}  # the per-update path passes no coefficients
+
+    def _updates_per_learn_call(self, batch_size: int) -> int:
+        return 1
+
+    @property
+    def _td_refresh_uses_rng(self) -> bool:
+        return True  # compute_td_error splits for the target resample
+
+    def _after_superstep(self) -> None:
+        # fused chains move the actor without refreshing the flat
+        # device snapshots — drop them so sync can't ship stale weights
+        self._flat_actor_dev = None
+        self._flat_actor_ready = None
 
     def _build_multi_learn_fn(self, batch_size: int, k: int):
         """K replay updates fused into ONE program: ``lax.scan`` threads
@@ -639,41 +656,47 @@ class SACJaxPolicy(JaxPolicy):
         self._flat_actor_ready = None
         super().set_weights(weights)
 
+    def _td_error_device_fn(self):
+        """Signed per-sample TD error of the min-twin critic vs the
+        soft TD target — shared by ``compute_td_error`` (plain jit)
+        and the superstep's in-scan prioritized refresh."""
+        actor, critic = self.actor, self.critic
+        gamma = self.gamma**self.n_step
+        low, high = self.low, self.high
+
+        def fn(params, aux, batch, rng):
+            obs = batch[SampleBatch.OBS].astype(jnp.float32)
+            next_obs = batch[SampleBatch.NEXT_OBS].astype(
+                jnp.float32
+            )
+            rewards = batch[SampleBatch.REWARDS].astype(jnp.float32)
+            not_done = 1.0 - batch[
+                SampleBatch.TERMINATEDS
+            ].astype(jnp.float32)
+            actions = batch[SampleBatch.ACTIONS].astype(jnp.float32)
+            alpha = jnp.exp(params["log_alpha"])
+            next_dist = SquashedGaussian(
+                actor.apply(params["actor"], next_obs),
+                low=low,
+                high=high,
+            )
+            next_a, next_logp = next_dist.sampled_action_logp(rng)
+            tq1, tq2 = critic.apply(
+                aux["target_critic"], next_obs, next_a
+            )
+            target_q = jnp.minimum(tq1, tq2) - alpha * next_logp
+            td_target = rewards + gamma * not_done * target_q
+            q1, q2 = critic.apply(params["critic"], obs, actions)
+            return jnp.minimum(q1, q2) - td_target
+
+        return fn
+
     def compute_td_error(self, samples) -> np.ndarray:
         """Per-sample |TD error| of the min-twin critic vs the soft TD
         target, for prioritized-replay priority refresh (reference
         sac_torch_policy keeps ``policy.td_error`` from the loss)."""
         if not hasattr(self, "_td_error_fn"):
-            actor, critic = self.actor, self.critic
-            gamma = self.gamma**self.n_step
-            low, high = self.low, self.high
-
-            def fn(params, aux, batch, rng):
-                obs = batch[SampleBatch.OBS].astype(jnp.float32)
-                next_obs = batch[SampleBatch.NEXT_OBS].astype(
-                    jnp.float32
-                )
-                rewards = batch[SampleBatch.REWARDS].astype(jnp.float32)
-                not_done = 1.0 - batch[
-                    SampleBatch.TERMINATEDS
-                ].astype(jnp.float32)
-                actions = batch[SampleBatch.ACTIONS].astype(jnp.float32)
-                alpha = jnp.exp(params["log_alpha"])
-                next_dist = SquashedGaussian(
-                    actor.apply(params["actor"], next_obs),
-                    low=low,
-                    high=high,
-                )
-                next_a, next_logp = next_dist.sampled_action_logp(rng)
-                tq1, tq2 = critic.apply(
-                    aux["target_critic"], next_obs, next_a
-                )
-                target_q = jnp.minimum(tq1, tq2) - alpha * next_logp
-                td_target = rewards + gamma * not_done * target_q
-                q1, q2 = critic.apply(params["critic"], obs, actions)
-                return jnp.minimum(q1, q2) - td_target
-
-            self._td_error_fn = jax.jit(fn)
+            self._td_error_fn = jax.jit(self._td_error_device_fn())
         batch = self._td_input_tree(samples)
         self._rng, rng = jax.random.split(self._rng)
         td = self._td_error_fn(self.params, self.aux_state, batch, rng)

@@ -84,6 +84,29 @@ class LearnerThread(threading.Thread):
         self._feeder = None
         self._in_flight = 0
         self._lazy: "collections.deque" = collections.deque()
+        # superstep contract (docs/data_plane.md): fuse up to K queued
+        # batches into ONE compiled K-update program — one dispatch +
+        # one stats drain per superstep instead of per update. Only
+        # for policies whose update body rides the generic scan, and
+        # only on the two-phase deferred path (host stat hooks between
+        # updates would observe nothing anyway).
+        self._superstep_k = 1
+        try:
+            if self._defer and getattr(
+                policy, "supports_superstep", False
+            ):
+                from ray_tpu.sharding.superstep import (
+                    resolve_superstep,
+                )
+
+                self._superstep_k = resolve_superstep(
+                    getattr(policy, "config", None) or {},
+                    getattr(policy, "mesh", None),
+                )
+        except Exception:
+            self._superstep_k = 1
+        self._depth = max(PIPELINE_DEPTH, self._superstep_k)
+        self._stack_fn = None
         # Weight publishing: (version, host_weights) swapped atomically.
         self._publish_every = int(publish_weights_every)
         self._weights_lock = threading.Lock()
@@ -104,8 +127,49 @@ class LearnerThread(threading.Thread):
         if self._feeder is None:
             from ray_tpu.execution.device_feed import DeviceFeeder
 
-            self._feeder = DeviceFeeder(self.policy.batch_shardings)
+            self._feeder = DeviceFeeder(
+                self.policy.batch_shardings,
+                capacity=max(2, self._superstep_k),
+            )
         return self._feeder
+
+    def _trim_fixed(self, tree, bsize):
+        """Fixed-row contract for superstep stacking: trim a prepared
+        tree to the largest shard-divisible row count at or under the
+        config's train-batch geometry, so every queued batch has the
+        same shape and K of them stack into one scan feed. Frame-pool
+        batches (per-batch pool sizes) demote the thread to per-update
+        dispatch instead."""
+        from ray_tpu.ops.framestack import FRAMES as _FRAMES
+
+        if _FRAMES in tree:
+            self._superstep_k = 1
+            return tree, bsize
+        policy = self.policy
+        cfg = getattr(policy, "config", None) or {}
+        target = int(cfg.get("train_batch_size", bsize))
+        # IMPALA-family trees are (num_unrolls, T, ...): rows are
+        # whole unrolls, not env steps
+        frag_T = int(getattr(policy, "unroll_len", 0) or 0)
+        rows_target = target // frag_T if frag_T else target
+        div = max(1, getattr(policy, "n_shards", 1)) * max(
+            1, getattr(policy, "_unroll_T", 1)
+        )
+        fixed = (rows_target // div) * div
+        if fixed <= 0 or bsize < fixed:
+            return tree, bsize
+        if bsize == fixed:
+            return tree, bsize
+        T = max(1, getattr(policy, "_unroll_T", 1))
+        tree = {
+            c: (
+                v[: fixed // T]
+                if c.startswith("__chunk__")
+                else v[:fixed]
+            )
+            for c, v in tree.items()
+        }
+        return tree, fixed
 
     def run(self) -> None:
         try:
@@ -136,6 +200,8 @@ class LearnerThread(threading.Thread):
             self.stopped = True
             return False
         tree, bsize = self.policy.prepare_batch(batch)
+        if self._superstep_k > 1:
+            tree, bsize = self._trim_fixed(tree, bsize)
         self._get_feeder().put(tree, (bsize, batch.env_steps()))
         self._in_flight += 1
         return True
@@ -155,10 +221,10 @@ class LearnerThread(threading.Thread):
             except queue.Full:
                 pass
 
-    def _maybe_publish(self) -> None:
+    def _maybe_publish(self, steps: int = 1) -> None:
         if not self._publish_every:
             return
-        self._steps_since_publish += 1
+        self._steps_since_publish += steps
         if self._steps_since_publish < self._publish_every:
             return
         t0 = time.perf_counter()
@@ -192,7 +258,7 @@ class LearnerThread(threading.Thread):
         if self._in_flight == 0:
             if not self._pump(block=True):
                 return
-        while self._in_flight < PIPELINE_DEPTH:
+        while self._in_flight < self._depth:
             try:
                 if not self._pump(block=False):
                     break
@@ -211,6 +277,11 @@ class LearnerThread(threading.Thread):
             "learner_in", self.inqueue.qsize()
         )
         t0 = time.perf_counter()
+        if self._defer and self._superstep_k > 1:
+            if self._step_superstep(dev, bsize, env_steps, t0):
+                return
+            # demoted mid-flight (frame pools / ragged shapes):
+            # fall through to the per-update deferred path
         if self._defer:
             stats = self.policy.learn_on_device_batch(
                 dev, bsize, defer_stats=True
@@ -230,6 +301,76 @@ class LearnerThread(threading.Thread):
             self.outqueue.put_nowait((env_steps, info))
         except queue.Full:
             pass
+
+    def _step_superstep(self, dev, bsize, env_steps, t0) -> bool:
+        """Fuse up to ``_superstep_k`` queued device batches into one
+        compiled K-update dispatch (one stats drain for the chain).
+        Returns False — without consuming anything — when the first
+        batch can't ride the scan (frame pools: per-batch pool sizes),
+        demoting the thread to per-update dispatch. A starved or
+        ragged collection learns what it gathered per-update instead
+        (deferred), so throughput degrades gracefully."""
+        from ray_tpu.ops.framestack import FRAMES as _FRAMES
+
+        if _FRAMES in dev:
+            self._superstep_k = 1
+            return False
+        k_sup = self._superstep_k
+        batches = [(dev, bsize, env_steps)]
+        while len(batches) < k_sup:
+            while self._in_flight < self._depth:
+                try:
+                    if not self._pump(block=False):
+                        break
+                except queue.Empty:
+                    break
+            if self._in_flight <= 0:
+                break
+            try:
+                d2, (b2, e2) = self._feeder.get(timeout=10.0)
+            except queue.Empty:
+                break
+            self._in_flight -= 1
+            batches.append((d2, b2, e2))
+        sizes = {b[1] for b in batches}
+        if len(batches) == k_sup and len(sizes) == 1:
+            if self._stack_fn is None:
+                from ray_tpu import sharding as sharding_lib
+
+                self._stack_fn = sharding_lib.build_stack_fn(
+                    self.policy.mesh,
+                    k_sup,
+                    label=f"superstep_stack[{k_sup}]",
+                )
+            stacked = self._stack_fn(*[b[0] for b in batches])
+            infos, _, skipped = self.policy.learn_superstep(
+                k_sup, bsize, stacked=dict(stacked), k_max=k_sup
+            )
+            self.grad_timer += time.perf_counter() - t0
+            self.num_steps += k_sup
+            for (_, _, e_), info in zip(batches, infos):
+                info["cur_lr"] = self.policy.coeff_values.get("lr")
+                self.learner_info = info
+                try:
+                    self.outqueue.put_nowait((e_, info))
+                except queue.Full:
+                    pass
+            for s in skipped:
+                if s:
+                    telemetry_metrics.inc_skipped_batches()
+            self._maybe_publish(steps=k_sup)
+            return True
+        # starved/ragged collection: per-update deferred dispatch
+        for d_, b_, e_ in batches:
+            stats = self.policy.learn_on_device_batch(
+                d_, b_, defer_stats=True
+            )
+            self._lazy.append((e_, stats))
+            self.num_steps += 1
+        self.grad_timer += time.perf_counter() - t0
+        self._maybe_publish(steps=len(batches))
+        self._drain_lazy()
+        return True
 
     def _step_sync(self) -> None:
         t0 = time.perf_counter()

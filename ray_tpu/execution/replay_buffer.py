@@ -82,6 +82,20 @@ class ReplayBuffer:
         idx = self._rng.integers(0, self._size, num_items)
         return self._make_batch(idx)
 
+    def draw_index_sets(self, k: int, num_items: int) -> np.ndarray:
+        """``k`` uniform draws of ``num_items`` rows as a ``(k, n)``
+        index matrix — the superstep's pre-drawn batch schedule. The
+        draws are k SEQUENTIAL generator calls (never one k·n call):
+        the generator consumes its stream in the host ring's exact
+        per-update call order, so a fixed seed stays bit-identical to
+        k individual ``sample`` calls."""
+        return np.stack(
+            [
+                self._rng.integers(0, self._size, num_items)
+                for _ in range(k)
+            ]
+        )
+
     def _make_batch(self, idx: np.ndarray) -> SampleBatch:
         return SampleBatch(
             {k: col[idx] for k, col in self._cols.items()}
@@ -142,6 +156,17 @@ class _PrioritySampling:
         p_sample = self._sum_tree[idx] / total
         weights = (p_sample * self._size) ** (-beta) / max_weight
         return idx, weights.astype(np.float32)
+
+    def draw_prioritized_sets(self, k: int, num_items: int, beta: float):
+        """``k`` sequential stratified draws → ``(k, n)`` indices and
+        IS weights. Priorities are NOT refreshed between the draws —
+        the superstep's documented within-chain staleness
+        (docs/data_plane.md); the generator call order matches k
+        individual ``sample`` calls exactly."""
+        idx, weights = zip(
+            *(self._draw_prioritized(num_items, beta) for _ in range(k))
+        )
+        return np.stack(idx), np.stack(weights)
 
     def update_priorities(
         self, idx: np.ndarray, priorities: np.ndarray
@@ -297,6 +322,23 @@ class DeviceTrainBatch:
 
     def get(self, key, default=None):
         return self.tree.get(key, default)
+
+
+class SuperstepRingFeed:
+    """Feed descriptor handing the device replay rings to a policy's
+    fused superstep program (``JaxPolicy.learn_superstep``): the scan
+    gathers each update's rows from ``store`` in place using the
+    host-pre-drawn ``(k, B)`` index matrix — replay rows never leave
+    the mesh, and only ``idx`` (plus any ``extra`` stacked host
+    columns, e.g. PER importance weights) cross host→device."""
+
+    def __init__(self, store, idx, extra, gather_fn, shardings, key):
+        self.store = store
+        self.idx = idx
+        self.extra = extra
+        self.gather_fn = gather_fn
+        self.shardings = shardings
+        self.key = key  # compile-cache key: the stored column set
 
 
 class DeviceReplayBuffer:
@@ -625,6 +667,60 @@ class DeviceReplayBuffer:
             )
         tree = fn(self._store, idx.astype(np.int32))
         return DeviceTrainBatch(dict(tree), len(idx), indices=idx)
+
+    def draw_index_sets(self, k: int, num_items: int) -> np.ndarray:
+        """Same draw discipline as the host ring (k sequential calls
+        on the shared generator) — see ``ReplayBuffer
+        .draw_index_sets``. Valid whether or not this buffer spilled
+        (the generator object is shared with the spill ring)."""
+        size = len(self)
+        return np.stack(
+            [
+                self._rng.integers(0, size, num_items)
+                for _ in range(k)
+            ]
+        )
+
+    def superstep_feed(
+        self,
+        idx: np.ndarray,
+        extra: Optional[Dict[str, np.ndarray]] = None,
+    ) -> SuperstepRingFeed:
+        """Package the device rings for an in-program superstep gather
+        (``idx``: pre-drawn ``(k, B)`` positions; ``extra``: stacked
+        host columns merged after the gather). The gather body is the
+        sample path's — same uint32-lane unpack — so the scan consumes
+        rows bit-identical to ``gather()``'s output."""
+        if self._host is not None:
+            raise RuntimeError(
+                "superstep_feed on a spilled buffer — use the host "
+                "stacked path"
+            )
+        import jax
+        import jax.numpy as jnp
+
+        meta = dict(self._meta)
+
+        def gather_fn(store, idx2):
+            out = {}
+            for k_, v in store.items():
+                row_shape, _, packed = meta[k_]
+                g = v[idx2]
+                if packed:
+                    u8 = jax.lax.bitcast_convert_type(g, jnp.uint8)
+                    g = u8.reshape(tuple(idx2.shape) + row_shape)
+                out[k_] = g
+            return out
+
+        shardings = {k_: v.sharding for k_, v in self._store.items()}
+        return SuperstepRingFeed(
+            store=self._store,
+            idx=np.ascontiguousarray(idx, np.int32),
+            extra=dict(extra or {}),
+            gather_fn=gather_fn,
+            shardings=shardings,
+            key=tuple(sorted(self._store)),
+        )
 
     def stats(self) -> Dict:
         return {

@@ -79,3 +79,139 @@ def train_one_step(algorithm, train_batch) -> Dict:
 # policy (reference multi_gpu_train_one_step :92 needed a separate
 # buffer-loading protocol; here sharding is a device_put detail).
 multi_gpu_train_one_step = train_one_step
+
+
+def superstep_train_replay(
+    algorithm,
+    policy,
+    buf,
+    k: int,
+    k_max: int,
+    batch_size: int,
+    *,
+    prioritized: bool = False,
+    beta: float = 0.4,
+):
+    """One fused superstep of ``k`` replay updates — the uniform
+    K-updates-per-dispatch learner contract (docs/data_plane.md)
+    shared by the whole DQN off-policy family.
+
+    Index draws happen here, host-side, in the exact per-update
+    generator call order (``draw_index_sets`` /
+    ``draw_prioritized_sets``: k sequential draws, priorities frozen
+    within the chain), then:
+
+      - device-resident buffers hand their rings to the program
+        (``superstep_feed``) — the scan gathers each update's rows in
+        place, so only the ``(k, B)`` index matrix (plus PER weights)
+        cross host→device;
+      - host rings stack the k per-draw train trees into ONE
+        ``(k, B, ...)`` H2D transfer.
+
+    Prioritized buffers get the per-update ``|td|`` refresh as one
+    stacked ``(k, B)`` D2H at superstep end, applied to the host sum
+    tree in update order (bit-exact vs the per-update path given the
+    same draws; nan-guard-skipped updates skip their refresh too).
+
+    Returns the final update's stats dict, or None when this batch
+    shape can't ride the scan (deduplicated frame pools) — the caller
+    falls back to per-update chaining."""
+    import jax
+    import numpy as np
+
+    from ray_tpu.execution.replay_buffer import DeviceReplayBuffer
+    from ray_tpu.ops.framestack import FRAMES as _FRAMES
+
+    device_mode = isinstance(buf, DeviceReplayBuffer) and not buf.spilled
+    # a spilled device buffer delegates storage AND priority state to
+    # its host ring — draw/update through that single source of truth
+    src = (
+        buf._host
+        if isinstance(buf, DeviceReplayBuffer) and buf.spilled
+        else buf
+    )
+    refresh = prioritized and policy._td_error_device_fn() is not None
+    if prioritized:
+        idx, weights = src.draw_prioritized_sets(k, batch_size, beta)
+    else:
+        idx = src.draw_index_sets(k, batch_size)
+        weights = None
+    pad = k_max - k
+    if pad:
+        idx = np.concatenate(
+            [idx, np.zeros((pad, batch_size), idx.dtype)]
+        )
+        if weights is not None:
+            weights = np.concatenate(
+                [weights, np.ones((pad, batch_size), np.float32)]
+            )
+
+    if device_mode:
+        extra = (
+            {"weights": weights.astype(np.float32)}
+            if weights is not None
+            else {}
+        )
+        feed = buf.superstep_feed(idx, extra)
+        infos, pri, skipped = policy.learn_superstep(
+            k,
+            batch_size,
+            rings=feed,
+            k_max=k_max,
+            refresh_priorities=refresh,
+        )
+    else:
+        trees = []
+        for i in range(k):
+            b = src._make_batch(idx[i])
+            if prioritized:
+                # same columns the per-update PER sample carries
+                b["weights"] = weights[i].astype(np.float32)
+                b["batch_indexes"] = idx[i].astype(np.int64)
+            tree, bsize = policy.prepare_batch(b)
+            if bsize != batch_size or _FRAMES in tree:
+                return None  # ragged/frame-pool batch: per-update path
+            trees.append(tree)
+        stacked = {
+            c: np.stack([t[c] for t in trees]) for c in trees[0]
+        }
+        if pad:
+            stacked = {
+                c: np.concatenate([v, np.repeat(v[:1], pad, axis=0)])
+                for c, v in stacked.items()
+            }
+        infos, pri, skipped = policy.learn_superstep(
+            k,
+            batch_size,
+            stacked=stacked,
+            k_max=k_max,
+            refresh_priorities=refresh,
+        )
+
+    if prioritized:
+        # apply in update order: overlapping draws must resolve
+        # exactly as the per-update path's interleaved writes would
+        for i in range(k):
+            if skipped[i]:
+                continue
+            if pri is not None:
+                src.update_priorities(idx[i], pri[i] + 1e-6)
+            else:
+                # policies without per-sample errors: batch-mean
+                # scalar fallback (mirrors DQN._single_update)
+                src.update_priorities(
+                    idx[i],
+                    np.full(
+                        batch_size,
+                        abs(infos[i].get("mean_td_error", 0.0)) + 1e-6,
+                    ),
+                )
+
+    n_skipped = sum(1 for s in skipped if s)
+    if n_skipped and algorithm is not None:
+        algorithm._counters["num_nan_batches_skipped"] += n_skipped
+        recovery = getattr(algorithm, "_recovery", None)
+        if recovery is not None:
+            for _ in range(n_skipped):
+                recovery.note_skipped_batch()
+    return infos[-1] if infos else {}

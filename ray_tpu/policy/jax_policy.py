@@ -449,8 +449,14 @@ class JaxPolicy(Policy):
         self.coeff_values["lr"] = float(self._lr_schedule(t))
         self.coeff_values["entropy_coeff"] = float(self._entropy_schedule(t))
 
-    def _build_learn_fn(self, batch_size: int, with_frames: bool = False):
-        """Compile the full SGD nest for a given total batch size."""
+    def _nest_device_fn(self, batch_size: int, with_frames: bool = False):
+        """The per-batch SGD-nest device body —
+        ``(params, opt_state, aux, batch, rng, coeffs) -> (params,
+        opt_state, stats)`` — shared by the per-call learn program
+        (:meth:`_build_learn_fn`) and the fused superstep scan
+        (:meth:`learn_superstep`): both wrap THIS body, so the fused
+        chain is bit-identical to per-call dispatch. Runs inside
+        ``shard_map`` (uses the mesh collectives)."""
         n_shards = self.n_shards
         stack_k = int(self.observation_space.shape[-1]) if (
             with_frames
@@ -604,6 +610,15 @@ class JaxPolicy(Policy):
             }
             return params, opt_state, stats
 
+        return device_fn
+
+    def _build_learn_fn(self, batch_size: int, with_frames: bool = False):
+        """Compile the full SGD nest for a given total batch size."""
+        device_fn = self._nest_device_fn(
+            batch_size, with_frames=with_frames
+        )
+        mesh = self.mesh
+        axis = sharding_lib.data_axis(mesh)
         sharded = jax.shard_map(
             device_fn,
             mesh=mesh,
@@ -632,6 +647,340 @@ class JaxPolicy(Policy):
         return sharding_lib.sharded_jit(
             sharded, donate_argnums=(1,), label=label
         )
+
+    # -- superstep: K updates per dispatch (docs/data_plane.md) ----------
+
+    # Policies whose update body can't ride the generic scan (sequence
+    # replay with per-chunk state handling) set this True to opt out
+    # even when they kept the base learn program.
+    _superstep_opt_out = False
+
+    @property
+    def supports_superstep(self) -> bool:
+        """Whether K updates of this policy may fuse into one
+        ``lax.scan`` dispatch (:meth:`learn_superstep`). True only when
+        the subclass kept the base learn-program composition — the
+        superstep scan is built from :meth:`_device_update_fn`, so a
+        policy that replaced :meth:`_build_learn_fn` wholesale
+        (AlphaZero, QMIX, MADDPG, SlateQ) must chain per-call. The
+        actor-critic families override this with their own identity
+        checks. Requires the mesh backend (the scan program carries
+        explicit shardings)."""
+        return (
+            not self._superstep_opt_out
+            and self.sharding_backend == "mesh"
+            and type(self)._build_learn_fn is JaxPolicy._build_learn_fn
+            and type(self)._nest_device_fn is JaxPolicy._nest_device_fn
+            and type(self)._device_update_fn
+            is JaxPolicy._device_update_fn
+        )
+
+    def _device_update_fn(self, batch_size=None, with_frames=False):
+        """Uniform single-update device body for the superstep scan:
+        ``(params, opt_state, aux, batch, rng, coeffs) -> (params,
+        opt_state, aux, stats)``. The base policy wraps the per-batch
+        SGD nest (aux — target nets etc. — passes through unchanged);
+        actor-critic policies (SAC/DDPG) override with bodies that
+        thread their aux through the update."""
+        nest = self._nest_device_fn(
+            int(batch_size), with_frames=with_frames
+        )
+
+        def update_fn(params, opt_state, aux, batch, rng, coeffs):
+            if with_frames:
+                # per-update frame pool rides the batch tree (the
+                # per-call path ships it via aux; inside a scan each
+                # slot has its own pool)
+                batch = dict(batch)
+                frames = batch.pop(_FRAMES)
+                params, opt_state, stats = nest(
+                    params,
+                    opt_state,
+                    {"__frames__": frames, **aux},
+                    batch,
+                    rng,
+                    coeffs,
+                )
+            else:
+                params, opt_state, stats = nest(
+                    params, opt_state, aux, batch, rng, coeffs
+                )
+            return params, opt_state, aux, stats
+
+        return update_fn
+
+    def _wrap_update_program(self, update_fn, batch_size: int):
+        """shard_map + sharded_jit wrap of a 4-output single-update
+        body — the one per-call learn-program shape the actor-critic
+        family (SAC/DDPG/CQL/CRR) shares."""
+        mesh = self.mesh
+        axis = sharding_lib.data_axis(mesh)
+        sharded = jax.shard_map(
+            update_fn,
+            mesh=mesh,
+            in_specs=(P(), P(), P(), P(axis), P(), P()),
+            out_specs=(P(), P(), P(), P()),
+        )
+        label = f"learn[{type(self).__name__}:{batch_size}]"
+        if self.sharding_backend == "mesh":
+            rep = self._param_sharding
+            dat = self._data_sharding
+            return sharding_lib.sharded_jit(
+                sharded,
+                in_specs=(rep, rep, rep, dat, rep, rep),
+                out_specs=(rep, rep, rep, rep),
+                donate_argnums=(1,),
+                label=label,
+            )
+        return sharding_lib.sharded_jit(
+            sharded, donate_argnums=(1,), label=label
+        )
+
+    def _learn_coeffs(self):
+        """Host coefficients the learn program consumes this call —
+        what the per-update path passes, so the superstep matches it.
+        Frozen across a superstep's K updates (staleness contract:
+        docs/data_plane.md)."""
+        self._update_scheduled_coeffs()
+        return self._coeff_array()
+
+    def _updates_per_learn_call(self, batch_size: int) -> int:
+        """num_grad_updates increment of ONE learn call (the base nest
+        runs num_sgd_iter × minibatches; actor-critic bodies one)."""
+        return self.num_sgd_iter * max(
+            1, batch_size // max(1, self.minibatch_size)
+        )
+
+    # Whether the per-update PER priority refresh consumes a host rng
+    # split (SAC/DDPG: always; DQN: only under NoisyNet) — the
+    # superstep must replay the exact split order of the per-update
+    # path for bit parity.
+    @property
+    def _td_refresh_uses_rng(self) -> bool:
+        return False
+
+    def _td_error_device_fn(self):
+        """Per-sample TD-error device body ``(params, aux, batch, rng)
+        -> (B,)`` for the in-scan prioritized-replay refresh; None for
+        policies without per-sample errors (the caller falls back to
+        the batch-mean scalar, like ``DQN._single_update``)."""
+        return None
+
+    def _after_superstep(self) -> None:
+        """Hook: host-side cache invalidation after a fused chain
+        moved the params (SAC drops its device-flattened actor
+        snapshots here)."""
+
+    def learn_superstep(
+        self,
+        k: int,
+        batch_size: int,
+        *,
+        stacked=None,
+        rings=None,
+        k_max: Optional[int] = None,
+        refresh_priorities: bool = False,
+    ):
+        """Run ``k`` updates as ONE compiled program (the uniform
+        superstep contract — docs/data_plane.md): one dispatch, one
+        stats readback, weights never bounce through the host between
+        updates. Bit-identical to ``k`` sequential
+        ``learn_on_device_batch(..., defer_stats=True)`` calls on the
+        same batches (same device body, same host rng-split order;
+        host-side ``after_learn_on_batch`` reactions lag the chain —
+        callers that need them apply them to the drained stats).
+
+        Feed (exactly one):
+          - ``stacked``: ``(k_max, B, ...)`` column tree — host numpy
+            (one H2D for the whole superstep) or already-resident
+            device arrays (PPO's prefetched batches, zero H2D).
+          - ``rings``: a :class:`~ray_tpu.execution.replay_buffer
+            .DeviceReplayBuffer` feed (``buf.superstep_feed(idx,
+            extra)``) — the scan gathers each update's rows from the
+            device rings in place; only the ``(k_max, B)`` index array
+            (and PER weights) cross the wire.
+
+        ``k_max`` fixes the compiled scan length; any ``k <= k_max``
+        runs through the same executable via the active mask (no
+        per-K recompile — ``compile_stats()``-asserted in tests).
+        ``refresh_priorities`` runs the per-sample TD-error body after
+        each update (post-update state, per-update order) and returns
+        the stacked ``|td|`` matrix in one D2H.
+
+        Returns ``(infos, priorities, skipped)``: per-update host stat
+        dicts (update order), the ``(k, B)`` priority matrix (None
+        unless refreshing), and the per-update nan-guard skip flags.
+        """
+        import time as _time
+
+        if (stacked is None) == (rings is None):
+            raise ValueError(
+                "learn_superstep needs exactly one of stacked/rings"
+            )
+        k = int(k)
+        k_max = int(k_max or k)
+        if not 1 <= k <= k_max:
+            raise ValueError(f"k={k} outside [1, k_max={k_max}]")
+        nan_guard = bool(self.config.get("nan_guard"))
+        with_frames = stacked is not None and _FRAMES in stacked
+        pri_fn = (
+            self._td_error_device_fn() if refresh_priorities else None
+        )
+        if refresh_priorities and pri_fn is None:
+            raise ValueError(
+                f"{type(self).__name__} has no per-sample TD-error "
+                "body; gate refresh_priorities on "
+                "policy._td_error_device_fn() is not None"
+            )
+
+        from ray_tpu.sharding import superstep as superstep_lib
+
+        if rings is not None:
+            cache_mode = ("rings", rings.key, tuple(sorted(rings.extra)))
+        else:
+            cache_mode = ("stacked", tuple(sorted(stacked)))
+        cache_key = (
+            batch_size, k_max, cache_mode, refresh_priorities, nan_guard,
+        )
+        fns = self.__dict__.setdefault("_superstep_fns", {})
+        fn = fns.get(cache_key)
+        if fn is None:
+            kwargs = dict(
+                mesh=self.mesh,
+                backend=self.sharding_backend,
+                k=k_max,
+                label=(
+                    f"superstep[{type(self).__name__}:"
+                    f"{batch_size}x{k_max}]"
+                ),
+                priority_fn=pri_fn,
+                nan_guard=nan_guard,
+            )
+            if rings is not None:
+                kwargs.update(
+                    gather_fn=rings.gather_fn,
+                    store_shardings=rings.shardings,
+                    extra_cols=tuple(sorted(rings.extra)),
+                )
+            else:
+                kwargs.update(
+                    stacked_cols=tuple(sorted(stacked)),
+                    replicated_cols=(_FRAMES,) if with_frames else (),
+                )
+            fn = superstep_lib.build_superstep_fn(
+                self._device_update_fn(
+                    batch_size, with_frames=with_frames
+                ),
+                **kwargs,
+            )
+            fns[cache_key] = fn
+
+        coeffs = self._learn_coeffs()
+        # exact per-update host split order: learn split, then (iff the
+        # per-update priority pass consumes one) the td split
+        keys, pri_keys = [], []
+        td_rng = refresh_priorities and self._td_refresh_uses_rng
+        for _ in range(k):
+            self._rng, r = jax.random.split(self._rng)
+            keys.append(r)
+            if refresh_priorities:
+                if td_rng:
+                    self._rng, r2 = jax.random.split(self._rng)
+                else:
+                    r2 = jnp.zeros_like(r)
+                pri_keys.append(r2)
+        pad_key = jnp.zeros_like(keys[0])
+        while len(keys) < k_max:
+            keys.append(pad_key)
+        rngs = jnp.stack(keys)
+        active = np.zeros(k_max, np.float32)
+        active[:k] = 1.0
+        rest = ()
+        if refresh_priorities:
+            while len(pri_keys) < k_max:
+                pri_keys.append(pad_key)
+            rest = (jnp.stack(pri_keys),)
+
+        if rings is not None:
+            feed = (rings.store, rings.idx, rings.extra)
+            telemetry_metrics.add_h2d_bytes(
+                "learn",
+                rings.idx.nbytes
+                + sharding_lib.tree_nbytes(rings.extra),
+            )
+        else:
+            feed = stacked
+            if not any(
+                isinstance(v, jax.Array) for v in stacked.values()
+            ):
+                telemetry_metrics.add_h2d_bytes(
+                    "learn", sharding_lib.tree_nbytes(stacked)
+                )
+
+        compiles_before = getattr(fn, "traces", 0)
+        t0 = _time.perf_counter()
+        with tracing.start_span(
+            "learn:superstep", k=k, batch_size=batch_size
+        ) as _sp:
+            out = fn(
+                self.params,
+                self.opt_state,
+                self.aux_state,
+                feed,
+                active,
+                rngs,
+                *rest,
+                coeffs,
+            )
+            if refresh_priorities:
+                (
+                    self.params, self.opt_state, self.aux_state,
+                    stats, pri,
+                ) = out
+            else:
+                self.params, self.opt_state, self.aux_state, stats = out
+                pri = None
+            _sp.set_attribute(
+                "recompiles",
+                getattr(fn, "traces", 0) - compiles_before,
+            )
+            # ONE drain for the whole chain: the stacked stats tree
+            # (and the PER priority matrix) come back in a single
+            # device→host readback
+            if pri is not None:
+                stats, pri = jax.device_get((stats, pri))
+                pri = np.abs(np.asarray(pri)[:k])
+            else:
+                stats = jax.device_get(stats)
+        self.num_grad_updates += k * self._updates_per_learn_call(
+            batch_size
+        )
+        self._after_superstep()
+        telemetry_metrics.counter(
+            telemetry_metrics.LEARN_STEPS_TOTAL,
+            "SGD-nest programs dispatched",
+        ).inc(float(k))
+        telemetry_metrics.inc_superstep_updates(k)
+        self.last_learn_timers["learn_superstep_s"] = (
+            _time.perf_counter() - t0
+        )
+        self.last_learn_timers["learn_recompiles"] = float(
+            getattr(fn, "traces", 0) - compiles_before
+        )
+
+        skip = np.asarray(
+            stats.get(superstep_lib.SKIP_KEY, np.zeros(k_max))
+        )
+        skipped = [bool(skip[i] > 0.5) for i in range(k)]
+        infos = [
+            {
+                name: float(np.asarray(v)[i])
+                for name, v in stats.items()
+                if name != superstep_lib.SKIP_KEY
+            }
+            for i in range(k)
+        ]
+        return infos, pri, skipped
 
     def prepare_batch(self, samples) -> Tuple[Dict[str, np.ndarray], int]:
         """Public phase 1 of learning: turn a SampleBatch (or plain dict of
@@ -1350,6 +1699,7 @@ class JaxPolicy(Policy):
             self.config.get("num_sgd_iter", self.num_sgd_iter)
         )
         self._learn_fns.clear()
+        self.__dict__.pop("_superstep_fns", None)
         if hasattr(self, "_grad_fn"):
             del self._grad_fn
         # Rebuild exploration (type/knobs may have mutated) and drop the

@@ -43,6 +43,11 @@ from ray_tpu.sharding.specs import (
     sharding_tree,
     tree_nbytes,
 )
+from ray_tpu.sharding.superstep import (
+    build_stack_fn,
+    build_superstep_fn,
+    resolve_superstep,
+)
 
 
 def resolve_mesh(config):
@@ -67,6 +72,9 @@ __all__ = [
     "ShardedFunction",
     "available_devices",
     "batch_sharded",
+    "build_stack_fn",
+    "build_superstep_fn",
+    "resolve_superstep",
     "clear_mesh_cache",
     "compile_stats",
     "data_axis",

@@ -51,6 +51,9 @@ SKIPPED_BATCHES_TOTAL = "ray_tpu_skipped_batches_total"
 # stacked-chain transfer), replay_insert (each transition's ONE
 # crossing into a device-resident replay buffer)
 H2D_BYTES_TOTAL = "ray_tpu_h2d_bytes_total"
+# superstep learner contract (docs/data_plane.md): updates executed
+# inside fused K-updates-per-dispatch programs
+SUPERSTEP_UPDATES_TOTAL = "ray_tpu_superstep_updates_total"
 REPLAY_ROWS = "ray_tpu_replay_buffer_rows"
 REPLAY_CAPACITY = "ray_tpu_replay_buffer_capacity"
 REPLAY_BYTES = "ray_tpu_replay_buffer_bytes"
@@ -133,6 +136,16 @@ def inc_skipped_batches(n: int = 1) -> None:
     counter(
         SKIPPED_BATCHES_TOTAL,
         "learn batches skipped by the non-finite guard",
+    ).inc(float(n))
+
+
+def inc_superstep_updates(n: int = 1) -> None:
+    """Learner updates executed inside fused superstep programs (K
+    updates per dispatch — docs/data_plane.md). Compare against
+    ``ray_tpu_learn_steps_total`` for the fused fraction."""
+    counter(
+        SUPERSTEP_UPDATES_TOTAL,
+        "learner updates run inside fused superstep dispatches",
     ).inc(float(n))
 
 
