@@ -50,6 +50,13 @@ Flags:  --profile       run ONE telemetry-instrumented PPO iteration
                         writes benchmarks/e2e/superstep_ab.json (the
                         full bench's bench_mfu gains a `superstep`
                         sub-entry at the headline geometry)
+        --jax-env       rollout-lane A/B (docs/pipeline.md): CPU-actor
+                        lane vs device (jax) lane vs fused
+                        rollout+learn superstep on the same
+                        JaxVectorEnv, same seed, same step count;
+                        writes benchmarks/e2e/jax_env_ab.json
+                        (bench_mfu gains a `fused_rollout` sub-entry
+                        on the jittable pong_lite port)
 """
 
 import json
@@ -401,6 +408,66 @@ def bench_mfu(b=B, mb=MB, iters=ITERS, reps=4, h=H, w=W, c=C):
     except Exception as e:  # keep the headline bench alive
         superstep = {"error": str(e)}
 
+    # fused-rollout sub-entry (docs/pipeline.md "two rollout lanes"):
+    # rollout(T)+GAE+the SGD nest as ONE dispatched program on the
+    # jittable pong_lite port — the zero-H2D lane the next TPU round
+    # measures at scale. Smoke geometry here; env_steps/s and the
+    # per-dispatch wall are the comparable numbers.
+    fused_rollout = None
+    try:
+        from ray_tpu.algorithms.ppo.ppo import (
+            PPOConfig as _PPOCfg,
+            PPOJaxPolicy as _PPOPol,
+        )
+        from ray_tpu.env.jax_pong import PongLiteJax
+        from ray_tpu.execution.jax_rollout import JaxRolloutEngine
+        from ray_tpu.sharding.compile import compile_stats
+
+        n_env, t_ro = 8, 16
+        cfgj = _PPOCfg().to_dict()
+        cfgj.update(
+            seed=0,
+            train_batch_size=n_env * t_ro,
+            sgd_minibatch_size=64,
+            num_sgd_iter=2,
+            lr=3e-4,
+        )
+        cfgj["lambda"] = 0.95
+        envj = PongLiteJax({})
+        pj = _PPOPol(
+            envj.observation_space, envj.action_space, cfgj
+        )
+        eng = JaxRolloutEngine(
+            pj, envj, n_env, t_ro, seed=0
+        )
+        feed = eng.superstep_feed()
+        infos, carry, mets, _ = pj.learn_rollout_superstep(
+            1, eng.batch_size, feed, k_max=1
+        )  # compile+warm
+        eng.advance(carry, mets)
+        traces0 = compile_stats()["traces"]
+        fr_reps = max(2, reps // 2)
+        t0 = time.perf_counter()
+        for _ in range(fr_reps):
+            feed = eng.superstep_feed()
+            infos, carry, mets, _ = pj.learn_rollout_superstep(
+                1, eng.batch_size, feed, k_max=1
+            )
+            eng.advance(carry, mets)
+        fr_wall = (time.perf_counter() - t0) / fr_reps
+        fused_rollout = {
+            "env": "PongLiteJax-v0",
+            "num_envs": n_env,
+            "rollout_length": t_ro,
+            "wall_s_per_dispatch": round(fr_wall, 4),
+            "env_steps_per_s": round(eng.batch_size / fr_wall, 1),
+            "recompiles_in_timed_window": (
+                compile_stats()["traces"] - traces0
+            ),
+        }
+    except Exception as e:  # keep the headline bench alive
+        fused_rollout = {"error": str(e)}
+
     peak, kind = chip_peak_tflops()
     if compute_per_nest <= 0:
         # tunnel jitter inverted the medians; a clamped value would
@@ -413,6 +480,7 @@ def bench_mfu(b=B, mb=MB, iters=ITERS, reps=4, h=H, w=W, c=C):
             "unstable_timing": True,
             "deferred_stats": deferred,
             "superstep": superstep,
+            "fused_rollout": fused_rollout,
         }
     flops = b * iters * nature_cnn_train_flops_per_sample(h, w, c)
     achieved = flops / compute_per_nest / 1e12
@@ -427,6 +495,7 @@ def bench_mfu(b=B, mb=MB, iters=ITERS, reps=4, h=H, w=W, c=C):
         ),
         "deferred_stats": deferred,
         "superstep": superstep,
+        "fused_rollout": fused_rollout,
     }
 
 
@@ -1135,6 +1204,121 @@ def bench_chaos(out_path=None, iters=6):
     return report
 
 
+def bench_jax_env(out_path=None, iters=3, n_envs=32, t_rollout=64):
+    """Rollout-lane A/B (docs/pipeline.md "two rollout lanes"): the
+    SAME JaxVectorEnv (CartPoleJax), same fixed seed, same total env
+    steps, three lanes through the full PPO Algorithm —
+
+      - actor:  the CPU-actor lane (local SyncSampler drives the env
+        through the jitted adapter; train batch crosses H2D per iter);
+      - device: JAX-native rollouts on the learner mesh, rollout and
+        learn as separate dispatches (env_backend="jax",
+        jax_fused_rollout=False);
+      - fused:  rollout(T) + GAE + the SGD nest as ONE dispatched
+        program (the superstep's rollout feed) — per-iteration H2D is
+        the key stacks only.
+
+    Writes benchmarks/e2e/jax_env_ab.json with steps/s, per-iteration
+    rollout H2D bytes by lane, and the fused-vs-actor speedup (the
+    acceptance criterion is ≥ 4× at this geometry)."""
+    from ray_tpu.algorithms.ppo.ppo import PPOConfig
+    from ray_tpu.sharding.compile import compile_stats
+    from ray_tpu.telemetry import metrics as telemetry_metrics
+
+    out_path = out_path or "benchmarks/e2e/jax_env_ab.json"
+    steps_per_iter = n_envs * t_rollout
+
+    def build(backend, fused=True):
+        cfg = (
+            PPOConfig()
+            .environment(
+                "CartPoleJax-v0",
+                env_backend=backend,
+                jax_fused_rollout=fused,
+            )
+            .rollouts(
+                num_rollout_workers=0,
+                num_envs_per_worker=n_envs,
+                rollout_fragment_length=t_rollout,
+            )
+            .training(
+                train_batch_size=steps_per_iter,
+                sgd_minibatch_size=512,
+                num_sgd_iter=4,
+                lr=3e-4,
+                model={"fcnet_hiddens": [64, 64]},
+            )
+            .debugging(seed=0)
+        )
+        cfg.lambda_ = 0.95
+        return cfg.build()
+
+    def run(backend, fused=True):
+        algo = build(backend, fused)
+        try:
+            algo.train()  # warmup: compiles + first episode stream
+            h2d0 = telemetry_metrics.h2d_bytes_by_path()
+            traces0 = compile_stats()["traces"]
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                r = algo.train()
+            wall = time.perf_counter() - t0
+            h2d1 = telemetry_metrics.h2d_bytes_by_path()
+            d = {
+                p: h2d1.get(p, 0.0) - h2d0.get(p, 0.0)
+                for p in set(h2d0) | set(h2d1)
+            }
+            rollout_bytes = (
+                d.get("rollout", 0.0)
+                if backend == "jax"
+                else d.get("learn", 0.0) + d.get("feeder", 0.0)
+            )
+            return {
+                "steps_per_s": round(iters * steps_per_iter / wall, 1),
+                "wall_s_per_iteration": round(wall / iters, 4),
+                "rollout_h2d_bytes_per_iteration": round(
+                    rollout_bytes / iters, 1
+                ),
+                "recompiles_in_timed_window": (
+                    compile_stats()["traces"] - traces0
+                ),
+                "episode_reward_mean": r.get("episode_reward_mean"),
+            }
+        finally:
+            algo.cleanup()
+
+    report = {
+        "metric": "jax_env_rollout_lane_ab",
+        "env": "CartPoleJax-v0",
+        "geometry": {
+            "num_envs": n_envs,
+            "rollout_length": t_rollout,
+            "env_steps_per_iteration": steps_per_iter,
+            "timed_iterations": iters,
+        },
+        "actor_lane": run("actor"),
+        "device_lane": run("jax", fused=False),
+        "fused_lane": run("jax", fused=True),
+    }
+    report["speedup_fused_vs_actor"] = round(
+        report["fused_lane"]["steps_per_s"]
+        / report["actor_lane"]["steps_per_s"],
+        1,
+    )
+    report["speedup_device_vs_actor"] = round(
+        report["device_lane"]["steps_per_s"]
+        / report["actor_lane"]["steps_per_s"],
+        1,
+    )
+    import os
+
+    os.makedirs(os.path.dirname(out_path), exist_ok=True)
+    with open(out_path, "w") as f:
+        json.dump(report, f, indent=1)
+    print(json.dumps(report))
+    return report
+
+
 def main():
     if "--e2e" in sys.argv:
         from bench_e2e import main as e2e_main
@@ -1149,6 +1333,9 @@ def main():
         return
     if "--superstep" in sys.argv:
         bench_superstep()
+        return
+    if "--jax-env" in sys.argv:
+        bench_jax_env()
         return
     if "--profile" in sys.argv:
         bench_profile()

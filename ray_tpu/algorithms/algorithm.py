@@ -301,11 +301,33 @@ class Algorithm(Trainable):
                 )
                 - superstep_before
             )
+            env_steps_iter = float(
+                max(
+                    0,
+                    self._counters[NUM_ENV_STEPS_SAMPLED] - ts_before,
+                )
+            )
+            backend = config.get("env_backend", "actor")
             results["info"]["telemetry"] = {
                 **rollup,
                 **throughput,
                 **runtime_vals,
                 "h2d_bytes": {**h2d, "total": sum(h2d.values())},
+                # which rollout lane produced this iteration's samples
+                # and what it cost over the wire (docs/pipeline.md):
+                # the jax lane's bytes are its key stacks (path
+                # "rollout", ≈0); the actor lane's rollout batches
+                # cross on the feeder/learn paths
+                "rollout_lane": {
+                    "backend": backend,
+                    "env_steps": env_steps_iter,
+                    "h2d_bytes": (
+                        h2d.get("rollout", 0.0)
+                        if backend == "jax"
+                        else h2d.get("feeder", 0.0)
+                        + h2d.get("learn", 0.0)
+                    ),
+                },
                 # superstep contract (docs/data_plane.md): how many of
                 # this iteration's learner updates rode a fused
                 # K-per-dispatch program
@@ -375,6 +397,10 @@ class Algorithm(Trainable):
         lw = self.workers.local_worker()
         if lw is not None:
             episodes.extend(lw.get_metrics())
+        # non-worker episode sources (the device rollout lane's
+        # engine): callables returning RolloutMetrics lists
+        for src in getattr(self, "_extra_metric_sources", ()):
+            episodes.extend(src())
         # smooth over a sliding window (reference metrics smoothing)
         self._episode_history.extend(episodes)
         window = self.config.get(

@@ -25,6 +25,18 @@ class AlgorithmConfig:
         self.clip_actions = False
         self.normalize_actions = True
         self.horizon = None
+        # rollout lane (docs/pipeline.md "two rollout lanes"):
+        # "actor" (default) samples on CPU Ray-actor workers through
+        # the SyncSampler; "jax" runs act → env.step → postprocess as
+        # ONE jit'd program on the learner mesh (JaxVectorEnv envs
+        # only — zero rollout bytes over H2D). The two lanes share
+        # SampleBatch semantics and a fixed-seed parity contract
+        # (tests/test_jax_env.py).
+        self.env_backend = "actor"
+        # "jax" lane only: fuse rollout+learn into one dispatched
+        # superstep program (False keeps rollout and learn as
+        # separate dispatches — the benchmark A/B's middle lane)
+        self.jax_fused_rollout = True
 
         # framework (reference :408)
         self.framework_str = "jax"
@@ -190,12 +202,29 @@ class AlgorithmConfig:
         clip_actions: Optional[bool] = None,
         normalize_actions: Optional[bool] = None,
         horizon: Optional[int] = None,
+        env_backend: Optional[str] = None,
+        jax_fused_rollout: Optional[bool] = None,
         **kwargs,
     ) -> "AlgorithmConfig":
+        """``env_backend``: which rollout lane produces samples —
+        ``"actor"`` (CPU Ray-actor workers, any env) or ``"jax"``
+        (JaxVectorEnv rollouts jit'd onto the learner mesh, zero
+        rollout H2D — docs/pipeline.md). ``jax_fused_rollout``
+        additionally fuses rollout+learn into one dispatch on the jax
+        lane (default True)."""
         if env is not None:
             self.env = env
         if env_config is not None:
             self.env_config = env_config
+        if env_backend is not None:
+            if env_backend not in ("actor", "jax"):
+                raise ValueError(
+                    "env_backend must be 'actor' or 'jax', got "
+                    f"{env_backend!r}"
+                )
+            self.env_backend = env_backend
+        if jax_fused_rollout is not None:
+            self.jax_fused_rollout = bool(jax_fused_rollout)
         if observation_space is not None:
             self.observation_space = observation_space
         if action_space is not None:

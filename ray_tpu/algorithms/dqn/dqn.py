@@ -25,6 +25,7 @@ from ray_tpu.algorithms.algorithm_config import AlgorithmConfig
 from ray_tpu.data.sample_batch import DEFAULT_POLICY_ID, SampleBatch
 from ray_tpu.execution.replay_buffer import (
     DevicePrioritizedReplayBuffer,
+    DeviceReplayBuffer,
     MultiAgentReplayBuffer,
     PrioritizedReplayBuffer,
     resolve_device_resident,
@@ -774,10 +775,73 @@ class DQN(Algorithm):
         if buf is not None and "replay_buffer" in state:
             buf.set_state(state["replay_buffer"])
 
+    def _jax_rollout_fill(self) -> int:
+        """Device rollout lane for the off-policy family
+        (config.env_backend == "jax", docs/pipeline.md): one dispatched
+        rollout produces transition rows ON the learner mesh, and a
+        device-resident replay buffer absorbs them via
+        ``add_device_tree`` — rollout rows never touch the host (a
+        host-ring buffer pulls them back once, which still deletes the
+        actor lane's sampling cost). Returns env steps taken."""
+        eng = self.__dict__.get("_jax_rollout_engine")
+        if eng is None:
+            from ray_tpu.execution.jax_rollout import (
+                JaxRolloutEngine,
+                supports_jax_rollout_lane,
+            )
+
+            if int(self.config.get("n_step", 1)) > 1:
+                raise ValueError(
+                    "env_backend='jax' supports n_step=1 only (n-step "
+                    "folding is a host-side postprocess)"
+                )
+            if self.config.get("policies"):
+                raise ValueError(
+                    "env_backend='jax' is single-policy"
+                )
+            policy = self.get_policy()
+            env = self.workers.local_worker().env
+            ok, reason = supports_jax_rollout_lane(policy, env)
+            if not ok:
+                raise ValueError(
+                    "config.env_backend='jax' but the device rollout "
+                    f"lane is unavailable: {reason}"
+                )
+            N = int(self.config.get("num_envs_per_worker", 1)) * max(
+                1, int(self.config.get("num_workers", 0))
+            )
+            T = int(self.config.get("rollout_fragment_length", 4))
+            eng = JaxRolloutEngine(
+                policy,
+                env,
+                N,
+                T,
+                seed=self.config.get("seed"),
+                postprocess="none",
+            )
+            self._jax_rollout_engine = eng
+            self._extra_metric_sources = [eng.get_metrics]
+        tree, count = eng.rollout()
+        buf = self.local_replay_buffer._buffer(DEFAULT_POLICY_ID)
+        if isinstance(buf, DeviceReplayBuffer):
+            buf.add_device_tree(tree)
+        else:
+            import jax
+
+            self.local_replay_buffer.add(
+                SampleBatch(jax.device_get(tree))
+            )
+        return count
+
     def training_step(self) -> Dict:
         """reference dqn.py:336 (shared off-policy training_step)."""
         config = self.config
-        if config.get("sample_async") and self.workers.remote_workers():
+        batch = None
+        if config.get("env_backend") == "jax":
+            self._counters[NUM_ENV_STEPS_SAMPLED] += (
+                self._jax_rollout_fill()
+            )
+        elif config.get("sample_async") and self.workers.remote_workers():
             # Overlap rollout with learning (reference's sample_async /
             # Ape-X decoupling): collect the fragment requested LAST
             # round, then immediately kick off the next one so the
@@ -806,21 +870,23 @@ class DQN(Algorithm):
                 max_env_steps=config.get("rollout_fragment_length", 4)
                 * max(1, config.get("num_envs_per_worker", 1)),
             )
-        # worker-compressed framestack fragments (compress_replay_obs
-        # pools) rebuild OBS/NEXT_OBS byte-identically here, before
-        # n-step folding reads NEXT_OBS and rows enter the replay ring
-        batch = self._materialize_compressed(batch)
-        n_step = config.get("n_step", 1)
-        if n_step > 1:
-            from ray_tpu.data.sample_batch import MultiAgentBatch
+        if batch is not None:  # actor lane (jax lane inserted above)
+            # worker-compressed framestack fragments
+            # (compress_replay_obs pools) rebuild OBS/NEXT_OBS
+            # byte-identically here, before n-step folding reads
+            # NEXT_OBS and rows enter the replay ring
+            batch = self._materialize_compressed(batch)
+            n_step = config.get("n_step", 1)
+            if n_step > 1:
+                from ray_tpu.data.sample_batch import MultiAgentBatch
 
-            if isinstance(batch, MultiAgentBatch):
-                for b in batch.policy_batches.values():
-                    adjust_nstep(n_step, config["gamma"], b)
-            else:
-                adjust_nstep(n_step, config["gamma"], batch)
-        self._counters[NUM_ENV_STEPS_SAMPLED] += batch.env_steps()
-        self.local_replay_buffer.add(batch)
+                if isinstance(batch, MultiAgentBatch):
+                    for b in batch.policy_batches.values():
+                        adjust_nstep(n_step, config["gamma"], b)
+                else:
+                    adjust_nstep(n_step, config["gamma"], batch)
+            self._counters[NUM_ENV_STEPS_SAMPLED] += batch.env_steps()
+            self.local_replay_buffer.add(batch)
 
         train_info = {}
         if (

@@ -213,6 +213,8 @@ class PPO(Algorithm):
 
     def training_step(self) -> Dict:
         """reference ppo.py:400."""
+        if self.config.get("env_backend") == "jax":
+            return self._training_step_jax_rollout()
         if self._use_sample_prefetch():
             return self._training_step_prefetch()
         train_batch = synchronous_parallel_sample(
@@ -248,6 +250,117 @@ class PPO(Algorithm):
         ):
             self.workers.sync_filters()
         return train_info
+
+    # -- device rollout lane (config.env_backend == "jax") ---------------
+
+    def _jax_engine(self):
+        """Lazily build the device rollout engine (docs/pipeline.md):
+        N = num_envs_per_worker × max(1, num_workers) env slots on the
+        learner mesh, T = rollout_fragment_length — one rollout is
+        exactly one train batch, so the lane's geometry contract is
+        ``train_batch_size == N·T`` (fail fast otherwise)."""
+        eng = self.__dict__.get("_jax_rollout_engine")
+        if eng is None:
+            from ray_tpu.execution.jax_rollout import (
+                JaxRolloutEngine,
+                supports_jax_rollout_lane,
+            )
+
+            policy = self.get_policy()
+            env = self.workers.local_worker().env
+            ok, reason = supports_jax_rollout_lane(policy, env)
+            if not ok:
+                raise ValueError(
+                    "config.env_backend='jax' but the device rollout "
+                    f"lane is unavailable: {reason}"
+                )
+            N = int(self.config.get("num_envs_per_worker", 1)) * max(
+                1, int(self.config.get("num_workers", 0))
+            )
+            T = int(self.config.get("rollout_fragment_length", 200))
+            if N * T != int(self.config["train_batch_size"]):
+                raise ValueError(
+                    "jax rollout lane needs train_batch_size == "
+                    "num_envs_per_worker * max(1, num_workers) * "
+                    f"rollout_fragment_length, got {N * T} != "
+                    f"{self.config['train_batch_size']}"
+                )
+            eng = JaxRolloutEngine(
+                policy,
+                env,
+                N,
+                T,
+                seed=self.config.get("seed"),
+                postprocess="gae",
+                standardize_advantages=True,
+            )
+            self._jax_rollout_engine = eng
+            # Algorithm._collect_rollout_metrics drains these — the
+            # lane's episode returns come back with the stats readback
+            self._extra_metric_sources = [eng.get_metrics]
+        return eng
+
+    def _training_step_jax_rollout(self) -> Dict:
+        """One training_step on the device rollout lane: K ×
+        [rollout(T) + GAE + the num_sgd_iter-epoch nest] with zero
+        rollout H2D — fused into ONE dispatch when
+        ``jax_fused_rollout`` (default), or rollout / learn as two
+        dispatches otherwise (the benchmark's middle lane)."""
+        from ray_tpu.execution.train_ops import (
+            NUM_AGENT_STEPS_TRAINED,
+            NUM_ENV_STEPS_TRAINED,
+        )
+
+        eng = self._jax_engine()
+        policy = self.get_policy()
+        bsize = eng.batch_size
+        K = self._resolve_superstep_k()
+        fused = bool(
+            self.config.get("jax_fused_rollout", True)
+        ) and getattr(policy, "supports_superstep", False)
+
+        if fused:
+            feed = eng.superstep_feed()
+            infos, carry, metrics, skipped = (
+                policy.learn_rollout_superstep(K, bsize, feed, k_max=K)
+            )
+            eng.advance(carry, metrics)
+            # host-side KL adaptation applies to the drained
+            # per-update stats in order (the one chain of staleness —
+            # docs/data_plane.md)
+            for info_i in infos:
+                info_i.update(policy.after_learn_on_batch(info_i))
+            info = infos[-1]
+            for s in skipped:
+                if s:
+                    self._counters["num_nan_batches_skipped"] += 1
+                    self._recovery.note_skipped_batch()
+            n_updates = K
+        else:
+            info = {}
+            for _ in range(K):
+                batch, bsize = eng.rollout()
+                info = policy.learn_on_device_batch(
+                    eng.learn_batch(batch), bsize
+                )
+            n_updates = K
+
+        info["cur_lr"] = policy.coeff_values.get("lr")
+        steps = n_updates * bsize
+        self._counters[NUM_ENV_STEPS_SAMPLED] += steps
+        self._counters[NUM_AGENT_STEPS_SAMPLED] += steps
+        self._counters[NUM_ENV_STEPS_TRAINED] += steps
+        self._counters[NUM_AGENT_STEPS_TRAINED] += steps
+        timestep = self._counters[NUM_ENV_STEPS_SAMPLED]
+        if self.workers.num_remote_workers() > 0:
+            self.workers.sync_weights(
+                global_vars={"timestep": timestep}
+            )
+        else:
+            self.workers.local_worker().set_global_vars(
+                {"timestep": timestep}
+            )
+        return {DEFAULT_POLICY_ID: info}
 
     # -- pipelined sampling (config.sample_prefetch) ---------------------
 

@@ -1,10 +1,18 @@
 from ray_tpu.env.env_context import EnvContext
 from ray_tpu.env.vector_env import VectorEnv
+from ray_tpu.env.jax_env import (
+    ArraySpec,
+    JaxVectorEnv,
+    JaxVectorEnvAdapter,
+)
 from ray_tpu.env.multi_agent_env import MultiAgentEnv, make_multi_agent
 from ray_tpu.env.registry import register_env, get_env_creator
 
 __all__ = [
+    "ArraySpec",
     "EnvContext",
+    "JaxVectorEnv",
+    "JaxVectorEnvAdapter",
     "VectorEnv",
     "MultiAgentEnv",
     "make_multi_agent",

@@ -1,0 +1,191 @@
+"""JAX-native classic-control / gridworld reference envs for the
+device rollout lane (docs/pipeline.md).
+
+``CartPoleJax`` is the classic-control reference: gymnasium
+CartPole-v1 dynamics (Euler-integrated cart-pole, same constants and
+termination bounds) as pure JAX functions — the cheap, well-understood
+env the lane-parity tests and benchmarks run on. ``GridRoomsJax`` is a
+small stochastic-start gridworld (four rooms, goal reward 1, step cost
+0) exercising integer state + discrete dynamics under the same API.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import numpy as np
+
+from ray_tpu.env.jax_env import ArraySpec, JaxVectorEnv
+
+
+class CartPoleJax(JaxVectorEnv):
+    """gymnasium CartPole-v1, jittable (same physics constants,
+    ±0.05 uniform reset, |x| > 2.4 / |θ| > 12° termination, reward 1
+    per step, truncation at ``max_steps`` — 500 like the gym
+    registration, configurable)."""
+
+    obs_spec = ArraySpec((4,), np.float32)
+    action_spec = ArraySpec((), np.int32, num_values=2)
+
+    _GRAVITY = 9.8
+    _MASSCART = 1.0
+    _MASSPOLE = 0.1
+    _LENGTH = 0.5  # half pole length
+    _FORCE_MAG = 10.0
+    _TAU = 0.02
+    _THETA_LIMIT = 12 * 2 * np.pi / 360
+    _X_LIMIT = 2.4
+
+    def __init__(self, config: Optional[Dict] = None):
+        super().__init__(config)
+        self.max_steps = int(self.config.get("max_steps", 500))
+
+    def init(self, key):
+        import jax.numpy as jnp
+
+        return {
+            "key": key,
+            "s": jnp.zeros(4, jnp.float32),
+            "steps": jnp.int32(0),
+        }
+
+    def reset(self, state):
+        import jax
+
+        key, sk = jax.random.split(state["key"])
+        s = jax.random.uniform(
+            sk, (4,), minval=-0.05, maxval=0.05
+        ).astype("float32")
+        state = {"key": key, "s": s, "steps": state["steps"] * 0}
+        return state, s
+
+    def step(self, state, action):
+        import jax.numpy as jnp
+
+        x, x_dot, theta, theta_dot = (
+            state["s"][0],
+            state["s"][1],
+            state["s"][2],
+            state["s"][3],
+        )
+        force = jnp.where(
+            action == 1,
+            jnp.float32(self._FORCE_MAG),
+            jnp.float32(-self._FORCE_MAG),
+        )
+        costh = jnp.cos(theta)
+        sinth = jnp.sin(theta)
+        total_mass = self._MASSCART + self._MASSPOLE
+        polemass_length = self._MASSPOLE * self._LENGTH
+        temp = (
+            force + polemass_length * theta_dot**2 * sinth
+        ) / total_mass
+        theta_acc = (self._GRAVITY * sinth - costh * temp) / (
+            self._LENGTH
+            * (4.0 / 3.0 - self._MASSPOLE * costh**2 / total_mass)
+        )
+        x_acc = temp - polemass_length * theta_acc * costh / total_mass
+        x = x + self._TAU * x_dot
+        x_dot = x_dot + self._TAU * x_acc
+        theta = theta + self._TAU * theta_dot
+        theta_dot = theta_dot + self._TAU * theta_acc
+        s = jnp.stack([x, x_dot, theta, theta_dot]).astype(jnp.float32)
+        steps = state["steps"] + 1
+        terminated = (jnp.abs(x) > self._X_LIMIT) | (
+            jnp.abs(theta) > self._THETA_LIMIT
+        )
+        truncated = steps >= self.max_steps
+        state = {"key": state["key"], "s": s, "steps": steps}
+        return (
+            state,
+            s,
+            jnp.float32(1.0),
+            terminated,
+            truncated,
+        )
+
+
+class GridRoomsJax(JaxVectorEnv):
+    """Four-rooms gridworld (``size`` × ``size``, walls on the mid row/
+    column with door gaps): start uniformly in the top-left room, goal
+    at the bottom-right corner (+1, terminate), 4 cardinal actions,
+    truncation at ``max_steps``. Obs is the (row, col) position scaled
+    to [0, 1]² float32 — MLP-friendly without one-hot plumbing."""
+
+    action_spec = ArraySpec((), np.int32, num_values=4)
+    obs_spec = ArraySpec((2,), np.float32)
+
+    def __init__(self, config: Optional[Dict] = None):
+        super().__init__(config)
+        self.size = int(self.config.get("size", 9))
+        self.max_steps = int(self.config.get("max_steps", 100))
+        if self.size % 2 == 0:
+            raise ValueError("GridRoomsJax needs an odd size")
+
+    def _wall(self, r, c):
+        import jax.numpy as jnp
+
+        mid = self.size // 2
+        door = mid // 2
+        on_mid = (r == mid) | (c == mid)
+        # four door gaps, one per wall arm
+        gap = (
+            ((r == mid) & ((c == door) | (c == self.size - 1 - door)))
+            | ((c == mid) & ((r == door) | (r == self.size - 1 - door)))
+        )
+        return on_mid & ~gap
+
+    def init(self, key):
+        import jax.numpy as jnp
+
+        return {
+            "key": key,
+            "pos": jnp.zeros(2, jnp.int32),
+            "steps": jnp.int32(0),
+        }
+
+    def _obs(self, pos):
+        import jax.numpy as jnp
+
+        return pos.astype(jnp.float32) / float(self.size - 1)
+
+    def reset(self, state):
+        import jax
+
+        key, sk = jax.random.split(state["key"])
+        room = self.size // 2  # top-left room spans [0, mid)
+        pos = jax.random.randint(sk, (2,), 0, room)
+        state = {
+            "key": key,
+            "pos": pos.astype("int32"),
+            "steps": state["steps"] * 0,
+        }
+        return state, self._obs(state["pos"])
+
+    def step(self, state, action):
+        import jax.numpy as jnp
+
+        deltas = jnp.array(
+            [[-1, 0], [1, 0], [0, -1], [0, 1]], jnp.int32
+        )
+        nxt = jnp.clip(
+            state["pos"] + deltas[action], 0, self.size - 1
+        )
+        blocked = self._wall(nxt[0], nxt[1])
+        pos = jnp.where(blocked, state["pos"], nxt)
+        goal = jnp.all(pos == self.size - 1)
+        steps = state["steps"] + 1
+        state = {"key": state["key"], "pos": pos, "steps": steps}
+        return (
+            state,
+            self._obs(pos),
+            goal.astype(jnp.float32),
+            goal,
+            steps >= self.max_steps,
+        )
+
+
+from ray_tpu.env.registry import register_env  # noqa: E402
+
+register_env("CartPoleJax-v0", lambda cfg: CartPoleJax(cfg))
+register_env("GridRoomsJax-v0", lambda cfg: GridRoomsJax(cfg))

@@ -1,0 +1,200 @@
+"""PongLiteJax: the jittable port of :mod:`ray_tpu.env.pong_lite` for
+the device rollout lane (docs/pipeline.md).
+
+Same observation/compute shape as the numpy PongLite — 84x84 uint8
+grayscale frames rendered from (ball, paddle) state, Discrete(3)
+actions, +1 paddle contact / -1 miss, ``rallies`` rallies per episode,
+truncation at ``max_steps`` — expressed as pure JAX functions over an
+explicit state dict so act → step → postprocess lowers into one
+compiled program on the learner mesh. Dynamics are a faithful port
+(same constants, same update order); the serve randomness comes from
+the state's carried PRNG key (jax threefry) instead of the numpy
+generator, so episode CONTENT differs from the numpy env while the
+task is identical. Parity between the two LANES (device engine vs the
+host adapter) is exact because both run these same functions.
+
+Frames render flat (84, 84, 1) — the device lane trains straight from
+single frames (no host-side FrameStack wrapper; a stacking variant
+belongs to the wrapper layer, not the env).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import numpy as np
+
+from ray_tpu.env.jax_env import ArraySpec, JaxVectorEnv
+
+_SIZE = 84
+_PADDLE_H = 12
+_PADDLE_W = 2
+_BALL = 2
+_SPEED = 2.2
+
+
+class PongLiteJax(JaxVectorEnv):
+    obs_spec = ArraySpec((_SIZE, _SIZE, 1), np.uint8)
+    action_spec = ArraySpec((), np.int32, num_values=3)
+
+    def __init__(self, config: Optional[Dict] = None):
+        super().__init__(config)
+        cfg = self.config
+        self.rallies_per_episode = int(cfg.get("rallies", 21))
+        self.max_steps = int(cfg.get("max_steps", 1000))
+        self.paddle_speed = float(cfg.get("paddle_speed", 3.0))
+
+    # -- helpers ---------------------------------------------------------
+
+    @staticmethod
+    def _serve(key):
+        """(by, vx, vy) of a fresh serve, drawn from ``key`` (the jax
+        counterpart of PongLite._serve; bx is the fixed serve line)."""
+        import jax
+        import jax.numpy as jnp
+
+        k1, k2 = jax.random.split(key)
+        by = jax.random.uniform(
+            k1, (), minval=float(_BALL), maxval=float(_SIZE - _BALL)
+        )
+        angle = jax.random.uniform(k2, (), minval=-0.7, maxval=0.7)
+        return by, _SPEED * jnp.cos(angle), _SPEED * jnp.sin(angle)
+
+    @staticmethod
+    def _render(py, bx, by):
+        import jax.numpy as jnp
+
+        rows = jnp.arange(_SIZE)
+        cols = jnp.arange(_SIZE)
+        byi = by.astype(jnp.int32)
+        bxi = bx.astype(jnp.int32)
+        pyi = py.astype(jnp.int32)
+        ball = (
+            (rows[:, None] >= jnp.maximum(0, byi - _BALL))
+            & (rows[:, None] < byi + _BALL)
+            & (cols[None, :] >= jnp.maximum(0, bxi - _BALL))
+            & (cols[None, :] < bxi + _BALL)
+        )
+        paddle = (
+            (rows[:, None] >= jnp.maximum(0, pyi - _PADDLE_H // 2))
+            & (rows[:, None] < pyi + _PADDLE_H // 2)
+            & (cols[None, :] >= _SIZE - _PADDLE_W - 1)
+            & (cols[None, :] < _SIZE - 1)
+        )
+        frame = jnp.where(ball, 255, jnp.where(paddle, 180, 0))
+        return frame.astype(jnp.uint8)[:, :, None]
+
+    # -- JaxVectorEnv ----------------------------------------------------
+
+    def init(self, key):
+        import jax.numpy as jnp
+
+        return {
+            "key": key,
+            "py": jnp.float32(0.0),
+            "bx": jnp.float32(0.0),
+            "by": jnp.float32(0.0),
+            "vx": jnp.float32(0.0),
+            "vy": jnp.float32(0.0),
+            "rallies": jnp.int32(0),
+            "steps": jnp.int32(0),
+        }
+
+    def reset(self, state):
+        import jax
+        import jax.numpy as jnp
+
+        key, sk = jax.random.split(state["key"])
+        by, vx, vy = self._serve(sk)
+        state = {
+            "key": key,
+            "py": jnp.float32(_SIZE / 2.0),
+            "bx": jnp.float32(_SIZE * 0.3),
+            "by": by,
+            "vx": vx,
+            "vy": vy,
+            "rallies": jnp.int32(0),
+            "steps": jnp.int32(0),
+        }
+        return state, self._render(
+            state["py"], state["bx"], state["by"]
+        )
+
+    def step(self, state, action):
+        import jax
+        import jax.numpy as jnp
+
+        speed = jnp.float32(self.paddle_speed)
+        py = state["py"]
+        py = jnp.where(
+            action == 1, py - speed, jnp.where(action == 2, py + speed, py)
+        )
+        py = jnp.clip(
+            py, _PADDLE_H / 2.0, float(_SIZE - _PADDLE_H / 2)
+        )
+
+        bx = state["bx"] + state["vx"]
+        by = state["by"] + state["vy"]
+        vx, vy = state["vx"], state["vy"]
+        # top/bottom and left-wall bounces (same order as the numpy env)
+        wall = (by <= _BALL) | (by >= _SIZE - _BALL)
+        vy = jnp.where(wall, -vy, vy)
+        by = jnp.clip(by, float(_BALL), float(_SIZE - _BALL))
+        left = bx <= _BALL
+        vx = jnp.where(left, jnp.abs(vx), vx)
+        bx = jnp.where(left, jnp.float32(_BALL), bx)
+
+        paddle_x = _SIZE - _PADDLE_W - 1
+        at_paddle = bx >= paddle_x - _BALL
+        hit = at_paddle & (
+            jnp.abs(by - py) <= _PADDLE_H / 2.0 + _BALL
+        )
+        reward = jnp.where(
+            at_paddle,
+            jnp.where(hit, jnp.float32(1.0), jnp.float32(-1.0)),
+            jnp.float32(0.0),
+        )
+        # contact: reflect + spin + pin to the contact line
+        vx = jnp.where(hit, -jnp.abs(vx), vx)
+        vy = jnp.where(
+            hit, vy + 0.5 * (by - py) / (_PADDLE_H / 2.0), vy
+        )
+        bx = jnp.where(hit, jnp.float32(paddle_x - _BALL), bx)
+
+        rallies = state["rallies"] + at_paddle.astype(jnp.int32)
+        # serve a new rally (hit or miss) while the episode continues;
+        # the draw comes from the carried key, advanced every step so
+        # both lanes consume the identical stream
+        key, sk = jax.random.split(state["key"])
+        s_by, s_vx, s_vy = self._serve(sk)
+        serve = at_paddle & (rallies < self.rallies_per_episode)
+        bx = jnp.where(serve, jnp.float32(_SIZE * 0.3), bx)
+        by = jnp.where(serve, s_by, by)
+        vx = jnp.where(serve, s_vx, vx)
+        vy = jnp.where(serve, s_vy, vy)
+
+        steps = state["steps"] + 1
+        terminated = rallies >= self.rallies_per_episode
+        truncated = steps >= self.max_steps
+        state = {
+            "key": key,
+            "py": py,
+            "bx": bx,
+            "by": by,
+            "vx": vx,
+            "vy": vy,
+            "rallies": rallies,
+            "steps": steps,
+        }
+        return (
+            state,
+            self._render(py, bx, by),
+            reward,
+            terminated,
+            truncated,
+        )
+
+
+from ray_tpu.env.registry import register_env  # noqa: E402
+
+register_env("PongLiteJax-v0", lambda cfg: PongLiteJax(cfg))

@@ -23,11 +23,15 @@ def get_env_creator(env_spec) -> Callable[[EnvContext], Any]:
     if env_spec in _env_registry:
         return _env_registry[env_spec]
     if isinstance(env_spec, str) and (
-        env_spec.startswith(("PongLite", "Synthetic"))
+        env_spec.startswith(
+            ("PongLite", "Synthetic", "CartPoleJax", "GridRoomsJax")
+        )
     ):
         # in-repo envs register on import; pull them in so yaml/CLI
         # runs can name them without a registration preamble
         # (reference tuned-example UX)
+        import ray_tpu.env.jax_control  # noqa: F401
+        import ray_tpu.env.jax_pong  # noqa: F401
         import ray_tpu.env.pong_lite  # noqa: F401
         import ray_tpu.env.synthetic_env  # noqa: F401
 

@@ -4,6 +4,17 @@ Counterpart of the reference's ``rllib/env/vector_env.py:23``
 (``vectorize_gym_envs :42``). Steps sub-envs serially in-process (they live
 on CPU actors); auto-resets on episode end and surfaces the terminal
 observation so the sampler can bootstrap correctly.
+
+**Terminal-observation contract** (audited in tests/test_jax_env.py —
+the device rollout lane must match it exactly): ``vector_step`` never
+auto-resets; at a ``terminated | truncated`` step it returns the
+env's FINAL observation, which the sampler records as that row's
+NEXT_OBS (the GAE bootstrap reads it: 0 across ``terminated``,
+V(final obs) across ``truncated``). The sampler then calls
+``reset_at(index)`` and the RESET observation becomes the successor
+row's OBS. The JAX-native counterpart pins the same contract in
+``env/jax_env.py`` (its adapter implements THIS protocol over the
+pure-function API).
 """
 
 from __future__ import annotations

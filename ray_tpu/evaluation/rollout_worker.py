@@ -82,7 +82,23 @@ class RolloutWorker:
                 return env_creator(ctx)
 
             probe = make_sub_env(0)
-            if isinstance(probe, MultiAgentEnv):
+            from ray_tpu.env.jax_env import (
+                JaxVectorEnv,
+                JaxVectorEnvAdapter,
+            )
+
+            if isinstance(probe, JaxVectorEnv):
+                # JAX-native env on the host (actor) lane: ONE adapter
+                # drives all sub-env slots through jitted vmapped
+                # step/reset — the same pure functions the device
+                # rollout lane scans over, so the two lanes share
+                # dynamics and per-env key streams (docs/pipeline.md)
+                self._multiagent_env = False
+                self.env = probe
+                self.vector_env = JaxVectorEnvAdapter(
+                    probe, num_envs, seed=seed
+                )
+            elif isinstance(probe, MultiAgentEnv):
                 self.env = probe
                 self._multiagent_env = True
             else:

@@ -1,0 +1,433 @@
+"""Device rollout engine: act → step → postprocess as ONE mesh program.
+
+The device half of the two rollout lanes (docs/pipeline.md). For a
+:class:`~ray_tpu.env.jax_env.JaxVectorEnv`, the whole rollout —
+policy forward + exploration sampling, vmapped env step, auto-reset,
+GAE postprocess, advantage standardization — lowers into one
+``sharded_jit`` program over the learner mesh, with the env-state tree
+row-sharded like a batch (``sharding/specs.py``) and the policy's rng
+threaded in the host-visible split order (one split per env step — the
+exact stream the actor lane's local worker consumes), so a fixed seed
+produces the actor lane's trajectories bit for bit
+(tests/test_jax_env.py).
+
+Two consumption modes:
+
+- :meth:`JaxRolloutEngine.rollout` — one dispatch produces a
+  device-resident trajectory batch (``(N·T, ...)`` columns, env-major
+  row order like the host lane's concat). On-policy algorithms learn
+  from it in place; off-policy algorithms insert the rows into a
+  :class:`~ray_tpu.execution.replay_buffer.DeviceReplayBuffer` via
+  ``add_device_tree`` — rollout rows never touch the host either way.
+- :meth:`JaxRolloutEngine.superstep_feed` — the feed descriptor for
+  ``JaxPolicy.learn_rollout_superstep``: K × [rollout + SGD-nest
+  update] fuse into ONE dispatched program
+  (``sharding/superstep.build_superstep_fn``'s rollout feed), zero
+  batch bytes over H2D.
+
+Auto-reset follows the terminal-observation contract of
+``env/jax_env.py``: NEXT_OBS is the final (pre-reset) observation, the
+successor row's OBS the reset observation; GAE bootstraps 0 across
+``terminated`` and V(final obs) across ``truncated``
+(``ops/gae.compute_gae_fragment``) — matching the host sampler +
+``evaluation/postprocessing.py`` exactly.
+
+Episode returns/lengths accumulate in the carry and drain with the
+stats readback as ``(T, N)`` masked arrays — the lane's RolloutMetrics
+come back without any per-step host work.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ray_tpu.data.sample_batch import SampleBatch
+from ray_tpu.env.jax_env import JaxVectorEnv, env_keys, tree_where
+from ray_tpu.evaluation.metrics import RolloutMetrics
+from ray_tpu.telemetry import metrics as telemetry_metrics
+from ray_tpu.util import tracing
+
+# columns the PPO-family learn feed keeps (mirrors
+# ``_batch_to_train_tree`` semantics: NEXT_OBS dropped when the loss
+# never reads it — JaxPolicy._ship_next_obs)
+_LEARN_DROP = (SampleBatch.NEXT_OBS, SampleBatch.AGENT_INDEX, SampleBatch.T)
+
+
+class RolloutSuperstepFeed:
+    """Descriptor handing the engine's per-shard rollout body + env
+    carry to ``JaxPolicy.learn_rollout_superstep`` (the rollout feed
+    of ``build_superstep_fn``)."""
+
+    def __init__(self, carry, body, steps: int, key):
+        self.carry = carry
+        self.body = body
+        self.steps = int(steps)
+        self.key = key
+
+
+def supports_jax_rollout_lane(policy, env) -> Tuple[bool, str]:
+    """(ok, reason): whether (policy, env) can run on the device
+    rollout lane. Callers fail fast at config time with ``reason``."""
+    if not isinstance(env, JaxVectorEnv):
+        return False, f"env {type(env).__name__} is not a JaxVectorEnv"
+    if not getattr(policy, "supports_jax_rollout", False):
+        return False, (
+            f"policy {type(policy).__name__} cannot lower its act "
+            "path (recurrent model, stateful exploration, or non-mesh "
+            "backend)"
+        )
+    return True, ""
+
+
+class JaxRolloutEngine:
+    """One policy + one JaxVectorEnv, N env slots on the learner mesh.
+
+    ``postprocess="gae"`` computes advantages/value targets in-program
+    (on-policy); ``postprocess="none"`` emits raw transition rows
+    (replay fill). ``seed`` follows the actor lane's worker
+    convention (config seed; env ``i`` keyed ``seed + i``)."""
+
+    def __init__(
+        self,
+        policy,
+        env: JaxVectorEnv,
+        num_envs: int,
+        rollout_length: int,
+        *,
+        seed: Optional[int] = None,
+        postprocess: str = "gae",
+        standardize_advantages: bool = True,
+    ):
+        import jax
+
+        from ray_tpu import sharding as sharding_lib
+
+        ok, reason = supports_jax_rollout_lane(policy, env)
+        if not ok:
+            raise ValueError(f"jax rollout lane unavailable: {reason}")
+        self.policy = policy
+        self.env = env
+        self.N = int(num_envs)
+        self.T = int(rollout_length)
+        self.mesh = policy.mesh
+        self.n_shards = sharding_lib.num_shards(self.mesh)
+        if self.N % self.n_shards:
+            raise ValueError(
+                f"num_envs {self.N} must divide the {self.n_shards} "
+                "data shards (row-sharded env states)"
+            )
+        if postprocess not in ("gae", "none"):
+            raise ValueError(f"unknown postprocess {postprocess!r}")
+        self.postprocess = postprocess
+        self.standardize = bool(standardize_advantages)
+        self.gamma = float(policy.config.get("gamma", 0.99))
+        self.lambda_ = float(policy.config.get("lambda", 1.0))
+        self._seed = seed
+        self._metrics: List[RolloutMetrics] = []
+        self._rollout_fn = None
+        self._body = None
+        self.batch_size = self.N * self.T
+
+        # initial env carry, resident and row-sharded from step zero
+        keys = env_keys(seed, self.N)
+        state = jax.jit(jax.vmap(env.init))(keys)
+        state, obs = jax.jit(jax.vmap(env.reset))(state)
+        carry = {
+            "env": state,
+            "obs": obs,
+            "ep_ret": jax.numpy.zeros(self.N, jax.numpy.float32),
+            "ep_len": jax.numpy.zeros(self.N, jax.numpy.int32),
+        }
+        self._carry = jax.device_put(
+            carry, sharding_lib.batch_sharded(self.mesh)
+        )
+
+    # -- the per-shard rollout body --------------------------------------
+
+    def _rollout_body(self):
+        """``fn(params, carry, ro_rngs (T, 2), coeffs) -> (carry,
+        batch, metrics)`` over THIS SHARD's env rows; runs inside
+        ``shard_map`` (superstep scan slot or the standalone rollout
+        program — same body, same numerics)."""
+        if self._body is not None:
+            return self._body
+        import jax
+        import jax.numpy as jnp
+
+        from ray_tpu import sharding as sharding_lib
+        from ray_tpu.ops.gae import compute_gae_fragment
+
+        policy = self.policy
+        env = self.env
+        axis = sharding_lib.data_axis(self.mesh)
+        n_loc = self.N // self.n_shards
+        T = self.T
+        step_b = jax.vmap(env.step)
+        reset_b = jax.vmap(env.reset)
+        gamma, lam = self.gamma, self.lambda_
+        mode = self.postprocess
+        standardize = self.standardize and mode == "gae"
+        value_fwd = policy.model_forward
+
+        def body(params, carry, ro_rngs, coeffs):
+            def step(c, key_t):
+                env_state, obs, ep_ret, ep_len = c
+                # pin each sub-program's fusion boundary so it
+                # compiles like the actor lane's standalone jitted
+                # programs (action fn / vmapped env step / reset) —
+                # the lane parity contract (docs/data_plane.md)
+                params_b, obs_b, key_t = jax.lax.optimization_barrier(
+                    (params, obs, key_t)
+                )
+                actions, _, extra, _ = policy._action_step_body(
+                    params_b, obs_b, key_t, coeffs,
+                    explore=True, expl_state=(),
+                )
+                # pin the OUTPUTS as well: the value head's result
+                # feeds the in-program GAE below, and without a
+                # barrier XLA fuses it differently than the actor
+                # lane's standalone action program (last-ulp drift)
+                actions, extra = jax.lax.optimization_barrier(
+                    (actions, extra)
+                )
+                env_state_b, actions_b = jax.lax.optimization_barrier(
+                    (env_state, actions)
+                )
+                env_state2, obs2, rew, term, trunc = step_b(
+                    env_state_b, actions_b
+                )
+                done = term | trunc
+                env_state2b = jax.lax.optimization_barrier(env_state2)
+                env_state3, obs3 = reset_b(env_state2b)
+                rew = rew.astype(jnp.float32)
+                ep_ret2 = ep_ret + rew
+                ep_len2 = ep_len + 1
+                row = {
+                    SampleBatch.OBS: obs,
+                    SampleBatch.NEXT_OBS: obs2,
+                    SampleBatch.ACTIONS: actions,
+                    SampleBatch.REWARDS: rew,
+                    SampleBatch.TERMINATEDS: term,
+                    SampleBatch.TRUNCATEDS: trunc,
+                    SampleBatch.T: ep_len,
+                    **extra,
+                }
+                if mode == "gae":
+                    # fresh V(final obs) for boundary/tail bootstraps
+                    # — same (N,) forward shape as the act-path value,
+                    # so the two lanes' bootstraps agree
+                    obs2_b = jax.lax.optimization_barrier(obs2)
+                    _, v_next, _ = value_fwd(params_b, obs2_b)
+                    row["_v_next"] = v_next
+                metrics = {
+                    "ep_return": jnp.where(done, ep_ret2, 0.0),
+                    "ep_length": jnp.where(done, ep_len2, 0),
+                    "done": done,
+                }
+                env_state = tree_where(done, env_state3, env_state2)
+                obs_next = tree_where(done, obs3, obs2)
+                ep_ret = jnp.where(done, 0.0, ep_ret2)
+                ep_len = jnp.where(done, 0, ep_len2)
+                return (
+                    (env_state, obs_next, ep_ret, ep_len),
+                    (row, metrics),
+                )
+
+            c0 = (
+                carry["env"],
+                carry["obs"],
+                carry["ep_ret"],
+                carry["ep_len"],
+            )
+            (env_state, obs, ep_ret, ep_len), (rows, metrics) = (
+                jax.lax.scan(step, c0, ro_rngs)
+            )
+            carry = {
+                "env": env_state,
+                "obs": obs,
+                "ep_ret": ep_ret,
+                "ep_len": ep_len,
+            }
+            # global env index of each local row (host-lane
+            # AGENT_INDEX semantics)
+            shard0 = jax.lax.axis_index(axis) * n_loc
+            rows[SampleBatch.AGENT_INDEX] = jnp.broadcast_to(
+                shard0 + jnp.arange(n_loc, dtype=jnp.int32), (T, n_loc)
+            )
+            if mode == "gae":
+                values = rows[SampleBatch.VF_PREDS]  # (T, N)
+                fresh = rows.pop("_v_next")  # (T, N)
+                term = rows[SampleBatch.TERMINATEDS]
+                done = term | rows[SampleBatch.TRUNCATEDS]
+                # interior rows reuse the act-path values exactly like
+                # the host lane's vpred_t[1:]; boundary/tail rows use
+                # the fresh terminal-observation values
+                shifted = jnp.concatenate(
+                    [values[1:], fresh[-1:]], axis=0
+                )
+                next_values = jnp.where(done, fresh, shifted)
+                adv, vt = compute_gae_fragment(
+                    rows[SampleBatch.REWARDS].T,
+                    values.T,
+                    next_values.T,
+                    term.T,
+                    done.T,
+                    gamma,
+                    lam,
+                )  # (N, T)
+                if standardize:
+                    m = jax.lax.pmean(adv.mean(), axis)
+                    var = jax.lax.pmean(((adv - m) ** 2).mean(), axis)
+                    adv = (adv - m) / jnp.maximum(
+                        1e-4, jnp.sqrt(var)
+                    )
+                rows[SampleBatch.ADVANTAGES] = adv.T
+                rows[SampleBatch.VALUE_TARGETS] = vt.T
+
+            # (T, N, ...) -> env-major (N*T, ...) rows, the host
+            # lane's concat order
+            def to_rows(v):
+                v = jnp.swapaxes(v, 0, 1)
+                return v.reshape((n_loc * T,) + v.shape[2:])
+
+            batch = {k: to_rows(v) for k, v in rows.items()}
+            return carry, batch, metrics
+
+        self._body = body
+        return body
+
+    # -- fused rollout+learn feed ----------------------------------------
+
+    def superstep_feed(self) -> RolloutSuperstepFeed:
+        self._pre_dispatch()
+        return RolloutSuperstepFeed(
+            carry=self._carry,
+            body=self._learn_feed_body(),
+            steps=self.T,
+            key=(
+                "jax_rollout",
+                type(self.env).__name__,
+                self.N,
+                self.T,
+                self.postprocess,
+                self.standardize,
+            ),
+        )
+
+    def _learn_feed_body(self):
+        """The superstep-slot body: rollout, then hand the UPDATE the
+        learn-column subset (NEXT_OBS etc. stay out of the nest's
+        minibatch gathers, mirroring ``_batch_to_train_tree``)."""
+        body = self._rollout_body()
+
+        def fn(params, carry, ro_rngs, coeffs):
+            carry, batch, metrics = body(params, carry, ro_rngs, coeffs)
+            learn = {
+                k: v for k, v in batch.items() if k not in _LEARN_DROP
+            }
+            return carry, learn, metrics
+
+        return fn
+
+    def advance(self, carry, metrics) -> None:
+        """Commit the carry a fused superstep returned and absorb its
+        drained (host numpy) metrics tree."""
+        self._carry = carry
+        self._record_metrics(metrics)
+        telemetry_metrics.inc_env_steps_on_device(
+            int(np.asarray(metrics["done"]).size)
+        )
+
+    # -- standalone rollout (replay fill / per-update lane) --------------
+
+    def rollout(self):
+        """One dispatched rollout: returns ``(device batch tree,
+        batch_size)`` with the env carry advanced and episode metrics
+        absorbed. The policy's rng is split T times host-side (the
+        actor lane's per-step order)."""
+        import jax
+        import jax.numpy as jnp
+
+        from ray_tpu import sharding as sharding_lib
+
+        policy = self.policy
+        if self._rollout_fn is None:
+            from jax.sharding import PartitionSpec as P
+
+            axis = sharding_lib.data_axis(self.mesh)
+            body = self._rollout_body()
+
+            def program(params, carry, ro_rngs, coeffs):
+                return body(params, carry, ro_rngs, coeffs)
+
+            sharded = jax.shard_map(
+                program,
+                mesh=self.mesh,
+                in_specs=(P(), P(axis), P(), P()),
+                out_specs=(
+                    P(axis),
+                    P(axis),
+                    P(None, axis),
+                ),
+            )
+            rep = sharding_lib.replicated(self.mesh)
+            dat = sharding_lib.batch_sharded(self.mesh)
+            met = sharding_lib.batch_sharded(self.mesh, ndim_prefix=2)
+            self._rollout_fn = sharding_lib.sharded_jit(
+                sharded,
+                in_specs=(rep, dat, rep, rep),
+                out_specs=(dat, dat, met),
+                label=(
+                    f"jax_rollout[{type(self.env).__name__}:"
+                    f"{self.N}x{self.T}]"
+                ),
+            )
+        coeffs = self._pre_dispatch()
+        keys = []
+        for _ in range(self.T):
+            policy._rng, r = jax.random.split(policy._rng)
+            keys.append(r)
+        ro_rngs = jnp.stack(keys)
+        telemetry_metrics.add_h2d_bytes("rollout", int(ro_rngs.nbytes))
+        with tracing.start_span(
+            "rollout:device", num_envs=self.N, steps=self.T
+        ):
+            self._carry, batch, metrics = self._rollout_fn(
+                policy.params, self._carry, ro_rngs, coeffs
+            )
+            metrics = jax.device_get(metrics)
+        self._record_metrics(metrics)
+        telemetry_metrics.inc_env_steps_on_device(self.batch_size)
+        return dict(batch), self.batch_size
+
+    def learn_batch(self, batch: Dict[str, Any]) -> Dict[str, Any]:
+        """The learn-column subset of a :meth:`rollout` batch (what
+        the fused feed hands the nest)."""
+        return {k: v for k, v in batch.items() if k not in _LEARN_DROP}
+
+    def _pre_dispatch(self):
+        """Host-side per-dispatch upkeep mirroring compute_actions:
+        advance exploration schedules, then snapshot coeffs."""
+        policy = self.policy
+        policy.exploration.update_coeffs(
+            policy.coeff_values, policy.global_timestep
+        )
+        return policy._coeff_array()
+
+    # -- episode metrics --------------------------------------------------
+
+    def _record_metrics(self, metrics) -> None:
+        done = np.asarray(metrics["done"]).reshape(-1)
+        if not done.any():
+            return
+        rets = np.asarray(metrics["ep_return"]).reshape(-1)[done]
+        lens = np.asarray(metrics["ep_length"]).reshape(-1)[done]
+        for r, l in zip(rets, lens):
+            self._metrics.append(RolloutMetrics(int(l), float(r)))
+
+    def get_metrics(self) -> List[RolloutMetrics]:
+        out = self._metrics
+        self._metrics = []
+        return out

@@ -628,6 +628,40 @@ class DeviceReplayBuffer:
         self._num_added += n
         self._report_occupancy()
 
+    def add_device_tree(self, tree: Dict[str, Any]) -> None:
+        """Insert rows that are ALREADY device-resident (the jax
+        rollout lane: in-program rollout rows — docs/pipeline.md).
+        Zero H2D: the same donated scatter as :meth:`add_tree` runs on
+        the resident columns. Ring bookkeeping, the host index
+        generator, and (in the prioritized subclass) the sum-tree
+        stream are EXACTLY the host insert's — inserting the same rows
+        from either side leaves every subsequent ``sample()`` draw
+        bit-identical (tests/test_jax_env.py). A spilled buffer pulls
+        the rows back to its host ring (placement changes, sampling
+        doesn't)."""
+        tree = dict(tree)
+        if not tree:
+            return
+        n = int(next(iter(tree.values())).shape[0])
+        if n == 0:
+            return
+        if not self._ensure_storage(tree):
+            import jax
+
+            self._host.add(SampleBatch(jax.device_get(tree)))
+            self._report_occupancy()
+            return
+        if self._insert_fn is None:
+            self._insert_fn = self._build_insert_fn()
+        pos = (self._idx + np.arange(n)) % self.capacity
+        self._store = self._insert_fn(
+            self._store, tree, pos.astype(np.int32)
+        )
+        self._idx = int((self._idx + n) % self.capacity)
+        self._size = int(min(self._size + n, self.capacity))
+        self._num_added += n
+        self._report_occupancy()
+
     def _report_occupancy(self) -> None:
         from ray_tpu.telemetry import metrics as telemetry_metrics
 
@@ -857,6 +891,40 @@ class DevicePrioritizedReplayBuffer(_PrioritySampling, DeviceReplayBuffer):
             return
         self.update_priorities(idx, np.asarray(priorities, np.float64))
 
+    def add_device_tree(
+        self,
+        tree: Dict[str, Any],
+        priorities: Optional[np.ndarray] = None,
+    ) -> None:
+        """Device-resident insert with the host priority protocol:
+        new rows enter the sum/min trees at max priority (or the
+        caller's), exactly like :meth:`add_tree` — the host tree
+        stream stays bit-exact whichever side the rows came from."""
+        tree = dict(tree)
+        if not tree:
+            return
+        n = int(next(iter(tree.values())).shape[0])
+        if n == 0:
+            return
+        if priorities is None:
+            priorities = np.full(n, self._max_priority)
+        if self._host is not None:
+            import jax
+
+            self._host.add_with_priorities(
+                SampleBatch(jax.device_get(tree)), priorities
+            )
+            self._report_occupancy()
+            return
+        idx = (self._idx + np.arange(n)) % self.capacity
+        DeviceReplayBuffer.add_device_tree(self, tree)
+        if self._host is not None:  # this insert triggered the spill
+            self._host.update_priorities(
+                idx, np.asarray(priorities, np.float64)
+            )
+            return
+        self.update_priorities(idx, np.asarray(priorities, np.float64))
+
     def sample(self, num_items: int, beta: float = 0.4):
         if self._host is not None:
             return self._host.sample(num_items, beta=beta)
@@ -971,6 +1039,24 @@ class MultiAgentReplayBuffer:
                 buf.add_tree(tree)
             else:
                 buf.add(sb)
+
+    def add_device_tree(
+        self, tree: Dict[str, Any], policy_id: Optional[str] = None
+    ) -> None:
+        """Device-resident insert for the jax rollout lane: rows from
+        an in-program rollout land in ``policy_id``'s buffer without
+        touching the host. Requires ``device_resident=True`` (a host
+        ring can't absorb device rows without the very D2H round trip
+        this path exists to avoid)."""
+        from ray_tpu.data.sample_batch import DEFAULT_POLICY_ID
+
+        buf = self._buffer(policy_id or DEFAULT_POLICY_ID)
+        if not isinstance(buf, DeviceReplayBuffer):
+            raise TypeError(
+                "add_device_tree needs a device-resident buffer "
+                "(config replay_device_resident)"
+            )
+        buf.add_device_tree(tree)
 
     def sample(self, num_items: int, **kwargs):
         from ray_tpu.data.sample_batch import MultiAgentBatch

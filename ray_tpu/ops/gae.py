@@ -119,6 +119,58 @@ def compute_gae(
     return adv, value_targets
 
 
+def compute_gae_fragment(
+    rewards: jnp.ndarray,
+    values: jnp.ndarray,
+    next_values: jnp.ndarray,
+    terminateds: jnp.ndarray,
+    dones: jnp.ndarray,
+    gamma: float = 0.99,
+    lambda_: float = 1.0,
+):
+    """GAE over (B, T) fragments with the HOST lane's truncation
+    semantics (``evaluation/postprocessing.py``): bootstrap 0 across a
+    *terminated* step, bootstrap ``next_values`` (= V of the final,
+    pre-reset observation) across a *truncated* one, and stop the
+    advantage accumulation at EVERY episode boundary either way. This
+    is the device rollout lane's postprocess
+    (``execution/jax_rollout.py``); :func:`compute_gae` above keeps the
+    simpler single-mask form for fragments without mid-stream
+    truncation.
+
+    Args:
+        rewards/values: (B, T) float.
+        next_values: (B, T) float — V(NEXT_OBS[t]) per row, i.e. the
+            value of the observation AFTER step t *before* any
+            auto-reset (for non-terminal steps this equals
+            values[t+1]; at a truncation it is the terminal
+            observation's value, exactly what the host lane's
+            ``value_batch(last_obs)`` bootstrap uses).
+        terminateds/dones: (B, T) bool; ``dones = terminateds |
+            truncateds``.
+
+    Returns (advantages, value_targets), both (B, T) float32.
+    """
+    rewards = rewards.astype(jnp.float32)
+    values = values.astype(jnp.float32)
+    next_values = next_values.astype(jnp.float32)
+    not_term = 1.0 - terminateds.astype(jnp.float32)
+    not_done = 1.0 - dones.astype(jnp.float32)
+
+    deltas = rewards + gamma * next_values * not_term - values
+    coeffs = gamma * lambda_ * not_done
+
+    def combine(a, b):
+        ca, va = a
+        cb, vb = b
+        return ca * cb, va * cb + vb
+
+    _, adv = jax.lax.associative_scan(
+        combine, (coeffs, deltas), reverse=True, axis=deltas.ndim - 1
+    )
+    return adv, adv + values
+
+
 def standardize(x: jnp.ndarray, eps: float = 1e-4) -> jnp.ndarray:
     """Zero-mean unit-variance normalization (reference ppo.py:415
     standardize_fields)."""

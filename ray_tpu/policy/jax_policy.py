@@ -297,6 +297,51 @@ class JaxPolicy(Policy):
 
     # -- inference -------------------------------------------------------
 
+    def _action_step_body(
+        self, params, obs, rng, coeffs, *, explore=True, expl_state=()
+    ):
+        """The non-recurrent per-step action computation — model
+        forward, distribution, exploration sampling, extra fetches —
+        as a pure traced body: ``(actions, state_out, extra,
+        expl_state)``. Shared by the jitted ``compute_actions``
+        program (:meth:`_build_action_fn`) and the device rollout lane
+        (``execution/jax_rollout.py``), with the SAME internal rng
+        split structure, so the two rollout lanes consume identical
+        key streams per step (the fixed-seed parity contract of
+        docs/pipeline.md)."""
+        rng_m, rng = jax.random.split(rng)
+        dist_inputs, value, state_out = self._apply_model_for_actions(
+            params, obs, rng_m, explore
+        )
+        dist = self.dist_class(dist_inputs)
+        rng_x, rng = jax.random.split(rng)
+        actions, logp, expl_state = self.exploration.sample_fn(
+            dist, rng_x, explore, coeffs, expl_state
+        )
+        extra = {
+            SampleBatch.ACTION_DIST_INPUTS: dist_inputs,
+            SampleBatch.ACTION_LOGP: logp,
+        }
+        extra.update(
+            self.extra_action_out(dist_inputs, value, dist, rng)
+        )
+        return actions, state_out, extra, expl_state
+
+    @property
+    def supports_jax_rollout(self) -> bool:
+        """Whether this policy's act path can lower into the device
+        rollout lane's scanned program (``execution/jax_rollout.py``):
+        feedforward model, stateless exploration, mesh backend (the
+        rollout program carries explicit shardings). Recurrent unrolls
+        and stateful exploration (OU noise, ParameterNoise) stay on
+        the actor lane."""
+        return (
+            not self.model.is_recurrent
+            and self.sharding_backend == "mesh"
+            and not self.exploration.needs_last_obs
+            and self.exploration.initial_state(1) == ()
+        )
+
     def _build_action_fn(self):
         model = self.model
         dist_class = self.dist_class
@@ -313,22 +358,19 @@ class JaxPolicy(Policy):
             params, obs, states, rng, explore, coeffs, expl_state,
             prev_a, prev_r,
         ):
-            if recurrent:
-                kwargs = {}
-                if use_prev_a:
-                    kwargs["prev_actions"] = prev_a[:, None]
-                if use_prev_r:
-                    kwargs["prev_rewards"] = prev_r[:, None]
-                dist_inputs, value, state_out = model.apply(
-                    params, obs[:, None], states, **kwargs
+            if not recurrent:
+                return self._action_step_body(
+                    params, obs, rng, coeffs,
+                    explore=explore, expl_state=expl_state,
                 )
-            else:
-                rng_m, rng = jax.random.split(rng)
-                dist_inputs, value, state_out = (
-                    self._apply_model_for_actions(
-                        params, obs, rng_m, explore
-                    )
-                )
+            kwargs = {}
+            if use_prev_a:
+                kwargs["prev_actions"] = prev_a[:, None]
+            if use_prev_r:
+                kwargs["prev_rewards"] = prev_r[:, None]
+            dist_inputs, value, state_out = model.apply(
+                params, obs[:, None], states, **kwargs
+            )
             dist = dist_class(dist_inputs)
             rng_x, rng = jax.random.split(rng)
             actions, logp, expl_state = exploration.sample_fn(
@@ -981,6 +1023,149 @@ class JaxPolicy(Policy):
             for i in range(k)
         ]
         return infos, pri, skipped
+
+    def learn_rollout_superstep(
+        self,
+        k: int,
+        batch_size: int,
+        rollout,
+        *,
+        k_max: Optional[int] = None,
+    ):
+        """Fused rollout+learn superstep (docs/data_plane.md): ``k``
+        iterations of [roll out T env steps on the mesh → postprocess
+        → one SGD-nest update] as ONE compiled program — the device
+        rollout lane's hot path. The only H2D payload is the key
+        stacks and the active mask; rollout rows never exist on the
+        host.
+
+        ``rollout`` is the engine's feed descriptor
+        (``execution/jax_rollout.RolloutSuperstepFeed``): ``carry``
+        the device-resident env carry, ``body`` the per-shard rollout
+        function the scan slot calls, ``steps`` the env steps per
+        slot, ``key`` the compile-cache key.
+
+        Host rng split order per slot — ``steps`` rollout splits, then
+        the learn split — matches the actor lane's local-worker
+        stream (one ``compute_actions`` split per env step, then
+        ``learn_on_batch``'s), the fixed-seed parity contract.
+
+        Returns ``(infos, carry, metrics, skipped)``: per-update host
+        stat dicts, the advanced env carry (feed it back next call),
+        the stacked per-slot metrics tree (host numpy), and per-update
+        nan-guard skip flags.
+        """
+        import time as _time
+
+        k = int(k)
+        k_max = int(k_max or k)
+        if not 1 <= k <= k_max:
+            raise ValueError(f"k={k} outside [1, k_max={k_max}]")
+        nan_guard = bool(self.config.get("nan_guard"))
+
+        from ray_tpu.sharding import superstep as superstep_lib
+
+        cache_key = ("rollout", batch_size, k_max, rollout.key, nan_guard)
+        fns = self.__dict__.setdefault("_superstep_fns", {})
+        fn = fns.get(cache_key)
+        if fn is None:
+            fn = superstep_lib.build_superstep_fn(
+                self._device_update_fn(batch_size),
+                mesh=self.mesh,
+                backend=self.sharding_backend,
+                k=k_max,
+                label=(
+                    f"rollout_superstep[{type(self).__name__}:"
+                    f"{batch_size}x{k_max}]"
+                ),
+                rollout_fn=rollout.body,
+                nan_guard=nan_guard,
+            )
+            fns[cache_key] = fn
+
+        coeffs = self._learn_coeffs()
+        T = int(rollout.steps)
+        learn_keys, ro_keys = [], []
+        for _ in range(k):
+            slot = []
+            for _ in range(T):
+                self._rng, r = jax.random.split(self._rng)
+                slot.append(r)
+            ro_keys.append(jnp.stack(slot))
+            self._rng, r = jax.random.split(self._rng)
+            learn_keys.append(r)
+        pad = jnp.zeros_like(learn_keys[0])
+        pad_slot = jnp.zeros_like(ro_keys[0])
+        while len(learn_keys) < k_max:
+            learn_keys.append(pad)
+            ro_keys.append(pad_slot)
+        rngs = jnp.stack(learn_keys)
+        ro_rngs = jnp.stack(ro_keys)
+        active = np.zeros(k_max, np.float32)
+        active[:k] = 1.0
+        # the lane's entire H2D payload: key stacks + the mask
+        telemetry_metrics.add_h2d_bytes(
+            "rollout",
+            int(rngs.nbytes) + int(ro_rngs.nbytes) + active.nbytes,
+        )
+
+        compiles_before = getattr(fn, "traces", 0)
+        t0 = _time.perf_counter()
+        with tracing.start_span(
+            "learn:superstep", k=k, batch_size=batch_size, rollout=True
+        ) as _sp:
+            (
+                self.params,
+                self.opt_state,
+                self.aux_state,
+                carry,
+                stats,
+                metrics,
+            ) = fn(
+                self.params,
+                self.opt_state,
+                self.aux_state,
+                rollout.carry,
+                active,
+                rngs,
+                ro_rngs,
+                coeffs,
+            )
+            _sp.set_attribute(
+                "recompiles",
+                getattr(fn, "traces", 0) - compiles_before,
+            )
+            # ONE drain: stacked stats + episode metrics together
+            stats, metrics = jax.device_get((stats, metrics))
+        self.num_grad_updates += k * self._updates_per_learn_call(
+            batch_size
+        )
+        self._after_superstep()
+        telemetry_metrics.counter(
+            telemetry_metrics.LEARN_STEPS_TOTAL,
+            "SGD-nest programs dispatched",
+        ).inc(float(k))
+        telemetry_metrics.inc_superstep_updates(k)
+        self.last_learn_timers["learn_superstep_s"] = (
+            _time.perf_counter() - t0
+        )
+        self.last_learn_timers["learn_recompiles"] = float(
+            getattr(fn, "traces", 0) - compiles_before
+        )
+
+        skip = np.asarray(
+            stats.get(superstep_lib.SKIP_KEY, np.zeros(k_max))
+        )
+        skipped = [bool(skip[i] > 0.5) for i in range(k)]
+        infos = [
+            {
+                name: float(np.asarray(v)[i])
+                for name, v in stats.items()
+                if name != superstep_lib.SKIP_KEY
+            }
+            for i in range(k)
+        ]
+        return infos, carry, metrics, skipped
 
     def prepare_batch(self, samples) -> Tuple[Dict[str, np.ndarray], int]:
         """Public phase 1 of learning: turn a SampleBatch (or plain dict of

@@ -113,6 +113,7 @@ def build_superstep_fn(
     gather_fn: Optional[Callable] = None,
     store_shardings: Optional[Dict] = None,
     extra_cols: Sequence[str] = (),
+    rollout_fn: Optional[Callable] = None,
     priority_fn: Optional[Callable] = None,
     nan_guard: bool = False,
 ) -> ShardedFunction:
@@ -133,27 +134,64 @@ def build_superstep_fn(
         plus a host ``(K, B)`` index array and gathers the batches in
         place; ``extra_cols`` names host-shipped stacked columns
         merged after the gather (PER importance weights).
+      - ``rollout_fn(params, carry, rollout_rngs, coeffs) -> (carry,
+        batch, metrics)``: each slot PRODUCES its own batch by rolling
+        out a JAX-native vectorized env on the mesh
+        (``execution/jax_rollout.py``) — rollout(T) + postprocess +
+        update fuse into the scan body, so the whole
+        rollout+learn superstep is ONE dispatch with zero batch H2D.
+        ``carry`` (env state + carried obs, row-sharded) threads
+        through the scan alongside the learner state: slot k acts with
+        the params slot k-1 produced — the on-policy contract.
+        ``metrics`` (per-slot episode-completion arrays, any pytree of
+        ``(..., N)`` leaves sharded on the last axis) stack to
+        ``(K, ..., N)`` outputs and ride the single stats drain.
 
     ``priority_fn(params, aux, batch, rng) -> (B,)`` runs after each
     update on the post-update state (per-update PER refresh order) and
-    its outputs stack to a ``(K, B)`` program output.
+    its outputs stack to a ``(K, B)`` program output (stacked/gather
+    feeds only).
 
     Compiled signature::
 
-        fn(params, opt_state, aux, feed, active, rngs[, pri_rngs],
-           coeffs) -> (params, opt_state, aux, stats[, priorities])
+        fn(params, opt_state, aux, feed, active, rngs[, pri_rngs |
+           rollout_rngs], coeffs)
+          -> (params, opt_state, aux[, carry], stats[, priorities |
+              metrics])
 
-    where ``feed`` is the stacked tree or ``(store, idx, extra)``,
-    ``active`` is the ``(K,)`` float mask and ``rngs`` the host-split
-    ``(K, 2)`` key stack. ``opt_state`` is donated.
+    where ``feed`` is the stacked tree, ``(store, idx, extra)``, or
+    the rollout carry; ``active`` is the ``(K,)`` float mask and
+    ``rngs`` the host-split ``(K, 2)`` key stack (rollout mode adds
+    the ``(K, T, 2)`` rollout key stack). ``opt_state`` is donated.
     """
-    if (stacked_cols is None) == (gather_fn is None):
+    if (
+        int(stacked_cols is not None)
+        + int(gather_fn is not None)
+        + int(rollout_fn is not None)
+    ) != 1:
         raise ValueError(
-            "exactly one of stacked_cols / gather_fn must be given"
+            "exactly one of stacked_cols / gather_fn / rollout_fn "
+            "must be given"
+        )
+    if rollout_fn is not None and priority_fn is not None:
+        raise ValueError(
+            "priority_fn is a replay-feed feature; the rollout feed "
+            "is on-policy"
         )
     axis = data_axis(mesh)
     replicated_cols = set(replicated_cols)
     with_pri = priority_fn is not None
+
+    if rollout_fn is not None:
+        return _build_rollout_superstep(
+            update_fn,
+            rollout_fn,
+            mesh=mesh,
+            backend=backend,
+            axis=axis,
+            label=label,
+            nan_guard=nan_guard,
+        )
 
     def multi_fn(params, opt_state, aux, stacked, active, *rest):
         if with_pri:
@@ -290,6 +328,110 @@ def build_superstep_fn(
         program,
         in_specs=in_specs,
         out_specs=out_specs,
+        donate_argnums=(1,),
+        label=label,
+    )
+
+
+def _build_rollout_superstep(
+    update_fn: Callable,
+    rollout_fn: Callable,
+    *,
+    mesh,
+    backend: str,
+    axis: str,
+    label: str,
+    nan_guard: bool,
+) -> ShardedFunction:
+    """The rollout-producing feed of :func:`build_superstep_fn`: slot
+    k of the scan rolls out the env carry with the CURRENT params,
+    builds its train batch in place, and updates — rollout+learn as
+    one compiled chain (docs/data_plane.md "fused rollout").
+
+    Masked slots (``active`` 0) revert params/opt/aux AND the env
+    carry, so running ``k < k_max`` through the one executable neither
+    trains nor advances the envs for the padded slots."""
+
+    def multi_fn(params, opt_state, aux, carry0, active, rngs, ro_rngs, coeffs):
+        def body(scan_carry, x):
+            params, opt_state, aux, env_carry = scan_carry
+            act, rng, ro_rng = x
+            # same fusion-boundary pin as the batch feeds: the body
+            # compiles like the standalone rollout + update programs,
+            # keeping the fused chain bit-identical to dispatching the
+            # pieces separately
+            params, opt_state, aux, env_carry, rng, ro_rng = (
+                jax.lax.optimization_barrier(
+                    (params, opt_state, aux, env_carry, rng, ro_rng)
+                )
+            )
+            new_carry, batch, metrics = rollout_fn(
+                params, env_carry, ro_rng, coeffs
+            )
+            new_p, new_o, new_a, stats = update_fn(
+                params, opt_state, aux, batch, rng, coeffs
+            )
+            ok = act
+            if nan_guard:
+                fin = jax.lax.pmin(batch_finite(batch), axis)
+                ok = ok * fin
+                stats = dict(stats, **{SKIP_KEY: 1.0 - fin})
+            elif SKIP_KEY not in stats:
+                stats = dict(stats, **{SKIP_KEY: jnp.float32(0.0)})
+
+            def keep(new, old):
+                return jax.tree_util.tree_map(
+                    lambda n, o: jnp.where(ok > 0.5, n, o), new, old
+                )
+
+            params = keep(new_p, params)
+            opt_state = keep(new_o, opt_state)
+            aux = keep(new_a, aux)
+            # a nan-guarded slot keeps its ROLLOUT (those env steps
+            # happened; the host counts them) but reverts the update;
+            # only an INACTIVE slot reverts the env advance
+            env_carry = jax.tree_util.tree_map(
+                lambda n, o: jnp.where(act > 0.5, n, o),
+                new_carry,
+                env_carry,
+            )
+            return (params, opt_state, aux, env_carry), (stats, metrics)
+
+        (params, opt_state, aux, carry0), (stats, metrics) = (
+            jax.lax.scan(
+                body,
+                (params, opt_state, aux, carry0),
+                (active, rngs, ro_rngs),
+            )
+        )
+        return params, opt_state, aux, carry0, stats, metrics
+
+    # carry leaves are per-env rows (leading dim N); metrics leaves
+    # end in the env dim (engine contract) so they shard on axis -1
+    sharded = jax.shard_map(
+        multi_fn,
+        mesh=mesh,
+        in_specs=(P(), P(), P(), P(axis), P(), P(), P(), P()),
+        out_specs=(
+            P(),
+            P(),
+            P(),
+            P(axis),
+            P(),
+            P(*([None] * 2 + [axis])),
+        ),
+    )
+    if backend != "mesh":
+        return sharded_jit(
+            sharded, donate_argnums=(1,), label=label
+        )
+    rep = replicated(mesh)
+    dat = batch_sharded(mesh)
+    met = batch_sharded(mesh, ndim_prefix=3)
+    return sharded_jit(
+        sharded,
+        in_specs=(rep, rep, rep, dat, rep, rep, rep, rep),
+        out_specs=(rep, rep, rep, dat, rep, met),
         donate_argnums=(1,),
         label=label,
     )

@@ -54,6 +54,10 @@ H2D_BYTES_TOTAL = "ray_tpu_h2d_bytes_total"
 # superstep learner contract (docs/data_plane.md): updates executed
 # inside fused K-updates-per-dispatch programs
 SUPERSTEP_UPDATES_TOTAL = "ray_tpu_superstep_updates_total"
+# device rollout lane (docs/pipeline.md): env steps taken INSIDE
+# mesh-resident rollout programs (JaxVectorEnv lane) — compare against
+# ray_tpu_env_steps_sampled_total for the on-device fraction
+ENV_STEPS_ON_DEVICE_TOTAL = "ray_tpu_env_steps_on_device_total"
 REPLAY_ROWS = "ray_tpu_replay_buffer_rows"
 REPLAY_CAPACITY = "ray_tpu_replay_buffer_capacity"
 REPLAY_BYTES = "ray_tpu_replay_buffer_bytes"
@@ -149,9 +153,20 @@ def inc_superstep_updates(n: int = 1) -> None:
     ).inc(float(n))
 
 
+def inc_env_steps_on_device(n: int) -> None:
+    """Env steps executed inside a device rollout program (the
+    JaxVectorEnv lane — zero rollout bytes over H2D)."""
+    counter(
+        ENV_STEPS_ON_DEVICE_TOTAL,
+        "env steps taken inside mesh-resident rollout programs",
+    ).inc(float(n))
+
+
 def add_h2d_bytes(path: str, n: int) -> None:
     """Host→device payload bytes about to cross the wire on ``path``
-    (``feeder`` | ``learn`` | ``replay_insert``). The byte diet of
+    (``feeder`` | ``learn`` | ``replay_insert`` | ``rollout`` — the
+    device rollout lane's key stacks, its entire payload). The byte
+    diet of
     docs/data_plane.md is read off this counter: a device-resident
     replay run moves each transition once (``replay_insert``) instead
     of once per learn step (``learn``)."""

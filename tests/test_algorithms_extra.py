@@ -16,6 +16,9 @@ def test_registry_has_new_algos():
         assert get_algorithm_class(name) is not None
 
 
+@pytest.mark.slow  # ~19 s on the tier-1 host: PG learning curve
+# (moved out of tier-1 with PR 7, budget rule; the PG loss/algorithm
+# surface stays covered by the registry + exploration tests)
 def test_pg_cartpole_learns():
     algo = (
         PGConfig()

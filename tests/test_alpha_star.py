@@ -3,6 +3,8 @@ rllib/algorithms/alpha_star/tests)."""
 
 import time
 
+import pytest
+
 import gymnasium as gym
 import numpy as np
 
@@ -88,6 +90,9 @@ def test_league_builder_pfsp_and_snapshots():
     assert lb.should_snapshot()
 
 
+@pytest.mark.slow  # ~13 s: league growth e2e (moved out of tier-1 with
+# PR 7, budget rule; submesh + exploiter training stays covered by
+# test_per_policy_learner_submeshes_and_exploiter_trains)
 def test_alpha_star_league_grows_and_main_exploits():
     register_env("rps", lambda cfg: RepeatedRPS(cfg))
     algo = (

@@ -3,6 +3,8 @@ which also runs on a clonable CartPole)."""
 
 import time
 
+import pytest
+
 import gymnasium as gym
 import numpy as np
 
@@ -72,6 +74,10 @@ def test_mcts_prefers_better_action():
     env.close()
 
 
+@pytest.mark.slow  # ~33 s on the tier-1 host: MCTS learning curve
+# (moved out of tier-1 with PR 7 to keep the suite inside its 870 s
+# budget — the PR-1 rule; MCTS mechanics stay covered by
+# test_mcts_prefers_better_action)
 def test_alpha_zero_cartpole_improves():
     register_env("clone_cartpole", lambda cfg: ClonableCartPole(cfg))
     algo = (

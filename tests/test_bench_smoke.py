@@ -14,6 +14,8 @@ import bench
 pytestmark = pytest.mark.smoke
 
 
+@pytest.mark.slow  # ~15 s: full bench-path smoke (the bench also runs
+# standalone every round; moved out of tier-1 with PR 7, budget rule)
 def test_bench_jax_path_runs():
     (
         sps,

@@ -19,10 +19,13 @@ def test_impala_sync_mode_trains():
         .build()
     )
     result = algo.train()
-    # learner thread is async; wait for at least one learn step
+    # learner thread is async AND its stats drain lags STATS_LAG
+    # dispatches (deferred readback, docs/data_plane.md): wait until a
+    # drained info lands, not merely until the first dispatch
     deadline = time.time() + 30
     while (
-        algo._learner_thread.num_steps == 0 and time.time() < deadline
+        "total_loss" not in algo._learner_thread.learner_info
+        and time.time() < deadline
     ):
         algo.train()
     assert algo._learner_thread.num_steps > 0

@@ -166,6 +166,9 @@ def test_ring_attention_pallas_blocks_match_full(mesh8, causal):
     np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
 
 
+@pytest.mark.slow  # ~17 s: pallas-vs-XLA gradient parity (moved out
+# of tier-1 with PR 7, budget rule; the XLA ring-attention path and
+# its numerics stay covered by the remaining tests in this file)
 def test_ring_attention_pallas_gradients_match_xla(mesh8):
     """The Pallas-forward ring's custom VJP (XLA ring rematerialized)
     must match the XLA ring's gradients."""

@@ -117,6 +117,9 @@ def test_pbt_perturbs():
     assert scheduler.num_perturbations > 0
 
 
+@pytest.mark.slow  # ~14 s: tune+PPO e2e (moved out of tier-1 with
+# PR 7, budget rule; tune scheduling/PBT mechanics keep tier-1
+# coverage in this file)
 def test_tune_with_ppo():
     analysis = run(
         "PPO",
