@@ -57,6 +57,15 @@ Flags:  --profile       run ONE telemetry-instrumented PPO iteration
                         writes benchmarks/e2e/jax_env_ab.json
                         (bench_mfu gains a `fused_rollout` sub-entry
                         on the jittable pong_lite port)
+        --elastic       elastic-fleet chaos A/B (docs/resilience.md
+                        "elastic fleets & preemption"): PPO fleet
+                        forced 4→2→6 via noticed preemptions +
+                        autoscaler scale-up vs the PR-4 kill-only
+                        path (steps/s per fleet size, drain vs kill
+                        recovery cost), plus work lost on a mid-run
+                        driver crash with streamed vs periodic
+                        checkpoints; writes
+                        benchmarks/e2e/elastic_fleet.json
 """
 
 import json
@@ -1204,6 +1213,240 @@ def bench_chaos(out_path=None, iters=6):
     return report
 
 
+def bench_elastic(out_path=None):
+    """Elastic-fleet chaos A/B (docs/resilience.md "elastic fleets &
+    preemption"). Three phases:
+
+    A) **elastic**: a PPO fleet forced 4 → 2 via two noticed
+       preemptions (drained gracefully, zero recovery budget), then
+       → 6 via an autoscaler scale-up; per-iteration steps/s grouped
+       by fleet size.
+    B) **kill-only** (the PR-4 path): the same two workers die with
+       NO notice; recovery = probe + recreate. Drain vs kill cost.
+    C) **driver crash**: work lost restoring from the continuous
+       checkpoint stream (≤ 1 superstep) vs the periodic path (up to
+       ``checkpoint_frequency`` iterations), plus the streamer's
+       off-critical-path overhead (iteration time with streaming on
+       vs off).
+
+    Writes benchmarks/e2e/elastic_fleet.json."""
+    import os
+    import shutil
+
+    import ray_tpu.env.synthetic_env  # noqa: F401 registers SyntheticFast-v0
+    from ray_tpu.algorithms.ppo import PPOConfig
+
+    os.makedirs("benchmarks/e2e", exist_ok=True)
+    out_path = out_path or "benchmarks/e2e/elastic_fleet.json"
+
+    def build(elastic, fault_injection=None, **ft):
+        cfg = (
+            PPOConfig()
+            .environment("SyntheticFast-v0")
+            .rollouts(
+                num_rollout_workers=4,
+                num_envs_per_worker=4,
+                rollout_fragment_length=64,
+            )
+            .training(
+                train_batch_size=1024,
+                sgd_minibatch_size=256,
+                num_sgd_iter=2,
+                lr=3e-4,
+                model={"fcnet_hiddens": [32, 32]},
+            )
+            .fault_tolerance(
+                recreate_failed_workers=True,
+                worker_health_probe_timeout_s=10.0,
+                fault_injection=fault_injection or {},
+                **ft,
+            )
+            .debugging(seed=0)
+        )
+        if elastic:
+            cfg.fault_tolerance(
+                elastic=True,
+                min_workers=2,
+                max_workers=6,
+                drain_grace_s=120.0,
+                fleet_interval_s=0.2,
+            )
+        return cfg.build()
+
+    def timed_iters(algo, n):
+        out = []
+        for _ in range(n):
+            t0 = time.perf_counter()
+            r = algo.train()
+            dt = time.perf_counter() - t0
+            out.append(
+                (
+                    dt,
+                    algo.workers.num_remote_workers(),
+                    r["info"]["recovery"],
+                )
+            )
+        return out
+
+    # ---- A: elastic — 4 → 2 (noticed preemptions) → 6 (scale-up) ----
+    faults = {
+        "preempt_worker": [
+            {"worker_index": 2, "on_call": 2, "grace_s": 120.0},
+            {"worker_index": 3, "on_call": 3, "grace_s": 120.0},
+        ]
+    }
+    algo = build(elastic=True, fault_injection=faults)
+    per_fleet = {}
+    try:
+        timed_iters(algo, 1)  # compile + spin-up
+        rows = timed_iters(algo, 4)
+        # bounded patience for the async notice polls to drain both
+        for _ in range(8):
+            if rows[-1][2]["preemptions_drained"] >= 2:
+                break
+            rows += timed_iters(algo, 1)
+        drain_rows = list(rows)
+        algo._fleet.request_scale(+4)  # → max_workers = 6
+        rows += timed_iters(algo, 3)
+        steps_per_iter = 1024.0
+        for dt, fleet, _ in rows:
+            per_fleet.setdefault(fleet, []).append(
+                steps_per_iter / dt
+            )
+        rec = rows[-1][2]
+        elastic_report = {
+            "fleet_trajectory": [fleet for _, fleet, _ in rows],
+            "steps_per_s_by_fleet_size": {
+                str(k): round(float(np.median(v)), 1)
+                for k, v in sorted(per_fleet.items())
+            },
+            "preemptions_drained": rec["preemptions_drained"],
+            "recovery_budget_spent": rec["failures"],
+            "drain_iter_times_s": [
+                round(dt, 4) for dt, _, _ in drain_rows
+            ],
+            "fleet": rec["fleet"],
+        }
+    finally:
+        algo.cleanup()
+
+    # ---- B: kill-only (unnoticed) — the PR-4 recovery path ----
+    algo = build(
+        elastic=False,
+        fault_injection={
+            "kill_worker": [
+                {"worker_index": 2, "on_call": 2},
+                {"worker_index": 3, "on_call": 3},
+            ]
+        },
+        max_failures=10,
+    )
+    try:
+        timed_iters(algo, 1)
+        rows = timed_iters(algo, 6)
+        rec = rows[-1][2]
+        kill_report = {
+            "iter_times_s": [round(dt, 4) for dt, _, _ in rows],
+            "recovery_time_s": rec["time_lost_s"],
+            "worker_restarts": rec["worker_restarts"],
+            "recovery_budget_spent": rec["failures"],
+        }
+    finally:
+        algo.cleanup()
+
+    # ---- C: driver crash — streamed vs periodic work lost ----
+    root = "/tmp/ray_tpu_bench_elastic_ckpt"
+    shutil.rmtree(root, ignore_errors=True)
+
+    def build_local(streaming):
+        return (
+            PPOConfig()
+            .environment("SyntheticFast-v0")
+            .rollouts(
+                num_rollout_workers=0,
+                num_envs_per_worker=4,
+                rollout_fragment_length=64,
+            )
+            .training(
+                train_batch_size=256,
+                sgd_minibatch_size=128,
+                num_sgd_iter=2,
+                lr=3e-4,
+                model={"fcnet_hiddens": [32, 32]},
+            )
+            .fault_tolerance(
+                checkpoint_streaming=streaming,
+                checkpoint_frequency=5,
+                checkpoint_root=root,
+                restore_on_failure=True,
+            )
+            .debugging(seed=0)
+            .build()
+        )
+
+    # streaming off: baseline iteration time + the periodic loss bound
+    algo = build_local(streaming=False)
+    try:
+        timed_iters(algo, 1)
+        base_times = [dt for dt, _, _ in timed_iters(algo, 6)]
+        crashed_iter = algo.iteration
+        # newest periodic save at checkpoint_frequency = 5
+        periodic_ckpt_iter = (crashed_iter // 5) * 5
+    finally:
+        algo.cleanup()
+    periodic_lost_iters = crashed_iter - periodic_ckpt_iter
+
+    shutil.rmtree(root, ignore_errors=True)
+    algo = build_local(streaming=True)
+    try:
+        timed_iters(algo, 1)
+        stream_times = [dt for dt, _, _ in timed_iters(algo, 6)]
+        head = algo._ckpt_streamer._superstep
+        algo._ckpt_streamer.flush()
+    finally:
+        algo.cleanup()  # the "crash"
+    restored = build_local(streaming=True)
+    try:
+        path = restored._recovery.restore_latest()
+        from ray_tpu.resilience.streamer import CheckpointStreamer
+
+        tail = CheckpointStreamer.peek(path)["superstep"]
+    finally:
+        restored.cleanup()
+
+    crash_report = {
+        "streamed_lost_supersteps": head - tail,
+        "periodic_lost_iterations": periodic_lost_iters,
+        "iter_s_streaming_off_median": round(
+            float(np.median(base_times)), 4
+        ),
+        "iter_s_streaming_on_median": round(
+            float(np.median(stream_times)), 4
+        ),
+        "restored_from": path,
+    }
+
+    report = {
+        "metric": "elastic_fleet",
+        "elastic": elastic_report,
+        "kill_only": kill_report,
+        "driver_crash": crash_report,
+        "config": {
+            "num_rollout_workers": 4,
+            "min_workers": 2,
+            "max_workers": 6,
+            "train_batch_size": 1024,
+            "faults_elastic": "preempt worker 2 @ call 2, worker 3 "
+            "@ call 3 (grace 120 s); scale-up +4 after drains",
+            "faults_kill": "kill workers 2, 3 (no notice)",
+        },
+    }
+    with open(out_path, "w") as f:
+        json.dump(report, f, indent=1)
+    print(json.dumps(report))
+    return report
+
+
 def bench_jax_env(out_path=None, iters=3, n_envs=32, t_rollout=64):
     """Rollout-lane A/B (docs/pipeline.md "two rollout lanes"): the
     SAME JaxVectorEnv (CartPoleJax), same fixed seed, same total env
@@ -1342,6 +1585,9 @@ def main():
         return
     if "--chaos" in sys.argv:
         bench_chaos()
+        return
+    if "--elastic" in sys.argv:
+        bench_elastic()
         return
     profile_dir = None
     if "--xprof" in sys.argv:

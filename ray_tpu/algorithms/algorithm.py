@@ -142,6 +142,42 @@ class Algorithm(Trainable):
             config=config,
             num_workers=int(config.get("num_workers", 0)),
         )
+        # non-worker episode sources (the device rollout lane's
+        # engine, drained fleet workers): callables returning
+        # RolloutMetrics lists, read by _collect_rollout_metrics
+        self._extra_metric_sources: List[Callable] = []
+        # elastic fleet (docs/resilience.md "elastic fleets &
+        # preemption"): the FleetController's monitor thread is owned
+        # HERE — daemonized at setup, stop()-joined at cleanup — and
+        # its fleet mutations apply only through reconcile() on the
+        # driver thread between training-step rounds
+        self._fleet = None
+        if config.get("elastic") and int(
+            config.get("num_workers", 0)
+        ) > 0:
+            from ray_tpu.autoscaler.fleet import FleetController
+
+            self._fleet = FleetController(self, self.workers, config)
+            self._extra_metric_sources.append(
+                self._fleet.take_drained_metrics
+            )
+        # continuous checkpoint streaming (resilience/streamer.py):
+        # background param/opt-state snapshots every few supersteps,
+        # bounding work-lost-on-driver-crash to ~1 superstep
+        self._ckpt_streamer = None
+        if config.get("checkpoint_streaming"):
+            from ray_tpu.resilience.streamer import CheckpointStreamer
+
+            root = config.get("checkpoint_root") or os.path.join(
+                self.logdir, "resilience"
+            )
+            self._ckpt_streamer = CheckpointStreamer(
+                self,
+                CheckpointStreamer.stream_root(root),
+                every=int(
+                    config.get("checkpoint_stream_interval", 1) or 1
+                ),
+            )
         self.evaluation_workers: Optional[WorkerSet] = None
         if config.get("evaluation_interval"):
             eval_config = {
@@ -211,11 +247,20 @@ class Algorithm(Trainable):
                     # death → bounded probe + recreate + degraded
                     # continue (per the recreate/ignore flags);
                     # restartable driver failure → restore the latest
-                    # periodic checkpoint; anything unhandled — or
-                    # beyond the max_failures budget — propagates
+                    # periodic checkpoint or stream tail; anything
+                    # unhandled — or beyond the max_failures budget —
+                    # propagates
                     if not self._recovery.handle_failure(e):
                         raise
                     continue
+                # elastic fleet + checkpoint stream hooks run BETWEEN
+                # training-step rounds — the only point where the
+                # WorkerSet may change shape, and the superstep
+                # boundary the stream snapshots ride
+                if self._fleet is not None:
+                    self._fleet.reconcile()
+                if self._ckpt_streamer is not None:
+                    self._ckpt_streamer.offer()
                 done_t = (
                     min_t is None or (time.time() - t0) >= min_t
                 )
@@ -248,8 +293,15 @@ class Algorithm(Trainable):
             results["info"]["timers"] = learn_timers
         # resilience roll-up: restart/recovery/skip counts + time lost
         # to recovery this iteration (span-derived recovery_s appears
-        # in info/telemetry too when tracing runs)
-        results["info"]["recovery"] = self._recovery.stats()
+        # in info/telemetry too when tracing runs); with an elastic
+        # fleet / checkpoint stream running, their per-iteration state
+        # rides along under info/recovery/fleet and .../stream
+        recovery_info = self._recovery.stats()
+        if self._fleet is not None:
+            recovery_info["fleet"] = self._fleet.stats()
+        if self._ckpt_streamer is not None:
+            recovery_info["stream"] = self._ckpt_streamer.stats()
+        results["info"]["recovery"] = recovery_info
         # per-iteration telemetry roll-up: throughput gauges always
         # (they're process-local and near-free), the span-derived
         # stage times + overlap fraction only when tracing runs
@@ -383,6 +435,15 @@ class Algorithm(Trainable):
         ``kind`` (``"workers"`` or ``"restore"``). Subclasses rebuild
         whatever driver-side machinery the failure invalidated (PPO:
         the sample pipeline; IMPALA: the learner thread)."""
+
+    def on_fleet_change(self, added: List, removed: List) -> None:
+        """Hook: the FleetController just changed the fleet —
+        ``added`` workers joined (already weight+filter-synced),
+        ``removed`` drained out. Subclasses wire joiners into (and
+        drained workers out of) whatever persistent sampling machinery
+        they run (PPO: the prefetch pipeline's request manager; IMPALA:
+        the sampler rotation). The synchronous paths need nothing:
+        they re-read ``workers.remote_workers()`` every round."""
 
     def _collect_rollout_metrics(self) -> Dict:
         episodes = []
@@ -684,6 +745,12 @@ class Algorithm(Trainable):
         self.get_policy(policy_id).export_checkpoint(export_dir)
 
     def cleanup(self) -> None:
+        # the fleet monitor observes the WorkerSet: stop (and join) it
+        # before the workers it watches go away
+        if getattr(self, "_fleet", None) is not None:
+            self._fleet.stop()
+        if getattr(self, "_ckpt_streamer", None) is not None:
+            self._ckpt_streamer.stop()
         if hasattr(self, "workers"):
             self.workers.stop()
         if getattr(self, "evaluation_workers", None) is not None:

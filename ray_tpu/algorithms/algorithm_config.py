@@ -91,6 +91,34 @@ class AlgorithmConfig:
         # deterministic chaos spec (resilience/faults.py); {} = inert,
         # None additionally allows the RAY_TPU_FAULTS env fallback
         self.fault_injection: Optional[Dict] = None
+        # elastic fleet (docs/resilience.md "elastic fleets &
+        # preemption"): True starts a FleetController at
+        # Algorithm.setup — the rollout fleet grows/shrinks at runtime
+        # within [min_workers, max_workers]: preemption notices drain
+        # workers gracefully (zero recovery budget), learner
+        # starvation (empty sampler queues) scales up, long-idle
+        # workers reap down. Batch accounting is fleet-size
+        # independent, so a stable-fleet phase is bit-identical to a
+        # non-elastic run on a fixed seed.
+        self.elastic = False
+        self.min_workers = None  # None → 1
+        self.max_workers = None  # None → 2 × num_workers
+        # drain budget: how long a noticed/reaped worker gets to ship
+        # its final sample results + filter state before being dropped
+        self.drain_grace_s = 15.0
+        self.fleet_interval_s = 1.0  # monitor-thread poll period
+        self.fleet_idle_timeout_s = 30.0  # reap after this long idle
+        self.fleet_starvation_patience = 3  # polls before scale-up
+        self.scale_up_step = 1
+        # continuous checkpoint streaming (resilience/streamer.py):
+        # True snapshots params/opt-state every
+        # checkpoint_stream_interval supersteps on a background thread
+        # (atomic write + fsync, off the critical path), bounding
+        # work-lost-on-driver-crash to ~1 superstep; the recovery
+        # layer restores from the stream tail when it is newer than
+        # the latest periodic checkpoint.
+        self.checkpoint_streaming = False
+        self.checkpoint_stream_interval = 1
 
         # training (reference :717)
         self.gamma = 0.99
@@ -465,6 +493,16 @@ class AlgorithmConfig:
         retry_max_backoff_s: Optional[float] = None,
         retry_jitter: Optional[float] = None,
         fault_injection: Optional[Dict] = None,
+        elastic: Optional[bool] = None,
+        min_workers: Optional[int] = None,
+        max_workers: Optional[int] = None,
+        drain_grace_s: Optional[float] = None,
+        fleet_interval_s: Optional[float] = None,
+        fleet_idle_timeout_s: Optional[float] = None,
+        fleet_starvation_patience: Optional[int] = None,
+        scale_up_step: Optional[int] = None,
+        checkpoint_streaming: Optional[bool] = None,
+        checkpoint_stream_interval: Optional[int] = None,
         **kwargs,
     ) -> "AlgorithmConfig":
         """Fault-tolerance knobs (docs/resilience.md).
@@ -481,7 +519,14 @@ class AlgorithmConfig:
         actions (< 0 = unlimited). ``retry_*``: the uniform
         RetryPolicy behind every driver-side remote interaction.
         ``fault_injection``: deterministic chaos spec for tests and
-        ``bench.py --chaos`` (resilience/faults.py)."""
+        ``bench.py --chaos`` (resilience/faults.py).
+        ``elastic`` + ``min_workers``/``max_workers``: run the rollout
+        fleet under a FleetController — preemption notices drain
+        workers gracefully, learner starvation scales up, idle workers
+        reap down (docs/resilience.md "elastic fleets & preemption").
+        ``checkpoint_streaming`` + ``checkpoint_stream_interval``:
+        continuous background param/opt-state snapshots bounding
+        work-lost-on-driver-crash to ~1 superstep."""
         if ignore_worker_failures is not None:
             self.ignore_worker_failures = ignore_worker_failures
         if recreate_failed_workers is not None:
@@ -516,6 +561,30 @@ class AlgorithmConfig:
             self.retry_jitter = float(retry_jitter)
         if fault_injection is not None:
             self.fault_injection = fault_injection
+        if elastic is not None:
+            self.elastic = bool(elastic)
+        if min_workers is not None:
+            self.min_workers = int(min_workers)
+        if max_workers is not None:
+            self.max_workers = int(max_workers)
+        if drain_grace_s is not None:
+            self.drain_grace_s = float(drain_grace_s)
+        if fleet_interval_s is not None:
+            self.fleet_interval_s = float(fleet_interval_s)
+        if fleet_idle_timeout_s is not None:
+            self.fleet_idle_timeout_s = float(fleet_idle_timeout_s)
+        if fleet_starvation_patience is not None:
+            self.fleet_starvation_patience = int(
+                fleet_starvation_patience
+            )
+        if scale_up_step is not None:
+            self.scale_up_step = int(scale_up_step)
+        if checkpoint_streaming is not None:
+            self.checkpoint_streaming = bool(checkpoint_streaming)
+        if checkpoint_stream_interval is not None:
+            self.checkpoint_stream_interval = int(
+                checkpoint_stream_interval
+            )
         return self
 
     def telemetry(

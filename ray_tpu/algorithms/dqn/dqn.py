@@ -526,6 +526,23 @@ class DQN(Algorithm):
         )
         self._last_target_update = 0
 
+    def on_fleet_change(self, added, removed) -> None:
+        """Elastic fleet: the synchronous sampling path re-reads
+        ``workers.remote_workers()`` every round and needs nothing;
+        the ``sample_async`` path holds one pending ref per worker of
+        LAST round's fleet — drop them so the next round re-issues
+        against the current fleet instead of ray.get-ing a drained
+        worker's ref."""
+        super().on_fleet_change(added, removed)
+        if removed and getattr(self, "_pending_sample_refs", None):
+            import ray_tpu as _ray
+
+            try:
+                _ray.free(self._pending_sample_refs)
+            except Exception:
+                pass
+            self._pending_sample_refs = None
+
     def _single_update(self, prioritized: bool, kwargs: Dict) -> Dict:
         """One replay sample + learn round (the classic path), with
         per-sample PER priority refresh."""

@@ -433,6 +433,23 @@ class IMPALA(Algorithm):
             return_object_refs=bool(self._aggregators),
             name="impala_sampler",
         )
+        # elastic fleet: drains pull workers out of this rotation and
+        # the controller reads its in-flight counts for idleness
+        if self._fleet is not None:
+            self._fleet.register_manager(self._sample_manager)
+
+    def on_fleet_change(self, added, removed) -> None:
+        """Elastic fleet: joiners enter the sampler rotation
+        immediately (training_step's heal-drift add would catch them a
+        round later); drained workers were already retired from the
+        manager by the FleetController — just drop their stale
+        weight-version bookkeeping."""
+        super().on_fleet_change(added, removed)
+        mgr = getattr(self, "_sample_manager", None)
+        if mgr is not None and added:
+            mgr.add_workers(added)
+        for w in removed:
+            self._worker_weight_ver.pop(id(w), None)
 
     def on_recovery(self, kind: str) -> None:
         """After a checkpoint restore the old learner thread is dead

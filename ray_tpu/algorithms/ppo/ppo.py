@@ -297,7 +297,7 @@ class PPO(Algorithm):
             self._jax_rollout_engine = eng
             # Algorithm._collect_rollout_metrics drains these — the
             # lane's episode returns come back with the stats readback
-            self._extra_metric_sources = [eng.get_metrics]
+            self._extra_metric_sources.append(eng.get_metrics)
         return eng
 
     def _training_step_jax_rollout(self) -> Dict:
@@ -466,6 +466,11 @@ class PPO(Algorithm):
                 )
             ),
         )
+        # elastic fleet: the pipeline's request manager is the
+        # rotation drains remove workers from, and its in-flight
+        # counts are the controller's idleness signal
+        if self._fleet is not None:
+            self._fleet.register_manager(self._sample_pipeline.manager)
 
     def _next_prefetched(self):
         """Block for the next prefetched device batch, keeping the
@@ -620,6 +625,16 @@ class PPO(Algorithm):
                 f"{len(dead)} rollout worker(s) died in the sample "
                 "pipeline"
             )
+
+    def on_fleet_change(self, added, removed) -> None:
+        """Elastic fleet: joiners enter the prefetch pipeline's
+        rotation (they arrive weight+filter-synced from
+        ``WorkerSet.add_workers``); drained workers were already
+        retired from the registered manager by the FleetController."""
+        super().on_fleet_change(added, removed)
+        pipe = getattr(self, "_sample_pipeline", None)
+        if pipe is not None and added:
+            pipe.add_workers(added)
 
     def on_recovery(self, kind: str) -> None:
         """A checkpoint restore invalidates the prefetch pipeline (its

@@ -1,3 +1,4 @@
 from ray_tpu.autoscaler.autoscaler import StandardAutoscaler
+from ray_tpu.autoscaler.fleet import FleetController
 
-__all__ = ["StandardAutoscaler"]
+__all__ = ["FleetController", "StandardAutoscaler"]

@@ -1,0 +1,408 @@
+"""FleetController: elastic, preemption-native rollout fleets.
+
+Counterpart of the reference's ``autoscaler/_private/autoscaler.py:145``
+(StandardAutoscaler) + ``monitor.py:125`` applied to the ROLLOUT-WORKER
+fleet instead of cloud nodes: the resource demand signal is the PR-3
+telemetry layer (sampler-side queue depths starving the learner, and
+per-manager in-flight counts for idleness), the "eviction notice" is
+:meth:`RolloutWorker.preemption_notice` (backed by the fault injector
+here, a provider endpoint in production), and scaling actions go through
+:meth:`WorkerSet.scale_up` / the drain protocol.
+
+Two halves, split by thread for safety (docs/resilience.md "elastic
+fleets & preemption"):
+
+- the **monitor thread** (daemonized; ``stop()`` joins — owned by
+  ``Algorithm.setup``/``cleanup``) only OBSERVES: it polls preemption
+  notices with non-blocking probe refs, watches the queue-depth gauges
+  for learner starvation, and tracks per-worker idleness across the
+  registered AsyncRequestsManagers. It never mutates the fleet.
+- **``reconcile()``** runs on the driver thread between training-step
+  rounds and APPLIES the queued decisions: drain noticed workers,
+  execute scale-ups/downs, reap long-idle workers — so the WorkerSet
+  never changes under a round in progress.
+
+The fleet state machine per worker: ``joining`` (spawned,
+weight+filter sync queued ahead of any sample call) → ``active`` →
+``draining`` (noticed or reaped: out of every rotation, in-flight
+results harvested, final filter/metric state shipped) → gone. The
+idle-reaper never touches a worker with an in-flight request or a
+drain in progress, and never shrinks below ``min_workers``.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List
+
+import ray_tpu as ray
+from ray_tpu.telemetry import metrics as telemetry_metrics
+from ray_tpu.util import tracing
+
+_ACTOR_DEAD_ERRORS = (
+    ray.core.object_store.RayActorError,
+    ray.core.object_store.WorkerCrashedError,
+)
+
+# sampler-side queues whose sustained emptiness means the learner is
+# starved for samples (docs/observability.md queue catalog)
+_STARVATION_QUEUES = ("learner_in", "feeder_in", "feeder_out")
+
+
+class FleetController:
+    def __init__(self, algorithm, worker_set, config: Dict):
+        self.algo = algorithm
+        self.workers = worker_set
+        n0 = int(config.get("num_workers", 0))
+        self.min_workers = int(config.get("min_workers") or 1)
+        self.max_workers = int(
+            config.get("max_workers") or max(2 * n0, n0 + 1)
+        )
+        self.drain_grace_s = float(config.get("drain_grace_s", 15.0))
+        self.idle_timeout_s = float(
+            config.get("fleet_idle_timeout_s", 30.0)
+        )
+        self.update_interval_s = float(
+            config.get("fleet_interval_s", 1.0)
+        )
+        self.starvation_patience = int(
+            config.get("fleet_starvation_patience", 3)
+        )
+        self.scale_up_step = int(config.get("scale_up_step", 1))
+
+        self._lock = threading.Lock()
+        self._managers: List = []  # registered AsyncRequestsManagers
+        self._noticed: Dict[int, object] = {}  # id(w) -> worker
+        self._draining: set = set()  # id(w) with drain in progress
+        self._probe_refs: Dict[int, tuple] = {}  # id(w) -> (ref, w)
+        self._idle_since: Dict[int, float] = {}
+        self._reap_candidates: Dict[int, object] = {}
+        self._pending_scale = 0
+        self._starved_polls = 0
+        self._drained_metrics: List = []
+
+        self.num_scale_ups = 0
+        self.num_scale_downs = 0
+        self.num_drained = 0
+        self.num_preempt_lost = 0
+        self.num_reaped = 0
+
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="fleet_controller"
+        )
+        self._thread.start()
+        self._set_gauges()
+
+    # -- wiring ----------------------------------------------------------
+
+    def register_manager(self, manager) -> None:
+        """Register an AsyncRequestsManager whose rotation this fleet
+        feeds: drains remove workers from it, and its in-flight counts
+        are the idleness signal (satellite contract: the reaper never
+        reaps a worker with an in-flight request)."""
+        with self._lock:
+            if manager not in self._managers:
+                self._managers.append(manager)
+
+    def request_scale(self, delta: int) -> None:
+        """Queue a fleet-size change, applied at the next
+        ``reconcile()`` and clamped to ``[min_workers, max_workers]``
+        — the API the starvation policy (and tests/bench) drive."""
+        with self._lock:
+            self._pending_scale += int(delta)
+
+    def take_drained_metrics(self) -> List:
+        """Episodes shipped by drained workers (fed to the Algorithm's
+        metric collection so a graceful exit loses no episodes)."""
+        with self._lock:
+            out, self._drained_metrics = self._drained_metrics, []
+        return out
+
+    # -- monitor thread: observe only ------------------------------------
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.update_interval_s):
+            try:
+                self.update()
+            except Exception:
+                pass
+
+    def update(self) -> None:
+        """One observation pass (monitor thread, or called directly by
+        tests): poll preemption notices, the starvation gauges, and
+        per-worker idleness. Records decisions; never acts."""
+        self._poll_notices()
+        self._poll_starvation()
+        self._poll_idle()
+
+    def _poll_notices(self) -> None:
+        """Non-blocking notice probes: keep one outstanding
+        ``preemption_notice`` call per active worker, harvest whatever
+        completed. A probe queues behind the worker's in-flight sample
+        calls, so notice latency is about one sample duration — well
+        inside any realistic grace window."""
+        with self._lock:
+            skip = set(self._noticed) | self._draining
+        for w in list(self.workers.remote_workers()):
+            wid = id(w)
+            if wid in skip or wid in self._probe_refs:
+                continue
+            try:
+                self._probe_refs[wid] = (
+                    w.preemption_notice.remote(),
+                    w,
+                )
+            except _ACTOR_DEAD_ERRORS:
+                continue
+        if not self._probe_refs:
+            return
+        refs = [r for r, _ in self._probe_refs.values()]
+        ready, _ = ray.wait(refs, num_returns=len(refs), timeout=0)
+        done = {r.id for r in ready}
+        for wid, (ref, w) in list(self._probe_refs.items()):
+            if ref.id not in done:
+                continue
+            del self._probe_refs[wid]
+            try:
+                grace = ray.get(ref)
+            except Exception:
+                continue  # dead/dying worker: the failure path owns it
+            finally:
+                try:
+                    ray.free([ref])
+                except Exception:
+                    pass
+            if grace is not None:
+                with self._lock:
+                    self._noticed[wid] = w
+                tracing.event(
+                    "fleet:preemption_notice", grace_s=float(grace)
+                )
+
+    def _poll_starvation(self) -> None:
+        """Scale-up demand off the PR-3 queue gauges: when every
+        sampler-side queue the run exports sits at depth 0 for
+        ``starvation_patience`` consecutive polls, the learner is
+        starved — queue one scale-up step."""
+        m = telemetry_metrics.get_metric(telemetry_metrics.QUEUE_DEPTH)
+        if m is None:
+            return
+        depths = [
+            v
+            for tags, v in m.series()
+            if dict(tags).get("queue") in _STARVATION_QUEUES
+        ]
+        if not depths or any(d > 0 for d in depths):
+            self._starved_polls = 0
+            return
+        self._starved_polls += 1
+        if self._starved_polls < self.starvation_patience:
+            return
+        self._starved_polls = 0
+        with self._lock:
+            if (
+                self.workers.num_remote_workers() + self._pending_scale
+                < self.max_workers
+            ):
+                self._pending_scale += self.scale_up_step
+
+    def _poll_idle(self) -> None:
+        """Idle-reap candidates: a worker with zero in-flight requests
+        across every registered manager for ``idle_timeout_s``. With
+        no managers registered (fully synchronous algorithms) there is
+        no idleness signal and the reaper stays off. Workers that are
+        draining — or have any request in flight — are never
+        candidates."""
+        with self._lock:
+            managers = list(self._managers)
+            skip = set(self._noticed) | self._draining
+        if not managers:
+            return
+        now = time.monotonic()
+        for w in list(self.workers.remote_workers()):
+            wid = id(w)
+            if wid in skip:
+                self._idle_since.pop(wid, None)
+                continue
+            busy = any(m.in_flight(w) > 0 for m in managers)
+            if busy:
+                self._idle_since.pop(wid, None)
+                continue
+            t0 = self._idle_since.setdefault(wid, now)
+            if now - t0 >= self.idle_timeout_s:
+                with self._lock:
+                    self._reap_candidates[wid] = w
+
+    # -- driver thread: act ----------------------------------------------
+
+    def reconcile(self) -> None:
+        """Apply queued decisions (driver thread, between rounds):
+        drain noticed workers, reap idle ones down to ``min_workers``,
+        then settle any explicit/starvation scale request within
+        ``[min_workers, max_workers]``."""
+        with self._lock:
+            noticed = list(self._noticed.items())
+            self._noticed.clear()
+            for wid, _ in noticed:
+                self._draining.add(wid)
+        for wid, w in noticed:
+            self._set_gauges()
+            self._retire(w, preempted=True)
+            with self._lock:
+                self._draining.discard(wid)
+
+        with self._lock:
+            reap = list(self._reap_candidates.values())
+            self._reap_candidates.clear()
+        for w in reap:
+            if self.workers.num_remote_workers() <= self.min_workers:
+                break
+            if w not in self.workers.remote_workers():
+                continue
+            with self._lock:
+                # raced busy / noticed / draining since the idle
+                # observation → not a reap candidate anymore (the
+                # satellite contract: never reap a worker with an
+                # in-flight request or a drain in progress)
+                busy = (
+                    id(w) in self._draining
+                    or id(w) in self._noticed
+                    or any(
+                        m.in_flight(w) > 0 for m in self._managers
+                    )
+                )
+            if busy:
+                continue
+            self._retire(w, preempted=False)
+
+        with self._lock:
+            delta, self._pending_scale = self._pending_scale, 0
+        if delta:
+            cur = self.workers.num_remote_workers()
+            target = min(
+                self.max_workers, max(self.min_workers, cur + delta)
+            )
+            if target > cur:
+                self._scale_up(target - cur)
+            elif target < cur:
+                for w in list(self.workers.remote_workers())[target:]:
+                    self._retire(w, preempted=False)
+        self._set_gauges()
+
+    def _scale_up(self, k: int) -> None:
+        with self._lock:
+            draining = len(self._draining)
+        telemetry_metrics.set_fleet_size(
+            active=self.workers.num_remote_workers() - draining,
+            draining=draining,
+            joining=k,
+        )
+        with tracing.start_span("fleet:scale_up", workers=k):
+            new = self.workers.scale_up(k)
+        self.num_scale_ups += len(new)
+        if new:
+            tracing.event(
+                "fleet:joined",
+                workers=len(new),
+                fleet=self.workers.num_remote_workers(),
+            )
+            self.algo.on_fleet_change(added=new, removed=[])
+
+    def _retire(self, w, *, preempted: bool) -> bool:
+        """The drain protocol: stop submissions, collect the worker's
+        final state inside the grace budget, keep its completed
+        in-flight results for the normal harvest, drop the pending
+        ones explicitly, and reap the process. A noticed drain is NOT
+        a failure: it spends zero recovery budget. Returns True when
+        the worker drained cleanly."""
+        with self._lock:
+            managers = list(self._managers)
+        for m in managers:
+            m.remove_workers([w])
+        recovery = getattr(self.algo, "_recovery", None)
+        t0 = time.time()
+        with tracing.start_span(
+            "fleet:drain", preempted=preempted
+        ) as span:
+            try:
+                final = ray.get(
+                    w.drain_for_preemption.remote(),
+                    timeout=self.drain_grace_s,
+                )
+            except Exception:
+                # died (or wedged) before the drain completed: an
+                # unnoticed preemption after all — the ordinary
+                # death/recovery path owns whatever is left of it
+                span.set_attribute("drained", False)
+                for m in managers:
+                    m.retire_worker(w)
+                self.workers.remove_workers([w])
+                if preempted:
+                    self.num_preempt_lost += 1
+                    telemetry_metrics.inc_preemptions(drained=False)
+                    if recovery is not None:
+                        recovery.note_preemption(drained=False)
+                return False
+            span.set_attribute("drained", True)
+            span.set_attribute("drain_s", round(time.time() - t0, 4))
+        self.workers.absorb_filters(final.get("filters") or {})
+        with self._lock:
+            self._drained_metrics.extend(final.get("metrics") or [])
+        for m in managers:
+            m.retire_worker(w)
+        self.workers.remove_workers([w])
+        self._idle_since.pop(id(w), None)
+        self._probe_refs.pop(id(w), None)
+        try:
+            ray.kill(w)
+        except Exception:
+            pass
+        if preempted:
+            self.num_drained += 1
+            telemetry_metrics.inc_preemptions(drained=True)
+            if recovery is not None:
+                recovery.note_preemption(drained=True)
+        else:
+            self.num_reaped += 1
+            self.num_scale_downs += 1
+        self.algo.on_fleet_change(added=[], removed=[w])
+        return True
+
+    # -- reporting -------------------------------------------------------
+
+    def _set_gauges(self) -> None:
+        with self._lock:
+            draining = len(self._draining)
+        active = max(
+            0, self.workers.num_remote_workers() - draining
+        )
+        telemetry_metrics.set_fleet_size(
+            active=active, draining=draining
+        )
+
+    def stats(self) -> Dict:
+        with self._lock:
+            draining = len(self._draining)
+            pending = self._pending_scale
+        return {
+            "size": self.workers.num_remote_workers(),
+            "min_workers": self.min_workers,
+            "max_workers": self.max_workers,
+            "draining": draining,
+            "pending_scale": pending,
+            "scale_ups": self.num_scale_ups,
+            "scale_downs": self.num_scale_downs,
+            "preemptions_drained": self.num_drained,
+            "preemptions_lost": self.num_preempt_lost,
+            "reaped_idle": self.num_reaped,
+        }
+
+    def stop(self) -> None:
+        """Monitor-thread teardown (owned by ``Algorithm.cleanup``):
+        signal, then JOIN — a daemonized observer must not outlive the
+        WorkerSet it watches."""
+        self._stop.set()
+        if self._thread.is_alive():
+            self._thread.join(timeout=5.0)

@@ -273,6 +273,33 @@ class RolloutWorker:
         batch = self.sample()
         return batch, batch.env_steps()
 
+    # -- preemption / drain protocol (docs/resilience.md) ----------------
+
+    def preemption_notice(self) -> Optional[float]:
+        """Seconds of grace left before this worker's (injected)
+        preemption kills the process, or None. The FleetController
+        polls this off the critical path; a real deployment would
+        back it with the cloud provider's eviction endpoint."""
+        if self._fault_injector is None:
+            return None
+        return self._fault_injector.preemption_notice()
+
+    def drain_for_preemption(self) -> Dict[str, Any]:
+        """Graceful exit: ship everything the fleet would otherwise
+        lose with this worker — flushed observation-filter deltas and
+        the episodes not yet harvested. Actor calls execute in order,
+        so by the time this returns every previously submitted
+        ``sample`` has completed and its result is already in the
+        object store (the manager harvests those normally). After the
+        drain the worker answers no more sample calls usefully; the
+        driver removes it from rotation and reaps the process."""
+        self._draining = True
+        return {
+            "filters": self.get_filters(flush_after=True),
+            "metrics": self.get_metrics(),
+            "num_sample_calls": self._num_sample_calls,
+        }
+
     def add_policy(
         self,
         policy_id: str,

@@ -57,15 +57,25 @@ class WorkerSet:
                 num_workers=num_workers,
             )
         if num_workers > 0:
-            self.add_workers(num_workers)
+            # the initial population needs no elastic-join sync: every
+            # worker just built its policy from the same config/seed
+            # the local worker did, and nothing has trained yet
+            self.add_workers(num_workers, sync=False)
 
     def add_workers(
-        self, num_workers: int, *, config_overrides: Optional[Dict] = None
+        self,
+        num_workers: int,
+        *,
+        config_overrides: Optional[Dict] = None,
+        sync: bool = True,
     ) -> None:
         """reference worker_set.py:234. ``config_overrides`` lets the
         recovery path hand replacements a modified config (e.g. an
         empty ``fault_injection`` spec so a recreated worker doesn't
-        re-run its predecessor's death sentence)."""
+        re-run its predecessor's death sentence). ``sync`` (default
+        True — every mid-run join) queues the elastic-join
+        weight+filter sync on the new actors; the constructor's
+        initial population skips it."""
         if not ray.is_initialized():
             ray.init()
         RemoteWorker = ray.remote(RolloutWorker)
@@ -79,16 +89,14 @@ class WorkerSet:
             "_mesh": None,
             **(config_overrides or {}),
         }
-        # an injected kill models a preemption: the host is GONE, so
-        # the runtime's in-place actor restart must not resurrect it
-        # (a restarted process re-arms the injector's death sentence —
-        # fresh call counts — and the chaos run never converges); the
-        # recovery layer replaces the worker with a disarmed config
-        # instead
+        # an injected kill/preemption models a lost host: the runtime's
+        # in-place actor restart must not resurrect it (a restarted
+        # process re-arms the injector's death sentence — fresh call
+        # counts — and the chaos run never converges); the recovery
+        # layer replaces the worker with a disarmed config instead
+        fi = worker_config.get("fault_injection") or {}
         kill_armed = bool(
-            (worker_config.get("fault_injection") or {}).get(
-                "kill_worker"
-            )
+            fi.get("kill_worker") or fi.get("preempt_worker")
         )
         restarts = (
             3
@@ -111,7 +119,32 @@ class WorkerSet:
                     num_workers=num_workers,
                 )
             )
+        # Elastic-join contract (docs/resilience.md): a joining worker
+        # receives the CURRENT weights and observation-filter state
+        # before its first sample call — actor calls execute in
+        # submission order, so queuing the sync here, before the new
+        # handles are ever returned to a sampling rotation, guarantees
+        # it. A stale-policy first sample on scale-up would be silent
+        # off-policy corruption for PPO (importance ratios computed
+        # against ACTION_LOGP from weights the learner no longer has).
+        if sync:
+            self._sync_new_workers(self._remote_workers[start:])
         self._update_fleet_gauge()
+
+    def _sync_new_workers(self, new_workers: List) -> None:
+        if self._local_worker is None or not new_workers:
+            return
+        if not getattr(self._local_worker, "policy_map", None):
+            return
+        weights = self._local_worker.get_weights()
+        filters = self._local_worker.get_filters()
+        ref = ray.put(weights)
+        for w in new_workers:
+            try:
+                w.set_weights.remote(ref)
+                w.sync_filters.remote(filters)
+            except _ACTOR_DEAD_ERRORS:
+                continue
 
     def _update_fleet_gauge(self) -> None:
         telemetry_metrics.gauge(
@@ -290,12 +323,13 @@ class WorkerSet:
             return []
         self.remove_workers(dead)
         before = len(self._remote_workers)
+        # add_workers weight+filter-syncs the replacements before they
+        # are returned (the elastic-join contract)
         self.add_workers(
             len(dead), config_overrides=self._REPLACEMENT_OVERRIDES
         )
         new = self._remote_workers[before:]
         telemetry_metrics.inc_worker_restarts(len(new))
-        self.sync_weights()
         return new
 
     def recreate_failed_workers(self) -> int:
@@ -314,8 +348,52 @@ class WorkerSet:
             len(bad), config_overrides=self._REPLACEMENT_OVERRIDES
         )
         telemetry_metrics.inc_worker_restarts(len(bad))
-        self.sync_weights()
         return len(bad)
+
+    # -- elastic scaling (docs/resilience.md "elastic fleets") ----------
+
+    def scale_up(self, num_workers: int) -> List:
+        """Grow the fleet by ``num_workers``; returns the new handles,
+        already weight+filter-synced (``add_workers``) so they can
+        enter a sampling rotation immediately. Joiners spawn with
+        fault injection disarmed — a scale-up must not inherit a
+        chaos spec keyed on reused worker indices."""
+        if num_workers <= 0:
+            return []
+        before = len(self._remote_workers)
+        self.add_workers(
+            num_workers, config_overrides=self._REPLACEMENT_OVERRIDES
+        )
+        return self._remote_workers[before:]
+
+    def scale_to(self, n: int) -> Dict[str, List]:
+        """Bring the fleet to exactly ``n`` remote workers. Scale-up
+        spawns synced joiners; scale-down picks the newest workers as
+        victims and removes them from the set (the caller — normally
+        the FleetController — owns draining them first: harvesting
+        in-flight work, merging filters, reaping the process).
+        Returns ``{"added": [...], "removed": [...]}``."""
+        n = max(0, int(n))
+        cur = len(self._remote_workers)
+        if n > cur:
+            return {"added": self.scale_up(n - cur), "removed": []}
+        if n < cur:
+            victims = self._remote_workers[n:]
+            self._remote_workers = self._remote_workers[:n]
+            self._update_fleet_gauge()
+            return {"added": [], "removed": victims}
+        return {"added": [], "removed": []}
+
+    def absorb_filters(self, remote_filters: Dict) -> None:
+        """Merge one worker's flushed filter deltas into the local
+        worker's filters (the drain protocol's last transfer — the
+        same math ``sync_filters`` applies fleet-wide)."""
+        if self._local_worker is None or not remote_filters:
+            return
+        local = self._local_worker.filters
+        for pid, f in remote_filters.items():
+            if pid in local and isinstance(f, MeanStdFilter):
+                local[pid].apply_changes(f, with_buffer=False)
 
     @property
     def retry_policy(self) -> RetryPolicy:

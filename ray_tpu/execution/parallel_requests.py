@@ -99,11 +99,72 @@ class AsyncRequestsManager:
                 self._dead_ids.discard(id(w))
                 self._dead = [d for d in self._dead if d is not w]
 
-    def remove_workers(self, workers: List) -> None:
-        """Stop submitting to ``workers``; their in-flight refs stay
-        tracked so completions (or errors) still drain."""
+    def remove_workers(
+        self, workers: List, *, drop_in_flight: bool = False
+    ) -> int:
+        """Stop submitting to ``workers``. By default their in-flight
+        refs stay tracked so completions (or errors) still drain
+        through ``get_ready``; with ``drop_in_flight`` the refs are
+        explicitly dropped and freed instead — scale-down semantics:
+        every outstanding request is either harvested or dropped, never
+        leaked into the in-flight gauge. Returns the number of refs
+        dropped."""
         drop = {id(w) for w in workers}
         self._workers = [w for w in self._workers if id(w) not in drop]
+        if not drop_in_flight:
+            return 0
+        return self._drop_refs(drop)
+
+    def _drop_refs(self, worker_ids: set, pending_only: bool = False) -> int:
+        """Drop (and free) in-flight refs belonging to ``worker_ids``.
+        ``pending_only`` keeps refs that already completed — their
+        results are in the object store and harvest normally even
+        after the worker process is gone."""
+        victims = [
+            ref
+            for ref, w in self._in_flight.items()
+            if id(w) in worker_ids
+        ]
+        if pending_only and victims:
+            ready, _ = ray.wait(
+                victims, num_returns=len(victims), timeout=0
+            )
+            done = {r.id for r in ready}
+            victims = [r for r in victims if r.id not in done]
+        dropped = 0
+        for ref in victims:
+            w = self._in_flight.pop(ref)
+            wid = id(w)
+            self._counts[wid] = max(0, self._counts.get(wid, 1) - 1)
+            self.num_dropped += 1
+            dropped += 1
+            if not self._return_refs:
+                try:
+                    ray.free([ref])
+                except Exception:
+                    pass
+        if dropped:
+            telemetry_metrics.set_requests_in_flight(
+                self.name, len(self._in_flight)
+            )
+        return dropped
+
+    def retire_worker(self, worker) -> int:
+        """Planned scale-down exit (drain or reap — docs/resilience.md):
+        take ``worker`` out of rotation, keep its COMPLETED in-flight
+        results for the normal harvest (they're already in the object
+        store), explicitly drop-and-free the still-pending ones, and
+        suppress any later death report — a drained worker observed
+        dead after its planned exit must not re-enter the failure
+        protocol as a casualty. Returns the number of dropped refs."""
+        self._workers = [w for w in self._workers if w is not worker]
+        dropped = self._drop_refs({id(worker)}, pending_only=True)
+        # pre-mark dead WITHOUT queuing a report: _mark_dead's
+        # report-once check sees the id and stays silent if the killed
+        # process later surfaces an actor-death error on a leftover ref
+        self._dead_ids.add(id(worker))
+        self._dead = [d for d in self._dead if d is not worker]
+        return dropped
 
     def workers(self) -> List:
         return list(self._workers)

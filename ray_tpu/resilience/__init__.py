@@ -36,9 +36,13 @@ from ray_tpu.resilience.retry import (  # noqa: F401
     probe_actors,
     ray_get_retrying,
 )
+from ray_tpu.resilience.streamer import (  # noqa: F401
+    CheckpointStreamer,
+)
 
 __all__ = [
     "ACTOR_DEAD_ERRORS",
+    "CheckpointStreamer",
     "DEFAULT_RETRYABLE",
     "FaultInjector",
     "InjectedCrash",

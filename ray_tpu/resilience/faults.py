@@ -23,6 +23,14 @@ Spec forms (dict keys / env tokens):
 
 - ``kill_worker``: ``[{"worker_index": W, "on_call": K}, ...]`` or
   ``"W@K,W@K"`` — worker W ``os._exit``\\ s on its K-th sample call.
+- ``preempt_worker``: ``[{"worker_index": W, "on_call": K,
+  "grace_s": G}]`` or ``"W@KxG"`` — a preemption WITH NOTICE: on its
+  K-th sample call worker W receives an eviction notice (visible to
+  the driver through :meth:`RolloutWorker.preemption_notice`) and
+  dies ``os._exit``-style G seconds later. A driver that drains the
+  worker inside the grace window loses nothing (docs/resilience.md
+  "elastic fleets & preemption"); one that doesn't sees an ordinary
+  unnoticed kill.
 - ``delay_sample``: ``[{"worker_index": W, "on_call": K,
   "delay_s": S}]`` or ``"W@KxS"`` — worker W's K-th sample sleeps S
   seconds (exercises probe/harvest timeouts without killing anyone).
@@ -52,6 +60,18 @@ class InjectedCrash(RuntimeError):
     """A deliberately injected, restartable driver-side failure."""
 
 
+def _arm_exit_timer(grace_s: float) -> None:
+    """Arm the hard exit of an injected preemption: this PROCESS dies
+    ``grace_s`` seconds from now, drained or not. Module-level so
+    notice-semantics unit tests can stub it — a real timer armed in
+    the test process would kill the test runner minutes later."""
+    import threading
+
+    t = threading.Timer(grace_s, os._exit, args=(1,))
+    t.daemon = True
+    t.start()
+
+
 def _parse_env_spec(text: str) -> Dict[str, Any]:
     """``kill_worker:2@3;nan_batch:@2;delay_sample:1@2x0.5`` → dict."""
     spec: Dict[str, Any] = {}
@@ -64,6 +84,18 @@ def _parse_env_spec(text: str) -> Dict[str, Any]:
                 w, _, k = item.partition("@")
                 lst.append(
                     {"worker_index": int(w), "on_call": int(k or 1)}
+                )
+        elif kind == "preempt_worker":
+            lst = spec.setdefault("preempt_worker", [])
+            for item in filter(None, arg.split(",")):
+                w, _, rest = item.partition("@")
+                k, _, g = rest.partition("x")
+                lst.append(
+                    {
+                        "worker_index": int(w),
+                        "on_call": int(k or 1),
+                        "grace_s": float(g or 10.0),
+                    }
                 )
         elif kind == "delay_sample":
             lst = spec.setdefault("delay_sample", [])
@@ -98,6 +130,9 @@ class FaultInjector:
         self._learn_calls = 0
         self._thread_steps = 0
         self._fired: set = set()
+        # preemption-with-notice state: monotonic deadline after which
+        # this process hard-exits (None = no notice outstanding)
+        self._preempt_deadline: Optional[float] = None
 
     # -- spec normalization ----------------------------------------------
 
@@ -131,12 +166,35 @@ class FaultInjector:
                 and self._match_once("delay_sample", entry)
             ):
                 time.sleep(float(entry.get("delay_s", 1.0)))
+        for entry in self._as_list(self.spec.get("preempt_worker")):
+            if (
+                int(entry.get("worker_index", -1)) == worker_index
+                and int(entry.get("on_call", 1)) == call_n
+                and self._match_once("preempt_worker", entry)
+            ):
+                # a preemption WITH NOTICE: record the eviction
+                # deadline (the driver polls it) and arm the hard
+                # exit. The sample in flight completes normally — the
+                # notice models the cloud provider's "you have G
+                # seconds" signal, not an instant death.
+                grace = float(entry.get("grace_s", 10.0))
+                self._preempt_deadline = time.monotonic() + grace
+                _arm_exit_timer(grace)
         for entry in self._as_list(self.spec.get("kill_worker")):
             if (
                 int(entry.get("worker_index", -1)) == worker_index
                 and int(entry.get("on_call", 1)) == call_n
             ):
                 os._exit(1)
+
+    def preemption_notice(self) -> Optional[float]:
+        """Seconds of grace remaining before this process's injected
+        preemption kills it, or None when no notice is outstanding —
+        the injected stand-in for a cloud provider's eviction
+        endpoint."""
+        if self._preempt_deadline is None:
+            return None
+        return max(0.0, self._preempt_deadline - time.monotonic())
 
     # -- driver learn side -----------------------------------------------
 

@@ -94,11 +94,15 @@ class RecoveryManager:
         self.num_worker_restarts = 0
         self.num_recoveries: collections.Counter = collections.Counter()
         self.num_skipped_batches = 0
+        self.num_preemptions_drained = 0
+        self.num_preemptions_lost = 0
         self.time_lost_s = 0.0
         self.iter_time_lost_s = 0.0
         self.latest_checkpoint: Optional[str] = None
         # a restarted driver pointed at the same checkpoint_root picks
-        # up where the dead one left off
+        # up where the dead one left off — from the newest periodic
+        # checkpoint AND, when checkpoint streaming ran, the stream
+        # tail (restore_latest prefers whichever is newer)
         if self.checkpoint_root and os.path.isdir(self.checkpoint_root):
             ckpts = sorted(
                 d
@@ -137,10 +141,69 @@ class RecoveryManager:
         if (
             isinstance(exc, Exception)
             and self.restore_on_failure
-            and self.latest_checkpoint
+            and (self.latest_checkpoint or self._stream_tail())
         ):
             return self._restore_from_checkpoint(exc)
         return False
+
+    def _stream_tail(self) -> Optional[str]:
+        """Newest continuous-stream snapshot: the live streamer's tail
+        when one is attached, else whatever a previous (crashed)
+        driver left under ``<checkpoint_root>/stream``."""
+        streamer = getattr(self.algo, "_ckpt_streamer", None)
+        if streamer is not None and streamer.latest_path:
+            return streamer.latest_path
+        if not self.checkpoint_root:
+            return None
+        from ray_tpu.resilience.streamer import CheckpointStreamer
+
+        return CheckpointStreamer.latest(
+            CheckpointStreamer.stream_root(self.checkpoint_root)
+        )
+
+    def _pick_restore_target(self):
+        """(kind, path): the stream tail when it is at least as new as
+        the latest periodic checkpoint (streaming bounds work lost to
+        ~1 superstep; the periodic path loses up to
+        ``checkpoint_frequency`` iterations), the periodic checkpoint
+        otherwise."""
+        tail = self._stream_tail()
+        if tail is None:
+            return ("checkpoint", self.latest_checkpoint)
+        if self.latest_checkpoint is None:
+            return ("stream", tail)
+        from ray_tpu.resilience.streamer import CheckpointStreamer
+
+        try:
+            tail_iter = CheckpointStreamer.peek(tail)["iteration"]
+        except Exception:
+            return ("checkpoint", self.latest_checkpoint)
+        # periodic dirs are named checkpoint_{iteration:06d}
+        try:
+            ckpt_iter = int(
+                os.path.basename(self.latest_checkpoint).split("_")[-1]
+            )
+        except ValueError:
+            ckpt_iter = -1
+        if tail_iter >= ckpt_iter:
+            return ("stream", tail)
+        return ("checkpoint", self.latest_checkpoint)
+
+    def restore_latest(self) -> Optional[str]:
+        """Restore the newest recovery state (stream tail or periodic
+        checkpoint) into the algorithm; returns the path restored from
+        or None when nothing exists yet. Used by the failure path and
+        by a restarted driver pointed at the same checkpoint_root."""
+        kind, path = self._pick_restore_target()
+        if path is None:
+            return None
+        if kind == "stream":
+            from ray_tpu.resilience.streamer import CheckpointStreamer
+
+            CheckpointStreamer.restore_into(self.algo, path)
+        else:
+            self.algo.restore(path)
+        return path
 
     def _recover_workers(self, exc: BaseException) -> bool:
         cfg = self.algo.config
@@ -167,11 +230,12 @@ class RecoveryManager:
             return False
         t0 = time.time()
         with tracing.start_span(
-            "recovery:restore",
-            error=type(exc).__name__,
-            checkpoint=self.latest_checkpoint,
-        ):
-            self.algo.restore(self.latest_checkpoint)
+            "recovery:restore", error=type(exc).__name__
+        ) as span:
+            restored = self.restore_latest()
+            span.set_attribute("restored_from", restored)
+        if restored is None:
+            return False
         self._note("restore", t0)
         self.algo.on_recovery("restore")
         return True
@@ -181,6 +245,19 @@ class RecoveryManager:
         self.num_skipped_batches += 1
         telemetry_metrics.inc_skipped_batches()
         tracing.event("recovery:skip_nan_batch")
+
+    def note_preemption(self, drained: bool) -> None:
+        """A worker preemption ran its course. A DRAINED preemption is
+        not a failure: the notice was honored, nothing was lost, and —
+        the elastic contract — it spends ZERO recovery budget. A lost
+        one is only counted here; the worker's death then flows
+        through the ordinary actor-death path (which does spend
+        budget)."""
+        if drained:
+            self.num_preemptions_drained += 1
+        else:
+            self.num_preemptions_lost += 1
+        tracing.event("recovery:preemption", drained=drained)
 
     # -- periodic checkpoints --------------------------------------------
 
@@ -216,6 +293,8 @@ class RecoveryManager:
             "worker_restarts": self.num_worker_restarts,
             "recoveries": dict(self.num_recoveries),
             "skipped_batches": self.num_skipped_batches,
+            "preemptions_drained": self.num_preemptions_drained,
+            "preemptions_lost": self.num_preemptions_lost,
             "time_lost_s": round(self.time_lost_s, 4),
             "time_lost_s_this_iter": round(self.iter_time_lost_s, 4),
             "latest_checkpoint": self.latest_checkpoint,

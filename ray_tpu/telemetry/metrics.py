@@ -46,6 +46,17 @@ ITERATION_SECONDS = "ray_tpu_iteration_seconds"
 WORKER_RESTARTS_TOTAL = "ray_tpu_worker_restarts_total"
 RECOVERIES_TOTAL = "ray_tpu_recoveries_total"
 SKIPPED_BATCHES_TOTAL = "ray_tpu_skipped_batches_total"
+# elastic fleets & preemption (docs/resilience.md): rollout-fleet size
+# by lifecycle state, preemptions by outcome (drained = graceful exit
+# inside the notice window; a lost preemption fell through to the
+# ordinary kill path), and the continuous checkpoint stream's
+# snapshot count + how many supersteps the written tail lags the run
+FLEET_SIZE = "ray_tpu_fleet_size"
+PREEMPTIONS_TOTAL = "ray_tpu_preemptions_total"
+CKPT_STREAM_SNAPSHOTS_TOTAL = (
+    "ray_tpu_checkpoint_stream_snapshots_total"
+)
+CKPT_STREAM_LAG = "ray_tpu_checkpoint_stream_lag_supersteps"
 # device-resident data plane (docs/data_plane.md): host→device bytes
 # by path — feeder (pipelined transfer), learn (sync learn_on_batch /
 # stacked-chain transfer), replay_insert (each transition's ONE
@@ -141,6 +152,51 @@ def inc_skipped_batches(n: int = 1) -> None:
         SKIPPED_BATCHES_TOTAL,
         "learn batches skipped by the non-finite guard",
     ).inc(float(n))
+
+
+def set_fleet_size(
+    active: int, draining: int = 0, joining: int = 0
+) -> None:
+    """Rollout-fleet size by lifecycle state (set by the
+    FleetController on every transition; docs/resilience.md fleet
+    state machine)."""
+    g = gauge(
+        FLEET_SIZE,
+        "rollout workers by fleet lifecycle state",
+        ("state",),
+    )
+    g.set(float(active), {"state": "active"})
+    g.set(float(draining), {"state": "draining"})
+    g.set(float(joining), {"state": "joining"})
+
+
+def inc_preemptions(drained: bool, n: int = 1) -> None:
+    """Worker preemptions observed, split by outcome: ``drained`` =
+    the eviction notice was honored (graceful exit, zero recovery
+    budget); otherwise the preemption fell through to the ordinary
+    kill/recovery path."""
+    counter(
+        PREEMPTIONS_TOTAL,
+        "worker preemptions by drain outcome",
+        ("drained",),
+    ).inc(float(n), {"drained": "true" if drained else "false"})
+
+
+def inc_stream_snapshots(n: int = 1) -> None:
+    """Snapshots written by the continuous CheckpointStreamer."""
+    counter(
+        CKPT_STREAM_SNAPSHOTS_TOTAL,
+        "continuous checkpoint stream snapshots written",
+    ).inc(float(n))
+
+
+def set_stream_lag(supersteps: int) -> None:
+    """How many supersteps the written stream tail lags the live run
+    (the work-lost bound on a driver crash)."""
+    gauge(
+        CKPT_STREAM_LAG,
+        "supersteps between the run head and the written stream tail",
+    ).set(float(supersteps))
 
 
 def inc_superstep_updates(n: int = 1) -> None:

@@ -120,6 +120,54 @@ def main() -> None:
     other_loss = kv.get(f"loss_{1 - rank}", timeout=60.0)
     assert abs(other_loss - stats["total_loss"]) < 1e-5
 
+    # ---- elastic learner fleet: drain host1 on notice, continue on
+    # host0 (the control-plane half of the elastic contract over gloo:
+    # notice → one final lockstep step → the survivor keeps training
+    # on its LOCAL mesh with the drained fleet's weights) ----
+    dist.sync_global("pre_elastic")
+    if rank == 1:
+        # the "eviction notice": host1 announces it is leaving
+        kv.put("preempt_host1", {"grace_s": 60.0})
+    kv.get("preempt_host1", timeout=30.0)  # both observe the notice
+    # the drain step: one last lockstep update over the global mesh so
+    # the departing host's in-flight contribution is not lost
+    drain_stats = policy.learn_on_device_batch(global_batch, bsize)
+    assert np.isfinite(drain_stats["total_loss"]), drain_stats
+    kv.put(f"drain_loss_{rank}", drain_stats["total_loss"])
+    other_drain = kv.get(f"drain_loss_{1 - rank}", timeout=60.0)
+    assert abs(other_drain - drain_stats["total_loss"]) < 1e-5
+    if rank == 1:
+        kv.put("host1_drained", True)
+    else:
+        # host0 survives the shrink: rebuild the learner on its LOCAL
+        # devices (no cross-host collectives) with the fleet's final
+        # weights — params are replicated, so the pull is addressable
+        kv.get("host1_drained", timeout=60.0)
+        from ray_tpu import sharding as sharding_lib
+
+        local_mesh = sharding_lib.get_mesh(
+            devices=jax.local_devices()
+        )
+        survivor = PPOJaxPolicy(
+            obs_space,
+            act_space,
+            {
+                "_mesh": local_mesh,
+                "model": {"fcnet_hiddens": [16]},
+                "train_batch_size": B,
+                "sgd_minibatch_size": B,
+                "num_sgd_iter": 1,
+                "lr": 1e-3,
+                "seed": 0,
+            },
+        )
+        survivor.set_weights(policy.get_weights())
+        solo_stats = survivor.learn_on_batch(
+            SampleBatch(host_batch)
+        )
+        assert np.isfinite(solo_stats["total_loss"]), solo_stats
+        print("ELASTIC_OK survivor continued on local mesh")
+
     dist.sync_global("done")
     alive = kv.alive_nodes()
     assert f"host{rank}" in alive

@@ -34,6 +34,9 @@ def test_impala_sync_mode_trains():
     algo.cleanup()
 
 
+@pytest.mark.slow  # >30 s wall on this container (PR-1 budget rule);
+# tier-1 keeps IMPALA coverage via test_impala_sync_mode_trains +
+# the learner-thread/superstep/elastic suites
 def test_impala_async_with_workers():
     algo = (
         IMPALAConfig()

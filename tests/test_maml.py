@@ -3,6 +3,7 @@
 import time
 
 import numpy as np
+import pytest
 
 from ray_tpu.algorithms.maml import MAMLConfig, PointGoalEnv
 from ray_tpu.env.registry import register_env
@@ -19,6 +20,9 @@ def test_point_goal_env_tasks():
     assert r <= 0.0
 
 
+@pytest.mark.slow  # learning regression, >10 s on this container
+# (PR-1 budget rule); tier-1 keeps the env/task contract via
+# test_point_goal_env_tasks
 def test_maml_meta_learns_fast_adaptation():
     register_env("point_goal", lambda cfg: PointGoalEnv(cfg))
     algo = (

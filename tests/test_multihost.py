@@ -104,3 +104,6 @@ def test_two_process_dcn_cluster():
     for rank, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"rank {rank} failed:\n{out}"
         assert f"MULTIHOST_OK rank={rank}" in out
+    # elastic learner-fleet case: host1 drained on notice, host0
+    # finished the lockstep drain step and continued on its local mesh
+    assert "ELASTIC_OK" in outs[0]

@@ -326,6 +326,8 @@ def test_crr_offline_step(tmp_path):
     algo.cleanup()
 
 
+@pytest.mark.slow  # budget rule: tier-1 keeps offline coverage via
+# the reader/writer/estimator unit tests in this file
 def test_marwil_trains_and_reports_estimates(tmp_path):
     out_dir = str(tmp_path / "data")
     ppo = (

@@ -151,6 +151,8 @@ def _ppo_cfg(prefetch, seed=21):
     )
 
 
+@pytest.mark.slow  # budget rule: tier-1 keeps prefetch coverage via
+# test_ppo_prefetch_smoke_multi_step + sync_sample determinism below
 def test_ppo_prefetch_first_step_matches_sync_path():
     """Before any staleness can enter (step 1: both paths sample with
     the initial weights), the pipelined path must assemble the identical

@@ -260,6 +260,8 @@ class _Carrier(Trainable):
         pass
 
 
+@pytest.mark.slow  # budget rule: tier-1 keeps PBT coverage via the
+# scheduler-decision unit tests in this file
 def test_pbt_exploit_transfers_state_across_actors():
     scheduler = PopulationBasedTraining(
         perturbation_interval=2,
