@@ -57,6 +57,15 @@ Flags:  --profile       run ONE telemetry-instrumented PPO iteration
                         writes benchmarks/e2e/jax_env_ab.json
                         (bench_mfu gains a `fused_rollout` sub-entry
                         on the jittable pong_lite port)
+        --serve         inference-plane A/B (docs/serving.md):
+                        continuous batching vs naive per-request
+                        inference on the same fixed-seed request
+                        stream at 1/8/32/128 concurrent clients —
+                        latency/throughput curve, zero-recompile and
+                        bitwise-parity checks; writes
+                        benchmarks/e2e/serve_ab.json (bench_mfu gains
+                        a `serve_forward` sub-entry at the pixel
+                        geometry for the next TPU round)
         --elastic       elastic-fleet chaos A/B (docs/resilience.md
                         "elastic fleets & preemption"): PPO fleet
                         forced 4→2→6 via noticed preemptions +
@@ -477,6 +486,49 @@ def bench_mfu(b=B, mb=MB, iters=ITERS, reps=4, h=H, w=W, c=C):
     except Exception as e:  # keep the headline bench alive
         fused_rollout = {"error": str(e)}
 
+    # serve_forward sub-entry (docs/serving.md): the inference plane's
+    # fused batched forward at the pixel geometry — one dispatch of a
+    # bucket of Nature-CNN action forwards on the learner-style mesh
+    # (vectorized mode: the wide-hardware throughput formulation the
+    # next TPU round measures at scale; the exact/bitwise mode is the
+    # contract bench.py --serve asserts on MLPs).
+    serve_forward = None
+    try:
+        from ray_tpu.serve.policy_server import BatchedPolicyServer
+        from ray_tpu.sharding.compile import compile_stats
+
+        bucket = 16
+        psrv = setups[lo][0]
+        srv = BatchedPolicyServer(
+            psrv,
+            max_batch_size=bucket,
+            buckets=(bucket,),
+            explore=False,
+            vectorized=True,
+            start=False,
+        )
+        obs_rows = make_frames(rng, bucket + c - 1, h, w, 1)
+        obs_rows = np.concatenate(
+            [obs_rows[i : i + bucket] for i in range(c)], axis=-1
+        )
+        srv.forward_padded(obs_rows)  # compile+warm
+        traces0 = compile_stats()["traces"]
+        sf_reps = max(2, reps // 2)
+        t0 = time.perf_counter()
+        for _ in range(sf_reps):
+            srv.forward_padded(obs_rows)
+        sf_wall = (time.perf_counter() - t0) / sf_reps
+        serve_forward = {
+            "bucket": bucket,
+            "wall_s_per_forward": round(sf_wall, 4),
+            "actions_per_s": round(bucket / sf_wall, 1),
+            "recompiles_in_timed_window": (
+                compile_stats()["traces"] - traces0
+            ),
+        }
+    except Exception as e:  # keep the headline bench alive
+        serve_forward = {"error": str(e)}
+
     peak, kind = chip_peak_tflops()
     if compute_per_nest <= 0:
         # tunnel jitter inverted the medians; a clamped value would
@@ -490,6 +542,7 @@ def bench_mfu(b=B, mb=MB, iters=ITERS, reps=4, h=H, w=W, c=C):
             "deferred_stats": deferred,
             "superstep": superstep,
             "fused_rollout": fused_rollout,
+            "serve_forward": serve_forward,
         }
     flops = b * iters * nature_cnn_train_flops_per_sample(h, w, c)
     achieved = flops / compute_per_nest / 1e12
@@ -505,6 +558,7 @@ def bench_mfu(b=B, mb=MB, iters=ITERS, reps=4, h=H, w=W, c=C):
         "deferred_stats": deferred,
         "superstep": superstep,
         "fused_rollout": fused_rollout,
+        "serve_forward": serve_forward,
     }
 
 
@@ -1562,6 +1616,209 @@ def bench_jax_env(out_path=None, iters=3, n_envs=32, t_rollout=64):
     return report
 
 
+def bench_serve(
+    out_path=None,
+    n_requests=512,
+    clients_list=(1, 8, 32, 128),
+    max_batch_size=128,
+):
+    """Inference-plane A/B (docs/serving.md): continuous batching vs
+    naive per-request inference, same fixed-seed request stream on
+    both sides, at 1/8/32/128 concurrent clients.
+
+      - per_request: one ``compute_actions`` dispatch per request (the
+        serve core's one-call-per-actor-call shape), clients serialized
+        on the policy exactly like calls arriving at one replica;
+      - batched: the ``BatchedPolicyServer`` coalesces the SAME stream
+        into bucket-padded fused forwards (greedy flush, donated rng
+        carry, zero recompiles after warmup — asserted off
+        ``compile_stats``).
+
+    Acceptance (ISSUE 9): >= 4x throughput at >= 32 clients, batched
+    p99 latency no worse than 2x the per-request p99, zero recompiles
+    in the timed window, and batched results bit-identical to the
+    sequential reference. Writes benchmarks/e2e/serve_ab.json."""
+    import threading
+
+    import gymnasium as gym
+    import jax
+
+    from ray_tpu import sharding as sharding_lib
+    from ray_tpu.algorithms.ppo.ppo import PPOJaxPolicy
+    from ray_tpu.serve.policy_server import (
+        BatchedPolicyServer,
+        default_buckets,
+    )
+    from ray_tpu.sharding.compile import compile_stats
+
+    out_path = out_path or "benchmarks/e2e/serve_ab.json"
+    obs_space = gym.spaces.Box(-1.0, 1.0, (8,), np.float32)
+    act_space = gym.spaces.Discrete(4)
+
+    def make_policy():
+        return PPOJaxPolicy(
+            obs_space,
+            act_space,
+            {
+                "seed": 0,
+                "lr": 3e-4,
+                "train_batch_size": 64,
+                "sgd_minibatch_size": 64,
+                "num_sgd_iter": 1,
+                "model": {"fcnet_hiddens": [64, 64]},
+                # bitwise parity is a 1-shard-mesh contract
+                "_mesh": sharding_lib.get_mesh(
+                    devices=jax.devices()[:1]
+                ),
+            },
+        )
+
+    rng = np.random.default_rng(0)
+    obs_stream = rng.uniform(-1, 1, (n_requests, 8)).astype(
+        np.float32
+    )
+
+    def run_clients(n_clients, issue):
+        latencies = np.zeros(n_requests)
+        next_i = [0]
+        ilock = threading.Lock()
+
+        def worker():
+            while True:
+                with ilock:
+                    i = next_i[0]
+                    if i >= n_requests:
+                        return
+                    next_i[0] += 1
+                t0 = time.perf_counter()
+                issue(i)
+                latencies[i] = time.perf_counter() - t0
+
+        threads = [
+            threading.Thread(target=worker)
+            for _ in range(n_clients)
+        ]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        return {
+            "throughput_rps": round(n_requests / wall, 1),
+            "wall_s": round(wall, 4),
+            "p50_ms": round(
+                float(np.percentile(latencies, 50)) * 1e3, 3
+            ),
+            "p99_ms": round(
+                float(np.percentile(latencies, 99)) * 1e3, 3
+            ),
+        }
+
+    # -- per-request side (explore=False: rng-independent, so the
+    # thread interleave can't change results)
+    naive = make_policy()
+    naive_lock = threading.Lock()
+    naive_actions = np.zeros(n_requests, np.int64)
+    naive.compute_actions(obs_stream[:1], explore=False)  # compile
+
+    def issue_naive(i):
+        with naive_lock:
+            a, _, _ = naive.compute_actions(
+                obs_stream[i][None], explore=False
+            )
+        naive_actions[i] = a[0]
+
+    # -- batched side: ONE server reused across the whole sweep
+    server = BatchedPolicyServer(
+        make_policy(),
+        max_batch_size=max_batch_size,
+        batch_wait_timeout_s=0.001,
+        explore=False,
+        start=False,
+    )
+    server.warmup()
+    server.start()
+    batched_actions = np.zeros(n_requests, np.int64)
+    batched_logp = np.zeros(n_requests, np.float32)
+
+    def issue_batched(i):
+        a, ex = server.submit(obs_stream[i]).result(120.0)
+        batched_actions[i] = a
+        batched_logp[i] = ex["action_logp"]
+
+    curve = []
+    traces0 = compile_stats()["traces"]
+    for c in clients_list:
+        per_request = run_clients(c, issue_naive)
+        batches0 = server.batches_total
+        rows0 = server.batch_rows_total
+        batched = run_clients(c, issue_batched)
+        nb = server.batches_total - batches0
+        batched["mean_batch_rows"] = round(
+            (server.batch_rows_total - rows0) / max(1, nb), 2
+        )
+        entry = {
+            "clients": c,
+            "per_request": per_request,
+            "batched": batched,
+            "speedup": round(
+                batched["throughput_rps"]
+                / per_request["throughput_rps"],
+                2,
+            ),
+            "p99_ratio": round(
+                batched["p99_ms"] / per_request["p99_ms"], 2
+            ),
+        }
+        curve.append(entry)
+    recompiles = compile_stats()["traces"] - traces0
+
+    # -- bitwise parity of the batched stream vs a fresh sequential
+    # reference (same seed, same order)
+    ref = make_policy()
+    parity = True
+    for i in range(n_requests):
+        a, _, ex = ref.compute_actions(
+            obs_stream[i][None], explore=False
+        )
+        if a[0] != batched_actions[i] or not np.array_equal(
+            ex["action_logp"][0], batched_logp[i]
+        ):
+            parity = False
+            break
+    server.stop()
+
+    wide = [e for e in curve if e["clients"] >= 32]
+    report = {
+        "metric": "serve_continuous_batching_ab",
+        "n_requests": n_requests,
+        "obs_dim": 8,
+        "model": [64, 64],
+        "max_batch_size": max_batch_size,
+        "buckets": list(default_buckets(max_batch_size)),
+        "curve": curve,
+        "recompiles_in_timed_window": recompiles,
+        "parity_bitwise": parity,
+        "criteria": {
+            "speedup_ge_4x_at_32plus_clients": all(
+                e["speedup"] >= 4.0 for e in wide
+            ),
+            "p99_no_worse_than_2x": all(
+                e["p99_ratio"] <= 2.0 for e in wide
+            ),
+            "zero_recompiles": recompiles == 0,
+        },
+    }
+    import os
+
+    os.makedirs(os.path.dirname(out_path), exist_ok=True)
+    with open(out_path, "w") as f:
+        json.dump(report, f, indent=1)
+    print(json.dumps(report))
+    return report
+
+
 def main():
     if "--e2e" in sys.argv:
         from bench_e2e import main as e2e_main
@@ -1579,6 +1836,9 @@ def main():
         return
     if "--jax-env" in sys.argv:
         bench_jax_env()
+        return
+    if "--serve" in sys.argv:
+        bench_serve()
         return
     if "--profile" in sys.argv:
         bench_profile()

@@ -277,6 +277,17 @@ class ObjectStore:
         e.error = err
         e.fire()
 
+    def peek_error(self, obj_id: str) -> Optional[BaseException]:
+        """The stored error of a READY object, without raising (None
+        for pending or successful objects). Lets completion callbacks
+        classify failures — e.g. a serve handle marking a replica dead
+        on an actor-death error — without consuming the ref."""
+        with self._lock:
+            e = self._entries.get(obj_id)
+        if e is None or not e.event.is_set():
+            return None
+        return e.error
+
     def put_remote(self, obj_id: str, loc: Dict) -> None:
         """Mark the object ready with its primary copy NODE-RESIDENT
         (reference: per-node plasma + object directory,

@@ -276,13 +276,20 @@ class RolloutWorker:
     # -- preemption / drain protocol (docs/resilience.md) ----------------
 
     def preemption_notice(self) -> Optional[float]:
-        """Seconds of grace left before this worker's (injected)
-        preemption kills the process, or None. The FleetController
-        polls this off the critical path; a real deployment would
-        back it with the cloud provider's eviction endpoint."""
-        if self._fault_injector is None:
-            return None
-        return self._fault_injector.preemption_notice()
+        """Seconds of grace left before this worker's preemption kills
+        the process, or None. The FleetController polls this off the
+        critical path. Two sources: the injected chaos deadline, and —
+        absent an injector notice — the provider stub
+        (``resilience/provider_notice.py``: env var / file probe, the
+        same surface serving replicas poll), which is where a real
+        cloud eviction endpoint plugs in."""
+        if self._fault_injector is not None:
+            grace = self._fault_injector.preemption_notice()
+            if grace is not None:
+                return grace
+        from ray_tpu.resilience import provider_notice
+
+        return provider_notice.probe()
 
     def drain_for_preemption(self) -> Dict[str, Any]:
         """Graceful exit: ship everything the fleet would otherwise

@@ -328,6 +328,22 @@ class JaxPolicy(Policy):
         return actions, state_out, extra, expl_state
 
     @property
+    def supports_batched_serve(self) -> bool:
+        """Whether concurrent single-request inference may coalesce
+        into the serve plane's fused batched forward
+        (``serve/policy_server.py``): the program vmaps
+        :meth:`_action_step_body` over per-request rng keys, so it
+        needs a feedforward model and stateless exploration (carried
+        OU/ParameterNoise state is per-stream, and a request stream
+        has no stable slot identity). Ineligible policies still serve,
+        one ``compute_actions`` per request."""
+        return (
+            not self.model.is_recurrent
+            and not self.exploration.needs_last_obs
+            and self.exploration.initial_state(1) == ()
+        )
+
+    @property
     def supports_jax_rollout(self) -> bool:
         """Whether this policy's act path can lower into the device
         rollout lane's scanned program (``execution/jax_rollout.py``):

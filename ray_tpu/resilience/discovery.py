@@ -1,0 +1,122 @@
+"""Checkpoint discovery shared by recovery and serving.
+
+One place answers "what is the newest restorable state under this
+``checkpoint_root``?" for every consumer: the driver-side
+:class:`~ray_tpu.resilience.recovery.RecoveryManager` (crash restore),
+a restarted driver pointed at the same root, and the serve plane's
+checkpoint hot-reload watcher (``serve/policy_server.py``). Before
+this module the preference logic lived inside ``RecoveryManager``;
+factoring it out keeps the two consumers from drifting — the serve
+watcher restores from EXACTLY the snapshot a crashed trainer would.
+
+The preference contract (docs/resilience.md):
+
+- a **stream tail** (continuous ``CheckpointStreamer`` snapshot under
+  ``<root>/stream/``) wins whenever its iteration is **at least** the
+  newest periodic checkpoint's — streaming bounds work lost to ~1
+  superstep, the periodic path to ``checkpoint_frequency`` iterations;
+- an unreadable tail (pruned mid-read, torn by a dying writer) falls
+  back to the periodic checkpoint rather than erroring — every probe
+  here is prune-safe, because the trainer deletes old snapshots and
+  checkpoints while watchers are looking.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Tuple
+
+PERIODIC_PREFIX = "checkpoint_"
+
+
+def latest_periodic(checkpoint_root: Optional[str]) -> Optional[str]:
+    """Newest ``checkpoint_*`` entry under ``checkpoint_root`` (the
+    zero-padded iteration names sort chronologically), or None. Same
+    scan the RecoveryManager constructor has always run."""
+    if not checkpoint_root or not os.path.isdir(checkpoint_root):
+        return None
+    try:
+        ckpts = sorted(
+            d
+            for d in os.listdir(checkpoint_root)
+            if d.startswith(PERIODIC_PREFIX)
+        )
+    except OSError:
+        return None
+    if not ckpts:
+        return None
+    return os.path.join(checkpoint_root, ckpts[-1])
+
+
+def latest_stream_tail(checkpoint_root: Optional[str]) -> Optional[str]:
+    """Newest continuous-stream snapshot under ``<root>/stream/``."""
+    if not checkpoint_root:
+        return None
+    from ray_tpu.resilience.streamer import CheckpointStreamer
+
+    return CheckpointStreamer.latest(
+        CheckpointStreamer.stream_root(checkpoint_root)
+    )
+
+
+def periodic_iteration(path: Optional[str]) -> int:
+    """Iteration baked into a periodic checkpoint's directory name
+    (``checkpoint_{iteration:06d}``); -1 when unparseable."""
+    if not path:
+        return -1
+    try:
+        return int(os.path.basename(path).split("_")[-1])
+    except ValueError:
+        return -1
+
+
+def pick_restore_target(
+    periodic: Optional[str], stream_tail: Optional[str]
+) -> Tuple[str, Optional[str]]:
+    """``(kind, path)`` — the newest of a periodic checkpoint and a
+    stream tail, kinds ``"checkpoint"`` / ``"stream"``. The stream
+    tail wins when its recorded iteration is at least the periodic
+    checkpoint's; an unreadable tail loses (prune-safe fallback).
+    Exactly the RecoveryManager preference, regression-pinned by
+    tests/test_serve_policy.py."""
+    if stream_tail is None:
+        return ("checkpoint", periodic)
+    if periodic is None:
+        return ("stream", stream_tail)
+    from ray_tpu.resilience.streamer import CheckpointStreamer
+
+    try:
+        tail_iter = CheckpointStreamer.peek(stream_tail)["iteration"]
+    except Exception:
+        return ("checkpoint", periodic)
+    if tail_iter >= periodic_iteration(periodic):
+        return ("stream", stream_tail)
+    return ("checkpoint", periodic)
+
+
+def discover(
+    checkpoint_root: Optional[str],
+) -> Tuple[str, Optional[str]]:
+    """Scan ``checkpoint_root`` and return the preferred
+    ``(kind, path)`` restore target (path None when nothing exists
+    yet) — the one-call surface the serve watcher polls."""
+    return pick_restore_target(
+        latest_periodic(checkpoint_root),
+        latest_stream_tail(checkpoint_root),
+    )
+
+
+def target_version(kind: str, path: str) -> Tuple[int, int]:
+    """Orderable ``(iteration, superstep)`` freshness of a restore
+    target, so a watcher can decide "newer than what I loaded?" across
+    kinds. Periodic checkpoints carry no superstep (0); raises when
+    the target vanished or is torn (callers retry the next poll)."""
+    if kind == "stream":
+        from ray_tpu.resilience.streamer import CheckpointStreamer
+
+        head = CheckpointStreamer.peek(path)
+        return (int(head["iteration"]), int(head["superstep"]))
+    it = periodic_iteration(path)
+    if it < 0:
+        raise ValueError(f"unversioned periodic checkpoint {path!r}")
+    return (it, 0)

@@ -1,0 +1,53 @@
+"""Provider preemption-notice probe (the PR-8 leftover).
+
+Cloud providers surface spot/preemptible eviction through a local
+endpoint (GCE's ``instance/preempted`` metadata key, AWS's
+``spot/instance-action``). This module is the minimal in-repo stand-in:
+one non-blocking probe both **rollout workers**
+(:meth:`RolloutWorker.preemption_notice`) and **serving replicas**
+(:meth:`PolicyDeployment.preemption_notice`) consult, so the fleet
+controller and a serve controller drain on the same signal with no
+per-caller plumbing. A real deployment replaces :func:`probe` sources
+with the provider endpoint; the callers don't change.
+
+Sources, first hit wins (both are cheap enough for per-poll use):
+
+- ``RAY_TPU_PREEMPTION_NOTICE``: grace seconds as a float (an armed
+  env var preempts every process that inherits it);
+- ``RAY_TPU_PREEMPTION_NOTICE_FILE``: a path; the notice is armed the
+  moment the file exists, its content the grace seconds (empty or
+  unparseable = 0.0, i.e. evict NOW). Touching one file preempts one
+  specific worker/replica — the testing and ops surface.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+NOTICE_ENV = "RAY_TPU_PREEMPTION_NOTICE"
+NOTICE_FILE_ENV = "RAY_TPU_PREEMPTION_NOTICE_FILE"
+
+
+def _parse_grace(raw: str) -> float:
+    try:
+        return max(0.0, float(raw.strip()))
+    except (TypeError, ValueError):
+        return 0.0
+
+
+def probe() -> Optional[float]:
+    """Seconds of grace left before this process's provider-announced
+    preemption, or None when no notice is outstanding. Non-blocking
+    and exception-free — safe on every poll path."""
+    raw = os.environ.get(NOTICE_ENV)
+    if raw is not None and raw.strip():
+        return _parse_grace(raw)
+    path = os.environ.get(NOTICE_FILE_ENV)
+    if path:
+        try:
+            with open(path) as f:
+                return _parse_grace(f.read())
+        except OSError:
+            return None  # file absent: notice not armed (yet)
+    return None

@@ -98,21 +98,15 @@ class RecoveryManager:
         self.num_preemptions_lost = 0
         self.time_lost_s = 0.0
         self.iter_time_lost_s = 0.0
-        self.latest_checkpoint: Optional[str] = None
         # a restarted driver pointed at the same checkpoint_root picks
         # up where the dead one left off — from the newest periodic
         # checkpoint AND, when checkpoint streaming ran, the stream
         # tail (restore_latest prefers whichever is newer)
-        if self.checkpoint_root and os.path.isdir(self.checkpoint_root):
-            ckpts = sorted(
-                d
-                for d in os.listdir(self.checkpoint_root)
-                if d.startswith("checkpoint_")
-            )
-            if ckpts:
-                self.latest_checkpoint = os.path.join(
-                    self.checkpoint_root, ckpts[-1]
-                )
+        from ray_tpu.resilience import discovery
+
+        self.latest_checkpoint: Optional[str] = (
+            discovery.latest_periodic(self.checkpoint_root)
+        )
 
     # -- iteration bookkeeping -------------------------------------------
 
@@ -166,28 +160,14 @@ class RecoveryManager:
         the latest periodic checkpoint (streaming bounds work lost to
         ~1 superstep; the periodic path loses up to
         ``checkpoint_frequency`` iterations), the periodic checkpoint
-        otherwise."""
-        tail = self._stream_tail()
-        if tail is None:
-            return ("checkpoint", self.latest_checkpoint)
-        if self.latest_checkpoint is None:
-            return ("stream", tail)
-        from ray_tpu.resilience.streamer import CheckpointStreamer
+        otherwise. The preference itself lives in
+        ``resilience.discovery`` so the serve hot-reload watcher
+        restores from the same snapshot this manager would."""
+        from ray_tpu.resilience import discovery
 
-        try:
-            tail_iter = CheckpointStreamer.peek(tail)["iteration"]
-        except Exception:
-            return ("checkpoint", self.latest_checkpoint)
-        # periodic dirs are named checkpoint_{iteration:06d}
-        try:
-            ckpt_iter = int(
-                os.path.basename(self.latest_checkpoint).split("_")[-1]
-            )
-        except ValueError:
-            ckpt_iter = -1
-        if tail_iter >= ckpt_iter:
-            return ("stream", tail)
-        return ("checkpoint", self.latest_checkpoint)
+        return discovery.pick_restore_target(
+            self.latest_checkpoint, self._stream_tail()
+        )
 
     def restore_latest(self) -> Optional[str]:
         """Restore the newest recovery state (stream tail or periodic

@@ -10,6 +10,13 @@ from ray_tpu.serve.serve import (
     shutdown,
     update_deployment,
 )
+from ray_tpu.serve.policy_server import (
+    BatchedPolicyServer,
+    CheckpointWatcher,
+    PolicyDeployment,
+    policy_deployment,
+    restore_policy,
+)
 
 __all__ = [
     "deployment",
@@ -22,4 +29,9 @@ __all__ = [
     "get_deployment_handle",
     "update_deployment",
     "shutdown",
+    "BatchedPolicyServer",
+    "CheckpointWatcher",
+    "PolicyDeployment",
+    "policy_deployment",
+    "restore_policy",
 ]

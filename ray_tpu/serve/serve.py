@@ -41,6 +41,17 @@ DEFAULT_AUTOSCALING = {
     "upscale_delay_s": 0.5,
     "downscale_delay_s": 2.0,
     "interval_s": 0.25,
+    # queue-WAIT targeting (docs/serving.md): when set, replica stats
+    # (the policy server's queue_wait_p50_s) join the inflight signal —
+    # scale up when requests wait longer than this before a forward
+    # starts, allow scale-down only once waits fall well under it
+    "target_queue_wait_s": None,
+    # probe replica stats every N seconds even without a queue-wait
+    # target: dead/stopped replicas are removed from the published
+    # membership and replaced (None = probe only when queue-wait
+    # targeting already polls stats)
+    "health_check_interval_s": None,
+    "stats_timeout_s": 2.0,
 }
 
 
@@ -82,16 +93,30 @@ class _Replica:
             self._obj.reconfigure(user_config)
 
     def stats(self):
-        return {
+        """Replica stats, merged with the wrapped object's own
+        ``stats()`` when it has one — a policy server contributes its
+        queue/latency fields here, which is how the controller's
+        queue-wait autoscaler sees them (docs/serving.md)."""
+        out = {
             "num_requests": self.num_requests,
             "num_reconfigures": self.num_reconfigures,
         }
+        obj_stats = getattr(self._obj, "stats", None)
+        if callable(obj_stats):
+            try:
+                out.update(obj_stats() or {})
+            except Exception:
+                pass
+        return out
 
 
 class DeploymentHandle:
     """Routing client to a replica group (reference serve/handle.py):
     round-robin over the CURRENT membership, which a long-poll listener
-    keeps fresh as the autoscaler adds/removes replicas."""
+    keeps fresh as the autoscaler adds/removes replicas. Replicas a
+    completed call exposed as DEAD (actor-death errors) leave the
+    rotation immediately — no more round-robining into a corpse while
+    waiting for the controller to replace it."""
 
     def __init__(self, name: str, replicas: List):
         self.name = name
@@ -99,6 +124,7 @@ class DeploymentHandle:
         self._rr = 0
         self._lock = threading.Lock()
         self._inflight = 0
+        self._dead: set = set()
         # start at the key's CURRENT version: a redeploy must not
         # adopt the previous generation's (killed) membership still
         # sitting on the shared long-poll key
@@ -121,20 +147,62 @@ class DeploymentHandle:
             with self._lock:
                 self._version = version
                 self._replicas = list(replicas)
+                # a republished membership supersedes local dead
+                # marks: removed corpses drop off, and a REUSED slot
+                # (the controller only ever publishes live actors)
+                # must not inherit a stale mark
+                live = {self._rid(r) for r in self._replicas}
+                self._dead &= live
+
+    @staticmethod
+    def _rid(replica):
+        # ActorHandle identity; plain ``getattr`` with a non-underscore
+        # name would synthesize an ActorMethod instead of failing
+        return replica.__dict__.get("_actor_id") or id(replica)
+
+    def mark_dead(self, replica) -> None:
+        """Take a replica out of this handle's rotation (observed
+        actor-death). The controller's health pass replaces it; the
+        long-poll republish clears the mark."""
+        with self._lock:
+            self._dead.add(self._rid(replica))
+
+    def num_dead(self) -> int:
+        with self._lock:
+            return len(self._dead)
 
     def _next(self):
         with self._lock:
-            r = self._replicas[self._rr % len(self._replicas)]
+            n = len(self._replicas)
+            for _ in range(n):
+                r = self._replicas[self._rr % n]
+                self._rr += 1
+                if self._rid(r) not in self._dead:
+                    return r
+            # every member is marked dead: fall through to plain RR
+            # so the caller fails fast on the death error instead of
+            # hanging on an empty rotation
+            r = self._replicas[self._rr % n]
             self._rr += 1
-        return r
+            return r
 
-    def _track(self, ref):
+    def _track(self, ref, replica=None):
         with self._lock:
             self._inflight += 1
 
         def done():
             with self._lock:
                 self._inflight -= 1
+            if replica is not None:
+                err = ref._store.peek_error(ref.id)
+                if isinstance(
+                    err,
+                    (
+                        ray.core.object_store.RayActorError,
+                        ray.core.object_store.WorkerCrashedError,
+                    ),
+                ):
+                    self.mark_dead(replica)
 
         ref._store.on_ready(ref.id, done)
         return ref
@@ -148,8 +216,9 @@ class DeploymentHandle:
             return len(self._replicas)
 
     def remote(self, *args, **kwargs):
+        r = self._next()
         return self._track(
-            self._next().handle.remote(list(args), kwargs)
+            r.handle.remote(list(args), kwargs), r
         )
 
     def method(self, name: str):
@@ -157,10 +226,10 @@ class DeploymentHandle:
 
         class _M:
             def remote(self, *args, **kwargs):
+                r = handle._next()
                 return handle._track(
-                    handle._next().call_method.remote(
-                        name, list(args), kwargs
-                    )
+                    r.call_method.remote(name, list(args), kwargs),
+                    r,
                 )
 
         return _M()
@@ -205,6 +274,8 @@ class RunningDeployment:
         self.user_config = spec.user_config
         self._stop = threading.Event()
         self._last_scale = time.monotonic()
+        self._last_health = time.monotonic()
+        self.num_replaced = 0
         self._scaler = None
         # publish the initial membership so handles listening from an
         # older generation's version converge onto THIS generation
@@ -250,6 +321,84 @@ class RunningDeployment:
         except Exception:
             pass
 
+    def replica_stats(
+        self, timeout: Optional[float] = None
+    ) -> List:
+        """``[(replica, stats-dict | None | "dead"), ...]`` across the
+        current membership: merged ``_Replica.stats`` (incl. any
+        wrapped policy-server queue/latency fields), ``None`` for a
+        replica that missed the timeout (busy, not dead), ``"dead"``
+        on an actor-death error."""
+        if timeout is None:
+            timeout = (self.autoscaling or DEFAULT_AUTOSCALING)[
+                "stats_timeout_s"
+            ]
+        with self._members_lock:
+            members = list(self.replicas)
+        refs = [(r, r.stats.remote()) for r in members]
+        out = []
+        for r, ref in refs:
+            try:
+                out.append((r, ray.get(ref, timeout=timeout)))
+            except (
+                ray.core.object_store.RayActorError,
+                ray.core.object_store.WorkerCrashedError,
+            ):
+                out.append((r, "dead"))
+            except Exception:
+                out.append((r, None))
+        return out
+
+    def stats(self) -> Dict:
+        """Aggregated deployment stats for dashboards/tests: replica
+        count, inflight, and the queue/latency aggregate the
+        autoscaler keys off."""
+        pairs = self.replica_stats()
+        replica_dicts = [s for _, s in pairs if isinstance(s, dict)]
+        waits = [
+            s["queue_wait_p50_s"]
+            for s in replica_dicts
+            if s.get("queue_wait_p50_s") is not None
+        ]
+        return {
+            "name": self.name,
+            "num_replicas": len(pairs),
+            "num_replaced": self.num_replaced,
+            "inflight": self.handle.num_inflight(),
+            "queue_depth_total": sum(
+                s.get("queue_depth", 0) or 0 for s in replica_dicts
+            ),
+            "queue_wait_p50_s_max": max(waits) if waits else None,
+            "replicas": replica_dicts,
+        }
+
+    def _replace_dead(self, dead: List) -> None:
+        """Swap confirmed-dead replicas for fresh ones at constant
+        size; the republished membership also clears handle-side dead
+        marks for the removed corpses."""
+        if not dead:
+            return
+        replacements = [self._spawn_replica() for _ in dead]
+        with self._members_lock:
+            if self._stop.is_set():
+                for r in replacements:
+                    try:
+                        ray.kill(r)
+                    except Exception:
+                        pass
+                return
+            dead_ids = {id(r) for r in dead}
+            self.replicas = [
+                r for r in self.replicas if id(r) not in dead_ids
+            ] + replacements
+        self.num_replaced += len(dead)
+        self._publish()
+        for r in dead:
+            try:
+                ray.kill(r)  # make sure a wedged corpse stays dead
+            except Exception:
+                pass
+
     def _autoscale_loop(self):
         cfg = self.autoscaling
         while not self._stop.wait(cfg["interval_s"]):
@@ -259,8 +408,45 @@ class RunningDeployment:
             per = ongoing / max(1, n)
             target = cfg["target_num_ongoing_requests_per_replica"]
             now = time.monotonic()
+            # -- replica stats pass (queue-wait targeting / health) --
+            wait_target = cfg.get("target_queue_wait_s")
+            health_every = cfg.get("health_check_interval_s")
+            wait_signal = None
+            need_stats = wait_target is not None or (
+                health_every is not None
+                and now - self._last_health >= health_every
+            )
+            if need_stats:
+                self._last_health = now
+                pairs = self.replica_stats(
+                    timeout=cfg["stats_timeout_s"]
+                )
+                self._replace_dead(
+                    [r for r, s in pairs if s == "dead"]
+                )
+                waits = [
+                    s["queue_wait_p50_s"]
+                    for _, s in pairs
+                    if isinstance(s, dict)
+                    and s.get("queue_wait_p50_s") is not None
+                ]
+                if waits:
+                    wait_signal = max(waits)
+                with self._members_lock:
+                    n = len(self.replicas)
+            wait_hot = (
+                wait_target is not None
+                and wait_signal is not None
+                and wait_signal > wait_target
+            )
+            # scale-down must not race a hot queue: with a wait
+            # target set, waits have to be WELL under it (or unknown)
+            wait_cool = wait_target is None or (
+                wait_signal is None
+                or wait_signal < 0.25 * wait_target
+            )
             if (
-                per > target
+                (per > target or wait_hot)
                 and n < cfg["max_replicas"]
                 and now - self._last_scale >= cfg["upscale_delay_s"]
             ):
@@ -277,6 +463,7 @@ class RunningDeployment:
                 self._publish()
             elif (
                 per < 0.5 * target
+                and wait_cool
                 and n > cfg["min_replicas"]
                 and now - self._last_scale >= cfg["downscale_delay_s"]
             ):

@@ -72,6 +72,16 @@ ENV_STEPS_ON_DEVICE_TOTAL = "ray_tpu_env_steps_on_device_total"
 REPLAY_ROWS = "ray_tpu_replay_buffer_rows"
 REPLAY_CAPACITY = "ray_tpu_replay_buffer_capacity"
 REPLAY_BYTES = "ray_tpu_replay_buffer_bytes"
+# inference plane (docs/serving.md): the continuous-batching policy
+# server's queue depth, coalesced forward batch sizes, request count,
+# end-to-end request latency (p50/p99 read off the histogram or the
+# server's exact stats()), and the params version the replica serves
+# (bumps on checkpoint hot-reload)
+SERVE_QUEUE_DEPTH = "ray_tpu_serve_queue_depth"
+SERVE_BATCH_SIZE = "ray_tpu_serve_batch_size"
+SERVE_REQUESTS_TOTAL = "ray_tpu_serve_requests_total"
+SERVE_LATENCY_SECONDS = "ray_tpu_serve_latency_seconds"
+SERVE_PARAMS_VERSION = "ray_tpu_serve_params_version"
 
 
 def gauge(
@@ -259,6 +269,63 @@ def set_replay_occupancy(
         "replay buffer resident storage bytes",
         ("policy", "storage"),
     ).set(float(nbytes), tags)
+
+
+def set_serve_queue_depth(deployment: str, depth: int) -> None:
+    """Requests waiting in one policy server's batch queue — the
+    serve-plane saturation signal the queue-wait autoscaler keys off
+    (docs/serving.md)."""
+    gauge(
+        SERVE_QUEUE_DEPTH,
+        "policy-server requests waiting to be batched",
+        ("deployment",),
+    ).set(float(depth), {"deployment": deployment})
+
+
+def observe_serve_batch(deployment: str, rows: int) -> None:
+    """Size of one coalesced forward batch (pre-padding): the
+    continuous-batching efficiency signal — a p50 near 1 under load
+    means the batcher is flushing too eagerly."""
+    m = get_metric(SERVE_BATCH_SIZE)
+    if not isinstance(m, Histogram):
+        m = Histogram(
+            SERVE_BATCH_SIZE,
+            "coalesced policy-server forward batch rows",
+            boundaries=(1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0),
+            tag_keys=("deployment",),
+        )
+    m.observe(float(rows), {"deployment": deployment})
+
+
+def inc_serve_requests(deployment: str, n: int = 1) -> None:
+    counter(
+        SERVE_REQUESTS_TOTAL,
+        "policy-server requests accepted",
+        ("deployment",),
+    ).inc(float(n), {"deployment": deployment})
+
+
+def observe_serve_latency(deployment: str, seconds: float) -> None:
+    """End-to-end request latency (submit → result ready): queue wait
+    + batch assembly + the sharded forward + scatter."""
+    m = get_metric(SERVE_LATENCY_SECONDS)
+    if not isinstance(m, Histogram):
+        m = Histogram(
+            SERVE_LATENCY_SECONDS,
+            "policy-server request latency seconds",
+            tag_keys=("deployment",),
+        )
+    m.observe(float(seconds), {"deployment": deployment})
+
+
+def set_serve_params_version(deployment: str, version: int) -> None:
+    """Monotonic params version a policy server is serving; bumps
+    exactly once per applied checkpoint hot-reload."""
+    gauge(
+        SERVE_PARAMS_VERSION,
+        "params version served (bumps on checkpoint hot-reload)",
+        ("deployment",),
+    ).set(float(version), {"deployment": deployment})
 
 
 def h2d_bytes_by_path() -> Dict[str, float]:

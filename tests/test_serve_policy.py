@@ -1,0 +1,578 @@
+"""Continuous-batching inference plane (docs/serving.md).
+
+Covers the serve-plane contracts:
+
+- batched-vs-sequential BITWISE parity on a 1-shard mesh (any batcher
+  slicing of a fixed-seed request stream equals sequential
+  ``compute_actions``);
+- bucket rounding: zero recompiles after warmup across every
+  occupancy (``compile_stats``-asserted);
+- timeout-flush semantics (partial batch after ``batch_wait_timeout_s``,
+  full bucket immediately);
+- checkpoint hot-reload mid-traffic: no dropped requests, no blended
+  requests, monotone params versions;
+- shared checkpoint discovery (the RecoveryManager preference,
+  regression-pinned) and the provider preemption-notice stub;
+- queue-wait autoscaling + dead-replica routing/replacement in the
+  serve core;
+- the closed train -> checkpoint -> serve -> hot-reload loop on
+  CartPole.
+"""
+
+import os
+import pickle
+import threading
+import time
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import gymnasium as gym
+
+import ray_tpu as ray
+from ray_tpu import sharding as sharding_lib
+from ray_tpu.algorithms.ppo.ppo import PPOConfig, PPOJaxPolicy
+from ray_tpu.resilience import discovery, provider_notice
+from ray_tpu.serve import serve
+from ray_tpu.serve.policy_server import (
+    BatchedPolicyServer,
+    CheckpointWatcher,
+    PolicyDeployment,
+    default_buckets,
+    restore_policy,
+)
+from ray_tpu.sharding.compile import compile_stats
+
+
+@pytest.fixture(autouse=True)
+def _serve_cleanup():
+    yield
+    serve.shutdown()
+
+
+def _one_shard_mesh():
+    return sharding_lib.get_mesh(devices=jax.devices()[:1])
+
+
+def _cfg(seed=7, **over):
+    cfg = PPOConfig().to_dict()
+    cfg.update(
+        seed=seed,
+        num_workers=0,
+        train_batch_size=64,
+        sgd_minibatch_size=32,
+        num_sgd_iter=1,
+        lr=3e-4,
+        model={"fcnet_hiddens": [16, 16]},
+        _mesh=_one_shard_mesh(),
+    )
+    cfg.update(over)
+    return cfg
+
+
+_OBS = gym.spaces.Box(-1.0, 1.0, (4,), np.float32)
+_ACT = gym.spaces.Discrete(2)
+
+
+def _policy(seed=7, **over):
+    return PPOJaxPolicy(_OBS, _ACT, _cfg(seed=seed, **over))
+
+
+# -- determinism / batching contracts ----------------------------------
+
+
+def test_default_buckets():
+    assert default_buckets(1) == (1,)
+    assert default_buckets(8) == (1, 2, 4, 8)
+    assert default_buckets(12) == (1, 2, 4, 8, 12)
+
+
+def test_batched_bitwise_parity_with_sequential(rng):
+    """Any coalescing of a fixed-seed request stream is bit-identical
+    to sequential compute_actions on a 1-shard mesh — actions AND
+    every extra column (logp, dist inputs, vf preds)."""
+    server = BatchedPolicyServer(
+        _policy(), max_batch_size=8, batch_wait_timeout_s=0.005,
+        explore=True, start=False,
+    )
+    assert server.fused
+    server.warmup()
+    server.start()
+    ref_policy = _policy()  # same seed: same params, same rng carry
+
+    obs_stream = rng.uniform(-1, 1, (13, 4)).astype(np.float32)
+    futs = [server.submit(o) for o in obs_stream]
+    outs = [f.result(60.0) for f in futs]
+    server.stop()
+
+    for i, o in enumerate(obs_stream):
+        a_ref, _, ex_ref = ref_policy.compute_actions(
+            o[None], explore=True
+        )
+        a, ex = outs[i]
+        assert np.array_equal(a, a_ref[0]), i
+        for k, v in ex_ref.items():
+            assert np.array_equal(ex[k], v[0]), (i, k)
+    # coalescing actually happened (not 13 singleton batches)
+    assert server.batches_total < len(obs_stream)
+
+
+def test_bucket_rounding_zero_recompiles_after_warmup(rng):
+    server = BatchedPolicyServer(
+        _policy(), max_batch_size=8, batch_wait_timeout_s=0.001,
+        explore=True, start=False,
+    )
+    compiled = server.warmup()
+    assert compiled == len(server.buckets) == 4
+    server.start()
+    before = compile_stats()["traces"]
+    for n in (1, 2, 3, 5, 8, 8, 4, 1):
+        acts, extras = server.compute_actions(
+            rng.uniform(-1, 1, (n, 4)).astype(np.float32)
+        )
+        assert acts.shape[0] == n
+    server.stop()
+    assert compile_stats()["traces"] == before  # zero recompiles
+
+
+def test_warmup_leaves_rng_carry_untouched():
+    """n_real=0 warmup dispatches every bucket without consuming a
+    single split — the served stream is independent of warmup."""
+    server = BatchedPolicyServer(
+        _policy(), max_batch_size=4, start=False
+    )
+    before = np.asarray(server._carry)
+    server.warmup()
+    assert np.array_equal(np.asarray(server._carry), before)
+
+
+def test_timeout_flush_and_full_bucket_flush(rng):
+    server = BatchedPolicyServer(
+        _policy(), max_batch_size=4, batch_wait_timeout_s=0.25,
+        explore=False,
+    )
+    obs = rng.uniform(-1, 1, (3, 4)).astype(np.float32)
+    t0 = time.perf_counter()
+    futs = [server.submit(o) for o in obs]
+    for f in futs:
+        f.result(30.0)
+    waited = time.perf_counter() - t0
+    # partial batch: ONE flush, only after the wait window
+    assert server.batches_total == 1
+    assert server.batch_rows_total == 3
+    assert waited >= 0.2
+
+    # a full bucket flushes immediately, well inside the window
+    t0 = time.perf_counter()
+    futs = [
+        server.submit(o)
+        for o in rng.uniform(-1, 1, (4, 4)).astype(np.float32)
+    ]
+    for f in futs:
+        f.result(30.0)
+    assert time.perf_counter() - t0 < 0.2
+    assert server.batches_total == 2
+    server.stop()
+
+
+def test_hot_reload_mid_traffic_no_drops_no_blends(rng):
+    """Swapping params mid-stream never drops a request, never blends
+    one (every response is entirely one version's output), and the
+    version sequence is monotone."""
+    policy = _policy()
+    w1 = policy.get_weights()
+    w2 = jax.tree_util.tree_map(lambda x: -x, w1)
+
+    ref = _policy()
+    obs_stream = rng.uniform(-1, 1, (120, 4)).astype(np.float32)
+    ref.set_weights(w1)
+    exp1 = [
+        ref.compute_actions(o[None], explore=False)
+        for o in obs_stream
+    ]
+    ref.set_weights(w2)
+    exp2 = [
+        ref.compute_actions(o[None], explore=False)
+        for o in obs_stream
+    ]
+
+    server = BatchedPolicyServer(
+        policy, max_batch_size=4, batch_wait_timeout_s=0.001,
+        explore=False, start=False,
+    )
+    server.warmup()
+    server.start()
+    futs = []
+    for i, o in enumerate(obs_stream):
+        futs.append(server.submit(o))
+        if i == 40:
+            # make sure some early responses completed under v1
+            # before the swap is staged (FIFO resolution order)
+            futs[7].result(30.0)
+            server.update_params({"weights": w2})
+        if i % 16 == 0:
+            time.sleep(0.002)  # let batches interleave the stream
+    outs = [f.result(60.0) for f in futs]  # nothing dropped
+    server.stop()
+
+    versions = [f.params_version for f in futs]
+    assert versions == sorted(versions)  # monotone in FIFO order
+    assert versions[0] == 1 and versions[-1] == 2  # swap landed
+    for i, (a, ex) in enumerate(outs):
+        exp = exp1[i] if versions[i] == 1 else exp2[i]
+        assert np.array_equal(a, exp[0][0]), i  # no blended params
+        assert np.array_equal(
+            ex["action_logp"], exp[2]["action_logp"][0]
+        ), i
+
+
+# -- checkpoint discovery (shared helper regression) --------------------
+
+
+def _fake_stream_snapshot(path, iteration, superstep):
+    with open(path, "wb") as f:
+        pickle.dump(
+            {
+                "iteration": iteration,
+                "superstep": superstep,
+                "policy_states": {},
+            },
+            f,
+        )
+
+
+def test_discovery_prefers_newer_and_is_prune_safe(tmp_path):
+    root = str(tmp_path)
+    assert discovery.discover(root) == ("checkpoint", None)
+
+    ck2 = os.path.join(root, "checkpoint_000002")
+    os.makedirs(ck2)
+    assert discovery.discover(root) == ("checkpoint", ck2)
+    assert discovery.target_version("checkpoint", ck2) == (2, 0)
+
+    stream = os.path.join(root, "stream")
+    os.makedirs(stream)
+    tail = os.path.join(stream, "snapshot_0000000005.pkl")
+    _fake_stream_snapshot(tail, iteration=2, superstep=5)
+    # tie on iteration -> the stream tail wins (streaming bounds work
+    # lost to ~1 superstep; the RecoveryManager preference)
+    assert discovery.discover(root) == ("stream", tail)
+    assert discovery.target_version("stream", tail) == (2, 5)
+
+    # an OLDER tail loses to a newer periodic checkpoint
+    ck3 = os.path.join(root, "checkpoint_000003")
+    os.makedirs(ck3)
+    assert discovery.discover(root) == ("checkpoint", ck3)
+
+    # a torn/pruned tail falls back to the periodic checkpoint
+    with open(tail, "wb") as f:
+        f.write(b"torn")
+    assert discovery.pick_restore_target(ck3, tail) == (
+        "checkpoint",
+        ck3,
+    )
+
+
+def test_recovery_manager_uses_shared_discovery(tmp_path):
+    """The manager's restore preference IS the shared helper —
+    behavior pinned through the public _pick_restore_target surface."""
+    from ray_tpu.resilience.recovery import RecoveryManager
+
+    root = str(tmp_path)
+    ck = os.path.join(root, "checkpoint_000004")
+    os.makedirs(ck)
+
+    class _Algo:
+        config = {
+            "checkpoint_root": root,
+            "restore_on_failure": True,
+        }
+
+    mgr = RecoveryManager(_Algo())
+    assert mgr.latest_checkpoint == ck
+    assert mgr._pick_restore_target() == ("checkpoint", ck)
+    stream = os.path.join(root, "stream")
+    os.makedirs(stream)
+    tail = os.path.join(stream, "snapshot_0000000009.pkl")
+    _fake_stream_snapshot(tail, iteration=7, superstep=9)
+    assert mgr._pick_restore_target() == ("stream", tail)
+
+
+# -- provider preemption notice ----------------------------------------
+
+
+def test_provider_notice_probe(tmp_path, monkeypatch):
+    monkeypatch.delenv(provider_notice.NOTICE_ENV, raising=False)
+    monkeypatch.delenv(
+        provider_notice.NOTICE_FILE_ENV, raising=False
+    )
+    assert provider_notice.probe() is None
+
+    monkeypatch.setenv(provider_notice.NOTICE_ENV, "12.5")
+    assert provider_notice.probe() == 12.5
+    monkeypatch.delenv(provider_notice.NOTICE_ENV)
+
+    notice_file = tmp_path / "notice"
+    monkeypatch.setenv(
+        provider_notice.NOTICE_FILE_ENV, str(notice_file)
+    )
+    assert provider_notice.probe() is None  # not armed yet
+    notice_file.write_text("3.0")
+    assert provider_notice.probe() == 3.0
+    notice_file.write_text("")  # armed, unparseable -> evict NOW
+    assert provider_notice.probe() == 0.0
+
+
+def test_rollout_worker_and_replica_share_notice(
+    tmp_path, monkeypatch
+):
+    from ray_tpu.evaluation.rollout_worker import RolloutWorker
+
+    notice_file = tmp_path / "notice"
+    monkeypatch.setenv(
+        provider_notice.NOTICE_FILE_ENV, str(notice_file)
+    )
+    worker = RolloutWorker(config={})
+    assert worker.preemption_notice() is None
+    notice_file.write_text("30")
+    # one probe, two fleets: the rollout worker and a serving replica
+    # see the identical notice surface
+    assert worker.preemption_notice() == 30.0
+    assert PolicyDeployment.preemption_notice.__get__(
+        object.__new__(PolicyDeployment)
+    )() == 30.0
+
+
+# -- serve core: stats surfacing, queue-wait autoscale, dead routing ---
+
+
+class _FakeQueueServer:
+    """Deployment whose queue-wait stat is driven through a file —
+    synthetic load for the queue-wait autoscaler (replica processes
+    can't share memory with the test)."""
+
+    def __init__(self, wait_file):
+        self._wait_file = wait_file
+
+    def __call__(self, x):
+        return x
+
+    def stats(self):
+        try:
+            with open(self._wait_file) as f:
+                wait = float(f.read().strip())
+        except (OSError, ValueError):
+            wait = 0.0
+        return {"queue_depth": 0, "queue_wait_p50_s": wait}
+
+
+def test_queue_wait_autoscale_up_and_down(tmp_path):
+    wait_file = str(tmp_path / "wait")
+    with open(wait_file, "w") as f:
+        f.write("0.5")  # hot queue from the start
+
+    dep = serve.deployment(
+        _FakeQueueServer,
+        name="qwait",
+        autoscaling_config={
+            "min_replicas": 1,
+            "max_replicas": 3,
+            # inflight can't trigger anything: only queue wait drives
+            "target_num_ongoing_requests_per_replica": 1e9,
+            "target_queue_wait_s": 0.05,
+            "upscale_delay_s": 0.1,
+            "downscale_delay_s": 0.3,
+            "interval_s": 0.1,
+            "stats_timeout_s": 5.0,
+        },
+    )
+    handle = serve.run(dep.bind(wait_file))
+    assert handle.num_replicas() == 1
+    deadline = time.time() + 30
+    while time.time() < deadline and handle.num_replicas() < 2:
+        time.sleep(0.1)
+    assert handle.num_replicas() >= 2, "no queue-wait upscale"
+    # replica stats flow through RunningDeployment.stats()
+    agg = serve._DEPLOYMENTS["qwait"].stats()
+    assert agg["queue_wait_p50_s_max"] == 0.5
+    assert agg["num_replicas"] >= 2
+
+    with open(wait_file, "w") as f:
+        f.write("0.001")  # cold queue -> scale back down
+    deadline = time.time() + 30
+    while time.time() < deadline and handle.num_replicas() > 1:
+        time.sleep(0.1)
+    assert handle.num_replicas() == 1, "no scale-down on cold queue"
+
+
+class _Echo:
+    def __call__(self, x):
+        return x + 1
+
+
+def test_handle_routes_around_dead_replica_and_controller_replaces():
+    dep = serve.deployment(
+        _Echo,
+        name="routed",
+        autoscaling_config={
+            "min_replicas": 2,
+            "max_replicas": 2,
+            "health_check_interval_s": 0.2,
+            "interval_s": 0.1,
+            "stats_timeout_s": 5.0,
+        },
+    )
+    handle = serve.run(dep.bind())
+    assert ray.get(handle.remote(1)) == 2
+    running = serve._DEPLOYMENTS["routed"]
+    victim = running.replicas[0]
+    ray.kill(victim)
+
+    # the first call(s) routed at the corpse fail fast and mark it
+    # dead; afterwards the handle never routes into it again
+    failures = 0
+    for _ in range(8):
+        try:
+            assert ray.get(handle.remote(1), timeout=30) == 2
+        except Exception:
+            failures += 1
+    assert failures <= 2
+    assert handle.num_dead() >= 1 or running.num_replaced >= 1
+    for _ in range(6):  # routed-around: all succeed now
+        assert ray.get(handle.remote(1), timeout=30) == 2
+
+    # the controller health pass swaps the corpse for a fresh replica
+    deadline = time.time() + 30
+    while time.time() < deadline and running.num_replaced < 1:
+        time.sleep(0.1)
+    assert running.num_replaced >= 1
+    deadline = time.time() + 10
+    while time.time() < deadline and handle.num_dead() > 0:
+        time.sleep(0.1)
+    assert handle.num_dead() == 0  # republish cleared the mark
+    assert ray.get(handle.remote(5), timeout=30) == 6
+
+
+# -- the closed loop ----------------------------------------------------
+
+
+def test_e2e_train_serve_hot_reload_cartpole(tmp_path):
+    """train -> checkpoint -> serve -> train more -> watcher hot-
+    reloads: the serving replica tracks the live run's checkpoint_root
+    and ends up with the trainer's exact weights."""
+    from ray_tpu.algorithms.ppo.ppo import PPO
+
+    cfg = _cfg(seed=3)
+    cfg.pop("_mesh")
+    cfg.update(
+        env="CartPole-v1",
+        rollout_fragment_length=32,
+        train_batch_size=128,
+        sgd_minibatch_size=64,
+        num_sgd_iter=2,
+    )
+    algo = PPO(config=cfg)
+    root = str(tmp_path / "ckpts")
+    try:
+        algo.train()
+        algo.save(os.path.join(root, "checkpoint_000001"))
+
+        dep = PolicyDeployment(
+            root,
+            name="cartpole",
+            max_batch_size=4,
+            batch_wait_timeout_s=0.005,
+            poll_interval_s=0.1,
+        )
+        try:
+            obs = np.asarray(
+                [0.01, 0.02, 0.03, 0.04], np.float32
+            )
+            out = dep({"obs": obs.tolist()})
+            assert out["params_version"] == 1
+            assert out["action"] in (0, 1)
+
+            algo.train()
+            algo.save(os.path.join(root, "checkpoint_000002"))
+            deadline = time.time() + 30
+            while (
+                time.time() < deadline
+                and dep.server.params_version < 2
+            ):
+                time.sleep(0.1)
+            out2 = dep({"obs": obs.tolist()})
+            assert out2["params_version"] == 2
+            assert dep.watcher.num_reloads == 1
+
+            served = dep.server.policy.get_weights()
+            trained = algo.get_policy().get_weights()
+            for a, b in zip(
+                jax.tree_util.tree_leaves(served),
+                jax.tree_util.tree_leaves(trained),
+            ):
+                assert np.array_equal(a, b)
+            # stats carry the queue/latency surface the autoscaler
+            # and bench read
+            st = dep.stats()
+            assert st["requests_total"] >= 2
+            assert st["latency_p50_s"] is not None
+            assert st["reload"]["num_reloads"] == 1
+        finally:
+            dep.stop()
+    finally:
+        algo.cleanup()
+
+
+def test_watcher_follows_stream_snapshots(tmp_path, rng):
+    """A continuous-stream tail newer than the periodic checkpoint
+    hot-reloads too (the RecoveryManager preference, live)."""
+    policy = _policy()
+    server = BatchedPolicyServer(
+        policy, max_batch_size=2, explore=False, start=False,
+    )
+    server.warmup()
+    server.start()
+    root = str(tmp_path)
+    stream = os.path.join(root, "stream")
+    os.makedirs(stream)
+    w2 = jax.tree_util.tree_map(
+        lambda x: x + 1.0, policy.get_weights()
+    )
+    with open(
+        os.path.join(stream, "snapshot_0000000003.pkl"), "wb"
+    ) as f:
+        pickle.dump(
+            {
+                "iteration": 1,
+                "superstep": 3,
+                "policy_states": {
+                    "default_policy": {"weights": w2}
+                },
+            },
+            f,
+        )
+    watcher = CheckpointWatcher(
+        root,
+        lambda state, info: server.update_params(
+            state, info=info
+        ),
+        poll_interval_s=0.05,
+    )
+    try:
+        deadline = time.time() + 20
+        while (
+            time.time() < deadline and server.params_version < 2
+        ):
+            time.sleep(0.05)
+        assert server.params_version == 2
+        assert watcher.version == (1, 3)
+        leaf_served = jax.tree_util.tree_leaves(
+            server.policy.get_weights()
+        )[0]
+        assert np.array_equal(
+            leaf_served, jax.tree_util.tree_leaves(w2)[0]
+        )
+    finally:
+        watcher.stop()
+        server.stop()
