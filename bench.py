@@ -529,6 +529,78 @@ def bench_mfu(b=B, mb=MB, iters=ITERS, reps=4, h=H, w=W, c=C):
     except Exception as e:  # keep the headline bench alive
         serve_forward = {"error": str(e)}
 
+    # transformer_nest sub-entry (docs/sharding.md "2-D mesh & param
+    # partitioning"): the decoder-transformer SGD nest — the
+    # architecture-agnostic learner proof — timed like the headline
+    # nest, so the next TPU round measures the tensor-parallel torso
+    # at real widths next to the Nature-CNN number (pair with
+    # bench.py --model-parallel for the replicated-vs-partitioned A/B).
+    transformer_nest = None
+    try:
+        import gymnasium as _gym
+
+        from ray_tpu.algorithms.ppo.ppo import (
+            PPOJaxPolicy as _TPPOPol,
+        )
+        from ray_tpu.sharding.compile import compile_stats
+
+        t_b, t_mb, t_obs = 256, 128, 64
+        pt = _TPPOPol(
+            _gym.spaces.Box(-1, 1, (t_obs,), np.float32),
+            _gym.spaces.Discrete(8),
+            {
+                "train_batch_size": t_b,
+                "sgd_minibatch_size": t_mb,
+                "num_sgd_iter": iters,
+                "lr": 3e-4,
+                "seed": 0,
+                "model": {
+                    "use_transformer": True,
+                    "transformer_dim": 128,
+                    "transformer_num_layers": 2,
+                    "transformer_num_heads": 4,
+                    "transformer_ff_dim": 512,
+                    "transformer_seq_len": 8,
+                },
+            },
+        )
+        t_rng = np.random.default_rng(0)
+        t_host = {
+            "obs": t_rng.standard_normal((t_b, t_obs)).astype(
+                np.float32
+            ),
+            "actions": t_rng.integers(0, 8, t_b).astype(np.int64),
+            "action_logp": np.full(t_b, -2.0, np.float32),
+            "action_dist_inputs": t_rng.standard_normal(
+                (t_b, 8)
+            ).astype(np.float32),
+            "advantages": t_rng.standard_normal(t_b).astype(
+                np.float32
+            ),
+            "value_targets": t_rng.standard_normal(t_b).astype(
+                np.float32
+            ),
+        }
+        t_prep, t_bsize = pt.prepare_batch(dict(t_host))
+        t_dev = jax.device_put(t_prep, pt.batch_shardings(t_prep))
+        pt.learn_on_device_batch(dict(t_dev), t_bsize)  # compile+warm
+        traces0 = compile_stats()["traces"]
+        tn_reps = max(2, reps // 2)
+        t0 = time.perf_counter()
+        for _ in range(tn_reps):
+            pt.learn_on_device_batch(dict(t_dev), t_bsize)
+        tn_wall = (time.perf_counter() - t0) / tn_reps
+        transformer_nest = {
+            "params": int(pt.model.num_params()),
+            "batch": t_b,
+            "wall_s_per_nest": round(tn_wall, 4),
+            "recompiles_in_timed_window": (
+                compile_stats()["traces"] - traces0
+            ),
+        }
+    except Exception as e:  # keep the headline bench alive
+        transformer_nest = {"error": str(e)}
+
     peak, kind = chip_peak_tflops()
     if compute_per_nest <= 0:
         # tunnel jitter inverted the medians; a clamped value would
@@ -543,6 +615,7 @@ def bench_mfu(b=B, mb=MB, iters=ITERS, reps=4, h=H, w=W, c=C):
             "superstep": superstep,
             "fused_rollout": fused_rollout,
             "serve_forward": serve_forward,
+            "transformer_nest": transformer_nest,
         }
     flops = b * iters * nature_cnn_train_flops_per_sample(h, w, c)
     achieved = flops / compute_per_nest / 1e12
@@ -559,6 +632,7 @@ def bench_mfu(b=B, mb=MB, iters=ITERS, reps=4, h=H, w=W, c=C):
         "superstep": superstep,
         "fused_rollout": fused_rollout,
         "serve_forward": serve_forward,
+        "transformer_nest": transformer_nest,
     }
 
 
@@ -1153,6 +1227,184 @@ def bench_superstep(
         json.dump(report, f, indent=1)
     print(json.dumps(report))
     return report
+
+
+def bench_model_parallel(out_path=None, m=4, reps=4):
+    """Replicated vs 2-D-partitioned transformer A/B
+    (docs/sharding.md "2-D mesh & param partitioning"): the SAME
+    fixed-seed transformer-PPO learn step on [("batch", D)] with
+    replicated params vs [("batch", D//M), ("model", M)] with
+    megatron-rule param placement — the geometry where replication is
+    the memory wall: every device holds the full tree on the left,
+    ~1/M of it on the right. Asserts per-shard ``params_bytes`` ~
+    total/M, fixed-seed parity (model_parallel=1 bitwise; M-way to
+    float-assoc tolerance — cross-shard reduction order), and zero
+    recompiles in the timed window. Writes
+    ``benchmarks/e2e/model_parallel_ab.json``. Runs itself under 8
+    simulated host devices when the process has fewer."""
+    import os
+    import subprocess
+
+    import jax
+
+    from ray_tpu import sharding as sharding_lib
+
+    if (
+        len(jax.devices()) < 2 * m
+        and not os.environ.get("_RT_MP_CHILD")
+    ):
+        env = {
+            **os.environ,
+            **sharding_lib.simulated_device_env(2 * m),
+            "_RT_MP_CHILD": "1",
+        }
+        subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--model-parallel"],
+            env=env,
+            check=True,
+        )
+        return
+
+    import gymnasium as gym
+
+    from ray_tpu.algorithms.ppo.ppo import PPOJaxPolicy
+    from ray_tpu.sharding.compile import compile_stats
+
+    os.makedirs("benchmarks/e2e", exist_ok=True)
+    out_path = out_path or "benchmarks/e2e/model_parallel_ab.json"
+    n_dev = len(jax.devices())
+    b, obs_dim = 512, 64
+    model = {
+        "use_transformer": True,
+        "transformer_dim": 256,
+        "transformer_num_layers": 4,
+        "transformer_num_heads": 8,
+        "transformer_ff_dim": 1024,
+        "transformer_seq_len": 8,
+    }
+
+    def make(mesh):
+        return PPOJaxPolicy(
+            gym.spaces.Box(-1, 1, (obs_dim,), np.float32),
+            gym.spaces.Discrete(8),
+            {
+                "train_batch_size": b,
+                "sgd_minibatch_size": b // 2,
+                "num_sgd_iter": 2,
+                "lr": 3e-4,
+                "seed": 0,
+                "model": dict(model),
+                "_mesh": mesh,
+            },
+        )
+
+    rng = np.random.default_rng(0)
+    host = {
+        "obs": rng.standard_normal((b, obs_dim)).astype(np.float32),
+        "actions": rng.integers(0, 8, b).astype(np.int64),
+        "action_logp": np.full(b, -2.0, np.float32),
+        "action_dist_inputs": rng.standard_normal((b, 8)).astype(
+            np.float32
+        ),
+        "advantages": rng.standard_normal(b).astype(np.float32),
+        "value_targets": rng.standard_normal(b).astype(np.float32),
+    }
+
+    arms = {
+        "replicated": sharding_lib.get_mesh(
+            devices=jax.devices()[:n_dev]
+        ),
+        "model_parallel_1": sharding_lib.get_mesh(
+            devices=jax.devices()[:n_dev],
+            axis_shapes=[("batch", n_dev), ("model", 1)],
+        ),
+        f"model_parallel_{m}": sharding_lib.get_mesh(
+            devices=jax.devices()[:n_dev],
+            axis_shapes=[("batch", n_dev // m), ("model", m)],
+        ),
+    }
+    results = {}
+    weights = {}
+    for name, mesh in arms.items():
+        p = make(mesh)
+        prep, bsize = p.prepare_batch(dict(host))
+        dev = jax.device_put(prep, p.batch_shardings(prep))
+        stats = p.learn_on_device_batch(dict(dev), bsize)  # warm
+        weights[name] = p.get_weights()
+        total = sharding_lib.tree_nbytes(p.params)
+        per_shard = (
+            sharding_lib.tree_shard_nbytes(
+                p.params, p.param_pspecs, p.mesh
+            )
+            if p.param_pspecs is not None
+            else total
+        )
+        traces0 = compile_stats()["traces"]
+        ts = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            p.learn_on_device_batch(dict(dev), bsize)
+            ts.append(time.perf_counter() - t0)
+        results[name] = {
+            "params_bytes_total": int(total),
+            "params_bytes_per_shard": int(per_shard),
+            "learn_wall_s_median": round(float(np.median(ts)), 4),
+            "first_step_total_loss": float(stats["total_loss"]),
+            "recompiles_in_timed_window": (
+                compile_stats()["traces"] - traces0
+            ),
+        }
+
+    # parity reference for the M-way arm: replicated on the SAME
+    # data-shard count (D//M shards), so the per-shard shuffle streams
+    # match and the ONLY difference is the model-axis split
+    p_ref = make(
+        sharding_lib.get_mesh(devices=jax.devices()[: n_dev // m])
+    )
+    prep, bsize = p_ref.prepare_batch(dict(host))
+    dev = jax.device_put(prep, p_ref.batch_shardings(prep))
+    p_ref.learn_on_device_batch(dict(dev), bsize)
+    weights["replicated_ref"] = p_ref.get_weights()
+
+    la = jax.tree_util.tree_leaves(weights["replicated"])
+    l1 = jax.tree_util.tree_leaves(weights["model_parallel_1"])
+    lr_ = jax.tree_util.tree_leaves(weights["replicated_ref"])
+    lm = jax.tree_util.tree_leaves(weights[f"model_parallel_{m}"])
+    parity_bitwise_mp1 = all(
+        np.array_equal(a, c) for a, c in zip(la, l1)
+    )
+    parity_allclose_mpm = all(
+        np.allclose(a, c, atol=5e-3) for a, c in zip(lr_, lm)
+    )
+    mp = results[f"model_parallel_{m}"]
+    shard_ratio = (
+        mp["params_bytes_per_shard"] / mp["params_bytes_total"]
+    )
+    out = {
+        "metric": "model_parallel_ab",
+        "devices": n_dev,
+        "model_parallel": m,
+        "geometry": {
+            "batch": b,
+            **{k: v for k, v in model.items() if k != "use_transformer"},
+        },
+        "arms": results,
+        # the memory-wall headline: one device's param bytes, and how
+        # close the split tree sits to the ideal total/M
+        "per_shard_over_total": round(shard_ratio, 4),
+        "ideal_over_total": round(1.0 / m, 4),
+        "parity_bitwise_mp1": bool(parity_bitwise_mp1),
+        f"parity_allclose_mp{m}": bool(parity_allclose_mpm),
+    }
+    assert parity_bitwise_mp1, "model_parallel=1 must be bitwise"
+    assert parity_allclose_mpm, f"{m}-way parity failed"
+    assert shard_ratio < 1.0 / m + 0.1, (
+        f"per-shard bytes {shard_ratio:.3f} of total; expected ~1/{m}"
+    )
+    with open(out_path, "w") as f:
+        json.dump(out, f, indent=2)
+    print(json.dumps(out))
+    return out
 
 
 def bench_chaos(out_path=None, iters=6):
@@ -1839,6 +2091,9 @@ def main():
         return
     if "--serve" in sys.argv:
         bench_serve()
+        return
+    if "--model-parallel" in sys.argv:
+        bench_model_parallel()
         return
     if "--profile" in sys.argv:
         bench_profile()

@@ -108,7 +108,24 @@ class Algorithm(Trainable):
         else:
             from ray_tpu import sharding as sharding_lib
 
-            config["_mesh"] = sharding_lib.get_mesh(devices=devices)
+            # model_parallel (docs/sharding.md): a 2-D (data x model)
+            # mesh — params of rule-declaring models split across M
+            # shards instead of replicating on every device
+            mp = sharding_lib.resolve_model_parallel(
+                config, devices, strict=True
+            )
+            if mp:
+                config["_mesh"] = sharding_lib.get_mesh(
+                    devices=devices,
+                    axis_shapes=[
+                        ("batch", len(devices) // mp),
+                        ("model", mp),
+                    ],
+                )
+            else:
+                config["_mesh"] = sharding_lib.get_mesh(
+                    devices=devices
+                )
 
         policy_specs = None
         policy_mapping_fn = config.get("policy_mapping_fn")

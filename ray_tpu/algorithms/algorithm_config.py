@@ -176,6 +176,17 @@ class AlgorithmConfig:
         # the legacy ("data",)-mesh path with implicit placement.
         # Fixed-seed results are bit-identical between the two.
         self.sharding_backend = "mesh"
+        # tensor parallelism (docs/sharding.md "2-D mesh & param
+        # partitioning"): None (default) keeps the 1-D data mesh; an
+        # int M (or "auto") builds the 2-D [("batch", D//M),
+        # ("model", M)] mesh and places params per the model's
+        # partition rules — attention/MLP kernels split across M
+        # shards, so a policy too large to replicate per device still
+        # trains/serves on the same mesh runtime. "auto" resolves to 1
+        # on the CPU client, 2 behind an even-count accelerator.
+        # model_parallel=1 is the parity geometry: per-leaf specs flow
+        # but every leaf stays whole — bit-identical to replicated.
+        self.model_parallel = None
 
         # exploration
         self.explore = True
@@ -373,6 +384,37 @@ class AlgorithmConfig:
                     f"{sharding_backend!r}"
                 )
             self.sharding_backend = sharding_backend
+        return self
+
+    def sharding(
+        self,
+        *,
+        sharding_backend: Optional[str] = None,
+        model_parallel=None,
+        **kwargs,
+    ) -> "AlgorithmConfig":
+        """Learner-plane placement (docs/sharding.md).
+        ``sharding_backend``: "mesh" (default) | "pmap" — same knob as
+        :meth:`resources`. ``model_parallel``: "auto" | int M — build
+        the 2-D (data x model) mesh and partition params per the
+        model's rules; see the attribute comment in ``__init__``."""
+        if sharding_backend is not None:
+            if sharding_backend not in ("mesh", "pmap"):
+                raise ValueError(
+                    "sharding_backend must be 'mesh' or 'pmap', got "
+                    f"{sharding_backend!r}"
+                )
+            self.sharding_backend = sharding_backend
+        if model_parallel is not None:
+            if model_parallel != "auto":
+                m = int(model_parallel)
+                if m < 1:
+                    raise ValueError(
+                        "model_parallel must be 'auto' or an int "
+                        f">= 1, got {model_parallel!r}"
+                    )
+                model_parallel = m
+            self.model_parallel = model_parallel
         return self
 
     def offline_data(

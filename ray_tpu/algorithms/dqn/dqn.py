@@ -221,7 +221,12 @@ class DQNJaxPolicy(JaxPolicy):
             )
         self._uses_dqn_model = not any(
             model_cfg.get(k)
-            for k in ("use_lstm", "use_attention", "custom_model")
+            for k in (
+                "use_lstm",
+                "use_attention",
+                "custom_model",
+                "use_transformer",
+            )
         )
         if not self._uses_dqn_model:
             # the fallback treats the model's logits head as Q values —
@@ -230,13 +235,14 @@ class DQNJaxPolicy(JaxPolicy):
                 raise ValueError(
                     "distributional Q (num_atoms > 1) requires the "
                     "built-in DQNModel; it is unavailable with "
-                    "use_lstm/use_attention/custom_model"
+                    "use_lstm/use_attention/use_transformer/"
+                    "custom_model"
                 )
             if config.get("noisy"):
                 raise ValueError(
                     "noisy nets require the built-in DQNModel; "
                     "unavailable with use_lstm/use_attention/"
-                    "custom_model"
+                    "use_transformer/custom_model"
                 )
         if self._uses_dqn_model:
             from ray_tpu.models.catalog import MODEL_DEFAULTS

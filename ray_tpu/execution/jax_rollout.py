@@ -362,10 +362,15 @@ class JaxRolloutEngine:
             def program(params, carry, ro_rngs, coeffs):
                 return body(params, carry, ro_rngs, coeffs)
 
+            # params enter per their spec tree (P() = replicated on
+            # un-partitioned policies; per-leaf model-axis slices for
+            # partitioned ones — the model inserts its own collectives)
+            p_ps = getattr(policy, "param_pspecs", None)
+            p_ps = P() if p_ps is None else p_ps
             sharded = jax.shard_map(
                 program,
                 mesh=self.mesh,
-                in_specs=(P(), P(axis), P(), P()),
+                in_specs=(p_ps, P(axis), P(), P()),
                 out_specs=(
                     P(axis),
                     P(axis),
@@ -373,11 +378,12 @@ class JaxRolloutEngine:
                 ),
             )
             rep = sharding_lib.replicated(self.mesh)
+            p_sh = getattr(policy, "param_shardings", None) or rep
             dat = sharding_lib.batch_sharded(self.mesh)
             met = sharding_lib.batch_sharded(self.mesh, ndim_prefix=2)
             self._rollout_fn = sharding_lib.sharded_jit(
                 sharded,
-                in_specs=(rep, dat, rep, rep),
+                in_specs=(p_sh, dat, rep, rep),
                 out_specs=(dat, dat, met),
                 label=(
                     f"jax_rollout[{type(self.env).__name__}:"

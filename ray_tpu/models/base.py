@@ -30,9 +30,36 @@ ModelOutput = Tuple[jnp.ndarray, jnp.ndarray, Sequence[jnp.ndarray]]
 class RTModel(nn.Module):
     """Marker base class; see module docstring for the contract."""
 
+    # Class-level override installed by :meth:`with_logical_rules`
+    # (None = ask :meth:`partition_rules`).
+    _partition_rules_override = None
+
     def initial_state(self, batch_size: int = 1) -> Sequence[jnp.ndarray]:
         """Initial recurrent state arrays, leading dim = batch_size."""
         return ()
+
+    def partition_rules(self):
+        """Ordered ``(pattern, PartitionSpec)`` rules mapping this
+        model's param-leaf paths onto the mesh's ``"model"`` axis
+        (``sharding.specs.param_pspecs`` grammar). None — the default
+        for every built-in except the transformer torso — keeps params
+        replicated; a 2-D mesh then simply carries a size-M model axis
+        nothing splits on."""
+        if self._partition_rules_override is not None:
+            return tuple(self._partition_rules_override)
+        return None
+
+    @classmethod
+    def with_logical_rules(cls, rules):
+        """Escape hatch: a subclass of this model class with the given
+        partition rules baked in (``model_config["custom_model"] =
+        MyNet.with_logical_rules([...])``) — for models whose default
+        rules (or lack of them) don't fit the deployment."""
+        return type(
+            cls.__name__ + "WithRules",
+            (cls,),
+            {"_partition_rules_override": tuple(rules)},
+        )
 
     @property
     def is_recurrent(self) -> bool:

@@ -57,6 +57,19 @@ MODEL_DEFAULTS: Dict[str, Any] = {
     "custom_model_config": {},
     "custom_action_dist": None,
     "dtype": None,  # None → per-model default (bf16 convs, f32 mlps)
+    # decoder-style transformer torso (models/transformer.py):
+    # tensor-parallel over the mesh's "model" axis when
+    # AlgorithmConfig.sharding(model_parallel=...) builds a 2-D mesh
+    "use_transformer": False,
+    "transformer_num_layers": 2,
+    "transformer_dim": 64,
+    "transformer_num_heads": 4,
+    "transformer_head_dim": None,  # None → dim // num_heads
+    "transformer_ff_dim": None,  # None → 4 * dim
+    "transformer_seq_len": 8,
+    # per-leaf placement override (ordered (pattern, spec) rules —
+    # sharding.specs grammar); None → the model class's own rules
+    "partition_rules": None,
 }
 
 _custom_models: Dict[str, Type[RTModel]] = {}
@@ -130,6 +143,22 @@ class ModelCatalog:
         obs_shape = obs_space.shape
         is_image = len(obs_shape) == 3
 
+        if cfg["use_transformer"]:
+            from ray_tpu.models.transformer import TransformerPolicyNet
+
+            cls = TransformerPolicyNet
+            if cfg.get("partition_rules"):
+                cls = cls.with_logical_rules(cfg["partition_rules"])
+            return cls(
+                num_outputs=num_outputs,
+                d_model=cfg["transformer_dim"],
+                num_layers=cfg["transformer_num_layers"],
+                num_heads=cfg["transformer_num_heads"],
+                head_dim=cfg["transformer_head_dim"],
+                ff_dim=cfg["transformer_ff_dim"],
+                seq_len=cfg["transformer_seq_len"],
+                dtype_=cfg["dtype"] or "float32",
+            )
         if cfg["use_lstm"]:
             return LSTMWrapper(
                 num_outputs=num_outputs,

@@ -71,6 +71,15 @@ class JaxPolicy(Policy):
     # constructors overwrite it from config via resolve_mesh).
     sharding_backend: str = "mesh"
 
+    # Per-leaf param placement (docs/sharding.md "2-D mesh & param
+    # partitioning"). Class defaults = the replicated legacy contract,
+    # so bespoke-net policies that bypass __init__ (SAC/DDPG families)
+    # keep replicated trees; __init__ installs per-leaf trees when the
+    # mesh carries a "model" axis and the model declares rules.
+    _param_pspecs = None
+    _opt_pspecs = None
+    _opt_sharding = None
+
     @property
     def last_learn_timers(self) -> Dict[str, float]:
         """Per-stage timers of the most recent learn call (device
@@ -131,6 +140,12 @@ class JaxPolicy(Policy):
             )
         else:
             self.params = self.model.init(init_rng, dummy_obs)
+        # per-leaf partitioned placement: when the mesh carries a
+        # "model" axis and the model declares partition rules, params
+        # become first-class sharded trees (attention/MLP kernels
+        # split megatron-style, the rest replicated); otherwise the
+        # replicated default above stands
+        self._install_param_placement()
         self.params = _tree_to_device(self.params, self._param_sharding)
 
         grad_clip = config.get("grad_clip")
@@ -139,9 +154,19 @@ class JaxPolicy(Policy):
             chain.append(optax.clip_by_global_norm(grad_clip))
         chain.append(optax.scale_by_adam(eps=config.get("adam_epsilon", 1e-8)))
         self._tx = optax.chain(*chain)
-        self.opt_state = _tree_to_device(
-            self._tx.init(self.params), self._param_sharding
-        )
+        opt0 = self._tx.init(self.params)
+        if self._param_pspecs is not None:
+            # optimizer moments inherit each param's placement
+            # (suffix-matched by path+shape); counts/scalars replicate
+            self._opt_pspecs = sharding_lib.state_pspecs(
+                opt0, self.params, self._param_pspecs
+            )
+            self._opt_sharding = sharding_lib.named_tree(
+                self.mesh, self._opt_pspecs
+            )
+        else:
+            self._opt_sharding = self._param_sharding
+        self.opt_state = _tree_to_device(opt0, self._opt_sharding)
 
         # ---- schedules / coefficients ----
         from ray_tpu.utils.schedules import make_schedule
@@ -172,8 +197,10 @@ class JaxPolicy(Policy):
         self._action_fn = None
         self._value_fn = None
         self.num_grad_updates = 0
-        # Replicated non-gradient state (target networks etc).
+        # Non-gradient state (target networks etc) — placement follows
+        # the params it mirrors (suffix-matched) when partitioned.
         self.aux_state: Dict[str, Any] = self._init_aux_state()
+        self._publish_params_bytes()
 
         # ---- exploration ----
         self._init_exploration()
@@ -295,6 +322,135 @@ class JaxPolicy(Policy):
         static under jit. The default ignores both."""
         return self.model.apply(params, obs)
 
+    # -- param placement (2-D data x model meshes) -----------------------
+
+    def _model_partition_rules(self):
+        """Ordered placement rules for this policy's params:
+        ``model_config["partition_rules"]`` wins, then the model
+        class's escape hatch / own rules (``with_logical_rules`` /
+        ``partition_rules()``). None = replicate everything."""
+        mc = getattr(self, "model_config", None) or {}
+        if mc.get("partition_rules"):
+            return tuple(mc["partition_rules"])
+        model = getattr(self, "model", None)
+        if model is None:
+            return None
+        ov = getattr(model, "_partition_rules_override", None)
+        if ov is not None:
+            return tuple(ov)
+        fn = getattr(model, "partition_rules", None)
+        if callable(fn):
+            try:
+                rules = fn()
+            except TypeError:  # pragma: no cover - odd signatures
+                rules = None
+            if rules:
+                return tuple(rules)
+        return None
+
+    def _install_param_placement(self) -> None:
+        """Derive per-leaf param specs from the model's rules when the
+        mesh has a model axis (docs/sharding.md). Runs on the HOST
+        param tree right after model.init, before device placement."""
+        if self.sharding_backend != "mesh":
+            return
+        if sharding_lib.model_axis(self.mesh) is None:
+            return
+        rules = self._model_partition_rules()
+        if not rules:
+            return
+        self._param_pspecs = sharding_lib.param_pspecs(
+            self.params, self.mesh, rules
+        )
+        self._param_sharding = sharding_lib.named_tree(
+            self.mesh, self._param_pspecs
+        )
+
+    @property
+    def param_shardings(self):
+        """Per-leaf NamedSharding tree of the params (a single
+        replicated NamedSharding on un-partitioned policies) — the
+        placement serve/rollout/checkpoint call sites must use instead
+        of assuming replication."""
+        return self._param_sharding
+
+    @property
+    def param_pspecs(self):
+        """PartitionSpec tree of the params; None = replicated."""
+        return self._param_pspecs
+
+    @property
+    def is_model_sharded(self) -> bool:
+        """Whether params are actually split across a model axis of
+        size > 1 (a size-1 axis keeps every leaf whole — the parity
+        geometry)."""
+        return (
+            self._param_pspecs is not None
+            and sharding_lib.model_shards(self.mesh) > 1
+        )
+
+    def _params_match_active_rules(self) -> bool:
+        """Do the live param arrays sit where the active rules say
+        (same mesh, per-leaf placement)? False e.g. after a raw
+        device_put replaced the tree — the serve plane gates its fused
+        forward on this."""
+        if self._param_pspecs is None:
+            return True
+        try:
+            arrs = jax.tree_util.tree_leaves(self.params)
+            wants = jax.tree_util.tree_leaves(
+                self._param_sharding,
+                is_leaf=lambda x: isinstance(x, NamedSharding),
+            )
+            if len(arrs) != len(wants):
+                return False
+            for arr, want in zip(arrs, wants):
+                s = getattr(arr, "sharding", None)
+                if s is None or not s.is_equivalent_to(want, arr.ndim):
+                    return False
+            return True
+        except Exception:
+            return False
+
+    def _carry_pspecs(self, with_frames: bool = False):
+        """(params, opt_state, aux) PartitionSpec trees for learn-
+        program construction — bare ``P()`` everywhere on the
+        replicated path, per-leaf trees when partitioned (aux leaves
+        suffix-match the params they mirror, e.g. target networks)."""
+        if self._param_pspecs is None:
+            return P(), P(), P()
+        p_ps = self._param_pspecs
+        o_ps = (
+            self._opt_pspecs
+            if self._opt_pspecs is not None
+            else sharding_lib.state_pspecs(
+                self.opt_state, self.params, p_ps
+            )
+        )
+        a_ps = sharding_lib.state_pspecs(
+            self.aux_state, self.params, p_ps
+        )
+        if with_frames and isinstance(a_ps, dict):
+            a_ps = {"__frames__": P(), **a_ps}
+        return p_ps, o_ps, a_ps
+
+    def _publish_params_bytes(self) -> None:
+        """``ray_tpu_params_bytes{policy,placement}``: global tree
+        bytes + what one device holds under the active placement."""
+        try:
+            total = sharding_lib.tree_nbytes(self.params)
+            if self._param_pspecs is not None:
+                per_shard = sharding_lib.tree_shard_nbytes(
+                    self.params, self._param_pspecs, self.mesh
+                )
+            else:
+                per_shard = total
+            telemetry_metrics.set_params_bytes(
+                type(self).__name__, total, per_shard
+            )
+        except Exception:  # telemetry must never break the policy
+            pass
+
     # -- inference -------------------------------------------------------
 
     def _action_step_body(
@@ -341,6 +497,15 @@ class JaxPolicy(Policy):
             not self.model.is_recurrent
             and not self.exploration.needs_last_obs
             and self.exploration.initial_state(1) == ()
+            # model-sharded params may fuse only while the serve mesh
+            # is the training mesh with params placed per the active
+            # rules (the fused forward carries the per-leaf shardings);
+            # anything else falls back to per-request compute_actions
+            # through the same queue (docs/serving.md)
+            and (
+                not self.is_model_sharded
+                or self._params_match_active_rules()
+            )
         )
 
     @property
@@ -677,27 +842,40 @@ class JaxPolicy(Policy):
         )
         mesh = self.mesh
         axis = sharding_lib.data_axis(mesh)
+        # per-leaf carry specs: bare P() (replicated) on the legacy
+        # path, the rule-derived trees when partitioned — the body
+        # then sees LOCAL param slices and the model inserts its own
+        # model-axis collectives (models/transformer.py)
+        p_ps, o_ps, a_ps = self._carry_pspecs(with_frames=with_frames)
         sharded = jax.shard_map(
             device_fn,
             mesh=mesh,
-            in_specs=(P(), P(), P(), P(axis), P(), P()),
-            out_specs=(P(), P(), P()),
+            in_specs=(p_ps, o_ps, a_ps, P(axis), P(), P()),
+            out_specs=(p_ps, o_ps, P()),
         )
         # Donate only opt_state: params buffers must stay valid because an
         # async sampler thread may be running compute_actions with them
         # concurrently (IMPALA sync mode shares the policy object).
         label = f"learn[{type(self).__name__}:{batch_size}]"
         if self.sharding_backend == "mesh":
-            # explicit placement: params/opt/aux/rng/coeffs replicated,
-            # batch row-sharded — jit broadcasts one sharding over each
-            # argument's pytree leaves, and the compile layer tracks
-            # retraces (compile-cache stats)
-            rep = self._param_sharding
+            # explicit placement: params/opt/aux per their spec trees
+            # (all-replicated on the legacy path), rng/coeffs
+            # replicated, batch row-sharded — jit broadcasts one
+            # sharding over each argument's pytree leaves, and the
+            # compile layer tracks retraces (compile-cache stats)
+            rep = sharding_lib.replicated(mesh)
+            p_sh = self._param_sharding
+            o_sh = self._opt_sharding or p_sh
+            a_sh = (
+                sharding_lib.named_tree(mesh, a_ps)
+                if self._param_pspecs is not None
+                else rep
+            )
             dat = self._data_sharding
             return sharding_lib.sharded_jit(
                 sharded,
-                in_specs=(rep, rep, rep, dat, rep, rep),
-                out_specs=(rep, rep, rep),
+                in_specs=(p_sh, o_sh, a_sh, dat, rep, rep),
+                out_specs=(p_sh, o_sh, rep),
                 donate_argnums=(1,),
                 label=label,
             )
@@ -773,20 +951,28 @@ class JaxPolicy(Policy):
         family (SAC/DDPG/CQL/CRR) shares."""
         mesh = self.mesh
         axis = sharding_lib.data_axis(mesh)
+        p_ps, o_ps, a_ps = self._carry_pspecs()
         sharded = jax.shard_map(
             update_fn,
             mesh=mesh,
-            in_specs=(P(), P(), P(), P(axis), P(), P()),
-            out_specs=(P(), P(), P(), P()),
+            in_specs=(p_ps, o_ps, a_ps, P(axis), P(), P()),
+            out_specs=(p_ps, o_ps, a_ps, P()),
         )
         label = f"learn[{type(self).__name__}:{batch_size}]"
         if self.sharding_backend == "mesh":
-            rep = self._param_sharding
+            rep = sharding_lib.replicated(mesh)
+            p_sh = self._param_sharding
+            o_sh = self._opt_sharding or p_sh
+            a_sh = (
+                sharding_lib.named_tree(mesh, a_ps)
+                if self._param_pspecs is not None
+                else rep
+            )
             dat = self._data_sharding
             return sharding_lib.sharded_jit(
                 sharded,
-                in_specs=(rep, rep, rep, dat, rep, rep),
-                out_specs=(rep, rep, rep, rep),
+                in_specs=(p_sh, o_sh, a_sh, dat, rep, rep),
+                out_specs=(p_sh, o_sh, a_sh, rep),
                 donate_argnums=(1,),
                 label=label,
             )
@@ -913,6 +1099,13 @@ class JaxPolicy(Policy):
                 ),
                 priority_fn=pri_fn,
                 nan_guard=nan_guard,
+                # per-leaf (params, opt, aux) placement threads
+                # through the scan carry + donation unchanged
+                carry_pspecs=(
+                    self._carry_pspecs()
+                    if self._param_pspecs is not None
+                    else None
+                ),
             )
             if rings is not None:
                 kwargs.update(
@@ -1096,6 +1289,11 @@ class JaxPolicy(Policy):
                 ),
                 rollout_fn=rollout.body,
                 nan_guard=nan_guard,
+                carry_pspecs=(
+                    self._carry_pspecs()
+                    if self._param_pspecs is not None
+                    else None
+                ),
             )
             fns[cache_key] = fn
 
@@ -1926,6 +2124,22 @@ class JaxPolicy(Policy):
             {k: self.params[k] for k in keys if k in self.params}
         )
 
+    def _weights_sharding(self, weights):
+        """Placement for an incoming (possibly partial) host weight
+        tree: the per-leaf tree sliced to the given top-level keys
+        when partitioned, the single replicated sharding otherwise.
+        This is the reshard-on-restore half of the checkpoint
+        contract: gather-on-save stays the format, and a tree saved
+        under any mesh geometry re-places per the ACTIVE rules here."""
+        ps = self._param_sharding
+        if (
+            isinstance(ps, dict)
+            and isinstance(weights, dict)
+            and all(k in ps for k in weights)
+        ):
+            return {k: ps[k] for k in weights}
+        return ps
+
     def set_weights(self, weights) -> None:
         if (
             isinstance(weights, dict)
@@ -1936,11 +2150,16 @@ class JaxPolicy(Policy):
             # existing params instead of dropping the absent subtrees
             merged = dict(self.params)
             merged.update(
-                _tree_to_device(weights, self._param_sharding)
+                _tree_to_device(
+                    weights, self._weights_sharding(weights)
+                )
             )
             self.params = merged
         else:
-            self.params = _tree_to_device(weights, self._param_sharding)
+            self.params = _tree_to_device(
+                weights, self._weights_sharding(weights)
+            )
+        self._publish_params_bytes()
         self.exploration.on_weights_updated(self)
 
     def get_state(self) -> Dict[str, Any]:
@@ -1957,7 +2176,8 @@ class JaxPolicy(Policy):
         self.set_weights(state["weights"])
         if "opt_state" in state:
             self.opt_state = _tree_to_device(
-                state["opt_state"], self._param_sharding
+                state["opt_state"],
+                self._opt_sharding or self._param_sharding,
             )
         self.coeff_values.update(state.get("coeff_values", {}))
         self.global_timestep = state.get("global_timestep", 0)

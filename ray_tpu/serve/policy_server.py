@@ -190,6 +190,13 @@ class BatchedPolicyServer:
         from ray_tpu import sharding as sharding_lib
 
         self._rep = sharding_lib.replicated(policy.mesh)
+        # params enter the fused forward per their live placement tree
+        # (replicated for ordinary policies; per-leaf model-axis
+        # shardings for partitioned ones — the supports_batched_serve
+        # gate already guaranteed the placement matches the rules)
+        self._param_spec = (
+            getattr(policy, "param_shardings", None) or self._rep
+        )
         # the rng carry CONTINUES the policy's own stream: a reference
         # policy built from the same seed makes the same splits
         # sequentially — the parity contract's anchor
@@ -399,7 +406,7 @@ class BatchedPolicyServer:
 
         return sharding_lib.sharded_jit(
             fn,
-            in_specs=(rep, rep, rows, rep, rep),
+            in_specs=(self._param_spec, rep, rows, rep, rep),
             out_specs=(rows, rows, rep),
             donate_argnums=(1,),
             label=(

@@ -32,16 +32,25 @@ from ray_tpu.sharding.mesh import (
     clear_mesh_cache,
     data_axis,
     get_mesh,
+    model_axis,
+    model_shards,
     num_shards,
+    resolve_model_parallel,
     simulated_device_env,
 )
 from ray_tpu.sharding.specs import (
     batch_sharded,
+    default_partition_rules,
     leaf_sharding,
+    named_tree,
+    param_pspecs,
+    param_sharding,
     replicated,
     shard_batch,
     sharding_tree,
+    state_pspecs,
     tree_nbytes,
+    tree_shard_nbytes,
 )
 from ray_tpu.sharding.superstep import (
     build_stack_fn,
@@ -63,6 +72,16 @@ def resolve_mesh(config):
         from ray_tpu.parallel import mesh as _legacy
 
         return _legacy.make_mesh()
+    mp = resolve_model_parallel(config)
+    if mp:
+        devs = list(available_devices())
+        return get_mesh(
+            devices=devs,
+            axis_shapes=[
+                (BATCH_AXIS, len(devs) // mp),
+                (MODEL_AXIS, mp),
+            ],
+        )
     return get_mesh()
 
 
@@ -74,18 +93,27 @@ __all__ = [
     "batch_sharded",
     "build_stack_fn",
     "build_superstep_fn",
+    "default_partition_rules",
     "resolve_superstep",
     "clear_mesh_cache",
     "compile_stats",
     "data_axis",
     "get_mesh",
     "leaf_sharding",
+    "model_axis",
+    "model_shards",
+    "named_tree",
     "num_shards",
+    "param_pspecs",
+    "param_sharding",
     "replicated",
     "resolve_mesh",
+    "resolve_model_parallel",
     "shard_batch",
     "sharded_jit",
     "sharding_tree",
     "simulated_device_env",
+    "state_pspecs",
     "tree_nbytes",
+    "tree_shard_nbytes",
 ]

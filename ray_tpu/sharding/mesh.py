@@ -100,6 +100,62 @@ def num_shards(mesh: Mesh) -> int:
     return int(mesh.shape[data_axis(mesh)])
 
 
+def model_axis(mesh: Mesh) -> Optional[str]:
+    """The tensor-parallel axis name when the mesh carries one
+    (2-D data x model layouts built by ``resolve_mesh`` with
+    ``model_parallel`` set), else None. Presence — even at size 1 —
+    activates per-leaf param placement in the learn programs; size 1
+    keeps every leaf whole (the parity geometry)."""
+    return MODEL_AXIS if MODEL_AXIS in mesh.axis_names else None
+
+
+def model_shards(mesh: Mesh) -> int:
+    """Size of the model axis (1 when the mesh has none)."""
+    if MODEL_AXIS in mesh.axis_names:
+        return int(mesh.shape[MODEL_AXIS])
+    return 1
+
+
+def resolve_model_parallel(config, devices=None, strict: bool = False) -> int:
+    """Resolve ``AlgorithmConfig.model_parallel`` (None | "auto" |
+    int) to the model-axis size M of this run's mesh.
+
+    Returns 0 when unset — the legacy 1-D data mesh, no model axis at
+    all — so existing runs are untouched. Any non-zero M (including
+    an explicit 1) builds the 2-D ``[("batch", D//M), ("model", M)]``
+    mesh and routes params through the per-leaf rule placement.
+    ``"auto"`` resolves to 1 on the CPU client (tensor parallelism
+    buys nothing without an accelerator memory wall) and to 2 behind
+    a real accelerator when the device count is even."""
+    mode = config.get("model_parallel")
+    if mode in (None, False, 0):
+        return 0
+    if devices is None:
+        devices = jax.devices()
+    n = len(list(devices))
+    if mode == "auto":
+        try:
+            if all(d.platform == "cpu" for d in devices):
+                return 1
+        except Exception:
+            return 1
+        return 2 if (n >= 2 and n % 2 == 0) else 1
+    m = int(mode)
+    if m < 1:
+        return 0 if m == 0 else 1
+    if n % m:
+        if strict:
+            raise ValueError(
+                f"model_parallel={m} does not divide the {n} learner "
+                "devices"
+            )
+        # non-strict callers (rollout workers resolving their own
+        # 1-device CPU mesh from the shipped config) degrade to the
+        # 1-D data mesh — inference replicas never split params
+        return 0
+    return m
+
+
 def simulated_device_env(n: int) -> dict:
     """Env-var dict that makes a fresh process expose ``n`` simulated
     CPU devices (must be set before jax initializes its backend; use

@@ -4,12 +4,20 @@ The placement vocabulary of the learner plane, as first-class
 functions instead of per-call-site constructions:
 
   - params / optimizer state / aux (target nets, frame pools):
-    replicated — every shard holds the full tree;
+    replicated by default — every shard holds the full tree — or
+    **per-leaf partitioned** over the mesh's ``"model"`` axis via
+    ordered name-pattern rules (:func:`param_pspecs`, megatron-style
+    defaults in :func:`default_partition_rules`);
   - SampleBatch columns: sharded over the leading (row) dim on the
     mesh's data axis;
   - ragged leading dims (a column whose row count doesn't divide the
     shard count) fall back to replication rather than erroring — the
-    ``get_naive_sharding`` pattern from the retrieved references.
+    ``get_naive_sharding`` pattern from the retrieved references. The
+    fallback is **observable**: it fires a
+    ``jit:fallback_replicated`` trace event and bumps
+    ``ray_tpu_sharding_fallback_replicated_total`` so a mis-sharded
+    hot path shows in the Prometheus scrape instead of just running
+    slow.
 
 Everything derives the axis name from the mesh object, so specs work
 on both the ``("batch",)`` meshes this package builds and the legacy
@@ -18,12 +26,13 @@ on both the ``("batch",)`` meshes this package builds and the legacy
 
 from __future__ import annotations
 
-from typing import Dict, Iterable, Optional
+import re
+from typing import Dict, Iterable, Optional, Sequence, Tuple
 
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ray_tpu.sharding.mesh import data_axis, num_shards
+from ray_tpu.sharding.mesh import MODEL_AXIS, data_axis, num_shards
 
 
 def replicated(mesh: Mesh) -> NamedSharding:
@@ -39,12 +48,34 @@ def batch_sharded(mesh: Mesh, ndim_prefix: int = 1) -> NamedSharding:
     return NamedSharding(mesh, P(*spec))
 
 
+def _note_fallback_replicated(shape) -> None:
+    """A batch leaf that SHOULD row-shard fell back to replication
+    (ragged leading dim on a multi-shard mesh): emit the
+    ``jit:fallback_replicated`` event + counter so the degraded
+    placement is visible in the scrape, not just slow."""
+    try:
+        from ray_tpu.telemetry import metrics as _tm
+
+        _tm.inc_sharding_fallback()
+        from ray_tpu.util import tracing as _tr
+
+        if _tr.is_enabled():
+            _tr.event(
+                "jit:fallback_replicated", shape=str(tuple(shape))
+            )
+    except Exception:  # telemetry must never break placement
+        pass
+
+
 def leaf_sharding(x, mesh: Mesh) -> NamedSharding:
     """Per-array placement: shard rows when the leading dim divides
-    the shard count, otherwise replicate (uneven-dim fallback)."""
+    the shard count, otherwise replicate (uneven-dim fallback —
+    counted, see :func:`_note_fallback_replicated`)."""
     shape = getattr(x, "shape", ())
     if len(shape) >= 1 and shape[0] % num_shards(mesh) == 0 and shape[0] > 0:
         return batch_sharded(mesh)
+    if len(shape) >= 1 and shape[0] > 0 and num_shards(mesh) > 1:
+        _note_fallback_replicated(shape)
     return replicated(mesh)
 
 
@@ -82,6 +113,162 @@ def tree_nbytes(tree) -> int:
             for x in jax.tree_util.tree_leaves(tree)
         )
     )
+
+
+# -- per-leaf partitioned param trees (2-D data x model meshes) --------
+#
+# The rule grammar (docs/sharding.md "2-D mesh & param partitioning"):
+# an ordered sequence of ``(pattern, spec)`` pairs. ``pattern`` is a
+# regex searched against the leaf's "/"-joined key path (e.g.
+# "layer_0/attn/wq"); the FIRST match wins. ``spec`` is a
+# PartitionSpec (or a plain tuple of axis names / None) naming, per
+# array dimension, the mesh axis that splits it. Axes absent from the
+# mesh prune to None, so rules written against "model" degrade to
+# replication on a 1-D data mesh. Anything unmatched replicates.
+
+
+def default_partition_rules() -> Tuple:
+    """Megatron-style defaults for the transformer torso
+    (``models/transformer.py`` naming): attention QKV projections
+    split on the head dim, the output projection on its input (head)
+    dim, MLP up on its output dim, MLP down on its input dim —
+    embeddings, layernorms, heads and biases-of-reduced-outputs
+    replicated. Ordered; first match wins; ``.*`` -> replicate is the
+    implicit tail."""
+    return (
+        (r"attn/w[qkv]$", P(None, MODEL_AXIS, None)),
+        (r"attn/b[qkv]$", P(MODEL_AXIS)),
+        (r"attn/wo$", P(MODEL_AXIS, None, None)),
+        (r"mlp/w_up$", P(None, MODEL_AXIS)),
+        (r"mlp/b_up$", P(MODEL_AXIS)),
+        (r"mlp/w_down$", P(MODEL_AXIS, None)),
+    )
+
+
+def _is_pspec(x) -> bool:
+    return isinstance(x, P)
+
+
+def _path_names(path) -> Tuple[str, ...]:
+    out = []
+    for k in path:
+        if hasattr(k, "key"):
+            out.append(str(k.key))
+        elif hasattr(k, "name"):
+            out.append(str(k.name))
+        elif hasattr(k, "idx"):
+            out.append(str(k.idx))
+        else:  # pragma: no cover - future key kinds
+            out.append(str(k))
+    return tuple(out)
+
+
+def _fit_spec(spec, ndim: int, mesh: Mesh):
+    """Normalize one rule spec against a concrete leaf: tuple -> P,
+    axes the mesh doesn't have -> None, rank mismatches that would
+    drop a named axis -> replicate (never silently mis-place)."""
+    entries = list(spec) if not isinstance(spec, P) else list(spec)
+    entries = [
+        (e if e is None or e in mesh.axis_names else None)
+        for e in entries
+    ]
+    if len(entries) > ndim:
+        if any(e is not None for e in entries[ndim:]):
+            return P()
+        entries = entries[:ndim]
+    while entries and entries[-1] is None:
+        entries.pop()
+    return P(*entries)
+
+
+def param_pspecs(tree, mesh: Mesh, rules: Sequence) -> object:
+    """Per-leaf :class:`PartitionSpec` tree for a param tree, from
+    ordered ``(pattern, spec)`` name rules (first match wins; no
+    match -> replicated). Leaf names are the "/"-joined key paths of
+    the tree."""
+    compiled = [(re.compile(pat), spec) for pat, spec in rules]
+
+    def one(path, x):
+        name = "/".join(_path_names(path))
+        ndim = len(getattr(x, "shape", ()))
+        for pat, spec in compiled:
+            if pat.search(name):
+                return _fit_spec(spec, ndim, mesh)
+        return P()
+
+    return jax.tree_util.tree_map_with_path(one, tree)
+
+
+def named_tree(mesh: Mesh, pspec_tree):
+    """PartitionSpec tree -> NamedSharding tree (same structure) for
+    ``sharded_jit`` in/out specs. A bare ``P()`` maps to
+    :func:`replicated`."""
+    return jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s), pspec_tree, is_leaf=_is_pspec
+    )
+
+
+def param_sharding(tree, mesh: Mesh, rules: Sequence):
+    """Per-leaf :class:`NamedSharding` tree for a param tree — the
+    builder the learn/serve/rollout call sites hand to
+    ``jax.device_put`` and ``sharded_jit`` (tentpole surface of
+    docs/sharding.md)."""
+    return named_tree(mesh, param_pspecs(tree, mesh, rules))
+
+
+def state_pspecs(state, params, params_pspecs) -> object:
+    """Spec tree for a params-derived state tree (optimizer moments,
+    target networks): each state leaf inherits the spec of the param
+    whose key path is a suffix of the leaf's path with the same shape
+    (longest suffix wins); everything else — step counts, scalars —
+    replicates. This is how per-leaf placement flows through
+    ``optax`` states and aux target trees without those containers
+    knowing about rules."""
+    pairs = []
+    pflat, _ = jax.tree_util.tree_flatten_with_path(params)
+    specs_flat = jax.tree_util.tree_leaves(
+        params_pspecs, is_leaf=_is_pspec
+    )
+    for (path, leaf), spec in zip(pflat, specs_flat):
+        pairs.append(
+            (_path_names(path), tuple(getattr(leaf, "shape", ())), spec)
+        )
+
+    def one(path, x):
+        names = _path_names(path)
+        shape = tuple(getattr(x, "shape", ()))
+        best = None
+        for pnames, pshape, spec in pairs:
+            if (
+                len(pnames) <= len(names)
+                and names[len(names) - len(pnames):] == pnames
+                and pshape == shape
+            ):
+                if best is None or len(pnames) > best[0]:
+                    best = (len(pnames), spec)
+        return best[1] if best is not None else P()
+
+    return jax.tree_util.tree_map_with_path(one, state)
+
+
+def tree_shard_nbytes(tree, pspec_tree, mesh: Mesh) -> int:
+    """Per-device bytes of a partitioned tree: each leaf's bytes
+    divided by the product of the mesh-axis sizes its spec names
+    (replicated leaves count full size on every shard) — the number
+    behind ``ray_tpu_params_bytes{placement="per_shard"}``."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    specs = jax.tree_util.tree_leaves(pspec_tree, is_leaf=_is_pspec)
+    total = 0
+    for x, spec in zip(leaves, specs):
+        denom = 1
+        for entry in spec:
+            for ax in (
+                entry if isinstance(entry, (tuple, list)) else (entry,)
+            ):
+                if ax is not None:
+                    denom *= int(mesh.shape[ax])
+        total += int(getattr(x, "nbytes", 0)) // max(1, denom)
+    return int(total)
 
 
 def shard_batch(

@@ -51,7 +51,7 @@ from jax.sharding import PartitionSpec as P
 
 from ray_tpu.sharding.compile import ShardedFunction, sharded_jit
 from ray_tpu.sharding.mesh import data_axis, num_shards
-from ray_tpu.sharding.specs import batch_sharded, replicated
+from ray_tpu.sharding.specs import batch_sharded, named_tree, replicated
 
 # stats-tree key for the in-scan nan_guard skip flag (1.0 = the slot's
 # update was suppressed because its batch contained non-finite floats)
@@ -116,6 +116,7 @@ def build_superstep_fn(
     rollout_fn: Optional[Callable] = None,
     priority_fn: Optional[Callable] = None,
     nan_guard: bool = False,
+    carry_pspecs=None,
 ) -> ShardedFunction:
     """Compile the K-update superstep program around ``update_fn``.
 
@@ -181,6 +182,14 @@ def build_superstep_fn(
     axis = data_axis(mesh)
     replicated_cols = set(replicated_cols)
     with_pri = priority_fn is not None
+    # (params, opt_state, aux) PartitionSpec trees: P() everywhere on
+    # the replicated path; per-leaf trees when the policy's params are
+    # partitioned over the model axis — the scan carry, donation, and
+    # the one compiled executable all preserve them
+    if carry_pspecs is None:
+        p_ps = o_ps = a_ps = P()
+    else:
+        p_ps, o_ps, a_ps = carry_pspecs
 
     if rollout_fn is not None:
         return _build_rollout_superstep(
@@ -191,6 +200,7 @@ def build_superstep_fn(
             axis=axis,
             label=label,
             nan_guard=nan_guard,
+            carry_pspecs=(p_ps, o_ps, a_ps),
         )
 
     def multi_fn(params, opt_state, aux, stacked, active, *rest):
@@ -270,10 +280,12 @@ def build_superstep_fn(
         c: (P() if c in replicated_cols else P(None, axis))
         for c in cols
     }
-    sm_in = (P(), P(), P(), stacked_spec, P(), P()) + (
+    sm_in = (p_ps, o_ps, a_ps, stacked_spec, P(), P()) + (
         (P(), P()) if with_pri else (P(),)
     )
-    sm_out = (P(), P(), P(), P()) + ((P(None, axis),) if with_pri else ())
+    sm_out = (p_ps, o_ps, a_ps, P()) + (
+        (P(None, axis),) if with_pri else ()
+    )
     sharded = jax.shard_map(
         multi_fn, mesh=mesh, in_specs=sm_in, out_specs=sm_out
     )
@@ -320,10 +332,15 @@ def build_superstep_fn(
         feed_spec = {
             c: (rep if c in replicated_cols else dat2) for c in cols
         }
-    in_specs = (rep, rep, rep, feed_spec, rep, rep) + (
+    p_sh = named_tree(mesh, p_ps)
+    o_sh = named_tree(mesh, o_ps)
+    a_sh = named_tree(mesh, a_ps)
+    in_specs = (p_sh, o_sh, a_sh, feed_spec, rep, rep) + (
         (rep, rep) if with_pri else (rep,)
     )
-    out_specs = (rep, rep, rep, rep) + ((dat2,) if with_pri else ())
+    out_specs = (p_sh, o_sh, a_sh, rep) + (
+        (dat2,) if with_pri else ()
+    )
     return sharded_jit(
         program,
         in_specs=in_specs,
@@ -342,6 +359,7 @@ def _build_rollout_superstep(
     axis: str,
     label: str,
     nan_guard: bool,
+    carry_pspecs=(P(), P(), P()),
 ) -> ShardedFunction:
     """The rollout-producing feed of :func:`build_superstep_fn`: slot
     k of the scan rolls out the env carry with the CURRENT params,
@@ -408,14 +426,15 @@ def _build_rollout_superstep(
 
     # carry leaves are per-env rows (leading dim N); metrics leaves
     # end in the env dim (engine contract) so they shard on axis -1
+    p_ps, o_ps, a_ps = carry_pspecs
     sharded = jax.shard_map(
         multi_fn,
         mesh=mesh,
-        in_specs=(P(), P(), P(), P(axis), P(), P(), P(), P()),
+        in_specs=(p_ps, o_ps, a_ps, P(axis), P(), P(), P(), P()),
         out_specs=(
-            P(),
-            P(),
-            P(),
+            p_ps,
+            o_ps,
+            a_ps,
             P(axis),
             P(),
             P(*([None] * 2 + [axis])),
@@ -428,10 +447,13 @@ def _build_rollout_superstep(
     rep = replicated(mesh)
     dat = batch_sharded(mesh)
     met = batch_sharded(mesh, ndim_prefix=3)
+    p_sh = named_tree(mesh, p_ps)
+    o_sh = named_tree(mesh, o_ps)
+    a_sh = named_tree(mesh, a_ps)
     return sharded_jit(
         sharded,
-        in_specs=(rep, rep, rep, dat, rep, rep, rep, rep),
-        out_specs=(rep, rep, rep, dat, rep, met),
+        in_specs=(p_sh, o_sh, a_sh, dat, rep, rep, rep, rep),
+        out_specs=(p_sh, o_sh, a_sh, dat, rep, met),
         donate_argnums=(1,),
         label=label,
     )

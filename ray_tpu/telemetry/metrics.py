@@ -72,6 +72,16 @@ ENV_STEPS_ON_DEVICE_TOTAL = "ray_tpu_env_steps_on_device_total"
 REPLAY_ROWS = "ray_tpu_replay_buffer_rows"
 REPLAY_CAPACITY = "ray_tpu_replay_buffer_capacity"
 REPLAY_BYTES = "ray_tpu_replay_buffer_bytes"
+# param placement (docs/sharding.md "2-D mesh & param partitioning"):
+# policy parameter bytes, global vs per-device — at M-way model
+# parallelism per_shard sits near global/M; and the count of batch
+# leaves whose ragged leading dim forced the replication fallback
+# (specs.leaf_sharding) — a nonzero rate means a hot path ships
+# full-copy columns it meant to row-shard
+PARAMS_BYTES = "ray_tpu_params_bytes"
+SHARDING_FALLBACK_TOTAL = (
+    "ray_tpu_sharding_fallback_replicated_total"
+)
 # inference plane (docs/serving.md): the continuous-batching policy
 # server's queue depth, coalesced forward batch sizes, request count,
 # end-to-end request latency (p50/p99 read off the histogram or the
@@ -269,6 +279,35 @@ def set_replay_occupancy(
         "replay buffer resident storage bytes",
         ("policy", "storage"),
     ).set(float(nbytes), tags)
+
+
+def set_params_bytes(
+    policy: str, global_bytes: int, per_shard_bytes: int
+) -> None:
+    """Parameter memory of one policy, next to the replay/live-buffer
+    gauges: ``global`` = the full tree, ``per_shard`` = what one
+    device actually holds under the active placement (equal when
+    replicated; ~global/M at M-way model parallelism)."""
+    g = gauge(
+        PARAMS_BYTES,
+        "policy parameter bytes by placement",
+        ("policy", "placement"),
+    )
+    g.set(float(global_bytes), {"policy": policy, "placement": "global"})
+    g.set(
+        float(per_shard_bytes),
+        {"policy": policy, "placement": "per_shard"},
+    )
+
+
+def inc_sharding_fallback(n: int = 1) -> None:
+    """Batch leaves replicated by the ragged-leading-dim fallback in
+    ``sharding.specs.leaf_sharding`` (should be 0 on a healthy hot
+    path)."""
+    counter(
+        SHARDING_FALLBACK_TOTAL,
+        "batch leaves replicated by the ragged-leading-dim fallback",
+    ).inc(float(n))
 
 
 def set_serve_queue_depth(deployment: str, depth: int) -> None:
