@@ -230,6 +230,27 @@ class Algorithm(Trainable):
         """Override point (reference algorithm.py:841)."""
         raise NotImplementedError
 
+    def _replay_tree_plane(self) -> str:
+        """Which prioritized-replay tree implementation serves this
+        run's draws: "device" | "host" (one plane), "mixed" (multiple
+        buffers disagree — e.g. a spilled shard), or "none" (no
+        prioritized buffer in play)."""
+        planes = set()
+        for shard in getattr(self, "replay_shards", None) or ():
+            plane = getattr(shard, "tree_plane", None)
+            if plane:
+                planes.add(plane)
+        buf = getattr(self, "local_replay_buffer", None)
+        for b in (getattr(buf, "buffers", None) or {}).values():
+            plane = getattr(b, "tree_plane", None)
+            if plane:
+                planes.add(plane)
+        if not planes:
+            return "none"
+        if len(planes) == 1:
+            return planes.pop()
+        return "mixed"
+
     def step(self) -> Dict:
         """reference algorithm.py:547 (incl. worker-failure handling)."""
         from ray_tpu import telemetry as telemetry_lib
@@ -243,6 +264,7 @@ class Algorithm(Trainable):
             telemetry_lib.metrics.SUPERSTEP_UPDATES_TOTAL
         )
         h2d_before = telemetry_lib.metrics.h2d_bytes_by_path()
+        d2h_before = telemetry_lib.metrics.d2h_bytes_by_path()
         results: Dict[str, Any] = {}
         train_info: Dict[str, Any] = {}
         min_t = config.get("min_time_s_per_iteration")
@@ -360,6 +382,11 @@ class Algorithm(Trainable):
                 p: h2d_after.get(p, 0.0) - h2d_before.get(p, 0.0)
                 for p in set(h2d_after) | set(h2d_before)
             }
+            d2h_after = telemetry_lib.metrics.d2h_bytes_by_path()
+            d2h = {
+                p: d2h_after.get(p, 0.0) - d2h_before.get(p, 0.0)
+                for p in set(d2h_after) | set(d2h_before)
+            }
             learn_delta = (
                 telemetry_lib.metrics.learn_steps_total()
                 - learn_before
@@ -396,6 +423,19 @@ class Algorithm(Trainable):
                         else h2d.get("feeder", 0.0)
                         + h2d.get("learn", 0.0)
                     ),
+                },
+                # prioritized-replay plane (docs/data_plane.md
+                # "device sum tree"): which tree implementation served
+                # this iteration's draws, the sample path's H2D
+                # payload (0 under the device tree — only the
+                # generator's raw uniform stream crosses, reported
+                # apart), and the PER refresh's remaining D2H (the
+                # |td| pull that feeds the host alpha-power)
+                "replay": {
+                    "tree": self._replay_tree_plane(),
+                    "sample_h2d_bytes": h2d.get("replay_sample", 0.0),
+                    "rng_h2d_bytes": h2d.get("replay_rng", 0.0),
+                    "d2h_bytes": d2h.get("replay_priorities", 0.0),
                 },
                 # superstep contract (docs/data_plane.md): how many of
                 # this iteration's learner updates rode a fused

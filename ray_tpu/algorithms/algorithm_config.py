@@ -144,6 +144,23 @@ class AlgorithmConfig:
         # bit-identical either way.
         self.replay_device_resident = "auto"
         self.replay_memory_cap_bytes = None
+        # Device sum tree (docs/data_plane.md "device sum tree"):
+        # prioritized-replay priorities live as f64 mesh arrays and a
+        # sample is ONE fused draw→gather program — zero payload bytes
+        # cross H2D on the sample path, and index draws reproduce the
+        # host sum tree bit-exactly (the generator's raw uniform
+        # stream stays host-fed). Requires device-resident rows.
+        # "auto" engages behind a real accelerator; True forces it
+        # (tests/benches); False keeps the host numpy tree walk.
+        self.replay_device_tree = "auto"
+        # Learn-while-rollout interleave for the off-policy family on
+        # the fused jax rollout lane (env_backend="jax"): dispatch the
+        # round's rollout-fill program asynchronously, run the replay
+        # superstep against the PREVIOUS round's buffer contents while
+        # the fill executes, then insert — acting and fused updates
+        # overlap in one cadence (one-round insert staleness, same
+        # spirit as sample_async's weight lag; docs/data_plane.md).
+        self.learn_while_rollout = False
         # On-device training superstep (docs/data_plane.md): one
         # driver dispatch = K learner updates, uniformly across the
         # learner path (DQN-family chained updates incl. prioritized
@@ -330,12 +347,15 @@ class AlgorithmConfig:
         replay_memory_cap_bytes: Optional[int] = None,
         deferred_stats: Optional[bool] = None,
         superstep=None,
+        replay_device_tree=None,
+        learn_while_rollout: Optional[bool] = None,
         **kwargs,
     ) -> "AlgorithmConfig":
         """``replay_device_resident`` / ``replay_memory_cap_bytes`` /
-        ``deferred_stats`` / ``superstep``: the device-resident
-        data-plane knobs (docs/data_plane.md) — see the attribute
-        comments in ``__init__``."""
+        ``deferred_stats`` / ``superstep`` / ``replay_device_tree`` /
+        ``learn_while_rollout``: the device-resident data-plane knobs
+        (docs/data_plane.md) — see the attribute comments in
+        ``__init__``."""
         if gamma is not None:
             self.gamma = gamma
         if lr is not None:
@@ -358,6 +378,10 @@ class AlgorithmConfig:
             self.deferred_stats = bool(deferred_stats)
         if superstep is not None:
             self.superstep = superstep
+        if replay_device_tree is not None:
+            self.replay_device_tree = replay_device_tree
+        if learn_while_rollout is not None:
+            self.learn_while_rollout = bool(learn_while_rollout)
         for k, v in kwargs.items():
             setattr(self, k, v)
         return self

@@ -2,15 +2,28 @@
 
 Counterpart of the reference's ``rllib/algorithms/apex_dqn/apex_dqn.py``
 (Horgan et al. 2018): many rollout workers with a per-worker epsilon
-ladder feed sharded replay-buffer ACTORS; the learner continuously draws
+ladder feed sharded replay buffers; the learner continuously draws
 prioritized samples from the shards, trains, and pushes per-sample
 priority updates back; weights broadcast to workers periodically.
 
-TPU-first shape: the learner is the driver's jitted DQN TD-step (one
-XLA program per draw); replay shards are plain actors on the CPU fleet;
-sampling, replay insertion, learning, and priority updates all overlap
-through in-flight futures (the reference overlaps via its learner
-thread + @ray.remote replay actors the same way)."""
+Two replay-shard planes (docs/data_plane.md "device sum tree &
+sharded Ape-X"):
+
+- **object plane** (the reference's shape): shards are
+  ``ReplayActor``s on the CPU fleet; every insert/sample/priority
+  round-trip crosses the object store, and every sampled batch
+  re-crosses H2D at learn time. Sampling, insertion, learning, and
+  priority updates overlap through in-flight futures.
+- **mesh plane** (``replay_device_resident`` resolves on): shards are
+  :class:`DevicePrioritizedReplayBuffer` rings placed on the learner
+  mesh. A fragment crosses H2D exactly once — the insert upload also
+  feeds the initial-priority TD program (the shared
+  ``_td_error_device_fn`` via ``compute_td_error``, not a second
+  transfer) — and the learn loop is distributed-insert →
+  in-program gather → ``learn_superstep`` per shard, with the PER
+  refresh landing back in each shard's (optionally device-resident)
+  sum tree. No object plane, no host copy between sample and update.
+"""
 
 from __future__ import annotations
 
@@ -27,7 +40,13 @@ from ray_tpu.algorithms.dqn.dqn import (
     adjust_nstep,
 )
 from ray_tpu.data.sample_batch import DEFAULT_POLICY_ID, SampleBatch
-from ray_tpu.execution.replay_buffer import PrioritizedReplayBuffer
+from ray_tpu.execution.replay_buffer import (
+    DevicePrioritizedReplayBuffer,
+    DeviceTrainBatch,
+    PrioritizedReplayBuffer,
+    resolve_device_resident,
+    resolve_device_tree,
+)
 from ray_tpu.execution.train_ops import NUM_ENV_STEPS_TRAINED
 
 
@@ -123,15 +142,40 @@ class ApexDQN(DQN):
             1, int(rb.get("capacity", 100000)) // n_shards
         )
         seed = config.get("seed")
-        self.replay_actors = [
-            ReplayActor.remote(
-                per_shard,
-                rb.get("prioritized_replay_alpha", 0.6),
-                rb.get("prioritized_replay_beta", 0.4),
-                None if seed is None else seed + 100 + i,
-            )
-            for i in range(n_shards)
-        ]
+        self._replay_beta = rb.get("prioritized_replay_beta", 0.4)
+        mesh = config.get("_mesh")
+        # mesh plane: shards become device rings on the learner mesh —
+        # same per-shard seeds and round-robin routing as the actor
+        # plane, so the per-shard generator streams are identical
+        self._apex_device = resolve_device_resident(config, mesh)
+        self.replay_shards: List = []
+        self.replay_actors: List = []
+        if self._apex_device:
+            device_tree = resolve_device_tree(config, mesh)
+            self.replay_shards = [
+                DevicePrioritizedReplayBuffer(
+                    per_shard,
+                    rb.get("prioritized_replay_alpha", 0.6),
+                    None if seed is None else seed + 100 + i,
+                    mesh=mesh,
+                    memory_cap_bytes=config.get(
+                        "replay_memory_cap_bytes"
+                    ),
+                    label=f"apex_shard_{i}",
+                    device_tree=device_tree,
+                )
+                for i in range(n_shards)
+            ]
+        else:
+            self.replay_actors = [
+                ReplayActor.remote(
+                    per_shard,
+                    rb.get("prioritized_replay_alpha", 0.6),
+                    self._replay_beta,
+                    None if seed is None else seed + 100 + i,
+                )
+                for i in range(n_shards)
+            ]
         self._sample_in_flight: Dict = {}  # ref -> worker
         self._replay_in_flight: Dict = {}  # ref -> replay actor
         self._shard_rr = 0
@@ -142,9 +186,15 @@ class ApexDQN(DQN):
         """n-step fold, optional initial priorities, round-robin shard
         insert. By default new samples insert at max priority (standard
         prioritized-replay behavior); worker_side_prioritization=True
-        computes real initial TD errors on the driver's learner policy —
-        an extra jitted forward per fragment on the learning critical
-        path, so it is opt-in."""
+        computes real initial TD errors through the policy's SHARED
+        per-sample TD program (``_td_error_device_fn``, the same body
+        the loss and the PER refresh run) — an extra jitted forward
+        per fragment on the learning critical path, so it is opt-in.
+
+        Mesh plane: the fragment's train columns cross H2D exactly
+        once — the SAME uploaded tree feeds the initial-TD program
+        and the donated insert scatter — then enter the round-robin
+        shard ring with the computed (or max) priorities."""
         config = self.config
         from ray_tpu.ops.framestack import (
             FRAMES as _FRAMES,
@@ -160,6 +210,10 @@ class ApexDQN(DQN):
         n_step = config.get("n_step", 1)
         if n_step > 1:
             adjust_nstep(n_step, config["gamma"], batch)
+
+        if self._apex_device:
+            self._route_to_device_shard(batch)
+            return
         prios = None
         if config.get("worker_side_prioritization"):
             try:
@@ -173,6 +227,47 @@ class ApexDQN(DQN):
         ]
         self._shard_rr += 1
         shard.add.remote(batch, prios)
+
+    def _route_to_device_shard(self, batch: SampleBatch) -> None:
+        """One H2D crossing per fragment: upload the policy's replay
+        columns, (optionally) run the shared TD program on that SAME
+        device tree for initial priorities, and hand the resident rows
+        to the round-robin shard's donated insert scatter."""
+        import jax
+
+        from ray_tpu import sharding as sharding_lib
+        from ray_tpu.telemetry import metrics as telemetry_metrics
+
+        policy = self.get_policy()
+        shard = self.replay_shards[
+            self._shard_rr % len(self.replay_shards)
+        ]
+        self._shard_rr += 1
+        if shard.spilled:
+            # spilled shards keep the host protocol (placement
+            # changed, sampling didn't)
+            prios = None
+            if self.config.get("worker_side_prioritization"):
+                prios = policy.compute_td_error(batch) + 1e-6
+            shard.add_tree(policy.replay_columns(batch), prios)
+            return
+        cols = policy.replay_columns(batch)
+        telemetry_metrics.add_h2d_bytes(
+            "replay_insert", sharding_lib.tree_nbytes(cols)
+        )
+        dev_tree = jax.device_put(cols, policy.batch_shardings(cols))
+        prios = None
+        if self.config.get("worker_side_prioritization"):
+            # the SHARED per-sample TD body (compute_td_error jits
+            # policy._td_error_device_fn) on the already-uploaded
+            # rows — bit-identical to the host-batch route, zero
+            # extra transfer (regression-pinned in tests)
+            n = int(next(iter(dev_tree.values())).shape[0])
+            prios = (
+                policy.compute_td_error(DeviceTrainBatch(dev_tree, n))
+                + 1e-6
+            )
+        shard.add_device_tree(dev_tree, priorities=prios)
 
     def training_step(self) -> Dict:
         """reference apex_dqn.py training_step: overlap sampling,
@@ -250,6 +345,13 @@ class ApexDQN(DQN):
         if (
             self._counters[NUM_ENV_STEPS_SAMPLED]
             >= config.get("num_steps_sampled_before_learning_starts", 0)
+        ) and self._apex_device:
+            info = self._learn_from_device_shards(policy)
+            if info:
+                train_info = info
+        elif (
+            self._counters[NUM_ENV_STEPS_SAMPLED]
+            >= config.get("num_steps_sampled_before_learning_starts", 0)
         ):
             # top up replay sample requests (one per shard in flight)
             shards_busy = set(
@@ -302,6 +404,75 @@ class ApexDQN(DQN):
                     "timestep": self._counters[NUM_ENV_STEPS_SAMPLED]
                 }
             )
+        return train_info
+
+    def _maybe_update_target(self, policy) -> None:
+        if (
+            self._counters[NUM_ENV_STEPS_TRAINED]
+            - self._last_target_update
+            >= self.config.get("target_network_update_freq", 2500)
+        ):
+            policy.update_target()
+            self._last_target_update = self._counters[
+                NUM_ENV_STEPS_TRAINED
+            ]
+            self._counters["num_target_updates"] += 1
+
+    def _learn_from_device_shards(self, policy) -> Dict:
+        """The mesh plane's learn round: every full-enough shard gets
+        one learn pass — a fused superstep of K prioritized updates
+        when the contract resolves on (in-program gather from the
+        shard's rings, in-scan PER refresh back into the shard's
+        tree), a single sample → learn → refresh otherwise. No host
+        copy between sample and update either way."""
+        from ray_tpu.execution.train_ops import superstep_train_replay
+
+        config = self.config
+        bs = int(config["train_batch_size"])
+        K = self._resolve_superstep_k()
+        train_info: Dict = {}
+        for shard in self.replay_shards:
+            if len(shard) < bs:
+                continue
+            fused = (
+                K > 1
+                and getattr(policy, "supports_superstep", False)
+                and bs % max(1, getattr(policy, "n_shards", 1)) == 0
+                and not shard.spilled
+            )
+            if fused:
+                info = superstep_train_replay(
+                    self,
+                    policy,
+                    shard,
+                    K,
+                    K,
+                    bs,
+                    prioritized=True,
+                    beta=self._replay_beta,
+                )
+                if info is None:
+                    # frame-pool/ragged batches can't ride the scan
+                    self._superstep_k = 1
+                    fused = False
+                else:
+                    train_info[DEFAULT_POLICY_ID] = info
+                    self._counters[NUM_ENV_STEPS_TRAINED] += K * bs
+            if not fused:
+                batch = shard.sample(bs, beta=self._replay_beta)
+                if getattr(batch, "is_device_resident", False):
+                    info = policy.learn_on_device_batch(
+                        dict(batch.tree), batch.count
+                    )
+                    idx = batch.indices
+                else:  # spilled shard: host SampleBatch
+                    info = policy.learn_on_batch(batch)
+                    idx = np.asarray(batch["batch_indexes"])
+                train_info[DEFAULT_POLICY_ID] = info
+                self._counters[NUM_ENV_STEPS_TRAINED] += batch.count
+                td = policy.compute_td_error(batch)
+                shard.update_priorities(idx, td + 1e-6)
+            self._maybe_update_target(policy)
         return train_info
 
     def cleanup(self) -> None:

@@ -29,6 +29,7 @@ from ray_tpu.execution.replay_buffer import (
     MultiAgentReplayBuffer,
     PrioritizedReplayBuffer,
     resolve_device_resident,
+    resolve_device_tree,
 )
 from ray_tpu.execution.rollout_ops import synchronous_parallel_sample
 from ray_tpu.execution.train_ops import (
@@ -522,6 +523,9 @@ class DQN(Algorithm):
             device_resident=resolve_device_resident(
                 config, config.get("_mesh")
             ),
+            device_tree=resolve_device_tree(
+                config, config.get("_mesh")
+            ),
             mesh=config.get("_mesh"),
             memory_cap_bytes=config.get("replay_memory_cap_bytes"),
             # columns convert to the policy's train tree ONCE, at
@@ -798,14 +802,9 @@ class DQN(Algorithm):
         if buf is not None and "replay_buffer" in state:
             buf.set_state(state["replay_buffer"])
 
-    def _jax_rollout_fill(self) -> int:
-        """Device rollout lane for the off-policy family
-        (config.env_backend == "jax", docs/pipeline.md): one dispatched
-        rollout produces transition rows ON the learner mesh, and a
-        device-resident replay buffer absorbs them via
-        ``add_device_tree`` — rollout rows never touch the host (a
-        host-ring buffer pulls them back once, which still deletes the
-        actor lane's sampling cost). Returns env steps taken."""
+    def _jax_rollout_engine_get(self):
+        """Build (once) and return the fused-rollout engine
+        (config.env_backend == "jax", docs/pipeline.md)."""
         eng = self.__dict__.get("_jax_rollout_engine")
         if eng is None:
             from ray_tpu.execution.jax_rollout import (
@@ -844,7 +843,12 @@ class DQN(Algorithm):
             )
             self._jax_rollout_engine = eng
             self._extra_metric_sources = [eng.get_metrics]
-        tree, count = eng.rollout()
+        return eng
+
+    def _insert_rollout_tree(self, tree) -> None:
+        """Absorb one dispatched rollout's device rows: the
+        device-insert path for resident buffers (same donated scatter,
+        zero H2D), one pull-back for host rings."""
         buf = self.local_replay_buffer._buffer(DEFAULT_POLICY_ID)
         if isinstance(buf, DeviceReplayBuffer):
             buf.add_device_tree(tree)
@@ -854,16 +858,138 @@ class DQN(Algorithm):
             self.local_replay_buffer.add(
                 SampleBatch(jax.device_get(tree))
             )
+
+    def _jax_rollout_fill(self) -> int:
+        """Device rollout lane for the off-policy family
+        (config.env_backend == "jax", docs/pipeline.md): one dispatched
+        rollout produces transition rows ON the learner mesh, and a
+        device-resident replay buffer absorbs them via
+        ``add_device_tree`` — rollout rows never touch the host (a
+        host-ring buffer pulls them back once, which still deletes the
+        actor lane's sampling cost). Returns env steps taken."""
+        tree, count = self._jax_rollout_engine_get().rollout()
+        self._insert_rollout_tree(tree)
         return count
 
+    def _interleave_ready(self) -> bool:
+        """The learn-while-rollout cadence (``learn_while_rollout``,
+        docs/data_plane.md) engages once the lane is warm: engine
+        built, learning started, and the buffer already holds a full
+        batch of PREVIOUS rounds' rows for the updates to draw from —
+        until then the serial fill→learn order runs."""
+        config = self.config
+        if not config.get("learn_while_rollout"):
+            return False
+        if self.__dict__.get("_jax_rollout_engine") is None:
+            return False
+        buf = self.local_replay_buffer.buffers.get(DEFAULT_POLICY_ID)
+        if buf is None or len(buf) < int(config["train_batch_size"]):
+            return False
+        return self._counters[NUM_ENV_STEPS_SAMPLED] >= config.get(
+            "num_steps_sampled_before_learning_starts", 0
+        )
+
+    def _replay_update_phase(self, sampled_steps: int) -> Dict:
+        """The learn half of the shared off-policy training_step:
+        training-intensity debt → chained/fused replay updates (or the
+        single classic round), then the target-network sync.
+        ``sampled_steps`` is this round's env-step count (the debt
+        accrual basis)."""
+        config = self.config
+        train_info: Dict = {}
+        if not (
+            self._counters[NUM_ENV_STEPS_SAMPLED]
+            >= config.get("num_steps_sampled_before_learning_starts", 0)
+            and len(self.local_replay_buffer) > 0
+        ):
+            return train_info
+        rb_cfg = config.get("replay_buffer_config") or {}
+        prioritized = rb_cfg.get("prioritized_replay", False)
+        kwargs = (
+            {"beta": rb_cfg.get("prioritized_replay_beta", 0.4)}
+            if prioritized
+            else {}
+        )
+        # training_intensity (reference dqn.py calculate_rr_weights
+        # role): desired trained-steps : sampled-steps ratio. The
+        # natural ratio of one update per round is
+        # train_batch/rollout; a higher intensity runs MULTIPLE
+        # replay updates per round — fused K-per-dispatch under
+        # the superstep contract, per-update with deferred stats
+        # otherwise, so either way consecutive SGD programs
+        # pipeline on-device and the per-dispatch latency
+        # (dominant on a tunneled TPU) amortizes. PER joins the
+        # chain only under a superstep (its stacked priority
+        # refresh keeps the update-order tree writes); without
+        # one, priorities must refresh between samples, so PER
+        # keeps the one-update path.
+        updates = 1
+        ti = config.get("training_intensity")
+        if ti and (
+            not prioritized or self._resolve_superstep_k() > 1
+        ):
+            self._training_debt = (
+                getattr(self, "_training_debt", 0.0)
+                + sampled_steps * float(ti)
+            )
+            updates = int(
+                self._training_debt // config["train_batch_size"]
+            )
+            self._training_debt -= (
+                updates * config["train_batch_size"]
+            )
+        if updates > 1:
+            train_info = self._chained_updates(
+                updates,
+                prioritized=prioritized,
+                beta=kwargs.get("beta", 0.4),
+            )
+        elif updates == 1:
+            train_info = self._single_update(prioritized, kwargs)
+        # updates == 0: debt still accruing — sample-only round
+        # target network sync
+        if (
+            self._counters[NUM_ENV_STEPS_TRAINED]
+            - self._last_target_update
+            >= config.get("target_network_update_freq", 500)
+        ):
+            for pid in self.workers.local_worker().policy_map:
+                self.get_policy(pid).update_target()
+            self._last_target_update = self._counters[
+                NUM_ENV_STEPS_TRAINED
+            ]
+            self._counters["num_target_updates"] += 1
+        return train_info
+
     def training_step(self) -> Dict:
-        """reference dqn.py:336 (shared off-policy training_step)."""
+        """reference dqn.py:336 (shared off-policy training_step).
+
+        With ``learn_while_rollout`` on the jax lane
+        (docs/data_plane.md): the round's rollout-fill program is
+        DISPATCHED (async), the replay superstep runs against the
+        previous rounds' buffer contents while the fill executes on
+        the mesh, and the fill's rows insert afterwards — acting and
+        fused updates overlap in one cadence, at a one-round insert
+        staleness (the draws simply cannot see rows that are still
+        being produced)."""
         config = self.config
         batch = None
+        interleaved = False
+        jax_sampled = 0
+        train_info: Dict = {}
         if config.get("env_backend") == "jax":
-            self._counters[NUM_ENV_STEPS_SAMPLED] += (
-                self._jax_rollout_fill()
-            )
+            if self._interleave_ready():
+                tree, count = self._jax_rollout_engine_get().rollout()
+                self._counters[NUM_ENV_STEPS_SAMPLED] += count
+                # jax dispatch is asynchronous: the fill program is
+                # queued, not finished — the superstep below neither
+                # waits on it nor depends on its rows
+                train_info = self._replay_update_phase(count)
+                self._insert_rollout_tree(tree)
+                interleaved = True
+            else:
+                jax_sampled = self._jax_rollout_fill()
+                self._counters[NUM_ENV_STEPS_SAMPLED] += jax_sampled
         elif config.get("sample_async") and self.workers.remote_workers():
             # Overlap rollout with learning (reference's sample_async /
             # Ape-X decoupling): collect the fragment requested LAST
@@ -911,68 +1037,11 @@ class DQN(Algorithm):
             self._counters[NUM_ENV_STEPS_SAMPLED] += batch.env_steps()
             self.local_replay_buffer.add(batch)
 
-        train_info = {}
-        if (
-            self._counters[NUM_ENV_STEPS_SAMPLED]
-            >= config.get("num_steps_sampled_before_learning_starts", 0)
-            and len(self.local_replay_buffer) > 0
-        ):
-            rb_cfg = config.get("replay_buffer_config") or {}
-            prioritized = rb_cfg.get("prioritized_replay", False)
-            kwargs = (
-                {"beta": rb_cfg.get("prioritized_replay_beta", 0.4)}
-                if prioritized
-                else {}
+        if not interleaved:
+            sampled = (
+                batch.env_steps() if batch is not None else jax_sampled
             )
-            # training_intensity (reference dqn.py calculate_rr_weights
-            # role): desired trained-steps : sampled-steps ratio. The
-            # natural ratio of one update per round is
-            # train_batch/rollout; a higher intensity runs MULTIPLE
-            # replay updates per round — fused K-per-dispatch under
-            # the superstep contract, per-update with deferred stats
-            # otherwise, so either way consecutive SGD programs
-            # pipeline on-device and the per-dispatch latency
-            # (dominant on a tunneled TPU) amortizes. PER joins the
-            # chain only under a superstep (its stacked priority
-            # refresh keeps the update-order tree writes); without
-            # one, priorities must refresh between samples, so PER
-            # keeps the one-update path.
-            updates = 1
-            ti = config.get("training_intensity")
-            if ti and (
-                not prioritized or self._resolve_superstep_k() > 1
-            ):
-                self._training_debt = (
-                    getattr(self, "_training_debt", 0.0)
-                    + batch.env_steps() * float(ti)
-                )
-                updates = int(
-                    self._training_debt // config["train_batch_size"]
-                )
-                self._training_debt -= (
-                    updates * config["train_batch_size"]
-                )
-            if updates > 1:
-                train_info = self._chained_updates(
-                    updates,
-                    prioritized=prioritized,
-                    beta=kwargs.get("beta", 0.4),
-                )
-            elif updates == 1:
-                train_info = self._single_update(prioritized, kwargs)
-            # updates == 0: debt still accruing — sample-only round
-            # target network sync
-            if (
-                self._counters[NUM_ENV_STEPS_TRAINED]
-                - self._last_target_update
-                >= config.get("target_network_update_freq", 500)
-            ):
-                for pid in self.workers.local_worker().policy_map:
-                    self.get_policy(pid).update_target()
-                self._last_target_update = self._counters[
-                    NUM_ENV_STEPS_TRAINED
-                ]
-                self._counters["num_target_updates"] += 1
+            train_info = self._replay_update_phase(sampled)
 
         self.workers.sync_weights(
             global_vars={

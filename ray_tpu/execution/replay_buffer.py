@@ -123,13 +123,30 @@ class ReplayBuffer:
             self._cols[k][: self._size] = v
 
 
+def powered_priorities(priorities, alpha: float):
+    """THE canonical priority→leaf transform: clamp to 1e-6, then the
+    alpha-power — in host numpy f64, for BOTH tree planes. The power
+    is the one op in the prioritized path that numpy and XLA round
+    differently (last-ulp), so it stays host-side and the device tree
+    receives already-powered leaves; everything downstream (sums,
+    prefix descent, min, gathers) is exact f64 arithmetic on either
+    plane. Returns ``(powered, clamped)`` — the clamped values feed
+    the max-priority watermark exactly as the host tree's update
+    does."""
+    clamped = np.maximum(np.asarray(priorities, np.float64), 1e-6)
+    return clamped**alpha, clamped
+
+
 class _PrioritySampling:
     """Host-side proportional-priority machinery shared by the host
     and device prioritized buffers: numpy sum/min segment trees, the
     stratified index draw, IS-weight computation, and priority
     updates. One implementation on purpose — the device buffer keeps
     bit-identical sampling to the host ring because it runs exactly
-    this code; only WHERE the rows live differs."""
+    this code; only WHERE the rows live differs. (The device SUM TREE
+    — ``replay_device_tree`` — overrides the tree walks with the
+    bit-exact device programs of ``ops/segment_tree.DeviceSumTree``;
+    this class remains the oracle both planes are asserted against.)"""
 
     def _init_priority_trees(self, capacity: int, alpha: float) -> None:
         assert alpha >= 0
@@ -137,13 +154,17 @@ class _PrioritySampling:
         cap2 = 1
         while cap2 < capacity:
             cap2 *= 2
+        self._tree_capacity = cap2
         self._sum_tree = SumSegmentTree(cap2)
         self._min_tree = MinSegmentTree(cap2)
         self._max_priority = 1.0
+        self._tree_op = "update"  # insert paths flip this transiently
 
     def _draw_prioritized(self, num_items: int, beta: float):
         """→ (row indices, IS weights float32) for one stratified
         proportional draw over the current ``self._size`` rows."""
+        from ray_tpu.telemetry import metrics as telemetry_metrics
+
         total = self._sum_tree.sum(0, self._size)
         mass = (
             self._rng.random(num_items) + np.arange(num_items)
@@ -155,6 +176,7 @@ class _PrioritySampling:
         max_weight = (p_min * self._size) ** (-beta)
         p_sample = self._sum_tree[idx] / total
         weights = (p_sample * self._size) ** (-beta) / max_weight
+        telemetry_metrics.inc_tree_op("sample", "host")
         return idx, weights.astype(np.float32)
 
     def draw_prioritized_sets(self, k: int, num_items: int, beta: float):
@@ -171,12 +193,15 @@ class _PrioritySampling:
     def update_priorities(
         self, idx: np.ndarray, priorities: np.ndarray
     ) -> None:
-        priorities = np.maximum(np.asarray(priorities, np.float64), 1e-6)
-        self._sum_tree.set_items(idx, priorities**self._alpha)
-        self._min_tree.set_items(idx, priorities**self._alpha)
+        from ray_tpu.telemetry import metrics as telemetry_metrics
+
+        powered, clamped = powered_priorities(priorities, self._alpha)
+        self._sum_tree.set_items(idx, powered)
+        self._min_tree.set_items(idx, powered)
         self._max_priority = max(
-            self._max_priority, float(priorities.max())
+            self._max_priority, float(clamped.max())
         )
+        telemetry_metrics.inc_tree_op(self._tree_op, "host")
 
     def _priority_state(self) -> Dict:
         """Raw (already alpha-powered) leaf values of the stored range
@@ -202,6 +227,8 @@ class PrioritizedReplayBuffer(_PrioritySampling, ReplayBuffer):
     """Proportional prioritized replay (reference
     prioritized_replay_buffer.py:19), vectorized over the whole sample
     batch via the numpy segment trees."""
+
+    tree_plane = "host"
 
     def __init__(
         self,
@@ -230,14 +257,25 @@ class PrioritizedReplayBuffer(_PrioritySampling, ReplayBuffer):
             return
         idx = (self._idx + np.arange(n)) % self.capacity
         ReplayBuffer.add(self, batch)
-        self.update_priorities(idx, np.asarray(priorities, np.float64))
+        self._tree_op = "insert"
+        try:
+            self.update_priorities(
+                idx, np.asarray(priorities, np.float64)
+            )
+        finally:
+            self._tree_op = "update"
 
     def sample(self, num_items: int, beta: float = 0.4) -> SampleBatch:
-        idx, weights = self._draw_prioritized(num_items, beta)
-        batch = self._make_batch(idx)
-        batch["weights"] = weights
-        batch["batch_indexes"] = idx.astype(np.int64)
-        return batch
+        from ray_tpu.util import tracing
+
+        with tracing.start_span(
+            "replay:sample", n=num_items, tree="host"
+        ):
+            idx, weights = self._draw_prioritized(num_items, beta)
+            batch = self._make_batch(idx)
+            batch["weights"] = weights
+            batch["batch_indexes"] = idx.astype(np.int64)
+            return batch
 
     def get_state(self) -> Dict:
         state = super().get_state()
@@ -285,6 +323,33 @@ def resolve_device_resident(config: Dict, mesh=None) -> bool:
             except Exception:
                 shards = 1
         if int(config.get("train_batch_size", 0)) % max(1, shards):
+            return False
+    return True
+
+
+def resolve_device_tree(config: Dict, mesh=None) -> bool:
+    """Resolve the ``replay_device_tree`` knob (docs/data_plane.md
+    "device sum tree"). Requires device-resident rows (the tree's
+    whole point is an in-program draw→gather over resident rings).
+    ``"auto"`` (default) engages only behind a real accelerator —
+    on the CPU client the numpy tree walk shares the host RAM the
+    "device" tree would live in, and the extra programs are pure
+    overhead; ``True`` forces it anywhere (tests, benches)."""
+    mode = config.get("replay_device_tree", "auto")
+    if not mode:
+        return False
+    if not resolve_device_resident(config, mesh):
+        return False
+    if mode == "auto":
+        try:
+            import jax
+
+            devices = mesh.devices.flatten() if mesh is not None else (
+                jax.devices()
+            )
+            if all(d.platform == "cpu" for d in devices):
+                return False
+        except Exception:
             return False
     return True
 
@@ -678,8 +743,11 @@ class DeviceReplayBuffer:
     def sample(self, num_items: int):
         if self._host is not None:
             return self._host.sample(num_items)
-        idx = self._rng.integers(0, self._size, num_items)
-        return self.gather(idx)
+        from ray_tpu.util import tracing
+
+        with tracing.start_span("replay:sample", n=num_items):
+            idx = self._rng.integers(0, self._size, num_items)
+            return self.gather(idx)
 
     def _num_shards(self) -> int:
         from ray_tpu import sharding as sharding_lib
@@ -690,6 +758,8 @@ class DeviceReplayBuffer:
         """Rows at caller-chosen ring positions as one jit'd device
         gather (QMIX draws its own indices; ``sample`` feeds the
         host-seeded uniform draw through here)."""
+        from ray_tpu.telemetry import metrics as telemetry_metrics
+
         idx = np.asarray(idx)
         row_sharded = len(idx) % self._num_shards() == 0 and len(idx) > 0
         if self._sample_fn is None:
@@ -699,7 +769,12 @@ class DeviceReplayBuffer:
             fn = self._sample_fn[row_sharded] = self._build_sample_fn(
                 row_sharded
             )
-        tree = fn(self._store, idx.astype(np.int32))
+        idx32 = idx.astype(np.int32)
+        # the index upload is the sample path's entire H2D payload
+        # here (rows are resident); the device-tree draw even deletes
+        # this — its indices never exist host-side
+        telemetry_metrics.add_h2d_bytes("replay_sample", idx32.nbytes)
+        tree = fn(self._store, idx32)
         return DeviceTrainBatch(dict(tree), len(idx), indices=idx)
 
     def draw_index_sets(self, k: int, num_items: int) -> np.ndarray:
@@ -733,6 +808,8 @@ class DeviceReplayBuffer:
         import jax
         import jax.numpy as jnp
 
+        if not isinstance(idx, jax.Array):
+            idx = np.ascontiguousarray(idx, np.int32)
         meta = dict(self._meta)
 
         def gather_fn(store, idx2):
@@ -749,7 +826,7 @@ class DeviceReplayBuffer:
         shardings = {k_: v.sharding for k_, v in self._store.items()}
         return SuperstepRingFeed(
             store=self._store,
-            idx=np.ascontiguousarray(idx, np.int32),
+            idx=idx,
             extra=dict(extra or {}),
             gather_fn=gather_fn,
             shardings=shardings,
@@ -828,12 +905,28 @@ class DeviceReplayBuffer:
 
 
 class DevicePrioritizedReplayBuffer(_PrioritySampling, DeviceReplayBuffer):
-    """Prioritized replay with device-resident rows: the sum/min trees
-    (and every priority update) stay host-side — exactly the host
-    :class:`PrioritizedReplayBuffer` code via ``_PrioritySampling`` —
-    while the drawn rows gather on device. IS weights ride into the
-    batch tree as a device column; ``batch_indexes`` stay host-side on
-    the returned :class:`DeviceTrainBatch` for the priority refresh."""
+    """Prioritized replay with device-resident rows. Two tree planes
+    (docs/data_plane.md "device sum tree"):
+
+    - ``device_tree=False`` (legacy): the sum/min trees (and every
+      priority update) stay host-side — exactly the host
+      :class:`PrioritizedReplayBuffer` code via ``_PrioritySampling``
+      — while the drawn rows gather on device.
+    - ``device_tree=True``: priorities live as f64 mesh arrays
+      (``ops/segment_tree.DeviceSumTree``) and a sample is ONE fused
+      program — prefix-descent draw → clip → IS weights → row gather
+      — whose only host-fed input is the generator's raw uniform
+      stream, so the index draws (and sampled priorities) reproduce
+      the host trees bit-exactly and zero payload bytes cross H2D on
+      the sample path. The alpha-power transform stays host-side
+      (``powered_priorities`` — the one cross-backend-inexact op), so
+      priority refreshes pull |td| D2H, power, and push powered
+      leaves back; the tree WALK never returns to the host.
+
+    IS weights ride into the batch tree as a device column;
+    ``batch_indexes`` ride on the returned :class:`DeviceTrainBatch`
+    (host numpy under the host tree, a device i32 array under the
+    device tree — ``update_priorities`` accepts either)."""
 
     def __init__(
         self,
@@ -843,6 +936,7 @@ class DevicePrioritizedReplayBuffer(_PrioritySampling, DeviceReplayBuffer):
         mesh=None,
         memory_cap_bytes: Optional[int] = None,
         label: str = "default_policy",
+        device_tree: bool = False,
     ):
         super().__init__(
             capacity,
@@ -852,10 +946,40 @@ class DevicePrioritizedReplayBuffer(_PrioritySampling, DeviceReplayBuffer):
             label=label,
         )
         self._init_priority_trees(capacity, alpha)
+        self._dtree = None
+        self._tree_sample_fns: Dict = {}
+        self._tree_draw_fns: Dict = {}
+        if device_tree:
+            from ray_tpu.ops.segment_tree import DeviceSumTree
+
+            self._dtree = DeviceSumTree(
+                self._tree_capacity, mesh=self.mesh, label=label
+            )
+
+    @property
+    def tree_plane(self) -> str:
+        """Which tree implementation serves draws right now (the
+        ``tree`` label of ``info/telemetry/replay``)."""
+        if self._host is not None or self._dtree is None:
+            return "host"
+        return "device"
 
     def _make_host_fallback(self) -> ReplayBuffer:
         buf = PrioritizedReplayBuffer(self.capacity, self._alpha)
         buf._rng = self._rng
+        if self._dtree is not None:
+            # the spill rings own the priorities from here on: pull
+            # the (usually still pristine) device leaves across once
+            buf._set_priority_state(
+                {
+                    "leaf_values": self._dtree.leaf_values(self._size),
+                    "max_priority": self._max_priority,
+                }
+            )
+            self._dtree = None
+            self._tree_sample_fns = {}
+            self._tree_draw_fns = {}
+            return buf
         # spill happens at first insert, before any priority write:
         # handing over the (still pristine) trees keeps one source of
         # truth if callers pre-seeded priorities
@@ -863,6 +987,77 @@ class DevicePrioritizedReplayBuffer(_PrioritySampling, DeviceReplayBuffer):
         buf._min_tree = self._min_tree
         buf._max_priority = self._max_priority
         return buf
+
+    # -- device-tree priority writes ------------------------------------
+
+    def update_priorities(
+        self, idx, priorities: np.ndarray
+    ) -> None:
+        """Host-tree mode: the mixin's numpy tree writes. Device-tree
+        mode: host alpha-power (the oracle transform), then one
+        donated device update program; ``idx`` may be a host array or
+        the device i32 indices a fused sample returned (no D2H)."""
+        if self._dtree is None:
+            return _PrioritySampling.update_priorities(
+                self, idx, priorities
+            )
+        from ray_tpu.telemetry import metrics as telemetry_metrics
+
+        powered, clamped = powered_priorities(priorities, self._alpha)
+        self._dtree.set_powered(idx, powered)
+        self._max_priority = max(
+            self._max_priority, float(clamped.max())
+        )
+        telemetry_metrics.inc_tree_op(self._tree_op, "device")
+
+    def refresh_priorities_stacked(
+        self, idx, abs_td: np.ndarray, active
+    ) -> None:
+        """The superstep's PER refresh against the device tree: the
+        stacked ``(k, B)`` |td| (one D2H — the host alpha-power needs
+        it) powers host-side and lands in ONE stacked device update,
+        applied in update order with the nan-guard's skipped slots
+        masked out — exactly the host path's per-update
+        ``update_priorities(idx[i], td[i] + 1e-6)`` loop."""
+        from ray_tpu.telemetry import metrics as telemetry_metrics
+
+        active = np.asarray(active, bool)
+        if not active.any():
+            return
+        # the epsilon add stays in the |td| dtype (f32): the host call
+        # site computes `pri[i] + 1e-6` under numpy's weak-scalar
+        # promotion BEFORE the f64 cast inside update_priorities —
+        # rounding it the same way here keeps the leaf stream (and the
+        # max-priority watermark) bit-exact across tree planes
+        powered, clamped = powered_priorities(
+            np.asarray(abs_td) + 1e-6, self._alpha
+        )
+        if self._dtree is None:
+            # spilled mid-superstep is impossible (feed construction
+            # requires residency), but route host-tree mode through
+            # the sequential oracle writes for completeness
+            for i in range(len(active)):
+                if active[i]:
+                    _PrioritySampling.update_priorities(
+                        self, np.asarray(idx)[i], abs_td[i] + 1e-6
+                    )
+            return
+        self._dtree.set_powered(idx, powered, active=active)
+        self._max_priority = max(
+            self._max_priority, float(clamped[active].max())
+        )
+        telemetry_metrics.inc_tree_op(
+            "update", "device", int(active.sum())
+        )
+
+    def _insert_priorities(self, idx, priorities) -> None:
+        self._tree_op = "insert"
+        try:
+            self.update_priorities(
+                idx, np.asarray(priorities, np.float64)
+            )
+        finally:
+            self._tree_op = "update"
 
     def add_tree(
         self,
@@ -889,7 +1084,7 @@ class DevicePrioritizedReplayBuffer(_PrioritySampling, DeviceReplayBuffer):
                 idx, np.asarray(priorities, np.float64)
             )
             return
-        self.update_priorities(idx, np.asarray(priorities, np.float64))
+        self._insert_priorities(idx, priorities)
 
     def add_device_tree(
         self,
@@ -898,7 +1093,7 @@ class DevicePrioritizedReplayBuffer(_PrioritySampling, DeviceReplayBuffer):
     ) -> None:
         """Device-resident insert with the host priority protocol:
         new rows enter the sum/min trees at max priority (or the
-        caller's), exactly like :meth:`add_tree` — the host tree
+        caller's), exactly like :meth:`add_tree` — the priority
         stream stays bit-exact whichever side the rows came from."""
         tree = dict(tree)
         if not tree:
@@ -923,26 +1118,168 @@ class DevicePrioritizedReplayBuffer(_PrioritySampling, DeviceReplayBuffer):
                 idx, np.asarray(priorities, np.float64)
             )
             return
-        self.update_priorities(idx, np.asarray(priorities, np.float64))
+        self._insert_priorities(idx, priorities)
+
+    # -- sampling --------------------------------------------------------
+
+    def _build_tree_sample_fn(self, num_items: int, row_sharded: bool):
+        """ONE program: prefix-descent draw → clip → IS weights → row
+        gather (docs/data_plane.md "device sum tree"). Built and
+        called in the f64 scope (the tree inputs); rows/weights leave
+        as the learner's f32/u8 world with the same out-shardings the
+        two-step path emitted."""
+        import jax
+        import jax.numpy as jnp
+
+        from ray_tpu import sharding as sharding_lib
+        from ray_tpu.ops.segment_tree import draw_body
+
+        meta = dict(self._meta)
+        cap = self._dtree.capacity
+
+        def fn(sum_t, min_t, store, rand, size, beta):
+            idx, weights, _ = draw_body(
+                sum_t, min_t, rand, size, beta, cap
+            )
+            idx32 = idx.astype(jnp.int32)
+            out = {}
+            for k, v in store.items():
+                row_shape, dtype, packed = meta[k]
+                g = v[idx32]
+                if packed:
+                    u8 = jax.lax.bitcast_convert_type(g, jnp.uint8)
+                    g = u8.reshape((g.shape[0],) + row_shape)
+                out[k] = g
+            out["weights"] = weights
+            return out, idx32
+
+        row_spec = (
+            sharding_lib.batch_sharded(self.mesh)
+            if row_sharded
+            else sharding_lib.replicated(self.mesh)
+        )
+        rep = sharding_lib.replicated(self.mesh)
+        out_cols = {k: row_spec for k in meta}
+        out_cols["weights"] = row_spec
+        return sharding_lib.sharded_jit(
+            fn,
+            out_specs=(out_cols, rep),
+            label=f"replay_draw_sample[{self.label}:{num_items}]",
+        )
+
+    def _tree_sample(self, num_items: int, beta: float):
+        from ray_tpu import sharding as sharding_lib
+        from ray_tpu.telemetry import metrics as telemetry_metrics
+
+        rand = self._rng.random(num_items)
+        row_sharded = (
+            num_items % self._num_shards() == 0 and num_items > 0
+        )
+        key = (num_items, row_sharded)
+        fn = self._tree_sample_fns.get(key)
+        if fn is None:
+            fn = self._tree_sample_fns[key] = (
+                self._build_tree_sample_fn(num_items, row_sharded)
+            )
+        with sharding_lib.f64_scope():
+            rows, idx = fn(
+                self._dtree.sum_value,
+                self._dtree.min_value,
+                self._store,
+                rand,
+                np.int64(self._size),
+                np.float64(beta),
+            )
+        # the generator's raw uniform stream is the draw's only
+        # host-fed input — counted apart from payload, which is zero
+        telemetry_metrics.add_h2d_bytes("replay_rng", rand.nbytes)
+        telemetry_metrics.inc_tree_op("sample", "device")
+        return DeviceTrainBatch(dict(rows), num_items, indices=idx)
 
     def sample(self, num_items: int, beta: float = 0.4):
         if self._host is not None:
             return self._host.sample(num_items, beta=beta)
-        import jax
+        from ray_tpu.util import tracing
 
+        with tracing.start_span(
+            "replay:sample", n=num_items, tree=self.tree_plane
+        ):
+            if self._dtree is not None:
+                return self._tree_sample(num_items, beta)
+            import jax
+
+            from ray_tpu import sharding as sharding_lib
+            from ray_tpu.telemetry import metrics as telemetry_metrics
+
+            idx, weights = self._draw_prioritized(num_items, beta)
+            batch = self.gather(idx)
+            # same layout as the gathered rows, so the learn program's
+            # committed-input check sees one consistent batch tree
+            spec = (
+                sharding_lib.batch_sharded(self.mesh)
+                if num_items % self._num_shards() == 0
+                else sharding_lib.replicated(self.mesh)
+            )
+            telemetry_metrics.add_h2d_bytes(
+                "replay_sample", weights.nbytes
+            )
+            batch.tree["weights"] = jax.device_put(weights, spec)
+            return batch
+
+    def draw_prioritized_sets_device(
+        self, k: int, k_max: int, num_items: int, beta: float
+    ):
+        """The superstep's pre-drawn schedule against the DEVICE tree:
+        ``k`` sequential host generator calls (the exact per-update
+        stream order), padded host-side to ``k_max`` rows, one draw
+        program → ``(k_max, B)`` device index/weight matrices laid out
+        for the scan feed (indices replicated, weights row-sharded
+        like every stacked extra column). Draws see window-start
+        priorities — the documented within-chain staleness."""
         from ray_tpu import sharding as sharding_lib
+        from ray_tpu.telemetry import metrics as telemetry_metrics
 
-        idx, weights = self._draw_prioritized(num_items, beta)
-        batch = self.gather(idx)
-        # same layout as the gathered rows, so the learn program's
-        # committed-input check sees one consistent batch tree
-        spec = (
-            sharding_lib.batch_sharded(self.mesh)
-            if num_items % self._num_shards() == 0
-            else sharding_lib.replicated(self.mesh)
+        rand = np.zeros((k_max, num_items), np.float64)
+        for i in range(k):
+            rand[i] = self._rng.random(num_items)
+        key = (k_max, num_items)
+        fn = self._tree_draw_fns.get(key)
+        if fn is None:
+            import jax.numpy as jnp
+
+            from ray_tpu.ops.segment_tree import draw_body
+
+            cap = self._dtree.capacity
+
+            def prog(sum_t, min_t, r, size, beta_):
+                idx, weights, _ = draw_body(
+                    sum_t, min_t, r, size, beta_, cap
+                )
+                return idx.astype(jnp.int32), weights
+
+            fn = self._tree_draw_fns[key] = sharding_lib.sharded_jit(
+                prog,
+                out_specs=(
+                    sharding_lib.replicated(self.mesh),
+                    sharding_lib.batch_sharded(
+                        self.mesh, ndim_prefix=2
+                    ),
+                ),
+                label=f"tree_draw_sets[{self.label}:{k_max}x{num_items}]",
+            )
+        with sharding_lib.f64_scope():
+            idx, weights = fn(
+                self._dtree.sum_value,
+                self._dtree.min_value,
+                rand,
+                np.int64(self._size),
+                np.float64(beta),
+            )
+        telemetry_metrics.add_h2d_bytes(
+            "replay_rng", k * num_items * 8
         )
-        batch.tree["weights"] = jax.device_put(weights, spec)
-        return batch
+        telemetry_metrics.inc_tree_op("sample", "device", k)
+        return idx, weights
 
     def get_state(self) -> Dict:
         state = super().get_state()
@@ -954,6 +1291,22 @@ class DevicePrioritizedReplayBuffer(_PrioritySampling, DeviceReplayBuffer):
         super().set_state(state)
         if "priorities" in state and self._host is None:
             self._set_priority_state(state["priorities"])
+
+    def _priority_state(self) -> Dict:
+        if self._dtree is None:
+            return _PrioritySampling._priority_state(self)
+        # same layout as the host trees' state: checkpoints move
+        # freely between tree planes
+        return {
+            "leaf_values": self._dtree.leaf_values(self._size),
+            "max_priority": self._max_priority,
+        }
+
+    def _set_priority_state(self, state: Dict) -> None:
+        if self._dtree is None:
+            return _PrioritySampling._set_priority_state(self, state)
+        self._dtree.set_leaf_values(state["leaf_values"])
+        self._max_priority = float(state.get("max_priority", 1.0))
 
 
 class MultiAgentReplayBuffer:
@@ -976,6 +1329,7 @@ class MultiAgentReplayBuffer:
         mesh=None,
         memory_cap_bytes: Optional[int] = None,
         replay_columns_fn: Optional[Callable] = None,
+        device_tree: bool = False,
     ):
         self.capacity = capacity
         self.prioritized = prioritized
@@ -985,6 +1339,7 @@ class MultiAgentReplayBuffer:
         self.mesh = mesh
         self.memory_cap_bytes = memory_cap_bytes
         self.replay_columns_fn = replay_columns_fn
+        self.device_tree = device_tree
         self.buffers: Dict[str, ReplayBuffer] = {}
 
     def _buffer(self, pid: str) -> ReplayBuffer:
@@ -1002,7 +1357,11 @@ class MultiAgentReplayBuffer:
                 )
                 if self.prioritized:
                     self.buffers[pid] = cls(
-                        self.capacity, self.alpha, self.seed, **kwargs
+                        self.capacity,
+                        self.alpha,
+                        self.seed,
+                        device_tree=self.device_tree,
+                        **kwargs,
                     )
                 else:
                     self.buffers[pid] = cls(

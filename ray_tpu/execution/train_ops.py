@@ -130,14 +130,25 @@ def superstep_train_replay(
         if isinstance(buf, DeviceReplayBuffer) and buf.spilled
         else buf
     )
+    # device sum tree: the draw runs in-program and its (k_max, B)
+    # index/weight matrices never exist host-side
+    device_tree = (
+        device_mode
+        and prioritized
+        and getattr(buf, "_dtree", None) is not None
+    )
     refresh = prioritized and policy._td_error_device_fn() is not None
-    if prioritized:
+    pad = k_max - k
+    if prioritized and device_tree:
+        idx, weights = buf.draw_prioritized_sets_device(
+            k, k_max, batch_size, beta
+        )
+    elif prioritized:
         idx, weights = src.draw_prioritized_sets(k, batch_size, beta)
     else:
         idx = src.draw_index_sets(k, batch_size)
         weights = None
-    pad = k_max - k
-    if pad:
+    if pad and not device_tree:
         idx = np.concatenate(
             [idx, np.zeros((pad, batch_size), idx.dtype)]
         )
@@ -188,7 +199,27 @@ def superstep_train_replay(
             refresh_priorities=refresh,
         )
 
-    if prioritized:
+    if prioritized and device_tree:
+        if pri is not None:
+            # ONE stacked device update, applied in update order with
+            # the skipped slots masked — the host tree walk is gone;
+            # what remains host-side is the alpha-power on the pulled
+            # |td| (docs/data_plane.md "device sum tree")
+            buf.refresh_priorities_stacked(
+                idx[:k], pri, active=[not s for s in skipped]
+            )
+        else:
+            for i in range(k):
+                if skipped[i]:
+                    continue
+                buf.update_priorities(
+                    idx[i],
+                    np.full(
+                        batch_size,
+                        abs(infos[i].get("mean_td_error", 0.0)) + 1e-6,
+                    ),
+                )
+    elif prioritized:
         # apply in update order: overlapping draws must resolve
         # exactly as the per-update path's interleaved writes would
         for i in range(k):

@@ -1154,10 +1154,20 @@ class JaxPolicy(Policy):
 
         if rings is not None:
             feed = (rings.store, rings.idx, rings.extra)
+            # sample-path payload: the pre-drawn index matrix + stacked
+            # extra columns, counted only when they actually cross
+            # H2D — a device-tree draw hands device arrays here and
+            # the sample path ships zero payload bytes
             telemetry_metrics.add_h2d_bytes(
-                "learn",
-                rings.idx.nbytes
-                + sharding_lib.tree_nbytes(rings.extra),
+                "replay_sample",
+                sum(
+                    v.nbytes
+                    for v in (
+                        rings.idx,
+                        *rings.extra.values(),
+                    )
+                    if not isinstance(v, jax.Array)
+                ),
             )
         else:
             feed = stacked
@@ -1201,6 +1211,11 @@ class JaxPolicy(Policy):
             if pri is not None:
                 stats, pri = jax.device_get((stats, pri))
                 pri = np.abs(np.asarray(pri)[:k])
+                # the |td| pull that feeds the host alpha-power — the
+                # PER path's one remaining D2H (docs/data_plane.md)
+                telemetry_metrics.add_d2h_bytes(
+                    "replay_priorities", pri.nbytes
+                )
             else:
                 stats = jax.device_get(stats)
         self.num_grad_updates += k * self._updates_per_learn_call(

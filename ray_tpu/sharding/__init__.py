@@ -23,6 +23,7 @@ on one device.
 from ray_tpu.sharding.compile import (
     ShardedFunction,
     compile_stats,
+    f64_scope,
     sharded_jit,
 )
 from ray_tpu.sharding.mesh import (
@@ -98,6 +99,7 @@ __all__ = [
     "clear_mesh_cache",
     "compile_stats",
     "data_axis",
+    "f64_scope",
     "get_mesh",
     "leaf_sharding",
     "model_axis",

@@ -141,6 +141,22 @@ def sharded_jit(
     )
 
 
+def f64_scope():
+    """The x64 scope the device segment-tree programs build and run
+    in (``ops/segment_tree.DeviceSumTree``). Priorities are float64
+    state — the host sum tree the device tree must reproduce
+    bit-exactly is numpy f64 — but this process keeps jax's default
+    x64-off canonicalization for every learner program. The scope is
+    thread-local and wraps ONLY the tree programs: their f64 arrays
+    stay f64 across calls (a jit traced outside the scope would
+    silently downcast them to f32), while their f32/i32 outputs (IS
+    weights, drawn indices) feed the ordinary f32 learner world
+    outside."""
+    from jax.experimental import enable_x64
+
+    return enable_x64()
+
+
 def compile_stats() -> Dict[str, Any]:
     """Process-wide compile-cache summary across every live
     ShardedFunction (benchmarks and the acceptance test read this)."""

@@ -65,6 +65,17 @@ H2D_BYTES_TOTAL = "ray_tpu_h2d_bytes_total"
 # superstep learner contract (docs/data_plane.md): updates executed
 # inside fused K-updates-per-dispatch programs
 SUPERSTEP_UPDATES_TOTAL = "ray_tpu_superstep_updates_total"
+# prioritized-replay segment-tree operations by op and by which tree
+# implementation performed them (docs/data_plane.md "device sum
+# tree"): host = the numpy SumSegmentTree walk, device = the
+# mesh-resident f64 tree programs. A healthy device-tree run shows
+# its sample/update ops under tree="device" and zero under "host".
+REPLAY_TREE_OPS_TOTAL = "ray_tpu_replay_tree_ops_total"
+# device→host payload bytes by path — the mirror of the H2D counter
+# for the readbacks the data plane still performs (today:
+# "replay_priorities", the stacked |td| pull that feeds the host
+# alpha-power before a device-tree priority refresh)
+D2H_BYTES_TOTAL = "ray_tpu_d2h_bytes_total"
 # device rollout lane (docs/pipeline.md): env steps taken INSIDE
 # mesh-resident rollout programs (JaxVectorEnv lane) — compare against
 # ray_tpu_env_steps_sampled_total for the on-device fraction
@@ -253,6 +264,44 @@ def add_h2d_bytes(path: str, n: int) -> None:
         "host to device payload bytes by transfer path",
         ("path",),
     ).inc(float(n), {"path": path})
+
+
+def inc_tree_op(op: str, tree: str, n: int = 1) -> None:
+    """One segment-tree operation on the prioritized-replay path:
+    ``op`` ∈ insert | update | sample, ``tree`` ∈ host | device
+    (which implementation walked the tree)."""
+    counter(
+        REPLAY_TREE_OPS_TOTAL,
+        "prioritized-replay segment-tree ops by op and tree plane",
+        ("op", "tree"),
+    ).inc(float(n), {"op": op, "tree": tree})
+
+
+def add_d2h_bytes(path: str, n: int) -> None:
+    """Device→host payload bytes about to cross on ``path``
+    (``replay_priorities``: the stacked |td| pull for the host
+    alpha-power — docs/data_plane.md documents why that transform
+    stays host-side)."""
+    if n <= 0:
+        return
+    counter(
+        D2H_BYTES_TOTAL,
+        "device to host payload bytes by transfer path",
+        ("path",),
+    ).inc(float(n), {"path": path})
+
+
+def d2h_bytes_by_path() -> Dict[str, float]:
+    """Per-path totals of the D2H byte counter ({} before any
+    readback) — same shape as :func:`h2d_bytes_by_path`."""
+    m = get_metric(D2H_BYTES_TOTAL)
+    if m is None:
+        return {}
+    out: Dict[str, float] = {}
+    for tags, v in m.series():
+        path = dict(tags).get("path", "")
+        out[path] = out.get(path, 0.0) + v
+    return out
 
 
 def set_replay_occupancy(

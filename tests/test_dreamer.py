@@ -4,6 +4,7 @@ import gymnasium as gym
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from ray_tpu.algorithms.dreamer import Dreamer, DreamerConfig, EpisodicBuffer
 from ray_tpu.env.registry import register_env
@@ -212,6 +213,9 @@ class TinyImageEnv(gym.Env):
         return self._render(), reward, False, self._t >= self.horizon, {}
 
 
+@pytest.mark.slow  # PR-1 budget rule: 11 s; the conv encoder/decoder
+# path keeps tier-1 coverage via the world-model-loss and end-to-end
+# dreamer tests in this file
 def test_dreamer_conv_path_trains_on_images():
     """The DMC-style 64x64 conv encoder/decoder path: shapes line up,
     pixels normalize, one full training step runs with finite losses."""

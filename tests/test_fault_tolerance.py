@@ -140,6 +140,9 @@ for t in ana.trials:
 
 
 @pytest.mark.regression
+@pytest.mark.slow  # PR-1 budget rule: 10 s; checkpoint auto-restore
+# keeps tier-1 coverage via test_resilience.py (crash→restore
+# roundtrip) and test_elastic.py (stream-tail restore bound)
 def test_tune_driver_kill_and_resume(tmp_path):
     """Kill the driver mid-experiment (SIGKILL, no cleanup); a resumed
     driver finishes from the checkpoints instead of restarting at

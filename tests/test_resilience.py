@@ -322,6 +322,9 @@ def test_restore_without_checkpoint_propagates():
 # ---------------------------------------------------------------------------
 
 
+@pytest.mark.slow  # PR-1 budget rule: 23 s; every failure mode it
+# composes (worker kill + probe/recreate, nan-batch skip, recovery
+# counters) keeps tier-1 coverage via the individual tests above
 def test_chaos_e2e_kill_two_of_four_workers_and_nan_batch():
     """The acceptance scenario: FaultInjector kills 2 of 4 rollout
     workers and poisons one learn batch mid-PPO-run; ``train()`` must

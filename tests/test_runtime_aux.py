@@ -84,6 +84,9 @@ def test_log_monitor_captures_worker_output(tmp_path):
     )
 
 
+@pytest.mark.slow  # PR-1 budget rule: 11 s; worker-kill-during-train
+# coverage stays in tier-1 via tests/test_resilience.py's targeted
+# kill/recreate tests and tests/test_elastic.py's drain paths
 def test_chaos_worker_kills_during_training():
     """Fault injection (reference NodeKillerActor + test_chaos.py):
     kill rollout workers mid-run; training must recover via task
