@@ -98,6 +98,11 @@ class CQLJaxPolicy(SACJaxPolicy):
         num_actions = int(cfg.get("num_actions", 10))
         min_q_weight = float(cfg.get("min_q_weight", 5.0))
         act_dim = self.action_dim
+        # log density of the uniform proposal over the action box:
+        # (1/(high-low))^d (reference uses log(0.5^d) for [-1,1]).
+        # Host math on static space bounds — computed once here, not
+        # per trace inside the device body (RTA002).
+        random_density = -float(act_dim) * np.log(high - low)
 
         def q_repeat(cp, obs, actions_rep):
             """Q for (B*num_actions) actions against repeated obs."""
@@ -155,9 +160,6 @@ class CQLJaxPolicy(SACJaxPolicy):
 
             cur_acts, cur_logp = sample_repeat(cur_dist, rng_c)
             next_acts, next_logp = sample_repeat(next_dist, rng_n)
-            # log density of the uniform proposal over the action box:
-            # (1/(high-low))^d (reference uses log(0.5^d) for [-1,1])
-            random_density = -float(act_dim) * np.log(high - low)
 
             def critic_loss(cp):
                 q1, q2 = critic.apply(cp, obs, actions)

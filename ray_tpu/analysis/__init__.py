@@ -1,0 +1,35 @@
+"""``ray_tpu.analysis`` — device-contract static analyzer.
+
+An AST-based rule engine encoding the repo's device contracts
+(docs/static_analysis.md has the catalog and the originating bug for
+each rule):
+
+==========  ============================================================
+RTA001      use-after-donate: a tree donated to a ``sharded_jit``
+            program read again before reassignment
+RTA002      trace hazards: host numpy / ``.item()`` / coercions inside
+            device contexts; bare Python scalars fed to cached programs
+RTA003      weak-type promotion: bare float literals in f64 scopes
+            (the PR-11 ``|td|+1e-6`` divergence class)
+RTA004      RNG discipline: global ``np.random.*`` in library code;
+            PRNG keys consumed twice without split/fold_in
+RTA005      host sync in hot paths: blocking D2H outside the counted
+            drain helpers in superstep/serve/learner-thread spans
+RTA006      thread ownership: cross-thread calls between
+            ``# ray-tpu: thread=<owner>``-annotated surfaces
+==========  ============================================================
+
+Run ``python -m ray_tpu.analysis`` (pure AST — works without jax);
+CI gates on zero unbaselined findings via
+``tests/test_static_analysis.py``.
+"""
+
+from ray_tpu.analysis.engine import (  # noqa: F401
+    Finding,
+    ModuleModel,
+    ScanResult,
+    default_baseline_path,
+    load_baseline,
+    save_baseline,
+    scan_paths,
+)

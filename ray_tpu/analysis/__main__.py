@@ -1,0 +1,128 @@
+"""CLI for the device-contract analyzer.
+
+    python -m ray_tpu.analysis [paths...] [--json] [--rules RTA00X,..]
+                               [--baseline PATH|--no-baseline]
+                               [--write-baseline] [--root DIR]
+
+Exit status: 0 when every finding is suppressed or baselined, 1 when
+unbaselined findings remain, 2 on parse errors. Stale baseline
+entries are reported (the baseline should only ever shrink) but do
+not fail the run.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from ray_tpu.analysis.engine import (
+    default_baseline_path,
+    load_baseline,
+    save_baseline,
+    scan_paths,
+)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m ray_tpu.analysis",
+        description="ray_tpu device-contract static analyzer",
+    )
+    ap.add_argument(
+        "paths",
+        nargs="*",
+        default=None,
+        help="files/directories to scan (default: ray_tpu/)",
+    )
+    ap.add_argument("--json", action="store_true", dest="as_json")
+    ap.add_argument(
+        "--root",
+        default=None,
+        help="repo root findings/baseline paths are relative to "
+        "(default: cwd)",
+    )
+    ap.add_argument(
+        "--baseline",
+        default=None,
+        help="baseline file (default: ray_tpu/analysis/baseline.json)",
+    )
+    ap.add_argument(
+        "--no-baseline",
+        action="store_true",
+        help="report every finding, grandfathered or not",
+    )
+    ap.add_argument(
+        "--write-baseline",
+        action="store_true",
+        help="write the current findings as the new baseline",
+    )
+    ap.add_argument(
+        "--rules",
+        default=None,
+        help="comma-separated rule ids to run (default: all)",
+    )
+    args = ap.parse_args(argv)
+
+    root = os.path.abspath(args.root or os.getcwd())
+    paths = args.paths or [os.path.join(root, "ray_tpu")]
+    baseline_path = args.baseline or default_baseline_path()
+    baseline = None
+    if not args.no_baseline and not args.write_baseline:
+        if os.path.exists(baseline_path):
+            baseline = load_baseline(baseline_path)
+
+    rules = None
+    if args.rules:
+        from ray_tpu.analysis.rules import rules_by_id
+
+        rules = rules_by_id(args.rules.split(","))
+
+    result = scan_paths(
+        paths, root=root, baseline=baseline, rules=rules
+    )
+
+    if args.write_baseline:
+        save_baseline(baseline_path, result.findings)
+        print(
+            f"wrote {len({f.key for f in result.findings})} entries "
+            f"to {baseline_path}"
+        )
+        return 0
+
+    if args.as_json:
+        print(json.dumps(result.to_dict(), indent=1))
+    else:
+        for f in result.findings:
+            print(f.render())
+        for e in result.stale_baseline:
+            print(
+                "stale baseline entry (fixed or moved — remove it): "
+                f"{e['rule']} {e['path']} [{e['symbol']}]"
+            )
+        for err in result.parse_errors:
+            print(f"parse error: {err}")
+        counts = result.counts()
+        by_rule = (
+            " ("
+            + ", ".join(
+                f"{r}={n}" for r, n in sorted(counts.items())
+            )
+            + ")"
+            if counts
+            else ""
+        )
+        print(
+            f"{len(result.findings)} unbaselined finding(s){by_rule}, "
+            f"{len(result.baselined)} baselined, "
+            f"{len(result.stale_baseline)} stale baseline entr(ies) — "
+            f"{result.files} files in {result.duration_s:.2f}s"
+        )
+    if result.parse_errors:
+        return 2
+    return 1 if result.findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

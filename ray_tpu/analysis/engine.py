@@ -1,0 +1,662 @@
+"""Rule engine for the device-contract static analyzer.
+
+Pure ``ast`` + ``tokenize`` — importing this module (and running a
+scan) never imports jax, so the pass runs in CI images where jax is
+broken or absent and costs AST-parse time only.
+
+The engine's job is classification; the rules in
+:mod:`ray_tpu.analysis.rules` consume the classified model:
+
+- **Device contexts.** A function whose body is traced into an XLA
+  program must obey the trace contracts (no host numpy on tracers, no
+  ``.item()``, no Python-value branching — RTA002/RTA003). The
+  classifier marks a function as a device context when it is
+
+  * annotated ``# ray-tpu: device-fn``;
+  * referenced in the arguments of a known tracing entry point
+    (``sharded_jit``, ``jax.jit``, ``jax.shard_map``, ``jax.lax.scan``
+    / ``map`` / ``cond`` / ``switch`` / ``while_loop`` /
+    ``fori_loop``, ``jax.vmap``, ``jax.grad`` …) in the same module;
+  * defined (at any depth) inside one of the repo's device-program
+    builders (``_device_update_fn``, ``_nest_device_fn``,
+    ``_build_serve_fn``, ``build_superstep_fn`` … — the entry points
+    docs/data_plane.md names); or
+  * nested inside another device context.
+
+  ``# ray-tpu: host-fn`` overrides all of the above (for builder
+  helpers that run at build time, not trace time).
+
+- **f64 zones** (RTA003): functions annotated ``# ray-tpu: f64`` (the
+  device sum-tree program bodies), anything nested in one, and
+  statements lexically inside a ``with f64_scope():`` block.
+
+- **Thread owners** (RTA006): ``# ray-tpu: thread=<name>`` on a def.
+
+- **Hot paths** (RTA005): ``# ray-tpu: hot-path`` on a def marks a
+  superstep/serve-batcher/learner-thread span where blocking D2H must
+  go through the counted drain helpers.
+
+Suppression and grandfathering:
+
+- ``# ray-tpu: allow[RTA003] reason`` on the offending line (or the
+  comment line directly above it) suppresses that rule there; on a
+  ``def`` header it suppresses the rule for the whole function.
+- ``analysis/baseline.json`` grandfathers findings keyed by
+  ``(rule, path, symbol)`` — symbol is the enclosing function's
+  dotted qualname, so entries survive line drift. Stale entries
+  (matching nothing) are reported so the baseline only shrinks.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import json
+import os
+import re
+import time
+import tokenize
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+# ---------------------------------------------------------------------------
+# annotations
+
+_DIRECTIVE_RE = re.compile(r"#\s*ray-tpu:\s*(.+?)\s*$")
+_ALLOW_RE = re.compile(r"allow\[([A-Za-z0-9_,\s]+)\]\s*(.*)$")
+_THREAD_RE = re.compile(r"thread=([A-Za-z0-9_\-]+)$")
+
+#: directives a def header understands (besides allow/thread)
+_FLAG_DIRECTIVES = {"device-fn", "host-fn", "f64", "hot-path", "drain-ok"}
+
+#: the tracing entry points whose function arguments become device
+#: contexts. Matched on the LAST attribute of the dotted call name,
+#: optionally constrained on earlier parts (``lax.map`` yes,
+#: builtin ``map`` no).
+_ENTRY_LAST = {
+    "sharded_jit": None,
+    "shard_map": None,
+    "vmap": ("jax",),
+    "pmap": ("jax",),
+    "grad": ("jax",),
+    "value_and_grad": ("jax",),
+    "remat": ("jax",),
+    "jit": ("jax",),
+    "scan": ("lax",),
+    "map": ("lax",),
+    "cond": ("lax",),
+    "switch": ("lax",),
+    "while_loop": ("lax",),
+    "fori_loop": ("lax",),
+    "associative_scan": ("lax",),
+}
+
+#: repo builder functions whose nested defs are device-program bodies
+#: (the known entry points of docs/data_plane.md / ISSUE 12)
+DEVICE_ENTRY_BUILDERS = {
+    "_device_update_fn",
+    "_nest_device_fn",
+    "_build_serve_fn",
+    "build_superstep_fn",
+    "_build_rollout_superstep",
+    "_build_learn_fn",
+    "_build_action_fn",
+    "_build_update_fn",
+    "_td_error_device_fn",
+}
+
+#: classes whose ``_build_*`` methods contain device bodies
+DEVICE_ENTRY_CLASSES = {"JaxRolloutEngine"}
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str  # repo-relative posix path
+    line: int
+    col: int
+    symbol: str  # dotted qualname of enclosing function, or <module>
+    message: str
+
+    @property
+    def key(self) -> Tuple[str, str, str]:
+        return (self.rule, self.path, self.symbol)
+
+    def to_dict(self) -> Dict:
+        return {
+            "rule": self.rule,
+            "path": self.path,
+            "line": self.line,
+            "col": self.col,
+            "symbol": self.symbol,
+            "message": self.message,
+        }
+
+    def render(self) -> str:
+        return (
+            f"{self.path}:{self.line}:{self.col} {self.rule} "
+            f"[{self.symbol}] {self.message}"
+        )
+
+
+@dataclass(eq=False)  # identity semantics: usable as dict/set keys
+class FuncInfo:
+    node: ast.AST  # FunctionDef / AsyncFunctionDef
+    qualname: str
+    parent: Optional["FuncInfo"]
+    directives: Set[str] = field(default_factory=set)
+    allow: Set[str] = field(default_factory=set)  # function-scope allows
+    thread: Optional[str] = None
+    device: bool = False
+    f64: bool = False
+    hot: bool = False
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` for a Name/Attribute chain, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def is_entry_call(call: ast.Call) -> bool:
+    name = dotted_name(call.func)
+    if not name:
+        return False
+    parts = name.split(".")
+    last = parts[-1]
+    need = _ENTRY_LAST.get(last, False)
+    if need is False:
+        return False
+    if need is None:
+        return True
+    # constrained: one of the required tokens must appear earlier in
+    # the chain (jax.vmap, jax.lax.scan, lax.map, …)
+    return any(tok in parts[:-1] for tok in need)
+
+
+class ModuleModel:
+    """One parsed module plus everything the rules need: the tree,
+    per-node enclosing-function map, device/f64/thread/hot
+    classification, and the suppression tables."""
+
+    def __init__(self, path: str, relpath: str, source: str):
+        self.path = path
+        self.relpath = relpath.replace(os.sep, "/")
+        self.source = source
+        self.tree = ast.parse(source, filename=path)
+        self.lines = source.splitlines()
+        # line -> directives, line -> allowed rule ids
+        self.line_directives: Dict[int, List[str]] = {}
+        self.allow_lines: Dict[int, Set[str]] = {}
+        self._collect_comments(source)
+        # parent pointers + function table
+        self._parents: Dict[ast.AST, ast.AST] = {}
+        self.funcs: List[FuncInfo] = []
+        self._func_of_def: Dict[ast.AST, FuncInfo] = {}
+        self._build_funcs()
+        self._attach_annotations()
+        self.f64_spans = self._find_f64_spans()
+        self._classify()
+
+    # -- comments --------------------------------------------------------
+
+    def _collect_comments(self, source: str) -> None:
+        try:
+            toks = tokenize.generate_tokens(
+                io.StringIO(source).readline
+            )
+            comments = [
+                (t.start[0], t.string)
+                for t in toks
+                if t.type == tokenize.COMMENT
+            ]
+        except tokenize.TokenError:
+            comments = []
+        for line, text in comments:
+            m = _DIRECTIVE_RE.search(text)
+            if not m:
+                continue
+            body = m.group(1)
+            am = _ALLOW_RE.match(body)
+            if am:
+                rules = {
+                    r.strip().upper()
+                    for r in am.group(1).split(",")
+                    if r.strip()
+                }
+                self.allow_lines.setdefault(line, set()).update(rules)
+                continue
+            # space-separated directives: "thread=driver hot-path"
+            self.line_directives.setdefault(line, []).extend(
+                body.split()
+            )
+
+    def allows_at(self, line: int) -> Set[str]:
+        """Rule ids suppressed at ``line``: a trailing comment on the
+        line itself, or a standalone comment line directly above (with
+        any run of further comment lines above that)."""
+        out = set(self.allow_lines.get(line, ()))
+        probe = line - 1
+        while probe >= 1:
+            text = (
+                self.lines[probe - 1] if probe <= len(self.lines) else ""
+            )
+            stripped = text.strip()
+            if not stripped.startswith("#"):
+                break
+            out |= self.allow_lines.get(probe, set())
+            probe -= 1
+        return out
+
+    # -- function table --------------------------------------------------
+
+    def _build_funcs(self) -> None:
+        def visit(node, parent, qual, parent_fn):
+            for child in ast.iter_child_nodes(node):
+                self._parents[child] = node
+                if isinstance(
+                    child, (ast.FunctionDef, ast.AsyncFunctionDef)
+                ):
+                    q = (
+                        f"{qual}.{child.name}" if qual else child.name
+                    )
+                    fi = FuncInfo(child, q, parent_fn)
+                    self.funcs.append(fi)
+                    self._func_of_def[child] = fi
+                    visit(child, node, q, fi)
+                elif isinstance(child, ast.ClassDef):
+                    q = (
+                        f"{qual}.{child.name}" if qual else child.name
+                    )
+                    visit(child, node, q, parent_fn)
+                else:
+                    visit(child, node, qual, parent_fn)
+
+        visit(self.tree, None, "", None)
+
+    def parent(self, node: ast.AST) -> Optional[ast.AST]:
+        return self._parents.get(node)
+
+    def enclosing(self, node: ast.AST) -> Optional[FuncInfo]:
+        """The FuncInfo whose body contains ``node`` (the node of a def
+        maps to its OWN FuncInfo)."""
+        cur: Optional[ast.AST] = node
+        while cur is not None:
+            fi = self._func_of_def.get(cur)
+            if fi is not None:
+                return fi
+            cur = self._parents.get(cur)
+        return None
+
+    def symbol_for(self, node: ast.AST) -> str:
+        fi = self.enclosing(node)
+        return fi.qualname if fi is not None else "<module>"
+
+    def enclosing_class_name(self, node: ast.AST) -> Optional[str]:
+        cur = self._parents.get(node)
+        while cur is not None:
+            if isinstance(cur, ast.ClassDef):
+                return cur.name
+            cur = self._parents.get(cur)
+        return None
+
+    # -- annotations -----------------------------------------------------
+
+    def _header_lines(self, node) -> Iterable[int]:
+        """Lines whose directives attach to this def: the def header
+        span, plus the contiguous comment block immediately above the
+        def (or its first decorator)."""
+        first = node.lineno
+        if node.decorator_list:
+            first = min(
+                first, min(d.lineno for d in node.decorator_list)
+            )
+        body_start = node.body[0].lineno if node.body else node.lineno
+        yield from range(first, body_start + 1)
+        probe = first - 1
+        while probe >= 1:
+            text = (
+                self.lines[probe - 1] if probe <= len(self.lines) else ""
+            )
+            stripped = text.strip()
+            if not stripped.startswith("#"):
+                break
+            yield probe
+            probe -= 1
+
+    def _attach_annotations(self) -> None:
+        for fi in self.funcs:
+            for line in self._header_lines(fi.node):
+                for d in self.line_directives.get(line, ()):  # flags
+                    tm = _THREAD_RE.match(d)
+                    if tm:
+                        fi.thread = tm.group(1)
+                    elif d in _FLAG_DIRECTIVES:
+                        fi.directives.add(d)
+                fi.allow |= self.allow_lines.get(line, set())
+
+    # -- f64 zones -------------------------------------------------------
+
+    def _find_f64_spans(self) -> List[Tuple[int, int]]:
+        spans = []
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.With):
+                continue
+            for item in node.items:
+                expr = item.context_expr
+                if isinstance(expr, ast.Call):
+                    name = dotted_name(expr.func) or ""
+                    if name.split(".")[-1] == "f64_scope":
+                        spans.append(
+                            (
+                                node.lineno,
+                                getattr(
+                                    node, "end_lineno", node.lineno
+                                ),
+                            )
+                        )
+        return spans
+
+    def in_f64_span(self, line: int) -> bool:
+        return any(a <= line <= b for a, b in self.f64_spans)
+
+    # -- classification --------------------------------------------------
+
+    def _classify(self) -> None:
+        # names referenced in the arguments of tracing entry calls
+        traced_names: Set[Tuple[Optional[FuncInfo], str]] = set()
+        for node in ast.walk(self.tree):
+            if not (
+                isinstance(node, ast.Call) and is_entry_call(node)
+            ):
+                continue
+            scope = self.enclosing(node)
+            for arg in list(node.args):
+                for sub in ast.walk(arg):
+                    if isinstance(sub, ast.Name):
+                        traced_names.add((scope, sub.id))
+
+        by_scope_name: Dict[Tuple[Optional[FuncInfo], str], FuncInfo] = {}
+        for fi in self.funcs:
+            by_scope_name[(fi.parent, fi.node.name)] = fi
+
+        def name_marked(fi: FuncInfo) -> bool:
+            # a def is traced if ITS name is referenced in an entry
+            # call from the same scope chain it is visible in
+            scope = fi.parent
+            probe: Optional[FuncInfo] = scope
+            while True:
+                if (probe, fi.node.name) in traced_names:
+                    # visibility check: the def found by that (scope,
+                    # name) lookup must be this one
+                    if by_scope_name.get((scope, fi.node.name)) is fi:
+                        return True
+                if probe is None:
+                    return False
+                probe = probe.parent
+
+        for fi in self.funcs:
+            if "host-fn" in fi.directives:
+                fi.device = False
+                continue
+            dev = "device-fn" in fi.directives or name_marked(fi)
+            if not dev:
+                anc = fi.parent
+                while anc is not None:
+                    in_entry_class = (
+                        self.enclosing_class_name(anc.node)
+                        in DEVICE_ENTRY_CLASSES
+                        and anc.node.name.startswith("_build_")
+                    )
+                    if (
+                        anc.node.name in DEVICE_ENTRY_BUILDERS
+                        or in_entry_class
+                        or anc.device
+                    ):
+                        dev = True
+                        break
+                    anc = anc.parent
+            fi.device = dev
+        # second pass: nesting inside an (already marked) device fn
+        for fi in self.funcs:
+            if fi.device or "host-fn" in fi.directives:
+                continue
+            anc = fi.parent
+            while anc is not None:
+                if anc.device:
+                    fi.device = True
+                    break
+                anc = anc.parent
+        # third pass (fixed point): everything a device context CALLS
+        # executes at trace time too — propagate along same-module
+        # call edges (`name(...)` in scope, `self.method(...)` in the
+        # same class)
+        by_class: Dict[Tuple[Optional[str], str], FuncInfo] = {}
+        for fi in self.funcs:
+            cls = self.enclosing_class_name(fi.node)
+            by_class.setdefault((cls, fi.node.name), fi)
+
+        def resolve_call(caller: FuncInfo, call: ast.Call):
+            func = call.func
+            if (
+                isinstance(func, ast.Attribute)
+                and isinstance(func.value, ast.Name)
+                and func.value.id == "self"
+            ):
+                cls = self.enclosing_class_name(caller.node)
+                return by_class.get((cls, func.attr))
+            if isinstance(func, ast.Name):
+                probe = caller.parent
+                while True:
+                    hit = by_scope_name.get((probe, func.id))
+                    if hit is not None:
+                        return hit
+                    if probe is None:
+                        return None
+                    probe = probe.parent
+            return None
+
+        changed = True
+        while changed:
+            changed = False
+            for fi in self.funcs:
+                if not fi.device:
+                    continue
+                for node in ast.walk(fi.node):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    callee = resolve_call(fi, node)
+                    if (
+                        callee is not None
+                        and not callee.device
+                        and "host-fn" not in callee.directives
+                    ):
+                        callee.device = True
+                        changed = True
+
+        for fi in self.funcs:
+            f64 = "f64" in fi.directives or self.in_f64_span(
+                fi.node.lineno
+            )
+            if not f64:
+                anc = fi.parent
+                while anc is not None:
+                    if anc.f64:
+                        f64 = True
+                        break
+                    anc = anc.parent
+            fi.f64 = f64
+            fi.hot = "hot-path" in fi.directives
+            if fi.thread is None and fi.parent is not None:
+                fi.thread = fi.parent.thread
+
+    # -- rule support ----------------------------------------------------
+
+    def finding(
+        self, rule: str, node: ast.AST, message: str
+    ) -> Optional[Finding]:
+        """Build a Finding unless an allow annotation suppresses it
+        (line-scope or enclosing-function scope)."""
+        line = getattr(node, "lineno", 1)
+        col = getattr(node, "col_offset", 0)
+        if rule in self.allows_at(line):
+            return None
+        fi = self.enclosing(node)
+        while fi is not None:
+            if rule in fi.allow:
+                return None
+            fi = fi.parent
+        return Finding(
+            rule=rule,
+            path=self.relpath,
+            line=line,
+            col=col,
+            symbol=self.symbol_for(node),
+            message=message,
+        )
+
+
+# ---------------------------------------------------------------------------
+# baseline
+
+def load_baseline(path: str) -> List[Dict]:
+    with open(path) as f:
+        data = json.load(f)
+    return list(data.get("entries", []))
+
+
+def save_baseline(path: str, findings: Sequence[Finding]) -> None:
+    entries = sorted(
+        {f.key for f in findings}
+    )  # dedup per (rule, path, symbol)
+    data = {
+        "version": 1,
+        "entries": [
+            {"rule": r, "path": p, "symbol": s}
+            for r, p, s in entries
+        ],
+    }
+    with open(path, "w") as f:
+        json.dump(data, f, indent=1)
+        f.write("\n")
+
+
+# ---------------------------------------------------------------------------
+# scanning
+
+@dataclass
+class ScanResult:
+    findings: List[Finding]  # unbaselined, unsuppressed
+    baselined: List[Finding]
+    stale_baseline: List[Dict]
+    files: int
+    duration_s: float
+    parse_errors: List[str] = field(default_factory=list)
+
+    @property
+    def ok(self) -> bool:
+        return not self.findings
+
+    def to_dict(self) -> Dict:
+        return {
+            "ok": self.ok,
+            "files": self.files,
+            "duration_s": round(self.duration_s, 3),
+            "findings": [f.to_dict() for f in self.findings],
+            "baselined": [f.to_dict() for f in self.baselined],
+            "stale_baseline": self.stale_baseline,
+            "parse_errors": self.parse_errors,
+            "counts": self.counts(),
+        }
+
+    def counts(self) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for f in self.findings:
+            out[f.rule] = out.get(f.rule, 0) + 1
+        return out
+
+
+def iter_py_files(paths: Sequence[str]) -> Iterable[str]:
+    for p in paths:
+        if os.path.isfile(p):
+            if p.endswith(".py"):
+                yield p
+            continue
+        for dirpath, dirnames, filenames in os.walk(p):
+            dirnames[:] = [
+                d
+                for d in dirnames
+                if d != "__pycache__" and not d.startswith(".")
+            ]
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    yield os.path.join(dirpath, fn)
+
+
+def scan_paths(
+    paths: Sequence[str],
+    *,
+    root: Optional[str] = None,
+    baseline: Optional[Sequence[Dict]] = None,
+    rules: Optional[Sequence] = None,
+) -> ScanResult:
+    """Scan ``paths`` (files or directories) with every registered
+    rule. ``root`` anchors the repo-relative paths findings and
+    baseline entries use (default: cwd)."""
+    from ray_tpu.analysis.rules import all_rules
+
+    root = os.path.abspath(root or os.getcwd())
+    active = list(rules) if rules is not None else all_rules()
+    t0 = time.perf_counter()
+    raw: List[Finding] = []
+    files = 0
+    errors: List[str] = []
+    for path in iter_py_files(paths):
+        apath = os.path.abspath(path)
+        rel = os.path.relpath(apath, root)
+        try:
+            with open(apath, encoding="utf-8") as f:
+                source = f.read()
+            model = ModuleModel(apath, rel, source)
+        except (SyntaxError, UnicodeDecodeError) as e:
+            errors.append(f"{rel}: {e}")
+            continue
+        files += 1
+        for rule in active:
+            raw.extend(rule.check(model))
+    raw.sort(key=lambda f: (f.path, f.line, f.rule))
+
+    base_keys = {
+        (e["rule"], e["path"], e["symbol"]) for e in (baseline or ())
+    }
+    kept, grandfathered = [], []
+    hit_keys = set()
+    for f in raw:
+        if f.key in base_keys:
+            grandfathered.append(f)
+            hit_keys.add(f.key)
+        else:
+            kept.append(f)
+    stale = [
+        e
+        for e in (baseline or ())
+        if (e["rule"], e["path"], e["symbol"]) not in hit_keys
+    ]
+    return ScanResult(
+        findings=kept,
+        baselined=grandfathered,
+        stale_baseline=stale,
+        files=files,
+        duration_s=time.perf_counter() - t0,
+        parse_errors=errors,
+    )
+
+
+def default_baseline_path() -> str:
+    return os.path.join(os.path.dirname(__file__), "baseline.json")

@@ -1,0 +1,34 @@
+"""Rule registry for the device-contract analyzer.
+
+Each rule module exposes ``RULE_ID`` and
+``check(model: ModuleModel) -> List[Finding]``.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from ray_tpu.analysis.rules import (
+    donation,
+    dtype,
+    hostsync,
+    rng,
+    threads,
+    trace,
+)
+
+_ALL = [donation, trace, dtype, rng, hostsync, threads]
+
+RULE_DOCS = {
+    mod.RULE_ID: (mod.__doc__ or "").strip().splitlines()[0]
+    for mod in _ALL
+}
+
+
+def all_rules() -> List:
+    return list(_ALL)
+
+
+def rules_by_id(ids) -> List:
+    want = {i.upper() for i in ids}
+    return [m for m in _ALL if m.RULE_ID in want]

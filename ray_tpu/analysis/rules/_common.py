@@ -1,0 +1,106 @@
+"""Shared AST helpers for the analyzer rules."""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional, Set, Tuple
+
+from ray_tpu.analysis.engine import FuncInfo, ModuleModel, dotted_name
+
+
+def own_nodes(fi: FuncInfo) -> Iterable[ast.AST]:
+    """Every node inside ``fi`` excluding nested def/class subtrees
+    (those are classified and scanned on their own). Lambdas count as
+    part of the enclosing function."""
+    stack: List[ast.AST] = list(ast.iter_child_nodes(fi.node))
+    while stack:
+        node = stack.pop()
+        if isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+        ):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def own_stmts(fi: FuncInfo) -> List[ast.stmt]:
+    """Ordered statement list of ``fi``'s body, recursing into
+    control-flow blocks but not nested defs. Linear program order is
+    approximated by source position."""
+    out = [n for n in own_nodes(fi) if isinstance(n, ast.stmt)]
+    out.sort(key=lambda n: (n.lineno, n.col_offset))
+    return out
+
+
+def expr_key(node: ast.AST) -> Optional[str]:
+    """Stable key for a Name or a Name-rooted attribute chain
+    (``opt_state`` / ``self.opt_state``); None for anything else."""
+    return dotted_name(node)
+
+
+def stores_of(stmt: ast.stmt) -> Set[str]:
+    """expr_keys written by ``stmt`` (assign/augassign/for targets,
+    ``with ... as`` bindings, deletions)."""
+    out: Set[str] = set()
+    for node in ast.walk(stmt):
+        if isinstance(node, (ast.Name, ast.Attribute)) and isinstance(
+            getattr(node, "ctx", None), (ast.Store, ast.Del)
+        ):
+            key = expr_key(node)
+            if key:
+                out.add(key)
+    return out
+
+
+def loads_of(stmt: ast.stmt) -> List[Tuple[str, ast.AST]]:
+    out: List[Tuple[str, ast.AST]] = []
+    for node in ast.walk(stmt):
+        if isinstance(node, (ast.Name, ast.Attribute)) and isinstance(
+            getattr(node, "ctx", None), ast.Load
+        ):
+            key = expr_key(node)
+            if key:
+                out.append((key, node))
+    return out
+
+
+def call_name(call: ast.Call) -> str:
+    return dotted_name(call.func) or ""
+
+
+def const_int_tuple(node: ast.AST) -> Optional[Tuple[int, ...]]:
+    """Evaluate a literal int / tuple-of-ints AST node (the only
+    shapes ``donate_argnums`` takes)."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        vals = []
+        for el in node.elts:
+            if isinstance(el, ast.Constant) and isinstance(
+                el.value, int
+            ):
+                vals.append(el.value)
+            else:
+                return None
+        return tuple(vals)
+    return None
+
+
+def keyword(call: ast.Call, name: str) -> Optional[ast.AST]:
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+def class_methods(
+    model: ModuleModel, class_name: Optional[str]
+) -> dict:
+    """Map method name -> FuncInfo for the named class."""
+    if class_name is None:
+        return {}
+    out = {}
+    for fi in model.funcs:
+        if model.enclosing_class_name(fi.node) == class_name:
+            out.setdefault(fi.node.name, fi)
+    return out

@@ -1,0 +1,199 @@
+"""RTA001 — use-after-donate.
+
+``sharded_jit(..., donate_argnums=(i,))`` releases the i-th argument's
+buffers to the program's outputs (opt-state double-buffering). Reading
+the donated tree after the dispatch is undefined: on real accelerator
+backends the buffer is already aliased to an output. The contract is
+that the donated expression is REASSIGNED (usually by the same
+statement unpacking the program's outputs) before anything reads it.
+
+The rule tracks, per module:
+
+- donating program builders: functions whose body constructs a
+  ``sharded_jit``/``ShardedFunction`` with ``donate_argnums`` (the
+  repo's ``_build_*`` pattern), plus the cross-module builders the
+  sharding layer exports (``build_superstep_fn`` donates position 1);
+- donating callables: locals/attributes assigned from those builders
+  or from a donating ``sharded_jit`` call directly;
+
+and then flags any Load of a donated argument expression after the
+donating call, before a Store to it, within the same function (linear
+statement order).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from ray_tpu.analysis.engine import Finding, ModuleModel
+from ray_tpu.analysis.rules._common import (
+    call_name,
+    const_int_tuple,
+    expr_key,
+    keyword,
+    loads_of,
+    own_stmts,
+    stores_of,
+)
+
+RULE_ID = "RTA001"
+
+#: builders defined elsewhere whose return value donates: position map
+KNOWN_BUILDERS: Dict[str, Tuple[int, ...]] = {
+    "build_superstep_fn": (1,),  # opt_state (sharding/superstep.py)
+}
+
+
+def _donating_jit_call(node: ast.AST) -> Optional[Tuple[int, ...]]:
+    """donate positions if ``node`` is a sharded_jit/ShardedFunction/
+    jax.jit call with a literal donate_argnums."""
+    if not isinstance(node, ast.Call):
+        return None
+    last = call_name(node).split(".")[-1]
+    if last not in ("sharded_jit", "ShardedFunction", "jit"):
+        return None
+    kw = keyword(node, "donate_argnums")
+    if kw is None:
+        return None
+    return const_int_tuple(kw)
+
+
+def _module_builders(model: ModuleModel) -> Dict[str, Tuple[int, ...]]:
+    """Function (simple) names in this module that build-and-return a
+    donating program."""
+    out = dict(KNOWN_BUILDERS)
+    for fi in model.funcs:
+        positions: Set[int] = set()
+        returns = False
+        for node in ast.walk(fi.node):
+            pos = _donating_jit_call(node)
+            if pos:
+                positions.update(pos)
+            if isinstance(node, ast.Return) and node.value is not None:
+                returns = True
+        if positions and returns:
+            out[fi.node.name] = tuple(sorted(positions))
+    return out
+
+
+def _donating_value(
+    node: ast.AST, builders: Dict[str, Tuple[int, ...]]
+) -> Optional[Tuple[int, ...]]:
+    """donate positions if ``node`` evaluates to a donating program:
+    a direct donating jit call, or a call to a known builder."""
+    direct = _donating_jit_call(node)
+    if direct:
+        return direct
+    if isinstance(node, ast.Call):
+        last = call_name(node).split(".")[-1]
+        if last in builders:
+            return builders[last]
+    return None
+
+
+def _class_attr_programs(
+    model: ModuleModel, builders: Dict[str, Tuple[int, ...]]
+) -> Dict[Tuple[Optional[str], str], Tuple[int, ...]]:
+    """``self.X = <donating program>`` assignments anywhere in a class
+    -> {(class, attr): positions}."""
+    out: Dict[Tuple[Optional[str], str], Tuple[int, ...]] = {}
+    for node in ast.walk(model.tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        pos = _donating_value(node.value, builders)
+        if not pos:
+            continue
+        for tgt in node.targets:
+            if (
+                isinstance(tgt, ast.Attribute)
+                and isinstance(tgt.value, ast.Name)
+                and tgt.value.id == "self"
+            ):
+                cls = model.enclosing_class_name(node)
+                out[(cls, tgt.attr)] = pos
+    return out
+
+
+def check(model: ModuleModel) -> List[Finding]:
+    builders = _module_builders(model)
+    attr_programs = _class_attr_programs(model, builders)
+    findings: List[Finding] = []
+
+    for fi in model.funcs:
+        stmts = own_stmts(fi)
+        cls = model.enclosing_class_name(fi.node)
+        local_programs: Dict[str, Tuple[int, ...]] = {}
+        # (call id, donated position) -> (key, call, label, idx); the
+        # flat stmt list nests (an `if` contains its body stmts), so a
+        # call is seen once per enclosing stmt — keep the NARROWEST
+        # (greatest index) so the use-after window starts at the
+        # call's own statement
+        donations: Dict[
+            Tuple[int, int], Tuple[str, ast.Call, str, int]
+        ] = {}
+
+        for idx, stmt in enumerate(stmts):
+            # track locals bound to donating programs (chained
+            # targets included: fn = self._fns[k] = build(...))
+            if isinstance(stmt, ast.Assign):
+                pos = _donating_value(stmt.value, builders)
+                if pos:
+                    for tgt in stmt.targets:
+                        if isinstance(tgt, ast.Name):
+                            local_programs[tgt.id] = pos
+
+            for node in ast.walk(stmt):
+                if not isinstance(node, ast.Call):
+                    continue
+                pos: Optional[Tuple[int, ...]] = None
+                label = ""
+                if (
+                    isinstance(node.func, ast.Name)
+                    and node.func.id in local_programs
+                ):
+                    pos = local_programs[node.func.id]
+                    label = node.func.id
+                elif (
+                    isinstance(node.func, ast.Attribute)
+                    and isinstance(node.func.value, ast.Name)
+                    and node.func.value.id == "self"
+                    and (cls, node.func.attr) in attr_programs
+                ):
+                    pos = attr_programs[(cls, node.func.attr)]
+                    label = f"self.{node.func.attr}"
+                if not pos:
+                    continue
+                for p in pos:
+                    if p >= len(node.args):
+                        continue
+                    key = expr_key(node.args[p])
+                    if key is None:
+                        continue
+                    donations[(id(node), p)] = (key, node, label, idx)
+
+        for key, call, label, idx in donations.values():
+            # the donating statement itself may reassign the donated
+            # expr (tuple-unpack of the program outputs): that closes
+            # the window immediately
+            if key in stores_of(stmts[idx]):
+                continue
+            for later in stmts[idx + 1 :]:
+                hit = next(
+                    (n for k, n in loads_of(later) if k == key), None
+                )
+                if hit is not None:
+                    f = model.finding(
+                        RULE_ID,
+                        hit,
+                        f"`{key}` read after being donated to "
+                        f"`{label}` (donate_argnums position — the "
+                        "buffer is aliased to the program's outputs "
+                        "after dispatch); reassign before reading",
+                    )
+                    if f:
+                        findings.append(f)
+                    break
+                if key in stores_of(later):
+                    break
+    return findings

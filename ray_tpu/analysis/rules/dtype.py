@@ -1,0 +1,109 @@
+"""RTA003 — weak-type promotion in f64 scopes.
+
+The PR-11 Ape-X bug class: the device sum tree's programs build and
+run inside ``sharding.f64_scope()``, where a bare Python float
+literal (``|td| + 1e-6``) is WEAK-typed — its result dtype follows
+jax's canonicalization for the scope the expression happens to trace
+in, not the f64 contract of the tree state. The same expression
+evaluated host-side (numpy promotes the literal to f64) and
+device-side (weak literal keeps the f32 operand's dtype outside the
+scope, or traces differently across scopes) produced diverging
+max-priority watermarks. The contract: inside an f64 zone every float
+literal that touches array values carries an explicit dtype
+(``jnp.float64(1e-6)`` / ``np.float64(...)``).
+
+f64 zones are functions annotated ``# ray-tpu: f64`` (the device
+sum-tree program bodies), anything nested in one, and statements
+inside a ``with f64_scope():`` block. Device contexts outside an f64
+zone are NOT flagged — an f32 learner body's ``0.5 * loss`` is
+exactly what weak typing is for.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Set
+
+from ray_tpu.analysis.engine import Finding, ModuleModel
+from ray_tpu.analysis.rules._common import call_name, own_nodes
+
+RULE_ID = "RTA003"
+
+_DTYPE_CTORS = {
+    "float64", "float32", "float16", "asarray", "array", "full",
+    "full_like", "zeros", "ones", "arange", "linspace",
+}
+_JNP_ROOTS = {"jnp", "jax"}
+
+
+def _is_float_literal(node: ast.AST) -> bool:
+    if isinstance(node, ast.Constant) and isinstance(
+        node.value, float
+    ):
+        return True
+    if (
+        isinstance(node, ast.UnaryOp)
+        and isinstance(node.op, (ast.USub, ast.UAdd))
+        and isinstance(node.operand, ast.Constant)
+        and isinstance(node.operand.value, float)
+    ):
+        return True
+    return False
+
+
+def check(model: ModuleModel) -> List[Finding]:
+    findings: List[Finding] = []
+    seen: Set[int] = set()
+
+    def add(node, msg):
+        if id(node) in seen:
+            return
+        seen.add(id(node))
+        f = model.finding(RULE_ID, node, msg)
+        if f:
+            findings.append(f)
+
+    def scan_nodes(nodes):
+        for node in nodes:
+            if isinstance(node, ast.BinOp):
+                for side in (node.left, node.right):
+                    if _is_float_literal(side):
+                        add(
+                            side,
+                            "bare float literal arithmetic in an f64 "
+                            "scope — weak-typed literals canonicalize "
+                            "per-scope (the PR-11 `|td|+1e-6` "
+                            "divergence); wrap with jnp.float64(...) "
+                            "or np.float64(...)",
+                        )
+            elif isinstance(node, ast.Call):
+                parts = call_name(node).split(".")
+                if (
+                    len(parts) >= 2
+                    and parts[0] in _JNP_ROOTS
+                    and parts[-1] not in _DTYPE_CTORS
+                ):
+                    for arg in node.args:
+                        if _is_float_literal(arg):
+                            add(
+                                arg,
+                                "bare float literal passed to "
+                                f"`{'.'.join(parts)}` in an f64 scope "
+                                "— give it an explicit dtype "
+                                "(jnp.float64(...)) so both planes "
+                                "round identically",
+                            )
+
+    for fi in model.funcs:
+        if fi.f64:
+            scan_nodes(own_nodes(fi))
+        else:
+            # statements lexically inside `with f64_scope():` blocks
+            # of a non-f64 function
+            scan_nodes(
+                n
+                for n in own_nodes(fi)
+                if hasattr(n, "lineno")
+                and model.in_f64_span(n.lineno)
+            )
+    return findings

@@ -1,0 +1,68 @@
+"""RTA005 — blocking host sync in a hot-path span.
+
+The superstep / serve-batcher / learner-thread spans are annotated
+``# ray-tpu: hot-path``: one dispatch and ONE counted drain per
+superstep is the whole point of those designs (docs/data_plane.md),
+so a stray ``jax.device_get`` / ``.block_until_ready()`` / ``.item()``
+inside them silently serializes the pipeline on a device round trip
+per call. Sanctioned drains live in helper functions annotated
+``# ray-tpu: drain-ok`` (``LearnerThread._drain_lazy``,
+``flush_deferred_stats``) or carry an inline
+``# ray-tpu: allow[RTA005] <why this drain is counted>``.
+
+The rule flags only the sync PRIMITIVES — calling a drain-ok helper
+from a hot span is the sanctioned shape and passes by construction.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from ray_tpu.analysis.engine import Finding, ModuleModel
+from ray_tpu.analysis.rules._common import call_name, own_nodes
+
+RULE_ID = "RTA005"
+
+_SYNC_METHODS = {"item", "block_until_ready"}
+_SYNC_FUNCS = {"device_get", "block_until_ready"}
+
+
+def check(model: ModuleModel) -> List[Finding]:
+    findings: List[Finding] = []
+
+    def add(node, msg):
+        f = model.finding(RULE_ID, node, msg)
+        if f:
+            findings.append(f)
+
+    for fi in model.funcs:
+        if not fi.hot or "drain-ok" in fi.directives:
+            continue
+        for node in own_nodes(fi):
+            if not isinstance(node, ast.Call):
+                continue
+            name = call_name(node)
+            last = name.split(".")[-1]
+            if last in _SYNC_FUNCS and (
+                "." in name or last == "device_get"
+            ):
+                add(
+                    node,
+                    f"blocking `{name}` in hot-path span "
+                    f"`{fi.qualname}` — route the readback through a "
+                    "counted drain helper (ray-tpu: drain-ok) or "
+                    "defer it past the dispatch",
+                )
+            elif (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr in _SYNC_METHODS
+            ):
+                add(
+                    node,
+                    f"`.{node.func.attr}()` in hot-path span "
+                    f"`{fi.qualname}` blocks on a device round trip "
+                    "per call — batch it into the span's one counted "
+                    "drain",
+                )
+    return findings

@@ -1,0 +1,149 @@
+"""RTA004 — RNG discipline.
+
+Two contracts:
+
+- **No global-stream numpy randomness in library code.** Every random
+  draw flows through an explicitly seeded generator object
+  (``np.random.default_rng(seed)`` / ``RandomState``) — the bit-exact
+  generator invariant the replay planes depend on. Direct
+  ``np.random.seed`` / ``np.random.randint`` / ... calls mutate or
+  read interpreter-global state that any import can perturb.
+
+- **Split-order discipline for PRNG keys.** A jax PRNG key is a
+  VALUE: feeding the same key to two samplers silently correlates
+  them, and the per-update host split order is the bitwise-parity
+  contract for every lane (superstep = K individual calls). A key
+  variable must be re-derived (``jax.random.split`` / ``fold_in``)
+  between consecutive sampler consumptions.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from ray_tpu.analysis.engine import Finding, ModuleModel
+from ray_tpu.analysis.rules._common import call_name, expr_key
+
+RULE_ID = "RTA004"
+
+_NP_ROOTS = {"np", "numpy", "np_", "onp"}
+#: explicit-state constructors/types — the sanctioned surface
+_NP_RANDOM_ALLOWED = {
+    "default_rng", "Generator", "RandomState", "PCG64", "Philox",
+    "SFC64", "MT19937", "SeedSequence", "BitGenerator",
+}
+#: jax.random.* that derive keys rather than consuming them
+_KEY_DERIVERS = {"split", "fold_in", "PRNGKey", "key", "clone",
+                 "key_data", "wrap_key_data"}
+
+
+def _jax_random_attr(call: ast.Call) -> str:
+    parts = call_name(call).split(".")
+    if len(parts) >= 2 and parts[-2] == "random" and parts[0] in (
+        "jax",
+        "jrandom",
+    ):
+        return parts[-1]
+    if parts[0] in ("jrandom", "jax_random") and len(parts) == 2:
+        return parts[-1]
+    return ""
+
+
+def check(model: ModuleModel) -> List[Finding]:
+    findings: List[Finding] = []
+
+    def add(node, msg):
+        f = model.finding(RULE_ID, node, msg)
+        if f:
+            findings.append(f)
+
+    # (a) global numpy stream anywhere in library code
+    for node in ast.walk(model.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        parts = call_name(node).split(".")
+        if (
+            len(parts) >= 3
+            and parts[0] in _NP_ROOTS
+            and parts[1] == "random"
+            and parts[2] not in _NP_RANDOM_ALLOWED
+        ):
+            add(
+                node,
+                f"direct `np.random.{parts[2]}` uses the "
+                "interpreter-global stream — thread a seeded "
+                "`np.random.default_rng` generator instead "
+                "(bit-exact generator contract)",
+            )
+
+    # (b) per-function key double-consumption: a block-structured
+    # linear scan. Branches fork the consumption state (an if/else
+    # where each arm consumes the key once is legal); loops scan
+    # their body once with a forked state.
+    def scan_calls(stmt, consumed):
+        for node in ast.walk(stmt):
+            if not isinstance(node, ast.Call):
+                continue
+            attr = _jax_random_attr(node)
+            if not attr or attr in _KEY_DERIVERS or not node.args:
+                continue
+            # the key is a sampler's FIRST positional argument
+            key = expr_key(node.args[0])
+            if key is None:
+                continue
+            if key in consumed:
+                add(
+                    node,
+                    f"PRNG key `{key}` consumed by a second "
+                    f"sampler (`jax.random.{attr}`) without an "
+                    "interleaving split/fold_in — correlated "
+                    "streams break the split-order parity contract",
+                )
+            else:
+                consumed[key] = node
+
+    def pop_stores(stmt, consumed):
+        for node in ast.walk(stmt):
+            if isinstance(
+                node, (ast.Name, ast.Attribute)
+            ) and isinstance(
+                getattr(node, "ctx", None), (ast.Store, ast.Del)
+            ):
+                key = expr_key(node)
+                if key:
+                    consumed.pop(key, None)
+
+    def scan_block(stmts, consumed):
+        for stmt in stmts:
+            if isinstance(
+                stmt,
+                (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef),
+            ):
+                continue  # scanned as its own function
+            if isinstance(stmt, ast.If):
+                for branch in (stmt.body, stmt.orelse):
+                    scan_block(branch, dict(consumed))
+                pop_stores(stmt, consumed)
+            elif isinstance(
+                stmt, (ast.For, ast.AsyncFor, ast.While)
+            ):
+                scan_block(stmt.body, dict(consumed))
+                scan_block(stmt.orelse, dict(consumed))
+                pop_stores(stmt, consumed)
+            elif isinstance(stmt, ast.Try):
+                scan_block(stmt.body, dict(consumed))
+                for h in stmt.handlers:
+                    scan_block(h.body, dict(consumed))
+                scan_block(stmt.orelse, dict(consumed))
+                scan_block(stmt.finalbody, consumed)
+                pop_stores(stmt, consumed)
+            elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+                scan_block(stmt.body, consumed)
+            else:
+                scan_calls(stmt, consumed)
+                pop_stores(stmt, consumed)
+
+    for fi in model.funcs:
+        scan_block(fi.node.body, {})
+    return findings

@@ -1,0 +1,83 @@
+"""RTA006 — thread-ownership violations.
+
+The elastic/serving machinery splits work across long-lived threads
+with strict ownership (docs/resilience.md, docs/serving.md): the
+FleetController's monitor thread OBSERVES and queues, only the driver
+thread's ``reconcile()`` ACTS; the CheckpointStreamer's driver-side
+``offer()`` captures refs while the writer thread does the D2H; the
+serve batcher owns the compiled forward and the rng carry. Functions
+are annotated ``# ray-tpu: thread=<owner>``; a call from a function
+owned by thread A to one owned by thread B is a cross-thread call the
+locking was not designed for.
+
+Resolution is same-module: direct ``name(...)`` calls to functions
+visible in the caller's scope chain and ``self.method(...)`` calls
+within the same class. Unannotated functions are never flagged —
+annotate both ends to give the rule teeth on a new surface.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional
+
+from ray_tpu.analysis.engine import Finding, FuncInfo, ModuleModel
+from ray_tpu.analysis.rules._common import class_methods, own_nodes
+
+RULE_ID = "RTA006"
+
+
+def _resolve(
+    model: ModuleModel, caller: FuncInfo, call: ast.Call
+) -> Optional[FuncInfo]:
+    func = call.func
+    if (
+        isinstance(func, ast.Attribute)
+        and isinstance(func.value, ast.Name)
+        and func.value.id == "self"
+    ):
+        cls = model.enclosing_class_name(caller.node)
+        return class_methods(model, cls).get(func.attr)
+    if isinstance(func, ast.Name):
+        # nearest visible def by simple name: walk the caller's scope
+        # chain outward, ending at module level
+        scopes: List[Optional[FuncInfo]] = []
+        probe = caller.parent
+        while probe is not None:
+            scopes.append(probe)
+            probe = probe.parent
+        scopes.append(None)
+        for scope in scopes:
+            for fi in model.funcs:
+                if fi.parent is scope and fi.node.name == func.id:
+                    return fi
+    return None
+
+
+def check(model: ModuleModel) -> List[Finding]:
+    findings: List[Finding] = []
+    for fi in model.funcs:
+        if fi.thread is None:
+            continue
+        for node in own_nodes(fi):
+            if not isinstance(node, ast.Call):
+                continue
+            callee = _resolve(model, fi, node)
+            if (
+                callee is None
+                or callee.thread is None
+                or callee.thread == fi.thread
+            ):
+                continue
+            f = model.finding(
+                RULE_ID,
+                node,
+                f"`{fi.qualname}` (thread={fi.thread}) calls "
+                f"`{callee.qualname}` (thread={callee.thread}) — "
+                "cross-thread call into a surface its owner thread "
+                "was not designed to share; queue a request or move "
+                "the work to the owning thread",
+            )
+            if f:
+                findings.append(f)
+    return findings

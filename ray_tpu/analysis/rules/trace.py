@@ -1,0 +1,214 @@
+"""RTA002 — trace hazards in device contexts.
+
+A device-context function's body executes at TRACE time: its array
+arguments are tracers, so host numpy calls, ``.item()`` /
+``.tolist()``, ``bool()/float()/int()`` coercions, and blocking
+device syncs either crash (ConcretizationTypeError) or silently bake
+a stale host value into the compiled program. The flip side of the
+same contract: host call sites must not feed bare Python scalars to
+cached programs — a weak-typed scalar changes the lowered signature
+and retraces (the zero-recompile contract; callers wrap scalars as
+``np.int32(n)`` / ``np.float64(beta)``).
+
+Static-shape helpers (``np.prod`` over a shape tuple) are legitimate
+trace-time host work — suppress with ``# ray-tpu: allow[RTA002]`` and
+a reason where used deliberately.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Tuple
+
+from ray_tpu.analysis.engine import Finding, ModuleModel
+from ray_tpu.analysis.rules._common import call_name, own_nodes
+
+RULE_ID = "RTA002"
+
+_NP_ROOTS = {"np", "numpy", "np_", "onp"}
+# dtype constructors / metadata are concrete trace-time constants
+_NP_ALLOWED = {
+    "float16", "float32", "float64", "int8", "int16", "int32",
+    "int64", "uint8", "uint16", "uint32", "uint64", "bool_",
+    "dtype", "ndim", "shape",
+}
+_SYNC_METHODS = {"item", "tolist", "numpy", "block_until_ready"}
+_COERCIONS = {"bool", "float", "int"}
+
+# -- trace-time-static expressions ------------------------------------
+# Shapes, dtypes, and config dicts are CONCRETE during tracing:
+# `int(np.prod(v.shape[1:]))` or `float(cfg.get("v_min"))` inside a
+# device body is host math on static values, not a tracer hazard.
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "nbytes"}
+_CONFIG_NAMES = {"cfg", "config", "hps", "self"}
+_STATIC_CALLS = {
+    "get", "len", "prod", "int", "float", "bool", "min", "max",
+    "bit_length", "range",
+}
+
+
+def _is_trace_static(node: ast.AST) -> bool:
+    if node is None or isinstance(node, ast.Constant):
+        return True
+    if isinstance(node, ast.Attribute):
+        if node.attr in _STATIC_ATTRS or node.attr == "config":
+            return True
+        # self.* / cfg.* reads in a traced body are static Python
+        # state (traced arrays arrive through the arguments)
+        return (
+            isinstance(node.value, ast.Name)
+            and node.value.id in _CONFIG_NAMES
+        )
+    if isinstance(node, ast.Name):
+        return node.id in _CONFIG_NAMES
+    if isinstance(node, ast.Call):
+        last = call_name(node).split(".")[-1]
+        if last not in _STATIC_CALLS:
+            return False
+        base_ok = True
+        if isinstance(node.func, ast.Attribute):
+            base_ok = _is_trace_static(node.func.value) or (
+                isinstance(node.func.value, ast.Name)
+                and node.func.value.id in _NP_ROOTS | _CONFIG_NAMES
+            )
+        return base_ok and all(
+            _is_trace_static(a) for a in node.args
+        )
+    if isinstance(node, ast.BinOp):
+        return _is_trace_static(node.left) and _is_trace_static(
+            node.right
+        )
+    if isinstance(node, ast.UnaryOp):
+        return _is_trace_static(node.operand)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return all(_is_trace_static(e) for e in node.elts)
+    if isinstance(node, ast.Subscript):
+        return _is_trace_static(node.value)
+    if isinstance(node, ast.Slice):
+        return all(
+            _is_trace_static(p)
+            for p in (node.lower, node.upper, node.step)
+        )
+    if isinstance(node, ast.Compare):
+        return _is_trace_static(node.left) and all(
+            _is_trace_static(c) for c in node.comparators
+        )
+    if isinstance(node, ast.IfExp):
+        return all(
+            _is_trace_static(p)
+            for p in (node.test, node.body, node.orelse)
+        )
+    return False
+
+
+def _np_call(call: ast.Call) -> Optional[str]:
+    parts = call_name(call).split(".")
+    if len(parts) >= 2 and parts[0] in _NP_ROOTS:
+        return parts[-1]
+    return None
+
+
+def _compiled_locals(fi) -> Dict[str, str]:
+    """Local names bound to compiled programs within this function:
+    assigned from ``sharded_jit(...)`` / ``*.sharded_jit(...)`` /
+    ``self._build_*(...)`` / ``build_superstep_fn(...)``."""
+    out: Dict[str, str] = {}
+    for node in own_nodes(fi):
+        if not isinstance(node, ast.Assign) or not isinstance(
+            node.value, ast.Call
+        ):
+            continue
+        last = call_name(node.value).split(".")[-1]
+        if last == "sharded_jit" or (
+            last.startswith("_build_") and last.endswith("_fn")
+        ) or last in ("build_superstep_fn", "build_stack_fn"):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    out[tgt.id] = last
+    return out
+
+
+def check(model: ModuleModel) -> List[Finding]:
+    findings: List[Finding] = []
+
+    def add(node, msg):
+        f = model.finding(RULE_ID, node, msg)
+        if f:
+            findings.append(f)
+
+    for fi in model.funcs:
+        if fi.device:
+            for node in own_nodes(fi):
+                if not isinstance(node, ast.Call):
+                    continue
+                np_attr = _np_call(node)
+                if (
+                    np_attr is not None
+                    and np_attr not in _NP_ALLOWED
+                    and not all(
+                        _is_trace_static(a) for a in node.args
+                    )
+                ):
+                    add(
+                        node,
+                        f"host `np.{np_attr}` call inside a device "
+                        "context — numpy cannot consume tracers; use "
+                        "jnp or hoist to the host caller",
+                    )
+                    continue
+                if (
+                    isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _SYNC_METHODS
+                ):
+                    add(
+                        node,
+                        f"`.{node.func.attr}()` inside a device "
+                        "context forces a concrete value mid-trace",
+                    )
+                    continue
+                name = call_name(node)
+                if name.split(".")[-1] == "device_get":
+                    add(
+                        node,
+                        "`jax.device_get` inside a device context — "
+                        "D2H mid-trace is a concretization error",
+                    )
+                    continue
+                if (
+                    isinstance(node.func, ast.Name)
+                    and node.func.id in _COERCIONS
+                    and len(node.args) == 1
+                    and not _is_trace_static(node.args[0])
+                ):
+                    add(
+                        node,
+                        f"`{node.func.id}(...)` coercion inside a "
+                        "device context concretizes a traced value "
+                        "(Python-value branching retraces per value)",
+                    )
+        else:
+            # host side of the contract: scalar feeds to cached
+            # programs retrace per dtype/weak-type signature
+            compiled = _compiled_locals(fi)
+            if not compiled:
+                continue
+            for node in own_nodes(fi):
+                if (
+                    isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Name)
+                    and node.func.id in compiled
+                ):
+                    for arg in node.args:
+                        if isinstance(arg, ast.Constant) and isinstance(
+                            arg.value, (int, float)
+                        ) and not isinstance(arg.value, bool):
+                            add(
+                                arg,
+                                f"bare Python scalar {arg.value!r} fed "
+                                f"to cached program `{node.func.id}` — "
+                                "wrap with an explicit np dtype "
+                                "(np.int32/np.float64) so the traced "
+                                "signature is stable (zero-recompile "
+                                "contract)",
+                            )
+    return findings

@@ -122,6 +122,7 @@ class FleetController:
 
     # -- monitor thread: observe only ------------------------------------
 
+    # ray-tpu: thread=monitor
     def _run(self) -> None:
         while not self._stop.wait(self.update_interval_s):
             try:
@@ -129,6 +130,7 @@ class FleetController:
             except Exception:
                 pass
 
+    # ray-tpu: thread=monitor
     def update(self) -> None:
         """One observation pass (monitor thread, or called directly by
         tests): poll preemption notices, the starvation gauges, and
@@ -137,6 +139,7 @@ class FleetController:
         self._poll_starvation()
         self._poll_idle()
 
+    # ray-tpu: thread=monitor
     def _poll_notices(self) -> None:
         """Non-blocking notice probes: keep one outstanding
         ``preemption_notice`` call per active worker, harvest whatever
@@ -181,6 +184,7 @@ class FleetController:
                     "fleet:preemption_notice", grace_s=float(grace)
                 )
 
+    # ray-tpu: thread=monitor
     def _poll_starvation(self) -> None:
         """Scale-up demand off the PR-3 queue gauges: when every
         sampler-side queue the run exports sits at depth 0 for
@@ -208,6 +212,7 @@ class FleetController:
             ):
                 self._pending_scale += self.scale_up_step
 
+    # ray-tpu: thread=monitor
     def _poll_idle(self) -> None:
         """Idle-reap candidates: a worker with zero in-flight requests
         across every registered manager for ``idle_timeout_s``. With
@@ -237,6 +242,7 @@ class FleetController:
 
     # -- driver thread: act ----------------------------------------------
 
+    # ray-tpu: thread=driver
     def reconcile(self) -> None:
         """Apply queued decisions (driver thread, between rounds):
         drain noticed workers, reap idle ones down to ``min_workers``,
@@ -291,6 +297,7 @@ class FleetController:
                     self._retire(w, preempted=False)
         self._set_gauges()
 
+    # ray-tpu: thread=driver
     def _scale_up(self, k: int) -> None:
         with self._lock:
             draining = len(self._draining)
@@ -310,6 +317,7 @@ class FleetController:
             )
             self.algo.on_fleet_change(added=new, removed=[])
 
+    # ray-tpu: thread=driver
     def _retire(self, w, *, preempted: bool) -> bool:
         """The drain protocol: stop submissions, collect the worker's
         final state inside the grace budget, keep its completed
@@ -372,6 +380,7 @@ class FleetController:
 
     # -- reporting -------------------------------------------------------
 
+    # ray-tpu: thread=driver
     def _set_gauges(self) -> None:
         with self._lock:
             draining = len(self._draining)

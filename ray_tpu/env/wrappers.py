@@ -162,10 +162,16 @@ class NoopResetEnv(gym.Wrapper):
     def __init__(self, env, noop_max: int = 30):
         super().__init__(env)
         self.noop_max = noop_max
+        # own generator (RTA004): the noop count must not ride the
+        # interpreter-global stream any import can perturb; a seed
+        # passed through reset(seed=...) pins it per worker
+        self._noop_rng = np.random.default_rng()
 
     def reset(self, **kwargs):
+        if kwargs.get("seed") is not None:
+            self._noop_rng = np.random.default_rng(kwargs["seed"])
         obs, info = self.env.reset(**kwargs)
-        noops = np.random.randint(1, self.noop_max + 1)
+        noops = int(self._noop_rng.integers(1, self.noop_max + 1))
         for _ in range(noops):
             obs, _, term, trunc, info = self.env.step(0)
             if term or trunc:

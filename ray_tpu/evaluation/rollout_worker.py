@@ -66,6 +66,11 @@ class RolloutWorker:
         )
         if seed is not None:
             seed = seed + worker_index * 1000
+            # the one sanctioned global-stream touch: third-party envs
+            # (gym classics) draw from np.random at reset/step, and
+            # per-worker reproducibility requires seeding that stream
+            # here; library code itself threads explicit generators
+            # ray-tpu: allow[RTA004] global seed side door for third-party envs
             np.random.seed(seed)
 
         # ---- build env ----

@@ -171,6 +171,7 @@ class LearnerThread(threading.Thread):
         }
         return tree, fixed
 
+    # ray-tpu: thread=learner
     def run(self) -> None:
         try:
             while not self.stopped:
@@ -191,6 +192,7 @@ class LearnerThread(threading.Thread):
             if self._feeder is not None:
                 self._feeder.stop()
 
+    # ray-tpu: thread=learner
     def _pump(self, block: bool) -> bool:
         """Move one host batch inqueue → feeder. Returns True if moved."""
         batch = self.inqueue.get(timeout=0.5) if block else (
@@ -206,6 +208,9 @@ class LearnerThread(threading.Thread):
         self._in_flight += 1
         return True
 
+    # the counted drain helper: deferred stats materialize here,
+    # STATS_LAG programs behind the dispatch (a free fetch)
+    # ray-tpu: thread=learner drain-ok
     def _drain_lazy(self, all_of_them: bool = False) -> None:
         """Materialize deferred stats older than STATS_LAG (their
         programs have finished; the fetch is a cheap copy-out)."""
@@ -221,6 +226,7 @@ class LearnerThread(threading.Thread):
             except queue.Full:
                 pass
 
+    # ray-tpu: thread=learner
     def _maybe_publish(self, steps: int = 1) -> None:
         if not self._publish_every:
             return
@@ -246,6 +252,7 @@ class LearnerThread(threading.Thread):
         the parked exception is in :attr:`error`."""
         return self.error is None and self.is_alive()
 
+    # ray-tpu: thread=learner hot-path
     def step(self) -> None:
         if self._fault_injector is not None:
             self._fault_injector.on_learner_thread_step()
@@ -302,6 +309,7 @@ class LearnerThread(threading.Thread):
         except queue.Full:
             pass
 
+    # ray-tpu: thread=learner hot-path
     def _step_superstep(self, dev, bsize, env_steps, t0) -> bool:
         """Fuse up to ``_superstep_k`` queued device batches into one
         compiled K-update dispatch (one stats drain for the chain).
@@ -372,6 +380,7 @@ class LearnerThread(threading.Thread):
         self._drain_lazy()
         return True
 
+    # ray-tpu: thread=learner
     def _step_sync(self) -> None:
         t0 = time.perf_counter()
         t_wait0 = time.time()

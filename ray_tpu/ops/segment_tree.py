@@ -107,6 +107,7 @@ class MinSegmentTree(SegmentTree):
 # cast the host path applies anyway (parity-suite asserted).
 
 
+# ray-tpu: device-fn f64
 def reduce_range_body(value, size, op, neutral, capacity: int):
     """In-program counterpart of ``SegmentTree.reduce(0, size)`` with a
     FIXED trip count (one executable serves every ``size``): the same
@@ -139,6 +140,7 @@ def reduce_range_body(value, size, op, neutral, capacity: int):
     return r
 
 
+# ray-tpu: device-fn f64
 def find_prefixsum_body(value, prefixsum, capacity: int):
     """In-program ``SumSegmentTree.find_prefixsum_idx``: the lockstep
     root→leaf descent, one comparison + exact f64 subtraction per
@@ -156,6 +158,7 @@ def find_prefixsum_body(value, prefixsum, capacity: int):
     return idx - capacity
 
 
+# ray-tpu: device-fn f64
 def draw_body(sum_value, min_value, rand, size, beta, capacity: int):
     """The whole stratified proportional draw of
     ``_PrioritySampling._draw_prioritized`` as one in-program body:
@@ -189,6 +192,7 @@ def draw_body(sum_value, min_value, rand, size, beta, capacity: int):
     return idx, weights, p_sample
 
 
+# ray-tpu: device-fn f64
 def _rebuild_body(arr, op, capacity: int):
     """Recompute every internal node bottom-up. Bit-identical to the
     host's incremental ancestor updates: each node is always exactly
@@ -249,6 +253,7 @@ class DeviceSumTree:
 
         cap = self.capacity
 
+        # ray-tpu: f64
         def fn(sum_t, min_t, idx, vals, mask):
             for i in range(u):
                 flat = jnp.where(mask[i], cap + idx[i], 0)
@@ -342,6 +347,7 @@ class DeviceSumTree:
 
             cap = self.capacity
 
+            # ray-tpu: f64
             def prog(sum_t, min_t, r, size_, beta_):
                 idx, weights, _ = draw_body(
                     sum_t, min_t, r, size_, beta_, cap

@@ -1015,6 +1015,7 @@ class JaxPolicy(Policy):
         moved the params (SAC drops its device-flattened actor
         snapshots here)."""
 
+    # ray-tpu: hot-path
     def learn_superstep(
         self,
         k: int,
@@ -1209,6 +1210,7 @@ class JaxPolicy(Policy):
             # (and the PER priority matrix) come back in a single
             # device→host readback
             if pri is not None:
+                # ray-tpu: allow[RTA005] the ONE counted drain for the chain
                 stats, pri = jax.device_get((stats, pri))
                 pri = np.abs(np.asarray(pri)[:k])
                 # the |td| pull that feeds the host alpha-power — the
@@ -1217,6 +1219,7 @@ class JaxPolicy(Policy):
                     "replay_priorities", pri.nbytes
                 )
             else:
+                # ray-tpu: allow[RTA005] the ONE counted drain for the chain
                 stats = jax.device_get(stats)
         self.num_grad_updates += k * self._updates_per_learn_call(
             batch_size
@@ -1248,6 +1251,7 @@ class JaxPolicy(Policy):
         ]
         return infos, pri, skipped
 
+    # ray-tpu: hot-path
     def learn_rollout_superstep(
         self,
         k: int,
@@ -1365,6 +1369,7 @@ class JaxPolicy(Policy):
                 getattr(fn, "traces", 0) - compiles_before,
             )
             # ONE drain: stacked stats + episode metrics together
+            # ray-tpu: allow[RTA005] the ONE counted drain for the chain
             stats, metrics = jax.device_get((stats, metrics))
         self.num_grad_updates += k * self._updates_per_learn_call(
             batch_size
@@ -1620,6 +1625,7 @@ class JaxPolicy(Policy):
         out["cur_lr"] = self.coeff_values["lr"]
         return out
 
+    # ray-tpu: drain-ok
     def flush_deferred_stats(self) -> Dict[str, float]:
         """Fetch (and clear) the stats handle a ``deferred_stats``
         policy is still holding — call after the last learn step when

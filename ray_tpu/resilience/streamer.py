@@ -75,6 +75,9 @@ class CheckpointStreamer:
 
     # -- driver-thread side ----------------------------------------------
 
+    # capture is ref-only on the driver thread: the D2H pull lives on
+    # the writer thread (RTA005 keeps it that way)
+    # ray-tpu: thread=driver hot-path
     def offer(self) -> None:
         """End-of-superstep hook (driver thread, O(refs)): count the
         superstep and, every ``every`` supersteps, capture a reference
@@ -92,6 +95,7 @@ class CheckpointStreamer:
             self._idle.clear()
         self._wake.set()
 
+    # ray-tpu: thread=driver hot-path
     def _capture(self) -> Dict[str, Any]:
         """Immutable-pytree snapshot: device refs for the heavy state,
         copies for the small host state. Runs on the driver thread so
@@ -143,6 +147,7 @@ class CheckpointStreamer:
 
     # -- writer thread ----------------------------------------------------
 
+    # ray-tpu: thread=streamer
     def _run(self) -> None:
         try:
             while True:
@@ -157,6 +162,7 @@ class CheckpointStreamer:
             self.error = e
             self._idle.set()
 
+    # ray-tpu: thread=streamer
     def _write_pending(self) -> None:
         with self._slot_lock:
             snap, self._slot = self._slot, None
@@ -212,6 +218,7 @@ class CheckpointStreamer:
             if self._slot is None:
                 self._idle.set()
 
+    # ray-tpu: thread=streamer
     def _prune(self) -> None:
         try:
             snaps = sorted(

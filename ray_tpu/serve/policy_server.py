@@ -307,6 +307,7 @@ class BatchedPolicyServer:
         with self._cv:
             self._cv.notify_all()
 
+    # ray-tpu: thread=batcher
     def _maybe_apply_params(self) -> None:
         """Batcher-thread only: adopt the newest staged state, if any.
         Runs strictly between forwards, which is what makes the swap
@@ -415,6 +416,7 @@ class BatchedPolicyServer:
             ),
         )
 
+    # ray-tpu: thread=batcher hot-path
     def forward_padded(
         self, obs_rows: np.ndarray, explore: Optional[bool] = None
     ):
@@ -492,6 +494,7 @@ class BatchedPolicyServer:
 
     # -- batcher thread --------------------------------------------------
 
+    # ray-tpu: thread=batcher
     def _run(self) -> None:
         try:
             while True:
@@ -519,10 +522,12 @@ class BatchedPolicyServer:
             for req in pending:
                 req.future._reject(e)
 
+    # ray-tpu: thread=batcher
     def _swap_pending(self) -> bool:
         ver, _ = self._swap_host.current("params")
         return ver > self._applied_swap
 
+    # ray-tpu: thread=batcher
     def _collect_batch(self) -> List[_Request]:
         """Drain up to ``max_batch_size`` same-explore requests, FIFO;
         a partial batch flushes ``batch_wait_timeout_s`` after its
@@ -555,6 +560,7 @@ class BatchedPolicyServer:
             )
             return batch
 
+    # ray-tpu: thread=batcher hot-path
     def _process_batch(self, batch: List[_Request]) -> None:
         t0 = time.perf_counter()
         n = len(batch)

@@ -1,0 +1,55 @@
+"""RTA001 fixtures: use-after-donate (true + false positives).
+
+Never imported — parsed by the analyzer only.
+"""
+
+import jax
+import numpy as np
+
+from ray_tpu.sharding.compile import sharded_jit
+
+
+def _body(params, opt_state, batch):
+    return params, opt_state, {"loss": batch.sum()}
+
+
+def tp_read_after_donate(params, opt_state, batch):
+    # TRUE POSITIVE: opt_state donated at position 1, then read before
+    # any reassignment — the buffer is aliased to the outputs
+    fn = sharded_jit(_body, donate_argnums=(1,), label="fx")
+    out = fn(params, opt_state, batch)
+    leaves = jax.tree_util.tree_leaves(opt_state)  # BAD: donated read
+    return out, leaves
+
+
+def tn_reassigned_same_statement(params, opt_state, batch):
+    # NEGATIVE: the donating call's own statement rebinds the donated
+    # tree (the repo's standard unpack shape)
+    fn = sharded_jit(_body, donate_argnums=(1,), label="fx")
+    params, opt_state, stats = fn(params, opt_state, batch)
+    return np.asarray(list(stats)), opt_state
+
+
+def tn_reassigned_before_read(params, opt_state, batch):
+    # NEGATIVE: rebind first, read after
+    fn = sharded_jit(_body, donate_argnums=(1,), label="fx")
+    out = fn(params, opt_state, batch)
+    opt_state = out[1]
+    return jax.tree_util.tree_leaves(opt_state)
+
+
+class DonatingHolder:
+    """Attribute-held donating program: the repo's self._fn pattern."""
+
+    def __init__(self):
+        self._step = sharded_jit(_body, donate_argnums=(1,), label="fx")
+
+    def tp_attr_read_after_donate(self, params, batch):
+        out = self._step(params, self.opt, batch)
+        stale = self.opt  # BAD: donated attribute read back
+        self.params, self.opt, _ = out
+        return stale
+
+    def tn_attr_unpack(self, params, batch):
+        self.params, self.opt, _ = self._step(params, self.opt, batch)
+        return self.params

@@ -1,0 +1,48 @@
+"""RTA002 fixtures: trace hazards in device contexts + scalar feeds."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.sharding.compile import sharded_jit
+
+
+def make_tp_program(cfg):
+    # ray-tpu: device-fn
+    def body(x):
+        mean = np.mean(x)  # BAD: host numpy on a tracer
+        scale = x.item()  # BAD: concretizes mid-trace
+        if bool(x.sum() > 0):  # BAD: Python-value branching
+            mean = mean + scale
+        return mean
+
+    return sharded_jit(body, label="fx")
+
+
+def make_tn_program(cfg):
+    # ray-tpu: device-fn
+    def body(x):
+        # static metadata + config reads are concrete at trace time
+        rows = int(np.prod(x.shape[1:]))
+        gamma = float(cfg.get("gamma", 0.99))
+        if cfg.get("normalize"):
+            x = x / jnp.float32(rows)
+        return jnp.mean(x) * gamma
+
+    return sharded_jit(body, label="fx")
+
+
+def tp_scalar_feed(x):
+    fn = sharded_jit(lambda a, b: a * b, label="fx")
+    return fn(x, 0.5)  # BAD: weak-typed Python scalar retraces
+
+
+def tn_wrapped_scalar_feed(x):
+    fn = sharded_jit(lambda a, b: a * b, label="fx")
+    return fn(x, np.float32(0.5))
+
+
+def tn_host_numpy(rows):
+    # NEGATIVE: ordinary host code uses numpy freely
+    stacked = np.stack(rows)
+    return float(np.mean(stacked))

@@ -1,0 +1,47 @@
+"""RTA003 fixtures: weak-type promotion in f64 scopes.
+
+``tp_pr11_priority_body`` reconstructs the PR-11 Ape-X bug: the
+device replay shard computed its initial priorities from the shared
+TD errors as ``|td| + 1e-6`` INSIDE the f64 tree program. The bare
+literal is weak-typed — traced under the f64 scope it canonicalized
+differently from the host plane's ``np.float64`` arithmetic, and the
+max-priority watermark diverged bitwise between the two planes.
+"""
+
+import jax.numpy as jnp
+
+from ray_tpu.sharding.compile import f64_scope, sharded_jit
+
+
+# ray-tpu: device-fn f64
+def tp_pr11_priority_body(sum_tree, idx, td):
+    # BAD: the PR-11 class — bare float literal arithmetic on the
+    # f64 TD errors feeding the priority leaves
+    powered = jnp.abs(td) + 1e-6
+    floor = jnp.maximum(powered, 1e-6)  # BAD: literal via jnp call
+    return sum_tree.at[idx].set(floor)
+
+
+# ray-tpu: device-fn f64
+def tn_explicit_dtype_body(sum_tree, idx, td):
+    # NEGATIVE: explicit-dtype literals round identically on both
+    # planes
+    eps = jnp.float64(1e-6)
+    powered = jnp.abs(td) + eps
+    return sum_tree.at[idx].set(jnp.maximum(powered, eps))
+
+
+# ray-tpu: device-fn
+def tn_f32_learner_body(params, batch):
+    # NEGATIVE: an ordinary f32 device body — weak literals are
+    # exactly what weak typing is for outside the f64 contract
+    loss = 0.5 * (batch["q"] - batch["target"]) ** 2
+    return loss.mean() * 0.25
+
+
+def tp_f64_with_block(tree, vals):
+    with f64_scope():
+        # BAD: literal arithmetic lexically inside the x64 scope
+        return sharded_jit(lambda t, v: t, label="fx")(
+            tree, vals * 2.0
+        )

@@ -1,0 +1,42 @@
+"""RTA004 fixtures: RNG discipline."""
+
+import jax
+import numpy as np
+
+
+def tp_global_stream(n):
+    np.random.seed(0)  # BAD: interpreter-global state
+    return np.random.randint(0, n)  # BAD: global stream draw
+
+
+def tn_generator(n, seed):
+    rng = np.random.default_rng(seed)
+    return rng.integers(0, n)
+
+
+def tp_key_reuse(key, shape):
+    a = jax.random.normal(key, shape)
+    b = jax.random.uniform(key, shape)  # BAD: same key, two sinks
+    return a + b
+
+
+def tn_split_between(key, shape):
+    k1, k2 = jax.random.split(key)
+    a = jax.random.normal(k1, shape)
+    b = jax.random.uniform(k2, shape)
+    return a + b
+
+
+def tn_fold_in_rederive(key, shape, step):
+    a = jax.random.normal(key, shape)
+    key = jax.random.fold_in(key, step)
+    b = jax.random.uniform(key, shape)
+    return a + b
+
+
+def tn_branch_single_consumption(key, shape, explore):
+    # one consumption per path — legal even though two sinks appear
+    if explore:
+        return jax.random.normal(key, shape)
+    else:
+        return jax.random.uniform(key, shape)

@@ -1,0 +1,35 @@
+"""RTA005 fixtures: blocking host sync in hot-path spans."""
+
+import jax
+
+
+# ray-tpu: drain-ok
+def drain_stats(lazy):
+    # the counted drain helper: sanctioned D2H
+    return [jax.device_get(s) for s in lazy]
+
+
+class Learner:
+    # ray-tpu: thread=learner hot-path
+    def tp_step(self, dev):
+        stats = self.fn(dev)
+        host = jax.device_get(stats)  # BAD: per-step blocking drain
+        stats.block_until_ready()  # BAD: serializes the pipeline
+        return host
+
+    # ray-tpu: thread=learner hot-path
+    def tn_step_deferred(self, dev):
+        stats = self.fn(dev)
+        self._lazy.append(stats)
+        drain_stats(self._lazy)  # calling the drain helper is fine
+        return True
+
+    # ray-tpu: thread=learner hot-path
+    def tn_step_counted(self, dev):
+        stats = self.fn(dev)
+        # ray-tpu: allow[RTA005] the one counted drain for this span
+        return jax.device_get(stats)
+
+    def tn_cold_path(self, dev):
+        # NEGATIVE: not a hot span — checkpointing may block freely
+        return jax.device_get(self.fn(dev))

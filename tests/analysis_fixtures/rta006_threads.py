@@ -1,0 +1,40 @@
+"""RTA006 fixtures: thread-ownership violations."""
+
+
+class Controller:
+    # ray-tpu: thread=monitor
+    def tp_observe(self):
+        self.seen += 1
+        self.apply_scale(1)  # BAD: monitor thread ACTS
+
+    # ray-tpu: thread=monitor
+    def tn_observe_and_queue(self):
+        self.seen += 1
+        self.note(self.seen)  # same-thread helper: fine
+        self.pending += 1
+
+    # ray-tpu: thread=monitor
+    def note(self, n):
+        self.last = n
+
+    # ray-tpu: thread=driver
+    def apply_scale(self, k):
+        self.size += k
+
+    # ray-tpu: thread=driver
+    def tn_reconcile(self):
+        self.apply_scale(self.pending)  # driver -> driver: fine
+        self.report()  # unannotated callee: never flagged
+
+    def report(self):
+        return self.size
+
+
+# ray-tpu: thread=writer
+def tp_module_level_writer(payload):
+    flush_driver_state(payload)  # BAD: writer calls driver-owned fn
+
+
+# ray-tpu: thread=driver
+def flush_driver_state(payload):
+    return payload

@@ -102,3 +102,37 @@ def test_base_env_passthrough_identity():
     )
     assert convert_to_base_env(base) is base
     base.stop()
+
+
+def test_noop_reset_rng_is_explicit_and_seeded():
+    """Fixed-seed regression for the RTA004 fix: NoopResetEnv draws
+    its noop count from an OWN generator seeded via reset(seed=...),
+    so the sequence is reproducible and independent of the
+    interpreter-global np.random stream (which it used to ride)."""
+    from ray_tpu.env.wrappers import NoopResetEnv
+
+    class _CountEnv(gym.Env):
+        observation_space = gym.spaces.Box(0.0, 1.0, (2,), np.float32)
+        action_space = gym.spaces.Discrete(2)
+
+        def __init__(self):
+            self.steps = 0
+
+        def reset(self, *, seed=None, options=None):
+            self.steps = 0
+            return np.zeros(2, np.float32), {}
+
+        def step(self, action):
+            self.steps += 1
+            return np.zeros(2, np.float32), 0.0, False, False, {}
+
+    counts = []
+    for global_seed in (0, 12345):
+        np.random.seed(global_seed)  # must not influence the noops
+        env = NoopResetEnv(_CountEnv(), noop_max=30)
+        env.reset(seed=123)
+        first = env.env.steps
+        env.reset()  # unseeded reset continues the SAME stream
+        counts.append((first, env.env.steps))
+    assert counts[0] == counts[1]
+    assert 1 <= counts[0][0] <= 30

@@ -103,3 +103,22 @@ def test_bench_batch_schema_matches_policy():
     assert bsize == 64
     info = policy.learn_on_batch(bench.make_batch(rng, 64))
     assert np.isfinite(info["total_loss"])
+
+
+def test_bench_lint_writes_report(tmp_path, monkeypatch):
+    """bench.py --lint: the static-analysis pass reports scan wall
+    time + finding counts and writes the e2e report (the tier-1 gate
+    in tests/test_static_analysis.py asserts the zero-findings half;
+    this asserts the bench wiring)."""
+    import json
+    import os
+
+    monkeypatch.chdir(os.path.dirname(os.path.dirname(__file__)))
+    out = tmp_path / "static_analysis.json"
+    report = bench.bench_lint(out_path=str(out), reps=1)
+    assert report["metric"] == "static_analysis"
+    assert report["ok"] is True
+    assert report["files"] > 180
+    assert report["scan_wall_s"] > 0
+    on_disk = json.loads(out.read_text())
+    assert on_disk["findings_unbaselined"] == 0

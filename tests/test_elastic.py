@@ -92,6 +92,8 @@ def _filtered_ppo(num_workers):
     )
 
 
+@pytest.mark.slow  # ~11 s on this container; moved out of
+# tier-1 with PR 12 (budget rule: suite at ~892 s vs the 870 s cap)
 def test_joining_worker_gets_weights_and_filters_before_sampling():
     """Satellite: a worker joining mid-run (scale-up / replacement)
     must carry the CURRENT policy weights and observation-filter

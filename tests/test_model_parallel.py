@@ -192,6 +192,10 @@ def test_state_pspecs_suffix_matching():
 # -- learn-path parity -------------------------------------------------
 
 
+@pytest.mark.slow  # ~11 s on this container; moved out of
+# tier-1 with PR 12 (budget rule: suite at ~892 s vs the 870 s cap)
+@pytest.mark.slow  # ~11 s on this container; moved out of
+# tier-1 with PR 12 (budget rule: suite at ~892 s vs the 870 s cap)
 def test_ppo_transformer_mp1_bitwise_vs_replicated():
     rng = np.random.default_rng(0)
     batch = _ppo_batch(rng)
@@ -283,6 +287,10 @@ def test_mp2_learn_matches_replicated_math():
 # -- superstep ---------------------------------------------------------
 
 
+@pytest.mark.slow  # ~14 s on this container; moved out of
+# tier-1 with PR 12 (budget rule: suite at ~892 s vs the 870 s cap)
+@pytest.mark.slow  # ~14 s on this container; moved out of
+# tier-1 with PR 12 (budget rule: suite at ~892 s vs the 870 s cap)
 def test_superstep_partitioned_zero_recompile_and_parity():
     from ray_tpu.policy.jax_policy import JaxPolicy  # noqa: F401
 

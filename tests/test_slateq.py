@@ -2,6 +2,8 @@
 
 import time
 
+import pytest
+
 import numpy as np
 
 from ray_tpu.algorithms.slateq import (
@@ -27,6 +29,8 @@ def test_synthetic_slate_env_contract():
     assert resp[0].sum() in (0.0, 1.0)
 
 
+@pytest.mark.slow  # ~12 s on this container; moved out of
+# tier-1 with PR 12 (budget rule: suite at ~892 s vs the 870 s cap)
 def test_slateq_greedy_slate_beats_random():
     _register()
     algo = (
