@@ -69,6 +69,13 @@ class Algorithm(Trainable):
         self._iteration_marks: collections.deque = collections.deque(
             maxlen=1024
         )
+        # optional jax.profiler capture of the first N iterations
+        # (telemetry(profile_iters=N); no-op fallback where the
+        # profiler is unavailable — and numerics-neutral either way,
+        # bit-parity-tested against telemetry off)
+        tc = config.get("telemetry_config") or {}
+        self._profile_iters = int(tc.get("profile_iters", 0) or 0)
+        self._profiling = False
         # resilience layer (docs/resilience.md): the driver-side chaos
         # injector (None when inert) and the recovery manager step()
         # consults on failure — always present, inert until the config
@@ -271,6 +278,7 @@ class Algorithm(Trainable):
         min_ts = config.get("min_sample_timesteps_per_iteration") or 0
         ts_before = self._counters[NUM_ENV_STEPS_SAMPLED]
         self._recovery.begin_iteration()
+        self._maybe_start_profile()
         # the iteration span is the driver-side root every remote
         # submission in this iteration parents under
         with tracing.start_span(
@@ -314,6 +322,7 @@ class Algorithm(Trainable):
             # iteration's telemetry window)
             self._recovery.maybe_checkpoint()
         t_train_end = time.time()
+        self._maybe_stop_profile()
 
         results["info"] = {
             "learner": train_info,
@@ -360,19 +369,37 @@ class Algorithm(Trainable):
             wall_s=t_train_end - t0,
         )
         runtime_vals = telemetry_lib.metrics.sample_runtime_gauges()
-        if tracing.is_enabled():
-            # span roll-up lags one iteration: worker-side rollout
-            # spans only reach the driver when their fragments are
-            # harvested, so sampling that overlapped iteration k is
-            # fully visible only during k+1. The previous window is
-            # complete; the current one would under-count sample_s
-            # (and report overlap 0) on the pipelined path.
-            prev = getattr(self, "_prev_iter_window", None)
-            window = prev or (t0, t_train_end)
-            rollup = telemetry_lib.iteration_rollup(
-                tracing.get_spans(), *window
+        # compiled-program ledger (docs/observability.md "device
+        # ledger"): per-program FLOPs / HBM bytes / execution counts /
+        # MFU / recompile causes, in every result while the ledger runs
+        if telemetry_lib.device.enabled():
+            results["info"]["device_ledger"] = (
+                telemetry_lib.device.snapshot()
             )
-            rollup["window_iterations_ago"] = 1 if prev else 0
+        if tracing.is_enabled():
+            # roll up THIS iteration's window first: worker rollout
+            # spans ride the result messages and are harvested (→
+            # recorded driver-side) within the same iteration that
+            # consumes their batches, so blanket-deferring the window
+            # an iteration (the old behavior) threw away data it
+            # already had — the synchronous path never needs the lag.
+            # Only when the pipelined path's sampling for this window
+            # is still in flight at the edge (no sample span landed in
+            # it yet) fall back to the previous, now-settled window —
+            # `window_iterations_ago` says which one this is.
+            spans = tracing.get_spans()
+            rollup = telemetry_lib.iteration_rollup(
+                spans, t0, t_train_end
+            )
+            lag = 0
+            prev = getattr(self, "_prev_iter_window", None)
+            if rollup["sample_s"] == 0.0 and prev is not None:
+                settled = telemetry_lib.iteration_rollup(
+                    spans, *prev
+                )
+                if settled["sample_s"] > 0.0:
+                    rollup, lag = settled, 1
+            rollup["window_iterations_ago"] = lag
             # per-iteration H2D bytes by path (docs/data_plane.md):
             # feeder/learn/replay_insert deltas next to the stage busy
             # times — the byte diet of device-resident replay is read
@@ -486,6 +513,40 @@ class Algorithm(Trainable):
                 algorithm=self, result=results
             )
         return results
+
+    def _maybe_start_profile(self) -> None:
+        """Begin the ``telemetry(profile_iters=N)`` capture on the
+        first iteration: ``jax.profiler.start_trace`` into
+        ``<logdir>/jax_profile`` when the profiler is available, a
+        silent no-op otherwise (the capture must never change what the
+        run computes — bit-parity-tested)."""
+        if self._profile_iters <= 0 or self._profiling:
+            return
+        try:
+            import jax.profiler
+
+            path = os.path.join(self.logdir, "jax_profile")
+            os.makedirs(path, exist_ok=True)
+            jax.profiler.start_trace(path)
+            self._profiling = True
+        except Exception:
+            # unavailable/unsupported backend: disarm instead of
+            # retrying every iteration
+            self._profile_iters = 0
+
+    def _maybe_stop_profile(self) -> None:
+        if not self._profiling:
+            return
+        self._profile_iters -= 1
+        if self._profile_iters > 0:
+            return
+        try:
+            import jax.profiler
+
+            jax.profiler.stop_trace()
+        except Exception:
+            pass
+        self._profiling = False
 
     def on_recovery(self, kind: str) -> None:
         """Hook: the RecoveryManager just absorbed a failure of
@@ -802,6 +863,11 @@ class Algorithm(Trainable):
         self.get_policy(policy_id).export_checkpoint(export_dir)
 
     def cleanup(self) -> None:
+        # an interrupted profile_iters capture must not leak an open
+        # jax.profiler session into the next run in this process
+        if getattr(self, "_profiling", False):
+            self._profile_iters = 0
+            self._maybe_stop_profile()
         # the fleet monitor observes the WorkerSet: stop (and join) it
         # before the workers it watches go away
         if getattr(self, "_fleet", None) is not None:

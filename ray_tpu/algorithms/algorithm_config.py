@@ -658,6 +658,9 @@ class AlgorithmConfig:
         *,
         metrics_port: Optional[int] = None,
         trace: Optional[bool] = None,
+        device_ledger=None,
+        profile_iters: Optional[int] = None,
+        peak_flops: Optional[float] = None,
         **kwargs,
     ) -> "AlgorithmConfig":
         """Run-telemetry activation (docs/observability.md).
@@ -669,12 +672,34 @@ class AlgorithmConfig:
         carry trace context, every ``train()`` result gains
         ``info/telemetry`` (stage wall-times + rollout/learn overlap
         fraction), and ``Algorithm.export_timeline(path)`` writes the
-        chrome trace."""
+        chrome trace (with the device program lanes when the ledger
+        runs).
+        ``device_ledger``: the compiled-program ledger
+        (docs/observability.md "device ledger") — per-program FLOPs /
+        HBM bytes / MFU / recompile causes under
+        ``info/device_ledger``. Defaults on whenever telemetry is
+        active; ``"light"`` skips the cost/memory analysis (and its
+        one extra AOT compile per traced signature), ``False``
+        disables.
+        ``profile_iters``: capture ``jax.profiler`` traces of the
+        first N train iterations into ``<logdir>/jax_profile`` (no-op
+        where the profiler is unavailable; numerics untouched —
+        bit-parity-tested).
+        ``peak_flops``: per-device peak FLOPs/s the MFU accounting
+        divides by — overrides the built-in device-kind table (the
+        CPU-container knob; ``peak_hbm_bytes_per_s`` rides along in
+        kwargs)."""
         tc = dict(self.telemetry_config)
         if metrics_port is not None:
             tc["metrics_port"] = int(metrics_port)
         if trace is not None:
             tc["trace"] = bool(trace)
+        if device_ledger is not None:
+            tc["device_ledger"] = device_ledger
+        if profile_iters is not None:
+            tc["profile_iters"] = int(profile_iters)
+        if peak_flops is not None:
+            tc["peak_flops"] = float(peak_flops)
         tc.update(kwargs)
         self.telemetry_config = tc
         return self

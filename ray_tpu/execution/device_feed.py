@@ -65,11 +65,14 @@ class DeviceFeeder:
                     sharding = sharding(host_batch)
                 from ray_tpu.sharding import tree_nbytes
 
-                telemetry_metrics.add_h2d_bytes(
-                    "feeder", tree_nbytes(host_batch)
-                )
+                nbytes = tree_nbytes(host_batch)
+                telemetry_metrics.add_h2d_bytes("feeder", nbytes)
                 t0 = _time.perf_counter()
-                with tracing.start_span("feeder:transfer"):
+                # nbytes on the span: the timeline's transfer lane and
+                # the report CLI read per-transfer payload off it
+                with tracing.start_span(
+                    "feeder:transfer", nbytes=nbytes
+                ):
                     if sharding is not None:
                         dev = jax.device_put(host_batch, sharding)
                     else:

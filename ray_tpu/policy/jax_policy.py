@@ -40,6 +40,7 @@ from ray_tpu.models.catalog import ModelCatalog
 from ray_tpu.ops.framestack import FRAME_IDX as _FRAME_IDX
 from ray_tpu.ops.framestack import FRAMES as _FRAMES
 from ray_tpu.policy.policy import Policy
+from ray_tpu.telemetry import device as device_ledger
 from ray_tpu.telemetry import metrics as telemetry_metrics
 from ray_tpu.util import tracing
 from ray_tpu.utils.metrics import timer_histogram
@@ -1221,6 +1222,10 @@ class JaxPolicy(Policy):
             else:
                 # ray-tpu: allow[RTA005] the ONE counted drain for the chain
                 stats = jax.device_get(stats)
+            # the drain proves the superstep program finished: close
+            # its device-busy interval in the ledger (timestamps only,
+            # no extra sync)
+            device_ledger.drain_point()
         self.num_grad_updates += k * self._updates_per_learn_call(
             batch_size
         )
@@ -1371,6 +1376,9 @@ class JaxPolicy(Policy):
             # ONE drain: stacked stats + episode metrics together
             # ray-tpu: allow[RTA005] the ONE counted drain for the chain
             stats, metrics = jax.device_get((stats, metrics))
+            # drain done → the fused rollout+learn program is finished;
+            # close its ledger interval (timestamps only)
+            device_ledger.drain_point()
         self.num_grad_updates += k * self._updates_per_learn_call(
             batch_size
         )
@@ -1596,6 +1604,9 @@ class JaxPolicy(Policy):
                 # float() conversions each pay a full device round
                 # trip).
                 stats = jax.device_get(stats)
+                # stats landed → the nest finished; close its ledger
+                # interval at this (the one counted) drain
+                device_ledger.drain_point()
         # per-stage timers: a call that traced pays compile; the rest
         # of this call's wall time is the step (device compute + stats
         # fetch). Exposed both as metrics series (utils.metrics) and on
@@ -1634,6 +1645,9 @@ class JaxPolicy(Policy):
         if prev is None:
             return {}
         stats = jax.device_get(prev)
+        # the lagged handle belongs to the most recent dispatch on
+        # this thread — its arrival closes that ledger interval
+        device_ledger.drain_point()
         return {k: float(v) for k, v in stats.items()}
 
     def learn_on_batch(self, samples: SampleBatch) -> Dict[str, Any]:

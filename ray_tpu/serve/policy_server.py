@@ -454,6 +454,11 @@ class BatchedPolicyServer:
             )
         actions = np.asarray(actions)[:n]
         extra = {k: np.asarray(v)[:n] for k, v in extra.items()}
+        # results materialized host-side → the serve program finished;
+        # close its ledger interval (timestamps only, no extra sync)
+        from ray_tpu.telemetry import device as device_ledger
+
+        device_ledger.drain_point()
         return actions, extra
 
     def warmup(self, explore: Optional[bool] = None) -> int:
@@ -602,11 +607,22 @@ class BatchedPolicyServer:
         self.batch_rows_total += n
         self.padded_rows_total += self._bucket_for(n) - n
         telemetry_metrics.observe_serve_batch(self.name, n)
+        # bucket occupancy of the forward that just ran: real rows /
+        # executed rows (the fused path pads to a power-of-two bucket;
+        # the sequential fallback runs exactly its rows)
+        executed = self._bucket_for(n) if self.fused else n
+        telemetry_metrics.set_serve_batch_fill(
+            self.name, n / executed if executed else 0.0
+        )
         for req, value in zip(batch, results):
             lat = t1 - req.t_submit
+            wait = t0 - req.t_submit
             self._lat.append((t1, lat))
-            self._queue_wait.append((t1, t0 - req.t_submit))
+            self._queue_wait.append((t1, wait))
             telemetry_metrics.observe_serve_latency(self.name, lat)
+            telemetry_metrics.observe_serve_queue_wait(
+                self.name, wait
+            )
             req.future._resolve(value, version, lat)
 
     # -- introspection ---------------------------------------------------
@@ -636,6 +652,14 @@ class BatchedPolicyServer:
                 else 0.0
             ),
             "padded_rows_total": self.padded_rows_total,
+            # cumulative bucket occupancy: of every row the fused
+            # forwards executed, the fraction that was real work
+            "batch_fill_fraction": (
+                self.batch_rows_total
+                / (self.batch_rows_total + self.padded_rows_total)
+                if self.batch_rows_total
+                else 0.0
+            ),
             "latency_p50_s": self._pct(lat, 50),
             "latency_p99_s": self._pct(lat, 99),
             "queue_wait_p50_s": self._pct(qw, 50),

@@ -13,6 +13,7 @@ plain jit — so compile stats cover the whole learner plane either way.
 
 from __future__ import annotations
 
+import contextlib
 import threading
 import time
 import weakref
@@ -20,6 +21,7 @@ from typing import Any, Dict, Optional, Sequence, Tuple
 
 import jax
 
+from ray_tpu.telemetry import device as device_ledger
 from ray_tpu.util import tracing
 
 _LOCK = threading.Lock()
@@ -55,11 +57,21 @@ class ShardedFunction:
         self.traces = 0
         self.calls = 0
         self.compile_time_s = 0.0
+        # ledger-visible program identity (telemetry/device.py)
+        self.in_specs = in_specs
+        self.out_specs = out_specs
+        self.donate_argnums = tuple(donate_argnums)
+        self.static_argnames = tuple(static_argnames)
         self._lock = threading.Lock()
+        self._uncounted = threading.local()
 
         def _counted(*args, **kwargs):
-            with self._lock:
-                self.traces += 1
+            # the ledger's ahead-of-time analysis compile re-traces
+            # abstractly; that must not count as a (re)trace of the
+            # execution path
+            if not getattr(self._uncounted, "on", False):
+                with self._lock:
+                    self.traces += 1
             return fn(*args, **kwargs)
 
         kw: Dict[str, Any] = {}
@@ -75,28 +87,54 @@ class ShardedFunction:
         with _LOCK:
             _REGISTRY.add(self)
 
+    @contextlib.contextmanager
+    def uncounted_traces(self):
+        """Scope in which re-traces don't bump ``traces`` (the device
+        ledger's AOT analysis compile — same function, abstract args)."""
+        self._uncounted.on = True
+        try:
+            yield
+        finally:
+            self._uncounted.on = False
+
     def __call__(self, *args, **kwargs):
         before = self.traces
+        t_wall0 = time.time()
         t0 = time.perf_counter()
         if tracing.is_enabled():
             # trace-vs-cached-execute span: "did this step recompile?"
             # shows up as a lane in the chrome trace, and a retrace
-            # after warmup additionally records a recompile event
+            # after warmup additionally records a recompile event —
+            # with the ledger on, carrying the forensics cause (which
+            # abstract leaf's shape/dtype moved)
             with tracing.start_span("jit:" + self.label) as sp:
                 out = self._jitted(*args, **kwargs)
                 traced = self.traces != before
                 sp.set_attribute("traced", traced)
-                if traced and before > 0:
-                    tracing.event(
-                        "jit:recompile", label=self.label
+                if traced:
+                    cause = device_ledger.on_traced(
+                        self, args, kwargs,
+                        time.perf_counter() - t0,
                     )
+                    if before > 0:
+                        ev = {"label": self.label}
+                        if cause:
+                            ev["cause"] = cause
+                        tracing.event("jit:recompile", **ev)
         else:
             out = self._jitted(*args, **kwargs)
+            if self.traces != before:
+                device_ledger.on_traced(
+                    self, args, kwargs, time.perf_counter() - t0
+                )
         dt = time.perf_counter() - t0
         with self._lock:
             self.calls += 1
             if self.traces != before:
                 self.compile_time_s += dt
+        device_ledger.on_call(
+            self, t_wall0, dt, traced=self.traces != before
+        )
         return out
 
     @property
@@ -170,4 +208,8 @@ def compile_stats() -> Dict[str, Any]:
         "calls": sum(s["calls"] for s in per_fn),
         "compile_time_s": sum(s["compile_time_s"] for s in per_fn),
         "per_function": per_fn,
+        # forensics rollup (telemetry/device.py): per-label recompile
+        # causes — the abstract-signature diffs of every retrace seen
+        # while the device ledger ran ({} with the ledger off)
+        "recompile_causes": device_ledger.recompile_causes(),
     }

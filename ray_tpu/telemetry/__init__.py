@@ -17,6 +17,7 @@ the counterpart of ``_private/metrics_agent.py:63``) — into one layer:
   reported under ``info/telemetry`` in every ``train()`` result.
 """
 
+from ray_tpu.telemetry import device  # noqa: F401
 from ray_tpu.telemetry import metrics  # noqa: F401
 from ray_tpu.telemetry.rollup import (  # noqa: F401
     STAGE_PREFIXES,
@@ -35,6 +36,7 @@ from ray_tpu.telemetry.runtime import (  # noqa: F401
 __all__ = [
     "TelemetryRuntime",
     "STAGE_PREFIXES",
+    "device",
     "enabled",
     "init",
     "init_from_config",

@@ -103,6 +103,18 @@ SERVE_BATCH_SIZE = "ray_tpu_serve_batch_size"
 SERVE_REQUESTS_TOTAL = "ray_tpu_serve_requests_total"
 SERVE_LATENCY_SECONDS = "ray_tpu_serve_latency_seconds"
 SERVE_PARAMS_VERSION = "ray_tpu_serve_params_version"
+# serve-plane batch observability (docs/serving.md): occupancy of the
+# executed bucket (1.0 = every padded row was real work) and how long
+# a request waited in the queue before its batch launched
+SERVE_BATCH_FILL_FRACTION = "ray_tpu_serve_batch_fill_fraction"
+SERVE_QUEUE_WAIT_SECONDS = "ray_tpu_serve_queue_wait_seconds"
+# device-plane program ledger (docs/observability.md "device ledger",
+# telemetry/device.py): per compiled program — steady-state execution
+# count, cumulative device-busy seconds closed at the drain points,
+# and the program's per-execution FLOPs from cost_analysis()
+PROGRAM_EXECUTIONS_TOTAL = "ray_tpu_program_executions_total"
+PROGRAM_DEVICE_SECONDS_TOTAL = "ray_tpu_program_device_seconds_total"
+PROGRAM_FLOPS = "ray_tpu_program_flops"
 
 
 def gauge(
@@ -404,6 +416,64 @@ def observe_serve_latency(deployment: str, seconds: float) -> None:
             tag_keys=("deployment",),
         )
     m.observe(float(seconds), {"deployment": deployment})
+
+
+def set_serve_batch_fill(deployment: str, fill: float) -> None:
+    """Occupancy of the bucket the last forward executed: real rows /
+    bucket rows (post-padding). A sustained low fill means the batcher
+    flushes under-full buckets — wasted device work per request."""
+    gauge(
+        SERVE_BATCH_FILL_FRACTION,
+        "real rows / executed bucket rows of the last serve batch",
+        ("deployment",),
+    ).set(float(fill), {"deployment": deployment})
+
+
+def observe_serve_queue_wait(deployment: str, seconds: float) -> None:
+    """Time one request sat in the batch queue before its forward
+    launched — the queue-wait component of the end-to-end latency
+    histogram (and the autoscaler's saturation signal, exact
+    percentiles in the server's stats())."""
+    m = get_metric(SERVE_QUEUE_WAIT_SECONDS)
+    if not isinstance(m, Histogram):
+        m = Histogram(
+            SERVE_QUEUE_WAIT_SECONDS,
+            "policy-server request queue-wait seconds",
+            tag_keys=("deployment",),
+        )
+    m.observe(float(seconds), {"deployment": deployment})
+
+
+def inc_program_execution(program: str, n: int = 1) -> None:
+    """One steady-state execution of a compiled device program
+    (traced/compile calls excluded — telemetry/device.py)."""
+    counter(
+        PROGRAM_EXECUTIONS_TOTAL,
+        "compiled-program executions by program label",
+        ("program",),
+    ).inc(float(n), {"program": program})
+
+
+def add_program_device_seconds(program: str, seconds: float) -> None:
+    """Device-busy wall seconds accrued by one program's execution
+    interval (dispatch start → drain point)."""
+    if seconds <= 0:
+        return
+    counter(
+        PROGRAM_DEVICE_SECONDS_TOTAL,
+        "cumulative device-busy seconds by program label",
+        ("program",),
+    ).inc(float(seconds), {"program": program})
+
+
+def set_program_flops(program: str, flops: float) -> None:
+    """Per-execution FLOPs of a compiled program (XLA
+    ``cost_analysis()``, captured once per traced signature)."""
+    gauge(
+        PROGRAM_FLOPS,
+        "per-execution FLOPs of a compiled program (cost_analysis)",
+        ("program",),
+    ).set(float(flops), {"program": program})
 
 
 def set_serve_params_version(deployment: str, version: int) -> None:

@@ -25,7 +25,14 @@ STAGE_PREFIXES: Dict[str, Tuple[str, ...]] = {
     "sample": ("rollout:", "sampler:", "sample:round"),
     "assemble": ("prefetch:assemble", "prefetch:deliver"),
     "transfer": ("feeder:transfer", "learn:transfer"),
-    "learn": ("learn:nest",),
+    # learn:nest = the per-update SGD nest; learn:superstep = the
+    # fused K-updates-per-dispatch program that replaces it on the
+    # superstep path (without it, superstep runs reported learn_s 0)
+    "learn": ("learn:nest", "learn:superstep"),
+    # compiled-program execution intervals on the synthetic device
+    # lanes (telemetry/device.py) — busy time of the device plane
+    # itself, next to the host stages that feed it
+    "device": ("device:",),
     # time lost to the resilience layer: fleet probe+recreate,
     # checkpoint restore, periodic checkpoint writes (recovery:* spans)
     "recovery": ("recovery:",),

@@ -32,6 +32,7 @@ class TelemetryRuntime:
         metrics_port: Optional[int] = None,
         trace: bool = False,
         metrics_host: str = "127.0.0.1",
+        device_ledger: Any = True,
     ):
         self.trace = bool(trace)
         self.metrics_server = None
@@ -47,6 +48,17 @@ class TelemetryRuntime:
             from ray_tpu.util import tracing
 
             tracing.enable()
+        # compiled-program ledger (telemetry/device.py): on whenever
+        # the runtime is — "light" keeps counters/forensics but skips
+        # the cost/memory analysis (its one extra AOT compile per
+        # traced signature); False leaves the dispatch path untouched
+        self.device_ledger = device_ledger
+        if device_ledger:
+            from ray_tpu.telemetry import device as device_lib
+
+            device_lib.enable(
+                analyze=(device_ledger != "light")
+            )
 
     def shutdown(self) -> None:
         global _RUNTIME
@@ -57,6 +69,10 @@ class TelemetryRuntime:
             from ray_tpu.util import tracing
 
             tracing.disable()
+        if self.device_ledger:
+            from ray_tpu.telemetry import device as device_lib
+
+            device_lib.disable()
         with _LOCK:
             if _RUNTIME is self:
                 _RUNTIME = None
@@ -76,6 +92,7 @@ def init(
     metrics_port: Optional[int] = None,
     trace: bool = False,
     metrics_host: str = "127.0.0.1",
+    device_ledger: Any = True,
 ) -> TelemetryRuntime:
     """Start (or return the already-running) telemetry runtime."""
     global _RUNTIME
@@ -90,6 +107,13 @@ def init(
 
                 tracing.enable()
                 _RUNTIME.trace = True
+            if device_ledger:
+                from ray_tpu.telemetry import device as device_lib
+
+                device_lib.enable(
+                    analyze=(device_ledger != "light")
+                )
+                _RUNTIME.device_ledger = device_ledger
             if (
                 metrics_port is not None
                 and _RUNTIME.metrics_server is None
@@ -109,6 +133,7 @@ def init(
             metrics_port=metrics_port,
             trace=trace,
             metrics_host=metrics_host,
+            device_ledger=device_ledger,
         )
         return _RUNTIME
 
@@ -122,6 +147,21 @@ def init_from_config(
     tc = (config or {}).get("telemetry_config") or {}
     metrics_port = tc.get("metrics_port")
     trace = bool(tc.get("trace", False))
-    if metrics_port is None and not trace:
+    # device_ledger=True may activate telemetry alone (counters-only
+    # runs that want the program ledger without spans or a scrape port)
+    ledger_cfg = tc.get("device_ledger")
+    if metrics_port is None and not trace and not ledger_cfg:
         return None
-    return init(metrics_port=metrics_port, trace=trace)
+    if tc.get("peak_flops"):
+        from ray_tpu.telemetry import device as device_lib
+
+        device_lib.set_peak_flops(
+            tc.get("peak_flops"), tc.get("peak_hbm_bytes_per_s")
+        )
+    return init(
+        metrics_port=metrics_port,
+        trace=trace,
+        device_ledger=(
+            True if ledger_cfg is None else ledger_cfg
+        ),
+    )

@@ -265,6 +265,42 @@ def clear() -> None:
         _finished.clear()
 
 
+def _clamped_intervals(spans: List[Dict]) -> Dict[str, tuple]:
+    """Per-span [start, end] intervals with cross-actor clock skew
+    contained: a child span is clamped into its parent's (clamped)
+    interval, and end never precedes start. Worker clocks are plain
+    ``time.time()`` — a worker ahead of the driver used to render its
+    execution span outside (or "before") the submitting span, which
+    chrome://tracing draws as negative-duration garbage. Parentage is
+    ground truth (the submission carried the context), so the parent
+    interval bounds the child."""
+    by_id = {
+        s["span_id"]: s for s in spans if s.get("span_id")
+    }
+    out: Dict[str, tuple] = {}
+
+    def resolve(s, seen) -> tuple:
+        sid = s.get("span_id")
+        if sid in out:
+            return out[sid]
+        start = s["start"]
+        end = s["end"] if s["end"] is not None else start
+        end = max(end, start)
+        pid = s.get("parent_id")
+        parent = by_id.get(pid)
+        if parent is not None and pid not in seen:
+            ps, pe = resolve(parent, seen | {pid})
+            start = min(max(start, ps), pe)
+            end = min(max(end, start), pe)
+        if sid:
+            out[sid] = (start, end)
+        return (start, end)
+
+    for s in spans:
+        resolve(s, {s.get("span_id")})
+    return out
+
+
 def export_chrome_trace(
     path: str, since: Optional[float] = None
 ) -> str:
@@ -273,31 +309,40 @@ def export_chrome_trace(
     ``since`` keeps only spans that END at or after that
     ``time.time()`` stamp (Algorithm.export_timeline's last-N-iteration
     window). Each (pid, tid) lane carries a thread_name metadata event
-    so prefetcher/feeder/learner threads are labeled in the viewer."""
+    so prefetcher/feeder/learner threads are labeled in the viewer.
+    Child spans are clamped into their parent's interval so cross-actor
+    clock skew can't produce negative durations or out-of-parent
+    rendering (raw stamps stay available in the span list API)."""
     with _lock:
         spans = list(_finished)
     if since is not None:
         spans = [
             s for s in spans if (s["end"] or s["start"]) >= since
         ]
-    events = [
-        {
-            "name": s["name"],
-            "cat": "span",
-            "ph": "X",
-            "ts": s["start"] * 1e6,
-            "dur": ((s["end"] or s["start"]) - s["start"]) * 1e6,
-            "pid": s["pid"],
-            "tid": s.get("tid", 0),
-            "args": {
-                "trace_id": s["trace_id"],
-                "span_id": s["span_id"],
-                "parent_id": s["parent_id"],
-                **s["attributes"],
-            },
-        }
-        for s in spans
-    ]
+    clamped = _clamped_intervals(spans)
+    events = []
+    for s in spans:
+        start, end = clamped.get(
+            s.get("span_id"),
+            (s["start"], s["end"] or s["start"]),
+        )
+        events.append(
+            {
+                "name": s["name"],
+                "cat": "span",
+                "ph": "X",
+                "ts": start * 1e6,
+                "dur": (end - start) * 1e6,
+                "pid": s["pid"],
+                "tid": s.get("tid", 0),
+                "args": {
+                    "trace_id": s["trace_id"],
+                    "span_id": s["span_id"],
+                    "parent_id": s["parent_id"],
+                    **s["attributes"],
+                },
+            }
+        )
     lanes = {}
     for s in spans:
         lanes.setdefault(

@@ -176,6 +176,61 @@ def test_timeout_flush_and_full_bucket_flush(rng):
     server.stop()
 
 
+def test_batch_fill_fraction_and_queue_wait_observability(rng):
+    """ISSUE-13 serve satellite: the server reports bucket occupancy
+    (real rows / executed rows) in stats() and the
+    ``ray_tpu_serve_batch_fill_fraction`` gauge, plus a queue-wait
+    histogram — the signals that distinguish an eager-flushing batcher
+    from a saturated one."""
+    from ray_tpu.utils.metrics import get_metric
+
+    server = BatchedPolicyServer(
+        _policy(), max_batch_size=4, batch_wait_timeout_s=0.05,
+        explore=False, name="fillstats",
+    )
+    # 3 rows pad into the 4-bucket → fill 3/4
+    futs = [
+        server.submit(o)
+        for o in rng.uniform(-1, 1, (3, 4)).astype(np.float32)
+    ]
+    for f in futs:
+        f.result(30.0)
+    st = server.stats()
+    assert st["batch_fill_fraction"] == pytest.approx(3 / 4)
+    g = get_metric("ray_tpu_serve_batch_fill_fraction")
+    assert g is not None
+    fills = {
+        dict(tags).get("deployment"): v for tags, v in g.series()
+    }
+    assert fills["fillstats"] == pytest.approx(3 / 4)
+    h = get_metric("ray_tpu_serve_queue_wait_seconds")
+    series = [
+        s
+        for tags, s in h.series()
+        if dict(tags).get("deployment") == "fillstats"
+    ]
+    assert series and series[0]["count"] == 3
+    assert st["queue_wait_p50_s"] is not None
+    # a full bucket is fill 1.0; the cumulative fraction rises
+    futs = [
+        server.submit(o)
+        for o in rng.uniform(-1, 1, (4, 4)).astype(np.float32)
+    ]
+    for f in futs:
+        f.result(30.0)
+    st2 = server.stats()
+    assert st2["batch_fill_fraction"] == pytest.approx(7 / 8)
+    assert fills_after_full(g) == pytest.approx(1.0)
+    server.stop()
+
+
+def fills_after_full(gauge):
+    return {
+        dict(tags).get("deployment"): v
+        for tags, v in gauge.series()
+    }["fillstats"]
+
+
 def test_hot_reload_mid_traffic_no_drops_no_blends(rng):
     """Swapping params mid-stream never drops a request, never blends
     one (every response is entirely one version's output), and the

@@ -270,6 +270,42 @@ def test_iteration_rollup_overlap_fraction():
     assert r3["overlap_fraction"] == 0.0
 
 
+def test_iteration_rollup_same_iteration_vs_deferred_harvest():
+    """The overlap roll-up is a pure function of (spans, window):
+    worker spans harvested WITHIN the iteration and the same spans
+    arriving an iteration late (the old deferred protocol) produce
+    identical numbers for that window — which is what lets
+    Algorithm.step roll up the CURRENT window instead of lagging one
+    iteration (ISSUE-13 satellite)."""
+    from ray_tpu import telemetry
+
+    worker = [
+        _span("rollout:sample", 1.0, 6.0, pid=2),
+        _span("sampler:collect", 2.0, 5.0, pid=2),
+    ]
+    driver = [
+        _span("learn:nest", 4.0, 8.0),
+        _span("feeder:transfer", 3.0, 3.5),
+    ]
+    window = (0.0, 10.0)
+    # harvested in-iteration (worker spans already present) vs
+    # deferred (they arrive after the driver's, i.e. appended last) vs
+    # interleaved: all the same
+    orders = [
+        worker + driver,
+        driver + worker,
+        [driver[0], worker[0], driver[1], worker[1]],
+    ]
+    results = [
+        telemetry.iteration_rollup(o, *window) for o in orders
+    ]
+    for r in results[1:]:
+        assert r == results[0]
+    assert results[0]["sample_s"] == pytest.approx(5.0)
+    # learn 4..8 ∩ sampling 1..6 = 4..6 → 2/4
+    assert results[0]["overlap_fraction"] == pytest.approx(0.5)
+
+
 def test_merge_and_intersect_primitives():
     from ray_tpu.telemetry import intersect, merge_intervals
 
@@ -287,12 +323,16 @@ def test_merge_and_intersect_primitives():
 
 
 def test_ppo_telemetry_end_to_end(tmp_path):
-    """AlgorithmConfig.telemetry() activates everything: train()
-    results carry info/telemetry (stage times + overlap fraction),
-    /metrics scrapes throughput + queue series, export_timeline
-    writes a chrome trace with spans from >= 2 processes and >= 2
-    driver threads."""
+    """AlgorithmConfig.telemetry() activates everything, on the
+    superstep path: train() results carry info/telemetry (stage times
+    + overlap fraction, with the fused ``learn:superstep`` span
+    counting as the learn stage) AND info/device_ledger (per-program
+    FLOPs / HBM bytes / executions / MFU — the ISSUE-13 acceptance
+    surface), /metrics scrapes throughput + queue + program series,
+    export_timeline writes one chrome trace with spans from >= 2
+    processes, >= 2 driver threads, and the device program lanes."""
     from ray_tpu.algorithms.ppo import PPOConfig
+    from ray_tpu.telemetry import device as device_ledger
 
     cfg = (
         PPOConfig()
@@ -307,6 +347,7 @@ def test_ppo_telemetry_end_to_end(tmp_path):
             sgd_minibatch_size=64,
             num_sgd_iter=2,
             lr=3e-4,
+            superstep=2,
         )
         .debugging(seed=0)
         .telemetry(metrics_port=0, trace=True)
@@ -327,8 +368,31 @@ def test_ppo_telemetry_end_to_end(tmp_path):
         ):
             assert key in tel, key
         assert tel["learn_s"] > 0
+        # satellite fix: the roll-up prefers the CURRENT iteration's
+        # window (worker spans harvested within it included) and only
+        # falls back one settled window when this window's sampling is
+        # still in flight — never more
+        assert tel["window_iterations_ago"] in (0, 1)
         assert tel["sample_s"] > 0
         assert 0.0 <= tel["overlap_fraction"] <= 1.0
+        # the superstep path really ran (fused updates counted)
+        assert tel["superstep"]["updates"] > 0
+
+        # device ledger (acceptance): per-program FLOPs, HBM bytes,
+        # executions, MFU on the superstep program
+        ledger = result["info"]["device_ledger"]
+        sup = next(
+            p
+            for p in ledger["programs"]
+            if p["label"].startswith("superstep[")
+        )
+        assert sup["flops"] and sup["flops"] > 0
+        assert sup["bytes_accessed"] and sup["bytes_accessed"] > 0
+        assert sup["memory"]["temp_bytes"] >= 0
+        assert sup["executions"] >= 1
+        assert sup["mfu"] is not None and sup["mfu"] > 0
+        assert ledger["totals"]["mfu"] is not None
+        assert ledger["peak_flops_per_device"] > 0
 
         port = algo._telemetry.metrics_port
         blob = urllib.request.urlopen(
@@ -341,7 +405,9 @@ def test_ppo_telemetry_end_to_end(tmp_path):
             'ray_tpu_requests_in_flight{manager="sample_prefetcher"}'
             in blob
         )
-        assert "ray_tpu_learner_step_seconds_bucket" in blob
+        assert "ray_tpu_program_executions_total" in blob
+        assert "ray_tpu_program_device_seconds_total" in blob
+        assert "ray_tpu_program_flops" in blob
 
         path = algo.export_timeline(
             str(tmp_path / "iter.json"), last_n=2
@@ -353,11 +419,13 @@ def test_ppo_telemetry_end_to_end(tmp_path):
             "rollout:sample",
             "prefetch:assemble",
             "feeder:transfer",
-            "learn:nest",
+            "learn:superstep",
         } <= names
+        # device program lanes merged into the same file
+        assert any(n.startswith("device:") for n in names)
         assert len({e["pid"] for e in x}) >= 2
         driver_pid = next(
-            e["pid"] for e in x if e["name"] == "learn:nest"
+            e["pid"] for e in x if e["name"] == "learn:superstep"
         )
         driver_tids = {
             e["tid"] for e in x if e["pid"] == driver_pid
@@ -365,6 +433,8 @@ def test_ppo_telemetry_end_to_end(tmp_path):
         assert len(driver_tids) >= 2
     finally:
         algo.cleanup()
+        device_ledger.disable()
+        device_ledger.clear()
 
 
 def test_telemetry_off_by_default_records_nothing():

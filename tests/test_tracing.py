@@ -69,6 +69,66 @@ def test_no_context_without_enable():
     assert tracing.get_spans() == []
 
 
+def test_chrome_trace_clamps_cross_actor_clock_skew(tmp_path):
+    """Regression: a worker clock running ahead of the driver used to
+    render its execution span outside the submitting span — and a
+    skewed end < start as a NEGATIVE duration chrome://tracing draws
+    as garbage. Children are clamped into their parent's interval and
+    durations never go negative."""
+
+    def span(name, sid, parent, start, end, pid):
+        return {
+            "trace_id": "t",
+            "span_id": sid,
+            "parent_id": parent,
+            "name": name,
+            "start": start,
+            "end": end,
+            "attributes": {},
+            "pid": pid,
+            "tid": 1,
+            "thread_name": None,
+        }
+
+    tracing.record_spans(
+        [
+            # driver parent: [100, 110]
+            span("train:iteration", "root", None, 100.0, 110.0, 1),
+            # worker clock +5s ahead: straddles the parent edge
+            span("actor:sample", "w1", "root", 104.0, 114.5, 2),
+            # nested worker span inherits the skew AND has end<start
+            # (a clock step mid-span): raw duration is negative
+            span("rollout:sample", "w2", "w1", 113.0, 112.4, 2),
+            # fully outside the parent (gross skew)
+            span("sampler:collect", "w3", "root", 140.0, 141.0, 2),
+        ]
+    )
+    path = tracing.export_chrome_trace(str(tmp_path / "skew.json"))
+    events = {
+        e["args"]["span_id"]: e
+        for e in json.load(open(path))["traceEvents"]
+        if e["ph"] == "X"
+    }
+    root = events["root"]
+
+    def interval(e):
+        return e["ts"], e["ts"] + e["dur"]
+
+    r0, r1 = interval(root)
+    for sid in ("w1", "w2", "w3"):
+        assert events[sid]["dur"] >= 0, sid
+        s, e = interval(events[sid])
+        assert r0 <= s <= r1 and r0 <= e <= r1, sid
+    # nested child stays inside its (clamped) direct parent too
+    p0, p1 = interval(events["w1"])
+    s, e = interval(events["w2"])
+    assert p0 <= s <= p1 and p0 <= e <= p1
+    # the raw span list keeps the unclamped stamps (clamping is a
+    # render-time fix, not data rewriting)
+    raw = {s["span_id"]: s for s in tracing.get_spans()}
+    assert raw["w3"]["start"] == 140.0
+
+
 def test_chrome_trace_export(tmp_path):
     @ray.remote
     def work():
