@@ -66,6 +66,18 @@ Flags:  --profile       run ONE telemetry-instrumented PPO iteration
                         benchmarks/e2e/serve_ab.json (bench_mfu gains
                         a `serve_forward` sub-entry at the pixel
                         geometry for the next TPU round)
+        --ingress       serving front-door A/B (docs/serving.md "the
+                        front door"): batched ingress (HTTP →
+                        coalescing router → fused replica forwards)
+                        vs the per-request serve-core HTTP path, both
+                        over REAL sockets, sweeping client counts —
+                        throughput + p50/p99 per path, bitwise
+                        response parity, zero recompiles in the timed
+                        window — plus the AOT cold-start A/B: fresh-
+                        replica warmup wall + time-to-first-response
+                        with an empty vs warm compile cache (warm =
+                        ZERO fresh compiles, test-asserted); writes
+                        benchmarks/e2e/ingress_ab.json
         --elastic       elastic-fleet chaos A/B (docs/resilience.md
                         "elastic fleets & preemption"): PPO fleet
                         forced 4→2→6 via noticed preemptions +
@@ -2141,6 +2153,327 @@ def bench_serve(
     return report
 
 
+def bench_ingress(
+    out_path=None,
+    n_requests=256,
+    clients_list=(1, 8, 32),
+    max_batch_size=32,
+):
+    """Serving front-door A/B (docs/serving.md "the front door"),
+    everything over REAL sockets:
+
+      - per_request: the serve-core HTTP path — one request per
+        replica actor call (``serve.run(policy_deployment(...),
+        http_host=...)`` with ``max_batch_size=1``), exactly the
+        pre-ingress architecture;
+      - ingress: ``PolicyIngress`` → ``CoalescingRouter`` → one
+        in-process ``BatchedPolicyServer`` replica restored from the
+        SAME checkpoint — requests coalesce across connections into
+        power-of-two buckets before dispatch.
+
+    Plus the AOT cold-start A/B: a fresh replica's warmup wall and
+    time-to-first-response with an empty compile cache (live XLA
+    compiles, which also SEED the cache) vs a warm one (every bucket
+    restored from disk — zero fresh compiles, trace-count-asserted).
+
+    Acceptance (ISSUE 14): ingress throughput >= 4x per-request at
+    32 clients, bitwise response parity, 0 recompiles in the timed
+    window, AOT cold start with 0 fresh compiles of cached buckets.
+    Writes benchmarks/e2e/ingress_ab.json."""
+    import os
+    import shutil
+    import tempfile
+    import threading
+    import urllib.request
+
+    import ray_tpu as ray
+    from ray_tpu.algorithms.ppo.ppo import PPO
+    from ray_tpu.ingress import (
+        CoalescingRouter,
+        LocalReplica,
+        PolicyIngress,
+    )
+    from ray_tpu.serve import serve
+    from ray_tpu.serve.policy_server import (
+        BatchedPolicyServer,
+        policy_deployment,
+        restore_policy,
+    )
+    from ray_tpu.sharding.aot import AOTCompileCache
+    from ray_tpu.sharding.compile import compile_stats
+
+    out_path = out_path or "benchmarks/e2e/ingress_ab.json"
+    workdir = tempfile.mkdtemp(prefix="ingress_bench_")
+    ckpt_root = os.path.join(workdir, "ckpts")
+
+    cfg = {
+        "env": "CartPole-v1",
+        "seed": 0,
+        "num_workers": 0,
+        "train_batch_size": 64,
+        "sgd_minibatch_size": 64,
+        "num_sgd_iter": 1,
+        "lr": 3e-4,
+        "model": {"fcnet_hiddens": [64, 64]},
+    }
+    algo = PPO(config=cfg)
+    try:
+        algo.save(os.path.join(ckpt_root, "checkpoint_000001"))
+    finally:
+        algo.cleanup()
+
+    rng = np.random.default_rng(0)
+    obs_stream = rng.uniform(
+        -1.0, 1.0, (n_requests, 4)
+    ).astype(np.float32)
+
+    def post(url, payload, timeout=120.0, retries=3):
+        import http.client
+
+        req = urllib.request.Request(
+            url,
+            data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        # the stdlib ThreadingHTTPServer on the per-request side
+        # occasionally resets a fresh connection under rapid
+        # open/close churn; a transient-layer retry keeps the A/B
+        # about the serving architecture, not loopback TCP flakes
+        # (retries stay inside the request's timed latency)
+        for attempt in range(retries):
+            try:
+                with urllib.request.urlopen(
+                    req, timeout=timeout
+                ) as resp:
+                    return json.loads(resp.read())
+            except (
+                ConnectionError,
+                http.client.RemoteDisconnected,
+            ):
+                if attempt == retries - 1:
+                    raise
+                time.sleep(0.01 * (attempt + 1))
+
+    def run_clients(full_url, n_clients):
+        latencies = np.zeros(n_requests)
+        results = [None] * n_requests
+        errors = []
+        next_i = [0]
+        ilock = threading.Lock()
+
+        def worker():
+            while True:
+                with ilock:
+                    i = next_i[0]
+                    if i >= n_requests:
+                        return
+                    next_i[0] += 1
+                t0 = time.perf_counter()
+                try:
+                    out = post(
+                        full_url, {"obs": obs_stream[i].tolist()}
+                    )
+                except Exception as e:
+                    with ilock:
+                        errors.append((i, repr(e)))
+                    continue
+                latencies[i] = time.perf_counter() - t0
+                results[i] = out.get("result", out)
+
+        threads = [
+            threading.Thread(target=worker)
+            for _ in range(n_clients)
+        ]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        if errors:
+            raise RuntimeError(
+                f"{len(errors)} request(s) failed against "
+                f"{full_url}; first: {errors[0]}"
+            )
+        return {
+            "throughput_rps": round(n_requests / wall, 1),
+            "wall_s": round(wall, 4),
+            "p50_ms": round(
+                float(np.percentile(latencies, 50)) * 1e3, 3
+            ),
+            "p99_ms": round(
+                float(np.percentile(latencies, 99)) * 1e3, 3
+            ),
+        }, results
+
+    # -- per-request side: the serve-core HTTP path ------------------
+    serve.run(
+        policy_deployment(
+            ckpt_root,
+            name="bench_naive",
+            max_batch_size=1,
+            watch=False,
+        ),
+        http_host="127.0.0.1",
+    )
+    naive_url = (
+        f"http://127.0.0.1:{serve.http_port()}/bench_naive"
+    )
+    naive_curve = {}
+    naive_results = None
+    try:
+        for c in clients_list:
+            naive_curve[c], naive_results = run_clients(
+                naive_url, c
+            )
+    finally:
+        serve.shutdown()
+        ray.shutdown()
+
+    # -- ingress side: front door + router + batched replica ---------
+    policy, prep, obs_filter, _info = restore_policy(ckpt_root)
+    server = BatchedPolicyServer(
+        policy,
+        name="bench_ingress",
+        max_batch_size=max_batch_size,
+        batch_wait_timeout_s=0.002,
+        explore=False,
+        obs_filter=obs_filter,
+        preprocessor=prep,
+        start=False,
+    )
+    server.warmup()
+    server.start()
+    router = CoalescingRouter(
+        "bench",
+        [LocalReplica(server)],
+        max_batch_size=max_batch_size,
+        batch_wait_timeout_s=0.002,
+    )
+    ingress = PolicyIngress().start()
+    ingress.add_policy("bench", router)
+    ingress_curve = {}
+    ingress_results = None
+    traces0 = compile_stats()["traces"]
+    try:
+        for c in clients_list:
+            ingress_curve[c], ingress_results = run_clients(
+                ingress.url + "/v1/policy/bench/actions", c
+            )
+        recompiles = compile_stats()["traces"] - traces0
+        router_stats = router.stats()
+    finally:
+        ingress.stop()
+        router.stop()
+        server.stop()
+
+    parity = all(
+        int(a["action"]) == int(b["action"])
+        for a, b in zip(ingress_results, naive_results)
+    )
+
+    # -- AOT cold-start A/B ------------------------------------------
+    def cold_start(cache, name):
+        p, pr, fl, _ = restore_policy(ckpt_root)
+        srv = BatchedPolicyServer(
+            p,
+            name=name,
+            max_batch_size=max_batch_size,
+            explore=False,
+            obs_filter=fl,
+            preprocessor=pr,
+            aot_cache=cache,
+            start=False,
+        )
+        t0 = time.perf_counter()
+        srv.warmup()
+        warmup_s = time.perf_counter() - t0
+        srv.start()
+        t0 = time.perf_counter()
+        srv.submit(obs_stream[0]).result(120.0)
+        first_response_s = time.perf_counter() - t0
+        fresh_compiles = sum(
+            fn.traces for fn in srv._fns.values()
+        )
+        sources = sorted(
+            {fn.aot_source for fn in srv._fns.values()}
+        )
+        srv.stop()
+        return {
+            "warmup_s": round(warmup_s, 4),
+            "first_response_s": round(first_response_s, 5),
+            "fresh_compiles": fresh_compiles,
+            "sources": sources,
+        }
+
+    cache = AOTCompileCache(os.path.join(workdir, "aot_cache"))
+    # cold replica, empty cache: live AOT compiles seed the cache
+    cold_live = cold_start(cache, "bench_cold")
+    cache.flush()
+    # fresh replica, warm cache: every bucket restores from disk
+    cold_aot = cold_start(cache, "bench_cold")
+    cache.stop()
+    aot_ab = {
+        "live": cold_live,
+        "aot_cache": cold_aot,
+        "warmup_speedup": round(
+            cold_live["warmup_s"]
+            / max(cold_aot["warmup_s"], 1e-9),
+            2,
+        ),
+    }
+
+    curve = [
+        {
+            "clients": c,
+            "per_request": naive_curve[c],
+            "ingress": ingress_curve[c],
+            "speedup": round(
+                ingress_curve[c]["throughput_rps"]
+                / naive_curve[c]["throughput_rps"],
+                2,
+            ),
+        }
+        for c in clients_list
+    ]
+    wide = [e for e in curve if e["clients"] >= 32]
+    report = {
+        "metric": "ingress_front_door_ab",
+        "n_requests": n_requests,
+        "model": [64, 64],
+        "max_batch_size": max_batch_size,
+        "transport": "real sockets (HTTP/1.1, keep-alive)",
+        "curve": curve,
+        "router": {
+            "batches_total": router_stats["batches_total"],
+            "mean_merged_rows": round(
+                router_stats["mean_merged_rows"], 2
+            ),
+        },
+        "recompiles_in_timed_window": recompiles,
+        "parity_bitwise": parity,
+        "aot_cold_start": aot_ab,
+        "criteria": {
+            "speedup_ge_4x_at_32_clients": all(
+                e["speedup"] >= 4.0 for e in wide
+            ),
+            "zero_recompiles": recompiles == 0,
+            "parity_bitwise": parity,
+            "aot_cold_start_zero_fresh_compiles": (
+                cold_aot["fresh_compiles"] == 0
+                and cold_aot["sources"] == ["aot_cache"]
+            ),
+        },
+    }
+    shutil.rmtree(workdir, ignore_errors=True)
+    os.makedirs(os.path.dirname(out_path), exist_ok=True)
+    with open(out_path, "w") as f:
+        json.dump(report, f, indent=1)
+    print(json.dumps(report))
+    return report
+
+
 def bench_apex(out_path=None, iters=4):
     """Host sum tree vs device sum tree A/B at a training_intensity-
     heavy DQN geometry, plus the learn-while-rollout interleave A/B
@@ -2651,6 +2984,9 @@ def main():
         return
     if "--serve" in sys.argv:
         bench_serve()
+        return
+    if "--ingress" in sys.argv:
+        bench_ingress()
         return
     if "--model-parallel" in sys.argv:
         bench_model_parallel()

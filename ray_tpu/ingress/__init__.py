@@ -1,0 +1,44 @@
+"""``ray_tpu.ingress``: the internet-scale serving front door.
+
+Three layers between a TCP socket and a mesh forward
+(docs/serving.md "the front door"):
+
+- :mod:`~ray_tpu.ingress.http` — the asyncio HTTP/ASGI ingress
+  (``POST /v1/policy/<name>/actions``, ``/healthz``, ``/metrics``);
+- :mod:`~ray_tpu.ingress.router` — cross-replica batch coalescing
+  into full power-of-two buckets with deadlines and dead-replica
+  rerouting;
+- :mod:`~ray_tpu.ingress.admission` — bounded in-flight budget +
+  queue-wait shedding (429/503 + Retry-After) so overload sheds
+  instead of queueing.
+
+Cold starts skip the compile storm via the AOT executable cache
+(:mod:`ray_tpu.sharding.aot`), loaded by
+``BatchedPolicyServer.warmup(aot_cache=...)``.
+"""
+
+from ray_tpu.ingress.admission import (  # noqa: F401
+    AdmissionController,
+    AdmissionDecision,
+)
+from ray_tpu.ingress.http import PolicyIngress  # noqa: F401
+from ray_tpu.ingress.router import (  # noqa: F401
+    ActorReplica,
+    CoalescingRouter,
+    DeadlineExpired,
+    LocalReplica,
+    NoReplicasAvailable,
+    wrap_replica,
+)
+
+__all__ = [
+    "AdmissionController",
+    "AdmissionDecision",
+    "PolicyIngress",
+    "CoalescingRouter",
+    "LocalReplica",
+    "ActorReplica",
+    "DeadlineExpired",
+    "NoReplicasAvailable",
+    "wrap_replica",
+]

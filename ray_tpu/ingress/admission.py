@@ -1,0 +1,190 @@
+"""Admission control and backpressure at the front door.
+
+An ingress without admission control turns overload into unbounded
+queue growth: every request is accepted, queue waits climb, deadlines
+pass inside the queue, and the mesh spends its cycles computing
+answers whose clients already gave up. This module makes the ingress
+shed INSTEAD of queueing (docs/serving.md "the front door"):
+
+- **bounded in-flight budget** — at most ``max_inflight`` admitted
+  requests may be unanswered at once; past that the ingress answers
+  **429 Too Many Requests** with a ``Retry-After`` hint instead of
+  enqueueing;
+- **queue-wait shedding** — when the trailing-window p50 queue wait
+  (``BatchedPolicyServer.queue_wait_window()`` — the SAME shared
+  accessor the serve autoscaler targets through ``stats()``, surfaced
+  via ``CoalescingRouter.queue_wait_signal``) exceeds
+  ``shed_queue_wait_s``, new requests get **503 Service Unavailable**
+  + ``Retry-After`` sized to the observed wait, letting the
+  autoscaler catch up instead of the queue;
+- **dead-on-arrival drops** — a request whose deadline is already
+  unmeetable is refused immediately (the router separately drops
+  requests that expire while queued, before dispatch).
+
+The wait signal is sampled at most every ``signal_interval_s`` so the
+admission decision costs one monotonic read per request, not a stats
+aggregation.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, Optional
+
+from ray_tpu.telemetry import metrics as telemetry_metrics
+
+
+class AdmissionDecision:
+    """A refusal: HTTP status, machine-readable reason, Retry-After."""
+
+    __slots__ = ("status", "reason", "retry_after_s")
+
+    def __init__(self, status: int, reason: str, retry_after_s: float):
+        self.status = status
+        self.reason = reason
+        self.retry_after_s = retry_after_s
+
+
+class AdmissionController:
+    """Per-policy (or shared) admission state. ``try_admit`` returns
+    None to admit — the caller MUST pair it with ``release()`` (or use
+    the :meth:`admit` context manager) — or an
+    :class:`AdmissionDecision` describing the shed."""
+
+    def __init__(
+        self,
+        *,
+        max_inflight: int = 256,
+        shed_queue_wait_s: Optional[float] = None,
+        wait_signal: Optional[Callable[[], Optional[float]]] = None,
+        signal_interval_s: float = 0.25,
+        retry_after_s: float = 1.0,
+    ):
+        self.max_inflight = int(max_inflight)
+        self.shed_queue_wait_s = shed_queue_wait_s
+        self.wait_signal = wait_signal
+        self.signal_interval_s = float(signal_interval_s)
+        self.retry_after_s = float(retry_after_s)
+        self._lock = threading.Lock()
+        self._inflight = 0
+        self._signal_value: Optional[float] = None
+        self._signal_t = 0.0
+        self.admitted_total = 0
+        self.shed_total: Dict[str, int] = {
+            "inflight": 0, "queue_wait": 0, "deadline": 0,
+        }
+
+    # -- the decision ----------------------------------------------------
+
+    def _current_wait(self) -> Optional[float]:
+        """Cached wait signal: refreshed at most once per
+        ``signal_interval_s`` so admission stays O(1) per request."""
+        if self.wait_signal is None:
+            return None
+        now = time.monotonic()
+        with self._lock:
+            fresh = now - self._signal_t < self.signal_interval_s
+            if fresh:
+                return self._signal_value
+            self._signal_t = now
+        try:
+            value = self.wait_signal()
+        except Exception:
+            value = None
+        with self._lock:
+            self._signal_value = value
+        return value
+
+    def try_admit(
+        self, deadline_s: Optional[float] = None
+    ) -> Optional[AdmissionDecision]:
+        """Admit (None) or shed (a decision). ``deadline_s`` is the
+        request's RELATIVE deadline; non-positive means it cannot be
+        met no matter what — refused without touching the queue."""
+        if deadline_s is not None and deadline_s <= 0:
+            return self._shed("deadline", 504, self.retry_after_s)
+        wait = self._current_wait()
+        if (
+            self.shed_queue_wait_s is not None
+            and wait is not None
+            and wait > self.shed_queue_wait_s
+        ):
+            # Retry-After sized to the congestion actually observed:
+            # long enough for the autoscaler / the queue to drain
+            return self._shed(
+                "queue_wait",
+                503,
+                max(self.retry_after_s, 2.0 * wait),
+            )
+        with self._lock:
+            if self._inflight >= self.max_inflight:
+                shed = True
+            else:
+                shed = False
+                self._inflight += 1
+                self.admitted_total += 1
+                inflight = self._inflight
+        if shed:
+            return self._shed("inflight", 429, self.retry_after_s)
+        telemetry_metrics.set_ingress_inflight(inflight)
+        return None
+
+    def _shed(
+        self, reason: str, status: int, retry_after_s: float
+    ) -> AdmissionDecision:
+        with self._lock:
+            self.shed_total[reason] = (
+                self.shed_total.get(reason, 0) + 1
+            )
+        telemetry_metrics.inc_ingress_shed(reason)
+        return AdmissionDecision(status, reason, retry_after_s)
+
+    def release(self) -> None:
+        with self._lock:
+            self._inflight = max(0, self._inflight - 1)
+            inflight = self._inflight
+        telemetry_metrics.set_ingress_inflight(inflight)
+
+    class _Admit:
+        __slots__ = ("ctrl", "decision")
+
+        def __init__(self, ctrl, decision):
+            self.ctrl = ctrl
+            self.decision = decision
+
+        @property
+        def admitted(self) -> bool:
+            return self.decision is None
+
+        def __enter__(self):
+            return self
+
+        def __exit__(self, *exc):
+            if self.admitted:
+                self.ctrl.release()
+            return False
+
+    def admit(
+        self, deadline_s: Optional[float] = None
+    ) -> "AdmissionController._Admit":
+        """``with ctrl.admit(...) as a:`` — ``a.admitted`` says
+        whether to proceed; release happens on exit automatically."""
+        return self._Admit(self, self.try_admit(deadline_s))
+
+    # -- introspection ---------------------------------------------------
+
+    def num_inflight(self) -> int:
+        with self._lock:
+            return self._inflight
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "inflight": self._inflight,
+                "max_inflight": self.max_inflight,
+                "admitted_total": self.admitted_total,
+                "shed_total": dict(self.shed_total),
+                "shed_queue_wait_s": self.shed_queue_wait_s,
+                "last_wait_signal": self._signal_value,
+            }

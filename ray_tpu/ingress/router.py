@@ -1,0 +1,536 @@
+"""Cross-replica batch coalescing: the router behind the front door.
+
+PR 9's serving plane batches per replica: each ``BatchedPolicyServer``
+coalesces only the requests that happened to reach ITS queue, so at
+moderate load N replicas run N under-full buckets where one full
+bucket would do. This router merges the streams BEFORE dispatch: all
+ingress requests for a deployment land in one queue, the batcher
+forms **full power-of-two buckets** out of them, and each bucket goes
+to exactly one replica as a single atomic run (``submit_many`` /
+``PolicyDeployment.handle_rows``).
+
+Why this is recompile-free by construction: replicas only ever execute
+the bucket shapes they warmed (the PR-9 power-of-two contract), and a
+router-merged bucket is just more real rows in the same padded shapes
+— cross-replica merging changes bucket OCCUPANCY, never bucket SHAPE.
+
+Determinism (docs/serving.md): a replica's server advances its rng
+carry once per real request in arrival order, and the router dispatches
+buckets to a given replica in formation order from one batcher thread —
+so the per-request-key contract survives the extra hop: any router
+coalescing of a fixed-seed stream onto one replica is BIT-identical to
+sequential ``compute_actions`` on a 1-shard mesh
+(tests/test_ingress.py).
+
+Reliability:
+
+- **deadlines** — every request may carry one; expired requests are
+  dropped at collection time, BEFORE dispatch, so the mesh never
+  computes an answer nobody is waiting for;
+- **dead replicas** — a dispatch that dies (actor death, stopped
+  server, timeout) marks the replica dead, re-queues the bucket's
+  unexpired requests at the FRONT of the queue, and the next
+  formation routes them to a survivor;
+- **membership** — the router polls the serve controller's
+  replica-membership feed (``serve.membership_feed`` →
+  ``resilience.discovery.MembershipFeed``) between batches, adopting
+  autoscaler scale-ups and dead-replica replacements without a
+  listener thread of its own.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from concurrent.futures import Future, ThreadPoolExecutor
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+from ray_tpu.serve.policy_server import TrailingWindow, default_buckets
+from ray_tpu.telemetry import metrics as telemetry_metrics
+from ray_tpu.util import tracing
+
+
+class DeadlineExpired(RuntimeError):
+    """The request's deadline passed before a replica computed it."""
+
+
+class NoReplicasAvailable(RuntimeError):
+    """Every known replica is dead and membership has no fresh ones."""
+
+
+class LocalReplica:
+    """In-process replica client over a ``BatchedPolicyServer`` (or a
+    ``PolicyDeployment`` owning one): the zero-copy path tests, bench,
+    and single-process deployments use. ``begin`` enqueues the bucket
+    atomically on the caller's thread (preserving per-replica FIFO —
+    the determinism anchor); ``finish`` blocks for the results on a
+    dispatch-pool thread."""
+
+    def __init__(self, server, name: str = "local"):
+        # accept a PolicyDeployment transparently
+        self.server = getattr(server, "server", server)
+        self.name = name
+        self.dead = False
+
+    def begin(self, rows: Sequence[Any], explore):
+        return self.server.submit_many(rows, explore=explore)
+
+    def finish(self, token, timeout_s: float) -> List[Dict[str, Any]]:
+        out = []
+        deadline = time.perf_counter() + timeout_s
+        for fut in token:
+            remaining = max(0.0, deadline - time.perf_counter())
+            action, extra = fut.result(remaining)
+            out.append(
+                {
+                    "action": action,
+                    "params_version": fut.params_version,
+                    "extra": extra,
+                }
+            )
+        return out
+
+    def alive(self) -> bool:
+        return (
+            not self.dead
+            and self.server.error is None
+            and not self.server._stop.is_set()
+        )
+
+    def queue_wait_p50_s(self) -> Optional[float]:
+        # the shared accessor (satellite contract): the SAME window
+        # stats() feeds the autoscaler also feeds ingress shedding
+        return self.server.queue_wait_window()["p50_s"]
+
+
+class ActorReplica:
+    """Replica client over a serve-core ``_Replica`` actor hosting a
+    ``PolicyDeployment`` — the multi-process fleet path. ``begin`` is
+    the non-blocking actor submit (ordered per actor), ``finish`` the
+    bounded harvest; actor-death errors surface in ``finish`` and mark
+    the replica dead."""
+
+    def __init__(self, actor, name: str = "replica"):
+        self.actor = actor
+        self.name = name
+        self.dead = False
+
+    def begin(self, rows: Sequence[Any], explore):
+        import numpy as np
+
+        return self.actor.call_method.remote(
+            "handle_rows",
+            [[np.asarray(r).tolist() for r in rows]],
+            {"explore": explore},
+        )
+
+    def finish(self, token, timeout_s: float) -> List[Dict[str, Any]]:
+        import ray_tpu as ray
+
+        return ray.get(token, timeout=timeout_s)
+
+    def alive(self) -> bool:
+        return not self.dead
+
+    def queue_wait_p50_s(self) -> Optional[float]:
+        # remote stats are the autoscaler's polling job, not the
+        # per-request admission path's — no synchronous actor RTT here
+        return None
+
+
+def _is_actor_handle(member) -> bool:
+    # NOT a duck-check: an ActorHandle synthesizes an ActorMethod for
+    # ANY attribute name, so hasattr() answers True for everything —
+    # classification must be by type
+    from ray_tpu.core.api import ActorHandle
+
+    return isinstance(member, ActorHandle)
+
+
+def wrap_replica(member, index: int = 0):
+    """Default membership wrap: serve actors → :class:`ActorReplica`,
+    in-process servers/deployments → :class:`LocalReplica`."""
+    if _is_actor_handle(member):
+        return ActorReplica(member, name=f"replica-{index}")
+    return LocalReplica(member, name=f"local-{index}")
+
+
+def _as_client(member, index: int, wrap) -> Any:
+    """Normalize one membership entry into a replica client: actor
+    handles and bare servers/deployments go through ``wrap``; objects
+    already speaking the client protocol (begin/finish) pass through
+    — the type check comes FIRST because actor handles would pass any
+    hasattr probe."""
+    if _is_actor_handle(member):
+        return wrap(member, index)
+    if hasattr(member, "begin") and hasattr(member, "finish"):
+        return member
+    return wrap(member, index)
+
+
+def _safe_reject(fut: Future, err: BaseException) -> None:
+    """Reject a request future, tolerating a client that cancelled it
+    first (asyncio ``wait_for`` cancels the wrapped future on its own
+    timeout) — an InvalidStateError here must never kill a router
+    thread."""
+    try:
+        fut.set_exception(err)
+    except Exception:
+        pass
+
+
+def _safe_resolve(fut: Future, value) -> None:
+    try:
+        if fut.set_running_or_notify_cancel():
+            fut.set_result(value)
+    except Exception:
+        pass
+
+
+class _RouterRequest:
+    __slots__ = ("obs", "explore", "deadline", "future", "t_submit")
+
+    def __init__(self, obs, explore, deadline, future, t_submit):
+        self.obs = obs
+        self.explore = explore
+        self.deadline = deadline
+        self.future = future
+        self.t_submit = t_submit
+
+
+class CoalescingRouter:
+    """Merges ingress requests across replicas into full power-of-two
+    buckets before dispatch. Thread layout: callers enqueue from any
+    thread; ONE batcher thread forms buckets and begins dispatches
+    (per-replica FIFO); a small pool harvests results so slow replicas
+    never stall bucket formation."""
+
+    def __init__(
+        self,
+        name: str,
+        replicas: Sequence[Any] = (),
+        *,
+        membership=None,
+        wrap: Optional[Callable[[Any, int], Any]] = None,
+        max_batch_size: int = 32,
+        buckets: Optional[Sequence[int]] = None,
+        batch_wait_timeout_s: float = 0.002,
+        default_deadline_s: Optional[float] = None,
+        dispatch_timeout_s: float = 60.0,
+        dispatch_workers: int = 4,
+        stats_window_s: float = 30.0,
+        start: bool = True,
+    ):
+        self.name = name
+        self.max_batch_size = int(max_batch_size)
+        self.buckets = tuple(
+            sorted(set(int(b) for b in buckets))
+            if buckets
+            else default_buckets(self.max_batch_size)
+        )
+        self.batch_wait_timeout_s = float(batch_wait_timeout_s)
+        self.default_deadline_s = default_deadline_s
+        self.dispatch_timeout_s = float(dispatch_timeout_s)
+        self._membership = membership
+        self._wrap = wrap or wrap_replica
+        self._members_version = -1
+        self._replicas: List[Any] = [
+            _as_client(r, i, self._wrap)
+            for i, r in enumerate(replicas)
+        ]
+        self._rr = 0
+
+        self._queue: "collections.deque[_RouterRequest]" = (
+            collections.deque()
+        )
+        self._cv = threading.Condition()
+        self._stop = threading.Event()
+        self.error: Optional[BaseException] = None
+
+        self.batches_total = 0
+        self.merged_rows_total = 0
+        self.expired_total = 0
+        self.rerouted_total = 0
+        self._wait_window = TrailingWindow(stats_window_s)
+
+        self._pool = ThreadPoolExecutor(
+            max_workers=int(dispatch_workers),
+            thread_name_prefix=f"router_dispatch_{name}",
+        )
+        self._thread: Optional[threading.Thread] = None
+        if start:
+            self.start()
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._refresh_membership()
+        self._thread = threading.Thread(
+            target=self._run, daemon=True,
+            name=f"router_batcher_{self.name}",
+        )
+        self._thread.start()
+
+    # -- client side -----------------------------------------------------
+
+    def submit(
+        self,
+        obs,
+        explore: Optional[bool] = None,
+        deadline_s: Optional[float] = None,
+    ) -> Future:
+        """Enqueue one observation; returns a ``concurrent.futures``
+        Future resolving to ``{"action", "params_version", ...}`` (or
+        raising :class:`DeadlineExpired` / :class:`NoReplicasAvailable`).
+        ``deadline_s`` is relative; expired requests are dropped
+        before dispatch, never computed."""
+        if self._stop.is_set():
+            raise RuntimeError("router is stopped")
+        now = time.perf_counter()
+        if deadline_s is None:
+            deadline_s = self.default_deadline_s
+        fut: Future = Future()
+        req = _RouterRequest(
+            obs,
+            explore,
+            now + deadline_s if deadline_s is not None else None,
+            fut,
+            now,
+        )
+        with self._cv:
+            self._queue.append(req)
+            self._cv.notify_all()
+        return fut
+
+    # -- batcher thread --------------------------------------------------
+
+    # ray-tpu: thread=router-batcher
+    def _run(self) -> None:
+        try:
+            while True:
+                with self._cv:
+                    while not self._queue and not self._stop.is_set():
+                        self._cv.wait()
+                    if self._stop.is_set() and not self._queue:
+                        break
+                self._refresh_membership()
+                batch, expired = self._collect()
+                self._drop_expired(expired)
+                if batch:
+                    self._dispatch(batch)
+        except BaseException as e:  # pragma: no cover - defensive
+            self.error = e
+            with self._cv:
+                pending = list(self._queue)
+                self._queue.clear()
+            for req in pending:
+                _safe_reject(req.future, e)
+
+    def _refresh_membership(self) -> None:
+        """Adopt the controller's current replica set when its feed
+        version moved (scale-up, dead-replica replacement). A
+        republished membership only ever contains live actors, so a
+        fresh wrap also clears stale dead marks — the same contract
+        ``DeploymentHandle``'s listener applies."""
+        if self._membership is None:
+            return
+        try:
+            version, members = self._membership.current()
+        except Exception:
+            return
+        if version == self._members_version:
+            return
+        self._members_version = version
+        if members:
+            self._replicas = [
+                _as_client(m, i, self._wrap)
+                for i, m in enumerate(members)
+            ]
+
+    # ray-tpu: thread=router-batcher
+    def _collect(self):
+        """Form one bucket: wait for a full ``max_batch_size`` run (or
+        the coalesce timeout after the FIRST request), then drain a
+        same-explore FIFO run, splitting out expired requests — they
+        are dropped before dispatch instead of computing dead work."""
+        with self._cv:
+            if not self._queue:
+                return [], []
+            deadline = (
+                self._queue[0].t_submit + self.batch_wait_timeout_s
+            )
+            while (
+                len(self._queue) < self.max_batch_size
+                and not self._stop.is_set()
+            ):
+                remaining = deadline - time.perf_counter()
+                if remaining <= 0:
+                    break
+                self._cv.wait(remaining)
+            now = time.perf_counter()
+            batch: List[_RouterRequest] = []
+            expired: List[_RouterRequest] = []
+            flag = None
+            while self._queue and len(batch) < self.max_batch_size:
+                req = self._queue[0]
+                if req.deadline is not None and now > req.deadline:
+                    expired.append(self._queue.popleft())
+                    continue
+                if flag is None:
+                    flag = req.explore
+                elif req.explore != flag:
+                    break
+                batch.append(self._queue.popleft())
+            return batch, expired
+
+    def _drop_expired(self, expired) -> None:
+        if not expired:
+            return
+        self.expired_total += len(expired)
+        telemetry_metrics.inc_router_expired(self.name, len(expired))
+        for req in expired:
+            _safe_reject(
+                req.future,
+                DeadlineExpired(
+                    "request expired before dispatch "
+                    f"(waited {time.perf_counter() - req.t_submit:.3f}s)"
+                ),
+            )
+
+    # ray-tpu: thread=router-batcher
+    def _next_replica(self):
+        n = len(self._replicas)
+        for _ in range(n):
+            r = self._replicas[self._rr % n]
+            self._rr += 1
+            if r.alive():
+                return r
+        return None
+
+    # ray-tpu: thread=router-batcher
+    def _dispatch(self, batch: List[_RouterRequest]) -> None:
+        """Begin the bucket on one live replica (on THIS thread, so a
+        replica sees buckets in formation order — the determinism
+        anchor) and hand the blocking harvest to the pool."""
+        replica = self._next_replica()
+        if replica is None:
+            # one forced membership refresh before giving up: the
+            # controller may have replaced the corpses already
+            self._members_version = -1
+            self._refresh_membership()
+            replica = self._next_replica()
+        if replica is None:
+            err = NoReplicasAvailable(
+                f"deployment {self.name!r}: no live replicas"
+            )
+            for req in batch:
+                _safe_reject(req.future, err)
+            return
+        explore = batch[0].explore
+        rows = [req.obs for req in batch]
+        t0 = time.perf_counter()
+        try:
+            token = replica.begin(rows, explore)
+        except Exception:
+            replica.dead = True
+            self._requeue(batch)
+            return
+        self.batches_total += 1
+        self.merged_rows_total += len(batch)
+        telemetry_metrics.observe_router_batch(self.name, len(batch))
+        for req in batch:
+            self._wait_window.observe(t0 - req.t_submit, t=t0)
+        self._pool.submit(self._finish, replica, token, batch)
+
+    def _requeue(self, batch: List[_RouterRequest]) -> None:
+        """Put a failed bucket's requests back at the FRONT of the
+        queue in their original order (expired ones get filtered by
+        the next collection). Called from batcher and dispatch
+        threads; the queue lock is the designed sharing point."""
+        self.rerouted_total += len(batch)
+        telemetry_metrics.inc_router_rerouted(self.name, len(batch))
+        with self._cv:
+            for req in reversed(batch):
+                self._queue.appendleft(req)
+            self._cv.notify_all()
+
+    # ray-tpu: thread=router-dispatch
+    def _finish(self, replica, token, batch) -> None:
+        """Harvest one dispatched bucket on a pool thread. A dead or
+        wedged replica routes the bucket back through the queue onto
+        a survivor."""
+        try:
+            with tracing.start_span(
+                "router:dispatch", rows=len(batch), replica=replica.name
+            ):
+                results = replica.finish(
+                    token, self.dispatch_timeout_s
+                )
+            if len(results) != len(batch):
+                raise RuntimeError(
+                    f"replica returned {len(results)} results for "
+                    f"{len(batch)} requests"
+                )
+        except BaseException:
+            replica.dead = True
+            self._requeue(batch)
+            return
+        for req, row in zip(batch, results):
+            _safe_resolve(req.future, row)
+
+    # -- introspection / lifecycle ---------------------------------------
+
+    def queue_wait_signal(self) -> Optional[float]:
+        """The shedding signal for admission control: the worst p50
+        queue wait across this router's window and every local
+        replica's ``BatchedPolicyServer.queue_wait_window()`` — the
+        SAME accessor the serve autoscaler reads through stats()."""
+        waits = [self._wait_window.pct(50)]
+        for r in self._replicas:
+            try:
+                waits.append(r.queue_wait_p50_s())
+            except Exception:
+                pass
+        waits = [w for w in waits if w is not None]
+        return max(waits) if waits else None
+
+    def num_replicas(self) -> int:
+        return len(self._replicas)
+
+    def num_dead(self) -> int:
+        return sum(0 if r.alive() else 1 for r in self._replicas)
+
+    def stats(self) -> Dict[str, Any]:
+        with self._cv:
+            depth = len(self._queue)
+        return {
+            "name": self.name,
+            "queue_depth": depth,
+            "replicas": self.num_replicas(),
+            "dead_replicas": self.num_dead(),
+            "batches_total": self.batches_total,
+            "merged_rows_total": self.merged_rows_total,
+            "mean_merged_rows": (
+                self.merged_rows_total / self.batches_total
+                if self.batches_total
+                else 0.0
+            ),
+            "expired_total": self.expired_total,
+            "rerouted_total": self.rerouted_total,
+            "queue_wait": self._wait_window.snapshot(),
+            "buckets": list(self.buckets),
+        }
+
+    def stop(self, join_timeout: float = 30.0) -> None:
+        self._stop.set()
+        with self._cv:
+            pending = list(self._queue)
+            self._queue.clear()
+            self._cv.notify_all()
+        err = RuntimeError("router stopped")
+        for req in pending:
+            _safe_reject(req.future, err)
+        if self._thread is not None and self._thread.is_alive():
+            self._thread.join(timeout=join_timeout)
+        self._pool.shutdown(wait=False)

@@ -24,9 +24,39 @@ The preference contract (docs/resilience.md):
 from __future__ import annotations
 
 import os
-from typing import Optional, Tuple
+from typing import Any, List, Optional, Tuple
 
 PERIODIC_PREFIX = "checkpoint_"
+
+
+class MembershipFeed:
+    """Versioned replica-membership feed for routing consumers.
+
+    The serve controller publishes a deployment's live replica set
+    onto a long-poll key every time membership changes (scale-up,
+    scale-down, dead-replica replacement); the ingress coalescing
+    router polls this feed between batches and adopts the new set —
+    the same membership discipline ``DeploymentHandle``'s listener
+    thread follows, exposed as a poll surface so the router never
+    needs its own listener thread. ``current()`` is cheap (one lock'd
+    dict read); ``wait_changed`` long-polls for pushes."""
+
+    def __init__(self, host, key: str):
+        self._host = host
+        self._key = key
+
+    def current(self) -> Tuple[int, List[Any]]:
+        version, members = self._host.current(self._key)
+        return version, list(members or [])
+
+    def wait_changed(
+        self, version: int, timeout: Optional[float] = None
+    ) -> Optional[Tuple[int, List[Any]]]:
+        out = self._host.listen(self._key, version, timeout=timeout)
+        if out is None:
+            return None
+        new_version, members = out
+        return new_version, list(members or [])
 
 
 def latest_periodic(checkpoint_root: Optional[str]) -> Optional[str]:

@@ -77,6 +77,56 @@ def default_buckets(max_batch_size: int) -> Tuple[int, ...]:
     return tuple(out)
 
 
+class TrailingWindow:
+    """THE trailing-window percentile accessor of the serve plane.
+
+    One implementation computes every queue-wait/latency signal —
+    ``BatchedPolicyServer.stats()`` (what ``_Replica.stats`` forwards
+    to the ``_autoscale_loop`` queue-wait targeting), the ingress
+    admission controller's shedding decision, and the router's own
+    wait tracking all read the SAME windowed numbers
+    (regression-pinned by tests/test_ingress.py). Samples older than
+    ``window_s`` decay out, so the signal relaxes once load does."""
+
+    def __init__(self, window_s: float = 30.0, maxlen: int = 8192):
+        self.window_s = float(window_s)
+        self._samples: collections.deque = collections.deque(
+            maxlen=maxlen
+        )
+        self._lock = threading.Lock()
+
+    def observe(self, value: float, t: Optional[float] = None) -> None:
+        with self._lock:
+            self._samples.append(
+                (time.perf_counter() if t is None else t, value)
+            )
+
+    def values(self) -> List[float]:
+        cutoff = time.perf_counter() - self.window_s
+        with self._lock:
+            return [v for (t, v) in self._samples if t >= cutoff]
+
+    def pct(self, q: float) -> Optional[float]:
+        vals = self.values()
+        if not vals:
+            return None
+        return float(np.percentile(np.asarray(vals), q))
+
+    def snapshot(self) -> Dict[str, Any]:
+        vals = self.values()
+        arr = np.asarray(vals) if vals else None
+        return {
+            "p50_s": float(np.percentile(arr, 50))
+            if arr is not None
+            else None,
+            "p99_s": float(np.percentile(arr, 99))
+            if arr is not None
+            else None,
+            "n": len(vals),
+            "window_s": self.window_s,
+        }
+
+
 class ServeFuture:
     """Per-request future a :meth:`BatchedPolicyServer.submit` returns.
     ``result()`` blocks for ``(action, extra)``; ``params_version``
@@ -117,13 +167,17 @@ class ServeFuture:
 
 
 class _Request:
-    __slots__ = ("obs", "explore", "future", "t_submit")
+    __slots__ = ("obs", "explore", "future", "t_submit", "flush")
 
-    def __init__(self, obs, explore, future, t_submit):
+    def __init__(self, obs, explore, future, t_submit, flush=False):
         self.obs = obs
         self.explore = explore
         self.future = future
         self.t_submit = t_submit
+        # flush hint: the tail of a router-coalesced bucket — the
+        # batcher drains immediately instead of waiting out the batch
+        # timeout for rows that are not coming
+        self.flush = flush
 
 
 class BatchedPolicyServer:
@@ -148,6 +202,7 @@ class BatchedPolicyServer:
         obs_filter=None,
         preprocessor=None,
         stats_window_s: float = 30.0,
+        aot_cache=None,
         start: bool = True,
     ):
         self.policy = policy
@@ -216,18 +271,32 @@ class BatchedPolicyServer:
         self._queue: "collections.deque[_Request]" = collections.deque()
         self._cv = threading.Condition()
         self._stop = threading.Event()
+        self._flush_hints = 0
         self.error: Optional[BaseException] = None
+        # AOT compiled-program cache (sharding/aot.py): warmup loads
+        # serialized serve executables instead of compiling — the
+        # cold-start path of docs/serving.md "the front door"
+        from ray_tpu.sharding import aot as aot_lib
+
+        self.aot_cache = aot_lib.resolve_cache(aot_cache)
+        # a cache built HERE from a path is ours to stop; a passed-in
+        # instance is fleet-shared and outlives any one server
+        self._owns_aot_cache = (
+            self.aot_cache is not None
+            and not isinstance(aot_cache, aot_lib.AOTCompileCache)
+        )
 
         self.requests_total = 0
         self.batches_total = 0
         self.batch_rows_total = 0
         self.padded_rows_total = 0
-        # (timestamp, seconds) samples; percentiles are computed over
-        # the trailing stats_window_s so the autoscale signal decays
-        # once load does (a lifetime p50 would pin scale-down forever)
+        # trailing-window percentile accessors — the ONE windowing
+        # implementation the autoscaler (via stats()) and the ingress
+        # shedding decision both read, so the signal decays once load
+        # does (a lifetime p50 would pin scale-down forever)
         self.stats_window_s = float(stats_window_s)
-        self._lat = collections.deque(maxlen=8192)
-        self._queue_wait = collections.deque(maxlen=8192)
+        self._lat = TrailingWindow(self.stats_window_s)
+        self._queue_wait = TrailingWindow(self.stats_window_s)
 
         self._thread: Optional[threading.Thread] = None
         if start:
@@ -246,13 +315,10 @@ class BatchedPolicyServer:
 
     # -- client side -----------------------------------------------------
 
-    def submit(self, obs, explore: Optional[bool] = None) -> ServeFuture:
-        """Enqueue ONE observation; returns its future. The obs goes
-        through the policy's preprocessor + observation filter
-        (``update=False`` — serving traffic must not mutate training
-        filter statistics)."""
-        if self._stop.is_set():
-            raise RuntimeError("policy server is stopped")
+    def _transform_obs(self, obs) -> np.ndarray:
+        """Preprocessor + observation filter (``update=False`` —
+        serving traffic must not mutate training filter statistics) +
+        shape/dtype validation, shared by submit and submit_many."""
         if self.preprocessor is not None:
             obs = self.preprocessor.transform(obs)
         if self.obs_filter is not None:
@@ -263,21 +329,58 @@ class BatchedPolicyServer:
                 f"obs shape {obs.shape} != policy row shape "
                 f"{self._row_shape}"
             )
-        fut = ServeFuture()
-        req = _Request(
-            obs,
-            self.explore if explore is None else bool(explore),
-            fut,
-            time.perf_counter(),
-        )
+        return obs
+
+    def submit(self, obs, explore: Optional[bool] = None) -> ServeFuture:
+        """Enqueue ONE observation; returns its future. No flush hint:
+        singleton submits rely on the batcher's timeout coalescing
+        (the PR-9 continuous-batching contract)."""
+        return self._enqueue([obs], explore, flush=False)[0]
+
+    def submit_many(
+        self, obs_rows, explore: Optional[bool] = None
+    ) -> List[ServeFuture]:
+        """Enqueue a pre-coalesced run of observations ATOMICALLY (one
+        lock acquisition, one batcher wakeup): the ingress router's
+        dispatch path. The last request carries a flush hint, so the
+        batcher drains the run immediately instead of waiting out
+        ``batch_wait_timeout_s`` for rows that are not coming — a
+        router-formed bucket turns into exactly one forward (plus
+        whatever was already queued, which can only round UP to a
+        bigger warm bucket, never retrace)."""
+        return self._enqueue(obs_rows, explore, flush=True)
+
+    def _enqueue(
+        self, obs_rows, explore, flush: bool
+    ) -> List[ServeFuture]:
+        if self._stop.is_set():
+            raise RuntimeError("policy server is stopped")
+        obs_rows = list(obs_rows)
+        if not obs_rows:
+            return []  # no rows → no flush hint to pop, don't arm one
+        explore = self.explore if explore is None else bool(explore)
+        now = time.perf_counter()
+        reqs = []
+        for i, obs in enumerate(obs_rows):
+            reqs.append(
+                _Request(
+                    self._transform_obs(obs),
+                    explore,
+                    ServeFuture(),
+                    now,
+                    flush=flush and i == len(obs_rows) - 1,
+                )
+            )
         with self._cv:
-            self._queue.append(req)
+            self._queue.extend(reqs)
             depth = len(self._queue)
-            self.requests_total += 1
+            self.requests_total += len(reqs)
+            if flush:
+                self._flush_hints += 1
             self._cv.notify_all()
-        telemetry_metrics.inc_serve_requests(self.name)
+        telemetry_metrics.inc_serve_requests(self.name, len(reqs))
         telemetry_metrics.set_serve_queue_depth(self.name, depth)
-        return fut
+        return [r.future for r in reqs]
 
     def compute_actions(
         self, obs_batch, explore: Optional[bool] = None
@@ -493,6 +596,15 @@ class BatchedPolicyServer:
             fn = self._fns[key] = self._build_serve_fn(
                 bucket, explore
             )
+        if self.aot_cache is not None:
+            # AOT cold start (sharding/aot.py): a cache hit installs
+            # the serialized executable — the warm call below then
+            # executes WITHOUT any XLA compile; a miss compiles ahead
+            # of time once and seeds the cache for the next replica
+            fn.aot_warmup(
+                self.aot_cache,
+                params, self._carry, padded, np.int32(0), coeffs,
+            )
         _, _, self._carry = fn(
             params, self._carry, padded, np.int32(0), coeffs
         )
@@ -547,6 +659,9 @@ class BatchedPolicyServer:
             while (
                 len(self._queue) < self.max_batch_size
                 and not self._stop.is_set()
+                # a flush hint means a pre-coalesced run's tail is
+                # already queued — drain now, nothing more is coming
+                and self._flush_hints == 0
             ):
                 remaining = deadline - time.perf_counter()
                 if remaining <= 0:
@@ -559,7 +674,10 @@ class BatchedPolicyServer:
                 and len(batch) < self.max_batch_size
                 and self._queue[0].explore == flag
             ):
-                batch.append(self._queue.popleft())
+                req = self._queue.popleft()
+                if req.flush:
+                    self._flush_hints -= 1
+                batch.append(req)
             telemetry_metrics.set_serve_queue_depth(
                 self.name, len(self._queue)
             )
@@ -617,8 +735,8 @@ class BatchedPolicyServer:
         for req, value in zip(batch, results):
             lat = t1 - req.t_submit
             wait = t0 - req.t_submit
-            self._lat.append((t1, lat))
-            self._queue_wait.append((t1, wait))
+            self._lat.observe(lat, t=t1)
+            self._queue_wait.observe(wait, t=t1)
             telemetry_metrics.observe_serve_latency(self.name, lat)
             telemetry_metrics.observe_serve_queue_wait(
                 self.name, wait
@@ -627,12 +745,20 @@ class BatchedPolicyServer:
 
     # -- introspection ---------------------------------------------------
 
-    def _pct(self, samples, q) -> Optional[float]:
-        cutoff = time.perf_counter() - self.stats_window_s
-        vals = [v for (t, v) in samples if t >= cutoff]
-        if not vals:
-            return None
-        return float(np.percentile(np.asarray(vals), q))
+    def queue_wait_window(self) -> Dict[str, Any]:
+        """THE queue-wait signal: trailing-window percentiles of how
+        long requests sat queued before their forward launched. One
+        accessor feeds BOTH consumers — ``stats()`` (whose
+        ``queue_wait_p50_s`` the serve-core ``_autoscale_loop``
+        targets) and the ingress admission controller's shedding
+        decision — so the two planes can never act on different
+        numbers (regression-pinned by tests/test_ingress.py)."""
+        return self._queue_wait.snapshot()
+
+    def latency_window(self) -> Dict[str, Any]:
+        """Trailing-window end-to-end latency percentiles (same
+        accessor discipline as :meth:`queue_wait_window`)."""
+        return self._lat.snapshot()
 
     def stats(self) -> Dict[str, Any]:
         """Queue/latency surface (exact percentiles over the trailing
@@ -640,8 +766,8 @@ class BatchedPolicyServer:
         queue-wait autoscaler and what the bench curves read."""
         with self._cv:
             depth = len(self._queue)
-        lat = list(self._lat)
-        qw = list(self._queue_wait)
+        lat = self.latency_window()
+        qw = self.queue_wait_window()
         return {
             "queue_depth": depth,
             "requests_total": self.requests_total,
@@ -660,14 +786,19 @@ class BatchedPolicyServer:
                 if self.batch_rows_total
                 else 0.0
             ),
-            "latency_p50_s": self._pct(lat, 50),
-            "latency_p99_s": self._pct(lat, 99),
-            "queue_wait_p50_s": self._pct(qw, 50),
-            "queue_wait_p99_s": self._pct(qw, 99),
+            "latency_p50_s": lat["p50_s"],
+            "latency_p99_s": lat["p99_s"],
+            "queue_wait_p50_s": qw["p50_s"],
+            "queue_wait_p99_s": qw["p99_s"],
             "params_version": self.params_version,
             "fused": self.fused,
             "vectorized": self.vectorized,
             "buckets": list(self.buckets),
+            "aot": (
+                self.aot_cache.stats()
+                if self.aot_cache is not None
+                else None
+            ),
         }
 
     def stop(self, join_timeout: float = 30.0) -> None:
@@ -676,6 +807,8 @@ class BatchedPolicyServer:
             self._cv.notify_all()
         if self._thread is not None and self._thread.is_alive():
             self._thread.join(timeout=join_timeout)
+        if self._owns_aot_cache:
+            self.aot_cache.stop()
 
 
 # -- checkpoint restore / hot-reload sources ----------------------------
@@ -915,6 +1048,7 @@ class PolicyDeployment:
         watch: bool = True,
         poll_interval_s: float = 0.5,
         warmup: bool = True,
+        aot_cache=None,
         config_overrides: Optional[Dict[str, Any]] = None,
     ):
         policy, prep, obs_filter, info = restore_policy(
@@ -932,6 +1066,11 @@ class PolicyDeployment:
             explore=explore,
             obs_filter=obs_filter,
             preprocessor=prep,
+            # a directory path shared across the fleet: every replica
+            # process resolves its own cache client over the same
+            # entries, so the first replica's compiles become every
+            # later replica's cold-start hits
+            aot_cache=aot_cache,
             start=False,
         )
         if warmup:
@@ -989,6 +1128,28 @@ class PolicyDeployment:
         return self.server.compute_actions(
             obs_batch, explore=explore
         )
+
+    def handle_rows(self, rows, explore=None, timeout_s: float = 60.0):
+        """Batch entry point for the ingress coalescing router: one
+        pre-coalesced bucket in, one JSON-friendly result row per
+        request out (same fields as ``__call__``). The rows enqueue
+        atomically (``submit_many``) so a router bucket becomes
+        exactly one fused forward on this replica."""
+        futs = self.server.submit_many(
+            [np.asarray(r) for r in rows], explore=explore
+        )
+        out = []
+        for fut in futs:
+            action, extra = fut.result(timeout_s)
+            row = {
+                "action": np.asarray(action).tolist(),
+                "params_version": fut.params_version,
+            }
+            logp = extra.get("action_logp")
+            if logp is not None:
+                row["logp"] = float(np.asarray(logp))
+            out.append(row)
+        return out
 
     def reconfigure(self, user_config) -> None:
         """Serve-core live config push: an explicit

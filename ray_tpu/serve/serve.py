@@ -716,6 +716,23 @@ def get_deployment(name: str) -> DeploymentHandle:
     return _DEPLOYMENTS[name].handle
 
 
+def get_running(name: str) -> Optional[RunningDeployment]:
+    """Controller-state lookup for the ingress front door: the
+    RunningDeployment owns replica membership and the autoscale loop;
+    the ingress resolves policies against it (ingress/http.py)."""
+    return _DEPLOYMENTS.get(name)
+
+
+def membership_feed(name: str):
+    """The replica-membership feed for ``name`` — the SAME long-poll
+    key the controller publishes on and handles listen to, wrapped as
+    a poll surface (``resilience.discovery.MembershipFeed``) for the
+    ingress coalescing router."""
+    from ray_tpu.resilience.discovery import MembershipFeed
+
+    return MembershipFeed(_LONG_POLL, f"replicas:{name}")
+
+
 def update_deployment(
     name: str,
     *,

@@ -57,6 +57,12 @@ class ShardedFunction:
         self.traces = 0
         self.calls = 0
         self.compile_time_s = 0.0
+        # AOT-installed dispatch path (sharding/aot.py): a compiled
+        # executable restored from the persistent cache ("aot_cache")
+        # or compiled ahead of time here ("aot_live"); None = plain jit
+        self._aot = None
+        self.aot_source: Optional[str] = None
+        self.aot_fallbacks = 0
         # ledger-visible program identity (telemetry/device.py)
         self.in_specs = in_specs
         self.out_specs = out_specs
@@ -97,7 +103,97 @@ class ShardedFunction:
         finally:
             self._uncounted.on = False
 
+    def aot_warmup(self, cache, *args, **kwargs) -> str:
+        """Install an ahead-of-time compiled executable for the ONE
+        abstract signature ``(*args, **kwargs)`` describes (the serve
+        bucket contract: one ShardedFunction = one static shape).
+
+        Tries the persistent cache first — a hit installs the
+        deserialized executable with ZERO fresh compiles and registers
+        it in the device ledger with ``compile_s=0`` /
+        ``source="aot_cache"``. A miss compiles ahead of time (counted
+        as this function's one trace), installs the result, and queues
+        the serialized executable for the cache writer so the NEXT
+        replica hits. Returns ``"hit"`` / ``"compiled"`` /
+        ``"disabled"`` (no cache, or a jax build that can't serialize
+        executables — the caller falls back to plain jit warmup).
+        """
+        from ray_tpu.sharding import aot as aot_lib
+
+        cache = aot_lib.resolve_cache(cache)
+        if cache is None or not aot_lib.supported():
+            return "disabled"
+        try:
+            sig = device_ledger.signature_of(
+                args, kwargs, self.static_argnames
+            )
+        except Exception:
+            return "disabled"
+        loaded = cache.load(self.label, sig)
+        if loaded is not None:
+            self._aot = loaded
+            self.aot_source = "aot_cache"
+            device_ledger.on_aot(self, 0.0, "aot_cache")
+            return "hit"
+        t0 = time.perf_counter()
+        try:
+            with self.uncounted_traces():
+                compiled = self._jitted.lower(
+                    *args, **kwargs
+                ).compile()
+        except Exception:
+            return "disabled"
+        dt = time.perf_counter() - t0
+        with self._lock:
+            # a real XLA compile: count it exactly like a jit trace so
+            # compile_stats stays honest about cold-start cost
+            self.traces += 1
+            self.compile_time_s += dt
+        self._aot = compiled
+        self.aot_source = "aot_live"
+        device_ledger.on_aot(self, dt, "aot_live")
+        cache.save(self.label, sig, compiled)
+        return "compiled"
+
+    def _call_aot(self, args, kwargs):
+        """Dispatch through the installed AOT executable; any failure
+        (signature drift, an executable a stale cache slipped past the
+        keying) drops the AOT path and falls back to plain jit — the
+        graceful-fallback contract. Shape/dtype mismatches raise
+        BEFORE execution, so donated buffers are still intact for the
+        fallback call."""
+        t_wall0 = time.time()
+        t0 = time.perf_counter()
+        try:
+            if tracing.is_enabled():
+                with tracing.start_span("jit:" + self.label) as sp:
+                    out = self._aot(*args, **kwargs)
+                    sp.set_attribute("aot", self.aot_source)
+            else:
+                out = self._aot(*args, **kwargs)
+        except Exception:
+            self._aot = None
+            with self._lock:
+                self.aot_fallbacks += 1
+            tracing.event("aot:fallback", label=self.label)
+            try:
+                from ray_tpu.telemetry import metrics as tm
+
+                tm.inc_aot_cache_event("fallback")
+            except Exception:
+                pass
+            return None
+        dt = time.perf_counter() - t0
+        with self._lock:
+            self.calls += 1
+        device_ledger.on_call(self, t_wall0, dt, traced=False)
+        return (out,)
+
     def __call__(self, *args, **kwargs):
+        if self._aot is not None:
+            boxed = self._call_aot(args, kwargs)
+            if boxed is not None:
+                return boxed[0]
         before = self.traces
         t_wall0 = time.time()
         t0 = time.perf_counter()
@@ -142,13 +238,17 @@ class ShardedFunction:
         return max(0, self.traces - 1)
 
     def stats(self) -> Dict[str, Any]:
-        return {
+        out = {
             "label": self.label,
             "traces": self.traces,
             "recompiles": self.recompiles,
             "calls": self.calls,
             "compile_time_s": self.compile_time_s,
         }
+        if self.aot_source is not None or self.aot_fallbacks:
+            out["aot_source"] = self.aot_source
+            out["aot_fallbacks"] = self.aot_fallbacks
+        return out
 
     def lower(self, *args, **kwargs):
         return self._jitted.lower(*args, **kwargs)

@@ -285,6 +285,7 @@ class _ProgramEntry:
         "memory",
         "n_devices",
         "tid",
+        "source",
     )
 
     def __init__(self, label: str, donate_argnums=(), in_specs=None,
@@ -305,6 +306,13 @@ class _ProgramEntry:
         self.bytes_accessed: Optional[float] = None
         self.memory: Optional[Dict[str, float]] = None
         self.n_devices = 1
+        # how this program's executable came to exist: "live" (jit
+        # traced+compiled in this process), "aot_live" (compiled ahead
+        # of time here, seeding the AOT cache), or "aot_cache"
+        # (deserialized from the persistent cache — compile_s stays 0
+        # and no trace/forensics ever fire, because no compile
+        # happened in this process)
+        self.source = "live"
         # stable synthetic chrome-trace lane for this program
         self.tid = _DEVICE_TID_BASE + (
             zlib.crc32(label.encode()) % 0x10000
@@ -323,6 +331,7 @@ class _ProgramEntry:
             "in_shardings": self.in_shardings,
             "out_shardings": self.out_shardings,
             "n_devices": self.n_devices,
+            "source": self.source,
             "flops": self.flops,
             "bytes_accessed": self.bytes_accessed,
             "memory": self.memory,
@@ -525,6 +534,23 @@ def on_traced(sf, args, kwargs, compile_s: float) -> Optional[str]:
     if _analyze and entry.flops is None:
         _analyze_program(entry, sf, args, kwargs)
     return cause
+
+
+def on_aot(sf, compile_s: float, source: str) -> None:
+    """``sf`` just installed an AOT executable (sharding/aot.py).
+    ``source="aot_cache"`` registers the row with ``compile_s=0`` and
+    NO trace — a cache hit is not a compile, and must not feed the
+    ``jit:recompile`` forensics. ``source="aot_live"`` is the one
+    ahead-of-time compile that seeded the cache: counted exactly like
+    a trace so cold-start cost stays visible."""
+    if not _enabled:
+        return
+    with _LOCK:
+        entry = _entry_for(sf)
+        entry.source = source
+        if source == "aot_live":
+            entry.traces += 1
+            entry.compile_time_s += compile_s
 
 
 def on_call(sf, t_wall0: float, dt: float, traced: bool = False) -> None:

@@ -108,6 +108,25 @@ SERVE_PARAMS_VERSION = "ray_tpu_serve_params_version"
 # a request waited in the queue before its batch launched
 SERVE_BATCH_FILL_FRACTION = "ray_tpu_serve_batch_fill_fraction"
 SERVE_QUEUE_WAIT_SECONDS = "ray_tpu_serve_queue_wait_seconds"
+# ingress front door (docs/serving.md "the front door",
+# ray_tpu/ingress/): per-route request counts by HTTP status, admitted
+# requests currently in flight, sheds by reason (inflight budget /
+# queue-wait / expired deadline), and end-to-end ingress latency
+INGRESS_REQUESTS_TOTAL = "ray_tpu_ingress_requests_total"
+INGRESS_INFLIGHT = "ray_tpu_ingress_inflight"
+INGRESS_SHED_TOTAL = "ray_tpu_ingress_shed_total"
+INGRESS_LATENCY_SECONDS = "ray_tpu_ingress_latency_seconds"
+# cross-replica coalescing router (ingress/router.py): dispatched
+# buckets, rows merged into them, requests dropped at their deadline
+# BEFORE dispatch, and batches re-routed off a dead replica
+ROUTER_BATCHES_TOTAL = "ray_tpu_router_batches_total"
+ROUTER_MERGED_ROWS_TOTAL = "ray_tpu_router_merged_rows_total"
+ROUTER_EXPIRED_TOTAL = "ray_tpu_router_expired_total"
+ROUTER_REROUTED_TOTAL = "ray_tpu_router_rerouted_total"
+# AOT compiled-program cache (sharding/aot.py): hit/miss/save plus
+# the failure lanes (load_error/save_error → misses; fallback = an
+# installed executable rejected at dispatch, reverted to live jit)
+AOT_CACHE_EVENTS_TOTAL = "ray_tpu_aot_cache_events_total"
 # device-plane program ledger (docs/observability.md "device ledger",
 # telemetry/device.py): per compiled program — steady-state execution
 # count, cumulative device-busy seconds closed at the drain points,
@@ -442,6 +461,95 @@ def observe_serve_queue_wait(deployment: str, seconds: float) -> None:
             tag_keys=("deployment",),
         )
     m.observe(float(seconds), {"deployment": deployment})
+
+
+def inc_ingress_request(route: str, status: int) -> None:
+    """One HTTP request answered by the ingress front door, by route
+    and final status code (2xx served, 429/503 shed, 504 expired)."""
+    counter(
+        INGRESS_REQUESTS_TOTAL,
+        "ingress HTTP requests by route and status",
+        ("route", "status"),
+    ).inc(1.0, {"route": route, "status": str(status)})
+
+
+def set_ingress_inflight(n: int) -> None:
+    """Requests admitted past the front door and not yet answered —
+    the admission controller's bounded budget."""
+    gauge(
+        INGRESS_INFLIGHT,
+        "admitted ingress requests currently in flight",
+    ).set(float(n))
+
+
+def inc_ingress_shed(reason: str, n: int = 1) -> None:
+    """One request shed at the ingress: ``inflight`` (budget
+    exhausted → 429), ``queue_wait`` (replica waits over target →
+    503), or ``deadline`` (already expired on arrival → 504)."""
+    counter(
+        INGRESS_SHED_TOTAL,
+        "requests shed by the admission controller, by reason",
+        ("reason",),
+    ).inc(float(n), {"reason": reason})
+
+
+def observe_ingress_latency(route: str, seconds: float) -> None:
+    """End-to-end ingress latency: socket accept to response write —
+    the number a client actually experiences (queue wait + coalesce +
+    forward + serialization)."""
+    m = get_metric(INGRESS_LATENCY_SECONDS)
+    if not isinstance(m, Histogram):
+        m = Histogram(
+            INGRESS_LATENCY_SECONDS,
+            "end-to-end ingress request latency seconds",
+            tag_keys=("route",),
+        )
+    m.observe(float(seconds), {"route": route})
+
+
+def observe_router_batch(deployment: str, rows: int) -> None:
+    """One coalesced bucket the router dispatched to a replica, with
+    the rows merged into it (cross-request, cross-connection)."""
+    counter(
+        ROUTER_BATCHES_TOTAL,
+        "coalesced buckets dispatched by the router",
+        ("deployment",),
+    ).inc(1.0, {"deployment": deployment})
+    counter(
+        ROUTER_MERGED_ROWS_TOTAL,
+        "rows merged into dispatched router buckets",
+        ("deployment",),
+    ).inc(float(rows), {"deployment": deployment})
+
+
+def inc_router_expired(deployment: str, n: int = 1) -> None:
+    """Requests the router dropped at their deadline BEFORE dispatch
+    (no dead device work was computed for them)."""
+    counter(
+        ROUTER_EXPIRED_TOTAL,
+        "requests dropped at their deadline before dispatch",
+        ("deployment",),
+    ).inc(float(n), {"deployment": deployment})
+
+
+def inc_router_rerouted(deployment: str, n: int = 1) -> None:
+    """Requests re-queued off a replica that died mid-dispatch and
+    routed to a surviving one."""
+    counter(
+        ROUTER_REROUTED_TOTAL,
+        "requests rerouted off dead replicas",
+        ("deployment",),
+    ).inc(float(n), {"deployment": deployment})
+
+
+def inc_aot_cache_event(event: str, n: int = 1) -> None:
+    """AOT compile-cache traffic (sharding/aot.py): hit / miss / save
+    / load_error / save_error / fallback."""
+    counter(
+        AOT_CACHE_EVENTS_TOTAL,
+        "AOT compiled-program cache events",
+        ("event",),
+    ).inc(float(n), {"event": event})
 
 
 def inc_program_execution(program: str, n: int = 1) -> None:
