@@ -2913,17 +2913,22 @@ def bench_observability(
 def bench_lint(out_path=None, reps=2):
     """Device-contract static-analysis pass over all of ``ray_tpu/``
     (docs/static_analysis.md): reports scan wall time (the cost the
-    tier-1 gate pays every run), file count, per-rule finding counts,
-    and baseline/suppression totals. Pure AST — no jax import, so it
-    benches identically on broken-accelerator images. Writes
+    tier-1 gate pays every run — the gate test budgets against the
+    recorded number), file count, per-rule finding counts,
+    baseline/suppression totals, and the ``--since`` incremental
+    wall (empty change set: the parse+program-build floor every
+    pre-commit run pays). Pure AST — no jax import, so it benches
+    identically on broken-accelerator images. Writes
     ``benchmarks/e2e/static_analysis.json``."""
     import os
 
     from ray_tpu.analysis import (
+        SCHEMA_VERSION,
         default_baseline_path,
         load_baseline,
         scan_paths,
     )
+    from ray_tpu.analysis.rules import all_rules
 
     os.makedirs("benchmarks/e2e", exist_ok=True)
     out_path = out_path or "benchmarks/e2e/static_analysis.json"
@@ -2939,10 +2944,16 @@ def bench_lint(out_path=None, reps=2):
     for _ in range(max(1, int(reps))):
         res = scan_paths(["ray_tpu"], baseline=baseline)
         walls.append(round(res.duration_s, 3))
+    # the incremental floor: parse + whole-program build with zero
+    # rule work (what `--since <rev>` costs on an unchanged tree)
+    since = scan_paths(["ray_tpu"], baseline=baseline, changed=[])
     report = {
         "metric": "static_analysis",
+        "schema_version": SCHEMA_VERSION,
+        "rules": len(all_rules()),
         "scan_wall_s": walls[-1],
         "scan_wall_s_cold": walls[0],
+        "since_wall_s": round(since.duration_s, 3),
         "files": res.files,
         "findings_unbaselined": len(res.findings),
         "findings_by_rule": res.counts(),

@@ -65,10 +65,12 @@ class Checkpoint:
                     self._directory, path, dirs_exist_ok=True
                 )
         else:
-            with open(
-                os.path.join(path, "checkpoint.pkl"), "wb"
-            ) as f:
-                pickle.dump(self._data, f)
+            from ray_tpu.util.atomic_io import atomic_write
+
+            atomic_write(
+                os.path.join(path, "checkpoint.pkl"),
+                lambda f: pickle.dump(self._data, f),
+            )
         return path
 
     def to_bytes(self) -> bytes:

@@ -697,28 +697,13 @@ class Algorithm(Trainable):
 
     @staticmethod
     def _atomic_write(path: str, write_fn) -> None:
-        """Write through a same-directory temp file + ``os.replace`` so
-        a crash mid-save leaves either the old complete file or the new
-        complete file — never a truncated one. fsync before the rename:
-        the replace must not be reordered ahead of the data blocks."""
-        import tempfile
+        """Delegate to the shared helper (``util.atomic_io``, the one
+        RTA009-sanctioned implementation). Directory sync stays with
+        the caller: ``save_checkpoint`` batches several files and
+        issues ONE ``_fsync_dir`` at the end."""
+        from ray_tpu.util.atomic_io import atomic_write
 
-        fd, tmp = tempfile.mkstemp(
-            dir=os.path.dirname(path) or ".",
-            prefix=os.path.basename(path) + ".tmp.",
-        )
-        try:
-            with os.fdopen(fd, "wb") as f:
-                write_fn(f)
-                f.flush()
-                os.fsync(f.fileno())
-            os.replace(tmp, path)
-        except BaseException:
-            try:
-                os.unlink(tmp)
-            except OSError:
-                pass
-            raise
+        atomic_write(path, write_fn, sync_dir=False)
 
     def save_checkpoint(self, checkpoint_dir: str) -> str:
         """reference algorithm.py:1438. Alongside the state, a
@@ -773,14 +758,9 @@ class Algorithm(Trainable):
 
     @staticmethod
     def _fsync_dir(path: str) -> None:
-        try:
-            fd = os.open(path, os.O_RDONLY)
-        except OSError:
-            return  # platform without directory fds: best effort
-        try:
-            os.fsync(fd)
-        finally:
-            os.close(fd)
+        from ray_tpu.util.atomic_io import fsync_dir
+
+        fsync_dir(path)
 
     def _prune_old_checkpoints(self, checkpoint_dir: str) -> None:
         """Prune sibling ``checkpoint_*`` directories down to the

@@ -39,6 +39,7 @@ class AlgorithmConfig:
         self.jax_fused_rollout = True
 
         # framework (reference :408)
+        # ray-tpu: allow[RTA012] API-parity stub: the framework is always jax here; the knob exists so reference configs round-trip
         self.framework_str = "jax"
 
         # rollouts (reference :533)
@@ -47,6 +48,7 @@ class AlgorithmConfig:
         self.rollout_fragment_length = 200
         self.batch_mode = "truncate_episodes"
         self.observation_filter = "NoFilter"
+        # ray-tpu: allow[RTA012] API-parity stub: in-process transport never serializes observations, so there is nothing to compress
         self.compress_observations = False
         self.ignore_worker_failures = False
         self.recreate_failed_workers = False
@@ -204,6 +206,13 @@ class AlgorithmConfig:
         # model_parallel=1 is the parity geometry: per-leaf specs flow
         # but every leaf stays whole — bit-identical to replicated.
         self.model_parallel = None
+        # AOT compiled-program cache directory (sharding/aot.py,
+        # docs/serving.md "the front door"): when set, the policy's
+        # learn program warms through the fleet-shared executable
+        # cache at its first build — an elastic joiner (or a restarted
+        # driver) whose predecessor populated the cache compiles
+        # NOTHING on the learn path. None = live jit (the default).
+        self.aot_cache_dir = None
 
         # exploration
         self.explore = True
@@ -218,6 +227,7 @@ class AlgorithmConfig:
         # evaluation (reference :800)
         self.evaluation_interval = None
         self.evaluation_duration = 10
+        # ray-tpu: allow[RTA012] API-parity stub: evaluation counts episodes only; the timesteps unit is unimplemented and documented as such
         self.evaluation_duration_unit = "episodes"
         self.evaluation_num_workers = 0
         self.evaluation_config: Dict = {}
@@ -238,9 +248,14 @@ class AlgorithmConfig:
         # trace (bool → span tracing + per-iteration overlap rollup).
         self.telemetry_config: Dict = {}
 
-        # debugging / resources
+        # debugging / resources — API-parity stubs: this runtime
+        # schedules TPU meshes + CPU actors, not per-trial GPUs, and
+        # logging rides the host config
+        # ray-tpu: allow[RTA012] API-parity stub (see block comment)
         self.log_level = "WARN"
+        # ray-tpu: allow[RTA012] API-parity stub (see block comment)
         self.num_gpus = 0
+        # ray-tpu: allow[RTA012] API-parity stub (see block comment)
         self.num_cpus_per_worker = 1
 
         # callbacks
@@ -415,13 +430,19 @@ class AlgorithmConfig:
         *,
         sharding_backend: Optional[str] = None,
         model_parallel=None,
+        aot_cache_dir: Optional[str] = None,
         **kwargs,
     ) -> "AlgorithmConfig":
         """Learner-plane placement (docs/sharding.md).
         ``sharding_backend``: "mesh" (default) | "pmap" — same knob as
         :meth:`resources`. ``model_parallel``: "auto" | int M — build
         the 2-D (data x model) mesh and partition params per the
-        model's rules; see the attribute comment in ``__init__``."""
+        model's rules; see the attribute comment in ``__init__``.
+        ``aot_cache_dir``: fleet-shared AOT executable cache the learn
+        program warms through (zero fresh compiles for elastic
+        joiners on a warm cache)."""
+        if aot_cache_dir is not None:
+            self.aot_cache_dir = str(aot_cache_dir)
         if sharding_backend is not None:
             if sharding_backend not in ("mesh", "pmap"):
                 raise ValueError(

@@ -724,6 +724,7 @@ class Dreamer(Algorithm):
         while not done:
             if random:
                 # prefill: uniform actions, no latent filtering needed
+                # ray-tpu: allow[RTA011] the episode-length predicate only reaches device data through the NON-random branch's actions; when random=True every action in the trajectory came from this host generator, so the draw count is host-deterministic
                 tanh_a = self._np_rng.uniform(
                     -1.0, 1.0, self.act_dim
                 ).astype(np.float32)

@@ -1,30 +1,48 @@
-"""``ray_tpu.analysis`` — device-contract static analyzer.
+"""``ray_tpu.analysis`` — whole-program device-contract analyzer.
 
 An AST-based rule engine encoding the repo's device contracts
 (docs/static_analysis.md has the catalog and the originating bug for
-each rule):
+each rule). v2 runs on a repo-wide symbol table + call graph with a
+global device/thread/f64 fixed point and a local device-taint pass
+(:mod:`ray_tpu.analysis.program`), so cross-module chains — router
+batcher → server submit, streamer thread → atomic writer — are
+checkable.
 
 ==========  ============================================================
 RTA001      use-after-donate: a tree donated to a ``sharded_jit``
-            program read again before reassignment
+            program read again (directly or via a local alias) before
+            reassignment
 RTA002      trace hazards: host numpy / ``.item()`` / coercions inside
             device contexts; bare Python scalars fed to cached programs
 RTA003      weak-type promotion: bare float literals in f64 scopes
             (the PR-11 ``|td|+1e-6`` divergence class)
 RTA004      RNG discipline: global ``np.random.*`` in library code;
             PRNG keys consumed twice without split/fold_in
-RTA005      host sync in hot paths: blocking D2H outside the counted
-            drain helpers in superstep/serve/learner-thread spans
+RTA005      host sync in hot paths: blocking D2H (explicit primitives
+            AND taint-tracked implicit coercions) outside the counted
+            drain helpers
 RTA006      thread ownership: cross-thread calls between
             ``# ray-tpu: thread=<owner>``-annotated surfaces
+RTA007      blocking call reachable from the event loop (async defs /
+            ``thread=*-loop`` owners, over the call graph)
+RTA008      lock-order inversions collected across the call graph
+RTA009      durability: ``os.replace`` outside the atomic-write
+            helper, unfsynced renames, raw checkpoint opens
+RTA010      metric/span catalog consistency against
+            docs/observability.md (names AND label sets)
+RTA011      host-RNG draws under device-taint-derived conditionals
+            (draw-count determinism)
+RTA012      AlgorithmConfig knob reachability + docs/API.md index
 ==========  ============================================================
 
 Run ``python -m ray_tpu.analysis`` (pure AST — works without jax);
+``--since REV`` scans changed files + reverse call-graph dependents;
 CI gates on zero unbaselined findings via
 ``tests/test_static_analysis.py``.
 """
 
 from ray_tpu.analysis.engine import (  # noqa: F401
+    SCHEMA_VERSION,
     Finding,
     ModuleModel,
     ScanResult,
@@ -32,4 +50,8 @@ from ray_tpu.analysis.engine import (  # noqa: F401
     load_baseline,
     save_baseline,
     scan_paths,
+)
+from ray_tpu.analysis.program import (  # noqa: F401
+    ProgramModel,
+    TaintInfo,
 )

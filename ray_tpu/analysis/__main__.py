@@ -3,11 +3,21 @@
     python -m ray_tpu.analysis [paths...] [--json] [--rules RTA00X,..]
                                [--baseline PATH|--no-baseline]
                                [--write-baseline] [--root DIR]
+                               [--since REV]
 
 Exit status: 0 when every finding is suppressed or baselined, 1 when
 unbaselined findings remain, 2 on parse errors. Stale baseline
 entries are reported (the baseline should only ever shrink) but do
-not fail the run.
+not fail the run — ``--write-baseline`` prunes them automatically.
+
+``--since REV`` is the incremental pre-commit mode: the whole tree is
+still parsed (cross-module facts need the full call graph — parsing
+is the cheap part), but rules run only over the files git reports
+changed since ``REV`` plus their reverse call-graph/import
+dependents, and findings/baseline bookkeeping is scoped to that set.
+A change under ``docs/`` falls back to a full scan (the catalog
+rules read the docs). ``--json`` reports carry ``schema_version``
+(``engine.SCHEMA_VERSION``) so CI consumers can pin what they parse.
 """
 
 from __future__ import annotations
@@ -15,6 +25,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import subprocess
 import sys
 
 from ray_tpu.analysis.engine import (
@@ -23,6 +34,48 @@ from ray_tpu.analysis.engine import (
     save_baseline,
     scan_paths,
 )
+
+
+def _git_changed(root: str, rev: str):
+    """Repo-relative paths changed since ``rev`` (committed, staged,
+    unstaged, and untracked). Returns ``(py_paths, docs_changed)`` or
+    None when git is unavailable / the rev is bad."""
+    try:
+        diff = subprocess.run(
+            ["git", "diff", "--name-only", rev, "--"],
+            cwd=root,
+            capture_output=True,
+            text=True,
+            timeout=30,
+        )
+        if diff.returncode != 0:
+            return None
+        untracked = subprocess.run(
+            ["git", "ls-files", "--others", "--exclude-standard"],
+            cwd=root,
+            capture_output=True,
+            text=True,
+            timeout=30,
+        )
+    except (OSError, subprocess.SubprocessError):
+        return None
+    names = [
+        ln.strip()
+        for ln in (
+            diff.stdout.splitlines()
+            + (
+                untracked.stdout.splitlines()
+                if untracked.returncode == 0
+                else []
+            )
+        )
+        if ln.strip()
+    ]
+    py = [n for n in names if n.endswith(".py")]
+    docs_changed = any(
+        n.startswith("docs/") and n.endswith(".md") for n in names
+    )
+    return py, docs_changed
 
 
 def main(argv=None) -> int:
@@ -56,12 +109,21 @@ def main(argv=None) -> int:
     ap.add_argument(
         "--write-baseline",
         action="store_true",
-        help="write the current findings as the new baseline",
+        help="write the current findings as the new baseline, "
+        "pruning stale entries automatically (under --since, "
+        "out-of-scope entries are kept verbatim)",
     )
     ap.add_argument(
         "--rules",
         default=None,
         help="comma-separated rule ids to run (default: all)",
+    )
+    ap.add_argument(
+        "--since",
+        default=None,
+        metavar="REV",
+        help="incremental mode: run rules only on files changed "
+        "since REV plus their reverse call-graph dependents",
     )
     args = ap.parse_args(argv)
 
@@ -79,15 +141,58 @@ def main(argv=None) -> int:
 
         rules = rules_by_id(args.rules.split(","))
 
+    changed = None
+    if args.since:
+        got = _git_changed(root, args.since)
+        if got is None:
+            print(
+                f"--since {args.since}: git unavailable or bad rev; "
+                "falling back to a full scan",
+                file=sys.stderr,
+            )
+        else:
+            py, docs_changed = got
+            if not docs_changed:
+                changed = py
+            # else: the catalog rules (RTA010/RTA012) read docs/*.md
+            # — a doc edit can change findings anywhere → full scan
+
     result = scan_paths(
-        paths, root=root, baseline=baseline, rules=rules
+        paths,
+        root=root,
+        baseline=baseline,
+        rules=rules,
+        changed=changed,
     )
 
     if args.write_baseline:
-        save_baseline(baseline_path, result.findings)
+        new_keys = {f.key for f in result.findings}
+        pruned = 0
+        keys = set(new_keys)
+        if os.path.exists(baseline_path):
+            old = load_baseline(baseline_path)
+            old_keys = {
+                (e["rule"], e["path"], e["symbol"]) for e in old
+            }
+            if result.affected_paths is not None:
+                # incremental: out-of-scope entries were not
+                # re-validated — keep them; in-scope entries whose
+                # finding is gone are pruned
+                out_of_scope = {
+                    k
+                    for k in old_keys
+                    if k[1] not in result.affected_paths
+                }
+                keys |= out_of_scope
+                pruned = len(old_keys - keys)
+            else:
+                pruned = len(old_keys - new_keys)
+        save_baseline(
+            baseline_path, result.findings, keys=sorted(keys)
+        )
         print(
-            f"wrote {len({f.key for f in result.findings})} entries "
-            f"to {baseline_path}"
+            f"wrote {len(keys)} entr(ies) to {baseline_path}"
+            + (f" ({pruned} stale pruned)" if pruned else "")
         )
         return 0
 
@@ -98,7 +203,8 @@ def main(argv=None) -> int:
             print(f.render())
         for e in result.stale_baseline:
             print(
-                "stale baseline entry (fixed or moved — remove it): "
+                "stale baseline entry (fixed or moved — remove it, "
+                "or run --write-baseline to prune): "
                 f"{e['rule']} {e['path']} [{e['symbol']}]"
             )
         for err in result.parse_errors:
@@ -113,11 +219,17 @@ def main(argv=None) -> int:
             if counts
             else ""
         )
+        scope = (
+            f" [--since scope: {result.affected_files} files]"
+            if result.mode == "since"
+            else ""
+        )
         print(
             f"{len(result.findings)} unbaselined finding(s){by_rule}, "
             f"{len(result.baselined)} baselined, "
             f"{len(result.stale_baseline)} stale baseline entr(ies) — "
             f"{result.files} files in {result.duration_s:.2f}s"
+            f"{scope}"
         )
     if result.parse_errors:
         return 2

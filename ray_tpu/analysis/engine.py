@@ -67,7 +67,16 @@ _ALLOW_RE = re.compile(r"allow\[([A-Za-z0-9_,\s]+)\]\s*(.*)$")
 _THREAD_RE = re.compile(r"thread=([A-Za-z0-9_\-]+)$")
 
 #: directives a def header understands (besides allow/thread)
-_FLAG_DIRECTIVES = {"device-fn", "host-fn", "f64", "hot-path", "drain-ok"}
+_FLAG_DIRECTIVES = {
+    "device-fn",
+    "host-fn",
+    "f64",
+    "hot-path",
+    "drain-ok",
+    # RTA009: the sanctioned atomic-write implementation — the ONE
+    # place allowed to hand-roll temp + fsync + os.replace
+    "atomic-writer",
+}
 
 #: the tracing entry points whose function arguments become device
 #: contexts. Matched on the LAST attribute of the dotted call name,
@@ -150,6 +159,15 @@ class FuncInfo:
     device: bool = False
     f64: bool = False
     hot: bool = False
+    # whole-program facts (ray_tpu.analysis.program): the module this
+    # def lives in, and every thread owner whose call chains can reach
+    # it (seeded from `thread=` annotations, propagated globally)
+    module: Optional["ModuleModel"] = None
+    owners: Set[str] = field(default_factory=set)
+
+    @property
+    def is_async(self) -> bool:
+        return isinstance(self.node, ast.AsyncFunctionDef)
 
 
 def dotted_name(node: ast.AST) -> Optional[str]:
@@ -188,6 +206,14 @@ class ModuleModel:
     def __init__(self, path: str, relpath: str, source: str):
         self.path = path
         self.relpath = relpath.replace(os.sep, "/")
+        # dotted module name derived from the repo-relative path —
+        # the whole-program symbol table's key space
+        mod = self.relpath[:-3] if self.relpath.endswith(".py") else (
+            self.relpath
+        )
+        if mod.endswith("/__init__"):
+            mod = mod[: -len("/__init__")]
+        self.module_name = mod.replace("/", ".")
         self.source = source
         self.tree = ast.parse(source, filename=path)
         self.lines = source.splitlines()
@@ -279,6 +305,8 @@ class ModuleModel:
                     visit(child, node, qual, parent_fn)
 
         visit(self.tree, None, "", None)
+        for fi in self.funcs:
+            fi.module = self
 
     def parent(self, node: ast.AST) -> Optional[ast.AST]:
         return self._parents.get(node)
@@ -531,10 +559,19 @@ def load_baseline(path: str) -> List[Dict]:
     return list(data.get("entries", []))
 
 
-def save_baseline(path: str, findings: Sequence[Finding]) -> None:
+def save_baseline(
+    path: str,
+    findings: Sequence[Finding],
+    *,
+    keys: Optional[Sequence[Tuple[str, str, str]]] = None,
+) -> None:
+    """Write the baseline from ``findings`` (deduped per
+    ``(rule, path, symbol)``), or from an explicit ``keys`` list when
+    the caller merged scopes itself (the ``--since`` +
+    ``--write-baseline`` path)."""
     entries = sorted(
-        {f.key for f in findings}
-    )  # dedup per (rule, path, symbol)
+        set(keys) if keys is not None else {f.key for f in findings}
+    )
     data = {
         "version": 1,
         "entries": [
@@ -550,6 +587,11 @@ def save_baseline(path: str, findings: Sequence[Finding]) -> None:
 # ---------------------------------------------------------------------------
 # scanning
 
+#: version of the machine-readable report (``--json``); bumped on any
+#: field change so CI consumers can pin what they parse
+SCHEMA_VERSION = 2
+
+
 @dataclass
 class ScanResult:
     findings: List[Finding]  # unbaselined, unsuppressed
@@ -558,6 +600,12 @@ class ScanResult:
     files: int
     duration_s: float
     parse_errors: List[str] = field(default_factory=list)
+    mode: str = "full"  # "full" | "since"
+    affected_files: Optional[int] = None  # since-mode scope size
+    rules_run: int = 0
+    # since-mode scope (repo-relative paths); not serialized — the
+    # CLI's --write-baseline merge needs it
+    affected_paths: Optional[Set[str]] = None
 
     @property
     def ok(self) -> bool:
@@ -565,8 +613,12 @@ class ScanResult:
 
     def to_dict(self) -> Dict:
         return {
+            "schema_version": SCHEMA_VERSION,
             "ok": self.ok,
+            "mode": self.mode,
             "files": self.files,
+            "affected_files": self.affected_files,
+            "rules_run": self.rules_run,
             "duration_s": round(self.duration_s, 3),
             "findings": [f.to_dict() for f in self.findings],
             "baselined": [f.to_dict() for f in self.baselined],
@@ -605,16 +657,27 @@ def scan_paths(
     root: Optional[str] = None,
     baseline: Optional[Sequence[Dict]] = None,
     rules: Optional[Sequence] = None,
+    changed: Optional[Sequence[str]] = None,
 ) -> ScanResult:
     """Scan ``paths`` (files or directories) with every registered
     rule. ``root`` anchors the repo-relative paths findings and
-    baseline entries use (default: cwd)."""
+    baseline entries use (default: cwd).
+
+    Every scan parses ALL of ``paths`` and builds the whole-program
+    model (symbol table + call graph + global facts — the parse is
+    the cheap part and cross-module facts need the full tree).
+    ``changed`` (repo-relative paths, the ``--since`` mode) then
+    restricts where RULES run: the changed files plus their reverse
+    call-graph/import dependents. Findings, baseline hits, and stale
+    detection are all scoped to that affected set.
+    """
+    from ray_tpu.analysis.program import ProgramModel
     from ray_tpu.analysis.rules import all_rules
 
     root = os.path.abspath(root or os.getcwd())
     active = list(rules) if rules is not None else all_rules()
     t0 = time.perf_counter()
-    raw: List[Finding] = []
+    models: List[ModuleModel] = []
     files = 0
     errors: List[str] = []
     for path in iter_py_files(paths):
@@ -623,13 +686,35 @@ def scan_paths(
         try:
             with open(apath, encoding="utf-8") as f:
                 source = f.read()
-            model = ModuleModel(apath, rel, source)
+            models.append(ModuleModel(apath, rel, source))
         except (SyntaxError, UnicodeDecodeError) as e:
             errors.append(f"{rel}: {e}")
             continue
         files += 1
-        for rule in active:
-            raw.extend(rule.check(model))
+
+    program = ProgramModel(models, root)
+    affected: Optional[Set[str]] = None
+    if changed is not None:
+        affected = program.affected_by(changed)
+        # program-level rules consult this to skip out-of-scope
+        # modules (their findings are filtered to it anyway; the
+        # call-graph facts they read were already computed globally)
+        program.affected = affected
+
+    raw: List[Finding] = []
+    for rule in active:
+        if hasattr(rule, "check_program"):
+            raw.extend(rule.check_program(program))
+        else:
+            for model in models:
+                if (
+                    affected is not None
+                    and model.relpath not in affected
+                ):
+                    continue
+                raw.extend(rule.check(model))
+    if affected is not None:
+        raw = [f for f in raw if f.path in affected]
     raw.sort(key=lambda f: (f.path, f.line, f.rule))
 
     base_keys = {
@@ -647,6 +732,7 @@ def scan_paths(
         e
         for e in (baseline or ())
         if (e["rule"], e["path"], e["symbol"]) not in hit_keys
+        and (affected is None or e["path"] in affected)
     ]
     return ScanResult(
         findings=kept,
@@ -655,6 +741,10 @@ def scan_paths(
         files=files,
         duration_s=time.perf_counter() - t0,
         parse_errors=errors,
+        mode="full" if changed is None else "since",
+        affected_files=None if affected is None else len(affected),
+        rules_run=len(active),
+        affected_paths=affected,
     )
 
 

@@ -1,0 +1,834 @@
+"""Whole-program model: symbol table, call graph, global facts, taint.
+
+PR 12's engine classified each module in isolation — its device/thread
+fixed point stopped at module boundaries, so the exact cross-module
+chains the ingress and resilience planes run (router batcher → server
+submit, streamer thread → atomic writer) were invisible. This module
+is the v2 upgrade: it consumes every :class:`ModuleModel` of a scan
+and builds
+
+- a **repo-wide symbol table** — per-module imports, module-level
+  functions, classes with their methods, base classes, and attribute
+  types (``self.router = CoalescingRouter(...)`` /
+  ``self._streamer: CheckpointStreamer`` / annotated ``__init__``
+  params bound straight to ``self``);
+- a **call graph** — every resolvable call edge: lexical-scope names,
+  ``self.method()`` (through base classes), ``self.attr.method()``
+  through the attribute types above, ``mod.func()`` /
+  ``mod.Class(...)`` / ``Class.method()`` through the import table,
+  and locals whose class is inferable from an annotation or a
+  constructor assignment. Unresolvable calls (stdlib, jax, dynamic
+  dispatch) simply contribute no edge — the analysis stays sound for
+  what it claims and silent about the rest;
+- **global fixed points** — device-context, f64-zone, and
+  thread-owner facts propagated along the call edges to a repo-wide
+  fixed point (``FuncInfo.device`` / ``.f64`` / ``.owners``), so
+  RTA002/RTA003 see trace-time helpers in other modules and
+  RTA007/RTA008 know which threads can execute a function;
+- a **light intraprocedural dataflow pass** — per-function local
+  aliasing plus "value derived from a device array" taint
+  (:meth:`ProgramModel.taint`): sources are compiled-program results,
+  ``jax.device_get``, ``.item()`` / ``.tolist()``; taint flows
+  through assignments, subscripts, arithmetic, and simple coercions.
+  RTA005 upgrades onto it (device-derived coercions in hot spans),
+  RTA011 uses it for conditional host-RNG draws, and RTA001's
+  alias tracking rides the same machinery.
+
+The model is pure ``ast`` — building it never imports jax — and costs
+one extra walk over the already-parsed trees.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from ray_tpu.analysis.engine import (
+    FuncInfo,
+    ModuleModel,
+    dotted_name,
+)
+
+__all__ = ["ClassInfo", "ProgramModel", "TaintInfo"]
+
+
+@dataclass(eq=False)
+class ClassInfo:
+    name: str
+    qualname: str  # module.Class
+    module: ModuleModel
+    node: ast.ClassDef
+    methods: Dict[str, FuncInfo] = field(default_factory=dict)
+    bases: List[str] = field(default_factory=list)  # as written
+    #: self.<attr> -> ClassInfo (resolved after all classes exist)
+    attr_types: Dict[str, "ClassInfo"] = field(default_factory=dict)
+
+
+class TaintInfo:
+    """Per-function device-taint state in two strengths, tracked over
+    local expression keys (``x`` / ``self.x`` dotted chains) in
+    linearized statement order (a forward approximation: once
+    tainted, a key stays tainted until stored clean):
+
+    - ``device``: the value is (or contains) a still-on-device array —
+      a compiled program's output that nothing materialized yet.
+      Coercing one blocks (RTA005's implicit-sync check).
+    - ``derived``: a HOST value computed from device data
+      (``jax.device_get`` / ``.item()`` results and anything built
+      from them). Reading one is free, but branching on one makes
+      host control flow a function of device rounding (RTA011).
+    """
+
+    def __init__(
+        self,
+        device: Set[str],
+        derived: Set[str],
+        sources: Dict[str, int],
+    ):
+        self.device = device
+        self.derived = derived
+        self.sources = sources  # key -> line of the tainting stmt
+
+    def _hits(self, expr: ast.AST, keys: Set[str]) -> bool:
+        for node in ast.walk(expr):
+            if isinstance(node, (ast.Name, ast.Attribute)):
+                key = dotted_name(node)
+                if key and key in keys:
+                    return True
+        return False
+
+    def is_device(self, expr: ast.AST) -> bool:
+        """``expr`` reads a still-on-device program output."""
+        return self._hits(expr, self.device)
+
+    def is_tainted(self, expr: ast.AST) -> bool:
+        """``expr`` depends on device data at all (device OR derived
+        keys, or a materializing call inside the expression)."""
+        if self._hits(expr, self.device | self.derived):
+            return True
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Call) and _is_taint_source(node):
+                return True
+        return False
+
+
+# -- taint helpers ----------------------------------------------------
+
+_SYNC_SOURCES = {"item", "tolist"}
+
+
+def _is_taint_source(call: ast.Call) -> bool:
+    name = dotted_name(call.func) or ""
+    last = name.split(".")[-1]
+    if last == "device_get":
+        return True
+    if (
+        isinstance(call.func, ast.Attribute)
+        and call.func.attr in _SYNC_SOURCES
+    ):
+        return True
+    return False
+
+
+def _compiled_value(node: ast.AST) -> bool:
+    """Whether ``node`` is a call that builds or IS a compiled device
+    program invocation shape: ``sharded_jit(...)`` or the repo's
+    ``_build_*_fn`` / ``build_*_fn`` builders."""
+    if not isinstance(node, ast.Call):
+        return False
+    last = (dotted_name(node.func) or "").split(".")[-1]
+    return (
+        last == "sharded_jit"
+        or (last.startswith("_build_") and last.endswith("_fn"))
+        or (last.startswith("build_") and last.endswith("_fn"))
+    )
+
+
+class ProgramModel:
+    """The whole-program view over one scan's :class:`ModuleModel`s."""
+
+    def __init__(self, modules: Sequence[ModuleModel], root: str):
+        self.root = os.path.abspath(root)
+        self.modules: List[ModuleModel] = list(modules)
+        self.by_name: Dict[str, ModuleModel] = {
+            m.module_name: m for m in self.modules
+        }
+        # module -> {alias: dotted target module}
+        self._mod_imports: Dict[ModuleModel, Dict[str, str]] = {}
+        # module -> {alias: (target module dotted, symbol name)}
+        self._sym_imports: Dict[
+            ModuleModel, Dict[str, Tuple[str, str]]
+        ] = {}
+        # module -> {name: top-level FuncInfo}
+        self._mod_funcs: Dict[ModuleModel, Dict[str, FuncInfo]] = {}
+        self.classes: Dict[str, ClassInfo] = {}  # module.Class
+        self._class_by_simple: Dict[str, List[ClassInfo]] = {}
+        self._class_of_method: Dict[FuncInfo, ClassInfo] = {}
+        self._local_types_cache: Dict[
+            FuncInfo, Dict[str, ClassInfo]
+        ] = {}
+
+        self._build_symbols()
+        self._build_attr_types()
+        # call graph: caller -> [(call node, callee)]
+        self.calls: Dict[
+            FuncInfo, List[Tuple[ast.Call, FuncInfo]]
+        ] = {}
+        self.edges: Dict[FuncInfo, Set[FuncInfo]] = {}
+        self.redges: Dict[FuncInfo, Set[FuncInfo]] = {}
+        self._build_call_graph()
+        self._propagate_facts()
+        self._taints: Dict[FuncInfo, TaintInfo] = {}
+        #: --since scope (repo-relative paths) or None for full
+        #: scans; the engine sets it so per-module sweeps inside
+        #: program rules can skip out-of-scope modules
+        self.affected: Optional[Set[str]] = None
+
+    def in_scope(self, model: ModuleModel) -> bool:
+        return self.affected is None or model.relpath in self.affected
+
+    # -- symbol table ----------------------------------------------------
+
+    def _build_symbols(self) -> None:
+        for m in self.modules:
+            mod_imports: Dict[str, str] = {}
+            sym_imports: Dict[str, Tuple[str, str]] = {}
+            for node in ast.walk(m.tree):
+                if isinstance(node, ast.Import):
+                    for alias in node.names:
+                        name = alias.asname or alias.name.split(".")[0]
+                        target = (
+                            alias.name
+                            if alias.asname
+                            else alias.name.split(".")[0]
+                        )
+                        mod_imports[name] = target
+                elif isinstance(node, ast.ImportFrom):
+                    if node.level:  # relative: resolve against module
+                        # "from . import x" in pkg/mod.py (level 1)
+                        # targets pkg; in pkg/__init__.py it targets
+                        # pkg itself (the package IS the module name)
+                        parts = m.module_name.split(".")
+                        drop = node.level - (
+                            1 if m.relpath.endswith("__init__.py") else 0
+                        )
+                        base = parts[: len(parts) - drop] if drop else parts
+                        prefix = ".".join(base)
+                        target_mod = (
+                            f"{prefix}.{node.module}"
+                            if node.module
+                            else prefix
+                        )
+                    else:
+                        target_mod = node.module or ""
+                    for alias in node.names:
+                        if alias.name == "*":
+                            continue
+                        name = alias.asname or alias.name
+                        sym_imports[name] = (target_mod, alias.name)
+            self._mod_imports[m] = mod_imports
+            self._sym_imports[m] = sym_imports
+
+            funcs: Dict[str, FuncInfo] = {}
+            for fi in m.funcs:
+                if fi.parent is None and "." not in fi.qualname:
+                    funcs.setdefault(fi.node.name, fi)
+            self._mod_funcs[m] = funcs
+
+            for node in ast.walk(m.tree):
+                if not isinstance(node, ast.ClassDef):
+                    continue
+                qual = f"{m.module_name}.{node.name}"
+                ci = ClassInfo(
+                    name=node.name,
+                    qualname=qual,
+                    module=m,
+                    node=node,
+                    bases=[
+                        dotted_name(b)
+                        for b in node.bases
+                        if dotted_name(b)
+                    ],
+                )
+                for fi in m.funcs:
+                    if (
+                        m.enclosing_class_name(fi.node) == node.name
+                        and isinstance(
+                            m.parent(fi.node), ast.ClassDef
+                        )
+                    ):
+                        ci.methods.setdefault(fi.node.name, fi)
+                        self._class_of_method[fi] = ci
+                self.classes[qual] = ci
+                self._class_by_simple.setdefault(
+                    node.name, []
+                ).append(ci)
+
+    def class_of(self, fi: FuncInfo) -> Optional[ClassInfo]:
+        return self._class_of_method.get(fi)
+
+    def _resolve_class_name(
+        self, module: ModuleModel, name: str
+    ) -> Optional[ClassInfo]:
+        """A class named ``name`` (dotted allowed) as visible from
+        ``module``: local class, imported symbol, or — as a fallback —
+        the unique class of that simple name anywhere in the scan."""
+        parts = name.split(".")
+        simple = parts[-1]
+        # local class in the same module
+        ci = self.classes.get(f"{module.module_name}.{simple}")
+        if ci is not None and len(parts) == 1:
+            return ci
+        # from X import Class
+        sym = self._sym_imports.get(module, {}).get(parts[0])
+        if sym is not None:
+            tmod, tname = sym
+            if len(parts) == 1:
+                hit = self.classes.get(f"{tmod}.{tname}")
+                if hit is not None:
+                    return hit
+        # import x.y as m; m.Class
+        if len(parts) >= 2:
+            alias = self._mod_imports.get(module, {}).get(parts[0])
+            if alias is not None:
+                hit = self.classes.get(f"{alias}.{simple}")
+                if hit is not None:
+                    return hit
+        if ci is not None:
+            return ci
+        cands = self._class_by_simple.get(simple, [])
+        return cands[0] if len(cands) == 1 else None
+
+    def _build_attr_types(self) -> None:
+        for ci in self.classes.values():
+            m = ci.module
+            # class-level annotations
+            for stmt in ci.node.body:
+                if isinstance(stmt, ast.AnnAssign) and isinstance(
+                    stmt.target, ast.Name
+                ):
+                    t = self._ann_class(m, stmt.annotation)
+                    if t is not None:
+                        ci.attr_types[stmt.target.id] = t
+            for meth in ci.methods.values():
+                params = self._param_types(m, meth)
+                for node in ast.walk(meth.node):
+                    if isinstance(node, ast.AnnAssign):
+                        tgt = node.target
+                        if (
+                            isinstance(tgt, ast.Attribute)
+                            and isinstance(tgt.value, ast.Name)
+                            and tgt.value.id == "self"
+                        ):
+                            t = self._ann_class(m, node.annotation)
+                            if t is not None:
+                                ci.attr_types[tgt.attr] = t
+                    elif isinstance(node, ast.Assign):
+                        t = self._value_class(m, node.value, params)
+                        if t is None:
+                            continue
+                        for tgt in node.targets:
+                            if (
+                                isinstance(tgt, ast.Attribute)
+                                and isinstance(tgt.value, ast.Name)
+                                and tgt.value.id == "self"
+                            ):
+                                ci.attr_types[tgt.attr] = t
+
+    def _ann_class(
+        self, module: ModuleModel, ann: Optional[ast.AST]
+    ) -> Optional[ClassInfo]:
+        if ann is None:
+            return None
+        if isinstance(ann, ast.Constant) and isinstance(
+            ann.value, str
+        ):  # string annotation
+            return self._resolve_class_name(
+                module, ann.value.strip("'\"")
+            )
+        if isinstance(ann, ast.Subscript):  # Optional[Foo]
+            sub = ann.slice
+            if isinstance(sub, ast.Tuple):
+                return None
+            return self._ann_class(module, sub)
+        name = dotted_name(ann)
+        if name:
+            return self._resolve_class_name(module, name)
+        return None
+
+    def _param_types(
+        self, module: ModuleModel, fi: FuncInfo
+    ) -> Dict[str, ClassInfo]:
+        out: Dict[str, ClassInfo] = {}
+        args = fi.node.args
+        for a in (
+            list(args.posonlyargs) + list(args.args) + list(args.kwonlyargs)
+        ):
+            t = self._ann_class(module, a.annotation)
+            if t is not None:
+                out[a.arg] = t
+        return out
+
+    def _value_class(
+        self,
+        module: ModuleModel,
+        value: ast.AST,
+        params: Dict[str, ClassInfo],
+    ) -> Optional[ClassInfo]:
+        """The class an assigned VALUE constructs or forwards:
+        ``Foo(...)`` or a bare annotated parameter name."""
+        if isinstance(value, ast.Call):
+            name = dotted_name(value.func)
+            if name and name[:1].isalpha():
+                last = name.split(".")[-1]
+                if last[:1].isupper():  # constructor convention
+                    return self._resolve_class_name(module, name)
+            return None
+        if isinstance(value, ast.Name):
+            return params.get(value.id)
+        return None
+
+    # -- call resolution --------------------------------------------------
+
+    def _method_on(
+        self, ci: Optional[ClassInfo], name: str, _depth: int = 0
+    ) -> Optional[FuncInfo]:
+        if ci is None or _depth > 8:
+            return None
+        hit = ci.methods.get(name)
+        if hit is not None:
+            return hit
+        for base in ci.bases:
+            bci = self._resolve_class_name(ci.module, base)
+            if bci is ci:
+                continue
+            hit = self._method_on(bci, name, _depth + 1)
+            if hit is not None:
+                return hit
+        return None
+
+    def _attr_class(
+        self, fi: FuncInfo, ci: Optional[ClassInfo], attr: str,
+        _depth: int = 0,
+    ) -> Optional[ClassInfo]:
+        if ci is None or _depth > 8:
+            return None
+        hit = ci.attr_types.get(attr)
+        if hit is not None:
+            return hit
+        for base in ci.bases:
+            bci = self._resolve_class_name(ci.module, base)
+            if bci is not ci:
+                hit = self._attr_class(fi, bci, attr, _depth + 1)
+                if hit is not None:
+                    return hit
+        return None
+
+    def resolve_call(
+        self, caller: FuncInfo, call: ast.Call
+    ) -> Optional[FuncInfo]:
+        m = caller.module
+        if m is None:
+            return None
+        func = call.func
+        name = dotted_name(func)
+        if not name:
+            return None
+        parts = name.split(".")
+        ci = self._class_of_method.get(caller)
+
+        if parts[0] in ("self", "cls") and ci is not None:
+            if len(parts) == 2:
+                return self._method_on(ci, parts[1])
+            if len(parts) == 3:
+                tci = self._attr_class(caller, ci, parts[1])
+                return self._method_on(tci, parts[2])
+            return None
+
+        if len(parts) == 1:
+            # lexical scope chain (nested defs), then module level
+            probe = caller.parent
+            while probe is not None:
+                for fi in m.funcs:
+                    if (
+                        fi.parent is probe
+                        and fi.node.name == parts[0]
+                    ):
+                        return fi
+                probe = probe.parent
+            hit = self._mod_funcs[m].get(parts[0])
+            if hit is not None:
+                return hit
+            # imported function / class constructor
+            sym = self._sym_imports.get(m, {}).get(parts[0])
+            if sym is not None:
+                tmod, tname = sym
+                target = self.by_name.get(tmod)
+                if target is not None:
+                    f = self._mod_funcs.get(target, {}).get(tname)
+                    if f is not None:
+                        return f
+                tci = self.classes.get(f"{tmod}.{tname}")
+                if tci is not None:
+                    return self._method_on(tci, "__init__")
+            # local class constructor
+            tci = self.classes.get(f"{m.module_name}.{parts[0]}")
+            if tci is not None:
+                return self._method_on(tci, "__init__")
+            return None
+
+        # Class.method / var.method / mod.func / mod.Class(...)
+        head, rest = parts[0], parts[1:]
+        # a local whose class is inferable
+        tci = self._local_type(caller, head)
+        if tci is not None and len(rest) == 1:
+            return self._method_on(tci, rest[0])
+        # a class symbol visible here
+        tci = self._resolve_class_name(m, head)
+        if tci is not None and head[:1].isupper():
+            if len(rest) == 1:
+                return self._method_on(tci, rest[0])
+            return None
+        # module alias
+        target_name = self._mod_imports.get(m, {}).get(head)
+        if target_name is not None:
+            # longest-prefix module match: mod.sub.func
+            for cut in range(len(rest), 0, -1):
+                mod_dotted = ".".join([target_name] + rest[: cut - 1])
+                target = self.by_name.get(mod_dotted)
+                if target is None:
+                    continue
+                leaf = rest[cut - 1 :]
+                if len(leaf) == 1:
+                    f = self._mod_funcs.get(target, {}).get(leaf[0])
+                    if f is not None:
+                        return f
+                    tci = self.classes.get(
+                        f"{mod_dotted}.{leaf[0]}"
+                    )
+                    if tci is not None:
+                        return self._method_on(tci, "__init__")
+                elif len(leaf) == 2:
+                    tci = self.classes.get(
+                        f"{mod_dotted}.{leaf[0]}"
+                    )
+                    if tci is not None:
+                        return self._method_on(tci, leaf[1])
+                break
+        return None
+
+    def _local_type(
+        self, fi: FuncInfo, name: str
+    ) -> Optional[ClassInfo]:
+        cache = self._local_types_cache.get(fi)
+        if cache is None:
+            cache = self._build_local_types(fi)
+            self._local_types_cache[fi] = cache
+        return cache.get(name)
+
+    def _build_local_types(
+        self, fi: FuncInfo
+    ) -> Dict[str, ClassInfo]:
+        """name -> class for every local whose type is inferable:
+        annotated params, ``x = Foo(...)``, ``x: Foo = ...``, and
+        ``x = self.attr`` forwarding a typed attribute. One walk per
+        function, cached (resolve_call hits this per attribute
+        call)."""
+        m = fi.module
+        if m is None:
+            return {}
+        out: Dict[str, ClassInfo] = dict(self._param_types(m, fi))
+        ci = self._class_of_method.get(fi)
+        for node in ast.walk(fi.node):
+            if isinstance(node, ast.Assign):
+                t = self._value_class(m, node.value, out)
+                if t is None and (
+                    isinstance(node.value, ast.Attribute)
+                    and isinstance(node.value.value, ast.Name)
+                    and node.value.value.id == "self"
+                ):
+                    t = self._attr_class(fi, ci, node.value.attr)
+                if t is None:
+                    continue
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        out.setdefault(tgt.id, t)
+            elif isinstance(node, ast.AnnAssign):
+                if isinstance(node.target, ast.Name):
+                    t = self._ann_class(m, node.annotation)
+                    if t is not None:
+                        out.setdefault(node.target.id, t)
+        return out
+
+    # -- call graph -------------------------------------------------------
+
+    def _own_calls(self, fi: FuncInfo) -> Iterable[ast.Call]:
+        stack: List[ast.AST] = list(ast.iter_child_nodes(fi.node))
+        while stack:
+            node = stack.pop()
+            if isinstance(
+                node,
+                (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef),
+            ):
+                continue
+            if isinstance(node, ast.Call):
+                yield node
+            stack.extend(ast.iter_child_nodes(node))
+
+    def _build_call_graph(self) -> None:
+        for m in self.modules:
+            for fi in m.funcs:
+                out: List[Tuple[ast.Call, FuncInfo]] = []
+                for call in self._own_calls(fi):
+                    callee = self.resolve_call(fi, call)
+                    if callee is not None and callee is not fi:
+                        out.append((call, callee))
+                self.calls[fi] = out
+                self.edges[fi] = {c for _, c in out}
+                for c in self.edges[fi]:
+                    self.redges.setdefault(c, set()).add(fi)
+
+    # -- global fixed points ----------------------------------------------
+
+    def _propagate_facts(self) -> None:
+        # seed thread owners from annotations (engine already
+        # inherited `thread` lexically)
+        for m in self.modules:
+            for fi in m.funcs:
+                if fi.thread is not None:
+                    fi.owners = {fi.thread}
+
+        changed = True
+        while changed:
+            changed = False
+            for fi, callees in self.edges.items():
+                for g in callees:
+                    # device facts cross module boundaries: whatever
+                    # a device context calls executes at trace time
+                    if (
+                        fi.device
+                        and not g.device
+                        and "host-fn" not in g.directives
+                    ):
+                        g.device = True
+                        changed = True
+                    # f64 zones extend through device call chains
+                    if (
+                        fi.f64
+                        and fi.device
+                        and g.device
+                        and not g.f64
+                    ):
+                        g.f64 = True
+                        changed = True
+                    # thread owners accumulate on unannotated callees
+                    if fi.owners and g.thread is None:
+                        before = len(g.owners)
+                        g.owners |= fi.owners
+                        if len(g.owners) != before:
+                            changed = True
+
+    # -- reachability -----------------------------------------------------
+
+    def reachable_from(
+        self,
+        roots: Iterable[FuncInfo],
+        *,
+        stop: Optional[Sequence[FuncInfo]] = None,
+    ) -> Dict[FuncInfo, FuncInfo]:
+        """BFS over call edges from ``roots``. Returns
+        ``{reached: parent}`` (roots map to themselves) — the parent
+        chain reconstructs a witness path for findings."""
+        stop_set = set(stop or ())
+        out: Dict[FuncInfo, FuncInfo] = {}
+        frontier: List[FuncInfo] = []
+        for r in roots:
+            if r not in out:
+                out[r] = r
+                frontier.append(r)
+        while frontier:
+            cur = frontier.pop()
+            for g in self.edges.get(cur, ()):
+                if g in out or g in stop_set:
+                    continue
+                out[g] = cur
+                frontier.append(g)
+        return out
+
+    def witness(
+        self, parents: Dict[FuncInfo, FuncInfo], fi: FuncInfo
+    ) -> List[str]:
+        chain = [fi]
+        seen = {fi}
+        while parents.get(chain[-1]) not in (None, chain[-1]):
+            nxt = parents[chain[-1]]
+            if nxt in seen:
+                break
+            chain.append(nxt)
+            seen.add(nxt)
+        return [c.qualname for c in reversed(chain)]
+
+    # -- module dependency closure (--since) ------------------------------
+
+    def affected_by(self, changed_rel: Iterable[str]) -> Set[str]:
+        """Repo-relative paths whose findings may change when
+        ``changed_rel`` files change: the files themselves plus the
+        reverse call-graph/import closure over modules."""
+        changed = {p.replace(os.sep, "/") for p in changed_rel}
+        mod_deps: Dict[ModuleModel, Set[ModuleModel]] = {}
+        for m in self.modules:
+            deps: Set[ModuleModel] = set()
+            for name in self._mod_imports.get(m, {}).values():
+                t = self.by_name.get(name)
+                if t is not None:
+                    deps.add(t)
+            for tmod, _ in self._sym_imports.get(m, {}).values():
+                t = self.by_name.get(tmod)
+                if t is not None:
+                    deps.add(t)
+            for fi in m.funcs:
+                for g in self.edges.get(fi, ()):
+                    if g.module is not None and g.module is not m:
+                        deps.add(g.module)
+            mod_deps[m] = deps
+        rev: Dict[ModuleModel, Set[ModuleModel]] = {}
+        for m, deps in mod_deps.items():
+            for d in deps:
+                rev.setdefault(d, set()).add(m)
+        seeds = [m for m in self.modules if m.relpath in changed]
+        out: Set[ModuleModel] = set(seeds)
+        frontier = list(seeds)
+        while frontier:
+            cur = frontier.pop()
+            for dep in rev.get(cur, ()):
+                if dep not in out:
+                    out.add(dep)
+                    frontier.append(dep)
+        return {m.relpath for m in out} | changed
+
+    # -- taint ------------------------------------------------------------
+
+    def taint(self, fi: FuncInfo) -> TaintInfo:
+        cached = self._taints.get(fi)
+        if cached is not None:
+            return cached
+        info = self._compute_taint(fi)
+        self._taints[fi] = info
+        return info
+
+    def _compute_taint(self, fi: FuncInfo) -> TaintInfo:
+        from ray_tpu.analysis.rules._common import stores_of
+
+        device: Set[str] = set()
+        derived: Set[str] = set()
+        sources: Dict[str, int] = {}
+        # locals bound to compiled programs: calling them yields
+        # device arrays
+        program_locals: Set[str] = set()
+        attr_programs: Set[str] = set()  # self.<attr> program attrs
+        ci = self._class_of_method.get(fi)
+        if ci is not None:
+            for meth in ci.methods.values():
+                for node in ast.walk(meth.node):
+                    if not isinstance(node, ast.Assign):
+                        continue
+                    if _compiled_value(node.value):
+                        for tgt in node.targets:
+                            if (
+                                isinstance(tgt, ast.Attribute)
+                                and isinstance(
+                                    tgt.value, ast.Name
+                                )
+                                and tgt.value.id == "self"
+                            ):
+                                attr_programs.add(tgt.attr)
+
+        def _is_program_call(node: ast.Call) -> bool:
+            name = dotted_name(node.func) or ""
+            parts = name.split(".")
+            if len(parts) == 1 and parts[0] in program_locals:
+                return True
+            return (
+                len(parts) == 2
+                and parts[0] == "self"
+                and parts[1] in attr_programs
+            )
+
+        def classify(expr: Optional[ast.AST]) -> Tuple[bool, bool]:
+            """(still_device, host_derived) for a value expression.
+            A materializing call (device_get/.item/.tolist) anywhere
+            in the expression wins: its RESULT is host data even when
+            its argument was a device array."""
+            if expr is None:
+                return False, False
+            materializes = False
+            dev = False
+            der = False
+            for node in ast.walk(expr):
+                if isinstance(node, ast.Call):
+                    if _is_taint_source(node):
+                        materializes = True
+                    elif _is_program_call(node):
+                        dev = True
+                if isinstance(node, (ast.Name, ast.Attribute)):
+                    key = dotted_name(node)
+                    if key:
+                        if key in device:
+                            dev = True
+                        if key in derived:
+                            der = True
+            if materializes:
+                return False, True
+            return dev, der
+
+        def store(stmt: ast.stmt, dev: bool, der: bool) -> None:
+            for key in stores_of(stmt):
+                if dev:
+                    device.add(key)
+                    derived.discard(key)
+                    sources.setdefault(key, stmt.lineno)
+                elif der:
+                    derived.add(key)
+                    device.discard(key)
+                    sources.setdefault(key, stmt.lineno)
+                else:
+                    device.discard(key)
+                    derived.discard(key)
+
+        for stmt in _ordered_stmts(fi):
+            if isinstance(stmt, ast.Assign):
+                if _compiled_value(stmt.value):
+                    for tgt in stmt.targets:
+                        if isinstance(tgt, ast.Name):
+                            program_locals.add(tgt.id)
+                    continue
+                dev, der = classify(stmt.value)
+                store(stmt, dev, der)
+            elif isinstance(stmt, ast.AugAssign):
+                dev, der = classify(stmt.value)
+                if dev or der:
+                    store(stmt, dev, der)
+            elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+                dev, der = classify(stmt.iter)
+                if dev or der:
+                    store(stmt, dev, der)
+        return TaintInfo(device, derived, sources)
+
+
+def _ordered_stmts(fi: FuncInfo) -> List[ast.stmt]:
+    out: List[ast.stmt] = []
+    stack: List[ast.AST] = list(ast.iter_child_nodes(fi.node))
+    while stack:
+        node = stack.pop()
+        if isinstance(
+            node,
+            (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef),
+        ):
+            continue
+        if isinstance(node, ast.stmt):
+            out.append(node)
+        stack.extend(ast.iter_child_nodes(node))
+    out.sort(key=lambda n: (n.lineno, n.col_offset))
+    return out

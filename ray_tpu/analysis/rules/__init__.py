@@ -1,7 +1,13 @@
 """Rule registry for the device-contract analyzer.
 
-Each rule module exposes ``RULE_ID`` and
-``check(model: ModuleModel) -> List[Finding]``.
+Each rule module exposes ``RULE_ID`` and one of:
+
+- ``check(model: ModuleModel) -> List[Finding]`` — runs once per
+  module (and benefits from the whole-program facts the engine
+  propagates onto ``FuncInfo`` before rules run);
+- ``check_program(program: ProgramModel) -> List[Finding]`` — runs
+  once per scan with the full symbol table / call graph / taint
+  machinery (the RTA007+ rule pack).
 """
 
 from __future__ import annotations
@@ -9,15 +15,34 @@ from __future__ import annotations
 from typing import List
 
 from ray_tpu.analysis.rules import (
+    catalog,
     donation,
     dtype,
+    durability,
+    eventloop,
     hostsync,
+    knobs,
+    lockorder,
     rng,
+    rng_order,
     threads,
     trace,
 )
 
-_ALL = [donation, trace, dtype, rng, hostsync, threads]
+_ALL = [
+    donation,
+    trace,
+    dtype,
+    rng,
+    hostsync,
+    threads,
+    eventloop,
+    lockorder,
+    durability,
+    catalog,
+    rng_order,
+    knobs,
+]
 
 RULE_DOCS = {
     mod.RULE_ID: (mod.__doc__ or "").strip().splitlines()[0]

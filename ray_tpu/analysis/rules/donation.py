@@ -19,6 +19,13 @@ The rule tracks, per module:
 and then flags any Load of a donated argument expression after the
 donating call, before a Store to it, within the same function (linear
 statement order).
+
+v2 upgrade (the dataflow pass): local ALIASES of the donated
+expression are tracked too — ``opt = self.opt_state`` before the
+donating call makes a later read of ``opt`` a use-after-donate even
+though the donated spelling (``self.opt_state``) was reassigned by
+the unpack. Aliasing is the exact trap the double-buffering contract
+sets: the name points at the donated buffer, not the fresh one.
 """
 
 from __future__ import annotations
@@ -124,6 +131,10 @@ def check(model: ModuleModel) -> List[Finding]:
         stmts = own_stmts(fi)
         cls = model.enclosing_class_name(fi.node)
         local_programs: Dict[str, Tuple[int, ...]] = {}
+        # local aliasing: `opt = self.opt_state` makes `opt` another
+        # name for the same buffers; keyed alias -> aliased key,
+        # indexed by the statement that created the alias
+        aliases: Dict[str, Tuple[str, int]] = {}
         # (call id, donated position) -> (key, call, label, idx); the
         # flat stmt list nests (an `if` contains its body stmts), so a
         # call is seen once per enclosing stmt — keep the NARROWEST
@@ -142,6 +153,15 @@ def check(model: ModuleModel) -> List[Finding]:
                     for tgt in stmt.targets:
                         if isinstance(tgt, ast.Name):
                             local_programs[tgt.id] = pos
+                # alias creation / invalidation: `a = <key>` aliases;
+                # any other store to `a` clears it
+                src_key = expr_key(stmt.value)
+                for tgt in stmt.targets:
+                    if isinstance(tgt, ast.Name):
+                        if src_key is not None and not pos:
+                            aliases[tgt.id] = (src_key, idx)
+                        else:
+                            aliases.pop(tgt.id, None)
 
             for node in ast.walk(stmt):
                 if not isinstance(node, ast.Call):
@@ -173,27 +193,49 @@ def check(model: ModuleModel) -> List[Finding]:
                     donations[(id(node), p)] = (key, node, label, idx)
 
         for key, call, label, idx in donations.values():
+            # the donated key plus every live local alias of it
+            # created BEFORE the donating statement
+            watched = {key} | {
+                a
+                for a, (k, aidx) in aliases.items()
+                if k == key and aidx < idx
+            }
             # the donating statement itself may reassign the donated
             # expr (tuple-unpack of the program outputs): that closes
-            # the window immediately
+            # that key's window immediately
             if key in stores_of(stmts[idx]):
+                watched.discard(key)
+            if not watched:
                 continue
             for later in stmts[idx + 1 :]:
                 hit = next(
-                    (n for k, n in loads_of(later) if k == key), None
+                    (
+                        n
+                        for k, n in loads_of(later)
+                        if k in watched
+                    ),
+                    None,
                 )
                 if hit is not None:
+                    hit_key = expr_key(hit)
+                    via = (
+                        ""
+                        if hit_key == key
+                        else f" (via local alias of `{key}`)"
+                    )
                     f = model.finding(
                         RULE_ID,
                         hit,
-                        f"`{key}` read after being donated to "
-                        f"`{label}` (donate_argnums position — the "
-                        "buffer is aliased to the program's outputs "
-                        "after dispatch); reassign before reading",
+                        f"`{hit_key}` read after being donated to "
+                        f"`{label}`{via} (donate_argnums position — "
+                        "the buffer is aliased to the program's "
+                        "outputs after dispatch); reassign before "
+                        "reading",
                     )
                     if f:
                         findings.append(f)
                     break
-                if key in stores_of(later):
+                watched -= stores_of(later)
+                if not watched:
                     break
     return findings

@@ -1,0 +1,179 @@
+"""RTA009 — durability discipline for checkpoint-grade writes.
+
+The crash-safety story (docs/resilience.md) rests on ONE write shape:
+same-directory temp file → flush → ``os.fsync`` → ``os.replace`` →
+directory fsync. Before this rule, eight modules hand-rolled some
+prefix of that chain — several skipped the fsync (a host crash could
+publish a rename pointing at unwritten blocks) and most skipped the
+directory fsync (the rename itself lives in the directory inode).
+The shared helper is :func:`ray_tpu.util.atomic_io.atomic_write`,
+annotated ``# ray-tpu: atomic-writer``; everything else routes
+through it.
+
+Three checks:
+
+- **hand-rolled rename**: ``os.replace``/``os.rename`` in a function
+  NOT annotated ``atomic-writer`` is a finding — route the write
+  through the helper;
+- **helper validity**: inside an ``atomic-writer`` function the
+  ``os.replace`` must be preceded (same function, statement order)
+  by an ``os.fsync`` — the rename must not be reorderable ahead of
+  the data blocks — and followed (or preceded, for pre-staged dirs)
+  by a directory fsync (``fsync_dir``/``_fsync_dir`` call or a
+  second ``os.fsync``);
+- **raw checkpoint open**: ``open(path, "w"/"wb"/"a")`` where the
+  path expression names a checkpoint artifact (``checkpoint`` /
+  ``ckpt`` / ``snapshot`` in an identifier or literal) outside an
+  atomic-writer function is a finding — a truncate-then-write crash
+  window on the exact files the recovery layer trusts.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional
+
+from ray_tpu.analysis.engine import Finding, FuncInfo, ModuleModel
+from ray_tpu.analysis.rules._common import call_name, own_stmts
+
+RULE_ID = "RTA009"
+
+_CKPT_TOKENS = ("checkpoint", "ckpt", "snapshot")
+_DIR_FSYNC_NAMES = {"fsync_dir", "_fsync_dir"}
+
+
+def _is_rename(call: ast.Call) -> bool:
+    return call_name(call) in ("os.replace", "os.rename")
+
+
+def _is_fsync(call: ast.Call) -> bool:
+    return call_name(call) == "os.fsync"
+
+
+def _is_dir_fsync(call: ast.Call) -> bool:
+    return call_name(call).split(".")[-1] in _DIR_FSYNC_NAMES
+
+
+def _mentions_checkpoint(expr: ast.AST) -> bool:
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Constant) and isinstance(
+            node.value, str
+        ):
+            low = node.value.lower()
+            if any(t in low for t in _CKPT_TOKENS):
+                return True
+        if isinstance(node, ast.Name):
+            low = node.id.lower()
+            if any(t in low for t in _CKPT_TOKENS):
+                return True
+        if isinstance(node, ast.Attribute):
+            low = node.attr.lower()
+            if any(t in low for t in _CKPT_TOKENS):
+                return True
+    return False
+
+
+def _open_mode(call: ast.Call) -> Optional[str]:
+    if call_name(call).split(".")[-1] != "open":
+        return None
+    mode_node: Optional[ast.AST] = None
+    if len(call.args) >= 2:
+        mode_node = call.args[1]
+    else:
+        for kw in call.keywords:
+            if kw.arg == "mode":
+                mode_node = kw.value
+    if isinstance(mode_node, ast.Constant) and isinstance(
+        mode_node.value, str
+    ):
+        return mode_node.value
+    return None
+
+
+def _writer(fi: FuncInfo) -> bool:
+    probe: Optional[FuncInfo] = fi
+    while probe is not None:
+        if "atomic-writer" in probe.directives:
+            return True
+        probe = probe.parent
+    return False
+
+
+def check(model: ModuleModel) -> List[Finding]:
+    findings: List[Finding] = []
+
+    def add(node, msg):
+        f = model.finding(RULE_ID, node, msg)
+        if f:
+            findings.append(f)
+
+    for fi in model.funcs:
+        stmts = own_stmts(fi)
+        # own_stmts nests (an `if` contains its body statements), so
+        # dedup calls by identity, keeping the NARROWEST (greatest)
+        # statement index for the ordering checks
+        by_id = {}
+        for idx, stmt in enumerate(stmts):
+            for node in ast.walk(stmt):
+                if isinstance(node, ast.Call):
+                    by_id[id(node)] = (idx, node)
+        calls = sorted(by_id.values(), key=lambda p: p[0])
+        if _writer(fi):
+            # the sanctioned implementation: validate the chain
+            for idx, node in calls:
+                if not _is_rename(node):
+                    continue
+                fsync_before = any(
+                    _is_fsync(n) for i, n in calls if i <= idx
+                )
+                dir_sync = any(
+                    _is_dir_fsync(n) or (_is_fsync(n) and i > idx)
+                    for i, n in calls
+                )
+                if not fsync_before:
+                    add(
+                        node,
+                        f"`{call_name(node)}` in atomic-writer "
+                        f"`{fi.qualname}` without a preceding "
+                        "`os.fsync` — the rename can be reordered "
+                        "ahead of the data blocks; fsync the file "
+                        "before publishing it",
+                    )
+                elif not dir_sync:
+                    add(
+                        node,
+                        f"`{call_name(node)}` in atomic-writer "
+                        f"`{fi.qualname}` without a directory fsync "
+                        "— the rename lives in the directory inode; "
+                        "fsync the directory (util.atomic_io."
+                        "fsync_dir) after publishing",
+                    )
+            continue
+
+        for _, node in calls:
+            if _is_rename(node):
+                add(
+                    node,
+                    f"hand-rolled `{call_name(node)}` outside the "
+                    "atomic-write helper — route the write through "
+                    "`ray_tpu.util.atomic_io.atomic_write` (temp + "
+                    "fsync + replace + dir fsync) so a crash cannot "
+                    "publish a torn or unsynced file",
+                )
+                continue
+            mode = _open_mode(node)
+            if (
+                mode is not None
+                and ("w" in mode or "a" in mode)
+                and node.args
+                and _mentions_checkpoint(node.args[0])
+            ):
+                add(
+                    node,
+                    f"raw `open(..., {mode!r})` on a checkpoint "
+                    "artifact — a crash mid-write leaves a truncated "
+                    "file where the recovery layer expects a "
+                    "complete one; write through "
+                    "`util.atomic_io.atomic_write`",
+                )
+    return findings

@@ -1,0 +1,203 @@
+"""RTA007 — blocking call reachable from the event loop.
+
+The ingress plane is a SINGLE asyncio loop owning every socket
+(docs/serving.md "the front door"): one blocking call anywhere in a
+coroutine — or in any sync helper a coroutine calls — stalls every
+connection at once. Nothing enforced that before this rule: the
+ingress plane's async handlers called freely into sync code whose
+blocking behavior only review memory tracked.
+
+The rule computes the set of functions reachable over the whole-
+program call graph from (a) every ``async def`` body and (b) every
+function annotated ``# ray-tpu: thread=<owner>`` whose owner name
+ends in ``-loop`` (the ingress loop's thread functions), then flags
+the blocking primitives inside that set:
+
+- ``time.sleep`` (``asyncio.sleep`` is the async shape);
+- ``Future.result()`` / ``ray.get`` — blocking harvests
+  (``await asyncio.wrap_future(fut)`` is the async shape);
+- ``jax.device_get`` / ``.block_until_ready()`` — a device round
+  trip on the loop stalls every open socket for its duration;
+- blocking ``queue.get/put`` (receiver named like a queue, without
+  ``block=False``; ``get_nowait``/``put_nowait`` pass);
+- sync socket ops (``recv/recv_into/accept/connect/sendall`` on a
+  receiver named like a socket);
+- ``Event.wait()`` / ``Thread.join()`` — unbounded host blocking
+  (``is_set()`` probes pass).
+
+Traversal stops at other ``async def``s (calling one without await
+just builds a coroutine) and skips callables passed as ARGUMENTS to
+``run_in_executor`` / ``to_thread`` / pool ``submit`` — handing
+blocking work to an executor is the sanctioned shape.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List
+
+from ray_tpu.analysis.engine import Finding, FuncInfo
+from ray_tpu.analysis.rules._common import call_name, keyword, own_nodes
+
+RULE_ID = "RTA007"
+
+_LOOP_OWNER_SUFFIX = "-loop"
+
+_QUEUE_NAME_HINTS = ("queue", "_q", "inq", "outq")
+_SOCKET_NAME_HINTS = ("sock", "conn")
+_BLOCKING_METHODS_ANY = {"result", "block_until_ready"}
+_SOCKET_METHODS = {"recv", "recv_into", "accept", "connect", "sendall"}
+_WAITY_METHODS = {"wait", "join"}
+
+
+def _receiver_key(call: ast.Call) -> str:
+    func = call.func
+    if isinstance(func, ast.Attribute):
+        from ray_tpu.analysis.engine import dotted_name
+
+        return (dotted_name(func.value) or "").lower()
+    return ""
+
+
+def _looks_like_queue(recv: str) -> bool:
+    leaf = recv.split(".")[-1]
+    return any(h in leaf for h in _QUEUE_NAME_HINTS) or leaf == "q"
+
+
+def _looks_like_socket(recv: str) -> bool:
+    leaf = recv.split(".")[-1]
+    return any(h in leaf for h in _SOCKET_NAME_HINTS)
+
+
+def _looks_like_sync_obj(recv: str) -> bool:
+    leaf = recv.split(".")[-1]
+    return any(
+        h in leaf
+        for h in ("event", "thread", "ready", "stop", "done", "idle",
+                  "wake")
+    )
+
+
+def _blocking_reason(call: ast.Call) -> str:
+    """Why this call blocks the loop, or '' when it does not."""
+    name = call_name(call)
+    parts = name.split(".")
+    last = parts[-1]
+    if name in ("time.sleep",):
+        return "`time.sleep` suspends the whole loop (use `await asyncio.sleep`)"
+    if last == "get" and len(parts) >= 2 and parts[0] == "ray":
+        return "`ray.get` blocks the loop on a remote result"
+    if last == "device_get" and len(parts) >= 2:
+        return "`jax.device_get` blocks the loop on a device round trip"
+    if not isinstance(call.func, ast.Attribute):
+        return ""
+    attr = call.func.attr
+    recv = _receiver_key(call)
+    if attr in _BLOCKING_METHODS_ANY:
+        if attr == "result":
+            return (
+                "`.result()` blocks the loop on a future "
+                "(await `asyncio.wrap_future(...)` instead)"
+            )
+        return "`.block_until_ready()` blocks the loop on the device"
+    if attr in ("get", "put") and _looks_like_queue(recv):
+        blk = keyword(call, "block")
+        if isinstance(blk, ast.Constant) and blk.value is False:
+            return ""
+        return (
+            f"blocking `{recv}.{attr}()` parks the loop on a thread "
+            "queue (use the _nowait variant or an executor)"
+        )
+    if attr in _SOCKET_METHODS and _looks_like_socket(recv):
+        return (
+            f"sync socket op `{recv}.{attr}()` on the loop thread "
+            "(use the asyncio stream APIs)"
+        )
+    if attr in _WAITY_METHODS and _looks_like_sync_obj(recv):
+        return (
+            f"`{recv}.{attr}()` blocks the loop on host "
+            "synchronization"
+        )
+    return ""
+
+
+_EXECUTOR_HANDOFF = {"run_in_executor", "to_thread", "submit"}
+
+
+def check_program(program) -> List[Finding]:
+    roots: List[FuncInfo] = []
+    for m in program.modules:
+        for fi in m.funcs:
+            if fi.is_async or (
+                fi.thread is not None
+                and fi.thread.endswith(_LOOP_OWNER_SUFFIX)
+                and fi.is_async
+            ):
+                roots.append(fi)
+            elif fi.thread is not None and fi.thread.endswith(
+                _LOOP_OWNER_SUFFIX
+            ):
+                # sync functions owned by the loop thread outside the
+                # loop runner itself (the runner blocks by design in
+                # run_until_complete)
+                if not any(
+                    call_name(n).endswith("run_until_complete")
+                    or call_name(n).endswith("run_forever")
+                    for n in own_nodes(fi)
+                    if isinstance(n, ast.Call)
+                ):
+                    roots.append(fi)
+
+    # traversal never enters another async def FROM a call edge: the
+    # call builds a coroutine, the loop runs it — blocking inside it
+    # is caught because every async def is itself a root
+    async_defs = [
+        fi
+        for m in program.modules
+        for fi in m.funcs
+        if fi.is_async
+    ]
+    parents: Dict[FuncInfo, FuncInfo] = {}
+    reach: Dict[FuncInfo, FuncInfo] = {}
+    for root in roots:
+        sub = program.reachable_from(
+            [root], stop=[a for a in async_defs if a is not root]
+        )
+        for fi, par in sub.items():
+            if fi not in reach:
+                reach[fi] = root
+                parents[fi] = par
+
+    findings: List[Finding] = []
+    for fi, root in reach.items():
+        model = fi.module
+        if model is None:
+            continue
+        for node in own_nodes(fi):
+            if not isinstance(node, ast.Call):
+                continue
+            # skip callables handed to an executor: the args are the
+            # sanctioned blocking shape, and the executor call itself
+            # does not block
+            last = call_name(node).split(".")[-1]
+            if last in _EXECUTOR_HANDOFF:
+                continue
+            reason = _blocking_reason(node)
+            if not reason:
+                continue
+            via = (
+                ""
+                if fi is root
+                else f" (reachable from `{root.qualname}` via the "
+                f"call graph)"
+            )
+            f = model.finding(
+                RULE_ID,
+                node,
+                f"{reason} — in `{fi.qualname}`, which runs on the "
+                f"event loop{via}; hand blocking work to an executor "
+                "or use the async shape",
+            )
+            if f:
+                findings.append(f)
+    return findings

@@ -12,6 +12,13 @@ per call. Sanctioned drains live in helper functions annotated
 
 The rule flags only the sync PRIMITIVES — calling a drain-ok helper
 from a hot span is the sanctioned shape and passes by construction.
+
+v2 upgrade (the whole-program dataflow pass): the rule also runs the
+device-taint analysis (:meth:`ProgramModel.taint`) over hot spans and
+flags **implicit** syncs — ``float()`` / ``int()`` / ``np.asarray``
+/ ``np.array`` coercions whose argument derives from a compiled
+program's output. Those block exactly like ``.item()`` but never
+spell a sync primitive, so the v1 rule was blind to them.
 """
 
 from __future__ import annotations
@@ -19,16 +26,18 @@ from __future__ import annotations
 import ast
 from typing import List
 
-from ray_tpu.analysis.engine import Finding, ModuleModel
+from ray_tpu.analysis.engine import Finding
 from ray_tpu.analysis.rules._common import call_name, own_nodes
 
 RULE_ID = "RTA005"
 
 _SYNC_METHODS = {"item", "block_until_ready"}
 _SYNC_FUNCS = {"device_get", "block_until_ready"}
+_COERCIONS = {"float", "int", "bool"}
+_NP_MATERIALIZERS = {"asarray", "array"}
 
 
-def check(model: ModuleModel) -> List[Finding]:
+def _check_module(model, program) -> List[Finding]:
     findings: List[Finding] = []
 
     def add(node, msg):
@@ -39,6 +48,7 @@ def check(model: ModuleModel) -> List[Finding]:
     for fi in model.funcs:
         if not fi.hot or "drain-ok" in fi.directives:
             continue
+        taint = program.taint(fi) if program is not None else None
         for node in own_nodes(fi):
             if not isinstance(node, ast.Call):
                 continue
@@ -65,4 +75,44 @@ def check(model: ModuleModel) -> List[Finding]:
                     "per call — batch it into the span's one counted "
                     "drain",
                 )
+            elif taint is not None and node.args:
+                # implicit sync: host coercion of a device-derived
+                # value (the taint pass tracks program outputs
+                # through local aliasing)
+                parts = name.split(".")
+                is_coercion = (
+                    isinstance(node.func, ast.Name)
+                    and node.func.id in _COERCIONS
+                )
+                is_np_mat = (
+                    len(parts) == 2
+                    and parts[0] in ("np", "numpy", "onp")
+                    and parts[1] in _NP_MATERIALIZERS
+                )
+                if (is_coercion or is_np_mat) and taint.is_device(
+                    node.args[0]
+                ):
+                    add(
+                        node,
+                        f"`{name}(...)` of a device-program result "
+                        f"in hot-path span `{fi.qualname}` — an "
+                        "implicit D2H sync (same cost as .item()); "
+                        "defer the materialization past the "
+                        "dispatch or route it through the counted "
+                        "drain",
+                    )
     return findings
+
+
+def check_program(program) -> List[Finding]:
+    findings: List[Finding] = []
+    for model in program.modules:
+        if not program.in_scope(model):
+            continue
+        findings.extend(_check_module(model, program))
+    return findings
+
+
+def check(model) -> List[Finding]:
+    """Per-module fallback (no taint) — kept for direct callers."""
+    return _check_module(model, None)

@@ -1,0 +1,145 @@
+"""RTA012 — AlgorithmConfig knob reachability and documentation.
+
+``AlgorithmConfig`` is 160+ attributes grown over 14 PRs, consumed
+dict-style (``config.get("sample_prefetch")``) far from where they
+are declared. Two failure modes accumulate silently: a knob nothing
+reads (the setting is a no-op — users tune it and nothing happens),
+and a knob the code reads but no doc names (undiscoverable except by
+source-diving). Both are drift between the three surfaces — config
+module, consuming code, docs/API.md — that nothing reconciled until
+now.
+
+For every ``self.<name> = ...`` in the scanned ``AlgorithmConfig``
+class body (``__init__``; private ``_names`` excluded):
+
+- **unread knob**: the name appears nowhere outside the defining
+  module — neither as a string literal (``config["name"]`` /
+  ``.get("name")``) nor as an attribute access — finding at the
+  declaration. Fix: wire it, delete it, or mark the deliberate
+  API-parity stubs with ``# ray-tpu: allow[RTA012] <why>``;
+- **undocumented knob**: the name IS read by code but does not
+  appear in ``docs/API.md`` (the config-knob index) — finding at the
+  declaration. Fix: add it to the index.
+
+Fixture scans bring their own ``AlgorithmConfig`` class; a scan with
+no such class (or no ``docs/API.md`` under the root for the doc arm)
+is silent.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, List, Set, Tuple
+
+from ray_tpu.analysis.engine import Finding, ModuleModel
+
+RULE_ID = "RTA012"
+
+_CONFIG_CLASS = "AlgorithmConfig"
+
+
+def _knobs(
+    ci,
+) -> List[Tuple[str, ast.AST]]:
+    """(name, node) for every ``self.<name> =`` in the class's
+    ``__init__`` (first binding wins)."""
+    init = ci.methods.get("__init__")
+    if init is None:
+        return []
+    seen: Set[str] = set()
+    out: List[Tuple[str, ast.AST]] = []
+    for node in ast.walk(init.node):
+        targets: List[ast.AST] = []
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, ast.AnnAssign):
+            targets = [node.target]
+        for tgt in targets:
+            if (
+                isinstance(tgt, ast.Attribute)
+                and isinstance(tgt.value, ast.Name)
+                and tgt.value.id == "self"
+                and not tgt.attr.startswith("_")
+                and tgt.attr not in seen
+            ):
+                seen.add(tgt.attr)
+                out.append((tgt.attr, tgt))
+    return out
+
+
+def _reads(program, defining: ModuleModel) -> Set[str]:
+    """Every identifier-ish token READ outside the defining module:
+    string literals and attribute names (loads only)."""
+    out: Set[str] = set()
+    for m in program.modules:
+        if m is defining:
+            continue
+        for node in ast.walk(m.tree):
+            if isinstance(node, ast.Constant) and isinstance(
+                node.value, str
+            ):
+                v = node.value
+                if v and len(v) < 80 and v.isidentifier():
+                    out.add(v)
+            elif isinstance(node, ast.Attribute) and isinstance(
+                getattr(node, "ctx", None), ast.Load
+            ):
+                out.add(node.attr)
+    return out
+
+
+def check_program(program) -> List[Finding]:
+    config_classes = [
+        ci
+        for ci in program.classes.values()
+        if ci.name == _CONFIG_CLASS
+    ]
+    if not config_classes:
+        return []
+    api_doc = ""
+    try:
+        with open(
+            os.path.join(program.root, "docs", "API.md"),
+            encoding="utf-8",
+        ) as f:
+            api_doc = f.read()
+    except OSError:
+        pass
+
+    findings: List[Finding] = []
+    read_cache: Dict[ModuleModel, Set[str]] = {}
+    for ci in config_classes:
+        m = ci.module
+        knobs = _knobs(ci)
+        if not knobs:
+            continue
+        reads = read_cache.get(m)
+        if reads is None:
+            reads = _reads(program, m)
+            read_cache[m] = reads
+        for name, node in knobs:
+            if name not in reads:
+                f = m.finding(
+                    RULE_ID,
+                    node,
+                    f"config knob `{name}` is never read outside "
+                    "the config module — a silent no-op setting; "
+                    "wire it into the consuming code, delete it, or "
+                    "mark a deliberate API-parity stub with "
+                    "allow[RTA012]",
+                )
+                if f:
+                    findings.append(f)
+            elif api_doc and name not in api_doc:
+                f = m.finding(
+                    RULE_ID,
+                    node,
+                    f"config knob `{name}` is consumed by code but "
+                    "absent from docs/API.md — add it to the "
+                    "config-knob index so the surface stays "
+                    "discoverable",
+                )
+                if f:
+                    findings.append(f)
+    return findings

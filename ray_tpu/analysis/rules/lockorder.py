@@ -1,0 +1,273 @@
+"""RTA008 — lock-order discipline across the call graph.
+
+The control plane is full of small per-object locks — the fleet
+controller's monitor/driver split, the router's queue condition, the
+admission counter, the metrics registry — and calls that cross
+objects WHILE HOLDING one of them (``reconcile`` probes a request
+manager's in-flight count under the fleet lock). Two threads taking
+two locks in opposite orders is the textbook deadlock, and nothing
+but reviewer memory tracked the global order until now.
+
+The rule discovers lock objects (attributes or module globals
+assigned from ``threading.Lock/RLock/Condition``), collects every
+``with <lock>:`` acquisition, and computes ordered pairs
+``(outer, inner)``:
+
+- ``with A: ... with B:`` lexically nested in one function;
+- ``with A: ... f()`` where ``f`` may (transitively, over the
+  whole-program call graph) acquire ``B``.
+
+Any two locks observed in BOTH orders is a finding, reported at the
+lexically later inner-acquisition site and naming both witnesses.
+Locks are keyed ``Class._name`` / ``module._NAME``, so the rule
+reasons about lock OBJECTS, not variable spellings.
+
+Approximations (documented, deliberate): ``.acquire()`` call pairs
+are not ordered (the repo idiom is ``with``), and ``Condition.wait``
+releasing its lock mid-block is ignored — a pair involving a
+condition's wait window can be suppressed with
+``# ray-tpu: allow[RTA008] <why>`` at either site.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from ray_tpu.analysis.engine import Finding, FuncInfo, dotted_name
+from ray_tpu.analysis.rules._common import call_name
+
+RULE_ID = "RTA008"
+
+_LOCK_CTORS = {"Lock", "RLock", "Condition", "Semaphore",
+               "BoundedSemaphore"}
+
+
+def _is_lock_ctor(node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    parts = call_name(node).split(".")
+    return parts[-1] in _LOCK_CTORS and (
+        len(parts) == 1 or parts[0] == "threading"
+    )
+
+
+class _Locks:
+    """Known lock objects across the program, keyed stably."""
+
+    def __init__(self, program):
+        self.program = program
+        self.attr_locks: Set[Tuple[str, str]] = set()  # (Class, attr)
+        self.global_locks: Set[Tuple[str, str]] = set()  # (mod, name)
+        for m in program.modules:
+            for node in ast.walk(m.tree):
+                if not isinstance(node, ast.Assign):
+                    continue
+                if not _is_lock_ctor(node.value):
+                    continue
+                cls = m.enclosing_class_name(node)
+                for tgt in node.targets:
+                    if (
+                        isinstance(tgt, ast.Attribute)
+                        and isinstance(tgt.value, ast.Name)
+                        and tgt.value.id == "self"
+                        and cls is not None
+                    ):
+                        self.attr_locks.add((cls, tgt.attr))
+                    elif (
+                        isinstance(tgt, ast.Name)
+                        and m.enclosing(node) is None
+                    ):
+                        self.global_locks.add(
+                            (m.module_name, tgt.id)
+                        )
+
+    def key_for(
+        self, fi: FuncInfo, expr: ast.AST
+    ) -> Optional[str]:
+        """Stable key of the lock ``expr`` acquires in ``fi``'s
+        context, or None when it isn't a known lock."""
+        name = dotted_name(expr)
+        if not name:
+            return None
+        m = fi.module
+        parts = name.split(".")
+        if parts[0] == "self" and len(parts) == 2 and m is not None:
+            ci = self.program.class_of(fi)
+            cls = ci.name if ci is not None else None
+            probe = ci
+            depth = 0
+            while cls is not None and depth < 8:
+                if (cls, parts[1]) in self.attr_locks:
+                    return f"{cls}.{parts[1]}"
+                # inherited lock attribute
+                nxt = None
+                if probe is not None and probe.bases:
+                    nxt = self.program._resolve_class_name(
+                        probe.module, probe.bases[0]
+                    )
+                probe = nxt if nxt is not probe else None
+                cls = probe.name if probe is not None else None
+                depth += 1
+            return None
+        if len(parts) == 1 and m is not None:
+            if (m.module_name, parts[0]) in self.global_locks:
+                return f"{m.module_name}.{parts[0]}"
+        return None
+
+
+def _acquisitions(
+    locks: _Locks, fi: FuncInfo
+) -> List[Tuple[str, ast.With]]:
+    out: List[Tuple[str, ast.With]] = []
+    stack: List[ast.AST] = list(ast.iter_child_nodes(fi.node))
+    while stack:
+        node = stack.pop()
+        if isinstance(
+            node,
+            (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef),
+        ):
+            continue
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                key = locks.key_for(fi, item.context_expr)
+                if key is not None:
+                    out.append((key, node))
+        stack.extend(ast.iter_child_nodes(node))
+    return out
+
+
+def check_program(program) -> List[Finding]:
+    locks = _Locks(program)
+
+    # per-function: direct acquisitions, and (lock, node) held around
+    # each sub-statement
+    direct: Dict[FuncInfo, List[Tuple[str, ast.With]]] = {}
+    for m in program.modules:
+        for fi in m.funcs:
+            acq = _acquisitions(locks, fi)
+            if acq:
+                direct[fi] = acq
+
+    # transitive acquire sets over the call graph
+    acq_star: Dict[FuncInfo, Set[str]] = {
+        fi: {k for k, _ in acq} for fi, acq in direct.items()
+    }
+    all_funcs = [
+        fi for m in program.modules for fi in m.funcs
+    ]
+    for fi in all_funcs:
+        acq_star.setdefault(fi, set())
+    changed = True
+    while changed:
+        changed = False
+        for fi in all_funcs:
+            cur = acq_star[fi]
+            before = len(cur)
+            for g in program.edges.get(fi, ()):
+                cur |= acq_star.get(g, set())
+            if len(cur) != before:
+                changed = True
+
+    # ordered pairs with witness sites: (outer, inner) ->
+    # (module, node, holder qualname, detail)
+    pairs: Dict[Tuple[str, str], Tuple] = {}
+
+    def note(outer: str, inner: str, m, node, holder: str, why: str):
+        if outer == inner:
+            return
+        pairs.setdefault((outer, inner), (m, node, holder, why))
+
+    for fi, acq in direct.items():
+        m = fi.module
+        for outer_key, with_node in acq:
+            # everything INSIDE this with block
+            inner_stack: List[ast.AST] = []
+            for stmt in with_node.body:
+                inner_stack.append(stmt)
+            while inner_stack:
+                node = inner_stack.pop()
+                if isinstance(
+                    node,
+                    (
+                        ast.FunctionDef,
+                        ast.AsyncFunctionDef,
+                        ast.ClassDef,
+                    ),
+                ):
+                    continue
+                if isinstance(node, (ast.With, ast.AsyncWith)):
+                    for item in node.items:
+                        inner_key = locks.key_for(
+                            fi, item.context_expr
+                        )
+                        if inner_key is not None:
+                            note(
+                                outer_key,
+                                inner_key,
+                                m,
+                                node,
+                                fi.qualname,
+                                f"`with {inner_key}` nested inside "
+                                f"`with {outer_key}`",
+                            )
+                if isinstance(node, ast.Call):
+                    # skip methods ON the held lock itself
+                    # (cv.wait/notify inside `with cv` is the idiom)
+                    callee = program.resolve_call(fi, node)
+                    if callee is not None:
+                        for inner_key in acq_star.get(callee, ()):
+                            note(
+                                outer_key,
+                                inner_key,
+                                m,
+                                node,
+                                fi.qualname,
+                                f"call to `{callee.qualname}` (which "
+                                f"may acquire {inner_key}) while "
+                                f"holding {outer_key}",
+                            )
+                inner_stack.extend(ast.iter_child_nodes(node))
+
+    findings: List[Finding] = []
+    seen: Set[Tuple[str, str]] = set()
+    for (a, b), (m1, n1, q1, why1) in sorted(
+        pairs.items(),
+        key=lambda kv: (
+            kv[1][0].relpath,
+            getattr(kv[1][1], "lineno", 0),
+        ),
+    ):
+        if (b, a) not in pairs:
+            continue
+        if (b, a) in seen or (a, b) in seen:
+            continue
+        seen.add((a, b))
+        m2, n2, q2, why2 = pairs[(b, a)]
+        # report at the lexically later witness so the finding sits
+        # on the code most recently introduced
+        first = (m1.relpath, getattr(n1, "lineno", 0))
+        second = (m2.relpath, getattr(n2, "lineno", 0))
+        if second >= first:
+            m, node, why_here, why_other, other = (
+                m2, n2, why2, why1,
+                f"{m1.relpath}:{getattr(n1, 'lineno', 0)} "
+                f"[{q1}]",
+            )
+        else:
+            m, node, why_here, why_other, other = (
+                m1, n1, why1, why2,
+                f"{m2.relpath}:{getattr(n2, 'lineno', 0)} "
+                f"[{q2}]",
+            )
+        f = m.finding(
+            RULE_ID,
+            node,
+            f"lock-order inversion between {a} and {b}: here "
+            f"{why_here}; the OPPOSITE order ({why_other}) is taken "
+            f"at {other} — two threads interleaving these deadlock; "
+            "pick one global order or drop the inner acquisition",
+        )
+        if f:
+            findings.append(f)
+    return findings

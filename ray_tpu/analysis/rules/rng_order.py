@@ -1,0 +1,130 @@
+"""RTA011 — host-RNG call-order determinism under device-derived
+conditionals.
+
+Every lane pins fixed-seed bit-parity tests (superstep ≡ K calls,
+device tree ≡ host tree, router coalescing ≡ sequential), and they
+all rest on ONE invariant: the host generator's draw ORDER is a pure
+function of the seed and the step count. A draw sitting under an
+``if`` whose predicate derives from a DEVICE value breaks that in
+the worst way — the stream stays plausible, parity only diverges on
+the runs where the device value crossed the threshold (XLA and numpy
+rounding the predicate differently is enough). This is the dynamic
+cousin of the PR-11 ``|td|+1e-6`` bug: not a value divergence but a
+draw-count divergence.
+
+The rule runs the whole-program taint pass
+(:meth:`ProgramModel.taint`: compiled-program results,
+``jax.device_get``, ``.item()``/``.tolist()``, propagated through
+local aliasing) and flags any host-generator draw — a method call
+like ``integers``/``random``/``normal``/``uniform``/``choice``/
+``permutation``/``shuffle``/``standard_normal`` on a receiver named
+like a generator (``rng``/``_rng``/``gen``/``generator``/
+``random_state``) — lexically inside an ``if``/``while``/ternary
+whose test is device-tainted.
+
+Draws under CONFIG conditionals are fine (same branch every run);
+draws that consume a device value as an ARGUMENT are fine (the order
+is unchanged); a deliberately adaptive draw documents itself with
+``# ray-tpu: allow[RTA011] <why the parity contract does not apply>``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from ray_tpu.analysis.engine import Finding, dotted_name
+from ray_tpu.analysis.rules._common import call_name
+
+RULE_ID = "RTA011"
+
+_DRAW_METHODS = {
+    "integers", "random", "normal", "uniform", "choice",
+    "permutation", "shuffle", "standard_normal", "exponential",
+    "randint", "rand", "randn", "sample",
+}
+_GEN_HINTS = ("rng", "generator", "random_state", "nprandom")
+
+
+def _is_host_draw(call: ast.Call) -> bool:
+    if not isinstance(call.func, ast.Attribute):
+        return False
+    if call.func.attr not in _DRAW_METHODS:
+        return False
+    recv = (dotted_name(call.func.value) or "").lower()
+    leaf = recv.split(".")[-1]
+    return (
+        any(h in leaf for h in _GEN_HINTS)
+        or leaf in ("gen", "g")
+    )
+
+
+def check_program(program) -> List[Finding]:
+    findings: List[Finding] = []
+    for m in program.modules:
+        if not program.in_scope(m):
+            continue
+        for fi in m.funcs:
+            # device bodies use jax PRNG keys, not host generators;
+            # the contract here is host-side
+            if fi.device:
+                continue
+            taint = None  # computed lazily: most functions have no
+            # conditional draws at all
+            stack: List[ast.AST] = list(
+                ast.iter_child_nodes(fi.node)
+            )
+            while stack:
+                node = stack.pop()
+                if isinstance(
+                    node,
+                    (
+                        ast.FunctionDef,
+                        ast.AsyncFunctionDef,
+                        ast.ClassDef,
+                    ),
+                ):
+                    continue
+                tests = []
+                bodies = []
+                if isinstance(node, (ast.If, ast.While)):
+                    tests = [node.test]
+                    bodies = [node.body, node.orelse]
+                elif isinstance(node, ast.IfExp):
+                    tests = [node.test]
+                    bodies = [[node.body], [node.orelse]]
+                if tests:
+                    draws = [
+                        sub
+                        for blk in bodies
+                        for stmt in blk
+                        for sub in ast.walk(stmt)
+                        if isinstance(sub, ast.Call)
+                        and _is_host_draw(sub)
+                    ]
+                    if draws:
+                        if taint is None:
+                            taint = program.taint(fi)
+                        if any(
+                            taint.is_tainted(t) for t in tests
+                        ):
+                            for d in draws:
+                                f = m.finding(
+                                    RULE_ID,
+                                    d,
+                                    f"host-generator draw "
+                                    f"`{call_name(d)}` under a "
+                                    "conditional whose predicate "
+                                    "derives from a device value — "
+                                    "the draw COUNT now depends on "
+                                    "device rounding, breaking the "
+                                    "fixed-seed bit-parity contract; "
+                                    "draw unconditionally and select "
+                                    "the result, or move the "
+                                    "decision to a host-deterministic "
+                                    "signal",
+                                )
+                                if f:
+                                    findings.append(f)
+                stack.extend(ast.iter_child_nodes(node))
+    return findings

@@ -141,6 +141,7 @@ def _extract(archive: Dict) -> str:
             zf.extractall(tmp)
         open(os.path.join(tmp, ".complete"), "w").close()
         try:
+            # ray-tpu: allow[RTA009] directory publish for the extraction cache — concurrent workers race on the rename; the content is a re-extractable cache with no durability contract
             os.replace(tmp, dest)  # atomic: concurrent workers race safely
         except OSError:
             import shutil

@@ -195,6 +195,15 @@ class JaxPolicy(Policy):
 
         # (batch_size, with_frames) -> compiled SGD-nest program
         self._learn_fns: Dict[Tuple[int, bool], Any] = {}
+        # AOT executable cache for the learn program (sharding/aot.py;
+        # ROADMAP item 2 leftover): resolved lazily from
+        # config["aot_cache_dir"] so importing the policy never touches
+        # the cache machinery. The elastic joiner's warmup rides this —
+        # a freshly built policy whose fleet already populated the
+        # cache installs the serialized executable instead of paying
+        # the XLA compile (aot_warmup in learn_on_device_batch).
+        self._aot_cache = None
+        self._aot_cache_resolved = False
         self._action_fn = None
         self._value_fn = None
         self.num_grad_updates = 0
@@ -1521,6 +1530,44 @@ class JaxPolicy(Policy):
             self._learn_fns[key] = fn
         return fn
 
+    def _learn_aot_cache(self):
+        """The AOT executable cache for learn programs, resolved once
+        from ``config["aot_cache_dir"]`` (None when unconfigured)."""
+        if not self._aot_cache_resolved:
+            self._aot_cache_resolved = True
+            root = self.config.get("aot_cache_dir")
+            if root:
+                from ray_tpu.sharding import aot as aot_lib
+
+                self._aot_cache = aot_lib.resolve_cache(root)
+        return self._aot_cache
+
+    # the warmup belongs to the driver thread: it installs the
+    # program's dispatch path, which must not race a learn in flight
+    # ray-tpu: thread=driver
+    def _maybe_aot_warm(self, fn, args) -> None:
+        """Elastic-joiner cold start (``ShardedFunction.aot_warmup``):
+        before a freshly built learn program's FIRST dispatch, try to
+        install the fleet-shared serialized executable for this exact
+        signature. A hit means a joiner (or restarted driver) runs its
+        first learn step with ZERO fresh compiles; a miss compiles
+        ahead of time once and seeds the cache for the next joiner.
+        ``aot_warmup`` only LOWERS — nothing dispatches, so the
+        donated opt_state buffers in ``args`` are untouched (no
+        RTA001 hazard) and the caller reuses them for the real call."""
+        if getattr(fn, "_aot_warm_attempted", False):
+            return  # one attempt per program (a "disabled" jax build
+            # must not pay a lower() per learn call)
+        fn._aot_warm_attempted = True
+        if getattr(fn, "aot_source", None) is not None:
+            return  # already warmed (hit, live-compiled, or fallback)
+        if getattr(fn, "traces", 0) > 0 or getattr(fn, "calls", 0) > 0:
+            return  # program already compiled live: nothing to save
+        cache = self._learn_aot_cache()
+        if cache is None:
+            return
+        fn.aot_warmup(cache, *args)
+
     def learn_on_device_batch(
         self, dev_batch: Dict[str, Any], batch_size: int,
         *, defer_stats: bool = False,
@@ -1559,6 +1606,14 @@ class JaxPolicy(Policy):
             fn = self.learn_fn(batch_size)
         self._update_scheduled_coeffs()
         self._rng, rng = jax.random.split(self._rng)
+        coeffs = self._coeff_array()
+        # elastic-joiner AOT warmup at the _build_learn_fn call site:
+        # install the fleet-shared executable for this signature
+        # before the first dispatch (no-op without aot_cache_dir)
+        self._maybe_aot_warm(
+            fn,
+            (self.params, self.opt_state, aux, dev_batch, rng, coeffs),
+        )
         compiles_before = getattr(fn, "traces", 0)
         compile_s_before = getattr(fn, "compile_time_s", 0.0)
         t0 = _time.perf_counter()
@@ -1571,7 +1626,7 @@ class JaxPolicy(Policy):
                 aux,
                 dev_batch,
                 rng,
-                self._coeff_array(),
+                coeffs,
             )
             self.num_grad_updates += self.num_sgd_iter * max(
                 1, batch_size // max(1, self.minibatch_size)

@@ -200,12 +200,11 @@ class CheckpointStreamer:
             path = os.path.join(
                 self.root, f"snapshot_{snap['superstep']:010d}.pkl"
             )
-            from ray_tpu.algorithms.algorithm import Algorithm
+            from ray_tpu.util.atomic_io import atomic_write
 
-            Algorithm._atomic_write(
-                path, lambda f: pickle.dump(payload, f)
-            )
-            Algorithm._fsync_dir(self.root)
+            # atomic + file fsync + directory fsync in one helper:
+            # the stream tail on disk is always a complete snapshot
+            atomic_write(path, lambda f: pickle.dump(payload, f))
         self.latest_path = path
         self._last_written = snap["superstep"]
         self.num_snapshots += 1

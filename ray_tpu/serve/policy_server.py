@@ -555,8 +555,9 @@ class BatchedPolicyServer:
             actions, extra, self._carry = fn(
                 params, self._carry, padded, np.int32(n), coeffs
             )
+        # ray-tpu: allow[RTA005] the serve forward's ONE counted drain: result materialization closes the ledger interval (drain_point below)
         actions = np.asarray(actions)[:n]
-        extra = {k: np.asarray(v)[:n] for k, v in extra.items()}
+        extra = {k: np.asarray(v)[:n] for k, v in extra.items()}  # ray-tpu: allow[RTA005] same counted drain
         # results materialized host-side → the serve program finished;
         # close its ledger interval (timestamps only, no extra sync)
         from ray_tpu.telemetry import device as device_ledger

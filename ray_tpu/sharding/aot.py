@@ -235,13 +235,10 @@ class AOTCompileCache:
                     "out_tree": out_tree,
                 }
             )
+            from ray_tpu.util.atomic_io import atomic_write
+
             path = self.path_for(label, signature)
-            tmp = path + ".tmp.%d" % os.getpid()
-            with open(tmp, "wb") as f:
-                f.write(blob)
-                f.flush()
-                os.fsync(f.fileno())
-            os.replace(tmp, path)
+            atomic_write(path, lambda f: f.write(blob))
         except Exception:
             self._count("save_errors")
             _metric("save_error")

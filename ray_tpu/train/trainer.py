@@ -51,12 +51,12 @@ class _TrainWorker:
                     # durable mid-run checkpoint: the group-restart
                     # path resumes from here if a worker dies
                     # (reference train fault tolerance)
-                    import os
+                    from ray_tpu.util.atomic_io import atomic_write
 
-                    tmp = f"{ckpt_path}.tmp{os.getpid()}"
-                    with open(tmp, "wb") as f:
-                        f.write(ckpt.to_bytes())
-                    os.replace(tmp, ckpt_path)
+                    atomic_write(
+                        ckpt_path,
+                        lambda f: f.write(ckpt.to_bytes()),
+                    )
 
         air_session._init_session(
             self.rank, self.world_size, report_fn, checkpoint

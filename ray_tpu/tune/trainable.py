@@ -87,10 +87,12 @@ class Trainable:
             "timesteps_total": self._timesteps_total,
             "time_total": self._time_total,
         }
-        with open(
-            os.path.join(checkpoint_dir, ".tune_metadata"), "wb"
-        ) as f:
-            pickle.dump(meta, f)
+        from ray_tpu.util.atomic_io import atomic_write
+
+        atomic_write(
+            os.path.join(checkpoint_dir, ".tune_metadata"),
+            lambda f: pickle.dump(meta, f),
+        )
         return path or checkpoint_dir
 
     def restore(self, checkpoint_path: str) -> None:

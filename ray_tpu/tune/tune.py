@@ -318,10 +318,11 @@ class TrialRunner:
             }
             for t in self.trials
         }
-        tmp = path + ".tmp"
-        with open(tmp, "wb") as f:
-            pickle.dump(state, f)
-        os.replace(tmp, path)  # atomic: a crash never corrupts state
+        from ray_tpu.util.atomic_io import atomic_write
+
+        # atomic + fsync'd: a crash never corrupts (or un-publishes)
+        # the experiment state a resume depends on
+        atomic_write(path, lambda f: pickle.dump(state, f))
         self._maybe_sync_up()
 
     def _restore_experiment_state(self) -> None:

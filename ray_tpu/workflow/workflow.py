@@ -30,6 +30,7 @@ import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import ray_tpu as ray
+from ray_tpu.util.atomic_io import atomic_write
 
 _DEFAULT_STORAGE = os.path.expanduser("~/.ray_tpu_workflows")
 
@@ -186,10 +187,8 @@ class _Execution:
             # dynamic continuations are resolved inside _run_step (so
             # catch_exceptions wrapping can't hide them)
             value = self._run_step(node, args, kwargs)
-            tmp = path + f".tmp{os.getpid()}"
-            with open(tmp, "wb") as f:
-                pickle.dump(value, f)
-            os.replace(tmp, path)  # atomic: crash-safe checkpoint
+            # atomic + fsync'd: crash-safe step checkpoint
+            atomic_write(path, lambda f: pickle.dump(value, f))
             self.steps_run.append(step_id)
             return value
         if isinstance(node, (list, tuple)):
@@ -213,10 +212,10 @@ def _read_status(wf_dir: str) -> Dict:
 def _write_status(wf_dir: str, **fields) -> None:
     cur = _read_status(wf_dir)
     cur.update(fields)
-    tmp = os.path.join(wf_dir, f"status.json.tmp{os.getpid()}")
-    with open(tmp, "w") as f:
-        json.dump(cur, f)
-    os.replace(tmp, os.path.join(wf_dir, "status.json"))
+    atomic_write(
+        os.path.join(wf_dir, "status.json"),
+        lambda f: f.write(json.dumps(cur).encode()),
+    )
 
 
 @contextlib.contextmanager
@@ -250,9 +249,8 @@ def run(
         try:
             from ray_tpu.core import serialization as _ser
 
-            with open(dag_path + ".tmp", "wb") as f:
-                f.write(_ser.dumps(dag))
-            os.replace(dag_path + ".tmp", dag_path)
+            blob = _ser.dumps(dag)
+            atomic_write(dag_path, lambda f: f.write(blob))
         except Exception:
             pass  # truly unpicklable DAG: resume-by-id unavailable
     # a cancel() issued before (or racing) this startup write must not

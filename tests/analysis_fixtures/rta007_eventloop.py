@@ -1,0 +1,39 @@
+"""RTA007 fixtures: blocking calls reachable from the event loop."""
+
+import asyncio
+import time
+
+
+class Front:
+    async def tp_handler(self, req):
+        time.sleep(0.01)  # BAD: suspends every connection
+        return req
+
+    async def tn_handler(self, req):
+        await asyncio.sleep(0.01)  # the async shape: fine
+        return self._shape(req)
+
+    def _shape(self, req):
+        return {"obs": req}
+
+    async def tp_reaches_sync(self, req):
+        # the helper blocks; the finding lands there with a witness
+        return self.tp_helper_blocks(req)
+
+    def tp_helper_blocks(self, req):
+        return self.fut.result()  # BAD: blocking future harvest
+
+    # ray-tpu: thread=ingress-loop
+    def tp_loop_owned(self):
+        return self.in_queue.get()  # BAD: parks the loop thread
+
+    def tn_not_reachable(self, req):
+        time.sleep(0.01)  # fine: nothing on the loop calls this
+        return req
+
+    async def tn_nonblocking_queue(self):
+        return self.in_queue.get(block=False)
+
+    async def tn_executor_handoff(self, loop):
+        # handing blocking work to an executor is the sanctioned shape
+        return await loop.run_in_executor(None, time.sleep, 0.01)

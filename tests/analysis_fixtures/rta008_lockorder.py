@@ -1,0 +1,49 @@
+"""RTA008 fixtures: lock-order inversions across the call graph."""
+
+import threading
+
+
+class Inverted:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+
+    def tp_forward(self):
+        with self._a:
+            with self._b:  # order (a, b)
+                pass
+
+    def tp_backward(self):
+        with self._b:
+            self._take_a()  # order (b, a) through the call graph: BAD
+
+    def _take_a(self):
+        with self._a:
+            pass
+
+
+class Consistent:
+    def __init__(self):
+        self._outer = threading.Lock()
+        self._inner = threading.Lock()
+
+    def tn_one(self):
+        with self._outer:
+            with self._inner:  # always (outer, inner): fine
+                pass
+
+    def tn_two(self):
+        with self._outer:
+            self._locked_step()
+
+    def _locked_step(self):
+        with self._inner:
+            pass
+
+    def tn_condition_idiom(self):
+        # wait/notify on the HELD lock is the condition idiom, not a
+        # second acquisition
+        with self._outer:
+            pass
+        with self._inner:
+            pass

@@ -1,0 +1,55 @@
+"""RTA009 fixtures: durability discipline for checkpoint-grade writes."""
+
+import os
+import pickle
+
+
+def tp_hand_rolled(path, obj):
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        pickle.dump(obj, f)
+    os.replace(tmp, path)  # BAD: no fsync, outside the helper
+
+
+def tp_raw_checkpoint_open(checkpoint_dir, blob):
+    # BAD: truncate-then-write window on a checkpoint artifact
+    with open(os.path.join(checkpoint_dir, "state.bin"), "wb") as f:
+        f.write(blob)
+
+
+# ray-tpu: atomic-writer
+def tp_writer_missing_fsync(path, blob):
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(blob)
+    os.replace(tmp, path)  # BAD: rename may beat the data blocks
+
+
+# ray-tpu: atomic-writer
+def tn_proper_writer(path, blob):
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(blob)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    fsync_dir(os.path.dirname(path))
+
+
+def fsync_dir(d):
+    fd = os.open(d, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def tn_read_checkpoint(checkpoint_dir):
+    with open(os.path.join(checkpoint_dir, "state.bin"), "rb") as f:
+        return f.read()
+
+
+def tn_scratch_write(log_dir, text):
+    # not a checkpoint artifact: plain writes are fine
+    with open(os.path.join(log_dir, "notes.txt"), "w") as f:
+        f.write(text)

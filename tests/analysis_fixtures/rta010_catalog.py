@@ -1,0 +1,44 @@
+"""RTA010 fixtures: metric/span catalog consistency vs the real docs.
+
+Scanned with ``root`` at the repo, so the checks run against the
+actual ``docs/observability.md`` catalog.
+"""
+
+from ray_tpu.util import tracing
+from ray_tpu.utils.metrics import Counter, Gauge
+
+
+def tp_undocumented_family():
+    # BAD: no catalog row for this family
+    return Counter("ray_tpu_fixture_bogus_total", "a counter")
+
+
+def tp_undocumented_tag():
+    # BAD: the documented row for queue_depth does not name this tag
+    return Gauge(
+        "ray_tpu_queue_depth",
+        "queue depth",
+        tag_keys=("queue", "fixture_bogus_tag"),
+    )
+
+
+def tp_undocumented_span():
+    with tracing.start_span("fixture:bogus_stage"):
+        pass
+
+
+def tn_documented_family():
+    return Counter(
+        "ray_tpu_ingress_requests_total", "front-door requests"
+    )
+
+
+def tn_documented_span():
+    with tracing.start_span("learn:transfer"):
+        pass
+
+
+def tn_documented_glob_span():
+    # covered by the documented `recovery:*` glob
+    with tracing.start_span("recovery:fixture_case"):
+        pass

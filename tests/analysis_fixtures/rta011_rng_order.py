@@ -1,0 +1,43 @@
+"""RTA011 fixtures: host-RNG draws under device-derived conditionals."""
+
+import jax
+import numpy as np
+
+
+class Sampler:
+    def __init__(self, seed):
+        self.rng = np.random.default_rng(seed)
+        self._td_fn = None
+
+    def _build_td_fn(self):
+        return self._td_fn
+
+    def tp_conditional_draw(self, batch):
+        fn = self._build_td_fn()
+        td = fn(batch)
+        err = jax.device_get(td)
+        if err.max() > 1.0:  # predicate derives from device data
+            return self.rng.integers(0, 10)  # BAD: draw-count drift
+        return 0
+
+    def tn_unconditional_draw(self, batch):
+        fn = self._build_td_fn()
+        td = fn(batch)
+        draw = self.rng.integers(0, 10)  # drawn every call: order fixed
+        err = jax.device_get(td)
+        if err.max() > 1.0:
+            return draw
+        return 0
+
+    def tn_config_conditional(self, cfg):
+        if cfg.get("explore"):  # host-deterministic predicate: fine
+            return self.rng.integers(0, 10)
+        return 0
+
+    def tn_device_value_as_argument(self, batch):
+        fn = self._build_td_fn()
+        td = fn(batch)
+        hi = int(jax.device_get(td).max()) + 2
+        # consuming a device value as an ARGUMENT keeps the draw
+        # order fixed — only the predicate position breaks parity
+        return self.rng.integers(0, hi)

@@ -1,0 +1,16 @@
+"""RTA012 fixtures: config-knob reachability (the config side).
+
+Scanned TOGETHER with ``rta012_knobs_reader.py`` (reads must come
+from a DIFFERENT module) against the repo root, so the doc arm runs
+over the real ``docs/API.md``.
+"""
+
+
+class AlgorithmConfig:
+    def __init__(self):
+        self.tp_unused_knob = 1  # BAD: read nowhere
+        # BAD: read by the reader module but absent from docs/API.md
+        self.tp_undocumented_knob = 2
+        # read by the reader module AND in the docs index: fine
+        self.train_batch_size = 4000
+        self._private_state = 0  # private: never a knob

@@ -1,0 +1,8 @@
+"""RTA012 fixtures: the consuming side (reads live off-module)."""
+
+
+def make_tp_reader(config):
+    return (
+        config.get("tp_undocumented_knob"),
+        config["train_batch_size"],
+    )

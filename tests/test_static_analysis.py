@@ -61,8 +61,21 @@ class TestRepoGate:
             "baseline entries whose finding is gone — remove them: "
             f"{res.stale_baseline}"
         )
-        # the gate must stay a trivial fraction of the tier-1 budget
-        assert res.duration_s < 120
+        # the gate must stay a trivial fraction of the tier-1 budget:
+        # budgeted against the recorded bench (`bench.py --lint`),
+        # with generous headroom for a loaded single-core container
+        bench_path = os.path.join(
+            REPO, "benchmarks", "e2e", "static_analysis.json"
+        )
+        with open(bench_path) as f:
+            bench = json.load(f)
+        assert bench["scan_wall_s"] <= 10.0, (
+            "recorded full-scan wall blew the 10 s acceptance "
+            "budget — re-run `python bench.py --lint` on an idle "
+            "container and investigate the regression"
+        )
+        assert bench["since_wall_s"] < bench["scan_wall_s"]
+        assert res.duration_s < max(45.0, 5 * bench["scan_wall_s"])
 
     def test_cli_runs_without_jax(self):
         """`python -m ray_tpu.analysis --json` is a pure-AST pass: it
@@ -112,6 +125,12 @@ FIXTURE_CASES = [
     ("rta004_rng.py", "RTA004", 3),
     ("rta005_hostsync.py", "RTA005", 2),
     ("rta006_threads.py", "RTA006", 2),
+    # the v2 rule pack (whole-program call graph + taint)
+    ("rta007_eventloop.py", "RTA007", 3),
+    ("rta008_lockorder.py", "RTA008", 1),
+    ("rta009_durability.py", "RTA009", 3),
+    ("rta010_catalog.py", "RTA010", 3),
+    ("rta011_rng_order.py", "RTA011", 1),
 ]
 
 
@@ -161,6 +180,26 @@ class TestFixtureCorpus:
             "tn_explicit_dtype_body" in f.symbol
             for f in res.findings
         )
+
+    def test_rta012_knob_reachability(self):
+        """Knob fixtures span two files (reads must be off-module):
+        the unread knob and the read-but-undocumented knob are
+        flagged; the documented `train_batch_size` read is clean."""
+        res = scan_paths(
+            [
+                os.path.join(FIXTURES, "rta012_knobs.py"),
+                os.path.join(FIXTURES, "rta012_knobs_reader.py"),
+            ],
+            root=REPO,
+        )
+        assert res.parse_errors == []
+        got = sorted(
+            (f.rule, f.message.split("`")[1]) for f in res.findings
+        )
+        assert got == [
+            ("RTA012", "tp_undocumented_knob"),
+            ("RTA012", "tp_unused_knob"),
+        ], [f.render() for f in res.findings]
 
 
 # ---------------------------------------------------------------------------
@@ -321,3 +360,299 @@ class TestBaseline:
         res = scan_source(tmp_path, fixed, baseline=entries)
         assert res.findings == []
         assert res.stale_baseline == entries
+
+
+# ---------------------------------------------------------------------------
+# v2: whole-program machinery
+
+
+class TestWholeProgram:
+    def test_cross_module_device_propagation(self, tmp_path):
+        """A helper in ANOTHER module called from a traced body is a
+        device context: the global fixed point carries the fact
+        across the import, and RTA002 fires where the v1 engine was
+        blind."""
+        (tmp_path / "helper.py").write_text(
+            textwrap.dedent(
+                """
+                import numpy as np
+
+
+                def mean_of(x):
+                    return np.mean(x)
+                """
+            )
+        )
+        (tmp_path / "prog.py").write_text(
+            textwrap.dedent(
+                """
+                from ray_tpu.sharding.compile import sharded_jit
+
+                from helper import mean_of
+
+
+                def build():
+                    def body(x):
+                        return mean_of(x)
+
+                    return sharded_jit(body, label="m")
+                """
+            )
+        )
+        # helper alone: clean (nothing marks it device)
+        solo = scan_paths([str(tmp_path / "helper.py")], root=str(tmp_path))
+        assert solo.findings == []
+        both = scan_paths(
+            [str(tmp_path / "helper.py"), str(tmp_path / "prog.py")],
+            root=str(tmp_path),
+        )
+        hits = [
+            f
+            for f in both.findings
+            if f.rule == "RTA002" and f.path == "helper.py"
+        ]
+        assert hits, [f.render() for f in both.findings]
+        assert hits[0].symbol == "mean_of"
+
+    def test_since_scope_is_changed_plus_reverse_dependents(
+        self, tmp_path
+    ):
+        (tmp_path / "a.py").write_text(
+            "import numpy as np\n\n\n"
+            "def helper(n):\n"
+            "    return np.random.randint(0, n)\n"
+        )
+        (tmp_path / "b.py").write_text(
+            "from a import helper\n\n\n"
+            "def caller(n):\n"
+            "    return helper(n)\n"
+        )
+        (tmp_path / "c.py").write_text(
+            "def unrelated():\n    return 1\n"
+        )
+        res = scan_paths(
+            [str(tmp_path)], root=str(tmp_path), changed=["a.py"]
+        )
+        assert res.mode == "since"
+        assert res.affected_paths == {"a.py", "b.py"}
+        assert [f.rule for f in res.findings] == ["RTA004"]
+        # an out-of-scope change set skips a.py's finding entirely
+        res2 = scan_paths(
+            [str(tmp_path)], root=str(tmp_path), changed=["c.py"]
+        )
+        assert res2.findings == []
+        assert res2.affected_paths == {"c.py"}
+
+    def test_json_schema_is_versioned(self, tmp_path):
+        from ray_tpu.analysis.engine import SCHEMA_VERSION
+
+        res = scan_source(tmp_path, VIOLATION)
+        d = res.to_dict()
+        assert d["schema_version"] == SCHEMA_VERSION == 2
+        assert d["mode"] == "full"
+        assert set(d) >= {
+            "ok", "files", "findings", "counts", "duration_s",
+            "affected_files", "rules_run",
+        }
+
+
+class TestCLISince:
+    def _git(self, cwd, *args):
+        return subprocess.run(
+            ["git", "-c", "user.email=t@t", "-c", "user.name=t"]
+            + list(args),
+            cwd=cwd,
+            capture_output=True,
+            text=True,
+            timeout=30,
+        )
+
+    def test_since_rev_scans_only_the_diff(self, tmp_path, capsys):
+        from ray_tpu.analysis.__main__ import main
+
+        (tmp_path / "a.py").write_text("def ok():\n    return 1\n")
+        (tmp_path / "b.py").write_text(
+            "from a import ok\n\n\ndef caller():\n    return ok()\n"
+        )
+        assert self._git(tmp_path, "init", "-q").returncode == 0
+        self._git(tmp_path, "add", "-A")
+        assert self._git(
+            tmp_path, "commit", "-qm", "seed"
+        ).returncode == 0
+        # clean tree: --since HEAD runs rules on nothing
+        rc = main(
+            [
+                "--since", "HEAD", "--json", "--root", str(tmp_path),
+                "--no-baseline", str(tmp_path),
+            ]
+        )
+        report = json.loads(capsys.readouterr().out)
+        assert rc == 0 and report["mode"] == "since"
+        assert report["affected_files"] == 0
+        # introduce a violation in a.py: scope = a.py + dependent b.py
+        (tmp_path / "a.py").write_text(
+            "import numpy as np\n\n\n"
+            "def ok():\n    return np.random.randint(0, 3)\n"
+        )
+        rc = main(
+            [
+                "--since", "HEAD", "--json", "--root", str(tmp_path),
+                "--no-baseline", str(tmp_path),
+            ]
+        )
+        report = json.loads(capsys.readouterr().out)
+        assert rc == 1
+        assert report["mode"] == "since"
+        assert report["affected_files"] == 2
+        assert [f["rule"] for f in report["findings"]] == ["RTA004"]
+
+    def test_write_baseline_prunes_stale_entries(
+        self, tmp_path, capsys
+    ):
+        from ray_tpu.analysis.__main__ import main
+
+        (tmp_path / "mod.py").write_text(textwrap.dedent(VIOLATION))
+        bpath = tmp_path / "baseline.json"
+        bpath.write_text(
+            json.dumps(
+                {
+                    "version": 1,
+                    "entries": [
+                        {
+                            "rule": "RTA004",
+                            "path": "mod.py",
+                            "symbol": "draw",
+                        },
+                        {
+                            "rule": "RTA001",
+                            "path": "gone.py",
+                            "symbol": "long_fixed",
+                        },
+                    ],
+                }
+            )
+        )
+        rc = main(
+            [
+                "--write-baseline", "--root", str(tmp_path),
+                "--baseline", str(bpath), str(tmp_path / "mod.py"),
+            ]
+        )
+        out = capsys.readouterr().out
+        assert rc == 0 and "1 stale pruned" in out
+        entries = load_baseline(str(bpath))
+        assert entries == [
+            {"rule": "RTA004", "path": "mod.py", "symbol": "draw"}
+        ]
+
+
+# ---------------------------------------------------------------------------
+# mutation validation: one representative violation per new rule,
+# injected into a REAL module — each trips its rule and only its rule
+
+
+MUTATIONS = [
+    pytest.param(
+        "ray_tpu/ingress/http.py",
+        [
+            (
+                "router, admission = entry\n",
+                "router, admission = entry\n"
+                "        time.sleep(0.01)\n",
+            )
+        ],
+        "RTA007",
+        id="rta007-bare-sleep-in-ingress-handler",
+    ),
+    pytest.param(
+        "ray_tpu/autoscaler/fleet.py",
+        [
+            (
+                "self._lock = threading.Lock()\n",
+                "self._lock = threading.Lock()\n"
+                "        self._mut_lock = threading.Lock()\n",
+            ),
+            (
+                "    def stats(self) -> Dict:\n",
+                "    def _mut_a(self):\n"
+                "        with self._lock:\n"
+                "            with self._mut_lock:\n"
+                "                pass\n"
+                "\n"
+                "    def _mut_b(self):\n"
+                "        with self._mut_lock:\n"
+                "            with self._lock:\n"
+                "                pass\n"
+                "\n"
+                "    def stats(self) -> Dict:\n",
+            ),
+        ],
+        "RTA008",
+        id="rta008-swapped-lock-pair-in-fleet",
+    ),
+    pytest.param(
+        "ray_tpu/resilience/streamer.py",
+        [
+            (
+                "atomic_write(path, lambda f: pickle.dump(payload, f))",
+                'tmp = path + ".mut"\n'
+                '            with open(tmp, "wb") as _f:\n'
+                "                pickle.dump(payload, _f)\n"
+                "            os.replace(tmp, path)",
+            )
+        ],
+        "RTA009",
+        id="rta009-unfsynced-replace-in-streamer",
+    ),
+    pytest.param(
+        "ray_tpu/autoscaler/fleet.py",
+        [('"fleet:drain"', '"fleet:mutated_drain"')],
+        "RTA010",
+        id="rta010-renamed-span-in-fleet",
+    ),
+    pytest.param(
+        "ray_tpu/algorithms/dreamer/dreamer.py",
+        [("# ray-tpu: allow[RTA011]", "# (allow dropped)")],
+        "RTA011",
+        id="rta011-dropped-allow-in-dreamer",
+    ),
+    pytest.param(
+        "ray_tpu/algorithms/algorithm_config.py",
+        [
+            (
+                "self.gamma = 0.99\n",
+                "self.gamma = 0.99\n"
+                "        self.mut_unused_knob = 7\n",
+            )
+        ],
+        "RTA012",
+        id="rta012-dead-knob-in-config",
+    ),
+]
+
+
+class TestMutationValidation:
+    @pytest.mark.parametrize("rel,edits,rule", MUTATIONS)
+    def test_injected_violation_trips_exactly_its_rule(
+        self, tmp_path, rel, edits, rule
+    ):
+        src = open(os.path.join(REPO, rel)).read()
+        target = tmp_path / os.path.basename(rel)
+        target.write_text(src)
+        before = scan_paths([str(target)], root=REPO)
+        key = lambda f: (f.rule, f.symbol, f.message)
+        baseline_keys = {key(f) for f in before.findings}
+
+        mutated = src
+        for old, new in edits:
+            assert old in mutated, f"anchor drifted in {rel}: {old!r}"
+            mutated = mutated.replace(old, new, 1)
+        target.write_text(mutated)
+        after = scan_paths([str(target)], root=REPO)
+        fresh = [
+            f for f in after.findings if key(f) not in baseline_keys
+        ]
+        assert fresh, f"mutation of {rel} tripped nothing"
+        assert all(f.rule == rule for f in fresh), [
+            f.render() for f in fresh
+        ]
