@@ -36,20 +36,23 @@ ARTIFACT_DIR = pathlib.Path(__file__).parent / "benchmarks" / "e2e"
 
 
 def _ppo_cartpole():
-    # reference cartpole-ppo.yaml runs num_workers=0 with the driver ON
-    # a CPU; here the driver owns the TPU tunnel (per-env-step
-    # inference latency), so the one CPU rollout worker is a separate
-    # actor — same core count, same semantics
+    # FUSED LANE (ROADMAP 5a): the jax-native CartPole rolls out ON
+    # the learner mesh and rollout+GAE+the SGD nest dispatch as one
+    # fused superstep program (jax_fused_rollout, superstep="auto") —
+    # zero rollout bytes over H2D. The old actor-lane variant of this
+    # config lives on as `plumbing_ppo` (SyntheticFast) for sampler-
+    # loop trend continuity; fixed-seed trajectory parity between the
+    # two lanes is tests/test_jax_env.py's contract.
+    import ray_tpu.env.jax_control  # noqa: F401  registers CartPoleJax-v0
     from ray_tpu.algorithms.ppo import PPOConfig
 
     return (
         PPOConfig()
-        .environment("CartPole-v1")
+        .environment("CartPoleJax-v0", env_backend="jax")
         .rollouts(
-            num_rollout_workers=1,
-            num_envs_per_worker=4,
-            rollout_fragment_length=256,
-            sample_prefetch=1,
+            num_rollout_workers=0,
+            num_envs_per_worker=32,
+            rollout_fragment_length=64,
         )
         .training(
             gamma=0.99, lr=3e-4, lambda_=0.95,
@@ -62,21 +65,20 @@ def _ppo_cartpole():
 
 
 def _ppo_pong():
-    # reference geometry: ppo/pong-ppo.yaml (1 GPU + 32 workers);
-    # worker count scaled to this 1-core host
-    import ray_tpu.env.pong_lite  # noqa: F401  registers PongLite-v0
+    # reference geometry: ppo/pong-ppo.yaml (1 GPU + 32 workers).
+    # FUSED LANE (ROADMAP 5a): PongLiteJax rolls the pixel env out on
+    # the learner mesh — the rollout+learn superstep replaces the
+    # 2-worker CPU sampler ensemble the earlier rounds measured
+    import ray_tpu.env.jax_pong  # noqa: F401  registers PongLiteJax-v0
     from ray_tpu.algorithms.ppo import PPOConfig
 
     return (
         PPOConfig()
-        .environment("PongLite-v0")
+        .environment("PongLiteJax-v0", env_backend="jax")
         .rollouts(
-            num_rollout_workers=2,
-            num_envs_per_worker=8,
+            num_rollout_workers=0,
+            num_envs_per_worker=16,
             rollout_fragment_length=128,
-            # pipelined sampling (docs/pipeline.md): batch k+1 collects,
-            # concats and transfers while the SGD nest runs batch k
-            sample_prefetch=1,
         )
         .training(
             gamma=0.99, lr=2.5e-4, lambda_=0.95,

@@ -436,6 +436,8 @@ class DeviceReplayBuffer:
         mesh=None,
         memory_cap_bytes: Optional[int] = None,
         label: str = "default_policy",
+        use_pallas=None,
+        pallas_interpret: bool = False,
     ):
         from ray_tpu import sharding as sharding_lib
 
@@ -444,6 +446,13 @@ class DeviceReplayBuffer:
         self.mesh = mesh if mesh is not None else sharding_lib.get_mesh()
         self.memory_cap_bytes = memory_cap_bytes
         self.label = label
+        # None = auto: insert/sample row movement through the Pallas
+        # row-copy kernels (ops/framestack.py) where they lower —
+        # bitwise-identical data movement either way. Auto stays off on
+        # multi-device meshes (the kernels address the local ring, not
+        # a sharded one); a forced True is honored as-is (tests).
+        self.use_pallas = use_pallas
+        self.pallas_interpret = bool(pallas_interpret)
         self._store: Dict[str, Any] = {}  # name -> device ring array
         # name -> (row_shape, dtype, packed_as_uint32)
         self._meta: Dict[str, tuple] = {}
@@ -578,13 +587,28 @@ class DeviceReplayBuffer:
         self._sample_fn = None
         return True
 
+    def _resolve_pallas(self):
+        """The per-program use_pallas value: explicit knob wins; auto
+        (None) passes through to the kernels' own lowering probes,
+        except on multi-device meshes where it resolves to False."""
+        if self.use_pallas is not None:
+            return bool(self.use_pallas)
+        if self.pallas_interpret:
+            return True
+        if int(self.mesh.devices.size) != 1:
+            return False
+        return None
+
     def _build_insert_fn(self):
         import jax
         import jax.numpy as jnp
 
         from ray_tpu import sharding as sharding_lib
+        from ray_tpu.ops import framestack as framestack_lib
 
         meta = dict(self._meta)
+        up = self._resolve_pallas()
+        interp = self.pallas_interpret
 
         def fn(store, rows, pos):
             out = dict(store)
@@ -594,7 +618,9 @@ class DeviceReplayBuffer:
                     v = jax.lax.bitcast_convert_type(
                         v.reshape(v.shape[0], -1, 4), jnp.uint32
                     )
-                out[k] = store[k].at[pos].set(v)
+                out[k] = framestack_lib.scatter_rows(
+                    store[k], pos, v, use_pallas=up, interpret=interp
+                )
             return out
 
         return sharding_lib.sharded_jit(
@@ -608,14 +634,19 @@ class DeviceReplayBuffer:
         import jax.numpy as jnp
 
         from ray_tpu import sharding as sharding_lib
+        from ray_tpu.ops import framestack as framestack_lib
 
         meta = dict(self._meta)
+        up = self._resolve_pallas()
+        interp = self.pallas_interpret
 
         def fn(store, idx):
             out = {}
             for k, v in store.items():
                 row_shape, dtype, packed = meta[k]
-                g = v[idx]
+                g = framestack_lib.gather_rows(
+                    v, idx, use_pallas=up, interpret=interp
+                )
                 if packed:
                     u8 = jax.lax.bitcast_convert_type(g, jnp.uint8)
                     g = u8.reshape((g.shape[0],) + row_shape)
@@ -811,12 +842,17 @@ class DeviceReplayBuffer:
         if not isinstance(idx, jax.Array):
             idx = np.ascontiguousarray(idx, np.int32)
         meta = dict(self._meta)
+        up = self._resolve_pallas()
+        interp = self.pallas_interpret
+        from ray_tpu.ops import framestack as framestack_lib
 
         def gather_fn(store, idx2):
             out = {}
             for k_, v in store.items():
                 row_shape, _, packed = meta[k_]
-                g = v[idx2]
+                g = framestack_lib.gather_rows(
+                    v, idx2, use_pallas=up, interpret=interp
+                )
                 if packed:
                     u8 = jax.lax.bitcast_convert_type(g, jnp.uint8)
                     g = u8.reshape(tuple(idx2.shape) + row_shape)

@@ -25,15 +25,185 @@ path where the transfer win lives.
 
 from __future__ import annotations
 
+import functools
 from typing import Dict, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+try:  # Pallas row-copy kernels (gather/scatter lanes); the XLA
+    # gather below stays the portable path and the golden reference
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+except Exception:  # pragma: no cover - minimal jax builds
+    pl = None
+    pltpu = None
+
 # Batch columns of the deduplicated format.
 FRAMES = "obs_frames"
 FRAME_IDX = "obs_frame_idx"
+
+
+# -- Pallas row gather/scatter (docs/data_plane.md "Pallas kernels") ---
+#
+# The replay sample path, the superstep ring feed and the framestack
+# rebuild are all the same access pattern: gather R rows of a (M, D)
+# uint32-lane store (uint8 pixels ride packed 4-wide — see
+# build_stacks). XLA lowers that to a general gather HLO; the Pallas
+# kernel is a scalar-prefetch row copy — the index vector rides SMEM
+# ahead of the grid, each grid step DMAs exactly one store row
+# HBM→VMEM→HBM. Pure data movement at uint32 lane width, so outputs
+# are BITWISE identical to the XLA path (the uint8 unpack around the
+# kernel is a bitcast — a layout view, not a copy). ``use_pallas``
+# resolves like ops/flash_attention.py: None = auto (Pallas on TPU
+# backends where the shape class lowers, XLA elsewhere);
+# ``interpret=True`` runs the kernel through the Pallas interpreter on
+# any backend (the CPU-client fallback the parity tests exercise).
+
+
+def _row_copy_kernel(idx_ref, src_ref, out_ref):
+    # index plumbing lives entirely in the BlockSpec index_maps; the
+    # body is the DMA'd row copy
+    out_ref[...] = src_ref[...]
+
+
+def _row_scatter_kernel(idx_ref, vals_ref, ring_ref, out_ref):
+    # ring_ref is the aliased initial output (read untouched); the
+    # body overwrites just the block the out index_map routed here
+    del ring_ref
+    out_ref[...] = vals_ref[...]
+
+
+def _pallas_rows(src2, flat_idx, out_rows, scatter, interpret):
+    """Shared pallas_call for row gather/scatter on a (M, D) array.
+    Gather: out[i] = src2[idx[i]]; scatter: out starts as the aliased
+    ring and out[idx[i]] = src2[i]."""
+    r = flat_idx.shape[0]
+    d = src2.shape[1]
+    if scatter:
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(r,),
+            in_specs=[
+                pl.BlockSpec((1, d), lambda i, idx_ref: (i, 0)),
+                # the aliased ring: route its block to the same row
+                # the output writes so the alias is block-consistent
+                pl.BlockSpec(
+                    (1, d), lambda i, idx_ref: (idx_ref[i], 0)
+                ),
+            ],
+            out_specs=pl.BlockSpec(
+                (1, d), lambda i, idx_ref: (idx_ref[i], 0)
+            ),
+        )
+        # operand indices for aliasing count past the scalar-prefetch
+        # operand: 0=idx, 1=vals, 2=ring → output 0. Rows no grid step
+        # writes keep the ring's contents (the circular-buffer
+        # contract).
+        return pl.pallas_call(
+            _row_scatter_kernel,
+            grid_spec=grid_spec,
+            out_shape=jax.ShapeDtypeStruct((out_rows, d), src2.dtype),
+            input_output_aliases={2: 0},
+            interpret=interpret,
+        )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(r,),
+        in_specs=[
+            pl.BlockSpec((1, d), lambda i, idx_ref: (idx_ref[i], 0))
+        ],
+        out_specs=pl.BlockSpec((1, d), lambda i, idx_ref: (i, 0)),
+    )
+    return pl.pallas_call(
+        _row_copy_kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((out_rows, d), src2.dtype),
+        interpret=interpret,
+    )
+
+
+@functools.lru_cache(maxsize=None)
+def _rows_lower(m, d, dtype_str, scatter):
+    """One-time probe per shape class: does the row-copy kernel lower
+    on this backend? (Mosaic's envelope shifts between releases; a
+    failing class falls back to the XLA gather instead of crashing the
+    replay hot loop.)"""
+    try:
+        src = jnp.zeros((m if scatter else 2, d), dtype_str)
+        ring = jnp.zeros((2, d), dtype_str)
+        idx = jnp.zeros((m if scatter else 1,), jnp.int32)
+        if scatter:
+            jax.jit(
+                lambda i, s, rg: _pallas_rows(s, i, 2, True, False)(
+                    i, s, rg
+                )
+            ).lower(idx, src, ring).compile()
+        else:
+            jax.jit(
+                lambda i, s: _pallas_rows(s, i, 1, False, False)(i, s)
+            ).lower(idx, src).compile()
+        return True
+    except Exception:  # pragma: no cover - backend-dependent
+        return False
+
+
+def _resolve_use_pallas(use_pallas, interpret, probe):
+    if use_pallas is None:
+        return interpret or (
+            jax.default_backend() == "tpu" and pltpu is not None
+            and probe()
+        )
+    return bool(use_pallas) and pl is not None
+
+
+def gather_rows(src, idx, *, use_pallas=None, interpret=False):
+    """``src[idx]`` over the leading axis — the replay/framestack row
+    gather, optionally through the Pallas row-copy kernel. ``src``:
+    (M, ...) any dtype; ``idx``: any int shape. Bitwise identical on
+    every path (pure data movement)."""
+    idx = jnp.asarray(idx)
+    inner = src.shape[1:]
+    d = int(np.prod(inner)) if inner else 1
+    use = _resolve_use_pallas(
+        use_pallas,
+        interpret,
+        lambda: _rows_lower(1, d, str(src.dtype), False),
+    )
+    if not use:
+        return src[idx]
+    flat_idx = idx.reshape(-1).astype(jnp.int32)
+    src2 = src.reshape(src.shape[0], d)
+    out2 = _pallas_rows(
+        src2, flat_idx, flat_idx.shape[0], False, interpret
+    )(flat_idx, src2)
+    return out2.reshape(idx.shape + inner)
+
+
+def scatter_rows(ring, pos, vals, *, use_pallas=None, interpret=False):
+    """``ring.at[pos].set(vals)`` over the leading axis — the replay
+    insert's circular scatter, optionally through the Pallas row-copy
+    kernel (ring aliased through, so unwritten rows keep their
+    contents). ``pos``: (R,) int; ``vals``: (R, ...) matching ring's
+    row shape. Bitwise identical on every path."""
+    pos = jnp.asarray(pos)
+    inner = ring.shape[1:]
+    d = int(np.prod(inner)) if inner else 1
+    r = int(pos.shape[0])
+    use = _resolve_use_pallas(
+        use_pallas,
+        interpret,
+        lambda: _rows_lower(r, d, str(ring.dtype), True),
+    )
+    if not use:
+        return ring.at[pos].set(vals)
+    ring2 = ring.reshape(ring.shape[0], d)
+    vals2 = vals.reshape(r, d)
+    out2 = _pallas_rows(
+        vals2, pos.astype(jnp.int32), ring.shape[0], True, interpret
+    )(pos.astype(jnp.int32), vals2, ring2)
+    return out2.reshape(ring.shape)
 
 
 def frame_stream_columns(
@@ -232,7 +402,14 @@ def materialize_fragment(batch_cols: Dict, k: int) -> Dict:
     return cols
 
 
-def build_stacks(frames: jnp.ndarray, idx: jnp.ndarray, k: int):
+def build_stacks(
+    frames: jnp.ndarray,
+    idx: jnp.ndarray,
+    k: int,
+    *,
+    use_pallas=None,
+    interpret=False,
+):
     """Device-side: (M, H, W, 1) frame pool + (N,) first-frame indices
     → (N, H, W, k) stacked observations (one gather, XLA-fusable).
 
@@ -241,7 +418,11 @@ def build_stacks(frames: jnp.ndarray, idx: jnp.ndarray, k: int):
     for uint8 vs ~420 GB/s through uint32 lanes on v5e, measured for
     the minibatch row gather — MFU.md), and the pool gather is the same
     access pattern at 4× fewer, 4× wider elements. Pure data movement:
-    the reconstructed stacks are byte-identical."""
+    the reconstructed stacks are byte-identical. ``use_pallas`` routes
+    the gather through the scalar-prefetch row-copy kernel
+    (:func:`gather_rows`) with the uint32 unpack fused around it — the
+    surrounding bitcasts are layout views, so the Pallas path stays
+    bitwise identical too."""
     assert frames.shape[-1] == 1, (
         "frame pools are single-channel (stack depth k comes from the "
         f"index expansion); got channel dim {frames.shape[-1]} — "
@@ -252,10 +433,20 @@ def build_stacks(frames: jnp.ndarray, idx: jnp.ndarray, k: int):
         packed = jax.lax.bitcast_convert_type(
             frames.reshape(frames.shape[0], inner // 4, 4), jnp.uint32
         )
-        gathered = packed[idx[:, None] + jnp.arange(k)[None, :]]
+        gathered = gather_rows(
+            packed,
+            idx[:, None] + jnp.arange(k)[None, :],
+            use_pallas=use_pallas,
+            interpret=interpret,
+        )
         u8 = jax.lax.bitcast_convert_type(gathered, jnp.uint8)
         u8 = u8.reshape((u8.shape[0], k) + frames.shape[1:])
         return jnp.moveaxis(u8[..., 0], 1, -1)
-    gathered = frames[idx[:, None] + jnp.arange(k)[None, :]]
+    gathered = gather_rows(
+        frames,
+        idx[:, None] + jnp.arange(k)[None, :],
+        use_pallas=use_pallas,
+        interpret=interpret,
+    )
     # (N, k, H, W, 1) → (N, H, W, k)
     return jnp.moveaxis(gathered[..., 0], 1, -1)

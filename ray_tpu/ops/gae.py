@@ -10,9 +10,17 @@ rollout workers; here the fast path is a jit-compiled ``lax.scan`` over fixed
 
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+try:  # Pallas fragment-scan kernel; associative_scan stays the
+    # portable path and the golden reference
+    from jax.experimental import pallas as pl
+except Exception:  # pragma: no cover - minimal jax builds
+    pl = None
 
 
 def discount_cumsum_np(x: np.ndarray, gamma: float) -> np.ndarray:
@@ -119,6 +127,62 @@ def compute_gae(
     return adv, value_targets
 
 
+def _gae_scan_kernel(deltas_ref, coeffs_ref, adv_ref, *, t):
+    """Reverse first-order recurrence over the time axis for one row
+    block: adv[t] = delta[t] + coeff[t] * adv[t+1]. Sequential in T
+    (the mathematically exact order — no reassociation), vectorized
+    over the row block."""
+    # ray-tpu: device-fn
+    rows = adv_ref.shape[0]
+
+    def body(i, run):
+        col = t - 1 - i
+        d = pl.load(deltas_ref, (slice(None), pl.ds(col, 1)))
+        c = pl.load(coeffs_ref, (slice(None), pl.ds(col, 1)))
+        run = d + c * run
+        pl.store(adv_ref, (slice(None), pl.ds(col, 1)), run)
+        return run
+
+    jax.lax.fori_loop(
+        0, t, body, jnp.zeros((rows, 1), jnp.float32)
+    )
+
+
+def _gae_scan_pallas(deltas, coeffs, interpret):
+    b, t = deltas.shape
+    bq = min(b, 8) if b % 8 else 8
+    pad = (-b) % bq
+    if pad:
+        deltas = jnp.pad(deltas, ((0, pad), (0, 0)))
+        coeffs = jnp.pad(coeffs, ((0, pad), (0, 0)))
+    out = pl.pallas_call(
+        functools.partial(_gae_scan_kernel, t=t),
+        grid=((b + pad) // bq,),
+        in_specs=[
+            pl.BlockSpec((bq, t), lambda i: (i, 0)),
+            pl.BlockSpec((bq, t), lambda i: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((bq, t), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((b + pad, t), jnp.float32),
+        interpret=interpret,
+    )(deltas, coeffs)
+    return out[:b] if pad else out
+
+
+@functools.lru_cache(maxsize=None)
+def _gae_lowers(b, t):  # pragma: no cover - backend-dependent
+    """One-time probe per (B, T) class: does the fragment-scan kernel
+    lower on this backend's Mosaic?"""
+    try:
+        x = jnp.zeros((b, t), jnp.float32)
+        jax.jit(
+            lambda d, c: _gae_scan_pallas(d, c, False)
+        ).lower(x, x).compile()
+        return True
+    except Exception:
+        return False
+
+
 def compute_gae_fragment(
     rewards: jnp.ndarray,
     values: jnp.ndarray,
@@ -127,6 +191,8 @@ def compute_gae_fragment(
     dones: jnp.ndarray,
     gamma: float = 0.99,
     lambda_: float = 1.0,
+    use_pallas=None,
+    interpret: bool = False,
 ):
     """GAE over (B, T) fragments with the HOST lane's truncation
     semantics (``evaluation/postprocessing.py``): bootstrap 0 across a
@@ -150,7 +216,14 @@ def compute_gae_fragment(
             truncateds``.
 
     Returns (advantages, value_targets), both (B, T) float32.
-    """
+
+    ``use_pallas`` (None = auto, True/False forces) routes the reverse
+    recurrence through the Pallas fragment-scan kernel: sequential in
+    T per row block — the mathematically exact evaluation order — vs
+    the associative scan's log-depth reassociation, so the two paths
+    agree to float32 tolerance (~1e-5 rel), not bitwise; see
+    docs/data_plane.md. ``interpret=True`` runs the kernel through the
+    Pallas interpreter (the CPU parity path)."""
     rewards = rewards.astype(jnp.float32)
     values = values.astype(jnp.float32)
     next_values = next_values.astype(jnp.float32)
@@ -159,6 +232,15 @@ def compute_gae_fragment(
 
     deltas = rewards + gamma * next_values * not_term - values
     coeffs = gamma * lambda_ * not_done
+
+    if use_pallas is None:
+        use_pallas = interpret or (
+            jax.default_backend() == "tpu" and pl is not None
+            and _gae_lowers(*deltas.shape)
+        )
+    if use_pallas and pl is not None:
+        adv = _gae_scan_pallas(deltas, coeffs, interpret)
+        return adv, adv + values
 
     def combine(a, b):
         ca, va = a

@@ -158,8 +158,99 @@ def find_prefixsum_body(value, prefixsum, capacity: int):
     return idx - capacity
 
 
+# -- Pallas prefix descent (docs/data_plane.md "Pallas kernels") -------
+#
+# The root→leaf descent as one Pallas kernel: the whole tree rides
+# VMEM-resident and each level is a vectorized gather + exact f64
+# compare/subtract — the identical op sequence to
+# ``find_prefixsum_body``, so draws stay bit-exact vs the host trees.
+# The tree is f64 (the determinism contract above), which Mosaic does
+# not lower on current TPU releases — so on this container the kernel
+# is interpreter-only (``use_pallas="auto"`` resolves to the XLA body
+# on TPU via the lowering probe; benchmarks/e2e/pallas_kernels.json
+# records the why-not) and exists as the parity-tested template for
+# backends that grow f64 VMEM support.
+
+
 # ray-tpu: device-fn f64
-def draw_body(sum_value, min_value, rand, size, beta, capacity: int):
+def _descent_kernel(value_ref, p_ref, out_ref, *, levels, capacity):
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    p = p_ref[...]
+    idx = jnp.ones(p.shape, jnp.int32)
+    for _ in range(levels):
+        left = 2 * idx
+        left_vals = pl.load(value_ref, (left,))
+        go_right = p > left_vals
+        p = jnp.where(go_right, p - left_vals, p)
+        idx = jnp.where(go_right, left + 1, left)
+    out_ref[...] = idx - capacity
+
+
+def find_prefixsum_pallas(value, prefixsum, capacity: int, *, interpret=False):
+    """Pallas counterpart of :func:`find_prefixsum_body`; returns int64
+    leaf indices, bit-exact vs the XLA body (same compares, same exact
+    f64 subtractions)."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    out = pl.pallas_call(
+        functools.partial(
+            _descent_kernel,
+            levels=capacity.bit_length() - 1,
+            capacity=capacity,
+        ),
+        out_shape=jax.ShapeDtypeStruct(prefixsum.shape, jnp.int32),
+        interpret=interpret,
+    )(value, prefixsum)
+    return out.astype(jnp.int64)
+
+
+def _descent_lowers(capacity: int, n: int) -> bool:
+    """Probe: does the f64 descent lower on this backend? (It does not
+    on current TPU Mosaic — f64 vectors — which is exactly what the
+    auto knob needs to know.)"""
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu import sharding as sharding_lib
+
+    key = (capacity, n)
+    hit = _DESCENT_LOWERS.get(key)
+    if hit is not None:
+        return hit
+    try:
+        with sharding_lib.f64_scope():
+            v = jnp.zeros(2 * capacity, jnp.float64)
+            p = jnp.zeros(n, jnp.float64)
+            jax.jit(
+                lambda a, b: find_prefixsum_pallas(a, b, capacity)
+            ).lower(v, p).compile()
+        ok = True
+    except Exception:  # pragma: no cover - backend-dependent
+        ok = False
+    _DESCENT_LOWERS[key] = ok
+    return ok
+
+
+_DESCENT_LOWERS: dict = {}
+
+
+# ray-tpu: device-fn f64
+def draw_body(
+    sum_value,
+    min_value,
+    rand,
+    size,
+    beta,
+    capacity: int,
+    use_pallas: bool = False,
+    interpret: bool = False,
+):
     """The whole stratified proportional draw of
     ``_PrioritySampling._draw_prioritized`` as one in-program body:
     ``rand`` is the host generator's raw uniform stream (the ONLY
@@ -175,7 +266,12 @@ def draw_body(sum_value, min_value, rand, size, beta, capacity: int):
     )
     strata = jnp.arange(num_items, dtype=jnp.float64)
     mass = (rand + strata) / num_items * total
-    idx = find_prefixsum_body(sum_value, mass, capacity)
+    if use_pallas:
+        idx = find_prefixsum_pallas(
+            sum_value, mass, capacity, interpret=interpret
+        )
+    else:
+        idx = find_prefixsum_body(sum_value, mass, capacity)
     idx = jnp.clip(idx, 0, size - 1)
 
     p_min = (
@@ -221,7 +317,14 @@ class DeviceSumTree:
     retrace; masked rows scatter to flat index 0, the one slot the
     host layout never reads."""
 
-    def __init__(self, capacity: int, mesh=None, label: str = "default_policy"):
+    def __init__(
+        self,
+        capacity: int,
+        mesh=None,
+        label: str = "default_policy",
+        use_pallas=None,
+        pallas_interpret: bool = False,
+    ):
         assert capacity > 0 and capacity & (capacity - 1) == 0, (
             "capacity must be a positive power of 2"
         )
@@ -233,6 +336,12 @@ class DeviceSumTree:
         self.capacity = int(capacity)
         self.mesh = mesh if mesh is not None else sharding_lib.get_mesh()
         self.label = label
+        # None = auto: Pallas descent where the f64 kernel lowers
+        # (probe-gated; interpreter always qualifies), XLA body
+        # elsewhere — today that means XLA on TPU, see the module
+        # comment above find_prefixsum_pallas
+        self.use_pallas = use_pallas
+        self.pallas_interpret = bool(pallas_interpret)
         self._update_fns = {}
         self._draw_fns = {}
         with sharding_lib.f64_scope():
@@ -346,11 +455,23 @@ class DeviceSumTree:
             import jax.numpy as jnp
 
             cap = self.capacity
+            interp = self.pallas_interpret
+            if self.use_pallas is None:
+                pallas = interp or _descent_lowers(cap, rand.shape[-1])
+            else:
+                pallas = bool(self.use_pallas)
 
             # ray-tpu: f64
             def prog(sum_t, min_t, r, size_, beta_):
                 idx, weights, _ = draw_body(
-                    sum_t, min_t, r, size_, beta_, cap
+                    sum_t,
+                    min_t,
+                    r,
+                    size_,
+                    beta_,
+                    cap,
+                    use_pallas=pallas,
+                    interpret=interp,
                 )
                 return idx.astype(jnp.int32), weights
 

@@ -1026,6 +1026,96 @@ class JaxPolicy(Policy):
         snapshots here)."""
 
     # ray-tpu: hot-path
+    def _active_mask(self, k: int, k_max: int) -> np.ndarray:
+        """The (k_max,) float32 active mask for a k-of-k_max superstep,
+        cached per (k, k_max): the mask is read-only on the device side
+        so the same host array serves every dispatch (one less per-call
+        allocation on the dieted path)."""
+        masks = self.__dict__.setdefault("_active_masks", {})
+        m = masks.get((k, k_max))
+        if m is None:
+            m = np.zeros(k_max, np.float32)
+            m[:k] = 1.0
+            masks[(k, k_max)] = m
+        return m
+
+    # ray-tpu: hot-path
+    def _superstep_host_keys(self, k, k_max, refresh, td_rng):
+        """The superstep's host key schedule as ONE fused program: the
+        sequential split chain (learn split, then the optional td
+        split, per update) unrolls inside a single jitted function
+        that returns the advanced stream plus the padded (k_max, 2)
+        key stacks. threefry splitting is a deterministic integer
+        function of the key, so composing the chain inside one program
+        yields bit-identical keys and final stream to k (or 2k)
+        individual host splits — only the dispatch count changes
+        (bench.py --dispatch measures exactly this collapse)."""
+        fns = self.__dict__.setdefault("_split_chain_fns", {})
+        sig = ("superstep", k, k_max, bool(refresh), bool(td_rng))
+        fn = fns.get(sig)
+        if fn is None:
+
+            def chain(rng):
+                keys, pri_keys = [], []
+                for _ in range(k):
+                    rng, r = jax.random.split(rng)
+                    keys.append(r)
+                    if refresh:
+                        if td_rng:
+                            rng, r2 = jax.random.split(rng)
+                        else:
+                            r2 = jnp.zeros_like(r)
+                        pri_keys.append(r2)
+                pad = jnp.zeros_like(keys[0])
+                keys += [pad] * (k_max - k)
+                if refresh:
+                    pri_keys += [pad] * (k_max - k)
+                    return rng, jnp.stack(keys), jnp.stack(pri_keys)
+                return rng, jnp.stack(keys)
+
+            fn = jax.jit(chain)
+            fns[sig] = fn
+        out = fn(self._rng)
+        self._rng = out[0]
+        return out[1], (out[2] if refresh else None)
+
+    # ray-tpu: hot-path
+    def _rollout_host_keys(self, k, k_max, T):
+        """Fused host key schedule for the rollout superstep: per slot,
+        T rollout splits then the learn split — k*(T+1) sequential
+        splits as ONE dispatch. The T-loop runs as a lax.scan of the
+        same split, which composes the identical threefry chain, so
+        the stacks are bit-identical to the sequential host loop."""
+        fns = self.__dict__.setdefault("_split_chain_fns", {})
+        sig = ("rollout", k, k_max, T)
+        fn = fns.get(sig)
+        if fn is None:
+
+            def chain(rng):
+                def one_split(rng, _):
+                    rng, r = jax.random.split(rng)
+                    return rng, r
+
+                learn_keys, ro_keys = [], []
+                for _ in range(k):
+                    rng, slot = jax.lax.scan(
+                        one_split, rng, None, length=T
+                    )
+                    ro_keys.append(slot)
+                    rng, r = jax.random.split(rng)
+                    learn_keys.append(r)
+                pad = jnp.zeros_like(learn_keys[0])
+                pad_slot = jnp.zeros_like(ro_keys[0])
+                learn_keys += [pad] * (k_max - k)
+                ro_keys += [pad_slot] * (k_max - k)
+                return rng, jnp.stack(learn_keys), jnp.stack(ro_keys)
+
+            fn = jax.jit(chain)
+            fns[sig] = fn
+        rng, rngs, ro_rngs = fn(self._rng)
+        self._rng = rng
+        return rngs, ro_rngs
+
     def learn_superstep(
         self,
         k: int,
@@ -1139,29 +1229,40 @@ class JaxPolicy(Policy):
 
         coeffs = self._learn_coeffs()
         # exact per-update host split order: learn split, then (iff the
-        # per-update priority pass consumes one) the td split
-        keys, pri_keys = [], []
+        # per-update priority pass consumes one) the td split. On the
+        # dieted path the whole chain runs as ONE fused program (k or
+        # 2k tiny split dispatches collapse to one — the dominant
+        # per-superstep host cost at K=8, bench.py --dispatch); the
+        # chain composes the same threefry splits in the same order,
+        # so the key stacks and the advanced self._rng are bit-
+        # identical to the sequential host loop.
         td_rng = refresh_priorities and self._td_refresh_uses_rng
-        for _ in range(k):
-            self._rng, r = jax.random.split(self._rng)
-            keys.append(r)
+        if sharding_lib.dispatch_diet_enabled():
+            rngs, pri = self._superstep_host_keys(
+                k, k_max, refresh_priorities, td_rng
+            )
+            rest = (pri,) if refresh_priorities else ()
+        else:
+            keys, pri_keys = [], []
+            for _ in range(k):
+                self._rng, r = jax.random.split(self._rng)
+                keys.append(r)
+                if refresh_priorities:
+                    if td_rng:
+                        self._rng, r2 = jax.random.split(self._rng)
+                    else:
+                        r2 = jnp.zeros_like(r)
+                    pri_keys.append(r2)
+            pad_key = jnp.zeros_like(keys[0])
+            while len(keys) < k_max:
+                keys.append(pad_key)
+            rngs = jnp.stack(keys)
+            rest = ()
             if refresh_priorities:
-                if td_rng:
-                    self._rng, r2 = jax.random.split(self._rng)
-                else:
-                    r2 = jnp.zeros_like(r)
-                pri_keys.append(r2)
-        pad_key = jnp.zeros_like(keys[0])
-        while len(keys) < k_max:
-            keys.append(pad_key)
-        rngs = jnp.stack(keys)
-        active = np.zeros(k_max, np.float32)
-        active[:k] = 1.0
-        rest = ()
-        if refresh_priorities:
-            while len(pri_keys) < k_max:
-                pri_keys.append(pad_key)
-            rest = (jnp.stack(pri_keys),)
+                while len(pri_keys) < k_max:
+                    pri_keys.append(pad_key)
+                rest = (jnp.stack(pri_keys),)
+        active = self._active_mask(k, k_max)
 
         if rings is not None:
             feed = (rings.store, rings.idx, rings.extra)
@@ -1332,24 +1433,30 @@ class JaxPolicy(Policy):
 
         coeffs = self._learn_coeffs()
         T = int(rollout.steps)
-        learn_keys, ro_keys = [], []
-        for _ in range(k):
-            slot = []
-            for _ in range(T):
+        # host rng schedule: T rollout splits then the learn split per
+        # slot. Dieted path fuses the whole k*(T+1)-split chain into
+        # ONE dispatch (bit-identical keys — same threefry chain, same
+        # order); see learn_superstep.
+        if sharding_lib.dispatch_diet_enabled():
+            rngs, ro_rngs = self._rollout_host_keys(k, k_max, T)
+        else:
+            learn_keys, ro_keys = [], []
+            for _ in range(k):
+                slot = []
+                for _ in range(T):
+                    self._rng, r = jax.random.split(self._rng)
+                    slot.append(r)
+                ro_keys.append(jnp.stack(slot))
                 self._rng, r = jax.random.split(self._rng)
-                slot.append(r)
-            ro_keys.append(jnp.stack(slot))
-            self._rng, r = jax.random.split(self._rng)
-            learn_keys.append(r)
-        pad = jnp.zeros_like(learn_keys[0])
-        pad_slot = jnp.zeros_like(ro_keys[0])
-        while len(learn_keys) < k_max:
-            learn_keys.append(pad)
-            ro_keys.append(pad_slot)
-        rngs = jnp.stack(learn_keys)
-        ro_rngs = jnp.stack(ro_keys)
-        active = np.zeros(k_max, np.float32)
-        active[:k] = 1.0
+                learn_keys.append(r)
+            pad = jnp.zeros_like(learn_keys[0])
+            pad_slot = jnp.zeros_like(ro_keys[0])
+            while len(learn_keys) < k_max:
+                learn_keys.append(pad)
+                ro_keys.append(pad_slot)
+            rngs = jnp.stack(learn_keys)
+            ro_rngs = jnp.stack(ro_keys)
+        active = self._active_mask(k, k_max)
         # the lane's entire H2D payload: key stacks + the mask
         telemetry_metrics.add_h2d_bytes(
             "rollout",
@@ -1532,9 +1639,13 @@ class JaxPolicy(Policy):
 
     def _learn_aot_cache(self):
         """The AOT executable cache for learn programs, resolved once
-        from ``config["aot_cache_dir"]`` (None when unconfigured)."""
-        if not self._aot_cache_resolved:
+        from ``config["aot_cache_dir"]`` (None when unconfigured).
+        getattr-guarded: bespoke-net policies (SlateQ) run their own
+        init chain past ``JaxPolicy.__init__``, so the lazy attrs may
+        not exist on first touch."""
+        if not getattr(self, "_aot_cache_resolved", False):
             self._aot_cache_resolved = True
+            self._aot_cache = getattr(self, "_aot_cache", None)
             root = self.config.get("aot_cache_dir")
             if root:
                 from ray_tpu.sharding import aot as aot_lib

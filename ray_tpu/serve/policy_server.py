@@ -51,6 +51,7 @@ from __future__ import annotations
 import collections
 import os
 import pickle
+import re
 import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
@@ -257,6 +258,11 @@ class BatchedPolicyServer:
         # sequentially — the parity contract's anchor
         self._carry = jax.device_put(policy._rng, self._rep)
         self._fns: Dict[Tuple[int, bool], Any] = {}
+        # per-bucket program specs (sharding/registry.py): warmup()
+        # walks this registry, and an algorithm-owned registry can
+        # absorb the same rows so the driver's AOT/coverage sweep sees
+        # serve programs alongside the learn-side ones
+        self.program_registry = self._build_program_registry()
 
         # hot-reload staging rides a long-poll host: the watcher (any
         # thread) notifies, the batcher adopts between batches
@@ -565,14 +571,42 @@ class BatchedPolicyServer:
         device_ledger.drain_point()
         return actions, extra
 
+    def _build_program_registry(self):
+        """One warmable :class:`~ray_tpu.sharding.registry.ProgramSpec`
+        per bucket (plus the explore-variant pattern): the registry IS
+        the warmup plan."""
+        import functools
+
+        from ray_tpu.sharding import registry as registry_lib
+
+        reg = registry_lib.ProgramRegistry()
+        if not self.fused:
+            return reg
+        for b in self.buckets:
+            reg.add_program(
+                rf"serve\[{re.escape(self.name)}:{b}"
+                rf":(?:explore|greedy)\]",
+                kind="serve",
+                regex=True,
+                warm=functools.partial(self._warm_bucket, b, None),
+                meta={"bucket": b},
+            )
+        return reg
+
     def warmup(self, explore: Optional[bool] = None) -> int:
         """Compile every bucket for ``explore`` (default: the server's
-        flag) by running zero-occupancy forwards — ``n_real=0`` leaves
-        the rng carry bitwise untouched, so warmup never perturbs the
-        request stream. Returns the bucket count; after this, steady
-        traffic is recompile-free (``compile_stats``-asserted)."""
+        flag) by walking the per-bucket program registry with
+        zero-occupancy forwards — ``n_real=0`` leaves the rng carry
+        bitwise untouched, so warmup never perturbs the request
+        stream. Returns the bucket count; after this, steady traffic
+        is recompile-free (``compile_stats``-asserted)."""
         if not self.fused:
             return 0
+        if explore is None:
+            # the registry's warm callables carry explore=None (the
+            # server flag) — the common sweep the driver also runs
+            report = self.program_registry.sweep(kind="serve")
+            return report["warmed"]
         for b in self.buckets:
             self._warm_bucket(b, explore)
         return len(self.buckets)

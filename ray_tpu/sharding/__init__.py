@@ -23,7 +23,9 @@ on one device.
 from ray_tpu.sharding.compile import (
     ShardedFunction,
     compile_stats,
+    dispatch_diet_enabled,
     f64_scope,
+    set_dispatch_diet,
     sharded_jit,
 )
 from ray_tpu.sharding.mesh import (
@@ -41,6 +43,7 @@ from ray_tpu.sharding.mesh import (
 )
 from ray_tpu.sharding.specs import (
     batch_sharded,
+    clear_sharding_caches,
     default_partition_rules,
     leaf_sharding,
     named_tree,
@@ -52,6 +55,11 @@ from ray_tpu.sharding.specs import (
     state_pspecs,
     tree_nbytes,
     tree_shard_nbytes,
+)
+from ray_tpu.sharding.registry import (
+    ProgramRegistry,
+    ProgramSpec,
+    for_algorithm as registry_for_algorithm,
 )
 from ray_tpu.sharding.superstep import (
     build_stack_fn,
@@ -89,7 +97,10 @@ def resolve_mesh(config):
 __all__ = [
     "BATCH_AXIS",
     "MODEL_AXIS",
+    "ProgramRegistry",
+    "ProgramSpec",
     "ShardedFunction",
+    "registry_for_algorithm",
     "available_devices",
     "batch_sharded",
     "build_stack_fn",

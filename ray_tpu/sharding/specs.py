@@ -26,7 +26,10 @@ on both the ``("batch",)`` meshes this package builds and the legacy
 
 from __future__ import annotations
 
+import collections
+import functools
 import re
+import threading
 from typing import Dict, Iterable, Optional, Sequence, Tuple
 
 import jax
@@ -34,12 +37,28 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ray_tpu.sharding.mesh import MODEL_AXIS, data_axis, num_shards
 
+# -- dispatch-diet caches (benchmarks/MFU.md "dispatch overhead") ------
+#
+# NamedSharding construction is pure but not free, and the hot call
+# sites (per-batch ``sharding_tree`` in JaxPolicy.batch_shardings, the
+# per-call replicated()/batch_sharded() in feeders and supersteps)
+# used to rebuild identical objects every dispatch. Both builders
+# memoize on the (hashable) mesh; ``sharding_tree`` additionally keeps
+# a bounded signature-keyed memo of resolved trees with an
+# object-identity fast path for the immediately-previous tree. A
+# genuinely changed sharding (new mesh, a column whose leading dim
+# stops dividing the shard count, a changed replicate set) changes the
+# signature and misses to the full derivation — the invalidation
+# contract tests/test_dispatch_diet.py pins.
 
+
+@functools.lru_cache(maxsize=128)
 def replicated(mesh: Mesh) -> NamedSharding:
     """Full copy on every device (params, opt state, scalars)."""
     return NamedSharding(mesh, P())
 
 
+@functools.lru_cache(maxsize=128)
 def batch_sharded(mesh: Mesh, ndim_prefix: int = 1) -> NamedSharding:
     """Leading-dim row sharding over the data axis. ``ndim_prefix``
     places the axis deeper, e.g. 2 -> P(None, axis) for (T, B, ...)
@@ -79,12 +98,108 @@ def leaf_sharding(x, mesh: Mesh) -> NamedSharding:
     return replicated(mesh)
 
 
+# signature -> (resolved tree, fallback shapes) LRU; one entry per
+# distinct (mesh, column-name, placement-kind, replicate-set) batch
+# signature — steady training resolves its per-batch tree with dict
+# lookups instead of per-leaf reconstruction
+_TREE_MEMO: "collections.OrderedDict" = collections.OrderedDict()
+_TREE_MEMO_MAX = 256
+_TREE_MEMO_LOCK = threading.Lock()
+# object-identity fast path: (id(tree), signature-independent reuse is
+# NOT safe — ids recycle), so the identity memo pins the tree object
+# itself alongside its resolved result
+_LAST_TREE: Optional[Tuple[object, Mesh, frozenset, dict, tuple]] = None
+
+
+def clear_sharding_caches() -> None:
+    """Drop the resolved-tree memos (tests; mesh teardown)."""
+    global _LAST_TREE
+    with _TREE_MEMO_LOCK:
+        _TREE_MEMO.clear()
+        _LAST_TREE = None
+    replicated.cache_clear()
+    batch_sharded.cache_clear()
+
+
+def _flat_signature(tree: dict, mesh: Mesh, replicate_keys) -> Optional[tuple]:
+    """Placement signature of a flat dict-of-arrays batch: per column,
+    which of the three leaf_sharding outcomes applies (replicate /
+    row-shard / ragged-fallback-replicate). None when the tree isn't
+    the flat prepared-batch shape — the caller takes the full path."""
+    n = num_shards(mesh)
+    sig = []
+    for k, v in tree.items():
+        shape = getattr(v, "shape", None)
+        if shape is None or isinstance(v, dict):
+            return None
+        if k in replicate_keys:
+            kind = 0
+        elif len(shape) >= 1 and shape[0] > 0 and shape[0] % n == 0:
+            kind = 1
+        elif len(shape) >= 1 and shape[0] > 0 and n > 1:
+            kind = 2  # ragged: replicate + counted fallback
+        else:
+            kind = 0
+        sig.append((k, kind) if kind != 2 else (k, 2, tuple(shape)))
+    return tuple(sig)
+
+
 def sharding_tree(tree, mesh: Mesh, replicate_keys: Iterable[str] = ()):
     """Per-leaf sharding tree for a (possibly nested) batch tree.
     Top-level dict keys in ``replicate_keys`` pin to replication no
     matter their shape — e.g. the deduplicated frame pool, which every
-    shard gathers from locally."""
-    replicate_keys = set(replicate_keys)
+    shard gathers from locally.
+
+    Flat dict-of-arrays trees (every prepared train batch) resolve
+    through a signature-keyed memo: the NamedSharding tree is built
+    once per distinct placement signature and reused, with the ragged
+    fallback still counted per call (the degraded placement stays
+    visible in the scrape). Nested trees take the full per-leaf
+    derivation every time."""
+    global _LAST_TREE
+    replicate_keys = frozenset(replicate_keys)
+    if type(tree) is dict:
+        # identity fast path: the same tree object re-resolved against
+        # the same mesh (feeders re-deriving placement for a batch they
+        # already resolved) costs three `is` checks
+        last = _LAST_TREE
+        if (
+            last is not None
+            and last[0] is tree
+            and last[1] is mesh
+            and last[2] == replicate_keys
+        ):
+            for shape in last[4]:
+                _note_fallback_replicated(shape)
+            return dict(last[3])
+        sig = _flat_signature(tree, mesh, replicate_keys)
+        if sig is not None:
+            key = (mesh, sig, replicate_keys)
+            with _TREE_MEMO_LOCK:
+                hit = _TREE_MEMO.get(key)
+                if hit is not None:
+                    _TREE_MEMO.move_to_end(key)
+            if hit is None:
+                out = {}
+                fallbacks = []
+                for entry in sig:
+                    k, kind = entry[0], entry[1]
+                    out[k] = (
+                        batch_sharded(mesh)
+                        if kind == 1
+                        else replicated(mesh)
+                    )
+                    if kind == 2:
+                        fallbacks.append(entry[2])
+                hit = (out, tuple(fallbacks))
+                with _TREE_MEMO_LOCK:
+                    _TREE_MEMO[key] = hit
+                    while len(_TREE_MEMO) > _TREE_MEMO_MAX:
+                        _TREE_MEMO.popitem(last=False)
+            for shape in hit[1]:
+                _note_fallback_replicated(shape)
+            _LAST_TREE = (tree, mesh, replicate_keys, hit[0], hit[1])
+            return dict(hit[0])
     if isinstance(tree, dict) and replicate_keys:
         return {
             k: (

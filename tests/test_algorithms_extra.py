@@ -189,6 +189,9 @@ def test_appo_cartpole_learns():
     assert best >= 100.0, f"APPO failed to learn: best={best}"
 
 
+@pytest.mark.slow  # ~10 s multi-worker e2e; moved out of tier-1 by
+# the PR-1 budget rule — tier-1 keeps test_ddppo_requires_workers,
+# with the full learning run already in the slow tier
 def test_ddppo_decentralized_learning():
     from ray_tpu.algorithms.ddppo import DDPPOConfig
 

@@ -29,19 +29,24 @@ def test_bench_jax_path_runs():
     assert pipe_sps > 0 and res_sps > 0
 
 
-def test_bench_e2e_configs_enable_sample_prefetch():
-    """bench_e2e's PPO configs run the pipelined sampling path
-    (ISSUE 1: prefetch on for bench_e2e, off for seed tuned examples),
-    and the --prefetch CLI override reaches the built config."""
+def test_bench_e2e_configs_ride_the_fused_lanes():
+    """bench_e2e's PPO configs measure the device rollout lane by
+    default (ROADMAP 5a: the fused number is the headline); the
+    actor-lane plumbing config keeps the pipelined sampling path
+    (ISSUE 1), and the --prefetch CLI override reaches the built
+    config."""
     import bench_e2e
 
-    assert bench_e2e._ppo_pong().sample_prefetch == 1
+    for builder in (bench_e2e._ppo_cartpole, bench_e2e._ppo_pong):
+        cfg = builder()
+        assert cfg.env_backend == "jax"
+        assert cfg.num_workers == 0
     assert bench_e2e._plumbing_ppo().sample_prefetch == 1
     # tuned-example default stays synchronous
     from ray_tpu.algorithms.ppo import PPOConfig
 
     assert PPOConfig().sample_prefetch == 0
-    cfg = bench_e2e._ppo_pong()
+    cfg = bench_e2e._plumbing_ppo()
     cfg.sample_prefetch = 0  # what run_config's overrides do
     assert cfg.to_dict()["sample_prefetch"] == 0
 
@@ -105,6 +110,9 @@ def test_bench_batch_schema_matches_policy():
     assert np.isfinite(info["total_loss"])
 
 
+@pytest.mark.slow  # ~17 s: runs the whole-repo analysis scan twice;
+# moved out of tier-1 by the PR-1 budget rule — the scan itself gates
+# tier-1 via test_static_analysis.py TestRepoGate
 def test_bench_lint_writes_report(tmp_path, monkeypatch):
     """bench.py --lint: the static-analysis pass reports scan wall
     time + finding counts and writes the e2e report (the tier-1 gate

@@ -233,6 +233,10 @@ def _dqn_config(device_tree, **over):
     return cfg
 
 
+@pytest.mark.slow  # ~8 s DQN e2e; moved out of tier-1 by the PR-1
+# budget rule — tier-1 keeps the host/device tree parity pins above,
+# test_superstep's DQN prioritized-superstep parity, and the
+# prioritized device-replay DQN run in test_dispatch_diet.py
 def test_dqn_per_device_tree_bitwise_parity():
     """Acceptance: fixed-seed DQN learn results are bitwise identical
     device-tree vs host-tree on the 1-shard mesh — params, sum-tree

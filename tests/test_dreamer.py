@@ -157,6 +157,9 @@ def test_world_model_loss_decreases():
     algo.cleanup()
 
 
+@pytest.mark.slow  # ~9 s Algorithm e2e; moved out of tier-1 by the
+# PR-1 budget rule — tier-1 keeps the buffer/RSSM units and
+# test_world_model_loss_decreases (the learning-signal pin)
 def test_dreamer_end_to_end_and_checkpoint():
     algo = _tiny_algo(prefill_timesteps=50)
     result = algo.train()

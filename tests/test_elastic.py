@@ -403,6 +403,9 @@ def _leaves(algo):
     ]
 
 
+@pytest.mark.slow  # ~10 s; moved out of tier-1 by the PR-1 budget
+# rule — tier-1 keeps test_injected_crash_restores_from_stream_tail,
+# which exercises the same stream-tail restore bound end-to-end
 def test_stream_restore_loses_at_most_one_superstep(tmp_path):
     """The acceptance bound: after a simulated driver crash, restoring
     from the stream tail loses ≤ 1 superstep of updates — vs up to

@@ -4,6 +4,7 @@ rllib/algorithms/es/tests, ars/tests)."""
 import time
 
 import numpy as np
+import pytest
 
 from ray_tpu.algorithms.es import ARSConfig, ESConfig
 from ray_tpu.algorithms.es.es import (
@@ -54,6 +55,9 @@ def test_es_step_updates_weights():
     algo.cleanup()
 
 
+@pytest.mark.slow  # ~8 s learning regression; moved out of tier-1 by
+# the PR-1 budget rule — tier-1 keeps test_es_step_updates_weights +
+# the noise-table/checkpoint units
 def test_es_cartpole_learns():
     algo = _es_config(
         ESConfig,

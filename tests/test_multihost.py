@@ -59,6 +59,10 @@ def test_kv_heartbeats_track_liveness():
     server.shutdown()
 
 
+@pytest.mark.slow  # ~13 s: spins a real 2-process jax.distributed
+# cluster; moved out of tier-1 by the PR-1 budget rule — tier-1 keeps
+# the KV rendezvous/liveness units, and the verify recipe drives this
+# file standalone as its own surface
 def test_two_process_dcn_cluster():
     """Full rung: jax.distributed over 2 CPU processes x 2 devices,
     global-mesh psum, cross-host weight broadcast, KV rendezvous."""

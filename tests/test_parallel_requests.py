@@ -178,6 +178,10 @@ def test_ppo_prefetch_first_step_matches_sync_path():
     pre_algo.cleanup()
 
 
+@pytest.mark.slow  # ~10 s; moved out of tier-1 by the PR-1 budget
+# rule — tier-1 keeps the manager units above (in-flight cap, harvest
+# order, dead-worker drop, async round); the prefetch e2e pins ride
+# the slow tier with test_ppo_prefetch_first_step_matches_sync_path
 def test_ppo_prefetch_smoke_multi_step():
     """The pipelined loop keeps training: counters advance, stats stay
     finite, the pipeline reports progress, cleanup joins the threads."""

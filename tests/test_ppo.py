@@ -168,6 +168,9 @@ def test_evaluate_syncs_filters_and_uses_remote_eval_workers():
     algo.cleanup()
 
 
+@pytest.mark.slow  # ~9 s full save/rebuild cycle; moved out of tier-1
+# by the PR-1 budget rule — tier-1 keeps test_ppo_checkpoint_restore
+# (same save/restore machinery, explicit class)
 def test_from_checkpoint_rebuilds_without_class(tmp_path):
     """Algorithm.from_checkpoint resolves the concrete class and
     config from checkpoint metadata alone (reference

@@ -225,6 +225,10 @@ def test_dqn_use_lstm_raises_pointing_at_r2d2():
         )
 
 
+@pytest.mark.slow  # ~11 s; moved out of tier-1 by the PR-1 budget
+# rule — tier-1 keeps the recurrent-path pins (unroll forward parity,
+# stored-state train forward) + test_impala_lstm_trains as the
+# learning rung
 def test_ppo_lstm_learns_memory_task():
     """RecallEnv requires carrying the first-step cue to the last step;
     average reward ~0.5 is chance, >0.85 demands working memory AND a
@@ -266,6 +270,9 @@ def test_ppo_lstm_learns_memory_task():
     assert best >= 0.85, best
 
 
+@pytest.mark.slow  # ~10 s; moved out of tier-1 by the PR-1 budget
+# rule — tier-1 keeps test_attention_resets_isolate_episodes, which
+# pins the GTrXL forward + reset semantics without the training loop
 def test_ppo_attention_trains():
     """GTrXL (use_attention) through the same recurrent learn path."""
     algo = (

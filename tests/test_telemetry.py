@@ -322,6 +322,10 @@ def test_merge_and_intersect_primitives():
 # -- config-driven activation (tentpole e2e) ---------------------------
 
 
+@pytest.mark.slow  # ~19 s on this container; moved out of tier-1 by
+# the PR-1 budget rule — tier-1 keeps the roll-up/span/exposition
+# units here plus the fixed-seed ledger+telemetry e2e in
+# test_device_ledger.py
 def test_ppo_telemetry_end_to_end(tmp_path):
     """AlgorithmConfig.telemetry() activates everything, on the
     superstep path: train() results carry info/telemetry (stage times
