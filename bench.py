@@ -87,6 +87,14 @@ Flags:  --profile       run ONE telemetry-instrumented PPO iteration
                         driver crash with streamed vs periodic
                         checkpoints; writes
                         benchmarks/e2e/elastic_fleet.json
+        --fleet         elastic learner-mesh lane (docs/fleet.md):
+                        gloo CPU fleets of 1 and 2 hosts through the
+                        full rendezvous → epoch → lockstep-learn
+                        protocol — steps/s by fleet size, drain
+                        (noticed) vs kill (heartbeat) recovery wall,
+                        and the resize wall with a pre-seeded AOT
+                        cache vs cold (warm resize = zero fresh
+                        compiles); writes benchmarks/e2e/fleet.json
         --obs           device-ledger overhead A/B
                         (docs/observability.md "device ledger"): the
                         SAME fixed-seed superstep PPO chain with
@@ -1835,6 +1843,317 @@ def bench_elastic(out_path=None):
     return report
 
 
+def bench_fleet_worker():
+    """Subprocess entry for the --fleet lane (one learner host of a
+    gloo CPU fleet). Mirrors tests/_multihost_worker.py's protocol but
+    measures walls: steps/s over the epoch mesh, then (2-host modes)
+    the drain-vs-kill recovery and the resize wall. Rank 0 prints one
+    ``FLEETBENCH {json}`` line."""
+    import os
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import gymnasium as gym
+
+    from ray_tpu import fleet
+    from ray_tpu import sharding as sharding_lib
+    from ray_tpu.algorithms.ppo.ppo import PPOJaxPolicy
+    from ray_tpu.data.sample_batch import SampleBatch
+    from ray_tpu.parallel import distributed as dist
+
+    rank = int(os.environ["RAY_TPU_PROCESS_ID"])
+    world = int(os.environ["RAY_TPU_NUM_PROCESSES"])
+    mode = os.environ.get("RAY_TPU_FLEET_BENCH_MODE", "drain")
+    aot_root = os.environ.get("RAY_TPU_FLEET_BENCH_AOT", "")
+    if world > 1:
+        dist.initialize()
+
+    kv = fleet.KVClient(os.environ["RAY_TPU_KV_ADDRESS"])
+    coord = fleet.FleetCoordinator(kv) if rank == 0 else None
+    agent = fleet.HostAgent(
+        kv, f"host{rank}", rank_hint=rank, heartbeat_interval=0.5
+    )
+    agent.join()
+    if rank == 0:
+        coord.wait_for_members(world, timeout=60.0)
+        coord.propose_epoch(reason="bootstrap")
+    epoch1 = agent.wait_for_epoch(1)
+    mesh = fleet.epoch_mesh(epoch1)
+
+    B = 64
+    config = {
+        "_mesh": mesh,
+        "model": {"fcnet_hiddens": [32, 32]},
+        "train_batch_size": B,
+        "sgd_minibatch_size": 32,
+        "num_sgd_iter": 2,
+        "lr": 3e-4,
+        "seed": 0,
+    }
+    if aot_root:
+        config["aot_cache_dir"] = os.path.join(
+            aot_root, f"rank{rank}"
+        )
+    obs_space = gym.spaces.Box(-1.0, 1.0, (16,), np.float32)
+    act_space = gym.spaces.Discrete(4)
+    policy = PPOJaxPolicy(obs_space, act_space, config)
+    rng = np.random.default_rng(7)
+    host = {
+        SampleBatch.OBS: rng.standard_normal((B, 16)).astype(
+            np.float32
+        ),
+        SampleBatch.ACTIONS: rng.integers(0, 4, B).astype(np.int64),
+        SampleBatch.ACTION_LOGP: np.full(B, -1.4, np.float32),
+        SampleBatch.ACTION_DIST_INPUTS: rng.standard_normal(
+            (B, 4)
+        ).astype(np.float32),
+        SampleBatch.ADVANTAGES: rng.standard_normal(B).astype(
+            np.float32
+        ),
+        SampleBatch.VALUE_TARGETS: rng.standard_normal(B).astype(
+            np.float32
+        ),
+    }
+    tree, bsize = policy.prepare_batch(SampleBatch(host))
+    global_batch = {
+        k: sharding_lib.put_global(v, policy.data_sharding)
+        for k, v in tree.items()
+    }
+    policy.learn_on_device_batch(global_batch, bsize)  # compile
+    walls = []
+    for _ in range(6):
+        t0 = time.perf_counter()
+        policy.learn_on_device_batch(global_batch, bsize)
+        walls.append(time.perf_counter() - t0)
+    steps_per_s = B / float(np.median(walls))
+
+    if world == 1:
+        print(
+            "FLEETBENCH "
+            + json.dumps(
+                {"hosts": 1, "steps_per_s": round(steps_per_s, 1)}
+            )
+        )
+        agent.stop()
+        coord.stop()
+        return
+
+    if mode == "kill":
+        # the victim dies with NO notice; the survivor's heartbeat
+        # sweep must detect it (the gcs_heartbeat_manager path)
+        if rank == 1:
+            kv.put("bench/kill_ts", time.time())
+            agent.stop()
+            os._exit(0)
+        kill_ts = kv.get("bench/kill_ts", timeout=60.0)
+        deadline = time.monotonic() + 60.0
+        while True:
+            coord.reconcile()
+            coord.expire_dead(horizon=2.0)
+            ep = coord.current_epoch()
+            if ep is not None and ep.gen >= 2:
+                break
+            if time.monotonic() >= deadline:
+                raise TimeoutError("kill never detected")
+            time.sleep(0.05)
+        survivor = fleet.resize_policy(
+            policy, fleet.epoch_mesh(coord.current_epoch())
+        )
+        survivor.learn_on_batch(SampleBatch(host))
+        recovery_wall = time.time() - kill_ts
+        fn = survivor.learn_fn(bsize)
+        print(
+            "FLEETBENCH "
+            + json.dumps(
+                {
+                    "hosts": 2,
+                    "mode": "kill",
+                    "steps_per_s": round(steps_per_s, 1),
+                    "recovery_wall_s": round(recovery_wall, 3),
+                    "resize_aot_source": fn.aot_source,
+                    "resize_traces": fn.traces,
+                }
+            )
+        )
+        # rank1 is gone: skip jax.distributed teardown
+        os._exit(0)
+
+    # drain mode: provider-noticed preemption of host1
+    if rank == 1:
+        kv.put("bench/notice_ts", time.time())
+        agent.announce_notice(reason="preempted")
+    if rank == 0:
+        deadline = time.monotonic() + 60.0
+        while agent.poll_drain(1) is None:
+            coord.reconcile()
+            if time.monotonic() >= deadline:
+                raise TimeoutError("drain never posted")
+            time.sleep(0.02)
+    agent.await_drain(1)
+    policy.learn_on_device_batch(global_batch, bsize)  # drain step
+    agent.barrier("drained", epoch1)
+    if rank == 1:
+        agent.leave()
+        kv.get("bench/solo_done", timeout=120.0)
+        agent.stop()
+        return
+    notice_ts = kv.get("bench/notice_ts", timeout=10.0)
+    epoch2 = agent.wait_for_epoch(2)
+    t0 = time.perf_counter()
+    survivor = fleet.resize_policy(policy, fleet.epoch_mesh(epoch2))
+    survivor.learn_on_batch(SampleBatch(host))
+    resize_wall = time.perf_counter() - t0
+    recovery_wall = time.time() - notice_ts
+    fn = survivor.learn_fn(bsize)
+    print(
+        "FLEETBENCH "
+        + json.dumps(
+            {
+                "hosts": 2,
+                "mode": "drain",
+                "steps_per_s": round(steps_per_s, 1),
+                "recovery_wall_s": round(recovery_wall, 3),
+                "resize_wall_s": round(resize_wall, 3),
+                "resize_aot_source": fn.aot_source,
+                "resize_traces": fn.traces,
+            }
+        )
+    )
+    kv.put("bench/solo_done", True)
+    coord.stop()
+    agent.stop()
+
+
+def bench_fleet(out_path=None):
+    """Elastic learner-fleet lane (docs/fleet.md): gloo CPU fleets of
+    1 and 2 hosts (2 virtual devices each) through the full
+    rendezvous → epoch → lockstep-learn protocol. Reports
+
+      - steps/s at hosts ∈ {1, 2} and the DCN scaling efficiency;
+      - drain (provider-noticed) vs kill (heartbeat-detected)
+        recovery wall: notice/death → first post-resize step done;
+      - the resize wall with a pre-seeded AOT cache vs cold — the
+        warm-cache-restart headline (warm resize performs zero fresh
+        compiles; `resize_traces` in the JSON asserts it).
+
+    Writes benchmarks/e2e/fleet.json."""
+    import os
+    import shutil
+    import socket
+    import subprocess
+    import tempfile
+
+    from ray_tpu.fleet import KVServer
+
+    os.makedirs("benchmarks/e2e", exist_ok=True)
+    out_path = out_path or "benchmarks/e2e/fleet.json"
+    aot_root = tempfile.mkdtemp(prefix="ray_tpu_fleet_bench_aot_")
+
+    def run(world, mode="drain", preseed=True):
+        kv = KVServer(host="127.0.0.1")
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            coord_port = s.getsockname()[1]
+        env_base = {
+            **os.environ,
+            "JAX_PLATFORMS": "cpu",
+            "RAY_TPU_PLATFORM": "cpu",
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
+            "RAY_TPU_NUM_PROCESSES": str(world),
+            "RAY_TPU_KV_ADDRESS": f"127.0.0.1:{kv.port}",
+            "RAY_TPU_FLEET_BENCH_MODE": mode,
+            "RAY_TPU_FLEET_BENCH_AOT": aot_root if preseed else "",
+            "RAY_TPU_FLEET_PRESEED": "1" if preseed else "0",
+        }
+        if world > 1:
+            env_base["RAY_TPU_COORDINATOR"] = (
+                f"127.0.0.1:{coord_port}"
+            )
+        procs = []
+        for rank in range(world):
+            env = {**env_base, "RAY_TPU_PROCESS_ID": str(rank)}
+            procs.append(
+                subprocess.Popen(
+                    [sys.executable, __file__, "--fleet-worker"],
+                    env=env,
+                    stdout=subprocess.PIPE,
+                    stderr=subprocess.STDOUT,
+                    text=True,
+                )
+            )
+        outs = []
+        try:
+            for p in procs:
+                out, _ = p.communicate(timeout=300)
+                outs.append(out)
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+            kv.shutdown()
+        for rank, (p, out) in enumerate(zip(procs, outs)):
+            if p.returncode != 0:
+                raise RuntimeError(
+                    f"fleet bench rank {rank} failed:\n{out}"
+                )
+        for line in outs[0].splitlines():
+            if line.startswith("FLEETBENCH "):
+                return json.loads(line[len("FLEETBENCH ") :])
+        raise RuntimeError(f"no FLEETBENCH line:\n{outs[0]}")
+
+    one = run(world=1)
+    warm = run(world=2, mode="drain", preseed=True)
+    cold = run(world=2, mode="drain", preseed=False)
+    kill = run(world=2, mode="kill", preseed=True)
+    shutil.rmtree(aot_root, ignore_errors=True)
+
+    report = {
+        "metric": "fleet_elastic_learner_mesh",
+        "steps_per_s_by_hosts": {
+            "1": one["steps_per_s"],
+            "2": warm["steps_per_s"],
+        },
+        # 2 hosts double the devices over a CPU "DCN": efficiency is
+        # steps/s parity at the SAME global batch (weak scaling of
+        # the collective, not more throughput)
+        "dcn_scaling_efficiency": round(
+            warm["steps_per_s"] / one["steps_per_s"], 3
+        ),
+        "drain_recovery_wall_s": warm["recovery_wall_s"],
+        "kill_recovery_wall_s": kill["recovery_wall_s"],
+        "resize_wall_s": {
+            "preseeded_aot": warm["resize_wall_s"],
+            "cold": cold["resize_wall_s"],
+        },
+        "resize_speedup_from_preseed": round(
+            cold["resize_wall_s"] / max(warm["resize_wall_s"], 1e-9),
+            2,
+        ),
+        "warm_resize_fresh_compiles": warm["resize_traces"],
+        "warm_resize_aot_source": warm["resize_aot_source"],
+        "config": {
+            "world": 2,
+            "devices_per_host": 2,
+            "train_batch_size": 64,
+            "collectives": "gloo (CPU stand-in for DCN)",
+            "kill_detection_horizon_s": 2.0,
+        },
+        "note": (
+            "on the gloo/localhost stand-in every gradient pmean is "
+            "a socket round trip, so 2-host steps/s measures the "
+            "protocol's lockstep correctness, not DCN bandwidth — "
+            "the scaling headline belongs to the TPU round; the "
+            "portable numbers here are the recovery walls and the "
+            "preseed speedup"
+        ),
+    }
+    with open(out_path, "w") as f:
+        json.dump(report, f, indent=1)
+    print(json.dumps(report))
+    return report
+
+
 def bench_jax_env(out_path=None, iters=3, n_envs=32, t_rollout=64):
     """Rollout-lane A/B (docs/pipeline.md "two rollout lanes"): the
     SAME JaxVectorEnv (CartPoleJax), same fixed seed, same total env
@@ -3439,6 +3758,12 @@ def main():
         return
     if "--chaos" in sys.argv:
         bench_chaos()
+        return
+    if "--fleet-worker" in sys.argv:
+        bench_fleet_worker()
+        return
+    if "--fleet" in sys.argv:
+        bench_fleet()
         return
     if "--elastic" in sys.argv:
         bench_elastic()

@@ -107,14 +107,31 @@ class Algorithm(Trainable):
         n_learner = config.get("learner_devices")
         import jax
 
+        from ray_tpu import sharding as sharding_lib
+
+        # sharding(hosts=N) — the multi-host learner fleet
+        # (docs/fleet.md): the mesh spans the GLOBAL device view of
+        # the N-process jax.distributed runtime just joined above;
+        # strict resolution fails fast when the runtime geometry and
+        # the config promise disagree
+        hosts = sharding_lib.resolve_hosts(config, strict=True)
         devices = jax.devices()
         if n_learner:
+            if hosts > 1:
+                raise ValueError(
+                    "learner_devices cannot trim a multi-host mesh "
+                    f"(hosts={hosts}): every process's devices "
+                    "participate; shrink the fleet by host instead"
+                )
             devices = devices[:n_learner]
         if config.get("sharding_backend", "mesh") == "pmap":
+            if hosts > 1:
+                raise ValueError(
+                    "sharding(hosts=N) requires the 'mesh' backend — "
+                    "the pmap path is single-process only"
+                )
             config["_mesh"] = mesh_lib.make_mesh(devices=devices)
         else:
-            from ray_tpu import sharding as sharding_lib
-
             # model_parallel (docs/sharding.md): a 2-D (data x model)
             # mesh — params of rule-declaring models split across M
             # shards instead of replicating on every device

@@ -206,6 +206,14 @@ class AlgorithmConfig:
         # model_parallel=1 is the parity geometry: per-leaf specs flow
         # but every leaf stays whole — bit-identical to replicated.
         self.model_parallel = None
+        # multi-host learner fleet (docs/fleet.md): None (default)
+        # keeps the single-process mesh; an int N (or "auto") builds
+        # the learner mesh over the GLOBAL device view of an N-process
+        # jax.distributed runtime — the batch axis spans hosts, XLA
+        # routes collectives over ICI within a host and DCN across.
+        # Requires dist.initialize() to have joined N processes
+        # (RAY_TPU_COORDINATOR et al.; Algorithm.setup validates).
+        self.hosts = None
         # AOT compiled-program cache directory (sharding/aot.py,
         # docs/serving.md "the front door"): when set, the policy's
         # learn program warms through the fleet-shared executable
@@ -430,6 +438,7 @@ class AlgorithmConfig:
         *,
         sharding_backend: Optional[str] = None,
         model_parallel=None,
+        hosts=None,
         aot_cache_dir: Optional[str] = None,
         **kwargs,
     ) -> "AlgorithmConfig":
@@ -438,11 +447,23 @@ class AlgorithmConfig:
         :meth:`resources`. ``model_parallel``: "auto" | int M — build
         the 2-D (data x model) mesh and partition params per the
         model's rules; see the attribute comment in ``__init__``.
-        ``aot_cache_dir``: fleet-shared AOT executable cache the learn
-        program warms through (zero fresh compiles for elastic
-        joiners on a warm cache)."""
+        ``hosts``: "auto" | int N — span the learner mesh over the N
+        processes of the jax.distributed runtime (the multi-host
+        fleet, docs/fleet.md). ``aot_cache_dir``: fleet-shared AOT
+        executable cache the learn program warms through (zero fresh
+        compiles for elastic joiners on a warm cache)."""
         if aot_cache_dir is not None:
             self.aot_cache_dir = str(aot_cache_dir)
+        if hosts is not None:
+            if hosts != "auto":
+                h = int(hosts)
+                if h < 1:
+                    raise ValueError(
+                        "hosts must be 'auto' or an int >= 1, got "
+                        f"{hosts!r}"
+                    )
+                hosts = h
+            self.hosts = hosts
         if sharding_backend is not None:
             if sharding_backend not in ("mesh", "pmap"):
                 raise ValueError(

@@ -575,8 +575,11 @@ class DeviceReplayBuffer:
                     (self.capacity,) + row_shape, v.dtype
                 )
             # rows shard over the data axis when capacity divides the
-            # shard count, else replicate (specs.leaf_sharding rule)
-            self._store[k] = jax.device_put(
+            # shard count, else replicate (specs.leaf_sharding rule);
+            # put_global assembles cross-process shards when the mesh
+            # spans hosts (fleet rings, docs/fleet.md) and is plain
+            # device_put on a local mesh
+            self._store[k] = sharding_lib.put_global(
                 ring, sharding_lib.leaf_sharding(ring, self.mesh)
             )
             self._meta[k] = (row_shape, v.dtype, packed)

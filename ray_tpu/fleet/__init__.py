@@ -1,0 +1,84 @@
+"""ray_tpu.fleet — the elastic multi-host learner fleet (PR 17).
+
+The learner mesh becomes a fleet the way the reference's cluster is
+one (GCS node table, heartbeats, resource-change pubsub): hosts
+rendezvous through a KV control plane, membership lives with a
+single-writer coordinator, every mesh (re)construction is a
+generation-numbered epoch, and a preemption-driven resize is a
+warm-cache restart — the PR-10 reshard contract moves the state, the
+geometry-keyed PR-14 AOT cache supplies the executables, so the
+survivor's first post-resize step performs zero fresh compiles.
+
+Modules (docs/fleet.md):
+
+- :mod:`~ray_tpu.fleet.kv`          KV/rendezvous service (promoted
+  from ``parallel.distributed``; blocking gets, pubsub, heartbeats);
+- :mod:`~ray_tpu.fleet.coordinator` membership, mesh epochs, drain
+  protocol, epoch-scoped barriers;
+- :mod:`~ray_tpu.fleet.elastic`     resize/pre-seed primitives over
+  the reshard contract and the AOT cache.
+"""
+
+from ray_tpu.fleet.coordinator import (
+    BARRIER_TIMEOUT_ENV,
+    CH_JOIN,
+    CH_LEAVE,
+    CH_NOTICE,
+    EPOCH_TIMEOUT_ENV,
+    HEARTBEAT_ENV,
+    HORIZON_ENV,
+    FleetCoordinator,
+    HostAgent,
+    K_EPOCH_PTR,
+    K_MEMBERS,
+    K_READY,
+    MeshEpoch,
+    barrier_key,
+    drain_key,
+    epoch_key,
+)
+from ray_tpu.fleet.elastic import (
+    PRESEED_ENV,
+    epoch_mesh,
+    preseed_enabled,
+    preseed_resize,
+    resize_policy,
+    resize_target_meshes,
+    shadow_policy,
+)
+from ray_tpu.fleet.kv import (
+    HeartbeatReporter,
+    KVClient,
+    KVServer,
+    Subscriber,
+)
+
+__all__ = [
+    "BARRIER_TIMEOUT_ENV",
+    "CH_JOIN",
+    "CH_LEAVE",
+    "CH_NOTICE",
+    "EPOCH_TIMEOUT_ENV",
+    "FleetCoordinator",
+    "HEARTBEAT_ENV",
+    "HORIZON_ENV",
+    "HeartbeatReporter",
+    "HostAgent",
+    "KVClient",
+    "KVServer",
+    "K_EPOCH_PTR",
+    "K_MEMBERS",
+    "K_READY",
+    "MeshEpoch",
+    "PRESEED_ENV",
+    "Subscriber",
+    "barrier_key",
+    "drain_key",
+    "epoch_key",
+    "epoch_mesh",
+    "preseed_enabled",
+    "preseed_resize",
+    "resize_policy",
+    "resize_target_meshes",
+    "shadow_policy",
+]

@@ -1,0 +1,485 @@
+"""ray_tpu.fleet.coordinator — membership, mesh epochs, and the drain
+protocol of the elastic learner fleet.
+
+The learner mesh becomes a fleet the same way the reference's cluster
+does (GCS node table + heartbeat manager + resource-change pubsub):
+hosts register with a single coordinator, liveness rides the KV
+heartbeat plane (:mod:`ray_tpu.fleet.kv`), and every coordinated mesh
+(re)construction is a **generation-numbered epoch** — an immutable KV
+record naming the participating hosts in rank order. Hosts never
+negotiate peer-to-peer; they observe epochs and meet at epoch-scoped
+barriers, so a resize is a total order everyone replays.
+
+Threading follows the FleetController discipline (docs/resilience.md,
+RTA006): the subscriber thread only OBSERVES — join/leave/notice
+events are queued under one lock — and the driver's ``reconcile()``
+ACTS (mutates the member table, posts drains, cuts epochs). All KV
+writes happen on the driver thread of the one coordinator process, so
+the member table and epoch sequence have a single writer.
+
+Epoch/drain choreography on a preemption notice for a learner host::
+
+    host   announce_notice() ── publish fleet/notice ──▶ coordinator
+    coord  reconcile(): post drain record (epoch-scoped KV key),
+           drop the victim from members, cut epoch gen+1
+    hosts  await_drain(gen)  — BLOCKING get, so every host observes
+           the same drain record before its next superstep (lockstep
+           is preserved: the drain step is the last global step)
+    hosts  one final lockstep superstep (the victim's in-flight
+           contribution is not lost), then barrier("drained", gen)
+    victim exits; survivors wait_for_epoch(gen+1) and rebuild the
+           mesh at the surviving geometry (fleet/elastic.py)
+
+Env knobs (documented in docs/fleet.md + docs/API.md):
+``RAY_TPU_FLEET_HEARTBEAT_S`` host heartbeat interval,
+``RAY_TPU_FLEET_LIVENESS_HORIZON_S`` liveness horizon for
+``expire_dead``, ``RAY_TPU_FLEET_BARRIER_TIMEOUT_S`` epoch-barrier
+wait, ``RAY_TPU_FLEET_EPOCH_TIMEOUT_S`` wait for an epoch record.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu.fleet.kv import (
+    HeartbeatReporter,
+    KVClient,
+    Subscriber,
+)
+
+# -- KV schema (all under the fleet/ prefix) ---------------------------
+
+K_MEMBERS = "fleet/members"  # {host: {"rank_hint": int, ...}}
+K_EPOCH_PTR = "fleet/epoch"  # latest generation number (int)
+K_READY = "fleet/ready"  # coordinator's subscriber is registered
+
+
+def epoch_key(gen: int) -> str:
+    """Immutable epoch record for one generation."""
+    return f"fleet/epoch/{gen}"
+
+
+def drain_key(gen: int) -> str:
+    """Drain record cut against generation ``gen`` (the epoch being
+    torn down, not the one being built)."""
+    return f"fleet/drain/{gen}"
+
+
+def barrier_key(gen: int, name: str, host: str) -> str:
+    return f"fleet/barrier/{gen}/{name}/{host}"
+
+
+CH_JOIN = "fleet/join"
+CH_LEAVE = "fleet/leave"
+CH_NOTICE = "fleet/notice"
+
+HEARTBEAT_ENV = "RAY_TPU_FLEET_HEARTBEAT_S"
+HORIZON_ENV = "RAY_TPU_FLEET_LIVENESS_HORIZON_S"
+BARRIER_TIMEOUT_ENV = "RAY_TPU_FLEET_BARRIER_TIMEOUT_S"
+EPOCH_TIMEOUT_ENV = "RAY_TPU_FLEET_EPOCH_TIMEOUT_S"
+
+
+def _env_s(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshEpoch:
+    """One generation of the learner mesh: the participating hosts in
+    rank order. Immutable once written — a resize never edits an
+    epoch, it cuts the next one (the reference's cluster view is the
+    same append-only shape: node table revisions, not mutations)."""
+
+    gen: int
+    hosts: Tuple[str, ...]  # index == jax process rank
+    reason: str = "bootstrap"
+    created_at: float = 0.0
+
+    @property
+    def num_processes(self) -> int:
+        return len(self.hosts)
+
+    def rank_of(self, host: str) -> int:
+        return self.hosts.index(host)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "gen": self.gen,
+            "hosts": list(self.hosts),
+            "reason": self.reason,
+            "created_at": self.created_at,
+        }
+
+    @staticmethod
+    def from_dict(d: Dict[str, Any]) -> "MeshEpoch":
+        return MeshEpoch(
+            gen=int(d["gen"]),
+            hosts=tuple(d["hosts"]),
+            reason=d.get("reason", ""),
+            created_at=float(d.get("created_at", 0.0)),
+        )
+
+
+class FleetCoordinator:
+    """Single-writer membership + epoch authority (one per fleet,
+    typically the rank-0 learner process or the driver).
+
+    The subscriber thread buffers join/leave/notice events;
+    ``reconcile()`` — driver-owned, like FleetController.reconcile —
+    applies them to the member table and cuts epochs. Unit-testable
+    without meshes: events can also be injected directly via
+    ``register_host`` / ``remove_host`` / ``handle_notice`` from the
+    driver thread."""
+
+    def __init__(
+        self,
+        kv: KVClient,
+        liveness_horizon: Optional[float] = None,
+        subscribe: bool = True,
+    ):
+        self.kv = kv
+        self.horizon = (
+            liveness_horizon
+            if liveness_horizon is not None
+            else _env_s(HORIZON_ENV, 30.0)
+        )
+        # one lock guards the event queue AND the member/epoch mirror;
+        # never held across KV round trips
+        self._lock = threading.Lock()
+        self._events: List[Tuple[str, Dict[str, Any]]] = []
+        self._members: Dict[str, Dict[str, Any]] = {}
+        self._gen = 0
+        self._epoch: Optional[MeshEpoch] = None
+        self._sub: Optional[Subscriber] = None
+        # recover state from a previous coordinator's KV writes (the
+        # KV table may be persistent — RAY_TPU_KV_PERSIST)
+        try:
+            self._members = dict(kv.get(K_MEMBERS, timeout=0.1))
+        except KeyError:
+            pass
+        try:
+            self._gen = int(kv.get(K_EPOCH_PTR, timeout=0.1))
+            self._epoch = MeshEpoch.from_dict(
+                kv.get(epoch_key(self._gen), timeout=1.0)
+            )
+        except KeyError:
+            pass
+        if subscribe:
+            self._sub = Subscriber(
+                kv,
+                ["fleet/*"],
+                self._on_event,
+                sub_id="fleet-coordinator",
+                poll_timeout=1.0,
+            )
+        # readiness gate, written AFTER the subscriber is registered:
+        # agents block on it before announcing, so a join can never be
+        # published into a void (pubsub only reaches live subscribers)
+        kv.put(K_READY, time.time())
+
+    # ray-tpu: thread=fleet-sub
+    def _on_event(self, channel: str, msg: Dict[str, Any]) -> None:
+        """Subscriber callback: observe and queue, never act — the
+        driver's reconcile() applies events (RTA006 ownership)."""
+        if channel in (CH_JOIN, CH_LEAVE, CH_NOTICE):
+            with self._lock:
+                self._events.append((channel, dict(msg)))
+
+    # -- driver-side API ------------------------------------------------
+
+    # ray-tpu: thread=driver
+    def reconcile(self) -> List[Tuple[str, Dict[str, Any]]]:
+        """Drain queued events and apply them: joins/leaves edit the
+        member table; a notice triggers the drain + epoch cut. Returns
+        the events applied (for observability/tests)."""
+        with self._lock:
+            events, self._events = self._events, []
+        for channel, msg in events:
+            host = msg.get("host", "")
+            if not host:
+                continue
+            if channel == CH_JOIN:
+                self.register_host(
+                    host, rank_hint=msg.get("rank_hint")
+                )
+            elif channel == CH_LEAVE:
+                self.remove_host(host, reason=msg.get("reason", "leave"))
+            elif channel == CH_NOTICE:
+                self.handle_notice(
+                    host, reason=msg.get("reason", "preempted")
+                )
+        return events
+
+    # ray-tpu: thread=driver
+    def register_host(
+        self, host: str, rank_hint: Optional[int] = None
+    ) -> None:
+        with self._lock:
+            self._members[host] = {
+                "rank_hint": rank_hint,
+                "joined_at": time.time(),
+            }
+            snapshot = dict(self._members)
+        self.kv.put(K_MEMBERS, snapshot)
+
+    # ray-tpu: thread=driver
+    def remove_host(self, host: str, reason: str = "leave") -> None:
+        with self._lock:
+            self._members.pop(host, None)
+            snapshot = dict(self._members)
+        self.kv.put(K_MEMBERS, snapshot)
+
+    # ray-tpu: thread=driver
+    def handle_notice(
+        self, host: str, reason: str = "preempted"
+    ) -> Optional[MeshEpoch]:
+        """Preemption notice for a learner host: post the drain record
+        against the CURRENT generation (hosts block on it, so lockstep
+        is preserved — every host sees the drain before its next
+        superstep), drop the victim, cut the next epoch. Idempotent
+        per victim: a second notice for an already-removed host is a
+        no-op."""
+        with self._lock:
+            if host not in self._members:
+                return None
+            gen = self._gen
+        self.kv.put(
+            drain_key(gen),
+            {"victims": [host], "reason": reason, "ts": time.time()},
+        )
+        self.remove_host(host, reason=reason)
+        from ray_tpu.telemetry import metrics
+
+        metrics.inc_mesh_resizes(reason)
+        return self.propose_epoch(reason=reason)
+
+    # ray-tpu: thread=driver
+    def propose_epoch(self, reason: str = "resize") -> MeshEpoch:
+        """Cut generation ``gen+1`` over the current members. Rank
+        order is deterministic: sort by (rank_hint, host) so re-runs
+        and restarts agree without negotiation."""
+        with self._lock:
+            members = dict(self._members)
+            gen = self._gen + 1
+        hosts = tuple(
+            sorted(
+                members,
+                key=lambda h: (
+                    members[h].get("rank_hint")
+                    if members[h].get("rank_hint") is not None
+                    else 1 << 30,
+                    h,
+                ),
+            )
+        )
+        epoch = MeshEpoch(
+            gen=gen,
+            hosts=hosts,
+            reason=reason,
+            created_at=time.time(),
+        )
+        # record first, pointer second: a reader following the pointer
+        # always finds the record
+        self.kv.put(epoch_key(gen), epoch.to_dict())
+        self.kv.put(K_EPOCH_PTR, gen)
+        with self._lock:
+            self._gen, self._epoch = gen, epoch
+        from ray_tpu.telemetry import metrics
+
+        metrics.set_learner_fleet(len(hosts), gen)
+        return epoch
+
+    # ray-tpu: thread=driver
+    def expire_dead(
+        self, horizon: Optional[float] = None
+    ) -> List[str]:
+        """Heartbeat sweep (the gcs_heartbeat_manager role): any member
+        with no heartbeat inside the horizon is treated as a crashed
+        host — same removal path as a notice, but the epoch cut reason
+        records it was a kill, not a drain."""
+        horizon = horizon if horizon is not None else self.horizon
+        alive = self.kv.alive_nodes(horizon=horizon)
+        with self._lock:
+            dead = [h for h in self._members if h not in alive]
+        for host in dead:
+            self.handle_notice(host, reason="heartbeat-expired")
+        return dead
+
+    # ray-tpu: thread=driver
+    def wait_for_members(
+        self, count: int, timeout: float = 60.0
+    ) -> Dict[str, Dict[str, Any]]:
+        """Rendezvous: reconcile until ``count`` hosts registered."""
+        deadline = time.monotonic() + timeout
+        while True:
+            self.reconcile()
+            with self._lock:
+                members = dict(self._members)
+            if len(members) >= count:
+                return members
+            if time.monotonic() >= deadline:
+                raise TimeoutError(
+                    f"fleet rendezvous: {len(members)}/{count} hosts "
+                    f"after {timeout}s: {sorted(members)}"
+                )
+            time.sleep(0.05)
+
+    # ray-tpu: thread=driver
+    def members(self) -> Dict[str, Dict[str, Any]]:
+        with self._lock:
+            return dict(self._members)
+
+    # ray-tpu: thread=driver
+    def current_epoch(self) -> Optional[MeshEpoch]:
+        with self._lock:
+            return self._epoch
+
+    # ray-tpu: thread=driver
+    def stop(self) -> None:
+        if self._sub is not None:
+            self._sub.stop()
+            self._sub = None
+
+
+class HostAgent:
+    """Per-host fleet participant: heartbeats, join/leave/notice
+    announcements, epoch observation, and epoch-scoped barriers. Holds
+    no authority — every decision is the coordinator's; the agent only
+    announces and observes, so any host can crash at any point without
+    corrupting the member table."""
+
+    def __init__(
+        self,
+        kv: KVClient,
+        host: str,
+        rank_hint: Optional[int] = None,
+        heartbeat_interval: Optional[float] = None,
+    ):
+        self.kv = kv
+        self.host = host
+        self.rank_hint = rank_hint
+        interval = (
+            heartbeat_interval
+            if heartbeat_interval is not None
+            else _env_s(HEARTBEAT_ENV, 2.0)
+        )
+        self._hb = HeartbeatReporter(kv, host, interval=interval)
+
+    # ray-tpu: thread=driver
+    def join(self, timeout: Optional[float] = None) -> None:
+        """Announce this host to the coordinator. Blocks on the
+        coordinator's readiness flag first — the flag is written after
+        the coordinator's subscriber registered, so the join publish
+        is guaranteed an audience."""
+        timeout = (
+            timeout
+            if timeout is not None
+            else _env_s(EPOCH_TIMEOUT_ENV, 120.0)
+        )
+        self.kv.get(K_READY, timeout=timeout)
+        self.kv.publish(
+            CH_JOIN, {"host": self.host, "rank_hint": self.rank_hint}
+        )
+
+    # ray-tpu: thread=driver
+    def leave(self, reason: str = "leave") -> None:
+        self.kv.publish(
+            CH_LEAVE, {"host": self.host, "reason": reason}
+        )
+
+    # ray-tpu: thread=driver
+    def announce_notice(self, reason: str = "preempted") -> None:
+        """The learner-host half of the provider-notice pipeline
+        (resilience/provider_notice.py): forward the eviction signal
+        to the coordinator."""
+        self.kv.publish(
+            CH_NOTICE, {"host": self.host, "reason": reason}
+        )
+
+    # ray-tpu: thread=driver
+    def poll_drain(self, gen: int) -> Optional[Dict[str, Any]]:
+        """Non-blocking peek at the drain record for generation
+        ``gen`` (None if no drain posted). For loops that must not
+        stall when the fleet is healthy."""
+        try:
+            return self.kv.get(drain_key(gen), timeout=0.05)
+        except KeyError:
+            return None
+
+    # ray-tpu: thread=driver
+    def await_drain(
+        self, gen: int, timeout: Optional[float] = None
+    ) -> Dict[str, Any]:
+        """Blocking wait for the drain record — the lockstep anchor:
+        every host of generation ``gen`` observes the same record
+        before its drain step, so the final global superstep is
+        collective on all hosts (the pattern the 2-process worker
+        proved with its notice key)."""
+        timeout = (
+            timeout
+            if timeout is not None
+            else _env_s(EPOCH_TIMEOUT_ENV, 120.0)
+        )
+        return self.kv.get(drain_key(gen), timeout=timeout)
+
+    # ray-tpu: thread=driver
+    def wait_for_epoch(
+        self, gen: int, timeout: Optional[float] = None
+    ) -> MeshEpoch:
+        """Blocking wait for the epoch record of generation ``gen``
+        (the coordinator writes the record before the pointer, so a
+        published generation is always readable)."""
+        timeout = (
+            timeout
+            if timeout is not None
+            else _env_s(EPOCH_TIMEOUT_ENV, 120.0)
+        )
+        return MeshEpoch.from_dict(
+            self.kv.get(epoch_key(gen), timeout=timeout)
+        )
+
+    # ray-tpu: thread=driver
+    def barrier(
+        self,
+        name: str,
+        epoch: MeshEpoch,
+        timeout: Optional[float] = None,
+    ) -> None:
+        """Epoch-scoped barrier over the epoch's hosts: each puts its
+        own key, then blocks on every peer's. Keys are scoped by
+        (gen, name) so barriers of different epochs can never alias —
+        a late host of a dead generation cannot satisfy a new one."""
+        timeout = (
+            timeout
+            if timeout is not None
+            else _env_s(BARRIER_TIMEOUT_ENV, 60.0)
+        )
+        self.kv.put(
+            barrier_key(epoch.gen, name, self.host), time.time()
+        )
+        deadline = time.monotonic() + timeout
+        for peer in epoch.hosts:
+            if peer == self.host:
+                continue
+            remaining = max(0.1, deadline - time.monotonic())
+            try:
+                self.kv.get(
+                    barrier_key(epoch.gen, name, peer),
+                    timeout=remaining,
+                )
+            except KeyError:
+                raise TimeoutError(
+                    f"fleet barrier '{name}' gen={epoch.gen}: host "
+                    f"{peer} missing after {timeout}s"
+                )
+
+    # ray-tpu: thread=driver
+    def stop(self) -> None:
+        self._hb.stop()

@@ -1,0 +1,492 @@
+"""ray_tpu.fleet.kv — the KV/rendezvous control plane of the learner
+fleet.
+
+Promoted out of ``ray_tpu.parallel.distributed`` (PR 17): the fleet
+coordinator, the multi-host tests, and the cluster control plane all
+rendezvous through this one service, so it lives with the fleet
+subsystem that owns the membership protocol. Plays the reference's L1
+GCS roles — KV + rendezvous (``src/ray/gcs/gcs_server/
+gcs_kv_manager.cc``), heartbeat liveness (``gcs_heartbeat_manager.h:
+33``), and long-poll pubsub (``src/ray/pubsub/publisher.h:298``) —
+over plain TCP. ``ray_tpu.parallel.distributed`` re-exports every
+public name for back-compat.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pickle
+import socket
+import socketserver
+import threading
+import time
+from typing import Any, Dict, Optional
+
+
+def _request_hmac(token: str, req: Dict) -> str:
+    """Deterministic MAC over the request header (sorted-key JSON).
+    Requests with a payload carry its sha256 in the header (``body``),
+    so the MAC covers the payload bytes too — a captured header cannot
+    be reused with a substituted pickle blob. Replay of a complete
+    captured request is NOT prevented (no nonce); the token is a
+    second wall on top of network isolation, not a wire-security
+    protocol."""
+    import hashlib
+    import hmac as _hmac
+
+    msg = json.dumps(
+        {k: v for k, v in req.items() if k != "hmac"},
+        sort_keys=True,
+    ).encode()
+    return _hmac.new(
+        token.encode(), msg, hashlib.sha256
+    ).hexdigest()
+
+
+def _body_digest(blob: bytes) -> str:
+    import hashlib
+
+    return hashlib.sha256(blob).hexdigest()
+
+
+def _body_ok(req: Dict, blob: bytes) -> bool:
+    import hmac as _hmac
+
+    return _hmac.compare_digest(
+        req.get("body", ""), _body_digest(blob)
+    )
+
+
+def _channel_match(channel: str, patterns) -> bool:
+    """Exact names, or prefix patterns ending in ``*`` (the reference's
+    per-entity key subscriptions vs whole-channel subscriptions,
+    ``src/ray/pubsub/publisher.h:298``)."""
+    for p in patterns:
+        if p.endswith("*"):
+            if channel.startswith(p[:-1]):
+                return True
+        elif channel == p:
+            return True
+    return False
+
+
+class _KVHandler(socketserver.StreamRequestHandler):
+    def handle(self):
+        store = self.server.kv_store  # type: ignore[attr-defined]
+        try:
+            header = self.rfile.readline()
+            if not header:
+                return
+            req = json.loads(header)
+            op = req["op"]
+            if store.token is not None:
+                # shared-token HMAC gate: values are pickled, so an
+                # unauthenticated reachable KV is code execution — the
+                # reference's GCS has the same exposure and relies on
+                # network isolation; this adds a cheap second wall for
+                # multi-host deployments (RAY_TPU_KV_TOKEN)
+                import hmac as _hmac
+
+                if not _hmac.compare_digest(
+                    req.get("hmac", ""),
+                    _request_hmac(store.token, req),
+                ):
+                    self.wfile.write(
+                        b'{"ok": false, "error": "bad hmac"}\n'
+                    )
+                    return
+            if op == "put":
+                blob = self.rfile.read(req["len"])
+                if store.token is not None and not _body_ok(req, blob):
+                    self.wfile.write(
+                        b'{"ok": false, "error": "bad body digest"}\n'
+                    )
+                    return
+                with store.lock:
+                    store.data[req["key"]] = blob
+                    if store.persist is not None:
+                        store.persist.put("kv", req["key"], blob)
+                    store.cv.notify_all()
+                self.wfile.write(b'{"ok": true}\n')
+            elif op == "get":
+                deadline = time.monotonic() + req.get("timeout", 30.0)
+                with store.lock:
+                    while req["key"] not in store.data:
+                        remaining = deadline - time.monotonic()
+                        if remaining <= 0:
+                            break
+                        store.cv.wait(remaining)
+                    blob = store.data.get(req["key"])
+                if blob is None:
+                    self.wfile.write(b'{"ok": false}\n')
+                else:
+                    self.wfile.write(
+                        json.dumps({"ok": True, "len": len(blob)}).encode()
+                        + b"\n"
+                    )
+                    self.wfile.write(blob)
+            elif op == "subscribe":
+                import collections
+
+                with store.lock:
+                    existing = store.subs.get(req["sub"])
+                    if existing is not None:
+                        # re-subscribe (reconnect/retry): update the
+                        # channel list, keep the buffered queue
+                        existing["channels"] = list(req["channels"])
+                    else:
+                        store.subs[req["sub"]] = {
+                            "channels": list(req["channels"]),
+                            "queue": collections.deque(),
+                            "dropped": 0,
+                        }
+                self.wfile.write(b'{"ok": true}\n')
+            elif op == "unsubscribe":
+                with store.lock:
+                    store.subs.pop(req["sub"], None)
+                    # wake any in-flight poll for this subscriber so it
+                    # returns now instead of at its deadline
+                    store.pub_cv.notify_all()
+                self.wfile.write(b'{"ok": true}\n')
+            elif op == "publish":
+                blob = self.rfile.read(req["len"])
+                if store.token is not None and not _body_ok(req, blob):
+                    self.wfile.write(
+                        b'{"ok": false, "error": "bad body digest"}\n'
+                    )
+                    return
+                ch = req["channel"]
+                delivered = 0
+                with store.lock:
+                    for sub in store.subs.values():
+                        if _channel_match(ch, sub["channels"]):
+                            sub["queue"].append((ch, blob))
+                            if len(sub["queue"]) > store.sub_maxlen:
+                                sub["queue"].popleft()
+                                sub["dropped"] += 1
+                            delivered += 1
+                    store.pub_cv.notify_all()
+                self.wfile.write(
+                    json.dumps({"ok": True, "delivered": delivered}).encode()
+                    + b"\n"
+                )
+            elif op == "poll":
+                deadline = time.monotonic() + req.get("timeout", 30.0)
+                max_msgs = req.get("max", 100)
+                with store.lock:
+                    sub = store.subs.get(req["sub"])
+                    if sub is None:
+                        self.wfile.write(
+                            b'{"ok": false, "error": "no such subscriber"}\n'
+                        )
+                        return
+                    while (
+                        not sub["queue"]
+                        and store.subs.get(req["sub"]) is sub
+                    ):
+                        remaining = deadline - time.monotonic()
+                        if remaining <= 0:
+                            break
+                        store.pub_cv.wait(remaining)
+                    batch = []
+                    while sub["queue"] and len(batch) < max_msgs:
+                        batch.append(sub["queue"].popleft())
+                    dropped, sub["dropped"] = sub["dropped"], 0
+                header = {
+                    "ok": True,
+                    "channels": [c for c, _ in batch],
+                    "lens": [len(b) for _, b in batch],
+                    "dropped": dropped,
+                }
+                self.wfile.write(json.dumps(header).encode() + b"\n")
+                for _, b in batch:
+                    self.wfile.write(b)
+            elif op == "heartbeat":
+                with store.lock:
+                    store.heartbeats[req["node"]] = time.time()
+                self.wfile.write(b'{"ok": true}\n')
+            elif op == "nodes":
+                horizon = req.get("horizon", 30.0)
+                now = time.time()
+                with store.lock:
+                    alive = {
+                        n: now - t
+                        for n, t in store.heartbeats.items()
+                        if now - t <= horizon
+                    }
+                self.wfile.write(
+                    json.dumps({"ok": True, "alive": alive}).encode()
+                    + b"\n"
+                )
+        except Exception:
+            try:
+                self.wfile.write(b'{"ok": false}\n')
+            except Exception:
+                pass
+
+
+class KVServer:
+    """Blocking-get KV + heartbeat service, one per cluster (runs on the
+    coordinator host).
+
+    Trust model: values are pickled, so the service must only be
+    reachable from cluster hosts (same as the reference's GCS, which is
+    also unauthenticated by default). The default bind is loopback;
+    pass host="0.0.0.0" explicitly for a real multi-host cluster and
+    keep the port firewalled to the cluster network.
+
+    Durability: ``persist_path`` (or ``RAY_TPU_KV_PERSIST``) backs the
+    KV table with a durable store client — a restarted coordinator
+    reloads every key, so driver death no longer loses cluster KV state
+    (reference: GCS fault tolerance via external Redis,
+    ``gcs/store_client/redis_store_client.h:27``,
+    ``test_gcs_fault_tolerance.py``). Heartbeats stay volatile by
+    design — liveness must be re-proven after a restart."""
+
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        persist_path: Optional[str] = None,
+        token: Optional[str] = None,
+    ):
+        from ray_tpu.core.store_client import make_store_client
+
+        # shared-secret request authentication (off by default on
+        # loopback; set for any non-loopback bind)
+        self.token = token or os.environ.get("RAY_TPU_KV_TOKEN")
+        persist_path = persist_path or os.environ.get(
+            "RAY_TPU_KV_PERSIST"
+        )
+        self.persist = (
+            make_store_client(persist_path) if persist_path else None
+        )
+        self.data: Dict[str, bytes] = (
+            dict(self.persist.all("kv")) if self.persist else {}
+        )
+        self.heartbeats: Dict[str, float] = {}
+        # pubsub fan-out: subscriber id -> {channels, queue, dropped}.
+        # Queues are bounded (drop-oldest, counted) so one stalled
+        # subscriber cannot hold the coordinator's memory hostage —
+        # the reference's publisher has the same bounded-buffer policy
+        # (src/ray/pubsub/publisher.h:298 max buffered bytes).
+        self.subs: Dict[str, Dict] = {}
+        self.sub_maxlen = int(
+            os.environ.get("RAY_TPU_PUBSUB_MAXLEN", 1000)
+        )
+        self.lock = threading.Lock()
+        self.cv = threading.Condition(self.lock)
+        self.pub_cv = threading.Condition(self.lock)
+        class _Server(socketserver.ThreadingTCPServer):
+            # a restarted coordinator must be able to rebind its
+            # well-known port while old connections sit in TIME_WAIT
+            allow_reuse_address = True
+
+        self._server = _Server(
+            (host, port), _KVHandler, bind_and_activate=True
+        )
+        self._server.daemon_threads = True
+        self._server.kv_store = self  # type: ignore[attr-defined]
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True
+        )
+        self._thread.start()
+
+    @property
+    def address(self) -> str:
+        return f"{socket.gethostname()}:{self.port}"
+
+    def shutdown(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        if self.persist is not None:
+            self.persist.close()
+
+
+class KVClient:
+    """Client for KVServer (usable from any host)."""
+
+    def __init__(self, address: str, token: Optional[str] = None):
+        host, port = address.rsplit(":", 1)
+        self.host, self.port = host, int(port)
+        self.token = token or os.environ.get("RAY_TPU_KV_TOKEN")
+
+    def _roundtrip(self, req: Dict, payload: bytes = b"") -> Any:
+        if self.token is not None:
+            if payload:
+                req = dict(req, body=_body_digest(payload))
+            req = dict(req, hmac=_request_hmac(self.token, req))
+        # socket deadline must outlast a server-side blocking get, or
+        # long waits surface as TimeoutError instead of KeyError
+        sock_timeout = float(req.get("timeout", 30.0)) + 30.0
+        with socket.create_connection(
+            (self.host, self.port), timeout=sock_timeout
+        ) as s:
+            f = s.makefile("rwb")
+            f.write(json.dumps(req).encode() + b"\n")
+            if payload:
+                f.write(payload)
+            f.flush()
+            resp = json.loads(f.readline())
+            if req["op"] == "get" and resp.get("ok"):
+                resp["blob"] = f.read(resp["len"])
+            elif req["op"] == "poll" and resp.get("ok"):
+                resp["blobs"] = [f.read(n) for n in resp["lens"]]
+            return resp
+
+    def put(self, key: str, value: Any) -> None:
+        blob = pickle.dumps(value)
+        self._roundtrip(
+            {"op": "put", "key": key, "len": len(blob)}, blob
+        )
+
+    def get(self, key: str, timeout: float = 30.0) -> Any:
+        resp = self._roundtrip(
+            {"op": "get", "key": key, "timeout": timeout}
+        )
+        if not resp.get("ok"):
+            raise KeyError(key)
+        return pickle.loads(resp["blob"])
+
+    def subscribe(self, sub: str, channels) -> None:
+        """Register a subscriber for exact channels or ``prefix*``
+        patterns; messages buffer server-side until polled."""
+        self._roundtrip(
+            {"op": "subscribe", "sub": sub, "channels": list(channels)}
+        )
+
+    def unsubscribe(self, sub: str) -> None:
+        self._roundtrip({"op": "unsubscribe", "sub": sub})
+
+    def publish(self, channel: str, message: Any) -> int:
+        """Fan a message out to every matching subscriber's buffer;
+        returns the number of subscribers it reached."""
+        blob = pickle.dumps(message)
+        return self._roundtrip(
+            {"op": "publish", "channel": channel, "len": len(blob)},
+            blob,
+        ).get("delivered", 0)
+
+    def poll(self, sub: str, timeout: float = 30.0, max_msgs: int = 100):
+        """Long-poll a batch of buffered messages (the reference's
+        long-poll batch pubsub, ``src/ray/pubsub/publisher.h:298``).
+        Returns (messages, dropped) where messages is a list of
+        (channel, value) and dropped counts overflow losses since the
+        last poll."""
+        resp = self._roundtrip(
+            {"op": "poll", "sub": sub, "timeout": timeout, "max": max_msgs}
+        )
+        if not resp.get("ok"):
+            raise KeyError(resp.get("error", sub))
+        msgs = [
+            (c, pickle.loads(b))
+            for c, b in zip(resp["channels"], resp["blobs"])
+        ]
+        return msgs, resp.get("dropped", 0)
+
+    def heartbeat(self, node: str) -> None:
+        self._roundtrip({"op": "heartbeat", "node": node})
+
+    def alive_nodes(self, horizon: float = 30.0) -> Dict[str, float]:
+        return self._roundtrip({"op": "nodes", "horizon": horizon})[
+            "alive"
+        ]
+
+
+class Subscriber:
+    """Background long-poll loop dispatching published messages to a
+    callback (the reference's subscriber-side long-poll client,
+    ``src/ray/pubsub/subscriber.h``). ``callback(channel, message)``
+    runs on the poll thread; exceptions are swallowed so one bad
+    handler doesn't kill the stream."""
+
+    def __init__(
+        self,
+        client: KVClient,
+        channels,
+        callback,
+        sub_id: Optional[str] = None,
+        poll_timeout: float = 5.0,
+    ):
+        import uuid
+
+        self.client = client
+        self.sub_id = sub_id or f"sub_{uuid.uuid4().hex[:8]}"
+        self.callback = callback
+        self.poll_timeout = poll_timeout
+        self.dropped = 0
+        self.last_error: Optional[str] = None
+        self._channels = list(channels)
+        client.subscribe(self.sub_id, self._channels)
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        while not self._stop.is_set():
+            try:
+                msgs, dropped = self.client.poll(
+                    self.sub_id, timeout=self.poll_timeout
+                )
+                self.dropped += dropped
+            except KeyError as e:
+                if self._stop.is_set():
+                    return
+                if "no such subscriber" in str(e):
+                    # server lost our registration (KV restart — the
+                    # KV table persists but subscriptions are
+                    # volatile): re-subscribe and keep polling
+                    try:
+                        self.client.subscribe(self.sub_id, self._channels)
+                    except Exception:
+                        time.sleep(0.2)
+                else:
+                    # a different rejection (e.g. token mismatch) will
+                    # not heal by retrying fast — record it so the
+                    # owner can see why nothing is arriving
+                    self.last_error = str(e)
+                    time.sleep(1.0)
+                continue
+            except Exception as e:
+                if self._stop.is_set():
+                    return
+                self.last_error = str(e)
+                time.sleep(0.2)
+                continue
+            for ch, msg in msgs:
+                try:
+                    self.callback(ch, msg)
+                except Exception:
+                    pass
+
+    def stop(self):
+        self._stop.set()
+        try:
+            self.client.unsubscribe(self.sub_id)
+        except Exception:
+            pass
+        self._thread.join(timeout=self.poll_timeout + 1.0)
+
+
+class HeartbeatReporter:
+    """Background liveness pings (the gcs_heartbeat_manager role)."""
+
+    def __init__(self, client: KVClient, node: str, interval: float = 5.0):
+        self.client = client
+        self.node = node
+        self.interval = interval
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        while not self._stop.wait(self.interval):
+            try:
+                self.client.heartbeat(self.node)
+            except Exception:
+                pass
+
+    def stop(self):
+        self._stop.set()

@@ -1679,6 +1679,46 @@ class JaxPolicy(Policy):
             return
         fn.aot_warmup(cache, *args)
 
+    # ray-tpu: thread=driver
+    def _maybe_fleet_preseed(self, dev_batch, batch_size) -> None:
+        """Resize-geometry AOT pre-seed (docs/fleet.md): ONCE, at the
+        first learn on a mesh that spans processes with an AOT cache
+        configured, compile the learn program of each ±1-host resize
+        geometry into the shared cache — a later preemption-driven
+        resize then restores its executable instead of compiling
+        (fleet.elastic.resize_policy: zero fresh compiles). The seed
+        batch is zeros at the live batch's global shapes: executables
+        depend on placement and shape, never on values."""
+        if getattr(self, "_fleet_preseeded", False):
+            return
+        self._fleet_preseeded = True
+        if self._learn_aot_cache() is None:
+            return
+        mesh = getattr(self, "mesh", None)
+        if mesh is None or not sharding_lib.mesh_spans_processes(
+            mesh
+        ):
+            return
+        from ray_tpu.fleet import elastic as elastic_lib
+
+        if not elastic_lib.preseed_enabled():
+            return
+        try:
+            import numpy as np
+
+            host = {
+                k: np.zeros(v.shape, v.dtype)
+                for k, v in dev_batch.items()
+                if hasattr(v, "shape")
+            }
+            for target in elastic_lib.resize_target_meshes(mesh):
+                elastic_lib.preseed_resize(
+                    self, target, host, batch_size
+                )
+        except Exception:
+            pass  # the pre-seed is an optimization: a failed sweep
+            # must never break the live learn path
+
     def learn_on_device_batch(
         self, dev_batch: Dict[str, Any], batch_size: int,
         *, defer_stats: bool = False,
@@ -1725,6 +1765,7 @@ class JaxPolicy(Policy):
             fn,
             (self.params, self.opt_state, aux, dev_batch, rng, coeffs),
         )
+        self._maybe_fleet_preseed(dev_batch, batch_size)
         compiles_before = getattr(fn, "traces", 0)
         compile_s_before = getattr(fn, "compile_time_s", 0.0)
         t0 = _time.perf_counter()

@@ -3,21 +3,28 @@
 Cloud providers surface spot/preemptible eviction through a local
 endpoint (GCE's ``instance/preempted`` metadata key, AWS's
 ``spot/instance-action``). This module is the minimal in-repo stand-in:
-one non-blocking probe both **rollout workers**
-(:meth:`RolloutWorker.preemption_notice`) and **serving replicas**
-(:meth:`PolicyDeployment.preemption_notice`) consult, so the fleet
-controller and a serve controller drain on the same signal with no
-per-caller plumbing. A real deployment replaces :func:`probe` sources
-with the provider endpoint; the callers don't change.
+one non-blocking probe **rollout workers**
+(:meth:`RolloutWorker.preemption_notice`), **serving replicas**
+(:meth:`PolicyDeployment.preemption_notice`), and — since PR 17 —
+**learner hosts** (``fleet.coordinator.HostAgent``) consult, so the
+fleet controller, a serve controller, and the learner-mesh coordinator
+all drain on the same signal with no per-caller plumbing. A real
+deployment replaces :func:`probe` sources with the provider endpoint;
+the callers don't change.
 
-Sources, first hit wins (both are cheap enough for per-poll use):
+Sources, first hit wins (all are cheap enough for per-poll use):
 
 - ``RAY_TPU_PREEMPTION_NOTICE``: grace seconds as a float (an armed
   env var preempts every process that inherits it);
 - ``RAY_TPU_PREEMPTION_NOTICE_FILE``: a path; the notice is armed the
   moment the file exists, its content the grace seconds (empty or
   unparseable = 0.0, i.e. evict NOW). Touching one file preempts one
-  specific worker/replica — the testing and ops surface.
+  specific worker/replica — the testing and ops surface;
+- ``RAY_TPU_PREEMPTION_NOTICE_DIR``: a directory of per-host notice
+  files named ``<host>`` — the learner-fleet surface: every host of a
+  multi-host mesh shares ONE env value, and ``probe(host=...)``
+  consults only its own file, so an orchestrator evicts one learner
+  host by touching ``$DIR/host1`` without re-enving the fleet.
 """
 
 from __future__ import annotations
@@ -27,6 +34,7 @@ from typing import Optional
 
 NOTICE_ENV = "RAY_TPU_PREEMPTION_NOTICE"
 NOTICE_FILE_ENV = "RAY_TPU_PREEMPTION_NOTICE_FILE"
+NOTICE_DIR_ENV = "RAY_TPU_PREEMPTION_NOTICE_DIR"
 
 
 def _parse_grace(raw: str) -> float:
@@ -36,18 +44,29 @@ def _parse_grace(raw: str) -> float:
         return 0.0
 
 
-def probe() -> Optional[float]:
+def _probe_file(path: str) -> Optional[float]:
+    try:
+        with open(path) as f:
+            return _parse_grace(f.read())
+    except OSError:
+        return None  # file absent: notice not armed (yet)
+
+
+def probe(host: Optional[str] = None) -> Optional[float]:
     """Seconds of grace left before this process's provider-announced
     preemption, or None when no notice is outstanding. Non-blocking
-    and exception-free — safe on every poll path."""
+    and exception-free — safe on every poll path. ``host`` scopes the
+    directory source to one learner host's notice file; the env and
+    single-file sources are host-agnostic and fire regardless."""
     raw = os.environ.get(NOTICE_ENV)
     if raw is not None and raw.strip():
         return _parse_grace(raw)
     path = os.environ.get(NOTICE_FILE_ENV)
     if path:
-        try:
-            with open(path) as f:
-                return _parse_grace(f.read())
-        except OSError:
-            return None  # file absent: notice not armed (yet)
+        got = _probe_file(path)
+        if got is not None:
+            return got
+    root = os.environ.get(NOTICE_DIR_ENV)
+    if root and host:
+        return _probe_file(os.path.join(root, host))
     return None

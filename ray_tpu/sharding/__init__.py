@@ -35,9 +35,11 @@ from ray_tpu.sharding.mesh import (
     clear_mesh_cache,
     data_axis,
     get_mesh,
+    global_devices,
     model_axis,
     model_shards,
     num_shards,
+    resolve_hosts,
     resolve_model_parallel,
     simulated_device_env,
 )
@@ -46,9 +48,11 @@ from ray_tpu.sharding.specs import (
     clear_sharding_caches,
     default_partition_rules,
     leaf_sharding,
+    mesh_spans_processes,
     named_tree,
     param_pspecs,
     param_sharding,
+    put_global,
     replicated,
     shard_batch,
     sharding_tree,
@@ -73,7 +77,10 @@ def resolve_mesh(config):
     ``_mesh`` (Algorithm.setup, multi-host tests) wins; otherwise the
     backend decides — ``"mesh"`` builds through this package,
     ``"pmap"`` through the legacy ``ray_tpu.parallel`` adapter (axis
-    named ``"data"``), keeping that path byte-compatible."""
+    named ``"data"``), keeping that path byte-compatible.
+    ``sharding(hosts=N)`` builds over the GLOBAL device view (every
+    process of the jax.distributed runtime — the DCN × ICI mesh of
+    docs/fleet.md) instead of this process's local devices."""
     m = config.get("_mesh")
     if m is not None:
         return m
@@ -81,7 +88,19 @@ def resolve_mesh(config):
         from ray_tpu.parallel import mesh as _legacy
 
         return _legacy.make_mesh()
+    hosts = resolve_hosts(config)
     mp = resolve_model_parallel(config)
+    if hosts > 1:
+        devs = global_devices(hosts)
+        if mp:
+            return get_mesh(
+                devices=devs,
+                axis_shapes=[
+                    (BATCH_AXIS, len(devs) // mp),
+                    (MODEL_AXIS, mp),
+                ],
+            )
+        return get_mesh(devices=devs)
     if mp:
         devs = list(available_devices())
         return get_mesh(
@@ -112,14 +131,18 @@ __all__ = [
     "data_axis",
     "f64_scope",
     "get_mesh",
+    "global_devices",
     "leaf_sharding",
+    "mesh_spans_processes",
     "model_axis",
     "model_shards",
     "named_tree",
     "num_shards",
     "param_pspecs",
     "param_sharding",
+    "put_global",
     "replicated",
+    "resolve_hosts",
     "resolve_mesh",
     "resolve_model_parallel",
     "shard_batch",

@@ -23,7 +23,11 @@ Keying and the fallback contract (docs/serving.md "the front door"):
 - entries are keyed by a **fingerprint** (jax/jaxlib version, backend
   platform, device kind, device count — serialized executables are
   only valid on the topology+toolchain that built them), the program
-  label, and the abstract input signature;
+  label, and the abstract input signature — which carries the mesh
+  geometry of the program's shardings (``compile.py``
+  ``_mesh_geometry_token``), so one process can hold entries for
+  SEVERAL mesh geometries at once (the fleet pre-seeds its ±1-host
+  resize geometries ahead of a preemption, PR 17);
 - ANY mismatch — different version, different topology, a torn or
   corrupt file, an API that refuses to deserialize — is a plain cache
   miss: the caller compiles live (and repopulates the cache), never
@@ -47,7 +51,9 @@ from typing import Any, Dict, Optional, Tuple
 from ray_tpu.util import tracing
 
 # bump when the entry layout changes: old entries become misses
-FORMAT = 1
+# (2: mesh-geometry token joined the signature — pre-format entries
+# would collide across geometries, so they must miss)
+FORMAT = 2
 
 
 def supported() -> bool:

@@ -58,6 +58,34 @@ def set_dispatch_diet(on: bool) -> bool:
     return prev
 
 
+def _mesh_geometry_token(tree) -> Tuple:
+    """Stable token naming every mesh geometry the tree's shardings
+    reference: ((axis, size) pairs, participating device ids) per
+    distinct mesh. The AOT cache keys on it (aot.FORMAT 2) — a program
+    lowered on a 2-host (dcn=2, batch=k) mesh and its 1-host resize
+    twin share label AND abstract shapes but not executables, so the
+    geometry must be part of the entry identity for the fleet's
+    pre-seeded ±1-host entries to coexist."""
+    toks = set()
+    for leaf in jax.tree_util.tree_leaves(tree):
+        for holder in (leaf, getattr(leaf, "sharding", None)):
+            mesh = getattr(holder, "mesh", None)
+            if mesh is None:
+                continue
+            try:
+                axes = tuple(
+                    (str(a), int(s))
+                    for a, s in dict(mesh.shape).items()
+                )
+                ids = tuple(
+                    int(d.id) for d in mesh.devices.flat
+                )
+            except Exception:
+                continue
+            toks.add((axes, ids))
+    return tuple(sorted(toks))
+
+
 class ShardedFunction:
     """A compiled, partitioned callable.
 
@@ -157,6 +185,13 @@ class ShardedFunction:
         replica hits. Returns ``"hit"`` / ``"compiled"`` /
         ``"disabled"`` (no cache, or a jax build that can't serialize
         executables — the caller falls back to plain jit warmup).
+
+        The cache signature carries the MESH GEOMETRY of the program's
+        shardings on top of the ledger's shape/dtype signature: the
+        same label at the same shapes lowers to different collectives
+        on different meshes (a 2-host fleet pre-seeding its 1-host
+        resize geometry is the motivating case — without the token the
+        two entries would collide on one key).
         """
         from ray_tpu.sharding import aot as aot_lib
 
@@ -167,6 +202,11 @@ class ShardedFunction:
             sig = device_ledger.signature_of(
                 args, kwargs, self.static_argnames
             )
+            geo = _mesh_geometry_token(
+                (args, kwargs, self.in_specs, self.out_specs)
+            )
+            if geo:
+                sig = (sig, ("mesh", geo))
         except Exception:
             return "disabled"
         loaded = cache.load(self.label, sig)

@@ -156,6 +156,45 @@ def resolve_model_parallel(config, devices=None, strict: bool = False) -> int:
     return m
 
 
+def resolve_hosts(config, strict: bool = False) -> int:
+    """Resolve ``AlgorithmConfig.hosts`` (None | "auto" | int) to the
+    number of jax processes the learner mesh spans.
+
+    Returns 1 when unset — the single-process mesh, unchanged
+    behavior. ``"auto"`` adopts however many processes the
+    jax.distributed runtime brought up (``dist.initialize`` ran first
+    in Algorithm.setup). An explicit N asserts the runtime actually
+    spans N processes when ``strict`` — a mesh silently smaller than
+    the config promised is the hardest multi-host bug to notice."""
+    mode = config.get("hosts")
+    if mode in (None, False, 0):
+        return 1
+    if mode == "auto":
+        return int(jax.process_count())
+    h = int(mode)
+    if h < 1:
+        return 1
+    if strict and h != jax.process_count():
+        raise ValueError(
+            f"sharding(hosts={h}) but the jax runtime spans "
+            f"{jax.process_count()} process(es) — set "
+            "RAY_TPU_COORDINATOR/RAY_TPU_NUM_PROCESSES/"
+            "RAY_TPU_PROCESS_ID (or hosts='auto') so the fleet "
+            "geometry and the runtime agree"
+        )
+    return h
+
+
+def global_devices(hosts: int):
+    """The devices a ``hosts``-process learner mesh is built from:
+    every process's devices when the mesh spans hosts (the DCN × ICI
+    global view — XLA routes collectives over ICI within a host and
+    DCN across), this process's local devices otherwise."""
+    if hosts > 1:
+        return list(jax.devices())
+    return list(jax.local_devices())
+
+
 def simulated_device_env(n: int) -> dict:
     """Env-var dict that makes a fresh process expose ``n`` simulated
     CPU devices (must be set before jax initializes its backend; use

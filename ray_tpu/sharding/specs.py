@@ -98,6 +98,63 @@ def leaf_sharding(x, mesh: Mesh) -> NamedSharding:
     return replicated(mesh)
 
 
+@functools.lru_cache(maxsize=128)
+def mesh_spans_processes(mesh: Mesh) -> bool:
+    """Whether this mesh's devices live in more than one jax process —
+    the DCN case, where a plain ``device_put`` of a host value cannot
+    address the remote shards and placement must go through
+    :func:`put_global` instead."""
+    try:
+        return (
+            len({d.process_index for d in mesh.devices.flat}) > 1
+        )
+    except Exception:
+        return False
+
+
+def put_global(x, sharding: NamedSharding):
+    """``device_put`` that also works when the sharding's mesh spans
+    processes (multi-host learner fleets, docs/fleet.md).
+
+    Single-process meshes take the plain ``jax.device_put`` path —
+    byte-identical behavior to before. On a cross-process mesh, every
+    process must call this with the SAME host value (the lockstep SPMD
+    contract the multi-host tests pin): each process carves out the
+    row block its addressable shards own and the global array is
+    assembled via ``jax.make_array_from_process_local_data`` — the
+    device-replay rings allocate their cross-host shards through
+    exactly this path."""
+    mesh = getattr(sharding, "mesh", None)
+    if mesh is None or not mesh_spans_processes(mesh):
+        return jax.device_put(x, sharding)
+    import numpy as np
+
+    arr = np.asarray(x)
+    # the union of this process's shard index-boxes (contiguous per
+    # dim for the 1-D row layouts the learner uses)
+    idx_map = sharding.addressable_devices_indices_map(arr.shape)
+    local = arr
+    if idx_map:
+        slices = []
+        for d in range(arr.ndim):
+            starts = [
+                (idx[d].start or 0) for idx in idx_map.values()
+            ]
+            stops = [
+                (
+                    idx[d].stop
+                    if idx[d].stop is not None
+                    else arr.shape[d]
+                )
+                for idx in idx_map.values()
+            ]
+            slices.append(slice(min(starts), max(stops)))
+        local = arr[tuple(slices)]
+    return jax.make_array_from_process_local_data(
+        sharding, local, arr.shape
+    )
+
+
 # signature -> (resolved tree, fallback shapes) LRU; one entry per
 # distinct (mesh, column-name, placement-kind, replicate-set) batch
 # signature — steady training resolves its per-batch tree with dict

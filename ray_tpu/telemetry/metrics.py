@@ -53,6 +53,15 @@ SKIPPED_BATCHES_TOTAL = "ray_tpu_skipped_batches_total"
 # snapshot count + how many supersteps the written tail lags the run
 FLEET_SIZE = "ray_tpu_fleet_size"
 PREEMPTIONS_TOTAL = "ray_tpu_preemptions_total"
+# learner fleet (docs/fleet.md): hosts in the current mesh epoch and
+# the epoch generation itself (a resize shows as the host gauge
+# stepping and the generation bumping together), resizes by reason
+# (drain vs heartbeat-expired), and AOT pre-seed sweep outcomes by
+# aot_warmup status (hit / compiled / disabled)
+LEARNER_FLEET_HOSTS = "ray_tpu_learner_fleet_hosts"
+MESH_EPOCH = "ray_tpu_mesh_epoch"
+MESH_RESIZES_TOTAL = "ray_tpu_mesh_resizes_total"
+FLEET_PRESEEDS_TOTAL = "ray_tpu_fleet_aot_preseeds_total"
 CKPT_STREAM_SNAPSHOTS_TOTAL = (
     "ray_tpu_checkpoint_stream_snapshots_total"
 )
@@ -242,6 +251,38 @@ def inc_preemptions(drained: bool, n: int = 1) -> None:
         "worker preemptions by drain outcome",
         ("drained",),
     ).inc(float(n), {"drained": "true" if drained else "false"})
+
+
+def set_learner_fleet(hosts: int, gen: int) -> None:
+    """Learner-fleet geometry under the current mesh epoch (set by
+    the FleetCoordinator on every epoch cut; docs/fleet.md)."""
+    gauge(
+        LEARNER_FLEET_HOSTS,
+        "learner hosts in the current mesh epoch",
+    ).set(float(hosts))
+    gauge(
+        MESH_EPOCH,
+        "current learner mesh epoch generation",
+    ).set(float(gen))
+
+
+def inc_mesh_resizes(reason: str, n: int = 1) -> None:
+    """Learner-mesh resizes by reason (``preempted`` = notice-driven
+    drain, ``heartbeat-expired`` = crashed host swept by liveness)."""
+    counter(
+        MESH_RESIZES_TOTAL,
+        "learner mesh resizes",
+        ("reason",),
+    ).inc(float(n), {"reason": reason})
+
+
+def inc_fleet_preseed(status: str, n: int = 1) -> None:
+    """Resize-geometry AOT pre-seed attempts by aot_warmup outcome."""
+    counter(
+        FLEET_PRESEEDS_TOTAL,
+        "resize-geometry AOT pre-seed attempts",
+        ("status",),
+    ).inc(float(n), {"status": status})
 
 
 def inc_stream_snapshots(n: int = 1) -> None:

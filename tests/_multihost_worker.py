@@ -1,7 +1,20 @@
 """Subprocess entry for the 2-process DCN test (launched by
 test_multihost.py with JAX_PLATFORMS=cpu and a 2-device virtual host).
+
+Since PR 17 this is a thin driver over ray_tpu.fleet: rank 0 runs the
+FleetCoordinator (single-writer membership + epoch authority), every
+rank runs a HostAgent (join/heartbeat/epoch observation/barriers), and
+the elastic half is the real drain choreography — provider notice →
+coordinator cuts epoch gen+1 → lockstep drain step → barrier → the
+survivor rebuilds via fleet.resize_policy on fleet.epoch_mesh, with
+bitwise post-reshard params and (AOT cache pre-seeded in-process by
+the first learn step) zero fresh compiles.
+
 Exercises: jax.distributed bring-up, a global mesh psum across hosts,
-cross-host weight broadcast, KV rendezvous, heartbeats."""
+cross-host weight broadcast, put_global batch placement, fleet
+rendezvous + epochs + drain + barrier, live resize as a warm-cache
+restart.
+"""
 
 import os
 import sys
@@ -14,6 +27,7 @@ def main() -> None:
     import jax.numpy as jnp
     import numpy as np
 
+    from ray_tpu import fleet
     from ray_tpu.parallel import distributed as dist
 
     rank = int(os.environ["RAY_TPU_PROCESS_ID"])
@@ -23,28 +37,38 @@ def main() -> None:
     assert jax.local_device_count() == 2
     assert jax.device_count() == 4
 
-    # ---- KV + heartbeat (control plane) ----
-    kv = dist.KVClient(os.environ["RAY_TPU_KV_ADDRESS"])
-    hb = dist.HeartbeatReporter(kv, f"host{rank}", interval=2.0)
-    kv.heartbeat(f"host{rank}")
-    kv.put(f"hello_{rank}", {"rank": rank})
-    other = kv.get(f"hello_{1 - rank}", timeout=30.0)
-    assert other["rank"] == 1 - rank
+    # ---- fleet rendezvous: HostAgents announce, the coordinator
+    # (rank 0 only — single writer) registers them and cuts epoch 1 ----
+    kv = fleet.KVClient(os.environ["RAY_TPU_KV_ADDRESS"])
+    coord = fleet.FleetCoordinator(kv) if rank == 0 else None
+    agent = fleet.HostAgent(
+        kv, f"host{rank}", rank_hint=rank, heartbeat_interval=1.0
+    )
+    agent.join()  # blocks on the coordinator's readiness flag
+    if rank == 0:
+        members = coord.wait_for_members(2, timeout=60.0)
+        assert sorted(members) == ["host0", "host1"], members
+        coord.propose_epoch(reason="bootstrap")
+    epoch1 = agent.wait_for_epoch(1)
+    assert epoch1.hosts == ("host0", "host1"), epoch1
+    assert epoch1.rank_of(f"host{rank}") == rank
 
-    # ---- data plane: psum over the global (DCN) mesh ----
+    # ---- data plane: the epoch's mesh is the global (DCN) mesh ----
     from jax.sharding import NamedSharding, PartitionSpec as P
 
-    mesh = dist.global_mesh()
+    from ray_tpu import sharding as sharding_lib
+
+    mesh = fleet.epoch_mesh(epoch1)
+    assert len(mesh.devices.flat) == 4
+    axis = sharding_lib.data_axis(mesh)
 
     x = jnp.ones((4,), jnp.float32)  # one row per global device
-    sharded = jax.device_put(
-        x, NamedSharding(mesh, P("data"))
-    )
+    sharded = jax.device_put(x, NamedSharding(mesh, P(axis)))
     out = jax.jit(
         jax.shard_map(
-            lambda v: jax.lax.psum(v, "data"),
+            lambda v: jax.lax.psum(v, axis),
             mesh=mesh,
-            in_specs=P("data"),
+            in_specs=P(axis),
             out_specs=P(),
         )
     )(sharded)
@@ -61,8 +85,8 @@ def main() -> None:
     assert float(synced["b"]) == 0.0  # process 0's values everywhere
 
     # ---- multi-controller learner: PPO SGD nest over the GLOBAL mesh,
-    # each process feeding its local batch shard; gradient pmean spans
-    # hosts (DCN) ----
+    # batch placed via sharding.put_global (each process ships its
+    # local box); gradient pmean spans hosts (DCN) ----
     import gymnasium as gym
 
     from ray_tpu.algorithms.ppo.ppo import PPOJaxPolicy
@@ -71,19 +95,24 @@ def main() -> None:
     obs_space = gym.spaces.Box(-1.0, 1.0, (8,), np.float32)
     act_space = gym.spaces.Discrete(4)
     B = 8  # global rows; 2 per device
-    policy = PPOJaxPolicy(
-        obs_space,
-        act_space,
-        {
-            "_mesh": mesh,
-            "model": {"fcnet_hiddens": [16]},
-            "train_batch_size": B,
-            "sgd_minibatch_size": B,
-            "num_sgd_iter": 1,
-            "lr": 1e-3,
-            "seed": 0,  # identical init on every process
-        },
-    )
+    config = {
+        "_mesh": mesh,
+        "model": {"fcnet_hiddens": [16]},
+        "train_batch_size": B,
+        "sgd_minibatch_size": B,
+        "num_sgd_iter": 1,
+        "lr": 1e-3,
+        "seed": 0,  # identical init on every process
+    }
+    # per-rank AOT cache dir: the first learn step pre-seeds this
+    # rank's shrink geometry (fleet auto pre-seed), which the survivor
+    # later hits at resize — zero fresh compiles
+    aot_root = os.environ.get("RAY_TPU_TEST_AOT_DIR")
+    if aot_root:
+        config["aot_cache_dir"] = os.path.join(
+            aot_root, f"rank{rank}"
+        )
+    policy = PPOJaxPolicy(obs_space, act_space, config)
     data_rng = np.random.default_rng(42)  # same stream on all hosts
     host_batch = {
         SampleBatch.OBS: data_rng.standard_normal((B, 8)).astype(
@@ -104,74 +133,119 @@ def main() -> None:
         ),
     }
     tree, bsize = policy.prepare_batch(SampleBatch(host_batch))
-    # each process contributes its local slice of the global batch
-    local = jax.local_device_count() * (B // jax.device_count())
-    lo = rank * local
+    # every process passes the same global host value; put_global
+    # ships each process's addressable box (the lockstep contract)
     global_batch = {
-        k: jax.make_array_from_process_local_data(
-            policy.data_sharding, v[lo : lo + local]
-        )
+        k: sharding_lib.put_global(v, policy.data_sharding)
         for k, v in tree.items()
     }
     stats = policy.learn_on_device_batch(global_batch, bsize)
     assert np.isfinite(stats["total_loss"]), stats
     # identical data + params + lockstep pmean => identical loss
-    kv.put(f"loss_{rank}", stats["total_loss"])
-    other_loss = kv.get(f"loss_{1 - rank}", timeout=60.0)
+    kv.put(f"fleet_test/loss_{rank}", stats["total_loss"])
+    other_loss = kv.get(f"fleet_test/loss_{1 - rank}", timeout=60.0)
     assert abs(other_loss - stats["total_loss"]) < 1e-5
 
-    # ---- elastic learner fleet: drain host1 on notice, continue on
-    # host0 (the control-plane half of the elastic contract over gloo:
-    # notice → one final lockstep step → the survivor keeps training
-    # on its LOCAL mesh with the drained fleet's weights) ----
-    dist.sync_global("pre_elastic")
+    # ---- elastic resize: provider notice for host1 → coordinator
+    # drains epoch 1 and cuts epoch 2 → one final lockstep superstep →
+    # barrier → host0 rebuilds at the surviving geometry ----
     if rank == 1:
-        # the "eviction notice": host1 announces it is leaving
-        kv.put("preempt_host1", {"grace_s": 60.0})
-    kv.get("preempt_host1", timeout=30.0)  # both observe the notice
+        # the "eviction notice" lands as a provider file (the DIR
+        # source of resilience/provider_notice.py), the agent forwards
+        # it to the coordinator
+        from ray_tpu.resilience import provider_notice
+
+        notice_dir = os.environ.get(
+            provider_notice.NOTICE_DIR_ENV, ""
+        )
+        if notice_dir:
+            with open(
+                os.path.join(notice_dir, "host1"), "w"
+            ) as f:
+                f.write("60.0")  # grace seconds
+            grace = provider_notice.probe(host="host1")
+            assert grace == 60.0, grace
+        agent.announce_notice(reason="preempted")
+    if rank == 0:
+        # driver loop: apply the notice event; handle_notice posts the
+        # drain record and cuts epoch 2
+        import time as _time
+
+        deadline = _time.monotonic() + 60.0
+        while agent.poll_drain(1) is None:
+            coord.reconcile()
+            if _time.monotonic() >= deadline:
+                raise TimeoutError("drain record never posted")
+            _time.sleep(0.05)
+    # the lockstep anchor: every host observes the same drain record
+    # before its next superstep
+    drain = agent.await_drain(1)
+    assert drain["victims"] == ["host1"], drain
     # the drain step: one last lockstep update over the global mesh so
     # the departing host's in-flight contribution is not lost
     drain_stats = policy.learn_on_device_batch(global_batch, bsize)
     assert np.isfinite(drain_stats["total_loss"]), drain_stats
-    kv.put(f"drain_loss_{rank}", drain_stats["total_loss"])
-    other_drain = kv.get(f"drain_loss_{1 - rank}", timeout=60.0)
+    kv.put(f"fleet_test/drain_loss_{rank}", drain_stats["total_loss"])
+    other_drain = kv.get(
+        f"fleet_test/drain_loss_{1 - rank}", timeout=60.0
+    )
     assert abs(other_drain - drain_stats["total_loss"]) < 1e-5
+    agent.barrier("drained", epoch1)
+
     if rank == 1:
-        kv.put("host1_drained", True)
-    else:
-        # host0 survives the shrink: rebuild the learner on its LOCAL
-        # devices (no cross-host collectives) with the fleet's final
-        # weights — params are replicated, so the pull is addressable
-        kv.get("host1_drained", timeout=60.0)
-        from ray_tpu import sharding as sharding_lib
+        # the victim idles out its grace period (no more collectives),
+        # staying up until the survivor finishes so jax.distributed
+        # teardown is orderly
+        agent.leave()
+        kv.get("fleet_test/solo_done", timeout=120.0)
+        agent.stop()
+        print(f"MULTIHOST_OK rank={rank}")
+        return
 
-        local_mesh = sharding_lib.get_mesh(
-            devices=jax.local_devices()
+    # ---- host0 survives the shrink: epoch 2 names it alone; the
+    # resize is a warm-cache restart (PR-10 reshard + pre-seeded AOT) --
+    epoch2 = agent.wait_for_epoch(2)
+    assert epoch2.gen == 2 and epoch2.hosts == ("host0",), epoch2
+    new_mesh = fleet.epoch_mesh(epoch2)  # local devices, no DCN
+    assert len(new_mesh.devices.flat) == 2
+    survivor = fleet.resize_policy(policy, new_mesh)
+    # params bitwise across the reshard (replicated => addressable)
+    w_old, w_new = policy.get_weights(), survivor.get_weights()
+    for k in w_old:
+        for a, b in zip(
+            jax.tree_util.tree_leaves(w_old[k]),
+            jax.tree_util.tree_leaves(w_new[k]),
+        ):
+            assert (
+                np.asarray(a).tobytes() == np.asarray(b).tobytes()
+            ), f"reshard not bitwise: {k}"
+    print("RESHARD_BITWISE_OK")
+    solo_stats = survivor.learn_on_batch(SampleBatch(host_batch))
+    assert np.isfinite(solo_stats["total_loss"]), solo_stats
+    if aot_root:
+        fn = survivor.learn_fn(bsize)
+        assert fn.aot_source == "aot_cache" and fn.traces == 0, (
+            fn.aot_source,
+            fn.traces,
         )
-        survivor = PPOJaxPolicy(
-            obs_space,
-            act_space,
-            {
-                "_mesh": local_mesh,
-                "model": {"fcnet_hiddens": [16]},
-                "train_batch_size": B,
-                "sgd_minibatch_size": B,
-                "num_sgd_iter": 1,
-                "lr": 1e-3,
-                "seed": 0,
-            },
-        )
-        survivor.set_weights(policy.get_weights())
-        solo_stats = survivor.learn_on_batch(
-            SampleBatch(host_batch)
-        )
-        assert np.isfinite(solo_stats["total_loss"]), solo_stats
-        print("ELASTIC_OK survivor continued on local mesh")
+        # the PR-13 ledger agrees: the resized learn program
+        # registered as a cache restore (compile_s=0, no traces),
+        # not a live compile
+        from ray_tpu.telemetry import device as device_ledger
 
-    dist.sync_global("done")
-    alive = kv.alive_nodes()
-    assert f"host{rank}" in alive
-    hb.stop()
+        if device_ledger.enabled():
+            cached = [
+                p
+                for p in device_ledger.snapshot()["programs"]
+                if p["source"] == "aot_cache"
+                and p["executions"] > 0
+            ]
+            assert cached, "no aot_cache ledger row for the resize"
+        print("AOT_RESIZE_HIT zero fresh compiles")
+    print("ELASTIC_OK survivor continued on local mesh")
+    kv.put("fleet_test/solo_done", True)
+    coord.stop()
+    agent.stop()
     print(f"MULTIHOST_OK rank={rank}")
 
 
