@@ -95,6 +95,16 @@ Flags:  --profile       run ONE telemetry-instrumented PPO iteration
                         and the resize wall with a pre-seeded AOT
                         cache vs cold (warm resize = zero fresh
                         compiles); writes benchmarks/e2e/fleet.json
+        --fleetobs      fleet-observability overhead A/B
+                        (docs/observability.md "Fleet view"): the
+                        SAME fixed-seed 2-host lockstep learn, bare
+                        vs with per-host HostExporters + the rank-0
+                        FleetAggregator live — median-step-wall
+                        overhead (budget < 2%), bitwise-identical
+                        per-step losses (hard gate), both hosts
+                        host=-labeled in the merged exposition;
+                        writes
+                        benchmarks/e2e/fleet_observability.json
         --obs           device-ledger overhead A/B
                         (docs/observability.md "device ledger"): the
                         SAME fixed-seed superstep PPO chain with
@@ -2154,6 +2164,276 @@ def bench_fleet(out_path=None):
     return report
 
 
+def bench_fleetobs_worker():
+    """Subprocess entry for the --fleetobs lane (one learner host of a
+    2-host gloo CPU fleet). Same rendezvous → epoch → fixed-seed
+    lockstep-learn protocol as the --fleet lane, but the variable
+    under test is the fleetview plane itself: with
+    ``RAY_TPU_FLEETOBS_ON=1`` every host runs a periodic
+    ``HostExporter`` and rank 0 additionally runs the subscribing
+    ``FleetAggregator`` — the exact coordinator-side topology of
+    docs/observability.md "Fleet view". Each rank prints one
+    ``FLEETOBSBENCH {json}`` line with its step walls and the
+    per-step ``total_loss`` stream (bitwise parity across the A/B is
+    asserted by the driver: observation must not perturb training)."""
+    import os
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import gymnasium as gym
+
+    from ray_tpu import fleet
+    from ray_tpu import sharding as sharding_lib
+    from ray_tpu.algorithms.ppo.ppo import PPOJaxPolicy
+    from ray_tpu.data.sample_batch import SampleBatch
+    from ray_tpu.parallel import distributed as dist
+
+    rank = int(os.environ["RAY_TPU_PROCESS_ID"])
+    world = int(os.environ["RAY_TPU_NUM_PROCESSES"])
+    obs_on = os.environ.get("RAY_TPU_FLEETOBS_ON") == "1"
+    if world > 1:
+        dist.initialize()
+
+    kv = fleet.KVClient(os.environ["RAY_TPU_KV_ADDRESS"])
+    coord = fleet.FleetCoordinator(kv) if rank == 0 else None
+    agent = fleet.HostAgent(
+        kv, f"host{rank}", rank_hint=rank, heartbeat_interval=0.5
+    )
+    agent.join()
+    if rank == 0:
+        coord.wait_for_members(world, timeout=60.0)
+        coord.propose_epoch(reason="bootstrap")
+    epoch1 = agent.wait_for_epoch(1)
+    mesh = fleet.epoch_mesh(epoch1)
+
+    exporter = aggregator = None
+    if obs_on:
+        from ray_tpu.telemetry.fleetview import (
+            FleetAggregator,
+            HostExporter,
+        )
+
+        if rank == 0:
+            aggregator = FleetAggregator(
+                kv=kv, publish_aggregate=False
+            )
+        # short interval so the periodic publish actually fires
+        # several times inside the timed window (the overhead under
+        # measurement is the steady-state one, not a single flush)
+        exporter = HostExporter(kv, f"host{rank}", interval=0.2)
+
+    B = 64
+    config = {
+        "_mesh": mesh,
+        "model": {"fcnet_hiddens": [32, 32]},
+        "train_batch_size": B,
+        "sgd_minibatch_size": 32,
+        "num_sgd_iter": 2,
+        "lr": 3e-4,
+        "seed": 0,
+    }
+    obs_space = gym.spaces.Box(-1.0, 1.0, (16,), np.float32)
+    act_space = gym.spaces.Discrete(4)
+    policy = PPOJaxPolicy(obs_space, act_space, config)
+    rng = np.random.default_rng(7)
+    host = {
+        SampleBatch.OBS: rng.standard_normal((B, 16)).astype(
+            np.float32
+        ),
+        SampleBatch.ACTIONS: rng.integers(0, 4, B).astype(np.int64),
+        SampleBatch.ACTION_LOGP: np.full(B, -1.4, np.float32),
+        SampleBatch.ACTION_DIST_INPUTS: rng.standard_normal(
+            (B, 4)
+        ).astype(np.float32),
+        SampleBatch.ADVANTAGES: rng.standard_normal(B).astype(
+            np.float32
+        ),
+        SampleBatch.VALUE_TARGETS: rng.standard_normal(B).astype(
+            np.float32
+        ),
+    }
+    tree, bsize = policy.prepare_batch(SampleBatch(host))
+    global_batch = {
+        k: sharding_lib.put_global(v, policy.data_sharding)
+        for k, v in tree.items()
+    }
+    policy.learn_on_device_batch(global_batch, bsize)  # compile
+    walls, losses = [], []
+    for _ in range(12):
+        t0 = time.perf_counter()
+        stats = policy.learn_on_device_batch(global_batch, bsize)
+        walls.append(time.perf_counter() - t0)
+        losses.append(float(stats["total_loss"]))
+    steps_per_s = B / float(np.median(walls))
+
+    hosts_in_exposition = []
+    if obs_on:
+        exporter.flush()  # final snapshot so the merge sees this run
+        exporter.stop()
+        if aggregator is not None:
+            deadline = time.monotonic() + 30.0
+            while time.monotonic() < deadline:
+                hosts_in_exposition = aggregator.hosts()
+                if len(hosts_in_exposition) >= world:
+                    break
+                time.sleep(0.1)
+            text = aggregator.merged_exposition()
+            for h in hosts_in_exposition:
+                assert f'host="{h}"' in text, (h, text[:400])
+            aggregator.stop()
+    print(
+        "FLEETOBSBENCH "
+        + json.dumps(
+            {
+                "rank": rank,
+                "fleetobs_on": obs_on,
+                "steps_per_s": round(steps_per_s, 1),
+                "median_step_wall_s": float(np.median(walls)),
+                "losses": losses,
+                "hosts_in_exposition": sorted(hosts_in_exposition),
+            }
+        )
+    )
+    agent.barrier("fleetobs_done", epoch1)
+    agent.stop()
+    if coord is not None:
+        coord.stop()
+
+
+def bench_fleetobs(out_path=None):
+    """Fleet-observability overhead A/B (docs/observability.md "Fleet
+    view"): the SAME fixed-seed 2-host gloo lockstep learn, once bare
+    and once with the full fleetview plane live (per-host periodic
+    ``HostExporter`` + rank-0 subscribing ``FleetAggregator``).
+    Reports
+
+      - aggregator_overhead_pct: median-step-wall delta, budget < 2%;
+      - losses_bitwise_identical: the per-step ``total_loss`` stream
+        must match bit for bit across the A/B on every rank —
+        observation reads training state, never perturbs it;
+      - hosts_in_exposition: both hosts must appear (``host=``-labeled)
+        in the merged exposition produced during the run.
+
+    Writes benchmarks/e2e/fleet_observability.json."""
+    import os
+    import socket
+    import subprocess
+
+    from ray_tpu.fleet import KVServer
+
+    os.makedirs("benchmarks/e2e", exist_ok=True)
+    out_path = out_path or "benchmarks/e2e/fleet_observability.json"
+    world = 2
+
+    def run(obs_on):
+        kv = KVServer(host="127.0.0.1")
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            coord_port = s.getsockname()[1]
+        env_base = {
+            **os.environ,
+            "JAX_PLATFORMS": "cpu",
+            "RAY_TPU_PLATFORM": "cpu",
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
+            "RAY_TPU_NUM_PROCESSES": str(world),
+            "RAY_TPU_KV_ADDRESS": f"127.0.0.1:{kv.port}",
+            "RAY_TPU_COORDINATOR": f"127.0.0.1:{coord_port}",
+            "RAY_TPU_FLEETOBS_ON": "1" if obs_on else "0",
+        }
+        procs = []
+        for rank in range(world):
+            env = {**env_base, "RAY_TPU_PROCESS_ID": str(rank)}
+            procs.append(
+                subprocess.Popen(
+                    [sys.executable, __file__, "--fleetobs-worker"],
+                    env=env,
+                    stdout=subprocess.PIPE,
+                    stderr=subprocess.STDOUT,
+                    text=True,
+                )
+            )
+        outs = []
+        try:
+            for p in procs:
+                out, _ = p.communicate(timeout=300)
+                outs.append(out)
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+            kv.shutdown()
+        recs = {}
+        for rank, (p, out) in enumerate(zip(procs, outs)):
+            if p.returncode != 0:
+                raise RuntimeError(
+                    f"fleetobs bench rank {rank} failed:\n{out}"
+                )
+            for line in out.splitlines():
+                if line.startswith("FLEETOBSBENCH "):
+                    recs[rank] = json.loads(
+                        line[len("FLEETOBSBENCH ") :]
+                    )
+        if len(recs) != world:
+            raise RuntimeError(
+                f"missing FLEETOBSBENCH lines: {sorted(recs)}"
+            )
+        return recs
+
+    off = run(obs_on=False)
+    on = run(obs_on=True)
+
+    overhead_pct = round(
+        100.0
+        * (on[0]["median_step_wall_s"] - off[0]["median_step_wall_s"])
+        / off[0]["median_step_wall_s"],
+        2,
+    )
+    bitwise = all(
+        on[r]["losses"] == off[r]["losses"] for r in range(world)
+    )
+    report = {
+        "metric": "fleet_observability_overhead",
+        "steps_per_s": {
+            "fleetobs_off": off[0]["steps_per_s"],
+            "fleetobs_on": on[0]["steps_per_s"],
+        },
+        "median_step_wall_s": {
+            "fleetobs_off": off[0]["median_step_wall_s"],
+            "fleetobs_on": on[0]["median_step_wall_s"],
+        },
+        "aggregator_overhead_pct": overhead_pct,
+        "overhead_budget_pct": 2.0,
+        "losses_bitwise_identical": bitwise,
+        "hosts_in_exposition": on[0]["hosts_in_exposition"],
+        "config": {
+            "world": world,
+            "devices_per_host": 2,
+            "train_batch_size": 64,
+            "timed_steps": 12,
+            "exporter_interval_s": 0.2,
+            "collectives": "gloo (CPU stand-in for DCN)",
+        },
+        "note": (
+            "the exporter threads publish snapshots on their own "
+            "cadence while the lockstep learn runs; overhead is the "
+            "median per-step wall delta, so one-off flush costs and "
+            "the aggregator's subscriber thread (rank 0 only) are "
+            "both in frame — the bitwise loss check is the hard "
+            "gate, the percentage is the budget headline"
+        ),
+    }
+    if not bitwise:
+        raise RuntimeError(
+            "fleetview observation perturbed training: per-step "
+            "losses differ between fleetobs on/off"
+        )
+    with open(out_path, "w") as f:
+        json.dump(report, f, indent=1)
+    print(json.dumps(report))
+    return report
+
+
 def bench_jax_env(out_path=None, iters=3, n_envs=32, t_rollout=64):
     """Rollout-lane A/B (docs/pipeline.md "two rollout lanes"): the
     SAME JaxVectorEnv (CartPoleJax), same fixed seed, same total env
@@ -3761,6 +4041,12 @@ def main():
         return
     if "--fleet-worker" in sys.argv:
         bench_fleet_worker()
+        return
+    if "--fleetobs-worker" in sys.argv:
+        bench_fleetobs_worker()
+        return
+    if "--fleetobs" in sys.argv:
+        bench_fleetobs()
         return
     if "--fleet" in sys.argv:
         bench_fleet()

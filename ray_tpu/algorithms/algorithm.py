@@ -416,14 +416,42 @@ class Algorithm(Trainable):
             # it yet) fall back to the previous, now-settled window —
             # `window_iterations_ago` says which one this is.
             spans = tracing.get_spans()
+            # late-harvest accounting (fleetview satellite): a span
+            # first seen THIS iteration whose interval ended before a
+            # window opened missed that window's roll-up entirely —
+            # credit its full duration to the window we report now
+            # instead of dropping it (late_stage_times)
+            seen = getattr(self, "_rollup_seen_span_ids", frozenset())
+            fresh = [
+                s for s in spans if s.get("span_id") not in seen
+            ]
+            self._rollup_seen_span_ids = frozenset(
+                s.get("span_id") for s in spans
+            )
+            # spans from before the first window ever rolled up (worker
+            # init, compile warmup) belong to NO window — not late
+            first = getattr(self, "_first_window_start", None)
+            if first is None:
+                self._first_window_start = first = t0
+
+            def _late_for(window_start):
+                out = []
+                for s in fresh:
+                    end = s.get("end") or s.get("start")
+                    if end is None:
+                        continue
+                    if first <= end <= window_start:
+                        out.append(s)
+                return out
+
             rollup = telemetry_lib.iteration_rollup(
-                spans, t0, t_train_end
+                spans, t0, t_train_end, late=_late_for(t0)
             )
             lag = 0
             prev = getattr(self, "_prev_iter_window", None)
             if rollup["sample_s"] == 0.0 and prev is not None:
                 settled = telemetry_lib.iteration_rollup(
-                    spans, *prev
+                    spans, *prev, late=_late_for(prev[0])
                 )
                 if settled["sample_s"] > 0.0:
                     rollup, lag = settled, 1

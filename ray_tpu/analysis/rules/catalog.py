@@ -16,12 +16,17 @@ This rule makes the doc the enforced source of truth:
   every tag key must appear on the doc line(s) that mention the
   family (the catalog table row documents the label set — a tag the
   row doesn't name is an undocumented cardinality axis);
-- every literal span name opened via ``start_span("...")`` must be
-  documented: the full name appears in the doc, a documented
-  ``prefix:*`` glob covers it, or it starts with a stage prefix of
-  ``telemetry/rollup.py``'s ``STAGE_PREFIXES`` map (when that module
-  is in the scan). Dynamic names (``"jit:" + label``) are checked by
-  their constant prefix.
+- every literal span name opened via ``start_span("...")`` or
+  ``context_span(ctx, "...")`` must be documented: the full name
+  appears in the doc, a documented ``prefix:*`` glob covers it, or it
+  starts with a stage prefix of ``telemetry/rollup.py``'s
+  ``STAGE_PREFIXES`` map (when that module is in the scan). Dynamic
+  names (``"jit:" + label``) are checked by their constant prefix;
+- fleet-scoped families (``ray_tpu_fleet_*`` / ``ray_tpu_kv_*``) must
+  additionally name the ``host`` label in their catalog row: every
+  fleet-plane series is host-attributed — either tagged at the source
+  or ``host=``-injected by the fleetview aggregator — and a row that
+  doesn't say so misdocuments the merged exposition's cardinality.
 
 The doc is read once per scan; with no ``docs/observability.md``
 under the scan root the rule is silent (fixture scans anchor
@@ -41,11 +46,15 @@ from ray_tpu.analysis.rules._common import call_name, keyword
 RULE_ID = "RTA010"
 
 _FAMILY_RE = re.compile(r"^ray_tpu_[a-z0-9_]+$")
+# fleet-plane families: their doc rows must name the `host` label
+_HOST_SCOPED_RE = re.compile(r"^ray_tpu_(fleet|kv)_")
 _INSTRUMENT_CTORS = {
     "Counter", "Gauge", "Histogram", "counter", "gauge", "histogram",
     "timer_histogram", "get_metric",
 }
-_SPAN_OPENERS = {"start_span"}
+# opener -> index of the span-name argument (context_span takes the
+# propagated context first, the name second)
+_SPAN_OPENERS = {"start_span": 0, "context_span": 1}
 
 
 def _doc(program) -> Optional[str]:
@@ -143,7 +152,8 @@ def check_program(program) -> List[Finding]:
                         if isinstance(tgt, ast.Name):
                             consts[tgt.id] = (val, node.value)
         for name, (val, node) in consts.items():
-            if not family_rows(val):
+            rows = family_rows(val)
+            if not rows:
                 add(
                     m,
                     node,
@@ -151,6 +161,19 @@ def check_program(program) -> List[Finding]:
                     "docs/observability.md — add a catalog row (the "
                     "doc is the enforced source of truth for "
                     "dashboards)",
+                )
+            elif _HOST_SCOPED_RE.match(val) and "host" not in " ".join(
+                rows
+            ):
+                add(
+                    m,
+                    node,
+                    f"fleet-plane family `{val}` has a catalog row "
+                    "that never mentions the `host` label — every "
+                    "ray_tpu_fleet_*/ray_tpu_kv_* series is "
+                    "host-attributed in the merged exposition "
+                    "(tagged at the source or injected by the "
+                    "fleetview aggregator); document it",
                 )
 
         # instrument constructions: name + tag_keys
@@ -180,6 +203,20 @@ def check_program(program) -> List[Finding]:
                     "docs/observability.md — add a catalog row",
                 )
                 continue
+            if (
+                isinstance(arg, ast.Constant)
+                and _HOST_SCOPED_RE.match(family)
+                and "host" not in " ".join(rows)
+            ):
+                # literal ctor names never went through the
+                # module-const check above — same host-label contract
+                add(
+                    m,
+                    node,
+                    f"fleet-plane family `{family}` has a catalog "
+                    "row that never mentions the `host` label — "
+                    "document it (merged-exposition cardinality)",
+                )
             tags = keyword(node, "tag_keys")
             if tags is None:
                 continue
@@ -224,9 +261,10 @@ def check_program(program) -> List[Finding]:
         for node in ast.walk(m.tree):
             if not isinstance(node, ast.Call) or not node.args:
                 continue
-            if call_name(node).split(".")[-1] not in _SPAN_OPENERS:
+            idx = _SPAN_OPENERS.get(call_name(node).split(".")[-1])
+            if idx is None or len(node.args) <= idx:
                 continue
-            lit = _literal_prefix(node.args[0])
+            lit = _literal_prefix(node.args[idx])
             if lit is None:
                 continue
             text, is_full = lit

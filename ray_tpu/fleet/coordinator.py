@@ -76,6 +76,13 @@ def barrier_key(gen: int, name: str, host: str) -> str:
 CH_JOIN = "fleet/join"
 CH_LEAVE = "fleet/leave"
 CH_NOTICE = "fleet/notice"
+# barrier-arrival events for the fleetview aggregator
+# (telemetry/fleetview.py): each host publishes its own arrival stamp
+# the moment it reaches an epoch-scoped barrier, so straggler
+# attribution sees every arrival even though the KV store has no key
+# listing. The record carries the epoch's host tuple — the aggregator
+# knows the expected arrival count without a KV read.
+CH_BARRIER = "fleet/barrier_arrival"
 
 HEARTBEAT_ENV = "RAY_TPU_FLEET_HEARTBEAT_S"
 HORIZON_ENV = "RAY_TPU_FLEET_LIVENESS_HORIZON_S"
@@ -461,9 +468,27 @@ class HostAgent:
             if timeout is not None
             else _env_s(BARRIER_TIMEOUT_ENV, 60.0)
         )
+        arrived_at = time.time()
         self.kv.put(
-            barrier_key(epoch.gen, name, self.host), time.time()
+            barrier_key(epoch.gen, name, self.host), arrived_at
         )
+        # fleetview feed: the same arrival as a pubsub event, so the
+        # aggregator attributes barrier wait/straggler per host
+        # without polling barrier keys (best-effort — a fleet without
+        # an aggregator just publishes into the void)
+        try:
+            self.kv.publish(
+                CH_BARRIER,
+                {
+                    "gen": epoch.gen,
+                    "name": name,
+                    "host": self.host,
+                    "hosts": list(epoch.hosts),
+                    "ts": arrived_at,
+                },
+            )
+        except Exception:
+            pass
         deadline = time.monotonic() + timeout
         for peer in epoch.hosts:
             if peer == self.host:

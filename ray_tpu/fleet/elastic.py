@@ -66,8 +66,28 @@ def resize_policy(policy, new_mesh):
     where the old geometry stopped. With an AOT cache configured and
     pre-seeded (``preseed_resize``), the twin's first learn step
     installs a cached executable: zero fresh compiles."""
+    import time
+
+    from ray_tpu.telemetry import fleetview
+    from ray_tpu.util import tracing
+
+    # collective drain point + recovery-lane span: every survivor
+    # resizes in lockstep, so the fleet aggregator can name the host
+    # that finished re-homing last (telemetry/fleetview.py)
+    t0 = time.time()
     twin = shadow_policy(policy, new_mesh)
     twin.set_state(policy.get_state())
+    fleetview.record_arrival("resize")
+    tracing.record_span(
+        "recovery:resize",
+        t0,
+        time.time(),
+        devices=int(
+            getattr(
+                getattr(new_mesh, "devices", None), "size", 0
+            )
+        ),
+    )
     return twin
 
 

@@ -206,6 +206,16 @@ class _KVHandler(socketserver.StreamRequestHandler):
                 with store.lock:
                     store.heartbeats[req["node"]] = time.time()
                 self.wfile.write(b'{"ok": true}\n')
+            elif op == "clock":
+                # the fleet's reference clock: the KV server runs on
+                # the coordinator host, so this one stamp is what the
+                # fleetview skew handshake corrects every host toward
+                self.wfile.write(
+                    json.dumps(
+                        {"ok": True, "ts": time.time()}
+                    ).encode()
+                    + b"\n"
+                )
             elif op == "nodes":
                 horizon = req.get("horizon", 30.0)
                 now = time.time()
@@ -389,6 +399,15 @@ class KVClient:
     def heartbeat(self, node: str) -> None:
         self._roundtrip({"op": "heartbeat", "node": node})
 
+    def server_clock(self) -> float:
+        """One ``time.time()`` stamp read off the KV server (the
+        coordinator host's clock) — the reference frame of the
+        fleetview skew correction."""
+        resp = self._roundtrip({"op": "clock"})
+        if not resp.get("ok"):
+            raise RuntimeError("kv clock op rejected")
+        return float(resp["ts"])
+
     def alive_nodes(self, horizon: float = 30.0) -> Dict[str, float]:
         return self._roundtrip({"op": "nodes", "horizon": horizon})[
             "alive"
@@ -471,12 +490,18 @@ class Subscriber:
 
 
 class HeartbeatReporter:
-    """Background liveness pings (the gcs_heartbeat_manager role)."""
+    """Background liveness pings (the gcs_heartbeat_manager role).
+
+    Each ping doubles as a transport-health probe: the measured KV
+    round trip lands in ``ray_tpu_kv_rtt_seconds{host}`` (readable via
+    ``last_rtt_s`` too), which the fleetview exporter publishes with
+    the rest of the host's snapshot (docs/observability.md)."""
 
     def __init__(self, client: KVClient, node: str, interval: float = 5.0):
         self.client = client
         self.node = node
         self.interval = interval
+        self.last_rtt_s: Optional[float] = None
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._run, daemon=True)
         self._thread.start()
@@ -484,7 +509,12 @@ class HeartbeatReporter:
     def _run(self):
         while not self._stop.wait(self.interval):
             try:
+                t0 = time.monotonic()
                 self.client.heartbeat(self.node)
+                self.last_rtt_s = time.monotonic() - t0
+                from ray_tpu.telemetry import metrics as _tm
+
+                _tm.set_kv_rtt(self.node, self.last_rtt_s)
             except Exception:
                 pass
 

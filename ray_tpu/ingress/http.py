@@ -19,7 +19,9 @@ Protocol (docs/serving.md "the front door"):
 - ``GET /healthz`` → liveness + per-policy router/admission summary;
 - ``GET /metrics`` → the process Prometheus exposition
   (``utils.metrics_exporter.format_prometheus``), so one scrape covers
-  ingress, router, serve, and device-ledger families.
+  ingress, router, serve, and device-ledger families — or, when a
+  fleet aggregator is installed (``telemetry.fleetview.install``), the
+  MERGED fleet exposition with a ``host=`` label on every series.
 
 Deployments resolve through the EXISTING serve machinery:
 :meth:`PolicyIngress.serve_deployment` wraps a named
@@ -35,6 +37,7 @@ import asyncio
 import json
 import threading
 import time
+import uuid
 from typing import Any, Dict, Optional, Tuple
 
 import numpy as np
@@ -46,6 +49,15 @@ from ray_tpu.ingress.router import (
     NoReplicasAvailable,
 )
 from ray_tpu.telemetry import metrics as telemetry_metrics
+from ray_tpu.util import tracing
+
+# cross-service trace propagation (docs/observability.md "Fleet
+# view"): a client may hand us a trace id in this header; when tracing
+# is on and none arrives, the ingress mints one. Either way the id is
+# echoed in the response and carried through router batch formation to
+# the replica, so ingress:request → router:dispatch → serve:batch
+# stitch into ONE trace across processes.
+TRACE_HEADER = "x-ray-tpu-trace"
 
 _REASONS = {
     200: "OK",
@@ -237,7 +249,7 @@ class PolicyIngress:
                     break
                 method, path, headers, body = request
                 status, extra_headers, payload = await self._dispatch(
-                    method, path, body
+                    method, path, body, headers=headers
                 )
                 keep_alive = (
                     headers.get("connection", "").lower() != "close"
@@ -295,11 +307,20 @@ class PolicyIngress:
 
     # -- shared dispatch (socket server AND the ASGI app) ----------------
 
-    async def _dispatch(self, method: str, path: str, body: bytes):
+    async def _dispatch(
+        self,
+        method: str,
+        path: str,
+        body: bytes,
+        headers: Optional[Dict[str, str]] = None,
+    ):
         """Route one request. Returns ``(status, extra_headers,
-        payload_bytes)``; never raises (a handler bug answers 500)."""
+        payload_bytes)``; never raises (a handler bug answers 500).
+        ``headers`` carries lowercase-keyed request headers (both the
+        socket parser and the ASGI adapter normalize to this)."""
         t0 = time.perf_counter()
         route = "other"
+        trace_id = (headers or {}).get(TRACE_HEADER) or None
         try:
             if path == "/healthz":
                 route = "healthz"
@@ -319,11 +340,19 @@ class PolicyIngress:
                         405, "POST required"
                     )
                 else:
+                    if trace_id is None and tracing.is_enabled():
+                        trace_id = uuid.uuid4().hex[:16]
                     (
                         status,
                         headers,
                         payload,
-                    ) = await self._handle_actions(name, body)
+                    ) = await self._handle_actions(
+                        name, body, trace_id=trace_id
+                    )
+                    if trace_id is not None:
+                        headers = list(headers) + [
+                            (TRACE_HEADER, trace_id)
+                        ]
             else:
                 status, headers, payload = self._error(
                     404, f"no route {path!r}"
@@ -336,7 +365,12 @@ class PolicyIngress:
         )
         return status, headers, payload
 
-    async def _handle_actions(self, name: str, body: bytes):
+    async def _handle_actions(
+        self,
+        name: str,
+        body: bytes,
+        trace_id: Optional[str] = None,
+    ):
         entry = self._policies.get(name)
         if entry is None:
             return self._error(404, f"no policy {name!r}")
@@ -355,35 +389,53 @@ class PolicyIngress:
             if deadline_ms is not None
             else None
         )
-        decision = admission.try_admit(deadline_s)
-        if decision is not None:
-            return self._shed_response(decision)
-        try:
-            fut = router.submit(
-                obs, explore=explore, deadline_s=deadline_s
-            )
-            timeout = (
-                deadline_s
-                if deadline_s is not None
-                else self.default_timeout_s
-            )
-            row = await asyncio.wait_for(
-                asyncio.wrap_future(fut), timeout=timeout + 0.25
-            )
-        except DeadlineExpired as e:
-            return self._error(504, str(e))
-        except asyncio.TimeoutError:
-            return self._error(504, "deadline exceeded awaiting result")
-        except NoReplicasAvailable as e:
-            return (
-                503,
-                [("Retry-After", "1")],
-                json.dumps({"error": str(e)}).encode(),
-            )
-        except Exception as e:
-            return self._error(500, repr(e))
-        finally:
-            admission.release()
+        # one ingress:request span per admitted request, on the
+        # client's trace when a header arrived (context_span) — its
+        # injected context rides the router request through batch
+        # formation so the replica's serve:batch span stitches under it
+        ctx = (
+            {"trace_id": trace_id, "parent_span_id": None}
+            if trace_id is not None
+            else None
+        )
+        with tracing.context_span(
+            ctx, "ingress:request", policy=name
+        ):
+            decision = admission.try_admit(deadline_s)
+            if decision is not None:
+                return self._shed_response(decision)
+            trace_ctx = tracing.inject_context()
+            try:
+                fut = router.submit(
+                    obs,
+                    explore=explore,
+                    deadline_s=deadline_s,
+                    trace=trace_ctx,
+                )
+                timeout = (
+                    deadline_s
+                    if deadline_s is not None
+                    else self.default_timeout_s
+                )
+                row = await asyncio.wait_for(
+                    asyncio.wrap_future(fut), timeout=timeout + 0.25
+                )
+            except DeadlineExpired as e:
+                return self._error(504, str(e))
+            except asyncio.TimeoutError:
+                return self._error(
+                    504, "deadline exceeded awaiting result"
+                )
+            except NoReplicasAvailable as e:
+                return (
+                    503,
+                    [("Retry-After", "1")],
+                    json.dumps({"error": str(e)}).encode(),
+                )
+            except Exception as e:
+                return self._error(500, repr(e))
+            finally:
+                admission.release()
         return (
             200,
             [],
@@ -430,7 +482,19 @@ class PolicyIngress:
     def _metrics(self):
         from ray_tpu.utils.metrics_exporter import format_prometheus
 
-        return 200, [], format_prometheus().encode()
+        # a process hosting the fleet aggregator serves the MERGED
+        # (host-labeled) fleet exposition from the same scrape route;
+        # everyone else serves the process-local one
+        text = None
+        try:
+            from ray_tpu.telemetry import fleetview
+
+            text = fleetview.render_installed()
+        except Exception:
+            text = None
+        if text is None:
+            text = format_prometheus()
+        return 200, [], text.encode()
 
     @staticmethod
     def _error(status: int, message: str):
@@ -469,9 +533,13 @@ class PolicyIngress:
                 body += msg.get("body", b"")
                 if not msg.get("more_body"):
                     break
+            req_headers = {
+                k.decode("latin1").lower(): v.decode("latin1")
+                for k, v in scope.get("headers") or ()
+            }
             status, extra_headers, payload = await ingress._dispatch(
                 scope.get("method", "GET"), scope.get("path", "/"),
-                body,
+                body, headers=req_headers,
             )
             headers = [
                 (b"content-type", b"application/json"),

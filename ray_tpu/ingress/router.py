@@ -67,14 +67,20 @@ class LocalReplica:
     the determinism anchor); ``finish`` blocks for the results on a
     dispatch-pool thread."""
 
+    # trace contexts flow through to BatchedPolicyServer.submit_many
+    # (the serve:batch span joins the request's trace)
+    accepts_trace = True
+
     def __init__(self, server, name: str = "local"):
         # accept a PolicyDeployment transparently
         self.server = getattr(server, "server", server)
         self.name = name
         self.dead = False
 
-    def begin(self, rows: Sequence[Any], explore):
-        return self.server.submit_many(rows, explore=explore)
+    def begin(self, rows: Sequence[Any], explore, trace=None):
+        return self.server.submit_many(
+            rows, explore=explore, trace=trace
+        )
 
     def finish(self, token, timeout_s: float) -> List[Dict[str, Any]]:
         out = []
@@ -189,14 +195,27 @@ def _safe_resolve(fut: Future, value) -> None:
 
 
 class _RouterRequest:
-    __slots__ = ("obs", "explore", "deadline", "future", "t_submit")
+    __slots__ = (
+        "obs",
+        "explore",
+        "deadline",
+        "future",
+        "t_submit",
+        "trace",
+    )
 
-    def __init__(self, obs, explore, deadline, future, t_submit):
+    def __init__(
+        self, obs, explore, deadline, future, t_submit, trace=None
+    ):
         self.obs = obs
         self.explore = explore
         self.deadline = deadline
         self.future = future
         self.t_submit = t_submit
+        # trace context ({"trace_id", "parent_span_id"}) riding batch
+        # formation: the bucket's dispatch span joins the trace of its
+        # FIRST request (docs/observability.md "Fleet view")
+        self.trace = trace
 
 
 class CoalescingRouter:
@@ -279,12 +298,15 @@ class CoalescingRouter:
         obs,
         explore: Optional[bool] = None,
         deadline_s: Optional[float] = None,
+        trace: Optional[Dict[str, Any]] = None,
     ) -> Future:
         """Enqueue one observation; returns a ``concurrent.futures``
         Future resolving to ``{"action", "params_version", ...}`` (or
         raising :class:`DeadlineExpired` / :class:`NoReplicasAvailable`).
         ``deadline_s`` is relative; expired requests are dropped
-        before dispatch, never computed."""
+        before dispatch, never computed. ``trace`` is an optional
+        tracing context (``tracing.inject_context()``) the bucket's
+        downstream spans stitch under."""
         if self._stop.is_set():
             raise RuntimeError("router is stopped")
         now = time.perf_counter()
@@ -297,6 +319,7 @@ class CoalescingRouter:
             now + deadline_s if deadline_s is not None else None,
             fut,
             now,
+            trace,
         )
         with self._cv:
             self._queue.append(req)
@@ -428,10 +451,19 @@ class CoalescingRouter:
                 _safe_reject(req.future, err)
             return
         explore = batch[0].explore
+        trace = batch[0].trace
         rows = [req.obs for req in batch]
         t0 = time.perf_counter()
         try:
-            token = replica.begin(rows, explore)
+            # replicas opt into trace pass-through via accepts_trace
+            # (LocalReplica does); the bare (rows, explore) protocol
+            # stays valid for custom replica clients
+            if trace is not None and getattr(
+                replica, "accepts_trace", False
+            ):
+                token = replica.begin(rows, explore, trace=trace)
+            else:
+                token = replica.begin(rows, explore)
         except Exception:
             replica.dead = True
             self._requeue(batch)
@@ -461,8 +493,14 @@ class CoalescingRouter:
         wedged replica routes the bucket back through the queue onto
         a survivor."""
         try:
-            with tracing.start_span(
-                "router:dispatch", rows=len(batch), replica=replica.name
+            # joins the trace of the bucket's first request (the
+            # ingress:request span), falling back to a fresh span for
+            # untraced submissions
+            with tracing.context_span(
+                getattr(batch[0], "trace", None),
+                "router:dispatch",
+                rows=len(batch),
+                replica=replica.name,
             ):
                 results = replica.finish(
                     token, self.dispatch_timeout_s

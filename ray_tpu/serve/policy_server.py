@@ -168,9 +168,18 @@ class ServeFuture:
 
 
 class _Request:
-    __slots__ = ("obs", "explore", "future", "t_submit", "flush")
+    __slots__ = (
+        "obs",
+        "explore",
+        "future",
+        "t_submit",
+        "flush",
+        "trace",
+    )
 
-    def __init__(self, obs, explore, future, t_submit, flush=False):
+    def __init__(
+        self, obs, explore, future, t_submit, flush=False, trace=None
+    ):
         self.obs = obs
         self.explore = explore
         self.future = future
@@ -179,6 +188,10 @@ class _Request:
         # batcher drains immediately instead of waiting out the batch
         # timeout for rows that are not coming
         self.flush = flush
+        # trace context riding batch formation: the serve:batch span
+        # joins the trace of its first traced request, so an ingress
+        # request's spans stitch end to end
+        self.trace = trace
 
 
 class BatchedPolicyServer:
@@ -337,14 +350,22 @@ class BatchedPolicyServer:
             )
         return obs
 
-    def submit(self, obs, explore: Optional[bool] = None) -> ServeFuture:
+    def submit(
+        self,
+        obs,
+        explore: Optional[bool] = None,
+        trace: Optional[Dict[str, Any]] = None,
+    ) -> ServeFuture:
         """Enqueue ONE observation; returns its future. No flush hint:
         singleton submits rely on the batcher's timeout coalescing
         (the PR-9 continuous-batching contract)."""
-        return self._enqueue([obs], explore, flush=False)[0]
+        return self._enqueue([obs], explore, flush=False, trace=trace)[0]
 
     def submit_many(
-        self, obs_rows, explore: Optional[bool] = None
+        self,
+        obs_rows,
+        explore: Optional[bool] = None,
+        trace: Optional[Dict[str, Any]] = None,
     ) -> List[ServeFuture]:
         """Enqueue a pre-coalesced run of observations ATOMICALLY (one
         lock acquisition, one batcher wakeup): the ingress router's
@@ -354,10 +375,10 @@ class BatchedPolicyServer:
         router-formed bucket turns into exactly one forward (plus
         whatever was already queued, which can only round UP to a
         bigger warm bucket, never retrace)."""
-        return self._enqueue(obs_rows, explore, flush=True)
+        return self._enqueue(obs_rows, explore, flush=True, trace=trace)
 
     def _enqueue(
-        self, obs_rows, explore, flush: bool
+        self, obs_rows, explore, flush: bool, trace=None
     ) -> List[ServeFuture]:
         if self._stop.is_set():
             raise RuntimeError("policy server is stopped")
@@ -375,6 +396,7 @@ class BatchedPolicyServer:
                     ServeFuture(),
                     now,
                     flush=flush and i == len(obs_rows) - 1,
+                    trace=trace,
                 )
             )
         with self._cv:
@@ -724,8 +746,19 @@ class BatchedPolicyServer:
         n = len(batch)
         explore = batch[0].explore
         version = self.params_version
-        with tracing.start_span(
-            "serve:batch", rows=n, version=version
+        # the forward's span joins the trace of the batch's first
+        # traced request (ingress→router→replica stitching); untraced
+        # batches keep their own fresh span as before
+        trace = next(
+            (
+                r.trace
+                for r in batch
+                if getattr(r, "trace", None) is not None
+            ),
+            None,
+        )
+        with tracing.context_span(
+            trace, "serve:batch", rows=n, version=version
         ):
             try:
                 if self.fused:

@@ -98,6 +98,28 @@ def leaf_sharding(x, mesh: Mesh) -> NamedSharding:
     return replicated(mesh)
 
 
+# lazily-bound telemetry.fleetview module; the cross-process put_global
+# path stamps a collective drain-point arrival there so the fleet
+# aggregator can attribute which host reached the placement last
+# (record_arrival is one flag check when no exporter runs)
+_FLEETVIEW = None
+
+
+def _note_collective_arrival(point: str) -> None:
+    global _FLEETVIEW
+    if _FLEETVIEW is None:
+        try:
+            from ray_tpu.telemetry import fleetview
+
+            _FLEETVIEW = fleetview
+        except Exception:  # telemetry must never break placement
+            return
+    try:
+        _FLEETVIEW.record_arrival(point)
+    except Exception:
+        pass
+
+
 @functools.lru_cache(maxsize=128)
 def mesh_spans_processes(mesh: Mesh) -> bool:
     """Whether this mesh's devices live in more than one jax process —
@@ -127,6 +149,10 @@ def put_global(x, sharding: NamedSharding):
     mesh = getattr(sharding, "mesh", None)
     if mesh is None or not mesh_spans_processes(mesh):
         return jax.device_put(x, sharding)
+    # collective drain point: every process reaches this placement in
+    # lockstep, so the arrival stamp lets the fleet aggregator name
+    # the straggler (telemetry/fleetview.py)
+    _note_collective_arrival("put_global")
     import numpy as np
 
     arr = np.asarray(x)

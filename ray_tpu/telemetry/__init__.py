@@ -33,11 +33,23 @@ from ray_tpu.telemetry.runtime import (  # noqa: F401
     runtime,
 )
 
+# imported last: fleetview pulls in tracing + the metric catalog above
+# (its fleet/kv imports stay lazy, inside methods, to avoid a package
+# cycle with ray_tpu.fleet)
+from ray_tpu.telemetry import fleetview  # noqa: E402,F401
+from ray_tpu.telemetry.fleetview import (  # noqa: E402,F401
+    FleetAggregator,
+    HostExporter,
+)
+
 __all__ = [
+    "FleetAggregator",
+    "HostExporter",
     "TelemetryRuntime",
     "STAGE_PREFIXES",
     "device",
     "enabled",
+    "fleetview",
     "init",
     "init_from_config",
     "intersect",

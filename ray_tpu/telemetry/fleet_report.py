@@ -1,0 +1,237 @@
+"""Fleet report: render the merged fleet-observability snapshot.
+
+::
+
+    python -m ray_tpu.telemetry.fleet_report --kv HOST:PORT [--json]
+    python -m ray_tpu.telemetry.fleet_report --dump aggregate.json
+
+Two sources:
+
+- ``--kv`` connects to a live fleet KV server. It prefers the
+  aggregator's periodically-written digest (``fleetview/aggregate``,
+  refreshed by a running :class:`~ray_tpu.telemetry.fleetview
+  .FleetAggregator`), which carries barrier walls and straggler
+  attribution; when no aggregator is running it falls back to reading
+  the fleet member list and each host's ``fleetview/host/<host>``
+  snapshot directly (health + skew + MFU, no barrier history).
+- ``--dump`` renders a JSON file previously written from
+  :meth:`FleetAggregator.report_data` (post-mortem).
+
+Sections: per-host health (snapshot age vs the staleness horizon, seq,
+clock offset, KV RTT, ledger MFU), barrier/collective walls (who the
+last arriver was, how long everyone else stood waiting), and the
+epoch history read from the coordinator's immutable epoch records.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Any, Dict, List, Optional
+
+
+def _snapshot_to_host_row(
+    host: str, snap: Dict[str, Any]
+) -> Dict[str, Any]:
+    """Shape a raw fleetview/host/<host> snapshot like one row of
+    FleetAggregator.report_data()['hosts'] (age unknown: the KV has no
+    receive stamp, so we report the sender's own publish time)."""
+    from ray_tpu.telemetry import fleetview, metrics as tm
+
+    ledger = snap.get("ledger") or {}
+    totals = ledger.get("totals") or {}
+    return {
+        "host": host,
+        "seq": snap.get("seq"),
+        "age_s": None,
+        "publish_ts": snap.get("ts"),
+        "clock_offset_s": snap.get("clock_offset_s"),
+        "rtt_s": snap.get("rtt_s"),
+        "mfu": totals.get("mfu"),
+        "kv_rtt_s": fleetview._family_value(
+            snap, tm.KV_RTT_SECONDS
+        ),
+        "spans_buffered": len(snap.get("spans") or ()),
+    }
+
+
+def _epoch_history(client, max_epochs: int = 20) -> List[Dict]:
+    """Walk the coordinator's immutable epoch records back from the
+    latest generation pointer."""
+    from ray_tpu.fleet.coordinator import K_EPOCH_PTR, epoch_key
+
+    out: List[Dict] = []
+    try:
+        gen = client.get(K_EPOCH_PTR, timeout=2.0)
+    except KeyError:
+        return out
+    if not gen:
+        return out
+    lo = max(1, int(gen) - max_epochs + 1)
+    for g in range(int(gen), lo - 1, -1):
+        try:
+            rec = client.get(epoch_key(g), timeout=2.0)
+        except KeyError:
+            continue
+        if isinstance(rec, dict):
+            out.append(rec)
+    return out
+
+
+def build_report(
+    kv: Optional[str] = None,
+    dump: Optional[str] = None,
+    token: Optional[str] = None,
+) -> Dict[str, Any]:
+    if dump:
+        with open(dump) as f:
+            report = json.load(f)
+        report.setdefault("source", f"dump:{dump}")
+        return report
+    if not kv:
+        raise ValueError("need --kv HOST:PORT or --dump FILE")
+    from ray_tpu.fleet.coordinator import K_MEMBERS
+    from ray_tpu.fleet.kv import KVClient
+    from ray_tpu.telemetry import fleetview
+
+    address = kv if ":" in kv else f"127.0.0.1:{kv}"
+    client = KVClient(address, token=token)
+    try:
+        agg = client.get(fleetview.K_AGGREGATE, timeout=2.0)
+    except KeyError:
+        agg = None
+    if isinstance(agg, dict) and agg.get("hosts") is not None:
+        report = dict(agg, source=f"kv:{kv} (aggregator)")
+    else:
+        # no aggregator running: read host snapshots directly
+        try:
+            members = client.get(K_MEMBERS, timeout=2.0) or {}
+        except KeyError:
+            members = {}
+        hosts = []
+        for h in sorted(members):
+            try:
+                snap = client.get(
+                    fleetview.snapshot_key(h), timeout=2.0
+                )
+            except KeyError:
+                continue
+            if isinstance(snap, dict):
+                hosts.append(_snapshot_to_host_row(h, snap))
+        report = {
+            "source": f"kv:{kv} (direct, no aggregator)",
+            "hosts": hosts,
+            "barriers": [],
+        }
+    report["epochs"] = _epoch_history(client)
+    if report.get("latest_gen") is None and report["epochs"]:
+        report["latest_gen"] = report["epochs"][0].get("gen")
+    return report
+
+
+def _ms(v) -> str:
+    return "-" if v is None else f"{1e3 * float(v):.2f}"
+
+
+def render_text(report: Dict[str, Any]) -> str:
+    out: List[str] = []
+    hosts = report.get("hosts") or []
+    out.append(
+        f"== fleet view: {report.get('source', '?')} "
+        f"({len(hosts)} hosts reporting, "
+        f"gen {report.get('latest_gen', '-')}) =="
+    )
+    out.append("")
+    out.append("-- hosts --")
+    out.append(
+        f"{'host':20s} {'seq':>5s} {'health':>7s} {'age_s':>7s} "
+        f"{'offset_ms':>10s} {'kv_rtt_ms':>10s} {'mfu%':>6s} "
+        f"{'spans':>7s}"
+    )
+    max_age = report.get("max_age_s")
+    for h in hosts:
+        age = h.get("age_s")
+        if age is None:
+            health = "?"
+            age_s = "-"
+        else:
+            stale = max_age is not None and age > max_age
+            health = "STALE" if stale else "live"
+            age_s = f"{age:.1f}"
+        mfu = h.get("mfu")
+        kv_rtt = h.get("kv_rtt_s")
+        if kv_rtt is None:
+            kv_rtt = h.get("rtt_s")
+        out.append(
+            f"{str(h.get('host'))[:20]:20s} "
+            f"{str(h.get('seq', '-')):>5s} {health:>7s} "
+            f"{age_s:>7s} {_ms(h.get('clock_offset_s')):>10s} "
+            f"{_ms(kv_rtt):>10s} "
+            f"{(f'{100 * mfu:.2f}' if mfu else '-'):>6s} "
+            f"{str(h.get('spans_buffered', '-')):>7s}"
+        )
+    barriers = report.get("barriers") or []
+    out.append("")
+    out.append(f"-- barrier walls ({len(barriers)}) --")
+    if barriers:
+        out.append(
+            f"{'gen':>4s} {'barrier':28s} {'kind':>10s} "
+            f"{'straggler':20s} {'max_wait_ms':>12s}"
+        )
+    for b in barriers[-20:]:
+        waits = b.get("waits") or {}
+        max_wait = max(waits.values()) if waits else None
+        out.append(
+            f"{str(b.get('gen', '-')):>4s} "
+            f"{str(b.get('name'))[:28]:28s} "
+            f"{str(b.get('kind', '-')):>10s} "
+            f"{str(b.get('straggler'))[:20]:20s} "
+            f"{_ms(max_wait):>12s}"
+        )
+    epochs = report.get("epochs") or []
+    out.append("")
+    out.append(f"-- epoch history ({len(epochs)}) --")
+    for e in epochs:
+        hosts_e = e.get("hosts") or ()
+        out.append(
+            f"gen {e.get('gen')}: {len(hosts_e)} hosts "
+            f"({', '.join(str(h) for h in hosts_e)})"
+        )
+    return "\n".join(out)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m ray_tpu.telemetry.fleet_report",
+        description=__doc__.splitlines()[0],
+    )
+    ap.add_argument(
+        "--kv", help="live fleet KV endpoint, HOST:PORT"
+    )
+    ap.add_argument(
+        "--dump",
+        help="FleetAggregator.report_data() JSON (post-mortem)",
+    )
+    ap.add_argument(
+        "--token",
+        help="KV auth token (default: RAY_TPU_KV_TOKEN env)",
+    )
+    ap.add_argument(
+        "--json", action="store_true", help="emit JSON, not text"
+    )
+    args = ap.parse_args(argv)
+    if not args.kv and not args.dump:
+        ap.error("one of --kv or --dump is required")
+    report = build_report(
+        kv=args.kv, dump=args.dump, token=args.token
+    )
+    if args.json:
+        print(json.dumps(report, indent=1, default=str))
+    else:
+        print(render_text(report))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

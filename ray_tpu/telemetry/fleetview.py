@@ -1,0 +1,873 @@
+"""ray_tpu.telemetry.fleetview — fleet-wide observability over the KV
+plane (docs/observability.md "Fleet view").
+
+PRs 3 and 13 built deep per-process observability; PR 17 made the
+system a fleet of hosts that were each a blind silo. This module is
+the per-node-agent → head aggregation pattern of the reference's
+dashboard (``dashboard/``'s metrics agents reporting to the head),
+reproduced natively on our own KV transport:
+
+- every host runs a :class:`HostExporter`: a periodic publish of its
+  Prometheus registry snapshot, a device-ledger digest, the span
+  segments finished since the last tick, its recent collective
+  drain-point arrivals, and a clock-offset handshake against the
+  coordinator's KV clock (:meth:`KVClient.server_clock`);
+- the coordinator host runs a :class:`FleetAggregator`: it merges the
+  snapshots into ONE Prometheus exposition (``host=`` label on every
+  series — counters SUM on a full-key collision, gauges last-write in
+  sorted host order, histograms merge bucket-wise), renders a
+  skew-corrected fleet chrome timeline (one lane group per host,
+  device lanes included, the tracing child-clamp rule reused per
+  host), and turns barrier/drain-point arrival records into
+  **straggler attribution**:
+  ``ray_tpu_fleet_barrier_wait_seconds{host,epoch}`` +
+  ``ray_tpu_fleet_straggler_total{host}`` plus ``fleet:barrier`` spans
+  naming the last arriver.
+
+Skew model: the exporter measures ``offset = host_clock − kv_clock``
+with an NTP-style midpoint handshake (the KV server runs on the
+coordinator host, so its clock is the fleet's reference frame) and
+ships it with every snapshot; the aggregator maps any host stamp into
+the reference frame as ``t − offset`` before comparing across hosts.
+
+Env knobs: ``RAY_TPU_FLEETVIEW_INTERVAL_S`` exporter cadence (2 s),
+``RAY_TPU_FLEETVIEW_MAX_AGE_S`` snapshot staleness horizon at the
+aggregator (15 s) — a host that stops publishing ages out of the
+merged exposition instead of serving stale series forever.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu.telemetry import metrics as tm
+from ray_tpu.util import tracing
+from ray_tpu.utils import metrics as instruments
+from ray_tpu.utils.metrics_exporter import _fmt_tags
+
+# pubsub channel the exporters publish snapshots on, and the durable
+# per-host key late joiners / the report CLI read
+CH_FLEETVIEW = "fleetview/host"
+# mirrors fleet.coordinator.CH_BARRIER (defined there next to the
+# publisher; duplicated literally to keep this module import-light)
+CH_BARRIER = "fleet/barrier_arrival"
+# the aggregator's own periodically-written digest, for
+# ``python -m ray_tpu.telemetry.fleet_report`` against a live KV
+K_AGGREGATE = "fleetview/aggregate"
+
+INTERVAL_ENV = "RAY_TPU_FLEETVIEW_INTERVAL_S"
+MAX_AGE_ENV = "RAY_TPU_FLEETVIEW_MAX_AGE_S"
+
+# families the aggregator computes itself (rendered from its local
+# registry, skipped in host snapshots so a coordinator that also runs
+# an exporter can't duplicate them)
+AGGREGATOR_FAMILIES = (
+    tm.FLEET_BARRIER_WAIT_SECONDS,
+    tm.FLEET_STRAGGLER_TOTAL,
+    tm.FLEET_HOSTS_REPORTING,
+)
+
+
+def snapshot_key(host: str) -> str:
+    return f"fleetview/host/{host}"
+
+
+def _env_f(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+# -- collective drain-point arrivals (put_global, resize) --------------
+#
+# Hot paths call record_arrival(); it is one flag check until a
+# HostExporter arms it. Under the lockstep contract every host reaches
+# the k-th arrival of a named point together, so (point, index) is a
+# cross-host join key the aggregator can attribute without barriers.
+
+_ARR_ON = False
+_ARR_LOCK = threading.Lock()
+_ARR_RECORDS: "collections.deque" = collections.deque(maxlen=512)
+_ARR_COUNTS: Dict[str, int] = {}
+
+
+def arrivals_on() -> bool:
+    return _ARR_ON
+
+
+def record_arrival(point: str, ts: Optional[float] = None) -> None:
+    """Record this process's arrival at a collective drain point
+    (``put_global`` placement, a resize). No-op (one flag check) when
+    no exporter runs."""
+    if not _ARR_ON:
+        return
+    if ts is None:
+        ts = time.time()
+    with _ARR_LOCK:
+        idx = _ARR_COUNTS.get(point, 0)
+        _ARR_COUNTS[point] = idx + 1
+        _ARR_RECORDS.append(
+            {"point": point, "index": idx, "ts": ts}
+        )
+
+
+def _drain_arrivals() -> List[Dict[str, Any]]:
+    with _ARR_LOCK:
+        out = list(_ARR_RECORDS)
+        _ARR_RECORDS.clear()
+    return out
+
+
+def _reset_arrivals() -> None:
+    with _ARR_LOCK:
+        _ARR_RECORDS.clear()
+        _ARR_COUNTS.clear()
+
+
+# -- snapshot building --------------------------------------------------
+
+
+def registry_snapshot() -> List[Dict[str, Any]]:
+    """Serialize the local metric registry: one dict per family
+    (name / kind / description / boundaries for histograms / series as
+    ``(sorted-tag-items, value)`` pairs), families sorted by name so a
+    snapshot renders byte-stable."""
+    fams: List[Dict[str, Any]] = []
+    for m in instruments.all_metrics():
+        fam: Dict[str, Any] = {
+            "name": m.name,
+            "kind": m.kind,
+            "description": m.description,
+        }
+        if isinstance(m, instruments.Histogram):
+            fam["boundaries"] = list(m.boundaries)
+            fam["series"] = [
+                (list(tags), dict(val)) for tags, val in m.series()
+            ]
+        else:
+            fam["series"] = [
+                (list(tags), val) for tags, val in m.series()
+            ]
+        fams.append(fam)
+    fams.sort(key=lambda f: f["name"])
+    return fams
+
+
+def clock_handshake(kv, samples: int = 3) -> Tuple[float, float]:
+    """NTP-style skew measurement against the KV server's clock.
+    Returns ``(offset_s, rtt_s)`` from the minimum-RTT sample, where
+    ``offset = host_clock − kv_clock`` (positive = this host runs
+    ahead): the server stamp is assumed taken at the midpoint of the
+    round trip, so the offset error is bounded by rtt/2."""
+    best: Optional[Tuple[float, float]] = None
+    for _ in range(max(1, samples)):
+        t0 = time.time()
+        ts = kv.server_clock()
+        t1 = time.time()
+        rtt = t1 - t0
+        off = (t0 + t1) / 2.0 - ts
+        if best is None or rtt < best[1]:
+            best = (off, rtt)
+    return best
+
+
+class HostExporter:
+    """One per host: periodically publish this process's observability
+    snapshot onto the fleet KV plane.
+
+    Each tick measures clock skew (:func:`clock_handshake`), then
+    publishes {metrics registry, device-ledger digest, span segments
+    finished since the last tick, drained collective-arrival records}
+    on :data:`CH_FLEETVIEW` *and* writes it to
+    ``fleetview/host/<host>`` (so late-joining aggregators and the
+    report CLI see the latest state without a subscription).
+
+    ``interval <= 0`` runs no thread — callers drive :meth:`flush`
+    (tests, the bench harness)."""
+
+    def __init__(
+        self,
+        kv,
+        host: str,
+        interval: Optional[float] = None,
+        max_spans_per_tick: int = 2000,
+    ):
+        global _ARR_ON
+        self.kv = kv
+        self.host = host
+        self.interval = (
+            interval
+            if interval is not None
+            else _env_f(INTERVAL_ENV, 2.0)
+        )
+        self.seq = 0
+        self.clock_offset_s = 0.0
+        self.rtt_s = 0.0
+        self._span_watermark = 0.0
+        self._max_spans = int(max_spans_per_tick)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        _ARR_ON = True  # arm the drain-point recorder
+        if self.interval > 0:
+            self._thread = threading.Thread(
+                target=self._run,
+                name="fleetview-exporter",
+                daemon=True,
+            )
+            self._thread.start()
+
+    # ray-tpu: thread=fleetview-exporter
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval):
+            try:
+                self.flush()
+            except Exception:
+                pass  # KV hiccups must not kill the exporter
+
+    def flush(self) -> Dict[str, Any]:
+        """One tick: handshake, snapshot, publish + put. Returns the
+        snapshot (tests/bench call this directly for determinism)."""
+        try:
+            off, rtt = clock_handshake(
+                self.kv, samples=3 if self.seq == 0 else 1
+            )
+            self.clock_offset_s, self.rtt_s = off, rtt
+            tm.set_clock_offset(self.host, off)
+        except Exception:
+            pass
+        snap = self.snapshot()
+        self.kv.put(snapshot_key(self.host), snap)
+        try:
+            self.kv.publish(CH_FLEETVIEW, snap)
+        except Exception:
+            pass
+        self.seq += 1
+        return snap
+
+    def snapshot(self) -> Dict[str, Any]:
+        """Assemble (without publishing) this host's snapshot."""
+        spans: List[Dict[str, Any]] = []
+        if tracing.is_enabled():
+            wm = self._span_watermark
+            for s in tracing.get_spans():
+                end = s.get("end") or s.get("start") or 0.0
+                if end > wm:
+                    spans.append(s)
+            if spans:
+                self._span_watermark = max(
+                    (s.get("end") or s.get("start") or 0.0)
+                    for s in spans
+                )
+                spans = spans[-self._max_spans :]
+        ledger = None
+        try:
+            from ray_tpu.telemetry import device
+
+            if device.enabled():
+                full = device.snapshot()
+                ledger = {
+                    "totals": full.get("totals"),
+                    "peak_flops_per_device": full.get(
+                        "peak_flops_per_device"
+                    ),
+                    "programs": [
+                        {
+                            k: p.get(k)
+                            for k in (
+                                "label",
+                                "executions",
+                                "flops",
+                                "mfu",
+                                "device_time_s",
+                            )
+                        }
+                        for p in full.get("programs", ())
+                    ],
+                }
+        except Exception:
+            ledger = None
+        return {
+            "host": self.host,
+            "seq": self.seq,
+            "ts": time.time(),
+            "clock_offset_s": self.clock_offset_s,
+            "rtt_s": self.rtt_s,
+            "metrics": registry_snapshot(),
+            "spans": spans,
+            "arrivals": _drain_arrivals(),
+            "ledger": ledger,
+        }
+
+    def stop(self) -> None:
+        global _ARR_ON
+        _ARR_ON = False
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=self.interval + 1.0)
+            self._thread = None
+
+
+# -- the aggregator -----------------------------------------------------
+
+
+def _merge_value(kind: str, prev, new):
+    """Cross-host merge on a full-key collision (same family, same
+    complete tag set after host injection): counters SUM (each host
+    counted its own events), gauges LAST-WRITE in sorted host order
+    (a point-in-time reading has no meaningful sum), histograms merge
+    bucket-wise."""
+    if kind == "counter":
+        return prev + new
+    if kind == "histogram" and isinstance(prev, dict):
+        pb, nb = prev.get("buckets", []), new.get("buckets", [])
+        if len(pb) != len(nb):
+            return new
+        return {
+            "buckets": [a + b for a, b in zip(pb, nb)],
+            "sum": prev.get("sum", 0.0) + new.get("sum", 0.0),
+            "count": prev.get("count", 0) + new.get("count", 0),
+        }
+    return new  # gauge (and unknown kinds): last write wins
+
+
+class FleetAggregator:
+    """The coordinator-side half: merge every host's published
+    snapshot into one exposition / one timeline / per-host barrier
+    attribution.
+
+    Runs a :class:`~ray_tpu.fleet.kv.Subscriber` on the fleetview and
+    barrier-arrival channels; the callback only ingests (pure compute
+    + local metric writes under one lock — never a KV round trip with
+    the lock held). :meth:`ingest` / :meth:`ingest_barrier` are also
+    public so tests and offline tools can feed snapshots directly.
+
+    Staleness: a host whose last snapshot is older than ``max_age``
+    is pruned at render time — its series age out of the merged
+    exposition instead of lingering forever after the host left."""
+
+    def __init__(
+        self,
+        kv=None,
+        max_age: Optional[float] = None,
+        subscribe: bool = True,
+        publish_aggregate: bool = True,
+        max_spans_per_host: int = 20000,
+        poll_timeout: float = 1.0,
+    ):
+        self.kv = kv
+        self.max_age = (
+            max_age
+            if max_age is not None
+            else _env_f(MAX_AGE_ENV, 15.0)
+        )
+        self.publish_aggregate = publish_aggregate and kv is not None
+        self.max_spans_per_host = int(max_spans_per_host)
+        self._lock = threading.Lock()
+        self._snaps: Dict[str, Dict[str, Any]] = {}
+        self._spans: Dict[str, "collections.deque"] = {}
+        self._arrivals: Dict[str, Dict[Tuple[str, int], float]] = {}
+        self._collective_done: set = set()
+        self._barriers: Dict[Tuple[int, str], Dict[str, float]] = {}
+        self._barrier_world: Dict[Tuple[int, str], Tuple[str, ...]] = {}
+        self._barrier_done: set = set()
+        self.barrier_history: List[Dict[str, Any]] = []
+        self.latest_gen = 0
+        self._last_aggregate_put = 0.0
+        self._sub = None
+        if subscribe and kv is not None:
+            from ray_tpu.fleet.kv import Subscriber
+
+            self._sub = Subscriber(
+                kv,
+                [CH_FLEETVIEW, CH_BARRIER],
+                self._on_message,
+                poll_timeout=poll_timeout,
+            )
+
+    # ray-tpu: thread=fleetview-sub
+    def _on_message(self, channel: str, msg: Dict[str, Any]) -> None:
+        if channel == CH_BARRIER:
+            self.ingest_barrier(msg)
+            return
+        self.ingest(msg)
+        # refresh the durable digest for the report CLI (outside the
+        # lock — RTA008: never hold a lock across a KV round trip),
+        # throttled to one put per second
+        if self.publish_aggregate:
+            now = time.monotonic()
+            if now - self._last_aggregate_put >= 1.0:
+                self._last_aggregate_put = now
+                try:
+                    self.kv.put(K_AGGREGATE, self.report_data())
+                except Exception:
+                    pass
+
+    def ingest(self, snap: Dict[str, Any]) -> None:
+        """Absorb one host snapshot (pubsub callback or direct)."""
+        host = snap.get("host")
+        if not host:
+            return
+        now = time.time()
+        with self._lock:
+            self._snaps[host] = dict(snap, _recv_at=now)
+            dq = self._spans.get(host)
+            if dq is None:
+                dq = self._spans[host] = collections.deque(
+                    maxlen=self.max_spans_per_host
+                )
+            dq.extend(snap.get("spans") or ())
+            arr = self._arrivals.setdefault(host, {})
+            for rec in snap.get("arrivals") or ():
+                try:
+                    arr[(str(rec["point"]), int(rec["index"]))] = (
+                        float(rec["ts"])
+                    )
+                except (KeyError, TypeError, ValueError):
+                    continue
+            self._attribute_collectives_locked()
+
+    def ingest_barrier(self, rec: Dict[str, Any]) -> None:
+        """Absorb one barrier-arrival event (HostAgent.barrier's
+        CH_BARRIER publish). When every host of the record's epoch has
+        arrived, attribute waits + the straggler."""
+        try:
+            gen = int(rec["gen"])
+            name = str(rec["name"])
+            host = str(rec["host"])
+            ts = float(rec["ts"])
+        except (KeyError, TypeError, ValueError):
+            return
+        with self._lock:
+            self.latest_gen = max(self.latest_gen, gen)
+            key = (gen, name)
+            if key in self._barrier_done:
+                return
+            world = tuple(rec.get("hosts") or ())
+            if world:
+                self._barrier_world[key] = world
+            self._barriers.setdefault(key, {})[host] = ts
+            world = self._barrier_world.get(key, ())
+            arr = self._barriers[key]
+            if world and set(world) <= set(arr):
+                self._attribute_locked(
+                    gen,
+                    name,
+                    {h: arr[h] for h in world},
+                    kind="barrier",
+                )
+                self._barrier_done.add(key)
+                self._barriers.pop(key, None)
+
+    # -- attribution (under self._lock; local compute only) ------------
+
+    def _offset_locked(self, host: str) -> float:
+        snap = self._snaps.get(host)
+        if snap is None:
+            return 0.0
+        try:
+            return float(snap.get("clock_offset_s") or 0.0)
+        except (TypeError, ValueError):
+            return 0.0
+
+    def _attribute_collectives_locked(self) -> None:
+        """Attribute every (point, index) drain point all live hosts
+        have reached — the lockstep contract makes the pair a
+        cross-host join key without any barrier."""
+        live = sorted(self._snaps)
+        if len(live) < 2:
+            return
+        for key in list(self._arrivals.get(live[0], {})):
+            if key in self._collective_done:
+                continue
+            if not all(
+                key in self._arrivals.get(h, {}) for h in live
+            ):
+                continue
+            arrivals = {h: self._arrivals[h][key] for h in live}
+            self._attribute_locked(
+                self.latest_gen,
+                f"{key[0]}[{key[1]}]",
+                arrivals,
+                kind="collective",
+            )
+            self._collective_done.add(key)
+            if len(self._collective_done) > 8192:
+                self._collective_done.clear()
+            for h in live:
+                self._arrivals.get(h, {}).pop(key, None)
+
+    def _attribute_locked(
+        self,
+        gen: int,
+        name: str,
+        arrivals: Dict[str, float],
+        kind: str,
+    ) -> None:
+        corrected = {
+            h: arrivals[h] - self._offset_locked(h)
+            for h in sorted(arrivals)
+        }
+        t_last = max(corrected.values())
+        straggler = max(
+            sorted(corrected), key=lambda h: corrected[h]
+        )
+        waits = {h: t_last - t for h, t in corrected.items()}
+        for h, w in waits.items():
+            tm.set_barrier_wait(h, gen, w)
+        tm.inc_straggler(straggler)
+        rec = {
+            "gen": gen,
+            "name": name,
+            "kind": kind,
+            "straggler": straggler,
+            "start": min(corrected.values()),
+            "end": t_last,
+            "waits": waits,
+        }
+        self.barrier_history.append(rec)
+        if len(self.barrier_history) > 1024:
+            del self.barrier_history[
+                : len(self.barrier_history) - 1024
+            ]
+        # the fleet-level span, in the KV clock frame already
+        tracing.record_span(
+            "fleet:barrier",
+            rec["start"],
+            rec["end"],
+            barrier=name,
+            gen=gen,
+            straggler=straggler,
+            kind=kind,
+        )
+
+    # -- reads ----------------------------------------------------------
+
+    def _prune_locked(self, now: float) -> None:
+        for host in [
+            h
+            for h, s in self._snaps.items()
+            if now - s.get("_recv_at", now) > self.max_age
+        ]:
+            del self._snaps[host]
+
+    def hosts(self) -> List[str]:
+        """Hosts with a live (non-aged) snapshot, sorted."""
+        with self._lock:
+            self._prune_locked(time.time())
+            return sorted(self._snaps)
+
+    def merged_exposition(self) -> str:
+        """The fleet's ONE Prometheus exposition: every live host's
+        families with a ``host=`` label injected on series that lack
+        one, plus the aggregator-computed families (barrier waits /
+        stragglers / hosts-reporting) from the local registry. Family
+        order is sorted by name; within a family, series iterate hosts
+        in sorted order — byte-stable across scrapes given the same
+        snapshots (the golden-test contract)."""
+        with self._lock:
+            self._prune_locked(time.time())
+            snaps = [self._snaps[h] for h in sorted(self._snaps)]
+        tm.set_hosts_reporting(len(snaps))
+        fams: Dict[str, Dict[str, Any]] = {}
+
+        def add_family(fam, inject_host=None):
+            name = fam.get("name")
+            if not name:
+                return
+            rec = fams.get(name)
+            if rec is None:
+                rec = fams[name] = {
+                    "kind": fam.get("kind", "untyped"),
+                    "description": fam.get("description", ""),
+                    "boundaries": fam.get("boundaries"),
+                    "series": collections.OrderedDict(),
+                }
+            for tags, value in fam.get("series", ()):
+                t = dict(tags)
+                if inject_host is not None and "host" not in t:
+                    t["host"] = inject_host
+                key = tuple(sorted(t.items()))
+                prev = rec["series"].get(key)
+                if prev is None:
+                    rec["series"][key] = value
+                else:
+                    rec["series"][key] = _merge_value(
+                        rec["kind"], prev, value
+                    )
+
+        local = {f["name"]: f for f in registry_snapshot()}
+        for name in AGGREGATOR_FAMILIES:
+            if name in local:
+                add_family(local[name])
+        for snap in snaps:
+            for fam in snap.get("metrics", ()):
+                if fam.get("name") in AGGREGATOR_FAMILIES:
+                    continue
+                add_family(fam, inject_host=snap["host"])
+        lines: List[str] = []
+        for name in sorted(fams):
+            rec = fams[name]
+            pname = name.replace(".", "_")
+            if rec["description"]:
+                lines.append(
+                    f"# HELP {pname} {rec['description']}"
+                )
+            lines.append(f"# TYPE {pname} {rec['kind']}")
+            if rec["kind"] == "histogram":
+                bounds = rec.get("boundaries") or []
+                for key, data in rec["series"].items():
+                    cum = 0.0
+                    for b, c in zip(bounds, data["buckets"]):
+                        cum += c
+                        t = dict(key)
+                        t["le"] = repr(float(b))
+                        lines.append(
+                            f"{pname}_bucket"
+                            f"{_fmt_tags(sorted(t.items()))} {cum}"
+                        )
+                    total = sum(data["buckets"])
+                    t = dict(key)
+                    t["le"] = "+Inf"
+                    lines.append(
+                        f"{pname}_bucket"
+                        f"{_fmt_tags(sorted(t.items()))} {total}"
+                    )
+                    lines.append(
+                        f"{pname}_sum{_fmt_tags(key)} {data['sum']}"
+                    )
+                    lines.append(
+                        f"{pname}_count{_fmt_tags(key)}"
+                        f" {data['count']}"
+                    )
+            else:
+                for key, value in rec["series"].items():
+                    lines.append(
+                        f"{pname}{_fmt_tags(key)} {value}"
+                    )
+        return "\n".join(lines) + "\n"
+
+    def export_fleet_timeline(
+        self, path: str, since: Optional[float] = None
+    ) -> str:
+        """One chrome://tracing file for the whole fleet: each host's
+        shipped spans shifted into the KV clock frame (``t − offset``),
+        the per-host child-clamp rule of
+        :func:`tracing._clamped_intervals` reused, one synthetic
+        process-lane group per (host, original pid) labeled with the
+        host name — device lanes ride along because the PR-13 ledger
+        records its ``device:`` spans into the same buffer the
+        exporter ships. Attributed barriers render on a ``fleet`` lane
+        (pid 0) naming the straggler."""
+        with self._lock:
+            hosts = sorted(set(self._spans) | set(self._snaps))
+            per_host = {
+                h: list(self._spans.get(h, ())) for h in hosts
+            }
+            offsets = {h: self._offset_locked(h) for h in hosts}
+            barriers = list(self.barrier_history)
+        events: List[Dict[str, Any]] = []
+        pid_map: Dict[Tuple[str, int], int] = {}
+
+        def lane_pid(host, orig_pid):
+            key = (host, orig_pid)
+            if key not in pid_map:
+                pid_map[key] = len(pid_map) + 1
+                events.append(
+                    {
+                        "name": "process_name",
+                        "ph": "M",
+                        "pid": pid_map[key],
+                        "tid": 0,
+                        "args": {
+                            "name": f"{host} (pid {orig_pid})"
+                        },
+                    }
+                )
+            return pid_map[key]
+
+        for host in hosts:
+            spans = per_host[host]
+            if since is not None:
+                spans = [
+                    s
+                    for s in spans
+                    if (s.get("end") or s.get("start") or 0.0)
+                    >= since
+                ]
+            off = offsets.get(host, 0.0)
+            shifted = []
+            for s in spans:
+                c = dict(s)
+                c["start"] = s["start"] - off
+                c["end"] = (
+                    s["end"]
+                    if s.get("end") is not None
+                    else s["start"]
+                ) - off
+                shifted.append(c)
+            clamped = tracing._clamped_intervals(shifted)
+            lanes: Dict[Tuple[int, int], Optional[str]] = {}
+            for s in shifted:
+                start, end = clamped.get(
+                    s.get("span_id"), (s["start"], s["end"])
+                )
+                pid = lane_pid(host, s.get("pid", 0))
+                tid = s.get("tid", 0)
+                events.append(
+                    {
+                        "name": s["name"],
+                        "cat": "span",
+                        "ph": "X",
+                        "ts": start * 1e6,
+                        "dur": (end - start) * 1e6,
+                        "pid": pid,
+                        "tid": tid,
+                        "args": {
+                            "host": host,
+                            "trace_id": s.get("trace_id"),
+                            "span_id": s.get("span_id"),
+                            "parent_id": s.get("parent_id"),
+                            **(s.get("attributes") or {}),
+                        },
+                    }
+                )
+                lanes.setdefault(
+                    (pid, tid), s.get("thread_name")
+                )
+            for (pid, tid), tname in sorted(lanes.items()):
+                if tname:
+                    events.append(
+                        {
+                            "name": "thread_name",
+                            "ph": "M",
+                            "pid": pid,
+                            "tid": tid,
+                            "args": {"name": tname},
+                        }
+                    )
+        if barriers:
+            events.append(
+                {
+                    "name": "process_name",
+                    "ph": "M",
+                    "pid": 0,
+                    "tid": 0,
+                    "args": {"name": "fleet"},
+                }
+            )
+            for rec in barriers:
+                if since is not None and rec["end"] < since:
+                    continue
+                events.append(
+                    {
+                        "name": "fleet:barrier",
+                        "cat": "span",
+                        "ph": "X",
+                        "ts": rec["start"] * 1e6,
+                        "dur": max(0.0, rec["end"] - rec["start"])
+                        * 1e6,
+                        "pid": 0,
+                        "tid": 0,
+                        "args": {
+                            "barrier": rec["name"],
+                            "gen": rec["gen"],
+                            "kind": rec["kind"],
+                            "straggler": rec["straggler"],
+                            "waits": rec["waits"],
+                        },
+                    }
+                )
+        with open(path, "w") as f:
+            json.dump({"traceEvents": events}, f)
+        return path
+
+    def report_data(self) -> Dict[str, Any]:
+        """JSON-safe digest for the report CLI / the KV aggregate key:
+        per-host health (age, seq, skew, rtt, ledger MFU), barrier
+        history, latest epoch generation."""
+        now = time.time()
+        with self._lock:
+            self._prune_locked(now)
+            hosts = []
+            for h in sorted(self._snaps):
+                s = self._snaps[h]
+                ledger = s.get("ledger") or {}
+                totals = ledger.get("totals") or {}
+                hosts.append(
+                    {
+                        "host": h,
+                        "seq": s.get("seq"),
+                        "age_s": now - s.get("_recv_at", now),
+                        "clock_offset_s": s.get("clock_offset_s"),
+                        "rtt_s": s.get("rtt_s"),
+                        "mfu": totals.get("mfu"),
+                        "kv_rtt_s": _family_value(
+                            s, tm.KV_RTT_SECONDS
+                        ),
+                        "spans_buffered": len(
+                            self._spans.get(h, ())
+                        ),
+                    }
+                )
+            return {
+                "ts": now,
+                "max_age_s": self.max_age,
+                "latest_gen": self.latest_gen,
+                "hosts": hosts,
+                "barriers": list(self.barrier_history[-50:]),
+            }
+
+    def stop(self) -> None:
+        if self._sub is not None:
+            self._sub.stop()
+            self._sub = None
+
+
+def _family_value(snap: Dict[str, Any], family: str):
+    """First series value of ``family`` in a snapshot's serialized
+    registry (None when the host never set it)."""
+    for fam in snap.get("metrics", ()):
+        if fam.get("name") == family:
+            for _tags, value in fam.get("series", ()):
+                return value
+    return None
+
+
+# -- process-wide installation (the ingress /metrics hook) -------------
+
+_INSTALLED: Optional[FleetAggregator] = None
+
+
+def install(agg: FleetAggregator) -> FleetAggregator:
+    """Make ``agg`` this process's fleet view: the ingress ``/metrics``
+    route and any MetricsServer constructed with
+    ``render=fleetview.render_installed`` serve its merged exposition
+    instead of the process-local one."""
+    global _INSTALLED
+    _INSTALLED = agg
+    return agg
+
+
+def current() -> Optional[FleetAggregator]:
+    return _INSTALLED
+
+
+def uninstall(agg: Optional[FleetAggregator] = None) -> None:
+    global _INSTALLED
+    if agg is None or _INSTALLED is agg:
+        _INSTALLED = None
+
+
+def render_installed() -> Optional[str]:
+    """Merged exposition of the installed aggregator, or None (callers
+    fall back to the process-local exposition)."""
+    agg = _INSTALLED
+    if agg is None:
+        return None
+    return agg.merged_exposition()

@@ -62,6 +62,19 @@ LEARNER_FLEET_HOSTS = "ray_tpu_learner_fleet_hosts"
 MESH_EPOCH = "ray_tpu_mesh_epoch"
 MESH_RESIZES_TOTAL = "ray_tpu_mesh_resizes_total"
 FLEET_PRESEEDS_TOTAL = "ray_tpu_fleet_aot_preseeds_total"
+# fleet-wide observability plane (docs/observability.md "Fleet view",
+# telemetry/fleetview.py): per-host barrier wall at each epoch-scoped
+# barrier (seconds a host's arrival led the LAST arriver's,
+# skew-corrected into the KV clock frame), straggler attribution
+# (times a host WAS the last arriver), each exporter's measured clock
+# offset against the coordinator's KV clock, how many hosts the
+# aggregator currently holds live snapshots for, and the KV
+# transport's own round-trip latency measured on the heartbeat path
+FLEET_BARRIER_WAIT_SECONDS = "ray_tpu_fleet_barrier_wait_seconds"
+FLEET_STRAGGLER_TOTAL = "ray_tpu_fleet_straggler_total"
+FLEET_CLOCK_OFFSET_SECONDS = "ray_tpu_fleet_clock_offset_seconds"
+FLEET_HOSTS_REPORTING = "ray_tpu_fleet_hosts_reporting"
+KV_RTT_SECONDS = "ray_tpu_kv_rtt_seconds"
 CKPT_STREAM_SNAPSHOTS_TOTAL = (
     "ray_tpu_checkpoint_stream_snapshots_total"
 )
@@ -274,6 +287,60 @@ def inc_mesh_resizes(reason: str, n: int = 1) -> None:
         "learner mesh resizes",
         ("reason",),
     ).inc(float(n), {"reason": reason})
+
+
+def set_barrier_wait(host: str, epoch: int, seconds: float) -> None:
+    """How long ``host``'s arrival at the latest epoch-scoped barrier
+    led the LAST arriver's (0 for the straggler itself) — the per-host
+    DCN stall attribution the fleet aggregator computes from KV
+    arrival records, skew-corrected into the coordinator's KV clock
+    frame (docs/observability.md "Fleet view")."""
+    gauge(
+        FLEET_BARRIER_WAIT_SECONDS,
+        "seconds a host waited on the barrier's last arriver",
+        ("host", "epoch"),
+    ).set(float(seconds), {"host": host, "epoch": str(epoch)})
+
+
+def inc_straggler(host: str, n: int = 1) -> None:
+    """One barrier where ``host`` was the LAST arriver (the fleet's
+    measured straggler)."""
+    counter(
+        FLEET_STRAGGLER_TOTAL,
+        "barriers where this host arrived last",
+        ("host",),
+    ).inc(float(n), {"host": host})
+
+
+def set_clock_offset(host: str, seconds: float) -> None:
+    """``host``'s wall clock minus the coordinator's KV clock, as
+    measured by the exporter's NTP-style handshake (positive = the
+    host's clock runs ahead)."""
+    gauge(
+        FLEET_CLOCK_OFFSET_SECONDS,
+        "host wall clock minus the coordinator KV clock",
+        ("host",),
+    ).set(float(seconds), {"host": host})
+
+
+def set_hosts_reporting(n: int) -> None:
+    """Hosts the fleet aggregator currently holds a live (non-aged)
+    snapshot for."""
+    gauge(
+        FLEET_HOSTS_REPORTING,
+        "hosts with a live snapshot at the fleet aggregator",
+    ).set(float(n))
+
+
+def set_kv_rtt(host: str, seconds: float) -> None:
+    """Round-trip latency of one KV heartbeat as measured by this
+    host's HeartbeatReporter — the fleet plane's own transport
+    health."""
+    gauge(
+        KV_RTT_SECONDS,
+        "KV heartbeat round-trip seconds measured per host",
+        ("host",),
+    ).set(float(seconds), {"host": host})
 
 
 def inc_fleet_preseed(status: str, n: int = 1) -> None:

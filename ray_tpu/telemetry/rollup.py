@@ -99,23 +99,63 @@ def _clamped(
     return merge_intervals(out)
 
 
+def late_stage_times(
+    late_spans: Iterable[dict],
+) -> Dict[str, float]:
+    """Per-stage busy time of spans that arrived AFTER their own
+    window settled (late cross-host harvest): full-duration union per
+    stage, no window clamp — the window they belong to already rolled
+    up without them, so the consumer credits them to its next window
+    instead of dropping the time on the floor."""
+    late_spans = list(late_spans)
+    out: Dict[str, float] = {}
+    for stage, prefixes in STAGE_PREFIXES.items():
+        ivs = []
+        for s in late_spans:
+            name = s.get("name", "")
+            if not any(name.startswith(p) for p in prefixes):
+                continue
+            start = s.get("start")
+            end = s.get("end") or start
+            if start is None or end is None:
+                continue
+            ivs.append((start, max(start, end)))
+        out[stage] = total(merge_intervals(ivs))
+    return out
+
+
 def iteration_rollup(
-    spans: Iterable[dict], t0: float, t1: float
+    spans: Iterable[dict],
+    t0: float,
+    t1: float,
+    late: Iterable[dict] = (),
 ) -> Dict[str, float]:
     """Summarize one iteration window ``[t0, t1]`` of finished spans.
 
     Returns ``{stage}_s`` busy times for each stage of
     :data:`STAGE_PREFIXES`, ``iteration_s``, and
     ``overlap_fraction`` = |learn ∩ sampling| / |learn| (0.0 when no
-    learn span landed in the window)."""
+    learn span landed in the window).
+
+    ``late`` names spans that were first harvested in THIS window but
+    ended before it opened (their own window settled without them —
+    the cross-host fleetview harvest can lag a full publish interval).
+    Their full durations are credited to this window's stage totals
+    via :func:`late_stage_times`, so the across-window sum matches an
+    on-time harvest instead of silently losing the segments. The
+    overlap fraction stays a pure in-window statement (late sampling
+    can't retroactively overlap this window's learn)."""
     spans = list(spans)
     out: Dict[str, float] = {
         "iteration_s": max(0.0, t1 - t0)
     }
+    late_times = late_stage_times(late) if late else {}
     merged: Dict[str, List[Interval]] = {}
     for stage, prefixes in STAGE_PREFIXES.items():
         merged[stage] = _clamped(spans, t0, t1, prefixes)
-        out[f"{stage}_s"] = total(merged[stage])
+        out[f"{stage}_s"] = total(merged[stage]) + late_times.get(
+            stage, 0.0
+        )
     sampling = _clamped(spans, t0, t1, _SAMPLING_FOR_OVERLAP)
     learn = merged["learn"]
     learn_total = total(learn)

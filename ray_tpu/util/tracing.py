@@ -240,6 +240,40 @@ def remote_span(ctx: Optional[Dict], name: str):
         _enabled = was_enabled
 
 
+@contextlib.contextmanager
+def context_span(ctx: Optional[Dict], name: str, **attributes):
+    """Open a span under an EXPLICIT trace context (the serving path's
+    ``x-ray-tpu-trace`` propagation: ingress → router → replica spans
+    stitch into one trace even though they run on different threads,
+    where contextvars can't carry the parent). Unlike
+    :func:`remote_span` this never force-enables tracing — when the
+    process has tracing off it costs one flag check and yields the
+    null span, so it is safe on the serve hot path. ``ctx`` is an
+    :func:`inject_context`-shaped dict; ``None`` falls back to the
+    calling context's current span (plain :func:`start_span`
+    semantics)."""
+    if not _enabled:
+        yield _NULL_SPAN
+        return
+    if ctx is None:
+        with start_span(name, **attributes) as span:
+            yield span
+        return
+    span = Span(
+        name,
+        trace_id=ctx.get("trace_id"),
+        parent_id=ctx.get("parent_span_id"),
+    )
+    for k, v in attributes.items():
+        span.set_attribute(k, v)
+    token = _current.set(span)
+    try:
+        yield span
+    finally:
+        _current.reset(token)
+        span.finish()
+
+
 def drain_finished() -> List[Dict]:
     """Worker-side: hand finished spans to the result pipe."""
     with _lock:

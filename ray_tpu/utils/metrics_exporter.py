@@ -76,9 +76,21 @@ def format_prometheus() -> str:
 
 
 class MetricsServer:
-    """Serves /metrics (Prometheus scrape target)."""
+    """Serves /metrics (Prometheus scrape target).
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+    ``render`` swaps the exposition source: the fleet aggregator
+    installs its merged (host-labeled) renderer here so the
+    coordinator's existing scrape port serves the whole fleet
+    (telemetry/fleetview.py). A renderer that raises falls back to the
+    process-local exposition rather than failing the scrape."""
+
+    def __init__(
+        self, host: str = "127.0.0.1", port: int = 0, render=None
+    ):
+        self.render = render
+
+        outer = self
+
         class Handler(BaseHTTPRequestHandler):
             def log_message(self, *args):
                 pass
@@ -88,7 +100,15 @@ class MetricsServer:
                     self.send_response(404)
                     self.end_headers()
                     return
-                blob = format_prometheus().encode()
+                text = None
+                if outer.render is not None:
+                    try:
+                        text = outer.render()
+                    except Exception:
+                        text = None
+                if text is None:
+                    text = format_prometheus()
+                blob = text.encode()
                 self.send_response(200)
                 self.send_header(
                     "Content-Type", "text/plain; version=0.0.4"

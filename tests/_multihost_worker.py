@@ -146,6 +146,68 @@ def main() -> None:
     other_loss = kv.get(f"fleet_test/loss_{1 - rank}", timeout=60.0)
     assert abs(other_loss - stats["total_loss"]) < 1e-5
 
+    # ---- fleet observability rung (PR 18): every rank runs a
+    # HostExporter, rank 0 the subscribing FleetAggregator; rank 1
+    # arrives late at an epoch barrier ON PURPOSE, and the aggregator
+    # must attribute it by name from the KV arrival records ----
+    import time as _time
+
+    from ray_tpu.telemetry import fleetview
+
+    aggregator = (
+        fleetview.FleetAggregator(kv=kv, publish_aggregate=False)
+        if rank == 0
+        else None
+    )
+    exporter = fleetview.HostExporter(kv, f"host{rank}", interval=0)
+    exporter.flush()  # snapshot (clock handshake included) pre-barrier
+    if rank == 0:
+        # pubsub drops messages published before the subscription
+        # registers: re-flush until our own snapshot round-trips, so
+        # the subscriber is provably live before any barrier publish
+        deadline = _time.monotonic() + 30.0
+        while "host0" not in aggregator.hosts():
+            if _time.monotonic() >= deadline:
+                raise TimeoutError("fleetview subscription not live")
+            exporter.flush()
+            _time.sleep(0.05)
+    if rank == 1:
+        _time.sleep(0.4)  # the deliberate straggler
+    agent.barrier("fleetobs", epoch1)
+    if rank == 0:
+        deadline = _time.monotonic() + 30.0
+        while True:
+            recs = [
+                r
+                for r in aggregator.barrier_history
+                if r["name"] == "fleetobs"
+            ]
+            if recs:
+                break
+            if _time.monotonic() >= deadline:
+                raise TimeoutError("barrier never attributed")
+            _time.sleep(0.05)
+        rec = recs[0]
+        assert rec["straggler"] == "host1", rec
+        assert rec["waits"]["host0"] >= 0.2, rec
+        assert rec["waits"]["host1"] == 0.0, rec
+        print(f"FLEETOBS_STRAGGLER {rec['straggler']}")
+        if len(aggregator.hosts()) < 2:
+            # host1's publish may have raced the subscription start;
+            # its durable per-host key (written by the same flush) is
+            # the late-joiner path
+            aggregator.ingest(
+                kv.get(fleetview.snapshot_key("host1"), timeout=30.0)
+            )
+        text = aggregator.merged_exposition()
+        assert 'host="host0"' in text and 'host="host1"' in text
+        assert (
+            'ray_tpu_fleet_straggler_total{host="host1"} 1.0' in text
+        )
+        print("FLEETOBS_MERGED 2 hosts")
+        aggregator.stop()
+    exporter.stop()
+
     # ---- elastic resize: provider notice for host1 → coordinator
     # drains epoch 1 and cuts epoch 2 → one final lockstep superstep →
     # barrier → host0 rebuilds at the surviving geometry ----

@@ -1,0 +1,455 @@
+"""Fleet observability (docs/observability.md "Fleet view").
+
+Covers the PR-18 tentpole seams single-process, with hand-built host
+snapshots where determinism matters:
+
+- the golden merged exposition: two fake hosts, ``host=`` injected
+  only where missing, counter-SUM vs gauge-last-write on a full-key
+  collision, byte-stable family/series order across scrapes AND
+  across ingest order;
+- skew correction: ±50 ms clock offsets produce a monotone fleet
+  timeline (identical raw stamps separate once mapped into the KV
+  clock frame);
+- barrier + collective drain-point attribution: waits, the named
+  straggler, the ``fleet:barrier`` span, idempotence on duplicate
+  delivery;
+- aggregator under churn: a host that stops publishing ages out of
+  the merged exposition;
+- the exporter itself against a fake KV (arrival-recorder arming,
+  span watermark, publish + durable put) and against a real
+  KVServer (``server_clock`` op, handshake, the HeartbeatReporter's
+  ``ray_tpu_kv_rtt_seconds{host}`` gauge);
+- the rollup late-span regression: segments harvested after their
+  window settled credit the NEXT window instead of vanishing;
+- ``tracing.context_span``: joining a propagated trace context vs
+  starting a root span (the ingress → router → serve stitch).
+
+The 2-process gloo rung (real barriers over a real fleet) lives in
+``tests/_multihost_worker.py`` / ``test_multihost.py``.
+"""
+
+import json
+import time
+
+import pytest
+
+from ray_tpu.telemetry import fleetview
+from ray_tpu.telemetry import metrics as tm
+from ray_tpu.telemetry.rollup import iteration_rollup, late_stage_times
+from ray_tpu.util import tracing
+from ray_tpu.utils import metrics as m
+
+
+def setup_function(_fn):
+    tracing.clear()
+    m.clear_registry()
+    fleetview._reset_arrivals()
+    fleetview.uninstall()
+
+
+def teardown_function(_fn):
+    tracing.disable()
+    tracing.clear()
+    m.clear_registry()
+    fleetview._reset_arrivals()
+    fleetview.uninstall()
+
+
+def _snap(host, offset=0.0, metrics=(), spans=(), arrivals=(), seq=1):
+    return {
+        "host": host,
+        "seq": seq,
+        "ts": time.time(),
+        "clock_offset_s": offset,
+        "rtt_s": 0.0005,
+        "metrics": list(metrics),
+        "spans": list(spans),
+        "arrivals": list(arrivals),
+        "ledger": None,
+    }
+
+
+def _demo_metrics(requests, depth, shared, temp):
+    return [
+        {
+            "name": "ray_tpu_demo_queue_depth",
+            "kind": "gauge",
+            "description": "demo queue depth",
+            "series": [([], depth)],
+        },
+        {
+            "name": "ray_tpu_demo_requests_total",
+            "kind": "counter",
+            "description": "demo requests",
+            "series": [([("route", "/act")], requests)],
+        },
+        {
+            # already host-tagged with the SAME value on every host:
+            # full-key collision -> counter SUM
+            "name": "ray_tpu_demo_shared_total",
+            "kind": "counter",
+            "description": "fleet-wide shared counter",
+            "series": [([("host", "fleet")], shared)],
+        },
+        {
+            # same collision for a gauge -> last write (sorted hosts)
+            "name": "ray_tpu_demo_temp",
+            "kind": "gauge",
+            "description": "fleet-wide shared gauge",
+            "series": [([("host", "fleet")], temp)],
+        },
+    ]
+
+
+# -- merged exposition -------------------------------------------------
+
+
+def test_merged_exposition_golden():
+    agg = fleetview.FleetAggregator(subscribe=False)
+    agg.ingest(
+        _snap("host0", metrics=_demo_metrics(3.0, 2.0, 1.0, 4.0))
+    )
+    agg.ingest(
+        _snap("host1", metrics=_demo_metrics(4.0, 7.0, 2.0, 9.0))
+    )
+    expected = """\
+# HELP ray_tpu_demo_queue_depth demo queue depth
+# TYPE ray_tpu_demo_queue_depth gauge
+ray_tpu_demo_queue_depth{host="host0"} 2.0
+ray_tpu_demo_queue_depth{host="host1"} 7.0
+# HELP ray_tpu_demo_requests_total demo requests
+# TYPE ray_tpu_demo_requests_total counter
+ray_tpu_demo_requests_total{host="host0",route="/act"} 3.0
+ray_tpu_demo_requests_total{host="host1",route="/act"} 4.0
+# HELP ray_tpu_demo_shared_total fleet-wide shared counter
+# TYPE ray_tpu_demo_shared_total counter
+ray_tpu_demo_shared_total{host="fleet"} 3.0
+# HELP ray_tpu_demo_temp fleet-wide shared gauge
+# TYPE ray_tpu_demo_temp gauge
+ray_tpu_demo_temp{host="fleet"} 9.0
+# HELP ray_tpu_fleet_hosts_reporting hosts with a live snapshot at \
+the fleet aggregator
+# TYPE ray_tpu_fleet_hosts_reporting gauge
+ray_tpu_fleet_hosts_reporting 2.0
+"""
+    assert agg.merged_exposition() == expected
+    # byte-stable across scrapes
+    assert agg.merged_exposition() == expected
+
+
+def test_merged_exposition_stable_across_ingest_order():
+    a = fleetview.FleetAggregator(subscribe=False)
+    a.ingest(_snap("host0", metrics=_demo_metrics(3.0, 2.0, 1.0, 4.0)))
+    a.ingest(_snap("host1", metrics=_demo_metrics(4.0, 7.0, 2.0, 9.0)))
+    first = a.merged_exposition()
+    b = fleetview.FleetAggregator(subscribe=False)
+    b.ingest(_snap("host1", metrics=_demo_metrics(4.0, 7.0, 2.0, 9.0)))
+    b.ingest(_snap("host0", metrics=_demo_metrics(3.0, 2.0, 1.0, 4.0)))
+    assert b.merged_exposition() == first
+
+
+def test_merge_value_semantics():
+    assert fleetview._merge_value("counter", 2.0, 3.0) == 5.0
+    assert fleetview._merge_value("gauge", 2.0, 3.0) == 3.0
+    merged = fleetview._merge_value(
+        "histogram",
+        {"buckets": [1, 2], "sum": 0.5, "count": 3},
+        {"buckets": [0, 1], "sum": 0.2, "count": 1},
+    )
+    assert merged == {"buckets": [1, 3], "sum": 0.7, "count": 4}
+    # boundary mismatch (a host upgraded mid-flight): last write wins
+    assert fleetview._merge_value(
+        "histogram",
+        {"buckets": [1, 2], "sum": 0.5, "count": 3},
+        {"buckets": [0], "sum": 0.2, "count": 1},
+    ) == {"buckets": [0], "sum": 0.2, "count": 1}
+
+
+def test_aggregator_churn_ages_series_out():
+    agg = fleetview.FleetAggregator(subscribe=False, max_age=0.2)
+    agg.ingest(
+        _snap("host0", metrics=_demo_metrics(3.0, 2.0, 1.0, 4.0))
+    )
+    agg.ingest(
+        _snap("host1", metrics=_demo_metrics(4.0, 7.0, 2.0, 9.0))
+    )
+    text = agg.merged_exposition()
+    assert 'host="host0"' in text and 'host="host1"' in text
+    time.sleep(0.3)
+    # host0 keeps publishing, host1 left the fleet
+    agg.ingest(
+        _snap("host0", metrics=_demo_metrics(5.0, 2.0, 1.0, 4.0))
+    )
+    text = agg.merged_exposition()
+    assert 'host="host0"' in text
+    assert 'host="host1"' not in text
+    assert "ray_tpu_fleet_hosts_reporting 1.0" in text
+    assert agg.hosts() == ["host0"]
+
+
+def test_install_render_installed():
+    assert fleetview.render_installed() is None
+    agg = fleetview.FleetAggregator(subscribe=False)
+    agg.ingest(
+        _snap("host0", metrics=_demo_metrics(3.0, 2.0, 1.0, 4.0))
+    )
+    fleetview.install(agg)
+    assert fleetview.current() is agg
+    text = fleetview.render_installed()
+    assert 'ray_tpu_demo_queue_depth{host="host0"} 2.0' in text
+    fleetview.uninstall(agg)
+    assert fleetview.render_installed() is None
+
+
+# -- skew-corrected fleet timeline -------------------------------------
+
+
+def test_skew_corrected_fleet_timeline(tmp_path):
+    # true (KV-frame) order: host0's span [100.00, 100.02], then
+    # host1's [100.10, 100.12]. host0's clock runs +50 ms ahead and
+    # host1's -50 ms behind, so BOTH stamp their span [100.05, 100.07]
+    # — raw stamps are identical; only the correction separates them.
+    agg = fleetview.FleetAggregator(subscribe=False)
+
+    def span(sid):
+        return {
+            "name": "learn:nest",
+            "start": 100.05,
+            "end": 100.07,
+            "span_id": sid,
+            "parent_id": None,
+            "trace_id": "t",
+            "pid": 1,
+            "tid": 1,
+        }
+
+    agg.ingest(_snap("host0", offset=0.05, spans=[span("a")]))
+    agg.ingest(_snap("host1", offset=-0.05, spans=[span("b")]))
+    path = str(tmp_path / "fleet_timeline.json")
+    agg.export_fleet_timeline(path)
+    with open(path) as f:
+        events = json.load(f)["traceEvents"]
+    xs = {
+        e["args"]["host"]: e
+        for e in events
+        if e.get("ph") == "X" and e.get("cat") == "span"
+    }
+    t0, t1 = xs["host0"]["ts"], xs["host1"]["ts"]
+    assert t0 == pytest.approx(100.00 * 1e6)
+    assert t1 == pytest.approx(100.10 * 1e6)
+    # monotone: host0's span ends before host1's begins
+    assert t0 + xs["host0"]["dur"] <= t1
+    # one lane group per host, labeled with the host name
+    names = {
+        e["args"]["name"]
+        for e in events
+        if e.get("ph") == "M" and e["name"] == "process_name"
+    }
+    assert names == {"host0 (pid 1)", "host1 (pid 1)"}
+
+
+# -- barrier / straggler attribution -----------------------------------
+
+
+def test_barrier_attribution_names_straggler():
+    tracing.enable()
+    agg = fleetview.FleetAggregator(subscribe=False)
+    agg.ingest(_snap("host0", offset=0.05))
+    agg.ingest(_snap("host1", offset=-0.05))
+    rec = {
+        "gen": 1,
+        "name": "epoch",
+        "host": "host0",
+        "hosts": ["host0", "host1"],
+        "ts": 10.00,
+    }
+    agg.ingest_barrier(rec)
+    assert agg.barrier_history == []  # host1 not arrived yet
+    agg.ingest_barrier(dict(rec, host="host1", ts=10.05))
+    # corrected arrivals: host0 at 9.95, host1 at 10.10
+    assert len(agg.barrier_history) == 1
+    done = agg.barrier_history[0]
+    assert done["kind"] == "barrier"
+    assert done["straggler"] == "host1"
+    assert done["waits"]["host0"] == pytest.approx(0.15)
+    assert done["waits"]["host1"] == 0.0
+    # duplicate delivery is idempotent
+    agg.ingest_barrier(dict(rec, host="host1", ts=10.05))
+    assert len(agg.barrier_history) == 1
+    # the attribution landed in the registry + the span buffer
+    text = agg.merged_exposition()
+    assert 'ray_tpu_fleet_straggler_total{host="host1"} 1.0' in text
+    assert (
+        'ray_tpu_fleet_barrier_wait_seconds{epoch="1",host="host0"}'
+        in text
+    )
+    spans = [
+        s for s in tracing.get_spans() if s["name"] == "fleet:barrier"
+    ]
+    assert len(spans) == 1
+    assert spans[0]["attributes"]["straggler"] == "host1"
+    assert spans[0]["attributes"]["barrier"] == "epoch"
+
+
+def test_collective_drain_point_attribution():
+    agg = fleetview.FleetAggregator(subscribe=False)
+    agg.ingest(
+        _snap(
+            "host0",
+            arrivals=[{"point": "put_global", "index": 0, "ts": 5.0}],
+        )
+    )
+    assert agg.barrier_history == []  # one host is not a fleet
+    agg.ingest(
+        _snap(
+            "host1",
+            arrivals=[{"point": "put_global", "index": 0, "ts": 5.2}],
+        )
+    )
+    assert len(agg.barrier_history) == 1
+    done = agg.barrier_history[0]
+    assert done["name"] == "put_global[0]"
+    assert done["kind"] == "collective"
+    assert done["straggler"] == "host1"
+    assert done["waits"]["host0"] == pytest.approx(0.2)
+    # re-ingesting the same records must not re-attribute
+    agg.ingest(
+        _snap(
+            "host1",
+            arrivals=[{"point": "put_global", "index": 0, "ts": 5.2}],
+        )
+    )
+    assert len(agg.barrier_history) == 1
+
+
+# -- the exporter ------------------------------------------------------
+
+
+class _FakeKV:
+    def __init__(self):
+        self.store = {}
+        self.published = []
+
+    def put(self, key, value):
+        self.store[key] = value
+
+    def publish(self, channel, msg):
+        self.published.append((channel, msg))
+
+    def server_clock(self):
+        return time.time()
+
+
+def test_host_exporter_flush_and_arrival_arming():
+    tracing.enable()
+    kv = _FakeKV()
+    assert not fleetview.arrivals_on()
+    fleetview.record_arrival("put_global")  # unarmed: dropped
+    exporter = fleetview.HostExporter(kv, "h9", interval=0)
+    try:
+        assert fleetview.arrivals_on()
+        fleetview.record_arrival("put_global")
+        fleetview.record_arrival("put_global")
+        tm.set_kv_rtt("h9", 0.001)
+        tracing.record_span("learn:nest", 1.0, 2.0)
+        snap = exporter.flush()
+        assert snap["host"] == "h9"
+        assert abs(snap["clock_offset_s"]) < 1.0
+        # the unarmed call was dropped; indices restart at 0
+        assert [
+            (a["point"], a["index"]) for a in snap["arrivals"]
+        ] == [("put_global", 0), ("put_global", 1)]
+        assert any(
+            f["name"] == tm.KV_RTT_SECONDS for f in snap["metrics"]
+        )
+        assert [s["name"] for s in snap["spans"]] == ["learn:nest"]
+        # published AND durably put under the per-host key
+        assert kv.store[fleetview.snapshot_key("h9")]["seq"] == 0
+        assert kv.published[0][0] == fleetview.CH_FLEETVIEW
+        # second tick: watermark + drain leave nothing to re-ship
+        snap2 = exporter.flush()
+        assert snap2["arrivals"] == []
+        assert snap2["spans"] == []
+        assert snap2["seq"] == 1
+    finally:
+        exporter.stop()
+    assert not fleetview.arrivals_on()
+
+
+@pytest.mark.filterwarnings("ignore::ResourceWarning")
+def test_kv_server_clock_and_heartbeat_rtt_gauge():
+    from ray_tpu.fleet import HeartbeatReporter, KVClient, KVServer
+
+    server = KVServer(host="127.0.0.1")
+    try:
+        client = KVClient(f"127.0.0.1:{server.port}")
+        ts = client.server_clock()
+        assert abs(ts - time.time()) < 5.0
+        off, rtt = fleetview.clock_handshake(client)
+        assert rtt >= 0.0
+        assert abs(off) < 5.0
+        hb = HeartbeatReporter(client, "hb0", interval=0.05)
+        try:
+            deadline = time.monotonic() + 5.0
+            while hb.last_rtt_s is None:
+                assert time.monotonic() < deadline, "no heartbeat"
+                time.sleep(0.01)
+        finally:
+            hb.stop()
+        fam = next(
+            f
+            for f in m.all_metrics()
+            if f.name == tm.KV_RTT_SECONDS
+        )
+        series = {
+            dict(tags)["host"]: val for tags, val in fam.series()
+        }
+        assert series["hb0"] > 0.0
+    finally:
+        server.shutdown()
+
+
+# -- rollup: late segments credit the next window ----------------------
+
+
+def test_late_spans_credit_next_window():
+    def learn(start, end):
+        return {"name": "learn:nest", "start": start, "end": end}
+
+    w1 = iteration_rollup([learn(2.0, 4.0)], 0.0, 10.0)
+    assert w1["learn_s"] == 2.0
+    # a [5, 6] segment belonging to window 1 arrives only after that
+    # window settled (lagged cross-host harvest). The old behavior
+    # dropped it; it must count into window 2 instead.
+    late = [learn(5.0, 6.0)]
+    assert late_stage_times(late)["learn"] == 1.0
+    w2_dropping = iteration_rollup([learn(12.0, 13.0)], 10.0, 20.0)
+    assert w2_dropping["learn_s"] == 1.0  # the bug shape
+    w2 = iteration_rollup([learn(12.0, 13.0)], 10.0, 20.0, late=late)
+    assert w2["learn_s"] == 2.0
+    # across-window total matches an on-time harvest bit for bit
+    assert w1["learn_s"] + w2["learn_s"] == 4.0
+
+
+# -- context_span: the propagated-trace stitch -------------------------
+
+
+def test_context_span_joins_remote_context():
+    tracing.enable()
+    with tracing.start_span("ingress:request") as root:
+        ctx = tracing.inject_context()
+    assert ctx["trace_id"] == root.trace_id
+    assert ctx["parent_span_id"] == root.span_id
+    with tracing.context_span(ctx, "router:dispatch", rows=3):
+        pass
+    with tracing.context_span(None, "serve:batch"):
+        pass
+    by_name = {s["name"]: s for s in tracing.get_spans()}
+    dispatch = by_name["router:dispatch"]
+    assert dispatch["trace_id"] == root.trace_id
+    assert dispatch["parent_id"] == root.span_id
+    assert dispatch["attributes"]["rows"] == 3
+    # no context -> a fresh root span
+    batch = by_name["serve:batch"]
+    assert batch["parent_id"] is None
+    assert batch["trace_id"] != root.trace_id

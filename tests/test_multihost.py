@@ -119,6 +119,11 @@ def test_two_process_dcn_cluster(tmp_path):
     for rank, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"rank {rank} failed:\n{out}"
         assert f"MULTIHOST_OK rank={rank}" in out
+    # fleet observability rung: rank 1's deliberately-late barrier
+    # arrival was attributed by name, and the merged exposition
+    # carried host= series for both hosts
+    assert "FLEETOBS_STRAGGLER host1" in outs[0]
+    assert "FLEETOBS_MERGED 2 hosts" in outs[0]
     # elastic learner-fleet case: host1 drained on notice, host0
     # finished the lockstep drain step and continued on its local mesh
     assert "ELASTIC_OK" in outs[0]
