@@ -95,6 +95,14 @@ Flags:  --profile       run ONE telemetry-instrumented PPO iteration
                         and the resize wall with a pre-seeded AOT
                         cache vs cold (warm resize = zero fresh
                         compiles); writes benchmarks/e2e/fleet.json
+        --fleet-chaos   control-plane failover lane (docs/fleet.md
+                        "failure model & leadership"): coordinator
+                        kill → fenced standby takeover → failover
+                        epoch cut, walls vs lease TTL (gate: median
+                        < 2x TTL), clean-handover comparison, and
+                        the zombie's stale-term write fenced every
+                        trial; control-plane only — no learners;
+                        writes benchmarks/e2e/fleet_chaos.json
         --fleetobs      fleet-observability overhead A/B
                         (docs/observability.md "Fleet view"): the
                         SAME fixed-seed 2-host lockstep learn, bare
@@ -2164,6 +2172,130 @@ def bench_fleet(out_path=None):
     return report
 
 
+def bench_fleet_chaos(out_path=None):
+    """Control-plane failover lane (docs/fleet.md "failure model &
+    leadership"): how long the fleet is headless after its coordinator
+    dies, as a function of the lease TTL.
+
+    Per TTL, three trials of the chaos choreography on an in-process
+    KV server — no learners, because the coordinator is never on the
+    data path, so the portable number is pure control-plane wall: a
+    leader at term 1 registers 2 hosts and cuts epoch 1; the leader
+    "crashes" (renew loop stops, lease NOT released — a SIGKILL; the
+    TTL must run out); an armed standby polls the lease, wins at term
+    2, rebuilds the member/epoch mirror from the KV table, and cuts
+    the failover epoch. The recorded wall runs kill → failover epoch
+    cut (the moment hosts can resume), and the acceptance gate is
+    median wall < 2x the lease TTL. Every trial also proves the
+    fence: the zombie's stale-term write must raise StaleTermError
+    and land in the store's fenced-write count. A clean-handover
+    trial (lease released on stop) rides along per TTL — its wall is
+    TTL-independent, which is the lane's point: the price of
+    crash-failover IS the TTL you chose.
+
+    Writes benchmarks/e2e/fleet_chaos.json."""
+    import statistics
+
+    from ray_tpu import fleet
+    from ray_tpu.fleet import KVClient, KVServer, StaleTermError
+
+    out_path = out_path or "benchmarks/e2e/fleet_chaos.json"
+    ttls = [0.5, 1.0, 2.0]
+    trials = 3
+
+    def one_trial(ttl, release):
+        server = KVServer(host="127.0.0.1")
+        kv = KVClient(f"127.0.0.1:{server.port}")
+        try:
+            leader = fleet.FleetCoordinator(
+                kv, lease_ttl=ttl, holder="leader", subscribe=False
+            )
+            leader.register_host("host0", rank_hint=0)
+            leader.register_host("host1", rank_hint=1)
+            leader.propose_epoch(reason="bootstrap")
+            standby = fleet.FleetCoordinator(
+                kv,
+                standby=True,
+                lease_ttl=ttl,
+                holder="standby",
+                subscribe=False,
+            )
+            t0 = time.perf_counter()
+            leader.stop(release_lease=release)
+            term = standby.acquire_leadership(timeout=10.0 + 3 * ttl)
+            assert term == 2 and standby.is_leader, term
+            # warm-cache restart: mirror rebuilt from the KV table
+            assert sorted(standby.members()) == ["host0", "host1"]
+            assert standby.current_epoch().gen == 1
+            epoch = standby.propose_epoch(reason="failover")
+            wall = time.perf_counter() - t0
+            assert epoch.gen == 2 and epoch.hosts == (
+                "host0",
+                "host1",
+            ), epoch
+            # split-brain counter-proof: the zombie acts at term 1
+            try:
+                leader._put("fleet/members", {})
+                raise AssertionError("zombie write was not fenced")
+            except StaleTermError:
+                pass
+            info = kv.lease_info(fleet.LEASE_NAME)
+            assert info["fenced_writes"] >= 1, info
+            standby.stop()
+            return wall
+        finally:
+            server.shutdown()
+
+    rows = []
+    for ttl in ttls:
+        kills = [one_trial(ttl, release=False) for _ in range(trials)]
+        clean = one_trial(ttl, release=True)
+        med = statistics.median(kills)
+        # the acceptance gate: a crashed coordinator costs at most
+        # two TTLs of headless fleet (in practice ~1x: lease residue
+        # at kill + the standby's poll cadence of TTL/4)
+        assert med < 2.0 * ttl, (med, ttl)
+        rows.append(
+            {
+                "lease_ttl_s": ttl,
+                "kill_failover_walls_s": [round(w, 3) for w in kills],
+                "kill_failover_median_s": round(med, 3),
+                "clean_handover_wall_s": round(clean, 3),
+                "median_wall_over_ttl": round(med / ttl, 2),
+            }
+        )
+
+    report = {
+        "metric": "fleet_chaos_failover",
+        "failover_by_ttl": rows,
+        "budget": "median kill-failover wall < 2x lease TTL",
+        "fenced_write_proof": (
+            "every trial: the killed leader's term-1 write raised "
+            "StaleTermError and incremented the store's fenced count"
+        ),
+        "config": {
+            "hosts": 2,
+            "trials_per_ttl": trials,
+            "fault_family": [
+                "kv_drop:op@K",
+                "kv_delay:ms@K",
+                "partition_host:H@K",
+                "kill_coordinator:@K",
+            ],
+        },
+        "note": (
+            "clean handover (lease released) is TTL-independent — "
+            "headless time after a crash is dominated by the lease "
+            "residue, so the TTL knob trades steady-state renew "
+            "traffic against worst-case failover wall"
+        ),
+    }
+    with open(out_path, "w") as f:
+        json.dump(report, f, indent=1)
+    print(json.dumps(report))
+    return report
+
+
 def bench_fleetobs_worker():
     """Subprocess entry for the --fleetobs lane (one learner host of a
     2-host gloo CPU fleet). Same rendezvous → epoch → fixed-seed
@@ -4047,6 +4179,9 @@ def main():
         return
     if "--fleetobs" in sys.argv:
         bench_fleetobs()
+        return
+    if "--fleet-chaos" in sys.argv:
+        bench_fleet_chaos()
         return
     if "--fleet" in sys.argv:
         bench_fleet()

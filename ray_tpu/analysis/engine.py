@@ -76,6 +76,9 @@ _FLAG_DIRECTIVES = {
     # RTA009: the sanctioned atomic-write implementation — the ONE
     # place allowed to hand-roll temp + fsync + os.replace
     "atomic-writer",
+    # RTA013: the sanctioned retried KV transport — the ONE place
+    # allowed to touch the raw socket / single-attempt roundtrip
+    "kv-retry-wrapper",
 }
 
 #: the tracing entry points whose function arguments become device

@@ -22,6 +22,7 @@ from ray_tpu.analysis.rules import (
     eventloop,
     hostsync,
     knobs,
+    kvretry,
     lockorder,
     rng,
     rng_order,
@@ -42,6 +43,7 @@ _ALL = [
     catalog,
     rng_order,
     knobs,
+    kvretry,
 ]
 
 RULE_DOCS = {

@@ -23,9 +23,12 @@ Three checks:
   second ``os.fsync``);
 - **raw checkpoint open**: ``open(path, "w"/"wb"/"a")`` where the
   path expression names a checkpoint artifact (``checkpoint`` /
-  ``ckpt`` / ``snapshot`` in an identifier or literal) outside an
-  atomic-writer function is a finding — a truncate-then-write crash
-  window on the exact files the recovery layer trusts.
+  ``ckpt`` / ``snapshot`` — and, since the fenced-leadership PR,
+  ``lease``: the coordinator's lease-term records are what keep a
+  zombie ex-leader fenced across a KV restart) in an identifier or
+  literal outside an atomic-writer function is a finding — a
+  truncate-then-write crash window on the exact files the recovery
+  layer trusts.
 """
 
 from __future__ import annotations
@@ -38,7 +41,9 @@ from ray_tpu.analysis.rules._common import call_name, own_stmts
 
 RULE_ID = "RTA009"
 
-_CKPT_TOKENS = ("checkpoint", "ckpt", "snapshot")
+# "lease" covers the fenced-leadership term records (fleet/kv.py):
+# a torn lease-term file un-fences a zombie coordinator on restart
+_CKPT_TOKENS = ("checkpoint", "ckpt", "snapshot", "lease")
 _DIR_FSYNC_NAMES = {"fsync_dir", "_fsync_dir"}
 
 

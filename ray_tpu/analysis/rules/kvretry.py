@@ -1,0 +1,119 @@
+"""RTA013 — unretried KV transport on a control-plane path.
+
+The fleet KV client's ONE sanctioned path to the wire is the retried
+wrapper (``KVClient._roundtrip``, annotated ``# ray-tpu:
+kv-retry-wrapper``): transient connect/timeout failures back off and
+re-attempt under a bounded per-op deadline, so a control-plane thread
+(HostAgent, HeartbeatReporter, HostExporter) survives a KV restart
+window instead of hanging or dying on the first refused connect
+(docs/fleet.md "Failure model & leadership"). Three ways to defeat
+that contract, each flagged:
+
+- calling the raw single-attempt ``_roundtrip_once`` from a function
+  not itself annotated ``kv-retry-wrapper``;
+- opening a raw socket (``socket.create_connection`` /
+  ``socket.socket``) inside a ``thread=``-annotated control-plane
+  function that is not a sanctioned wrapper;
+- constructing ``KVClient(..., retry=False)`` — a client whose every
+  op is one unretried attempt.
+
+Deliberate raw transport (tests proving retry behavior, one-shot
+probes where failure is the datum) carries
+``# ray-tpu: allow[RTA013] <reason>``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from ray_tpu.analysis.engine import Finding, ModuleModel
+from ray_tpu.analysis.rules._common import own_nodes
+
+RULE_ID = "RTA013"
+
+_RAW_SOCKET_ATTRS = {"create_connection", "socket"}
+
+
+def _is_raw_socket_call(node: ast.Call) -> bool:
+    func = node.func
+    return (
+        isinstance(func, ast.Attribute)
+        and func.attr in _RAW_SOCKET_ATTRS
+        and isinstance(func.value, ast.Name)
+        and func.value.id == "socket"
+    )
+
+
+def _is_kvclient_ctor(node: ast.Call) -> bool:
+    func = node.func
+    if isinstance(func, ast.Name):
+        return func.id == "KVClient"
+    if isinstance(func, ast.Attribute):
+        return func.attr == "KVClient"
+    return False
+
+
+def check(model: ModuleModel) -> List[Finding]:
+    findings: List[Finding] = []
+    for fi in model.funcs:
+        wrapper = "kv-retry-wrapper" in fi.directives
+        for node in own_nodes(fi):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            if (
+                isinstance(func, ast.Attribute)
+                and func.attr == "_roundtrip_once"
+                and not wrapper
+            ):
+                f = model.finding(
+                    RULE_ID,
+                    node,
+                    f"`{fi.qualname}` calls the raw single-attempt "
+                    "`_roundtrip_once` outside a `# ray-tpu: "
+                    "kv-retry-wrapper` function — one refused connect "
+                    "during a KV restart kills this path; go through "
+                    "the retried `_roundtrip`",
+                )
+                if f:
+                    findings.append(f)
+            elif (
+                fi.thread is not None
+                and not wrapper
+                and _is_raw_socket_call(node)
+            ):
+                f = model.finding(
+                    RULE_ID,
+                    node,
+                    f"`{fi.qualname}` (thread={fi.thread}) opens a raw "
+                    "socket on a control-plane thread — route KV ops "
+                    "through the retried KVClient transport (or "
+                    "annotate the sanctioned wrapper `# ray-tpu: "
+                    "kv-retry-wrapper`)",
+                )
+                if f:
+                    findings.append(f)
+    # module-level and in-function KVClient(..., retry=False)
+    for node in ast.walk(model.tree):
+        if not isinstance(node, ast.Call) or not _is_kvclient_ctor(
+            node
+        ):
+            continue
+        for kw in node.keywords:
+            if (
+                kw.arg == "retry"
+                and isinstance(kw.value, ast.Constant)
+                and kw.value.value is False
+            ):
+                f = model.finding(
+                    RULE_ID,
+                    node,
+                    "`KVClient(..., retry=False)` builds an unretried "
+                    "transport: every op is a single attempt that dies "
+                    "on a KV restart window — drop the kwarg (default "
+                    "schedule) or justify with an allow",
+                )
+                if f:
+                    findings.append(f)
+    return findings

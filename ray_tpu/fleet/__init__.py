@@ -17,6 +17,13 @@ Modules (docs/fleet.md):
   protocol, epoch-scoped barriers;
 - :mod:`~ray_tpu.fleet.elastic`     resize/pre-seed primitives over
   the reshard contract and the AOT cache.
+
+Crash tolerance (PR 19): the coordinator's authority is a fenced KV
+lease (``LEASE_NAME``) — standbys acquire it on expiry and rebuild
+from the durable KV table, stale-term writes are rejected at the
+store (:class:`StaleTermError`), the KV transport retries with
+backoff, and partitioned hosts self-fence at their epoch barrier
+(docs/fleet.md "Failure model & leadership").
 """
 
 from ray_tpu.fleet.coordinator import (
@@ -27,6 +34,8 @@ from ray_tpu.fleet.coordinator import (
     EPOCH_TIMEOUT_ENV,
     HEARTBEAT_ENV,
     HORIZON_ENV,
+    LEASE_NAME,
+    LEASE_TTL_ENV,
     FleetCoordinator,
     HostAgent,
     K_EPOCH_PTR,
@@ -44,12 +53,15 @@ from ray_tpu.fleet.elastic import (
     preseed_resize,
     resize_policy,
     resize_target_meshes,
+    resync_epoch,
     shadow_policy,
 )
 from ray_tpu.fleet.kv import (
+    KV_RETRY_ENV,
     HeartbeatReporter,
     KVClient,
     KVServer,
+    StaleTermError,
     Subscriber,
 )
 
@@ -66,11 +78,15 @@ __all__ = [
     "HostAgent",
     "KVClient",
     "KVServer",
+    "KV_RETRY_ENV",
     "K_EPOCH_PTR",
     "K_MEMBERS",
     "K_READY",
+    "LEASE_NAME",
+    "LEASE_TTL_ENV",
     "MeshEpoch",
     "PRESEED_ENV",
+    "StaleTermError",
     "Subscriber",
     "barrier_key",
     "drain_key",
@@ -80,5 +96,6 @@ __all__ = [
     "preseed_resize",
     "resize_policy",
     "resize_target_meshes",
+    "resync_epoch",
     "shadow_policy",
 ]

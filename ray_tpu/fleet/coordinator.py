@@ -17,6 +17,19 @@ ACTS (mutates the member table, posts drains, cuts epochs). All KV
 writes happen on the driver thread of the one coordinator process, so
 the member table and epoch sequence have a single writer.
 
+Leadership (PR 19, docs/fleet.md "Failure model & leadership"): the
+"one coordinator" is now enforced by a fenced KV lease, not by
+deployment discipline. A coordinator holds the ``fleet/leader`` lease
+and stamps every fleet write with its term; the KV store rejects
+writes whose term predates the lease's, so a deposed-but-still-running
+ex-coordinator cannot corrupt the member table or cut a conflicting
+epoch (:class:`~ray_tpu.fleet.kv.StaleTermError` is its signal to
+stand down). Any host can run a ``standby=True`` coordinator: it
+polls ``acquire_leadership()`` until the incumbent's lease expires,
+then rebuilds the member/epoch mirror from the persisted KV table
+and takes over at a higher term — failover is a warm-cache restart of
+the control plane, the same shape PR 17 gave the data plane.
+
 Epoch/drain choreography on a preemption notice for a learner host::
 
     host   announce_notice() ── publish fleet/notice ──▶ coordinator
@@ -48,6 +61,7 @@ from typing import Any, Dict, List, Optional, Tuple
 from ray_tpu.fleet.kv import (
     HeartbeatReporter,
     KVClient,
+    StaleTermError,
     Subscriber,
 )
 
@@ -56,6 +70,9 @@ from ray_tpu.fleet.kv import (
 K_MEMBERS = "fleet/members"  # {host: {"rank_hint": int, ...}}
 K_EPOCH_PTR = "fleet/epoch"  # latest generation number (int)
 K_READY = "fleet/ready"  # coordinator's subscriber is registered
+
+LEASE_NAME = "fleet/leader"  # the coordinator's fenced lease
+LEASE_TTL_ENV = "RAY_TPU_FLEET_LEASE_TTL_S"
 
 
 def epoch_key(gen: int) -> str:
@@ -143,20 +160,48 @@ class FleetCoordinator:
     applies them to the member table and cuts epochs. Unit-testable
     without meshes: events can also be injected directly via
     ``register_host`` / ``remove_host`` / ``handle_notice`` from the
-    driver thread."""
+    driver thread.
+
+    Leadership: construction with ``standby=False`` acquires the
+    ``fleet/leader`` lease immediately (blocking past an incumbent's
+    TTL if one exists); ``standby=True`` builds a dormant coordinator
+    that does nothing until ``acquire_leadership()`` wins the lease —
+    at which point it rebuilds the member/epoch mirror from the KV
+    table and becomes the single writer at a HIGHER term. Every fleet
+    write carries the term (``_put``), so a deposed leader's writes
+    are fenced at the store; a fenced write or failed renewal flips
+    ``is_leader`` off and the ex-leader must stop acting."""
 
     def __init__(
         self,
         kv: KVClient,
         liveness_horizon: Optional[float] = None,
         subscribe: bool = True,
+        standby: bool = False,
+        lease_ttl: Optional[float] = None,
+        holder: Optional[str] = None,
     ):
+        import socket as _socket
+
         self.kv = kv
         self.horizon = (
             liveness_horizon
             if liveness_horizon is not None
             else _env_s(HORIZON_ENV, 30.0)
         )
+        self.lease_ttl = (
+            lease_ttl
+            if lease_ttl is not None
+            else _env_s(LEASE_TTL_ENV, 10.0)
+        )
+        # holder identity is per-PROCESS: a restarted coordinator on
+        # the same host is a different holder and must re-acquire
+        self._holder = holder or f"{_socket.gethostname()}:{os.getpid()}"
+        self._subscribe = subscribe
+        self._term = 0
+        self._leader = False
+        self._renew_stop = threading.Event()
+        self._renew_thread: Optional[threading.Thread] = None
         # one lock guards the event queue AND the member/epoch mirror;
         # never held across KV round trips
         self._lock = threading.Lock()
@@ -165,31 +210,160 @@ class FleetCoordinator:
         self._gen = 0
         self._epoch: Optional[MeshEpoch] = None
         self._sub: Optional[Subscriber] = None
-        # recover state from a previous coordinator's KV writes (the
-        # KV table may be persistent — RAY_TPU_KV_PERSIST)
-        try:
-            self._members = dict(kv.get(K_MEMBERS, timeout=0.1))
-        except KeyError:
-            pass
-        try:
-            self._gen = int(kv.get(K_EPOCH_PTR, timeout=0.1))
-            self._epoch = MeshEpoch.from_dict(
-                kv.get(epoch_key(self._gen), timeout=1.0)
+        from ray_tpu.resilience.faults import kv_injector
+
+        self._chaos = kv_injector()
+        if not standby:
+            # blocking acquire: waits out an incumbent's TTL at most
+            self.acquire_leadership(
+                timeout=max(30.0, 3.0 * self.lease_ttl)
             )
+
+    # -- leadership ----------------------------------------------------
+
+    @property
+    def is_leader(self) -> bool:
+        return self._leader
+
+    @property
+    def term(self) -> int:
+        return self._term
+
+    # ray-tpu: thread=driver
+    def acquire_leadership(
+        self,
+        timeout: Optional[float] = None,
+        poll_interval: Optional[float] = None,
+    ) -> int:
+        """Poll the lease until granted (a standby's whole job), then
+        become leader: rebuild state from KV, start renewals,
+        subscribe, and write the readiness gate — all at the granted
+        term. Returns the term. Idempotent while already leader."""
+        if self._leader:
+            return self._term
+        poll = (
+            poll_interval
+            if poll_interval is not None
+            else max(0.1, self.lease_ttl / 4.0)
+        )
+        deadline = (
+            time.monotonic() + timeout if timeout is not None else None
+        )
+        while True:
+            resp = self.kv.lease_acquire(
+                LEASE_NAME, self._holder, ttl=self.lease_ttl
+            )
+            if resp.get("granted"):
+                self._become_leader(int(resp["term"]))
+                return self._term
+            if deadline is not None and time.monotonic() >= deadline:
+                raise TimeoutError(
+                    f"lease {LEASE_NAME} held by "
+                    f"{resp.get('holder')!r} (term {resp.get('term')}, "
+                    f"expires in {resp.get('expires_in', 0):.1f}s)"
+                )
+            # wait for the incumbent's TTL to run out, but re-probe
+            # well inside it — failover wall is what --fleet-chaos
+            # measures against the TTL
+            time.sleep(min(poll, max(0.05, resp.get("expires_in", poll))))
+
+    # ray-tpu: thread=driver
+    def _become_leader(self, term: int) -> None:
+        """The warm-cache restart of the control plane: mirror the
+        durable KV state (member table, epoch pointer + record), then
+        start acting at the new term. Members re-prove liveness via
+        heartbeats — the mirror is a starting guess the next
+        ``expire_dead`` sweep corrects."""
+        promoted = self._term != 0 and term > self._term
+        self._term = term
+        self._leader = True
+        with self._lock:
+            self._members = {}
+            self._gen, self._epoch = 0, None
+        try:
+            members = dict(self.kv.get(K_MEMBERS, timeout=0.1))
+            with self._lock:
+                self._members = members
         except KeyError:
             pass
-        if subscribe:
+        try:
+            gen = int(self.kv.get(K_EPOCH_PTR, timeout=0.1))
+            epoch = MeshEpoch.from_dict(
+                self.kv.get(epoch_key(gen), timeout=1.0)
+            )
+            with self._lock:
+                self._gen, self._epoch = gen, epoch
+        except KeyError:
+            pass
+        if self._subscribe and self._sub is None:
             self._sub = Subscriber(
-                kv,
+                self.kv,
                 ["fleet/*"],
                 self._on_event,
-                sub_id="fleet-coordinator",
+                sub_id=f"fleet-coordinator-{self._holder}",
                 poll_timeout=1.0,
             )
+        self._renew_stop.clear()
+        self._renew_thread = threading.Thread(
+            target=self._renew_loop, daemon=True
+        )
+        self._renew_thread.start()
+        from ray_tpu.telemetry import metrics
+
+        host = self._holder.rsplit(":", 1)[0]
+        try:
+            metrics.set_coordinator_term(host, term)
+            if promoted or term > 1:
+                metrics.inc_fleet_failover(host)
+        except Exception:
+            pass
         # readiness gate, written AFTER the subscriber is registered:
         # agents block on it before announcing, so a join can never be
-        # published into a void (pubsub only reaches live subscribers)
-        kv.put(K_READY, time.time())
+        # published into a void (pubsub only reaches live subscribers).
+        # First fenced write — a stale takeover dies right here.
+        self._put(K_READY, time.time())
+
+    # ray-tpu: thread=lease-renew
+    def _renew_loop(self) -> None:
+        """Renew the lease every TTL/3. A refused renewal means the
+        lease expired or a rival took over at a higher term — flip
+        ``is_leader`` off and stop; the driver notices via
+        ``is_leader`` (or the next ``_put`` being fenced)."""
+        while not self._renew_stop.wait(self.lease_ttl / 3.0):
+            try:
+                ok = self.kv.lease_renew(
+                    LEASE_NAME,
+                    self._holder,
+                    self._term,
+                    ttl=self.lease_ttl,
+                )
+            except Exception:
+                # KV unreachable past the retry schedule: keep trying
+                # until the TTL verdict is knowable again; writes stay
+                # term-fenced either way
+                continue
+            if not ok:
+                self._leader = False
+                return
+
+    def _put(self, key: str, value: Any) -> None:
+        """Every coordinator write goes through here: term-fenced, and
+        armed for ``kill_coordinator`` chaos. A fenced rejection means
+        leadership is gone — record it and re-raise so the caller's
+        control flow stops acting on the fleet."""
+        if self._chaos is not None:
+            self._chaos.on_coordinator_write()
+        try:
+            self.kv.put(
+                key,
+                value,
+                term=self._term,
+                lease=LEASE_NAME,
+                holder=self._holder,
+            )
+        except StaleTermError:
+            self._leader = False
+            raise
 
     # ray-tpu: thread=fleet-sub
     def _on_event(self, channel: str, msg: Dict[str, Any]) -> None:
@@ -234,14 +408,14 @@ class FleetCoordinator:
                 "joined_at": time.time(),
             }
             snapshot = dict(self._members)
-        self.kv.put(K_MEMBERS, snapshot)
+        self._put(K_MEMBERS, snapshot)
 
     # ray-tpu: thread=driver
     def remove_host(self, host: str, reason: str = "leave") -> None:
         with self._lock:
             self._members.pop(host, None)
             snapshot = dict(self._members)
-        self.kv.put(K_MEMBERS, snapshot)
+        self._put(K_MEMBERS, snapshot)
 
     # ray-tpu: thread=driver
     def handle_notice(
@@ -257,7 +431,7 @@ class FleetCoordinator:
             if host not in self._members:
                 return None
             gen = self._gen
-        self.kv.put(
+        self._put(
             drain_key(gen),
             {"victims": [host], "reason": reason, "ts": time.time()},
         )
@@ -294,8 +468,8 @@ class FleetCoordinator:
         )
         # record first, pointer second: a reader following the pointer
         # always finds the record
-        self.kv.put(epoch_key(gen), epoch.to_dict())
-        self.kv.put(K_EPOCH_PTR, gen)
+        self._put(epoch_key(gen), epoch.to_dict())
+        self._put(K_EPOCH_PTR, gen)
         with self._lock:
             self._gen, self._epoch = gen, epoch
         from ray_tpu.telemetry import metrics
@@ -349,10 +523,24 @@ class FleetCoordinator:
             return self._epoch
 
     # ray-tpu: thread=driver
-    def stop(self) -> None:
+    def stop(self, release_lease: bool = True) -> None:
+        """Clean shutdown: stop renewing, unsubscribe, and (unless
+        simulating a crash — tests pass ``release_lease=False``) hand
+        the lease back so a standby takes over immediately instead of
+        waiting out the TTL."""
+        self._renew_stop.set()
+        if self._renew_thread is not None:
+            self._renew_thread.join(timeout=self.lease_ttl)
+            self._renew_thread = None
         if self._sub is not None:
             self._sub.stop()
             self._sub = None
+        if self._leader and release_lease:
+            try:
+                self.kv.lease_release(LEASE_NAME, self._holder)
+            except Exception:
+                pass
+        self._leader = False
 
 
 class HostAgent:
@@ -360,7 +548,16 @@ class HostAgent:
     announcements, epoch observation, and epoch-scoped barriers. Holds
     no authority — every decision is the coordinator's; the agent only
     announces and observes, so any host can crash at any point without
-    corrupting the member table."""
+    corrupting the member table.
+
+    Partition self-fencing: a host whose KV heartbeats have failed
+    past the liveness horizon must assume the coordinator already
+    declared it dead and cut an epoch without it. ``self_fenced()``
+    detects that state; ``park_until_reconnected()`` is what the
+    host's step loop calls INSTEAD of dispatching supersteps — it
+    probes KV until reachable, reads the epoch pointer, and reports
+    whether the host may resume in-epoch (the fleet didn't move on)
+    or must rejoin at the new generation."""
 
     def __init__(
         self,
@@ -504,6 +701,75 @@ class HostAgent:
                     f"fleet barrier '{name}' gen={epoch.gen}: host "
                     f"{peer} missing after {timeout}s"
                 )
+
+    # ray-tpu: thread=driver
+    def kv_outage_s(self) -> float:
+        """Monotonic seconds since KV last acknowledged a heartbeat."""
+        return self._hb.seconds_since_ok()
+
+    # ray-tpu: thread=driver
+    def self_fenced(self, horizon: Optional[float] = None) -> bool:
+        """True when this host has been cut off from KV longer than
+        the liveness horizon — the coordinator's ``expire_dead`` sweep
+        may already have removed it, so dispatching another superstep
+        against a possibly-reformed mesh would be a split-brain step.
+        The honest move is to park (below)."""
+        horizon = (
+            horizon if horizon is not None else _env_s(HORIZON_ENV, 30.0)
+        )
+        return self.kv_outage_s() > horizon
+
+    # ray-tpu: thread=driver
+    def park_until_reconnected(
+        self,
+        epoch: MeshEpoch,
+        timeout: Optional[float] = None,
+        probe_interval: float = 0.5,
+    ) -> Tuple[MeshEpoch, bool]:
+        """Sit out the partition at the epoch barrier line. Probes KV
+        (cheap ``clock`` op) until it answers, then reads the epoch
+        pointer: if the fleet is still on ``epoch.gen`` the host
+        resumes in-epoch — returns ``(epoch, True)``; if the fleet cut
+        a new generation while we were gone, returns the new epoch and
+        ``False`` (the caller must rejoin/rebuild, fleet/elastic.py).
+        Counted in ``ray_tpu_fleet_self_fences_total{host}``."""
+        timeout = (
+            timeout
+            if timeout is not None
+            else _env_s(EPOCH_TIMEOUT_ENV, 120.0)
+        )
+        from ray_tpu.telemetry import metrics
+
+        try:
+            metrics.inc_self_fence(self.host)
+        except Exception:
+            pass
+        deadline = time.monotonic() + timeout
+        while True:
+            try:
+                self.kv.server_clock()
+                break
+            except Exception:
+                if time.monotonic() >= deadline:
+                    raise TimeoutError(
+                        f"host {self.host}: KV unreachable for "
+                        f"{self.kv_outage_s():.1f}s while parked "
+                        f"(waited {timeout}s)"
+                    )
+                time.sleep(probe_interval)
+        # reconnected: immediately re-prove liveness, then find out
+        # whether the fleet moved on without us
+        try:
+            self.kv.heartbeat(self.host)
+        except Exception:
+            pass
+        try:
+            gen = int(self.kv.get(K_EPOCH_PTR, timeout=5.0))
+        except KeyError:
+            return epoch, True  # no epochs cut at all: nothing moved
+        if gen == epoch.gen:
+            return epoch, True
+        return self.wait_for_epoch(gen), False
 
     # ray-tpu: thread=driver
     def stop(self) -> None:

@@ -166,6 +166,26 @@ def resize_target_meshes(mesh) -> List:
     return [sharding_lib.get_mesh(devices=local)]
 
 
+def resync_epoch(kv, current_gen: int, timeout: float = 30.0) -> MeshEpoch:
+    """Catch up with the fleet after an absence (a parked partition, a
+    coordinator failover window): follow the epoch pointer to the
+    LATEST generation ≥ ``current_gen`` and return its record. The
+    pointer is written after the record (coordinator invariant), so a
+    readable pointer always resolves. A host that finds the returned
+    generation differs from ``current_gen`` must rebuild via
+    ``resize_policy``/``epoch_mesh`` before stepping — its old epoch's
+    barriers are dead keys that can never complete."""
+    from ray_tpu.fleet.coordinator import K_EPOCH_PTR, epoch_key
+
+    gen = int(kv.get(K_EPOCH_PTR, timeout=timeout))
+    if gen < current_gen:
+        # a fresh KV (post-crash, unpersisted) can point backwards;
+        # our generation knowledge wins — wait for the fleet to catch
+        # up to where we already were
+        gen = current_gen
+    return MeshEpoch.from_dict(kv.get(epoch_key(gen), timeout=timeout))
+
+
 def epoch_mesh(epoch: MeshEpoch):
     """The mesh for one :class:`MeshEpoch`. A single-host epoch builds
     over this process's local devices (the survivor path of a shrink —

@@ -10,6 +10,29 @@ gcs_kv_manager.cc``), heartbeat liveness (``gcs_heartbeat_manager.h:
 33``), and long-poll pubsub (``src/ray/pubsub/publisher.h:298``) —
 over plain TCP. ``ray_tpu.parallel.distributed`` re-exports every
 public name for back-compat.
+
+Crash tolerance (PR 19, docs/fleet.md "Failure model & leadership"):
+
+- **liveness is monotonic** — heartbeat stamps and expiry run on
+  ``time.monotonic()`` server-side, so an NTP step cannot mass-expire
+  or immortalize the fleet; wall time survives only in the ``clock``
+  op (the fleetview skew handshake IS about wall clocks);
+- **fenced leases** — the ``lease`` op grants named leases with a
+  monotonically increasing term (terms are persisted, so fencing
+  survives a KV restart); a ``put`` carrying a ``term`` older than the
+  lease's current term is rejected at the store
+  (:class:`StaleTermError` client-side), so a zombie ex-coordinator
+  physically cannot split-brain the fleet;
+- **retried transport** — every client op routes through a
+  :class:`~ray_tpu.resilience.retry.RetryPolicy` (transient
+  connect/timeout failures back off and retry under one bounded
+  per-op deadline; all ops are idempotent, so blind retry is safe);
+  disable with ``RAY_TPU_KV_RETRY=0``;
+- **chaos-armable** — the transport consults the fleet fault family
+  of :mod:`ray_tpu.resilience.faults` (``kv_drop``/``kv_delay``/
+  ``partition_host`` via ``RAY_TPU_FAULTS``) once per attempt, so the
+  retry/fencing claims are proven by deterministic injection, not
+  hope.
 """
 
 from __future__ import annotations
@@ -22,6 +45,40 @@ import socketserver
 import threading
 import time
 from typing import Any, Dict, Optional
+
+KV_RETRY_ENV = "RAY_TPU_KV_RETRY"  # "0" = raw, unretried transport
+KV_RETRY_ATTEMPTS_ENV = "RAY_TPU_KV_RETRY_ATTEMPTS"
+
+
+class StaleTermError(RuntimeError):
+    """A lease-fenced ``put`` carried a term older than the store's —
+    the writer lost leadership and must stop acting on the fleet."""
+
+
+def _default_retry_policy():
+    """The transport's env-tuned retry schedule (None = disabled).
+    Lazy import: ``fleet.kv`` must stay importable without dragging in
+    the whole resilience/recovery stack at module load."""
+    if os.environ.get(KV_RETRY_ENV, "1").strip().lower() in (
+        "0",
+        "false",
+        "off",
+    ):
+        return None
+    from ray_tpu.resilience.retry import RetryPolicy
+
+    try:
+        attempts = int(os.environ.get(KV_RETRY_ATTEMPTS_ENV, 4))
+    except ValueError:
+        attempts = 4
+    return RetryPolicy(
+        max_attempts=max(1, attempts),
+        timeout_s=None,
+        backoff_s=0.05,
+        backoff_mult=2.0,
+        max_backoff_s=1.0,
+        jitter=0.1,
+    )
 
 
 def _request_hmac(token: str, req: Dict) -> str:
@@ -71,6 +128,95 @@ def _channel_match(channel: str, patterns) -> bool:
     return False
 
 
+def _lease_op(store, req: Dict) -> Dict:
+    """The ``lease`` op: named leases with monotonically increasing
+    terms (the GCS-leadership half of the reference's fault-tolerance
+    story, done as fencing tokens instead of an external leader
+    elector).
+
+    - ``acquire``: granted when the lease is free, expired, or already
+      held by this holder. A grant that isn't a same-holder refresh
+      **bumps the term** (and persists it — fencing survives a KV
+      restart); a refused acquire reports the current holder and time
+      to expiry so a standby knows how long to wait.
+    - ``renew``: extends the expiry ONLY for the live holder at the
+      current term — an expired or superseded leader's renew comes
+      back ``granted: false``, which is how it learns to stop acting.
+    - ``release``: drops the holder (term stays — the next acquire
+      still bumps past it).
+    - ``info``: term/holder/expiry plus the store's fenced-write
+      count (the split-brain writes that did NOT happen).
+
+    Liveness/expiry runs on the server's monotonic clock, same as
+    heartbeats."""
+    action = req.get("action", "info")
+    name = req.get("name", "fleet/leader")
+    holder = req.get("holder", "")
+    ttl = float(req.get("ttl", 10.0))
+    now = store._mono()
+    with store.lock:
+        cur = store.leases.get(name)
+        term = store.lease_terms.get(name, 0)
+        held = cur is not None and now < cur["expires"]
+        if action == "acquire":
+            if held and cur["holder"] != holder:
+                return {
+                    "ok": True,
+                    "granted": False,
+                    "term": term,
+                    "holder": cur["holder"],
+                    "expires_in": cur["expires"] - now,
+                }
+            if not (held and cur["holder"] == holder):
+                term += 1
+                store.lease_terms[name] = term
+                if store.persist is not None:
+                    store.persist.put(
+                        "lease", name, pickle.dumps({"term": term})
+                    )
+            store.leases[name] = {
+                "holder": holder,
+                "expires": now + ttl,
+                "ttl": ttl,
+            }
+            return {
+                "ok": True,
+                "granted": True,
+                "term": term,
+                "holder": holder,
+            }
+        if action == "renew":
+            if (
+                held
+                and cur["holder"] == holder
+                and int(req.get("term", -1)) == term
+            ):
+                cur["expires"] = now + ttl
+                return {
+                    "ok": True,
+                    "granted": True,
+                    "term": term,
+                    "holder": holder,
+                }
+            return {
+                "ok": True,
+                "granted": False,
+                "term": term,
+                "holder": cur["holder"] if held else None,
+            }
+        if action == "release":
+            if cur is not None and cur["holder"] == holder:
+                store.leases.pop(name, None)
+            return {"ok": True, "granted": True, "term": term}
+        return {
+            "ok": True,
+            "term": term,
+            "holder": cur["holder"] if held else None,
+            "expires_in": (cur["expires"] - now) if held else 0.0,
+            "fenced_writes": store.fenced_writes,
+        }
+
+
 class _KVHandler(socketserver.StreamRequestHandler):
     def handle(self):
         store = self.server.kv_store  # type: ignore[attr-defined]
@@ -103,6 +249,31 @@ class _KVHandler(socketserver.StreamRequestHandler):
                         b'{"ok": false, "error": "bad body digest"}\n'
                     )
                     return
+                term = req.get("term")
+                if term is not None:
+                    # lease-fenced write: reject at the store when the
+                    # writer's term predates the lease's — the one
+                    # mechanism that makes a zombie ex-coordinator
+                    # harmless no matter what it believes
+                    lease_name = req.get("lease", "fleet/leader")
+                    with store.lock:
+                        cur_term = store.lease_terms.get(lease_name, 0)
+                        stale = int(term) < cur_term
+                        if stale:
+                            store.fenced_writes += 1
+                    if stale:
+                        self.wfile.write(
+                            json.dumps(
+                                {
+                                    "ok": False,
+                                    "error": "stale term",
+                                    "fenced": True,
+                                    "term": cur_term,
+                                }
+                            ).encode()
+                            + b"\n"
+                        )
+                        return
                 with store.lock:
                     store.data[req["key"]] = blob
                     if store.persist is not None:
@@ -203,22 +374,27 @@ class _KVHandler(socketserver.StreamRequestHandler):
                 for _, b in batch:
                     self.wfile.write(b)
             elif op == "heartbeat":
+                # liveness runs on the MONOTONIC clock (store._mono):
+                # an NTP step of the wall clock must not mass-expire
+                # (step forward) or immortalize (step back) the fleet
                 with store.lock:
-                    store.heartbeats[req["node"]] = time.time()
+                    store.heartbeats[req["node"]] = store._mono()
                 self.wfile.write(b'{"ok": true}\n')
             elif op == "clock":
                 # the fleet's reference clock: the KV server runs on
                 # the coordinator host, so this one stamp is what the
-                # fleetview skew handshake corrects every host toward
+                # fleetview skew handshake corrects every host toward.
+                # Wall clock ON PURPOSE — skew correction is about
+                # wall clocks; liveness never touches this.
                 self.wfile.write(
                     json.dumps(
-                        {"ok": True, "ts": time.time()}
+                        {"ok": True, "ts": store._wall()}
                     ).encode()
                     + b"\n"
                 )
             elif op == "nodes":
                 horizon = req.get("horizon", 30.0)
-                now = time.time()
+                now = store._mono()
                 with store.lock:
                     alive = {
                         n: now - t
@@ -228,6 +404,10 @@ class _KVHandler(socketserver.StreamRequestHandler):
                 self.wfile.write(
                     json.dumps({"ok": True, "alive": alive}).encode()
                     + b"\n"
+                )
+            elif op == "lease":
+                self.wfile.write(
+                    json.dumps(_lease_op(store, req)).encode() + b"\n"
                 )
         except Exception:
             try:
@@ -252,7 +432,10 @@ class KVServer:
     (reference: GCS fault tolerance via external Redis,
     ``gcs/store_client/redis_store_client.h:27``,
     ``test_gcs_fault_tolerance.py``). Heartbeats stay volatile by
-    design — liveness must be re-proven after a restart."""
+    design — liveness must be re-proven after a restart. Lease TERMS
+    are durable (fencing must survive a KV restart: a zombie's stale
+    term stays stale); lease holders/expiries are volatile — after a
+    restart leadership is re-acquired, never assumed."""
 
     def __init__(
         self,
@@ -275,7 +458,27 @@ class KVServer:
         self.data: Dict[str, bytes] = (
             dict(self.persist.all("kv")) if self.persist else {}
         )
-        self.heartbeats: Dict[str, float] = {}
+        # liveness/lease clocks, injectable so tests can STEP the wall
+        # clock and prove liveness doesn't care: _mono owns heartbeat
+        # stamps, expiry sweeps, and lease TTLs; _wall exists only for
+        # the `clock` op (the fleetview skew handshake)
+        self._mono = time.monotonic
+        self._wall = time.time
+        self.heartbeats: Dict[str, float] = {}  # node -> _mono() stamp
+        # named leases: holder/expiry are volatile, TERMS are durable
+        # (reloaded below) — a restarted KV must still fence the old
+        # leader's writes even though leadership itself lapsed
+        self.leases: Dict[str, Dict[str, Any]] = {}
+        self.lease_terms: Dict[str, int] = {}
+        self.fenced_writes = 0
+        if self.persist is not None:
+            for name, blob in self.persist.all("lease").items():
+                try:
+                    self.lease_terms[name] = int(
+                        pickle.loads(blob)["term"]
+                    )
+                except Exception:
+                    pass
         # pubsub fan-out: subscriber id -> {channels, queue, dropped}.
         # Queues are bounded (drop-oldest, counted) so one stalled
         # subscriber cannot hold the coordinator's memory hostage —
@@ -316,14 +519,72 @@ class KVServer:
 
 
 class KVClient:
-    """Client for KVServer (usable from any host)."""
+    """Client for KVServer (usable from any host).
 
-    def __init__(self, address: str, token: Optional[str] = None):
+    Transport is retried by default: transient connect/timeout
+    failures back off and re-attempt on the
+    :class:`~ray_tpu.resilience.retry.RetryPolicy` schedule under one
+    bounded per-op deadline (every KV op is idempotent — last-write-
+    wins puts, keyed barrier/drain records — so blind retry is safe).
+    ``node`` is this client's host identity, used for retry/reconnect
+    metric labels and ``partition_host`` chaos matching."""
+
+    def __init__(
+        self,
+        address: str,
+        token: Optional[str] = None,
+        retry: Any = None,
+        node: Optional[str] = None,
+    ):
         host, port = address.rsplit(":", 1)
         self.host, self.port = host, int(port)
         self.token = token or os.environ.get("RAY_TPU_KV_TOKEN")
+        self.node = node or socket.gethostname()
+        # retry: None = env default schedule, False = raw transport,
+        # or an explicit RetryPolicy
+        if retry is None:
+            retry = _default_retry_policy()
+        elif retry is False:
+            retry = None
+        self._retry = retry
+        from ray_tpu.resilience.faults import kv_injector
 
+        self._chaos = kv_injector()
+
+    # ray-tpu: kv-retry-wrapper
     def _roundtrip(self, req: Dict, payload: bytes = b"") -> Any:
+        """The retried transport (the ONE sanctioned path to the wire
+        — RTA013): route each attempt through the policy with a
+        deadline of the op timeout plus one connect window, so a
+        control-plane thread's op costs O(op timeout) even across a KV
+        restart, never O(attempts x timeout) and never forever."""
+        if self._retry is None:
+            return self._roundtrip_once(req, payload)
+        op = req["op"]
+
+        def _on_retry(attempt, err):
+            from ray_tpu.telemetry import metrics as _tm
+
+            try:
+                _tm.inc_kv_retries(self.node, op)
+            except Exception:
+                pass
+
+        deadline = float(req.get("timeout", 30.0)) + 60.0
+        return self._retry.call(
+            lambda: self._roundtrip_once(req, payload),
+            retry_on=(ConnectionError, TimeoutError, OSError),
+            on_retry=_on_retry,
+            deadline_s=deadline,
+        )
+
+    # ray-tpu: kv-retry-wrapper
+    def _roundtrip_once(self, req: Dict, payload: bytes = b"") -> Any:
+        """One raw socket attempt. Only the retried wrapper above may
+        call this (RTA013) — a bare attempt on a control-plane thread
+        dies on the first KV restart window."""
+        if self._chaos is not None:
+            self._chaos.on_kv_op(self.node, req["op"])
         if self.token is not None:
             if payload:
                 req = dict(req, body=_body_digest(payload))
@@ -346,11 +607,42 @@ class KVClient:
                 resp["blobs"] = [f.read(n) for n in resp["lens"]]
             return resp
 
-    def put(self, key: str, value: Any) -> None:
+    def put(
+        self,
+        key: str,
+        value: Any,
+        term: Optional[int] = None,
+        lease: Optional[str] = None,
+        holder: Optional[str] = None,
+    ) -> None:
+        """Last-write-wins put. With ``term`` the write is LEASE-
+        FENCED: the server rejects it (:class:`StaleTermError`) when
+        the term predates the named lease's current term — the
+        coordinator passes its term on every write so a deposed
+        leader's writes die at the store."""
         blob = pickle.dumps(value)
-        self._roundtrip(
-            {"op": "put", "key": key, "len": len(blob)}, blob
-        )
+        req: Dict[str, Any] = {
+            "op": "put",
+            "key": key,
+            "len": len(blob),
+        }
+        if term is not None:
+            req["term"] = int(term)
+            req["holder"] = holder or self.node
+            if lease is not None:
+                req["lease"] = lease
+        resp = self._roundtrip(req, blob)
+        if resp.get("fenced"):
+            from ray_tpu.telemetry import metrics as _tm
+
+            try:
+                _tm.inc_fleet_fenced_write(holder or self.node)
+            except Exception:
+                pass
+            raise StaleTermError(
+                f"fenced write to {key!r}: term {term} predates "
+                f"store term {resp.get('term')} — leadership lost"
+            )
 
     def get(self, key: str, timeout: float = 30.0) -> Any:
         resp = self._roundtrip(
@@ -413,13 +705,78 @@ class KVClient:
             "alive"
         ]
 
+    # -- fenced leases (see _lease_op for the state machine) -----------
+
+    def lease_acquire(
+        self, name: str, holder: str, ttl: float = 10.0
+    ) -> Dict[str, Any]:
+        """Try to take the named lease. Returns the op's full verdict:
+        ``granted`` plus ``term`` on success; ``holder``/``expires_in``
+        of the incumbent on refusal (so a standby knows how long to
+        wait before re-probing)."""
+        return self._roundtrip(
+            {
+                "op": "lease",
+                "action": "acquire",
+                "name": name,
+                "holder": holder,
+                "ttl": ttl,
+            }
+        )
+
+    def lease_renew(
+        self, name: str, holder: str, term: int, ttl: float = 10.0
+    ) -> bool:
+        """Extend the lease — granted only for the live holder at the
+        current term. False means leadership is gone (expired or
+        superseded): stop acting."""
+        return bool(
+            self._roundtrip(
+                {
+                    "op": "lease",
+                    "action": "renew",
+                    "name": name,
+                    "holder": holder,
+                    "term": int(term),
+                    "ttl": ttl,
+                }
+            ).get("granted")
+        )
+
+    def lease_release(self, name: str, holder: str) -> None:
+        """Voluntarily drop the lease (clean shutdown): the next
+        acquire is granted immediately instead of waiting out the TTL.
+        The term survives — release never un-fences."""
+        self._roundtrip(
+            {
+                "op": "lease",
+                "action": "release",
+                "name": name,
+                "holder": holder,
+            }
+        )
+
+    def lease_info(self, name: str) -> Dict[str, Any]:
+        """Current term/holder/expiry plus the store's fenced-write
+        count (how many split-brain writes did NOT happen)."""
+        return self._roundtrip(
+            {"op": "lease", "action": "info", "name": name}
+        )
+
 
 class Subscriber:
     """Background long-poll loop dispatching published messages to a
     callback (the reference's subscriber-side long-poll client,
     ``src/ray/pubsub/subscriber.h``). ``callback(channel, message)``
     runs on the poll thread; exceptions are swallowed so one bad
-    handler doesn't kill the stream."""
+    handler doesn't kill the stream.
+
+    Survives a KV outage: transport failures back off exponentially
+    (0.1 s → 5 s) and every recovery — a successful re-subscribe after
+    a KV restart, or the first successful poll after transport
+    failures — is counted in ``reconnects`` and surfaced as
+    ``ray_tpu_kv_reconnects_total{host}``. The loop never goes
+    permanently quiet."""
 
     def __init__(
         self,
@@ -428,6 +785,7 @@ class Subscriber:
         callback,
         sub_id: Optional[str] = None,
         poll_timeout: float = 5.0,
+        host: Optional[str] = None,
     ):
         import uuid
 
@@ -435,7 +793,10 @@ class Subscriber:
         self.sub_id = sub_id or f"sub_{uuid.uuid4().hex[:8]}"
         self.callback = callback
         self.poll_timeout = poll_timeout
+        self.host = host or client.node
         self.dropped = 0
+        self.reconnects = 0
+        self.failures = 0
         self.last_error: Optional[str] = None
         self._channels = list(channels)
         client.subscribe(self.sub_id, self._channels)
@@ -443,13 +804,29 @@ class Subscriber:
         self._thread = threading.Thread(target=self._run, daemon=True)
         self._thread.start()
 
+    def _mark_reconnect(self):
+        self.reconnects += 1
+        from ray_tpu.telemetry import metrics as _tm
+
+        try:
+            _tm.inc_kv_reconnects(self.host)
+        except Exception:
+            pass
+
+    # ray-tpu: thread=kv-sub
     def _run(self):
+        backoff = 0.1
+        degraded = False  # saw a transport failure since last success
         while not self._stop.is_set():
             try:
                 msgs, dropped = self.client.poll(
                     self.sub_id, timeout=self.poll_timeout
                 )
                 self.dropped += dropped
+                if degraded:
+                    degraded = False
+                    self._mark_reconnect()
+                backoff = 0.1
             except KeyError as e:
                 if self._stop.is_set():
                     return
@@ -459,8 +836,12 @@ class Subscriber:
                     # volatile): re-subscribe and keep polling
                     try:
                         self.client.subscribe(self.sub_id, self._channels)
+                        self._mark_reconnect()
+                        degraded = False
+                        backoff = 0.1
                     except Exception:
-                        time.sleep(0.2)
+                        time.sleep(min(backoff, 5.0))
+                        backoff = min(backoff * 2.0, 5.0)
                 else:
                     # a different rejection (e.g. token mismatch) will
                     # not heal by retrying fast — record it so the
@@ -469,10 +850,17 @@ class Subscriber:
                     time.sleep(1.0)
                 continue
             except Exception as e:
+                # transient KV outage (restart window, partition): log
+                # the error, back off, and KEEP polling — a control-
+                # plane subscriber that records one failure and goes
+                # quiet turns a 2-second KV blip into a deaf fleet
                 if self._stop.is_set():
                     return
                 self.last_error = str(e)
-                time.sleep(0.2)
+                self.failures += 1
+                degraded = True
+                time.sleep(min(backoff, 5.0))
+                backoff = min(backoff * 2.0, 5.0)
                 continue
             for ch, msg in msgs:
                 try:
@@ -495,28 +883,61 @@ class HeartbeatReporter:
     Each ping doubles as a transport-health probe: the measured KV
     round trip lands in ``ray_tpu_kv_rtt_seconds{host}`` (readable via
     ``last_rtt_s`` too), which the fleetview exporter publishes with
-    the rest of the host's snapshot (docs/observability.md)."""
+    the rest of the host's snapshot (docs/observability.md).
+
+    Outage accounting: ``seconds_since_ok()`` is the monotonic age of
+    the last ping the KV actually acknowledged — the signal
+    ``HostAgent.self_fenced`` compares against the liveness horizon to
+    decide the host may already look dead to the coordinator.
+    Recoveries count into ``reconnects`` /
+    ``ray_tpu_kv_reconnects_total{host}``."""
 
     def __init__(self, client: KVClient, node: str, interval: float = 5.0):
         self.client = client
         self.node = node
         self.interval = interval
         self.last_rtt_s: Optional[float] = None
+        self.failures = 0
+        self.reconnects = 0
+        self.last_error: Optional[str] = None
+        # start "ok": the agent just talked to KV to construct itself
+        self._last_ok_mono = time.monotonic()
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._run, daemon=True)
         self._thread.start()
 
+    def seconds_since_ok(self) -> float:
+        """Monotonic seconds since KV last acknowledged a ping."""
+        return time.monotonic() - self._last_ok_mono
+
+    # ray-tpu: thread=kv-heartbeat
     def _run(self):
+        degraded = False
         while not self._stop.wait(self.interval):
             try:
                 t0 = time.monotonic()
                 self.client.heartbeat(self.node)
                 self.last_rtt_s = time.monotonic() - t0
+                self._last_ok_mono = time.monotonic()
+                if degraded:
+                    degraded = False
+                    self.reconnects += 1
+                    from ray_tpu.telemetry import metrics as _tm
+
+                    try:
+                        _tm.inc_kv_reconnects(self.node)
+                    except Exception:
+                        pass
                 from ray_tpu.telemetry import metrics as _tm
 
                 _tm.set_kv_rtt(self.node, self.last_rtt_s)
-            except Exception:
-                pass
+            except Exception as e:
+                # KV unreachable past the retry schedule: keep the
+                # loop alive (the next interval re-probes) and let
+                # seconds_since_ok() grow — self-fencing reads it
+                self.failures += 1
+                self.last_error = str(e)
+                degraded = True
 
     def stop(self):
         self._stop.set()

@@ -112,11 +112,25 @@ class PolicyIngress:
         max_inflight: int = 256,
         shed_queue_wait_s: Optional[float] = None,
         default_timeout_s: float = 60.0,
+        notice_host: Optional[str] = None,
+        notice_poll_s: float = 2.0,
     ):
         self.host = host
         self._requested_port = int(port)
         self.port: Optional[int] = None
         self.default_timeout_s = float(default_timeout_s)
+        # provider-notice drain (resilience/provider_notice.py): the
+        # ingress is a fleet member like any learner host — on a
+        # preemption notice it stops renewing keep-alive connections
+        # and answers healthz 503 so load balancers route away before
+        # the host dies. notice_host is the identity probed against
+        # the per-host notice dir (default: this machine's hostname).
+        import socket as _socket
+
+        self.notice_host = notice_host or _socket.gethostname()
+        self.notice_poll_s = float(notice_poll_s)
+        self._draining = False
+        self._notice_grace_s: Optional[float] = None
         self._admission_defaults = dict(
             max_inflight=max_inflight,
             shed_queue_wait_s=shed_queue_wait_s,
@@ -207,11 +221,43 @@ class PolicyIngress:
         )
         self.port = self._server.sockets[0].getsockname()[1]
         self._ready.set()
+        watcher = asyncio.ensure_future(self._watch_notice())
         try:
             async with self._server:
                 await self._server.serve_forever()
         except asyncio.CancelledError:
             pass
+        finally:
+            watcher.cancel()
+
+    # ray-tpu: thread=ingress-loop
+    async def _watch_notice(self) -> None:
+        """Poll the provider-notice source for this host; on a notice,
+        flip the ingress into draining mode: live keep-alive
+        connections get ``Connection: close`` on their next response,
+        ``/healthz`` answers 503 so the balancer stops sending. The
+        probe reads env/files only — cheap enough for the loop."""
+        from ray_tpu.resilience import provider_notice
+
+        while not self._stop.is_set():
+            try:
+                grace = provider_notice.probe(self.notice_host)
+            except Exception:
+                grace = None
+            if grace is not None:
+                self._draining = True
+                self._notice_grace_s = grace
+                return
+            await asyncio.sleep(self.notice_poll_s)
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def preemption_notice(self) -> Optional[float]:
+        """Grace seconds from the provider notice, or None when no
+        notice has been observed."""
+        return self._notice_grace_s
 
     @property
     def url(self) -> str:
@@ -253,6 +299,10 @@ class PolicyIngress:
                 )
                 keep_alive = (
                     headers.get("connection", "").lower() != "close"
+                    # draining: answer, then close — keep-alive
+                    # connections must not pin requests to a host
+                    # about to be preempted
+                    and not self._draining
                 )
                 head = (
                     f"HTTP/1.1 {status} "
@@ -464,17 +514,24 @@ class PolicyIngress:
                 "queue_depth": router.stats()["queue_depth"],
                 "inflight": admission.num_inflight(),
             }
-        ok = all(
-            p["replicas"] > p["dead_replicas"]
-            for p in policies.values()
+        ok = (
+            all(
+                p["replicas"] > p["dead_replicas"]
+                for p in policies.values()
+            )
+            and not self._draining
         )
+        status = "ok" if ok else "degraded"
+        if self._draining:
+            status = "draining"
         return (
             200 if ok else 503,
             [],
             json.dumps(
                 {
-                    "status": "ok" if ok else "degraded",
+                    "status": status,
                     "policies": policies,
+                    "draining": self._draining,
                 }
             ).encode(),
         )

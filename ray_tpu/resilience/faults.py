@@ -41,15 +41,37 @@ Spec forms (dict keys / env tokens):
 - ``crash_learner_thread``: ``{"on_step": K}`` — raise inside
   ``LearnerThread.step`` K.
 
+The **fleet family** (PR 19) arms the same injector inside the KV
+control plane (``fleet/kv.py`` consults :func:`kv_injector` per op;
+``fleet/coordinator.py`` consults it per fenced write), so control-
+plane chaos is exactly as deterministic as data-plane chaos:
+
+- ``kv_drop``: ``[{"kv_op": "put"|"", "on_call": K}]`` or
+  ``"op@K"`` / ``"@K"`` — this process's K-th KV op of that kind
+  (empty = any op) fails with ``ConnectionError`` ONCE; the retried
+  transport must absorb it invisibly.
+- ``kv_delay``: ``[{"delay_ms": MS, "on_call": K}]`` or ``"ms@K"`` —
+  the K-th KV op (any kind) stalls MS milliseconds first.
+- ``partition_host``: ``[{"host": H, "on_call": K, "heal_s": S}]`` or
+  ``"H@K"`` / ``"H@KxS"`` — from host H's K-th KV op, EVERY op raises
+  ``ConnectionError`` for S seconds (default 2.0): a network
+  partition, not a blip — long enough to outrun the retry schedule,
+  so the host's self-fencing path is what gets exercised.
+- ``kill_coordinator``: ``{"on_write": K}`` or ``"@K"`` — the process
+  hard-exits (``os._exit``) on its K-th coordinator lease-fenced KV
+  write: the leader dies mid-protocol with its lease outstanding.
+
 Every trigger fires **once** (deterministic: counts are per-process
-call numbers, not timers), and workers recreated by the recovery layer
-get an empty spec so a replacement doesn't re-run its predecessor's
-death sentence.
+call numbers, not timers; the partition's heal window is the one
+wall-clock element, by design), and workers recreated by the recovery
+layer get an empty spec so a replacement doesn't re-run its
+predecessor's death sentence.
 """
 
 from __future__ import annotations
 
 import os
+import threading
 import time
 from typing import Any, Dict, List, Optional
 
@@ -118,6 +140,38 @@ def _parse_env_spec(text: str) -> Dict[str, Any]:
         elif kind == "crash_learner_thread":
             _, _, k = arg.partition("@")
             spec["crash_learner_thread"] = {"on_step": int(k or 1)}
+        elif kind == "kv_drop":
+            lst = spec.setdefault("kv_drop", [])
+            for item in filter(None, arg.split(",")):
+                op, _, k = item.partition("@")
+                lst.append(
+                    {"kv_op": op.strip(), "on_call": int(k or 1)}
+                )
+        elif kind == "kv_delay":
+            lst = spec.setdefault("kv_delay", [])
+            for item in filter(None, arg.split(",")):
+                ms, _, k = item.partition("@")
+                lst.append(
+                    {
+                        "delay_ms": float(ms or 100.0),
+                        "on_call": int(k or 1),
+                    }
+                )
+        elif kind == "partition_host":
+            lst = spec.setdefault("partition_host", [])
+            for item in filter(None, arg.split(",")):
+                h, _, rest = item.partition("@")
+                k, _, s = rest.partition("x")
+                lst.append(
+                    {
+                        "host": h.strip(),
+                        "on_call": int(k or 1),
+                        "heal_s": float(s or 2.0),
+                    }
+                )
+        elif kind == "kill_coordinator":
+            _, _, k = arg.partition("@")
+            spec["kill_coordinator"] = {"on_write": int(k or 1)}
     return spec
 
 
@@ -133,6 +187,16 @@ class FaultInjector:
         # preemption-with-notice state: monotonic deadline after which
         # this process hard-exits (None = no notice outstanding)
         self._preempt_deadline: Optional[float] = None
+        # fleet-family counters: this process's KV op count (total and
+        # per op kind), coordinator fenced-write count, and each
+        # partitioned host's heal deadline (monotonic, keyed by host —
+        # only the NAMED host loses the network, even when clients
+        # share this process-wide injector)
+        self._kv_calls = 0
+        self._kv_op_calls: Dict[str, int] = {}
+        self._coord_writes = 0
+        self._partition_until: Dict[str, float] = {}
+        self._kv_lock = threading.Lock()
 
     # -- spec normalization ----------------------------------------------
 
@@ -263,6 +327,109 @@ class FaultInjector:
                 f"injected learner-thread crash on step "
                 f"{self._thread_steps}"
             )
+
+
+    # -- fleet control-plane side ------------------------------------------
+
+    def on_kv_op(self, node: Optional[str], op: str) -> None:
+        """Consulted by the KV transport once per op ATTEMPT (before
+        the socket opens). ``node`` is the caller's host identity (for
+        ``partition_host`` matching), ``op`` the wire op name. May
+        sleep (``kv_delay``) or raise ``ConnectionError`` (``kv_drop``
+        once; ``partition_host`` for its whole heal window) — exactly
+        the failures the retried transport claims to absorb."""
+        with self._kv_lock:
+            self._kv_calls += 1
+            total = self._kv_calls
+            per_op = self._kv_op_calls.get(op, 0) + 1
+            self._kv_op_calls[op] = per_op
+            # an armed partition dominates every other fault: the
+            # network is gone, nothing else can fire through it
+            for entry in self._as_list(self.spec.get("partition_host")):
+                if (
+                    node is not None
+                    and str(entry.get("host", "")) == node
+                    and total >= int(entry.get("on_call", 1))
+                    and self._match_once("partition_host", entry)
+                ):
+                    self._partition_until[node] = (
+                        time.monotonic()
+                        + float(entry.get("heal_s", 2.0))
+                    )
+            until = self._partition_until.get(node or "")
+            partitioned = (
+                until is not None and time.monotonic() < until
+            )
+            delay_s = 0.0
+            for entry in self._as_list(self.spec.get("kv_delay")):
+                if int(
+                    entry.get("on_call", 1)
+                ) == total and self._match_once("kv_delay", entry):
+                    delay_s = float(entry.get("delay_ms", 100.0)) / 1e3
+            drop = False
+            for entry in self._as_list(self.spec.get("kv_drop")):
+                want = str(entry.get("kv_op", "") or "")
+                if (
+                    (not want or want == op)
+                    and int(entry.get("on_call", 1))
+                    == (per_op if want else total)
+                    and self._match_once("kv_drop", entry)
+                ):
+                    drop = True
+        if delay_s > 0.0:
+            time.sleep(delay_s)
+        if partitioned:
+            raise ConnectionError(
+                f"injected partition: host {node!r} cut from KV"
+            )
+        if drop:
+            raise ConnectionError(f"injected kv_drop on op {op!r}")
+
+    def on_coordinator_write(self) -> None:
+        """Consulted by the FleetCoordinator once per lease-fenced KV
+        write. ``kill_coordinator`` hard-exits this process on the
+        matching write — the leader dies mid-protocol, lease
+        outstanding, exactly like a coordinator-host preemption."""
+        with self._kv_lock:
+            self._coord_writes += 1
+            n = self._coord_writes
+        kill = self.spec.get("kill_coordinator")
+        if kill and int(kill.get("on_write", 1)) == n:
+            os._exit(1)
+
+
+# process-wide injector for the KV transport: parsed from
+# RAY_TPU_FAULTS once, shared by every KVClient in the process so the
+# fleet-family call counters are global (deterministic per process,
+# like the reference's per-actor chaos counters). None when the env
+# carries no fleet-family fault — the transport pays one cached
+# None-check per op, nothing else.
+_KV_INJECTOR: Optional[FaultInjector] = None
+_KV_INJECTOR_ARMED: Optional[bool] = None
+
+_FLEET_FAULT_KINDS = (
+    "kv_drop",
+    "kv_delay",
+    "partition_host",
+    "kill_coordinator",
+)
+
+
+def kv_injector() -> Optional[FaultInjector]:
+    """The process-wide fleet-chaos injector (env-armed only —
+    control-plane faults have no per-worker config channel)."""
+    global _KV_INJECTOR, _KV_INJECTOR_ARMED
+    if _KV_INJECTOR_ARMED is None:
+        text = os.environ.get("RAY_TPU_FAULTS", "").strip()
+        spec = _parse_env_spec(text) if text else {}
+        fleet_spec = {
+            k: v for k, v in spec.items() if k in _FLEET_FAULT_KINDS
+        }
+        _KV_INJECTOR = (
+            FaultInjector(fleet_spec) if fleet_spec else None
+        )
+        _KV_INJECTOR_ARMED = _KV_INJECTOR is not None
+    return _KV_INJECTOR
 
 
 def from_config(config: Optional[Dict]) -> Optional[FaultInjector]:

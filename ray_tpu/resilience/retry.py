@@ -92,11 +92,22 @@ class RetryPolicy:
         *,
         retry_on: Optional[Tuple] = None,
         on_retry: Optional[Callable[[int, BaseException], None]] = None,
+        deadline_s: Optional[float] = None,
     ) -> Any:
         """Run ``fn()`` under this schedule: retryable errors sleep the
         backoff and try again; the final attempt's error propagates.
-        Non-retryable errors propagate immediately."""
+        Non-retryable errors propagate immediately. ``deadline_s``
+        bounds the WHOLE schedule with one wall clock: once it is
+        exhausted no further attempt launches and the last error
+        propagates — the shape the retried KV transport needs so a
+        control-plane thread's op cost stays ``O(op timeout)``, not
+        ``O(attempts × op timeout)``."""
         retry_on = retry_on or DEFAULT_RETRYABLE
+        deadline = (
+            time.monotonic() + deadline_s
+            if deadline_s is not None
+            else None
+        )
         last: Optional[BaseException] = None
         for attempt in range(max(1, self.max_attempts)):
             try:
@@ -105,9 +116,14 @@ class RetryPolicy:
                 last = e
                 if attempt >= self.max_attempts - 1:
                     raise
+                pause = self.delay(attempt)
+                if deadline is not None:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= pause:
+                        raise
                 if on_retry is not None:
                     on_retry(attempt, e)
-                time.sleep(self.delay(attempt))
+                time.sleep(pause)
         raise last  # pragma: no cover — loop always returns or raises
 
 

@@ -209,6 +209,9 @@ class HostExporter:
         self.seq = 0
         self.clock_offset_s = 0.0
         self.rtt_s = 0.0
+        self.kv_failures = 0
+        self.kv_reconnects = 0
+        self._kv_degraded = False
         self._span_watermark = 0.0
         self._max_spans = int(max_spans_per_tick)
         self._stop = threading.Event()
@@ -232,7 +235,13 @@ class HostExporter:
 
     def flush(self) -> Dict[str, Any]:
         """One tick: handshake, snapshot, publish + put. Returns the
-        snapshot (tests/bench call this directly for determinism)."""
+        snapshot (tests/bench call this directly for determinism).
+
+        Outage-tolerant: a KV that is down past the transport's retry
+        schedule costs this tick its put/publish (counted in
+        ``kv_failures``), never the exporter — the snapshot still
+        returns, the next tick re-tries, and the first tick after an
+        outage counts into ``ray_tpu_kv_reconnects_total{host}``."""
         try:
             off, rtt = clock_handshake(
                 self.kv, samples=3 if self.seq == 0 else 1
@@ -242,7 +251,18 @@ class HostExporter:
         except Exception:
             pass
         snap = self.snapshot()
-        self.kv.put(snapshot_key(self.host), snap)
+        try:
+            self.kv.put(snapshot_key(self.host), snap)
+            if self._kv_degraded:
+                self._kv_degraded = False
+                self.kv_reconnects += 1
+                try:
+                    tm.inc_kv_reconnects(self.host)
+                except Exception:
+                    pass
+        except Exception:
+            self.kv_failures += 1
+            self._kv_degraded = True
         try:
             self.kv.publish(CH_FLEETVIEW, snap)
         except Exception:

@@ -75,6 +75,18 @@ FLEET_STRAGGLER_TOTAL = "ray_tpu_fleet_straggler_total"
 FLEET_CLOCK_OFFSET_SECONDS = "ray_tpu_fleet_clock_offset_seconds"
 FLEET_HOSTS_REPORTING = "ray_tpu_fleet_hosts_reporting"
 KV_RTT_SECONDS = "ray_tpu_kv_rtt_seconds"
+# fleet control-plane fault tolerance (docs/fleet.md "Failure model &
+# leadership"): KV transport retries/reconnects per host, fenced
+# (stale-term) coordinator writes the KV store rejected, the leader's
+# current lease term, leadership transitions (standby promotions), and
+# hosts that self-fenced after losing the KV plane past the liveness
+# horizon
+KV_RETRIES_TOTAL = "ray_tpu_kv_retries_total"
+KV_RECONNECTS_TOTAL = "ray_tpu_kv_reconnects_total"
+FLEET_FENCED_WRITES_TOTAL = "ray_tpu_fleet_fenced_writes_total"
+FLEET_COORDINATOR_TERM = "ray_tpu_fleet_coordinator_term"
+FLEET_FAILOVERS_TOTAL = "ray_tpu_fleet_failovers_total"
+FLEET_SELF_FENCES_TOTAL = "ray_tpu_fleet_self_fences_total"
 CKPT_STREAM_SNAPSHOTS_TOTAL = (
     "ray_tpu_checkpoint_stream_snapshots_total"
 )
@@ -350,6 +362,68 @@ def inc_fleet_preseed(status: str, n: int = 1) -> None:
         "resize-geometry AOT pre-seed attempts",
         ("status",),
     ).inc(float(n), {"status": status})
+
+
+def inc_kv_retries(host: str, op: str, n: int = 1) -> None:
+    """KV ops this host re-attempted after a transient transport
+    failure (the retried KV transport's backoff schedule fired)."""
+    counter(
+        KV_RETRIES_TOTAL,
+        "KV ops retried after a transient transport failure",
+        ("host", "op"),
+    ).inc(float(n), {"host": host, "op": op})
+
+
+def inc_kv_reconnects(host: str, n: int = 1) -> None:
+    """KV control-plane threads (subscriber / heartbeat / exporter) on
+    this host that re-established service after an outage window."""
+    counter(
+        KV_RECONNECTS_TOTAL,
+        "control-plane threads that reconnected after a KV outage",
+        ("host",),
+    ).inc(float(n), {"host": host})
+
+
+def inc_fleet_fenced_write(host: str, n: int = 1) -> None:
+    """Coordinator writes rejected by the KV store for carrying a
+    stale lease term — each one is a split-brain write that did NOT
+    happen (``host`` is the zombie writer's lease holder identity)."""
+    counter(
+        FLEET_FENCED_WRITES_TOTAL,
+        "stale-term coordinator writes rejected by the KV store",
+        ("host",),
+    ).inc(float(n), {"host": host})
+
+
+def set_coordinator_term(host: str, term: int) -> None:
+    """The lease term under which ``host``'s coordinator currently
+    holds fleet leadership (bumps on every failover)."""
+    gauge(
+        FLEET_COORDINATOR_TERM,
+        "lease term of this host's fleet coordinator",
+        ("host",),
+    ).set(float(term), {"host": host})
+
+
+def inc_fleet_failover(host: str, n: int = 1) -> None:
+    """Leadership transitions: a standby coordinator on ``host``
+    acquired the fleet lease after the previous leader let it lapse."""
+    counter(
+        FLEET_FAILOVERS_TOTAL,
+        "standby coordinators promoted to fleet leadership",
+        ("host",),
+    ).inc(float(n), {"host": host})
+
+
+def inc_self_fence(host: str, n: int = 1) -> None:
+    """Times this host parked at its epoch boundary because it could
+    not reach KV past the liveness horizon (partition self-fencing:
+    the mesh may have re-formed without it)."""
+    counter(
+        FLEET_SELF_FENCES_TOTAL,
+        "hosts parked at an epoch boundary on a KV partition",
+        ("host",),
+    ).inc(float(n), {"host": host})
 
 
 def inc_stream_snapshots(n: int = 1) -> None:

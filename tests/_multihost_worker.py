@@ -10,10 +10,18 @@ survivor rebuilds via fleet.resize_policy on fleet.epoch_mesh, with
 bitwise post-reshard params and (AOT cache pre-seeded in-process by
 the first learn step) zero fresh compiles.
 
+Since PR 19 a chaos stage runs between the observability rung and the
+drain: rank 0's coordinator "crashes" without releasing its lease, a
+standby on rank 1 wins the fenced takeover at term 2 once the TTL
+runs out, training resumes on the same mesh (bitwise params, zero
+fresh compiles), and the revived ex-coordinator's stale-term write is
+rejected at the store — so the later drain/resize runs under a
+control plane that has already failed over twice.
+
 Exercises: jax.distributed bring-up, a global mesh psum across hosts,
 cross-host weight broadcast, put_global batch placement, fleet
-rendezvous + epochs + drain + barrier, live resize as a warm-cache
-restart.
+rendezvous + epochs + drain + barrier, fenced coordinator failover,
+live resize as a warm-cache restart.
 """
 
 import os
@@ -208,8 +216,114 @@ def main() -> None:
         aggregator.stop()
     exporter.stop()
 
+    # ---- chaos stage (PR 19): the coordinator dies mid-training and
+    # a fenced standby takes over. rank 0's coordinator "crashes"
+    # (renew loop stops, lease NOT released — exactly a SIGKILL, the
+    # TTL has to run out); rank 1's standby wins the lease at term 2,
+    # rebuilds the member/epoch mirror from the durable KV table, and
+    # cuts the failover epoch over the SAME hosts. Training resumes on
+    # the unchanged mesh — params untouched, zero fresh compiles —
+    # because the coordinator was never on the data path. The revived
+    # ex-coordinator then proves the fence: its stale-term write is
+    # rejected at the store (split-brain counter-proof). ----
+    import hashlib
+
+    lease_ttl = float(os.environ.get(fleet.LEASE_TTL_ENV, "10.0"))
+    fn_before = policy.learn_fn(bsize)
+    traces_before = fn_before.traces
+    if rank == 0:
+        info = kv.lease_info(fleet.LEASE_NAME)
+        assert info["term"] == 1 and info["holder"], info
+        coord.stop(release_lease=False)  # crash: lease left to expire
+        kv.put("fleet_test/coord_killed", _time.time())
+    standby = None
+    if rank == 1:
+        kv.get("fleet_test/coord_killed", timeout=60.0)
+        t0 = _time.monotonic()
+        standby = fleet.FleetCoordinator(
+            kv, standby=True, lease_ttl=lease_ttl, holder="host1-standby"
+        )
+        term = standby.acquire_leadership(timeout=60.0)
+        failover_wall = _time.monotonic() - t0
+        assert term == 2 and standby.is_leader, (term, standby.is_leader)
+        # warm-cache restart of the control plane: the mirror came
+        # back from the persisted KV table, not from re-rendezvous
+        assert sorted(standby.members()) == ["host0", "host1"]
+        assert standby.current_epoch().gen == 1, standby.current_epoch()
+        # failover wall is bounded by the dead incumbent's TTL plus
+        # the acquire poll cadence (the --fleet-chaos contract)
+        assert failover_wall < 2.0 * lease_ttl + 1.0, failover_wall
+        epoch2 = standby.propose_epoch(reason="failover")
+        assert epoch2.hosts == ("host0", "host1"), epoch2
+        print(f"FAILOVER_OK term={term} wall={failover_wall:.2f}s")
+    epoch2 = agent.wait_for_epoch(2)
+    assert epoch2.gen == 2 and epoch2.hosts == ("host0", "host1")
+    assert epoch2.reason == "failover", epoch2
+    # training resumes in lockstep under the new leader: same mesh,
+    # same compiled program, identical loss on both hosts
+    chaos_stats = policy.learn_on_device_batch(global_batch, bsize)
+    assert np.isfinite(chaos_stats["total_loss"]), chaos_stats
+    kv.put(f"fleet_test/chaos_loss_{rank}", chaos_stats["total_loss"])
+    other_chaos = kv.get(
+        f"fleet_test/chaos_loss_{1 - rank}", timeout=60.0
+    )
+    assert abs(other_chaos - chaos_stats["total_loss"]) < 1e-5
+    # zero fresh compiles across the failover window
+    assert policy.learn_fn(bsize) is fn_before
+    assert fn_before.traces == traces_before, (
+        fn_before.traces,
+        traces_before,
+    )
+    # post-resume params bitwise identical across hosts (lockstep
+    # held through the leadership change)
+    digest = hashlib.sha256()
+    for k in sorted(policy.get_weights()):
+        for leaf in jax.tree_util.tree_leaves(policy.get_weights()[k]):
+            digest.update(np.asarray(leaf).tobytes())
+    kv.put(f"fleet_test/chaos_digest_{rank}", digest.hexdigest())
+    assert (
+        kv.get(f"fleet_test/chaos_digest_{1 - rank}", timeout=60.0)
+        == digest.hexdigest()
+    )
+    print("CHAOS_BITWISE_OK params identical, zero fresh compiles")
+    if rank == 0:
+        # the revived ex-coordinator acts at its dead term — the store
+        # must fence it, and the fenced write flips is_leader off
+        try:
+            coord._put(
+                "fleet/members", {"zombie": {"rank_hint": None}}
+            )
+            raise AssertionError("stale-term write was accepted")
+        except fleet.StaleTermError:
+            pass
+        assert not coord.is_leader
+        info = kv.lease_info(fleet.LEASE_NAME)
+        assert info["term"] == 2, info
+        assert info["fenced_writes"] >= 1, info
+        print("FENCED_OK stale term rejected")
+        kv.put("fleet_test/fence_proved", True)
+    if rank == 1:
+        # failback: the clean-stop path releases the lease, so rank
+        # 0's re-acquire is immediate (no TTL wait) at term 3 — the
+        # drain stage below runs under a twice-failed-over control
+        # plane
+        kv.get("fleet_test/fence_proved", timeout=60.0)
+        standby.stop(release_lease=True)
+        kv.put("fleet_test/failback", True)
+    if rank == 0:
+        kv.get("fleet_test/failback", timeout=60.0)
+        coord = fleet.FleetCoordinator(kv, lease_ttl=lease_ttl)
+        assert coord.term == 3 and coord.is_leader
+        assert sorted(coord.members()) == ["host0", "host1"]
+        assert coord.current_epoch().gen == 2, coord.current_epoch()
+        kv.put("fleet_test/failback_done", True)
+    # pubsub only reaches live subscribers: host1 must not announce
+    # its notice until the failed-back coordinator's subscriber is
+    # provably registered
+    kv.get("fleet_test/failback_done", timeout=60.0)
+
     # ---- elastic resize: provider notice for host1 → coordinator
-    # drains epoch 1 and cuts epoch 2 → one final lockstep superstep →
+    # drains epoch 2 and cuts epoch 3 → one final lockstep superstep →
     # barrier → host0 rebuilds at the surviving geometry ----
     if rank == 1:
         # the "eviction notice" lands as a provider file (the DIR
@@ -234,14 +348,14 @@ def main() -> None:
         import time as _time
 
         deadline = _time.monotonic() + 60.0
-        while agent.poll_drain(1) is None:
+        while agent.poll_drain(2) is None:
             coord.reconcile()
             if _time.monotonic() >= deadline:
                 raise TimeoutError("drain record never posted")
             _time.sleep(0.05)
     # the lockstep anchor: every host observes the same drain record
     # before its next superstep
-    drain = agent.await_drain(1)
+    drain = agent.await_drain(2)
     assert drain["victims"] == ["host1"], drain
     # the drain step: one last lockstep update over the global mesh so
     # the departing host's in-flight contribution is not lost
@@ -252,7 +366,7 @@ def main() -> None:
         f"fleet_test/drain_loss_{1 - rank}", timeout=60.0
     )
     assert abs(other_drain - drain_stats["total_loss"]) < 1e-5
-    agent.barrier("drained", epoch1)
+    agent.barrier("drained", epoch2)
 
     if rank == 1:
         # the victim idles out its grace period (no more collectives),
@@ -264,11 +378,11 @@ def main() -> None:
         print(f"MULTIHOST_OK rank={rank}")
         return
 
-    # ---- host0 survives the shrink: epoch 2 names it alone; the
+    # ---- host0 survives the shrink: epoch 3 names it alone; the
     # resize is a warm-cache restart (PR-10 reshard + pre-seeded AOT) --
-    epoch2 = agent.wait_for_epoch(2)
-    assert epoch2.gen == 2 and epoch2.hosts == ("host0",), epoch2
-    new_mesh = fleet.epoch_mesh(epoch2)  # local devices, no DCN
+    epoch3 = agent.wait_for_epoch(3)
+    assert epoch3.gen == 3 and epoch3.hosts == ("host0",), epoch3
+    new_mesh = fleet.epoch_mesh(epoch3)  # local devices, no DCN
     assert len(new_mesh.devices.flat) == 2
     survivor = fleet.resize_policy(policy, new_mesh)
     # params bitwise across the reshard (replicated => addressable)

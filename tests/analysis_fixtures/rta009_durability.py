@@ -44,6 +44,13 @@ def fsync_dir(d):
         os.close(fd)
 
 
+def tp_raw_lease_write(lease_dir, term):
+    # BAD: lease-term records are fence state — a torn write un-fences
+    # a zombie coordinator on restart
+    with open(os.path.join(lease_dir, "term.json"), "w") as f:
+        f.write(str(term))
+
+
 def tn_read_checkpoint(checkpoint_dir):
     with open(os.path.join(checkpoint_dir, "state.bin"), "rb") as f:
         return f.read()

@@ -1,0 +1,52 @@
+"""RTA013 fixtures: unretried KV transport on a control-plane path."""
+
+import socket
+
+
+class _FakeKV:
+    # ray-tpu: kv-retry-wrapper
+    def _roundtrip(self, req):
+        return self._roundtrip_once(req)  # OK: inside the wrapper
+
+    # ray-tpu: kv-retry-wrapper
+    def _roundtrip_once(self, req):
+        with socket.create_connection(("h", 1)) as s:  # OK: wrapper
+            s.sendall(b"x")
+
+
+def tp_raw_once_call(kv, req):
+    # BAD: single-attempt transport — dies on a KV restart window
+    return kv._roundtrip_once(req)
+
+
+# ray-tpu: thread=kv-heartbeat
+def tp_raw_socket_on_thread(host, port):
+    # BAD: raw socket on a control-plane thread, not a wrapper
+    with socket.create_connection((host, port)) as s:
+        return s.recv(1)
+
+
+def tp_unretried_client(addr):
+    # BAD: every op on this client is one unretried attempt
+    return KVClient(addr, retry=False)
+
+
+def tn_wrapped_call(kv, req):
+    return kv._roundtrip(req)  # the retried path
+
+
+def tn_default_client(addr):
+    return KVClient(addr)  # default retry schedule
+
+
+# ray-tpu: thread=driver
+def tn_allowed_raw_probe(host, port):
+    # a one-shot reachability probe where failure IS the datum
+    # ray-tpu: allow[RTA013] probe: first failure is the answer
+    with socket.create_connection((host, port), timeout=0.1):
+        return True
+
+
+class KVClient:
+    def __init__(self, addr, retry=None):
+        self.addr = addr

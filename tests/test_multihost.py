@@ -66,8 +66,10 @@ def test_kv_heartbeats_track_liveness():
 def test_two_process_dcn_cluster(tmp_path):
     """Full rung: jax.distributed over 2 CPU processes x 2 devices,
     global-mesh psum, cross-host weight broadcast, fleet rendezvous +
-    epochs, and a live resize (drain host1, survivor reshards onto its
-    local mesh with a pre-seeded AOT cache — zero fresh compiles)."""
+    epochs, a coordinator-kill chaos stage (fenced standby failover
+    mid-training), and a live resize (drain host1, survivor reshards
+    onto its local mesh with a pre-seeded AOT cache — zero fresh
+    compiles)."""
     coord_port = _free_port()
     kv = KVServer(host="127.0.0.1")
     repo_root = os.path.dirname(os.path.dirname(__file__))
@@ -90,6 +92,9 @@ def test_two_process_dcn_cluster(tmp_path):
         # PR-13 ledger on: the worker asserts the survivor's learn
         # program row registered with source="aot_cache"
         "RAY_TPU_DEVICE_LEDGER": "1",
+        # short lease so the chaos stage's coordinator-kill failover
+        # (standby waits out the dead incumbent's TTL) stays fast
+        "RAY_TPU_FLEET_LEASE_TTL_S": "2.0",
     }
     script = os.path.join(
         os.path.dirname(__file__), "_multihost_worker.py"
@@ -124,6 +129,13 @@ def test_two_process_dcn_cluster(tmp_path):
     # carried host= series for both hosts
     assert "FLEETOBS_STRAGGLER host1" in outs[0]
     assert "FLEETOBS_MERGED 2 hosts" in outs[0]
+    # chaos stage: rank 0's coordinator died mid-training, rank 1's
+    # standby won the fenced lease at term 2 within the TTL window,
+    # training resumed bitwise with zero fresh compiles, and the
+    # zombie's stale-term write was rejected (split-brain proof)
+    assert "FAILOVER_OK term=2" in outs[1]
+    assert "CHAOS_BITWISE_OK" in outs[0] and "CHAOS_BITWISE_OK" in outs[1]
+    assert "FENCED_OK stale term rejected" in outs[0]
     # elastic learner-fleet case: host1 drained on notice, host0
     # finished the lockstep drain step and continued on its local mesh
     assert "ELASTIC_OK" in outs[0]

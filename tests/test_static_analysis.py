@@ -128,9 +128,10 @@ FIXTURE_CASES = [
     # the v2 rule pack (whole-program call graph + taint)
     ("rta007_eventloop.py", "RTA007", 3),
     ("rta008_lockorder.py", "RTA008", 1),
-    ("rta009_durability.py", "RTA009", 3),
+    ("rta009_durability.py", "RTA009", 4),
     ("rta010_catalog.py", "RTA010", 3),
     ("rta011_rng_order.py", "RTA011", 1),
+    ("rta013_kvretry.py", "RTA013", 3),
 ]
 
 
