@@ -78,6 +78,19 @@ Flags:  --profile       run ONE telemetry-instrumented PPO iteration
                         with an empty vs warm compile cache (warm =
                         ZERO fresh compiles, test-asserted); writes
                         benchmarks/e2e/ingress_ab.json
+        --flood         OPEN-loop flood harness for the horizontal
+                        front door (docs/serving.md "Scaling the
+                        front door"): Poisson + recorded-burst
+                        arrival schedules with a deadline mix, swept
+                        upward to locate each config's saturation
+                        knee (goodput >= 90% of offered), for 1 vs N
+                        ingress worker processes on ONE shared port;
+                        at 2x the knee every response must be a
+                        200-inside-deadline / 429 / 503 / 504 (never
+                        a hang, never a late 200); bitwise parity
+                        across configs, zero recompiles per worker;
+                        add --smoke for the shrunk tier-1 variant;
+                        writes benchmarks/e2e/flood.json
         --elastic       elastic-fleet chaos A/B (docs/resilience.md
                         "elastic fleets & preemption"): PPO fleet
                         forced 4→2→6 via noticed preemptions +
@@ -3205,6 +3218,554 @@ def bench_ingress(
     return report
 
 
+def bench_flood(out_path=None, smoke=False):
+    """OPEN-loop flood harness for the horizontal front door
+    (docs/serving.md "Scaling the front door"): find the saturation
+    knee of 1 vs N ingress worker processes and prove the overload
+    contract past it.
+
+    Closed-loop clients (bench_ingress) can never overload a server —
+    they wait for answers before sending more. This harness fires
+    requests on a fixed ARRIVAL SCHEDULE regardless of completions
+    (Poisson inter-arrivals per offered rate, plus a recorded bursty
+    on/off stream), with a deadline mix riding along, and sweeps
+    offered rates upward until goodput stops tracking offered load:
+
+      - knee = highest offered rate whose goodput (200s inside their
+        deadline) stays >= 90% of offered;
+      - at 2x the knee EVERY response must be a 200-inside-deadline,
+        429 (inflight/quota), 503 (queue-wait shed) or 504 (deadline)
+        — never a hang, never a 200 past its deadline;
+      - both configs serve the SAME checkpoint from a pre-seeded AOT
+        cache (fixed-seed obs stream, bitwise parity across configs,
+        zero fresh compiles per worker, heartbeat-asserted).
+
+    Each config is a real ``IngressSupervisor`` bank on one shared
+    port (SO_REUSEPORT where available). Writes
+    benchmarks/e2e/flood.json. NOTE the honesty caveat in the report:
+    on a single-core host N worker processes time-slice one CPU, so
+    the knee ratio measures isolation overhead, not the >= 2.5x
+    scale-out a multi-core front door shows.
+
+    ``--smoke`` shrinks rates/durations for the tier-1 test."""
+    import os
+    import shutil
+    import socket as socket_mod
+    import subprocess
+    import tempfile
+    import threading
+    import urllib.error
+    import urllib.request
+
+    from ray_tpu.ingress import IngressSupervisor
+    from ray_tpu.telemetry import metrics as telemetry_metrics
+
+    out_path = out_path or "benchmarks/e2e/flood.json"
+    workdir = tempfile.mkdtemp(prefix="flood_bench_")
+    ckpt_root = os.path.join(workdir, "ckpts")
+    cache_dir = os.path.join(workdir, "aot_cache")
+    repo = os.path.dirname(os.path.abspath(__file__))
+    max_batch_size = 16
+
+    if smoke:
+        workers_list = (1, 2)
+        rates = [10.0, 25.0]
+        duration_s = 1.2
+        n_obs = 32
+        n_senders = 8
+        overload_factor = 1.5
+        run_recorded = False
+        parity_n = 16
+    else:
+        workers_list = (1, 3)
+        rates = [
+            60.0, 120.0, 240.0, 480.0, 960.0, 1920.0, 3840.0,
+        ]
+        duration_s = 3.0
+        n_obs = 128
+        n_senders = 64
+        overload_factor = 2.0
+        run_recorded = True
+        parity_n = 64
+
+    # the checkpoint + AOT cache are built in a SUBPROCESS so this
+    # process never initializes the XLA client before forking worker
+    # banks (fork-after-jax-init is the classic deadlock); workers
+    # restore every bucket from the warm cache — zero fresh compiles
+    seed_code = (
+        "import json, sys\n"
+        "from ray_tpu.algorithms.ppo.ppo import PPO\n"
+        "ckpt, cache_dir, mbs = (\n"
+        "    sys.argv[1], sys.argv[2], int(sys.argv[3]))\n"
+        "cfg = {'env': 'CartPole-v1', 'seed': 0, 'num_workers': 0,\n"
+        "       'train_batch_size': 64, 'sgd_minibatch_size': 64,\n"
+        "       'num_sgd_iter': 1, 'lr': 3e-4,\n"
+        "       'model': {'fcnet_hiddens': [64, 64]}}\n"
+        "algo = PPO(config=cfg)\n"
+        "algo.save(ckpt)\n"
+        "algo.cleanup()\n"
+        "from ray_tpu.serve.policy_server import (\n"
+        "    BatchedPolicyServer, restore_policy)\n"
+        "from ray_tpu.sharding.aot import AOTCompileCache\n"
+        "p, prep, filt, _ = restore_policy(ckpt)\n"
+        "cache = AOTCompileCache(cache_dir)\n"
+        "srv = BatchedPolicyServer(\n"
+        "    p, name='flood', max_batch_size=mbs, explore=False,\n"
+        "    obs_filter=filt, preprocessor=prep, aot_cache=cache,\n"
+        "    start=False)\n"
+        "srv.warmup()\n"
+        "cache.flush()\n"
+        "srv.stop()\n"
+    )
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = repo + ":" + env.get("PYTHONPATH", "")
+    subprocess.run(
+        [
+            sys.executable, "-c", seed_code,
+            os.path.join(ckpt_root, "checkpoint_000001"),
+            cache_dir, str(max_batch_size),
+        ],
+        check=True, env=env, cwd=repo,
+    )
+
+    rng = np.random.default_rng(0)
+    obs_stream = rng.uniform(-1.0, 1.0, (n_obs, 4)).astype(
+        np.float32
+    )
+    # the deadline mix every run carries: most requests unbounded, a
+    # slice with a meetable budget, a slice tight enough to expire
+    # under congestion (ms, weight)
+    deadline_mix = [(None, 0.6), (400.0, 0.25), (120.0, 0.15)]
+
+    def worker_init(ctx):
+        # runs INSIDE each forked ingress worker: full replica stack
+        # per process, restored from the shared checkpoint + cache
+        from ray_tpu.ingress import CoalescingRouter, LocalReplica
+        from ray_tpu.serve.policy_server import (
+            BatchedPolicyServer,
+            restore_policy,
+        )
+        from ray_tpu.sharding.aot import AOTCompileCache
+        from ray_tpu.sharding.compile import compile_stats
+
+        policy, prep, obs_filter, _ = restore_policy(ckpt_root)
+        cache = AOTCompileCache(cache_dir)
+        server = BatchedPolicyServer(
+            policy,
+            name="flood",
+            max_batch_size=max_batch_size,
+            batch_wait_timeout_s=0.002,
+            explore=False,
+            obs_filter=obs_filter,
+            preprocessor=prep,
+            aot_cache=cache,
+            start=False,
+        )
+        server.warmup()
+        server.start()
+        router = CoalescingRouter(
+            "flood",
+            [LocalReplica(server)],
+            max_batch_size=max_batch_size,
+            batch_wait_timeout_s=0.002,
+        )
+        ctx.ingress.add_policy("flood", router)
+        traces0 = compile_stats()["traces"]
+        fresh0 = sum(fn.traces for fn in server._fns.values())
+        sources = sorted(
+            {fn.aot_source for fn in server._fns.values()}
+        )
+
+        def extra_stats():
+            return {
+                "recompiles": compile_stats()["traces"] - traces0,
+                "warmup_fresh_compiles": fresh0,
+                "aot_sources": sources,
+            }
+
+        ctx.ingress.extra_stats = extra_stats
+
+    def poisson_schedule(rate, dur, seed):
+        r = np.random.default_rng(seed)
+        gaps = r.exponential(1.0 / rate, int(rate * dur * 2) + 16)
+        t = np.cumsum(gaps)
+        return t[t < dur].tolist()
+
+    def recorded_schedule(rate, dur, seed):
+        # the "recorded stream": a fixed-seed bursty on/off arrival
+        # trace (0.5 s periods, 3x the mean rate while on, 0.2x
+        # while off) — the shape production front doors actually see
+        r = np.random.default_rng(seed)
+        out, t, period = [], 0.0, 0.5
+        while t < dur:
+            on = int(t / period) % 2 == 0
+            cur = rate * (3.0 if on else 0.2)
+            t += float(r.exponential(1.0 / cur))
+            if t < dur:
+                out.append(t)
+        return out
+
+    def run_flood(url, schedule, label, nominal_rps=None):
+        """Fire the schedule OPEN-loop; classify every response."""
+        n = len(schedule)
+        dl_r = np.random.default_rng(1)
+        choices = [d for d, _ in deadline_mix]
+        weights = [w for _, w in deadline_mix]
+        deadlines = [
+            choices[dl_r.choice(len(choices), p=weights)]
+            for _ in range(n)
+        ]
+        counts = {
+            k: 0
+            for k in (
+                "ok", "late_200", "shed_429", "shed_503",
+                "expired_504", "hang", "error",
+            )
+        }
+        ok_lat = []
+        lock = threading.Lock()
+        idx = [0]
+        t_start = time.perf_counter() + 0.1
+
+        import http.client
+        from urllib.parse import urlsplit
+
+        parts = urlsplit(url)
+        host, port = parts.hostname, parts.port
+        path = parts.path
+
+        def sender():
+            # each sender owns a persistent keep-alive connection:
+            # timing starts at the request WRITE (the deadline budget
+            # the payload declares), not at a per-request TCP connect
+            # whose accept-queue wait the server cannot observe
+            conn = [None]
+
+            def send_one(body):
+                for attempt in (0, 1):
+                    try:
+                        if conn[0] is None:
+                            conn[0] = http.client.HTTPConnection(
+                                host, port, timeout=10.0
+                            )
+                        conn[0].request(
+                            "POST",
+                            path,
+                            body,
+                            {"Content-Type": "application/json"},
+                        )
+                        resp = conn[0].getresponse()
+                        resp.read()
+                        if (
+                            resp.headers.get("Connection", "")
+                            .lower()
+                            == "close"
+                        ):
+                            conn[0].close()
+                            conn[0] = None
+                        return resp.status
+                    except socket_mod.timeout:
+                        if conn[0] is not None:
+                            conn[0].close()
+                            conn[0] = None
+                        return "hang"
+                    except Exception:
+                        # a dropped keep-alive connection: retry
+                        # once on a fresh one before calling it an
+                        # error
+                        if conn[0] is not None:
+                            conn[0].close()
+                            conn[0] = None
+                        if attempt == 1:
+                            return "error"
+
+            while True:
+                with lock:
+                    i = idx[0]
+                    if i >= n:
+                        if conn[0] is not None:
+                            conn[0].close()
+                        return
+                    idx[0] += 1
+                delay = (
+                    t_start + schedule[i] - time.perf_counter()
+                )
+                if delay > 0:
+                    time.sleep(delay)
+                dl = deadlines[i]
+                payload = {
+                    "obs": obs_stream[i % n_obs].tolist()
+                }
+                if dl is not None:
+                    payload["deadline_ms"] = dl
+                body = json.dumps(payload).encode()
+                t0 = time.perf_counter()
+                status = send_one(body)
+                lat = time.perf_counter() - t0
+                if status == 200:
+                    # a 200 must land INSIDE its deadline (100 ms
+                    # slack for time the request sat in transport
+                    # buffers before the server's own deadline
+                    # clock could start — everything the server CAN
+                    # observe as late it already 504s)
+                    if (
+                        dl is not None
+                        and lat * 1e3 > dl + 100.0
+                    ):
+                        kind = "late_200"
+                    else:
+                        kind = "ok"
+                elif status in ("hang", "error"):
+                    kind = status
+                    lat = None
+                else:
+                    kind = {
+                        429: "shed_429",
+                        503: "shed_503",
+                        504: "expired_504",
+                    }.get(status, "error")
+                    lat = None
+                with lock:
+                    counts[kind] += 1
+                    if kind == "ok" and lat is not None:
+                        ok_lat.append(lat)
+                telemetry_metrics.inc_flood_response(kind)
+
+        threads = [
+            threading.Thread(target=sender, name=f"flood_{j}")
+            for j in range(n_senders)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = max(
+            time.perf_counter() - t_start, schedule[-1] if n else 0.0
+        )
+        offered = n / wall if wall else 0.0
+        goodput = counts["ok"] / wall if wall else 0.0
+        telemetry_metrics.set_flood_offered_rps(offered)
+        telemetry_metrics.set_flood_goodput_rps(goodput)
+        shed = (
+            counts["shed_429"]
+            + counts["shed_503"]
+            + counts["expired_504"]
+        )
+        arr = np.asarray(ok_lat) if ok_lat else None
+        return {
+            "label": label,
+            "n_requests": n,
+            "wall_s": round(wall, 3),
+            "nominal_rps": nominal_rps,
+            # the sender pool has its own ceiling: offered falling
+            # well short of nominal means the GENERATOR saturated,
+            # not the server — the knee is a lower bound there
+            "generator_capped": (
+                nominal_rps is not None
+                and offered < 0.8 * nominal_rps
+            ),
+            "offered_rps": round(offered, 1),
+            "goodput_rps": round(goodput, 1),
+            "p50_ms": (
+                round(float(np.percentile(arr, 50)) * 1e3, 3)
+                if arr is not None
+                else None
+            ),
+            "p99_ms": (
+                round(float(np.percentile(arr, 99)) * 1e3, 3)
+                if arr is not None
+                else None
+            ),
+            "shed_fraction": round(shed / n, 4) if n else 0.0,
+            "counts": dict(counts),
+        }
+
+    def collect_worker_extras(sup):
+        extras = []
+        for _, stats in sorted(sup.worker_stats().items()):
+            if stats and stats.get("extra"):
+                extras.append(stats["extra"])
+        return extras
+
+    def parity_pass(url):
+        """Closed-loop, sequential: the fixed-seed obs stream's
+        actions, for cross-config bitwise comparison."""
+        actions = []
+        for i in range(parity_n):
+            req = urllib.request.Request(
+                url,
+                data=json.dumps(
+                    {"obs": obs_stream[i % n_obs].tolist()}
+                ).encode(),
+                headers={"Content-Type": "application/json"},
+                method="POST",
+            )
+            with urllib.request.urlopen(req, timeout=30.0) as r:
+                actions.append(
+                    int(json.loads(r.read())["action"])
+                )
+        return actions
+
+    configs = {}
+    parity_actions = {}
+    for n_workers in workers_list:
+        sup = IngressSupervisor(
+            num_workers=n_workers,
+            worker_init=worker_init,
+            heartbeat_s=0.25,
+            metrics_interval_s=1.0,
+            # per-PROCESS budgets: the bank's effective in-flight
+            # budget scales with the worker count, which is the
+            # point — and small enough that the sender pool can
+            # actually overrun it at 2x knee (429s are reachable)
+            ingress_kwargs={
+                "max_inflight": 32,
+                "shed_queue_wait_s": 0.2,
+            },
+        )
+        sup.start(timeout_s=600.0)
+        url = sup.url + "/v1/policy/flood/actions"
+        try:
+            parity_actions[n_workers] = parity_pass(url)
+            sweep = []
+            knee = None
+            saturated_streak = 0
+            for rate in rates:
+                entry = run_flood(
+                    url,
+                    poisson_schedule(rate, duration_s, seed=3),
+                    f"poisson@{rate:g}",
+                    nominal_rps=rate,
+                )
+                sweep.append(entry)
+                if (
+                    entry["goodput_rps"]
+                    >= 0.9 * entry["offered_rps"]
+                ):
+                    knee = entry["offered_rps"]
+                    if entry["generator_capped"]:
+                        break
+                    saturated_streak = 0
+                else:
+                    saturated_streak += 1
+                    # past the knee twice: the curve is told, stop
+                    if saturated_streak >= 2:
+                        break
+            if knee is None:  # saturated from the first rate
+                knee = max(e["goodput_rps"] for e in sweep)
+            overload = run_flood(
+                url,
+                poisson_schedule(
+                    overload_factor * knee, duration_s, seed=5
+                ),
+                f"overload@{overload_factor:g}x_knee",
+                nominal_rps=overload_factor * knee,
+            )
+            c = overload["counts"]
+            contract_ok = (
+                c["hang"] == 0
+                and c["late_200"] == 0
+                and c["error"] <= max(2, overload["n_requests"] // 100)
+            )
+            recorded = None
+            if run_recorded:
+                recorded = run_flood(
+                    url,
+                    recorded_schedule(knee, duration_s, seed=11),
+                    "recorded_burst@knee",
+                    nominal_rps=knee,
+                )
+            # wait one heartbeat so extra stats reflect the flood
+            time.sleep(0.6)
+            extras = collect_worker_extras(sup)
+            configs[str(n_workers)] = {
+                "num_workers": n_workers,
+                "reuseport": sup.stats()["reuseport"],
+                "sweep": sweep,
+                "knee_rps": round(knee, 1),
+                "overload": overload,
+                "overload_contract_ok": contract_ok,
+                "recorded": recorded,
+                "workers": extras,
+            }
+        finally:
+            sup.stop()
+
+    lo, hi = str(workers_list[0]), str(workers_list[-1])
+    knee_lo = configs[lo]["knee_rps"]
+    knee_hi = configs[hi]["knee_rps"]
+    scale_ratio = round(knee_hi / max(knee_lo, 1e-9), 2)
+    parity = (
+        parity_actions[workers_list[0]]
+        == parity_actions[workers_list[-1]]
+    )
+    all_extras = [
+        e for c in configs.values() for e in c["workers"]
+    ]
+    zero_recompiles = bool(all_extras) and all(
+        e["recompiles"] == 0 for e in all_extras
+    )
+    aot_warm = bool(all_extras) and all(
+        e["warmup_fresh_compiles"] == 0
+        and e["aot_sources"] == ["aot_cache"]
+        for e in all_extras
+    )
+    report = {
+        "metric": "ingress_flood",
+        "smoke": smoke,
+        "model": [64, 64],
+        "max_batch_size": max_batch_size,
+        "deadline_mix_ms": deadline_mix,
+        "transport": "real sockets (HTTP/1.1), open-loop senders",
+        "cpu_count": os.cpu_count(),
+        "configs": configs,
+        "scaleout": {
+            "workers": [workers_list[0], workers_list[-1]],
+            "knee_rps": [knee_lo, knee_hi],
+            "ratio": scale_ratio,
+            # True when the N-worker knee is a LOWER bound because
+            # the load generator saturated before the bank did
+            "hi_knee_generator_capped": any(
+                e.get("generator_capped")
+                for e in configs[hi]["sweep"]
+            ),
+        },
+        "parity_bitwise": parity,
+        "criteria": {
+            "knee_found_per_config": all(
+                c["knee_rps"] > 0 for c in configs.values()
+            ),
+            "overload_contract_429_503_504": all(
+                c["overload_contract_ok"]
+                for c in configs.values()
+            ),
+            "parity_bitwise": parity,
+            "zero_recompiles": zero_recompiles,
+            "aot_warm_start_all_workers": aot_warm,
+            "scaleout_knee_ge_2p5x": scale_ratio >= 2.5,
+        },
+        "caveats": [
+            (
+                f"host has {os.cpu_count()} CPU core(s): worker "
+                "processes time-slice the same core, so the knee "
+                "ratio here measures process-isolation overhead, "
+                "not the multi-core scale-out the >=2.5x target "
+                "describes; rerun on a multi-core front-door host "
+                "for the headline number"
+            )
+        ]
+        if (os.cpu_count() or 1) <= max(workers_list)
+        else [],
+    }
+    shutil.rmtree(workdir, ignore_errors=True)
+    os.makedirs(os.path.dirname(out_path), exist_ok=True)
+    with open(out_path, "w") as f:
+        json.dump(report, f, indent=1)
+    print(json.dumps(report))
+    return report
+
+
 def bench_apex(out_path=None, iters=4):
     """Host sum tree vs device sum tree A/B at a training_intensity-
     heavy DQN geometry, plus the learn-while-rollout interleave A/B
@@ -4158,6 +4719,9 @@ def main():
         return
     if "--ingress" in sys.argv:
         bench_ingress()
+        return
+    if "--flood" in sys.argv:
+        bench_flood(smoke="--smoke" in sys.argv)
         return
     if "--model-parallel" in sys.argv:
         bench_model_parallel()

@@ -204,15 +204,20 @@ class SSHNodeProvider(NodeProvider):
         # the fleet's shared secret must reach the remote agent or a
         # token-secured head (the normal setup for non-loopback
         # fleets — exactly this provider's use case) rejects its
-        # registration and data-plane pulls
-        secrets = ""
+        # registration and data-plane pulls. It travels over the ssh
+        # session's STDIN (``VAR=value`` lines, blank line ends the
+        # block), never in the argv — command lines are world-visible
+        # in ``ps`` / ``/proc/*/cmdline`` on both machines.
+        secret_lines = []
         for var in ("RAY_TPU_CLUSTER_TOKEN", "RAY_TPU_KV_TOKEN"):
             val = os.environ.get(var)
             if val:
-                secrets += f"{var}={q(val)} "
+                secret_lines.append(f"{var}={val}")
         remote = (
+            'while IFS= read -r _kv; do [ -n "$_kv" ] || break; '
+            'export "$_kv"; done; '
             f"cd {q(self.remote_repo)} && "
-            f"JAX_PLATFORMS=cpu {secrets}"
+            f"JAX_PLATFORMS=cpu "
             f"PYTHONPATH={q(self.remote_repo)}:$PYTHONPATH "
             f"exec {q(self.remote_python)} -m ray_tpu.core.node_agent"
             f" --address {q(self.head_address)}"
@@ -220,9 +225,17 @@ class SSHNodeProvider(NodeProvider):
         )
         proc = subprocess.Popen(
             self.ssh_cmd + [host, remote],
+            stdin=subprocess.PIPE,
             stdout=subprocess.DEVNULL,
             stderr=subprocess.DEVNULL,
         )
+        payload = "".join(f"{ln}\n" for ln in secret_lines) + "\n"
+        try:
+            proc.stdin.write(payload.encode())
+            proc.stdin.flush()
+            proc.stdin.close()
+        except (BrokenPipeError, OSError):
+            pass  # agent died instantly; reconcile loop replaces it
         self.nodes[node_id] = {"host": host, "proc": proc}
         return node_id
 

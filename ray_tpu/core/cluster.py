@@ -44,6 +44,7 @@ import os
 import socket
 import struct
 import threading
+import time
 import uuid
 from typing import Any, Dict, List, Optional
 
@@ -111,7 +112,13 @@ def _open_peer_conn(host: str, port: int, timeout: float = 30.0):
     is full-pickle on the consumer, so only authenticated cluster
     members may serve one). ``timeout`` bounds the connect AND each
     handshake read, so a peer that accepts but never speaks cannot
-    stall the caller past its deadline."""
+    stall the caller past its deadline.
+
+    The handshake is MUTUAL when a cluster token is set: the client
+    sends its own ``client_nonce`` and the server's ok-frame must echo
+    it under an HMAC keyed on the shared token — verified BEFORE any
+    pull payload is unpickled, so a spoofed data server cannot feed
+    this consumer attacker-controlled pickle bytes."""
     sock = socket.create_connection(
         (host, int(port)), timeout=timeout
     )
@@ -123,9 +130,11 @@ def _open_peer_conn(host: str, port: int, timeout: float = 30.0):
     ):
         sock.close()
         raise ConnectionError("data server sent no challenge")
+    client_nonce = uuid.uuid4().hex
     auth = {
         "op": "pull_auth",
         "nonce": challenge.get("nonce", ""),
+        "client_nonce": client_nonce,
         # version must be IN the frame before the MAC: _send_frame
         # stamps it on unversioned frames, and the MAC covers every
         # non-mac field
@@ -140,6 +149,15 @@ def _open_peer_conn(host: str, port: int, timeout: float = 30.0):
     if not isinstance(resp, dict) or not resp.get("ok"):
         sock.close()
         raise ConnectionError("data server rejected pull auth")
+    if token is not None and (
+        resp.get("nonce") != client_nonce
+        or not wire.register_ok(token, resp)
+    ):
+        sock.close()
+        raise ConnectionError(
+            "data server failed mutual auth (ok-frame HMAC over "
+            "the client nonce missing or wrong)"
+        )
     return sock, lock
 
 
@@ -167,16 +185,29 @@ def fetch_remote_object(
     gets a fresh-connection retry, then the object is reported lost
     (the caller maps that to an object-lost error).
 
-    ``timeout`` is the CALLER's deadline: when set, it bounds every
-    socket phase (connect, handshake, request) and a slow peer
-    re-raises ``socket.timeout`` immediately. When None ("block until
+    ``timeout`` is the CALLER's deadline: when set, it is ONE
+    monotonic deadline across BOTH attempts — the retry spends only
+    what the first attempt left, so a slow-then-dead peer cannot
+    stretch the call to 2x the requested bound. A slow peer re-raises
+    ``socket.timeout`` immediately. When None ("block until
     available"), socket ops still carry a 60 s liveness bound, but a
     trip of it counts as a transient failure (retry, then
     object-lost) — never a timeout error the caller didn't opt into."""
     key = (str(host), int(port))
-    sock_timeout = timeout if timeout is not None else 60.0
+    deadline = (
+        time.monotonic() + timeout if timeout is not None else None
+    )
     last_err: Optional[Exception] = None
     for attempt in range(2):
+        if deadline is None:
+            sock_timeout = 60.0
+        else:
+            sock_timeout = deadline - time.monotonic()
+            if sock_timeout <= 0:
+                raise socket.timeout(
+                    f"pull of {obj_id} from {host}:{port}: "
+                    "deadline exhausted before retry"
+                )
         with _peer_conns_lock:
             entry = _peer_conns.get(key)
         try:
@@ -370,92 +401,105 @@ class RemoteNode:
                 # a forbidden frame on an established agent connection
                 # means the peer is compromised or not ours: drop it
                 msg = None
-            if msg is None:
-                self._on_disconnect()
-                return
-            op = msg.get("op")
-            if op == "result":
-                task_id = msg["task_id"]
-                with self.state_lock:
-                    self.inflight.pop(task_id, None)
-                    trec = self.task_recs.pop(task_id, None)
-                    if trec is not None and not getattr(
-                        trec, "pg_spilled", False
-                    ):
-                        self.inflight_cpus -= trec.num_cpus
-                if trec is not None and getattr(
+            if msg is not None:
+                try:
+                    self._handle_agent_frame(msg)
+                    continue
+                except Exception:
+                    # schema-valid but semantically malformed (bad
+                    # result pickle, impossible split shape): the
+                    # connection's state is unknown — fall through to
+                    # the disconnect path instead of letting the
+                    # exception kill this thread and zombify the node
+                    # with its inflight tasks never failed over
+                    pass
+            self._on_disconnect()
+            return
+
+    def _handle_agent_frame(self, msg) -> None:
+        op = msg.get("op")
+        if op == "result":
+            task_id = msg["task_id"]
+            with self.state_lock:
+                self.inflight.pop(task_id, None)
+                trec = self.task_recs.pop(task_id, None)
+                if trec is not None and not getattr(
                     trec, "pg_spilled", False
                 ):
-                    trec.placement_group._release(
-                        trec.num_cpus, trec.acquired_bundle
-                    )
-                if trec is not None and self.runtime.pending:
-                    # capacity freed: queued tasks may spill now —
-                    # wake the cluster's single dispatcher thread (a
-                    # per-result thread would stampede runtime.lock at
-                    # high task rates, and dispatching inline here
-                    # would stall the recv loop on a slow marshal)
-                    cluster = getattr(self.runtime, "cluster", None)
-                    if cluster is not None:
-                        cluster.kick_dispatch()
-                if msg.get("ok"):
-                    node_obj = msg.get("node_obj")
-                    if node_obj is not None and self.data_port:
-                        split = node_obj.get("split_sizes")
-                        if split is not None:
-                            # agent split the multi-return tuple
-                            # node-side: register each element as its
-                            # own remote object under the
-                            # pre-registered split ref ids; drop the
-                            # base entry (its pending split callback
-                            # dies with it)
-                            with self.state_lock:
-                                for i in range(len(split)):
-                                    self.owned_objs.add(
-                                        f"{task_id}_{i}"
-                                    )
-                            for i, sz in enumerate(split):
-                                self.runtime.store.put_remote(
-                                    f"{task_id}_{i}",
-                                    {
-                                        "node_id": self.node_id,
-                                        "host": self.data_host,
-                                        "port": self.data_port,
-                                        "size": int(sz),
-                                    },
-                                )
-                            self.runtime.store.free([task_id])
-                            continue
-                        # bytes stayed on the agent: record the
-                        # location only (per-node data plane) — the
-                        # head pulls iff something here reads the ref
+                    self.inflight_cpus -= trec.num_cpus
+            if trec is not None and getattr(
+                trec, "pg_spilled", False
+            ):
+                trec.placement_group._release(
+                    trec.num_cpus, trec.acquired_bundle
+                )
+            if trec is not None and self.runtime.pending:
+                # capacity freed: queued tasks may spill now —
+                # wake the cluster's single dispatcher thread (a
+                # per-result thread would stampede runtime.lock at
+                # high task rates, and dispatching inline here
+                # would stall the recv loop on a slow marshal)
+                cluster = getattr(self.runtime, "cluster", None)
+                if cluster is not None:
+                    cluster.kick_dispatch()
+            if msg.get("ok"):
+                node_obj = msg.get("node_obj")
+                if node_obj is not None and self.data_port:
+                    split = node_obj.get("split_sizes")
+                    if split is not None:
+                        # agent split the multi-return tuple
+                        # node-side: register each element as its
+                        # own remote object under the
+                        # pre-registered split ref ids; drop the
+                        # base entry (its pending split callback
+                        # dies with it)
                         with self.state_lock:
-                            self.owned_objs.add(task_id)
-                        self.runtime.store.put_remote(
-                            task_id,
-                            {
-                                "node_id": self.node_id,
-                                "host": self.data_host,
-                                "port": self.data_port,
-                                "size": int(node_obj.get("size", 0)),
-                            },
-                        )
-                    else:
-                        self.runtime.store.put(
-                            task_id,
-                            ser.loads(msg["payload"]),
-                            use_shm=False,
-                        )
-                else:
-                    from ray_tpu.core.api import RayTaskError
-
-                    self.runtime.store.put_error(
+                            for i in range(len(split)):
+                                self.owned_objs.add(
+                                    f"{task_id}_{i}"
+                                )
+                        for i, sz in enumerate(split):
+                            self.runtime.store.put_remote(
+                                f"{task_id}_{i}",
+                                {
+                                    "node_id": self.node_id,
+                                    "host": self.data_host,
+                                    "port": self.data_port,
+                                    "size": int(sz),
+                                },
+                            )
+                        self.runtime.store.free([task_id])
+                        return
+                    # bytes stayed on the agent: record the
+                    # location only (per-node data plane) — the
+                    # head pulls iff something here reads the ref
+                    with self.state_lock:
+                        self.owned_objs.add(task_id)
+                    self.runtime.store.put_remote(
                         task_id,
-                        RayTaskError(
-                            msg.get("name", "remote"),
-                            msg.get("traceback", ""),
-                        ),
+                        {
+                            "node_id": self.node_id,
+                            "host": self.data_host,
+                            "port": self.data_port,
+                            "size": int(node_obj.get("size", 0)),
+                        },
                     )
+                else:
+                    self.runtime.store.put(
+                        task_id,
+                        ser.loads(msg["payload"]),
+                        use_shm=False,
+                    )
+            else:
+                from ray_tpu.core.api import RayTaskError
+
+                self.runtime.store.put_error(
+                    task_id,
+                    RayTaskError(
+                        msg.get("name", "remote"),
+                        msg.get("traceback", ""),
+                    ),
+                )
 
     def _on_disconnect(self):
         """Agent died / network split: fail everything it owed us
@@ -1184,7 +1228,20 @@ class NodeAgent:
             ):
                 conn.close()
                 return
-            _send_frame(conn, lock, {"ok": True})
+            # mutual auth: echo the client's nonce under an HMAC so
+            # the consumer can verify it is talking to a real cluster
+            # member BEFORE unpickling any pull payload
+            ok_frame = {
+                "ok": True,
+                "nonce": str(msg.get("client_nonce", "")),
+                "v": wire.FRAME_VERSION,
+            }
+            token = wire.cluster_token()
+            if token is not None:
+                ok_frame["hmac"] = wire.register_hmac(
+                    token, ok_frame
+                )
+            _send_frame(conn, lock, ok_frame)
             conn.settimeout(None)
             while True:
                 req = _recv_frame(conn, max_len=_MAX_HANDSHAKE_FRAME)

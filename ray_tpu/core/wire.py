@@ -146,7 +146,11 @@ _SCHEMAS: Dict[str, Dict[str, tuple]] = {
         "traceback": (str, False),
         "node_obj": (dict, False),
     },
-    "pull_auth": {"nonce": (str, True), "hmac": (str, False)},
+    "pull_auth": {
+        "nonce": (str, True),
+        "client_nonce": (str, False),
+        "hmac": (str, False),
+    },
     "pull": {"obj_id": (str, True)},
 }
 

@@ -8,9 +8,13 @@ Three layers between a TCP socket and a mesh forward
 - :mod:`~ray_tpu.ingress.router` — cross-replica batch coalescing
   into full power-of-two buckets with deadlines and dead-replica
   rerouting;
-- :mod:`~ray_tpu.ingress.admission` — bounded in-flight budget +
-  queue-wait shedding (429/503 + Retry-After) so overload sheds
-  instead of queueing.
+- :mod:`~ray_tpu.ingress.admission` — bounded in-flight budget,
+  per-policy quotas + queue-wait shedding (429/503 + Retry-After) so
+  overload sheds instead of queueing;
+- :mod:`~ray_tpu.ingress.supervisor` — horizontal scale-out: N
+  ingress worker PROCESSES accepting on ONE port (SO_REUSEPORT or an
+  inherited listener), with crash respawn, forwarded membership,
+  whole-bank drain, and one merged ``/metrics`` exposition.
 
 Cold starts skip the compile storm via the AOT executable cache
 (:mod:`ray_tpu.sharding.aot`), loaded by
@@ -30,6 +34,11 @@ from ray_tpu.ingress.router import (  # noqa: F401
     NoReplicasAvailable,
     wrap_replica,
 )
+from ray_tpu.ingress.supervisor import (  # noqa: F401
+    ForwardedFeed,
+    IngressSupervisor,
+    WorkerContext,
+)
 
 __all__ = [
     "AdmissionController",
@@ -41,4 +50,7 @@ __all__ = [
     "DeadlineExpired",
     "NoReplicasAvailable",
     "wrap_replica",
+    "IngressSupervisor",
+    "ForwardedFeed",
+    "WorkerContext",
 ]

@@ -10,6 +10,12 @@ shed INSTEAD of queueing (docs/serving.md "the front door"):
   requests may be unanswered at once; past that the ingress answers
   **429 Too Many Requests** with a ``Retry-After`` hint instead of
   enqueueing;
+- **per-policy quotas** — a SHARED controller may cap each policy's
+  slice of the in-flight budget (``quotas={"policy": n}`` or
+  ``default_quota``), so one hot tenant flooding its route cannot
+  exhaust the global budget and starve every other policy on the
+  mesh; a request past its policy's share gets **429** with reason
+  ``quota`` while other policies keep admitting;
 - **queue-wait shedding** — when the trailing-window p50 queue wait
   (``BatchedPolicyServer.queue_wait_window()`` — the SAME shared
   accessor the serve autoscaler targets through ``stats()``, surfaced
@@ -50,7 +56,13 @@ class AdmissionController:
     """Per-policy (or shared) admission state. ``try_admit`` returns
     None to admit — the caller MUST pair it with ``release()`` (or use
     the :meth:`admit` context manager) — or an
-    :class:`AdmissionDecision` describing the shed."""
+    :class:`AdmissionDecision` describing the shed.
+
+    ``quotas`` maps policy name → that policy's in-flight cap inside
+    this controller's global ``max_inflight``; ``default_quota``
+    applies to policies without an explicit row. Callers opt in by
+    passing ``policy=`` to ``try_admit``/``release`` — the pair must
+    name the SAME policy."""
 
     def __init__(
         self,
@@ -60,19 +72,28 @@ class AdmissionController:
         wait_signal: Optional[Callable[[], Optional[float]]] = None,
         signal_interval_s: float = 0.25,
         retry_after_s: float = 1.0,
+        quotas: Optional[Dict[str, int]] = None,
+        default_quota: Optional[int] = None,
     ):
         self.max_inflight = int(max_inflight)
         self.shed_queue_wait_s = shed_queue_wait_s
         self.wait_signal = wait_signal
         self.signal_interval_s = float(signal_interval_s)
         self.retry_after_s = float(retry_after_s)
+        self.quotas: Dict[str, int] = {
+            str(k): int(v) for k, v in (quotas or {}).items()
+        }
+        self.default_quota = (
+            int(default_quota) if default_quota is not None else None
+        )
         self._lock = threading.Lock()
         self._inflight = 0
+        self._policy_inflight: Dict[str, int] = {}
         self._signal_value: Optional[float] = None
         self._signal_t = 0.0
         self.admitted_total = 0
         self.shed_total: Dict[str, int] = {
-            "inflight": 0, "queue_wait": 0, "deadline": 0,
+            "inflight": 0, "quota": 0, "queue_wait": 0, "deadline": 0,
         }
 
     # -- the decision ----------------------------------------------------
@@ -96,12 +117,22 @@ class AdmissionController:
             self._signal_value = value
         return value
 
+    def _quota_for(self, policy: Optional[str]) -> Optional[int]:
+        if policy is None:
+            return None
+        q = self.quotas.get(policy)
+        return q if q is not None else self.default_quota
+
     def try_admit(
-        self, deadline_s: Optional[float] = None
+        self,
+        deadline_s: Optional[float] = None,
+        policy: Optional[str] = None,
     ) -> Optional[AdmissionDecision]:
         """Admit (None) or shed (a decision). ``deadline_s`` is the
         request's RELATIVE deadline; non-positive means it cannot be
-        met no matter what — refused without touching the queue."""
+        met no matter what — refused without touching the queue.
+        ``policy`` enables the per-tenant quota check and MUST be
+        echoed to the paired ``release``."""
         if deadline_s is not None and deadline_s <= 0:
             return self._shed("deadline", 504, self.retry_after_s)
         wait = self._current_wait()
@@ -117,17 +148,32 @@ class AdmissionController:
                 503,
                 max(self.retry_after_s, 2.0 * wait),
             )
+        quota = self._quota_for(policy)
         with self._lock:
             if self._inflight >= self.max_inflight:
-                shed = True
+                reason = "inflight"
+            elif (
+                quota is not None
+                and self._policy_inflight.get(policy, 0) >= quota
+            ):
+                reason = "quota"
             else:
-                shed = False
+                reason = None
                 self._inflight += 1
                 self.admitted_total += 1
                 inflight = self._inflight
-        if shed:
-            return self._shed("inflight", 429, self.retry_after_s)
+                if policy is not None:
+                    self._policy_inflight[policy] = (
+                        self._policy_inflight.get(policy, 0) + 1
+                    )
+                    policy_inflight = self._policy_inflight[policy]
+        if reason is not None:
+            return self._shed(reason, 429, self.retry_after_s)
         telemetry_metrics.set_ingress_inflight(inflight)
+        if policy is not None:
+            telemetry_metrics.set_ingress_policy_inflight(
+                policy, policy_inflight
+            )
         return None
 
     def _shed(
@@ -140,18 +186,28 @@ class AdmissionController:
         telemetry_metrics.inc_ingress_shed(reason)
         return AdmissionDecision(status, reason, retry_after_s)
 
-    def release(self) -> None:
+    def release(self, policy: Optional[str] = None) -> None:
         with self._lock:
             self._inflight = max(0, self._inflight - 1)
             inflight = self._inflight
+            if policy is not None:
+                self._policy_inflight[policy] = max(
+                    0, self._policy_inflight.get(policy, 0) - 1
+                )
+                policy_inflight = self._policy_inflight[policy]
         telemetry_metrics.set_ingress_inflight(inflight)
+        if policy is not None:
+            telemetry_metrics.set_ingress_policy_inflight(
+                policy, policy_inflight
+            )
 
     class _Admit:
-        __slots__ = ("ctrl", "decision")
+        __slots__ = ("ctrl", "decision", "policy")
 
-        def __init__(self, ctrl, decision):
+        def __init__(self, ctrl, decision, policy=None):
             self.ctrl = ctrl
             self.decision = decision
+            self.policy = policy
 
         @property
         def admitted(self) -> bool:
@@ -162,20 +218,26 @@ class AdmissionController:
 
         def __exit__(self, *exc):
             if self.admitted:
-                self.ctrl.release()
+                self.ctrl.release(self.policy)
             return False
 
     def admit(
-        self, deadline_s: Optional[float] = None
+        self,
+        deadline_s: Optional[float] = None,
+        policy: Optional[str] = None,
     ) -> "AdmissionController._Admit":
         """``with ctrl.admit(...) as a:`` — ``a.admitted`` says
         whether to proceed; release happens on exit automatically."""
-        return self._Admit(self, self.try_admit(deadline_s))
+        return self._Admit(
+            self, self.try_admit(deadline_s, policy=policy), policy
+        )
 
     # -- introspection ---------------------------------------------------
 
-    def num_inflight(self) -> int:
+    def num_inflight(self, policy: Optional[str] = None) -> int:
         with self._lock:
+            if policy is not None:
+                return self._policy_inflight.get(policy, 0)
             return self._inflight
 
     def stats(self) -> Dict[str, Any]:
@@ -187,4 +249,7 @@ class AdmissionController:
                 "shed_total": dict(self.shed_total),
                 "shed_queue_wait_s": self.shed_queue_wait_s,
                 "last_wait_signal": self._signal_value,
+                "quotas": dict(self.quotas),
+                "default_quota": self.default_quota,
+                "policy_inflight": dict(self._policy_inflight),
             }

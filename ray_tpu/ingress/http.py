@@ -70,6 +70,29 @@ _REASONS = {
     504: "Gateway Timeout",
 }
 
+# request-path diet: the response head's fixed parts are serialized
+# ONCE per status at import — the per-request work is two int formats
+# (length) and a join, not an f-string build + encode of the whole head
+_HEAD_PREFIX = {
+    status: (
+        f"HTTP/1.1 {status} {reason}\r\n"
+        "Content-Type: application/json\r\n"
+    ).encode("latin1")
+    for status, reason in _REASONS.items()
+}
+_CONN_KEEPALIVE = b"Connection: keep-alive\r\n\r\n"
+_CONN_CLOSE = b"Connection: close\r\n\r\n"
+
+
+def _head_prefix(status: int) -> bytes:
+    pre = _HEAD_PREFIX.get(status)
+    if pre is None:
+        pre = (
+            f"HTTP/1.1 {status} Unknown\r\n"
+            "Content-Type: application/json\r\n"
+        ).encode("latin1")
+    return pre
+
 ACTIONS_PREFIX = "/v1/policy/"
 ACTIONS_SUFFIX = "/actions"
 
@@ -114,11 +137,22 @@ class PolicyIngress:
         default_timeout_s: float = 60.0,
         notice_host: Optional[str] = None,
         notice_poll_s: float = 2.0,
+        quotas: Optional[Dict[str, int]] = None,
+        default_quota: Optional[int] = None,
+        reuse_port: bool = False,
+        listen_sock=None,
     ):
         self.host = host
         self._requested_port = int(port)
         self.port: Optional[int] = None
         self.default_timeout_s = float(default_timeout_s)
+        # horizontal scale-out hooks (ingress/supervisor.py): either
+        # bind our own SO_REUSEPORT socket so N sibling processes
+        # share ONE port (the kernel balances connections), or accept
+        # on a pre-bound listener inherited from the supervisor (the
+        # fallback where SO_REUSEPORT is unavailable)
+        self._reuse_port = bool(reuse_port)
+        self._listen_sock = listen_sock
         # provider-notice drain (resilience/provider_notice.py): the
         # ingress is a fleet member like any learner host — on a
         # preemption notice it stops renewing keep-alive connections
@@ -135,6 +169,19 @@ class PolicyIngress:
             max_inflight=max_inflight,
             shed_queue_wait_s=shed_queue_wait_s,
         )
+        # per-policy quotas only mean anything against ONE shared
+        # in-flight budget: with quotas configured, every mounted
+        # policy (without an explicit controller) admits through this
+        # shared controller, whose wait signal is the WORST signal
+        # across all mounted routers
+        self._shared_admission: Optional[AdmissionController] = None
+        if quotas is not None or default_quota is not None:
+            self._shared_admission = AdmissionController(
+                wait_signal=self._worst_wait_signal,
+                quotas=quotas,
+                default_quota=default_quota,
+                **self._admission_defaults,
+            )
         # name -> (router, admission); mutated only via add/remove
         self._policies: Dict[
             str, Tuple[CoalescingRouter, AdmissionController]
@@ -157,13 +204,32 @@ class PolicyIngress:
         """Mount ``router`` at ``/v1/policy/<name>/actions``. Without
         an explicit controller, one is built from the ingress defaults
         with the router's ``queue_wait_signal`` as its shed feed (the
-        shared ``queue_wait_window`` accessor)."""
+        shared ``queue_wait_window`` accessor) — unless this ingress
+        was configured with ``quotas``/``default_quota``, in which
+        case every defaulted policy admits through the ONE shared,
+        quota-aware controller."""
         if admission is None:
-            admission = AdmissionController(
-                wait_signal=router.queue_wait_signal,
-                **self._admission_defaults,
-            )
+            if self._shared_admission is not None:
+                admission = self._shared_admission
+            else:
+                admission = AdmissionController(
+                    wait_signal=router.queue_wait_signal,
+                    **self._admission_defaults,
+                )
         self._policies[name] = (router, admission)
+
+    def _worst_wait_signal(self) -> Optional[float]:
+        """Shed feed for the shared (quota) controller: the worst p50
+        queue wait across every mounted router."""
+        waits = []
+        for router, _ in self._policies.values():
+            try:
+                w = router.queue_wait_signal()
+            except Exception:
+                w = None
+            if w is not None:
+                waits.append(w)
+        return max(waits) if waits else None
 
     def serve_deployment(self, name: str, **router_kwargs) -> None:
         """Front a serve-core deployment: resolves the
@@ -216,9 +282,21 @@ class PolicyIngress:
             loop.close()
 
     async def _serve_forever(self) -> None:
-        self._server = await asyncio.start_server(
-            self._handle_conn, self.host, self._requested_port
-        )
+        if self._listen_sock is not None:
+            self._server = await asyncio.start_server(
+                self._handle_conn, sock=self._listen_sock
+            )
+        elif self._reuse_port:
+            self._server = await asyncio.start_server(
+                self._handle_conn,
+                self.host,
+                self._requested_port,
+                reuse_port=True,
+            )
+        else:
+            self._server = await asyncio.start_server(
+                self._handle_conn, self.host, self._requested_port
+            )
         self.port = self._server.sockets[0].getsockname()[1]
         self._ready.set()
         watcher = asyncio.ensure_future(self._watch_notice())
@@ -249,6 +327,15 @@ class PolicyIngress:
                 self._notice_grace_s = grace
                 return
             await asyncio.sleep(self.notice_poll_s)
+
+    def drain(self, grace_s: Optional[float] = None) -> None:
+        """Flip this ingress into draining mode NOW (the same state a
+        provider notice produces): healthz answers 503, keep-alive
+        connections close after their next response. The supervisor
+        broadcasts this to every worker of a bank so the whole front
+        door drains together."""
+        self._notice_grace_s = grace_s
+        self._draining = True
 
     @property
     def draining(self) -> bool:
@@ -288,9 +375,13 @@ class PolicyIngress:
         """One keep-alive connection: parse → dispatch → respond,
         until the client closes. Requests on DIFFERENT connections
         interleave on the loop; batching happens in the router."""
+        # one header dict per CONNECTION, cleared per request — a
+        # keep-alive client paying a dict allocation per request adds
+        # up at flood rates (the request-path diet)
+        hdr_buf: Dict[str, str] = {}
         try:
             while not self._stop.is_set():
-                request = await self._read_request(reader)
+                request = await self._read_request(reader, hdr_buf)
                 if request is None:
                     break
                 method, path, headers, body = request
@@ -304,20 +395,17 @@ class PolicyIngress:
                     # about to be preempted
                     and not self._draining
                 )
-                head = (
-                    f"HTTP/1.1 {status} "
-                    f"{_REASONS.get(status, 'Unknown')}\r\n"
-                    "Content-Type: application/json\r\n"
-                    f"Content-Length: {len(payload)}\r\n"
-                )
+                parts = [
+                    _head_prefix(status),
+                    b"Content-Length: %d\r\n" % len(payload),
+                ]
                 for k, v in extra_headers:
-                    head += f"{k}: {v}\r\n"
-                head += (
-                    "Connection: "
-                    + ("keep-alive" if keep_alive else "close")
-                    + "\r\n\r\n"
+                    parts.append(f"{k}: {v}\r\n".encode("latin1"))
+                parts.append(
+                    _CONN_KEEPALIVE if keep_alive else _CONN_CLOSE
                 )
-                writer.write(head.encode("latin1") + payload)
+                parts.append(payload)
+                writer.write(b"".join(parts))
                 await writer.drain()
                 if not keep_alive:
                     break
@@ -334,7 +422,7 @@ class PolicyIngress:
                 pass
 
     @staticmethod
-    async def _read_request(reader):
+    async def _read_request(reader, hdr_buf: Optional[Dict] = None):
         line = await reader.readline()
         if not line or line in (b"\r\n", b"\n"):
             return None
@@ -344,7 +432,13 @@ class PolicyIngress:
             )
         except ValueError:
             return None
-        headers: Dict[str, str] = {}
+        # reuse the caller's per-connection buffer when given (the
+        # request-path diet); fresh dict otherwise (ASGI adapter &c.)
+        if hdr_buf is not None:
+            hdr_buf.clear()
+            headers = hdr_buf
+        else:
+            headers = {}
         while True:
             h = await reader.readline()
             if h in (b"\r\n", b"\n", b""):
@@ -448,10 +542,11 @@ class PolicyIngress:
             if trace_id is not None
             else None
         )
+        t_req = time.perf_counter()
         with tracing.context_span(
             ctx, "ingress:request", policy=name
         ):
-            decision = admission.try_admit(deadline_s)
+            decision = admission.try_admit(deadline_s, policy=name)
             if decision is not None:
                 return self._shed_response(decision)
             trace_ctx = tracing.inject_context()
@@ -485,7 +580,18 @@ class PolicyIngress:
             except Exception as e:
                 return self._error(500, repr(e))
             finally:
-                admission.release()
+                admission.release(policy=name)
+            # the overload contract (bench.py --flood): a deadlined
+            # request NEVER gets a 200 past its deadline — a result
+            # that raced past it while batched is worthless to the
+            # client and is reported as the 504 it effectively is
+            if (
+                deadline_s is not None
+                and time.perf_counter() - t_req > deadline_s
+            ):
+                return self._error(
+                    504, "completed past deadline"
+                )
         return (
             200,
             [],

@@ -254,6 +254,7 @@ class CoalescingRouter:
         self._membership = membership
         self._wrap = wrap or wrap_replica
         self._members_version = -1
+        self._members_lock = threading.Lock()
         self._replicas: List[Any] = [
             _as_client(r, i, self._wrap)
             for i, r in enumerate(replicas)
@@ -362,14 +363,20 @@ class CoalescingRouter:
             version, members = self._membership.current()
         except Exception:
             return
-        if version == self._members_version:
-            return
-        self._members_version = version
-        if members:
-            self._replicas = [
-                _as_client(m, i, self._wrap)
-                for i, m in enumerate(members)
-            ]
+        # called from the batcher thread AND from health/stats readers
+        # (an idle router must still adopt a feed that arrived after
+        # construction, or healthz reports it degraded forever and a
+        # balancer never sends it its first request); the lock makes
+        # the version-gated swap safe from any thread
+        with self._members_lock:
+            if version == self._members_version:
+                return
+            self._members_version = version
+            if members:
+                self._replicas = [
+                    _as_client(m, i, self._wrap)
+                    for i, m in enumerate(members)
+                ]
 
     # ray-tpu: thread=router-batcher
     def _collect(self):
@@ -534,6 +541,7 @@ class CoalescingRouter:
         return max(waits) if waits else None
 
     def num_replicas(self) -> int:
+        self._refresh_membership()
         return len(self._replicas)
 
     def num_dead(self) -> int:

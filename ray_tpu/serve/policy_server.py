@@ -65,6 +65,49 @@ from ray_tpu.telemetry import metrics as telemetry_metrics
 from ray_tpu.util import tracing
 
 
+def device_ledger_summary() -> Optional[Dict[str, Any]]:
+    """The device-ledger slice of ``stats()``: aggregate MFU from the
+    telemetry ledger (``telemetry.device.snapshot()["totals"]``) plus
+    the fraction of HBM still free on this replica's device. This is
+    the serve autoscaler's SECOND signal source
+    (``autoscaling_config={"signal": "ledger"}``) — batch fill says
+    how hard the buckets run, this says whether another replica could
+    even fit. Returns None when neither number is knowable (ledger
+    disabled AND no memory stats), so stats() payloads stay honest.
+
+    ``RAY_TPU_HBM_HEADROOM`` overrides the measured headroom (CPU
+    hosts report no HBM; tests pin the gate with it)."""
+    mfu = None
+    try:
+        from ray_tpu.telemetry import device as device_ledger
+
+        if device_ledger.enabled():
+            mfu = device_ledger.snapshot()["totals"]["mfu"]
+    except Exception:
+        pass
+    headroom = None
+    env = os.environ.get("RAY_TPU_HBM_HEADROOM")
+    if env:
+        try:
+            headroom = float(env)
+        except ValueError:
+            headroom = None
+    if headroom is None:
+        try:
+            import jax
+
+            ms = jax.devices()[0].memory_stats()
+            in_use = (ms or {}).get("bytes_in_use")
+            limit = (ms or {}).get("bytes_limit")
+            if in_use is not None and limit:
+                headroom = max(0.0, 1.0 - in_use / limit)
+        except Exception:
+            pass
+    if mfu is None and headroom is None:
+        return None
+    return {"mfu": mfu, "hbm_headroom": headroom}
+
+
 def default_buckets(max_batch_size: int) -> Tuple[int, ...]:
     """Powers of two up to (and including) ``max_batch_size`` — the
     static batch shapes the server compiles. log2(B_max)+1 programs
@@ -861,6 +904,10 @@ class BatchedPolicyServer:
             "params_version": self.params_version,
             "fused": self.fused,
             "vectorized": self.vectorized,
+            # the ledger autoscale signal rides the same stats pull
+            # the queue-wait targeting already makes (None when the
+            # host can report neither MFU nor HBM headroom)
+            "device": device_ledger_summary(),
             "buckets": list(self.buckets),
             "aot": (
                 self.aot_cache.stats()

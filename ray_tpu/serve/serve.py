@@ -52,6 +52,21 @@ DEFAULT_AUTOSCALING = {
     # targeting already polls stats)
     "health_check_interval_s": None,
     "stats_timeout_s": 2.0,
+    # signal source (docs/serving.md "ledger-driven autoscaling"):
+    # "queue_wait" — inflight + queue-wait targeting (the default);
+    # "ledger" — device-ledger targeting off replica stats: mean
+    # batch-fill fraction (buckets running full = saturated compute)
+    # with an HBM-headroom gate on scale-up; "both" — scale up when
+    # EITHER side runs hot, down only when BOTH have cooled
+    "signal": "queue_wait",
+    # ledger targeting: mean fill above this = the fused buckets are
+    # full and more replicas would cut real queueing; fill under half
+    # of it = forwards are mostly padding, replicas can go
+    "target_batch_fill": 0.85,
+    # scale-up gate: never add a replica when the device reports less
+    # than this fraction of HBM free — a replica that cannot fit its
+    # params + activations only thrashes the allocator
+    "min_hbm_headroom": 0.1,
 }
 
 
@@ -408,13 +423,23 @@ class RunningDeployment:
             per = ongoing / max(1, n)
             target = cfg["target_num_ongoing_requests_per_replica"]
             now = time.monotonic()
-            # -- replica stats pass (queue-wait targeting / health) --
+            # -- signal source selection (ledger autoscaling) --------
+            source = cfg.get("signal") or "queue_wait"
+            use_queue = source in ("queue_wait", "both")
+            use_ledger = source in ("ledger", "both")
+            # -- replica stats pass (queue-wait / ledger / health) ---
             wait_target = cfg.get("target_queue_wait_s")
             health_every = cfg.get("health_check_interval_s")
             wait_signal = None
-            need_stats = wait_target is not None or (
-                health_every is not None
-                and now - self._last_health >= health_every
+            fill_signal = None
+            hbm_headroom = None
+            need_stats = (
+                wait_target is not None
+                or use_ledger
+                or (
+                    health_every is not None
+                    and now - self._last_health >= health_every
+                )
             )
             if need_stats:
                 self._last_health = now
@@ -424,14 +449,35 @@ class RunningDeployment:
                 self._replace_dead(
                     [r for r, s in pairs if s == "dead"]
                 )
+                dicts = [
+                    s for _, s in pairs if isinstance(s, dict)
+                ]
                 waits = [
                     s["queue_wait_p50_s"]
-                    for _, s in pairs
-                    if isinstance(s, dict)
-                    and s.get("queue_wait_p50_s") is not None
+                    for s in dicts
+                    if s.get("queue_wait_p50_s") is not None
                 ]
                 if waits:
                     wait_signal = max(waits)
+                # the device-ledger side of the same stats payload:
+                # bucket occupancy + HBM headroom, reported by the
+                # policy server (policy_server.stats()["device"])
+                fills = [
+                    s["batch_fill_fraction"]
+                    for s in dicts
+                    if s.get("batch_fill_fraction") is not None
+                    and s.get("batches_total")
+                ]
+                if fills:
+                    fill_signal = sum(fills) / len(fills)
+                rooms = [
+                    s["device"]["hbm_headroom"]
+                    for s in dicts
+                    if isinstance(s.get("device"), dict)
+                    and s["device"].get("hbm_headroom") is not None
+                ]
+                if rooms:
+                    hbm_headroom = min(rooms)
                 with self._members_lock:
                     n = len(self.replicas)
             wait_hot = (
@@ -445,8 +491,33 @@ class RunningDeployment:
                 wait_signal is None
                 or wait_signal < 0.25 * wait_target
             )
+            queue_hot = use_queue and (per > target or wait_hot)
+            queue_cool = not use_queue or (
+                per < 0.5 * target and wait_cool
+            )
+            fill_target = cfg.get("target_batch_fill") or 0.85
+            ledger_hot = (
+                use_ledger
+                and fill_signal is not None
+                and fill_signal > fill_target
+            )
+            ledger_cool = not use_ledger or (
+                fill_signal is None
+                or fill_signal < 0.5 * fill_target
+            )
+            # scale-up is gated on device headroom regardless of what
+            # ran hot: no room for another replica's params means an
+            # upscale only trades queueing for allocator thrash
+            min_room = cfg.get("min_hbm_headroom")
+            hbm_blocked = (
+                use_ledger
+                and min_room is not None
+                and hbm_headroom is not None
+                and hbm_headroom < min_room
+            )
             if (
-                (per > target or wait_hot)
+                (queue_hot or ledger_hot)
+                and not hbm_blocked
                 and n < cfg["max_replicas"]
                 and now - self._last_scale >= cfg["upscale_delay_s"]
             ):
@@ -462,8 +533,8 @@ class RunningDeployment:
                 self._last_scale = now
                 self._publish()
             elif (
-                per < 0.5 * target
-                and wait_cool
+                queue_cool
+                and ledger_cool
                 and n > cfg["min_replicas"]
                 and now - self._last_scale >= cfg["downscale_delay_s"]
             ):
@@ -748,6 +819,41 @@ def update_deployment(
         dep.reconfigure(user_config)
     if num_replicas is not None:
         dep.set_num_replicas(num_replicas)
+
+
+def autoscale(
+    name: str,
+    *,
+    signal: Optional[str] = None,
+    **overrides: Any,
+) -> Dict[str, Any]:
+    """Retune a RUNNING deployment's autoscaler in place — switch the
+    signal source (``"queue_wait"`` / ``"ledger"`` / ``"both"``) or
+    override any ``DEFAULT_AUTOSCALING`` knob (``target_batch_fill``,
+    ``min_hbm_headroom``, ``target_queue_wait_s``, delays, bounds…)
+    without restarting replicas: the loop reads its config dict every
+    interval, so the next tick acts on the new targets. Returns the
+    deployment's effective autoscaling config."""
+    dep = _DEPLOYMENTS[name]
+    if dep.autoscaling is None:
+        raise ValueError(
+            f"deployment {name!r} runs without an autoscaler; "
+            "deploy with autoscaling_config= to enable one"
+        )
+    if signal is not None:
+        if signal not in ("queue_wait", "ledger", "both"):
+            raise ValueError(
+                "signal must be 'queue_wait', 'ledger' or 'both', "
+                f"got {signal!r}"
+            )
+        overrides["signal"] = signal
+    unknown = set(overrides) - set(DEFAULT_AUTOSCALING)
+    if unknown:
+        raise ValueError(
+            f"unknown autoscaling keys: {sorted(unknown)}"
+        )
+    dep.autoscaling.update(overrides)
+    return dict(dep.autoscaling)
 
 
 def _start_http(host: str, port: int):

@@ -150,6 +150,20 @@ INGRESS_REQUESTS_TOTAL = "ray_tpu_ingress_requests_total"
 INGRESS_INFLIGHT = "ray_tpu_ingress_inflight"
 INGRESS_SHED_TOTAL = "ray_tpu_ingress_shed_total"
 INGRESS_LATENCY_SECONDS = "ray_tpu_ingress_latency_seconds"
+# multi-process front door (ingress/supervisor.py): live worker
+# processes in the bank, workers respawned after a crash, and admitted
+# in-flight per policy (the per-tenant quota's observable)
+INGRESS_WORKERS = "ray_tpu_ingress_workers"
+INGRESS_WORKER_RESPAWNS_TOTAL = (
+    "ray_tpu_ingress_worker_respawns_total"
+)
+INGRESS_POLICY_INFLIGHT = "ray_tpu_ingress_policy_inflight"
+# open-loop flood harness (bench.py --flood): offered vs achieved
+# rate of the CURRENT sweep step, and responses by contract outcome
+# (ok / shed_429 / shed_503 / expired_504)
+FLOOD_OFFERED_RPS = "ray_tpu_flood_offered_rps"
+FLOOD_GOODPUT_RPS = "ray_tpu_flood_goodput_rps"
+FLOOD_RESPONSES_TOTAL = "ray_tpu_flood_responses_total"
 # cross-replica coalescing router (ingress/router.py): dispatched
 # buckets, rows merged into them, requests dropped at their deadline
 # BEFORE dispatch, and batches re-routed off a dead replica
@@ -666,6 +680,7 @@ def set_ingress_inflight(n: int) -> None:
 
 def inc_ingress_shed(reason: str, n: int = 1) -> None:
     """One request shed at the ingress: ``inflight`` (budget
+    exhausted → 429), ``quota`` (the POLICY's in-flight share
     exhausted → 429), ``queue_wait`` (replica waits over target →
     503), or ``deadline`` (already expired on arrival → 504)."""
     counter(
@@ -687,6 +702,69 @@ def observe_ingress_latency(route: str, seconds: float) -> None:
             tag_keys=("route",),
         )
     m.observe(float(seconds), {"route": route})
+
+
+def set_ingress_workers(state: str, n: int) -> None:
+    """Worker-process census of the multi-process front door bank
+    (ingress/supervisor.py): ``state="live"`` is the processes
+    currently accepting on the shared port; ``state="target"`` the
+    configured bank size."""
+    gauge(
+        INGRESS_WORKERS,
+        "ingress worker processes by state",
+        ("state",),
+    ).set(float(n), {"state": state})
+
+
+def inc_ingress_worker_respawns(n: int = 1) -> None:
+    """One crashed ingress worker the supervisor replaced (the bank
+    keeps accepting on the shared port throughout)."""
+    counter(
+        INGRESS_WORKER_RESPAWNS_TOTAL,
+        "ingress worker processes respawned after a crash",
+    ).inc(float(n))
+
+
+def set_ingress_policy_inflight(policy: str, n: int) -> None:
+    """Admitted in-flight requests of ONE policy — the observable the
+    per-tenant quota bounds (shed reason ``quota`` fires when a
+    policy's next request would exceed its share)."""
+    gauge(
+        INGRESS_POLICY_INFLIGHT,
+        "admitted in-flight ingress requests per policy",
+        ("policy",),
+    ).set(float(n), {"policy": policy})
+
+
+def set_flood_offered_rps(rps: float) -> None:
+    """Open-loop offered arrival rate of the flood harness's current
+    sweep step (arrivals are scheduled, never gated on responses)."""
+    gauge(
+        FLOOD_OFFERED_RPS,
+        "flood harness offered arrival rate (open loop)",
+    ).set(float(rps))
+
+
+def set_flood_goodput_rps(rps: float) -> None:
+    """In-deadline 200 responses per second the mesh actually
+    sustained at the current offered rate — goodput, not throughput."""
+    gauge(
+        FLOOD_GOODPUT_RPS,
+        "flood harness in-deadline 200 responses per second",
+    ).set(float(rps))
+
+
+def inc_flood_response(kind: str, n: int = 1) -> None:
+    """One flood response by contract outcome: ``ok`` (200 within
+    deadline), ``shed_429`` / ``shed_503`` / ``expired_504`` (the
+    overload contract), ``late_200`` (a 200 past its deadline — a
+    contract VIOLATION the harness asserts never happens), or
+    ``error``."""
+    counter(
+        FLOOD_RESPONSES_TOTAL,
+        "flood harness responses by contract outcome",
+        ("kind",),
+    ).inc(float(n), {"kind": kind})
 
 
 def observe_router_batch(deployment: str, rows: int) -> None:

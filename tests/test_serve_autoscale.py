@@ -113,3 +113,147 @@ def test_rescale_propagates_to_handle_via_long_poll():
         time.sleep(0.1)
     assert handle.num_replicas() == 3
     assert ray.get(handle.remote(1)) == 2
+
+
+# -- ledger-driven autoscaling (signal="ledger"/"both") -----------------
+
+# replicas are separate worker processes, so tests steer the device
+# ledger they report through the user_config push (reconfigure) — the
+# same live-update channel production uses, no load generation needed
+
+
+def _ledger_deployment(name, fill=0.0, headroom=1.0, **autoscaling):
+    cfg = {
+        "min_replicas": 1,
+        "max_replicas": 3,
+        "signal": "ledger",
+        "target_batch_fill": 0.8,
+        "upscale_delay_s": 0.1,
+        "downscale_delay_s": 0.3,
+        "interval_s": 0.1,
+    }
+    cfg.update(autoscaling)
+
+    @serve.deployment(
+        name=name,
+        autoscaling_config=cfg,
+        user_config={"fill": fill, "headroom": headroom},
+    )
+    class LedgerModel:
+        def __init__(self):
+            self.fill = 0.0
+            self.headroom = 1.0
+
+        def reconfigure(self, config):
+            self.fill = config["fill"]
+            self.headroom = config["headroom"]
+
+        def __call__(self, x):
+            return x
+
+        def stats(self):
+            return {
+                "batch_fill_fraction": self.fill,
+                "batches_total": 100,
+                "device": {
+                    "mfu": 0.5,
+                    "hbm_headroom": self.headroom,
+                },
+            }
+
+    return serve.run(LedgerModel.bind())
+
+
+def _set_ledger(name, fill, headroom=1.0):
+    serve.update_deployment(
+        name, user_config={"fill": fill, "headroom": headroom}
+    )
+
+
+def _wait_replicas(handle, pred, timeout=15.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline and not pred(handle.num_replicas()):
+        time.sleep(0.1)
+    return handle.num_replicas()
+
+
+def test_ledger_signal_scales_on_batch_fill():
+    """signal="ledger": full buckets (not queue wait) drive upscale;
+    near-empty buckets drive downscale — no traffic involved."""
+    handle = _ledger_deployment("ledger_updown")
+    assert handle.num_replicas() == 1
+
+    # buckets consistently past target
+    _set_ledger("ledger_updown", fill=0.95)
+    n = _wait_replicas(handle, lambda n: n >= 2)
+    assert n >= 2, "no upscale on hot batch fill"
+
+    # forwards are mostly padding
+    _set_ledger("ledger_updown", fill=0.1)
+    n = _wait_replicas(handle, lambda n: n == 1)
+    assert n == 1, "no downscale on cold batch fill"
+
+
+def test_ledger_hbm_headroom_gates_upscale():
+    """A hot fill signal must NOT add replicas when the device
+    reports no HBM headroom for another replica's params."""
+    handle = _ledger_deployment(
+        "ledger_gated", fill=0.95, headroom=0.02  # hot, no room
+    )
+    time.sleep(1.5)  # many autoscale ticks
+    assert handle.num_replicas() == 1, "upscaled into full HBM"
+
+    # room freed: the SAME fill signal now scales
+    _set_ledger("ledger_gated", fill=0.95, headroom=0.9)
+    n = _wait_replicas(handle, lambda n: n >= 2)
+    assert n >= 2, "no upscale after headroom freed"
+
+
+def test_serve_autoscale_retunes_running_loop():
+    """serve.autoscale() swaps signal source / targets in place; the
+    next tick acts on them — no replica restart."""
+    handle = _ledger_deployment(
+        "ledger_retune", fill=0.95, signal="queue_wait"
+    )
+    # queue_wait source ignores the ledger: hot fill does nothing
+    time.sleep(1.0)
+    assert handle.num_replicas() == 1
+
+    cfg = serve.autoscale("ledger_retune", signal="ledger")
+    assert cfg["signal"] == "ledger"
+    n = _wait_replicas(handle, lambda n: n >= 2)
+    assert n >= 2, "retuned signal source not picked up"
+
+    # knob override without restart
+    cfg = serve.autoscale(
+        "ledger_retune", target_batch_fill=0.99
+    )
+    assert cfg["target_batch_fill"] == 0.99
+
+
+def test_serve_autoscale_validates_inputs():
+    _ledger_deployment("ledger_valid")
+    with pytest.raises(ValueError):
+        serve.autoscale("ledger_valid", signal="vibes")
+    with pytest.raises(ValueError):
+        serve.autoscale("ledger_valid", not_a_knob=1)
+
+    @serve.deployment(name="static_dep", num_replicas=1)
+    class Static:
+        def __call__(self, x):
+            return x
+
+    serve.run(Static.bind())
+    with pytest.raises(ValueError):
+        serve.autoscale("static_dep", signal="ledger")
+
+
+def test_device_ledger_summary_env_pin(monkeypatch):
+    """RAY_TPU_HBM_HEADROOM pins the reported headroom (the test/CPU
+    escape hatch documented on device_ledger_summary)."""
+    pytest.importorskip("jax")
+    from ray_tpu.serve import policy_server
+
+    monkeypatch.setenv("RAY_TPU_HBM_HEADROOM", "0.33")
+    s = policy_server.device_ledger_summary()
+    assert s["hbm_headroom"] == pytest.approx(0.33)
